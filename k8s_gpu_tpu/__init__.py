@@ -1,0 +1,32 @@
+"""k8s_gpu_tpu — a TPU-native accelerator-pool operator & training platform.
+
+A brand-new framework with the capability surface of the reference
+(`Andy-ckm/K8S-GPU-`, a documentation-only repo specifying an `AzureVmPool`
+Kubernetes operator for GPU-VM pools plus the "GoHai" multi-tenant AI
+platform; see /root/repo/SURVEY.md), re-designed TPU-first:
+
+- ``api``        — typed custom-resource models (AzureVmPool parity per
+                   reference README.md:83-156; TpuPodSlice, the TPU-native CRD).
+- ``controller`` — a homegrown controller runtime: in-memory API server fake,
+                   rate-limited work queues, reconciler manager with
+                   RequeueAfter semantics (reference README.md:167-236).
+- ``cloud``      — cloud backends behind one protocol: FakeAzure (envtest
+                   parity), CloudTPU queued-resources + FakeCloudTPU with
+                   scripted state transitions and fault injection.
+- ``operators``  — the reconcilers (AzureVmPool, TpuPodSlice).
+- ``scheduling`` — ICI-topology node labels, slice-correct placement,
+                   multislice DCN-aware anti-affinity.
+- ``parallel``   — jax.sharding mesh construction over ('dcn','ici') and
+                   dp/fsdp/tp/sp logical axes, collectives, ring attention.
+- ``models``     — flagship transformer LM + the reference's CNN workload
+                   (GPU调度平台搭建.md:557-636 parity).
+- ``ops``        — attention kernels (Pallas on TPU, jnp fallback).
+- ``train``      — training-job runner: distributed init, train loop,
+                   checkpointing.
+- ``platform``   — job-template expansion, instance-type catalog, assets,
+                   quota (GPU调度平台搭建.md:512-552, 686-744).
+- ``cli``        — GoHai-parity CLI verbs (GPU调度平台搭建.md:447-552).
+- ``utils``      — structured logging, metrics registry, clocks.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,32 @@
+from .types import (
+    ObjectMeta,
+    Condition,
+    CustomResource,
+    ValidationError,
+    set_condition,
+    get_condition,
+)
+from .azurevmpool import AzureVmPool, AzureVmPoolSpec, AzureVmPoolStatus, ImageReference
+from .tpupodslice import TpuPodSlice, TpuPodSliceSpec, TpuPodSliceStatus, SliceStatus
+from .core import Secret, Node, Event, Pod
+
+__all__ = [
+    "ObjectMeta",
+    "Condition",
+    "CustomResource",
+    "ValidationError",
+    "set_condition",
+    "get_condition",
+    "AzureVmPool",
+    "AzureVmPoolSpec",
+    "AzureVmPoolStatus",
+    "ImageReference",
+    "TpuPodSlice",
+    "TpuPodSliceSpec",
+    "TpuPodSliceStatus",
+    "SliceStatus",
+    "Secret",
+    "Node",
+    "Event",
+    "Pod",
+]

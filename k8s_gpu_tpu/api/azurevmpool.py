@@ -1,0 +1,74 @@
+"""AzureVmPool CRD — capability parity with the reference's core artifact.
+
+Field-for-field parity with the reference's Go types (reference
+README.md:83-156: spec 92-110, image 113-118, status 121-128, printer columns
+130-133).  Group/version kept identical (``compute.my.domain/v1alpha1``,
+reference README.md:76) so BASELINE config 1 ("AzureVmPool replicas=2
+reconcile under envtest") is checked against the same schema.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .types import CustomResource, Condition, ValidationError
+
+
+@dataclass
+class ImageReference:
+    """reference README.md:113-118."""
+
+    publisher: str = "Canonical"
+    offer: str = "0001-com-ubuntu-server-jammy"
+    sku: str = "22_04-lts-gen2"
+    version: str = "latest"
+
+
+@dataclass
+class AzureVmPoolSpec:
+    """reference README.md:92-110."""
+
+    replicas: int = 0
+    resource_group_name: str = ""
+    location: str = ""
+    vm_size: str = ""
+    vnet_name: str = ""
+    subnet_name: str = ""
+    image_reference: ImageReference = field(default_factory=ImageReference)
+    # Name of the K8s Secret holding AZURE_CLIENT_ID/SECRET/TENANT_ID/
+    # SUBSCRIPTION_ID (reference README.md:107-109, 244-252).
+    azure_credential_secret: str = ""
+
+
+@dataclass
+class VmInfo:
+    name: str = ""
+    provisioning_state: str = ""
+
+
+@dataclass
+class AzureVmPoolStatus:
+    """reference README.md:121-128."""
+
+    ready_replicas: int = 0
+    vms: list[VmInfo] = field(default_factory=list)
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class AzureVmPool(CustomResource):
+    kind: str = "AzureVmPool"
+    api_version: str = "compute.my.domain/v1alpha1"
+    spec: AzureVmPoolSpec = field(default_factory=AzureVmPoolSpec)
+    status: AzureVmPoolStatus = field(default_factory=AzureVmPoolStatus)
+
+    def validate(self) -> None:
+        super().validate()
+        # kubebuilder:validation:Minimum=0 (reference README.md:94).
+        if self.spec.replicas < 0:
+            raise ValidationError("spec.replicas must be >= 0")
+
+    # Printer columns Desired/Ready (reference README.md:132-133).
+    @property
+    def printer_columns(self) -> dict[str, int]:
+        return {"Desired": self.spec.replicas, "Ready": self.status.ready_replicas}

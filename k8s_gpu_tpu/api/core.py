@@ -1,0 +1,63 @@
+"""Built-in (non-custom) kinds the controllers interact with: Secret, Node,
+Event, Pod — the minimal core-API subset the reference operator touches
+(credential Secret, reference README.md:107-109, 244-252; Events README.md:311;
+nodes joining with device-plugin resources GPU调度平台搭建.md:128-138)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .types import CustomResource, ObjectMeta, Condition
+
+
+@dataclass
+class Secret(CustomResource):
+    kind: str = "Secret"
+    api_version: str = "v1"
+    data: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Node(CustomResource):
+    """A cluster node.  TPU nodes carry the device-plugin extended resource
+    ``google.com/tpu`` (the libtpu analogue of ``nvidia.com/gpu``,
+    GPU调度平台搭建.md:128-138) and ICI-topology labels used for
+    slice-correct placement (BASELINE.json config 3)."""
+
+    kind: str = "Node"
+    api_version: str = "v1"
+    capacity: dict[str, int] = field(default_factory=dict)
+    allocatable: dict[str, int] = field(default_factory=dict)
+    conditions: list[Condition] = field(default_factory=list)
+    ready: bool = False
+
+
+@dataclass
+class Event(CustomResource):
+    """Kubernetes Event parity (reference README.md:311: emit Events on VM
+    create/delete so ``kubectl describe`` shows operator activity)."""
+
+    kind: str = "Event"
+    api_version: str = "v1"
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = ""
+    type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+
+
+@dataclass
+class Pod(CustomResource):
+    """Minimal pod model: enough for the scheduler/placement layer — resource
+    requests, node selector/affinity, assigned node, phase."""
+
+    kind: str = "Pod"
+    api_version: str = "v1"
+    requests: dict[str, int] = field(default_factory=dict)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    node_name: str = ""
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+    # Pod-group id for gang semantics / multislice spread (SURVEY §2.7).
+    group: str = ""

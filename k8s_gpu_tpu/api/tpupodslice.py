@@ -1,0 +1,112 @@
+"""TpuPodSlice CRD — the TPU-native pool resource (BASELINE.json north star).
+
+Where the reference pools individual Azure GPU VMs (AzureVmPool,
+reference README.md:83-156), the atomic capacity unit on TPU is a *pod
+slice*: an all-or-nothing block of chips wired by ICI.  The CRD therefore
+declares slices (acceleratorType + topology + sliceCount for multislice)
+rather than VM replicas, and the reconciler drives Cloud TPU queued
+resources (CREATING→ACTIVE) rather than VM+NIC+Disk create/delete.
+
+Design notes vs the reference:
+- ``spec.slice_count`` > 1 == multislice over DCN (BASELINE config 4);
+  gang semantics are inherent (a slice is atomic — SURVEY §2.7), so there is
+  no Volcano-style ``minAvailable`` field.
+- ``spec.workload_identity`` replaces the Azure Service-Principal secret
+  (reference README.md:43-57; BASELINE north star: GCP Workload Identity).
+- ``status.ready_replicas`` keeps the reference's printer-column/parity
+  semantics (reference README.md:121-133): it counts *ready slices*.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .types import CustomResource, Condition, ValidationError
+from ..cloud.topology import parse_accelerator_type
+
+
+@dataclass
+class TpuPodSliceSpec:
+    # e.g. "v4-8", "v5p-64", "v5e-256" (BASELINE configs 2-4).
+    accelerator_type: str = "v4-8"
+    # Optional explicit chip topology ("4x4x4"); derived from accelerator
+    # type when empty.  Validated for consistency.
+    topology: str = ""
+    # Number of identical slices (multislice when > 1).
+    slice_count: int = 1
+    # TPU software stack on the hosts.
+    runtime_version: str = "tpu-ubuntu2204-base"
+    # GCP project/zone targeting.
+    project: str = ""
+    zone: str = ""
+    network: str = "default"
+    # Kubernetes ServiceAccount annotated for GCP Workload Identity; the
+    # client factory exchanges it for cloud credentials (no secret material
+    # in-cluster — the hardening step the reference defers, README.md:312).
+    workload_identity: str = "tpu-provisioner"
+    # Queued-resource niceties.
+    reserved: bool = False
+    spot: bool = False
+    # Best-effort provisioning deadline used for the Ready SLO.
+    provisioning_timeout_s: float = 300.0
+
+
+@dataclass
+class SliceStatus:
+    name: str = ""
+    state: str = ""  # queued-resource state: WAITING|PROVISIONING|ACTIVE|FAILED...
+    nodes_total: int = 0
+    nodes_ready: int = 0
+
+
+@dataclass
+class TpuPodSliceStatus:
+    # Ready *slices* (printer-column parity with the reference's
+    # readyReplicas, README.md:121-133).
+    ready_replicas: int = 0
+    slices: list[SliceStatus] = field(default_factory=list)
+    conditions: list[Condition] = field(default_factory=list)
+    # Aggregate queued-resource phase for kubectl get output.
+    phase: str = "Pending"
+    observed_generation: int = 0
+
+
+@dataclass
+class TpuPodSlice(CustomResource):
+    kind: str = "TpuPodSlice"
+    api_version: str = "tpu.k8sgpu.dev/v1alpha1"
+    spec: TpuPodSliceSpec = field(default_factory=TpuPodSliceSpec)
+    status: TpuPodSliceStatus = field(default_factory=TpuPodSliceStatus)
+
+    def validate(self) -> None:
+        super().validate()
+        if self.spec.slice_count < 0:
+            raise ValidationError("spec.sliceCount must be >= 0")
+        try:
+            info = parse_accelerator_type(self.spec.accelerator_type)
+        except ValueError as e:
+            raise ValidationError(str(e)) from e
+        if self.spec.topology:
+            try:
+                dims = tuple(int(d) for d in self.spec.topology.split("x"))
+            except ValueError as e:
+                raise ValidationError(
+                    f"malformed topology {self.spec.topology!r}; want e.g. '4x4x4'"
+                ) from e
+            prod = 1
+            for d in dims:
+                prod *= d
+            if prod != info.chips:
+                raise ValidationError(
+                    f"topology {self.spec.topology} has {prod} chips but "
+                    f"{self.spec.accelerator_type} requires {info.chips}"
+                )
+
+    @property
+    def printer_columns(self) -> dict:
+        return {
+            "Accelerator": self.spec.accelerator_type,
+            "Desired": self.spec.slice_count,
+            "Ready": self.status.ready_replicas,
+            "Phase": self.status.phase,
+        }

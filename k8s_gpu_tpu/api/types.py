@@ -1,0 +1,102 @@
+"""Core object-model types for the homegrown controller runtime.
+
+Mirrors the apimachinery surface the reference's Go operator relies on
+(ObjectMeta, Conditions, status subresource; reference README.md:83-156) as
+plain dataclasses.  Objects are deep-copied at the API-server boundary, so
+mutating a fetched object never mutates the stored copy — the same
+"serialize through the wire" discipline a real cluster enforces.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class ValidationError(Exception):
+    """Rejected by schema validation (kubebuilder-marker parity,
+    e.g. ``Minimum=0`` on replicas, reference README.md:94)."""
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: float | None = None
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    finalizers: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Condition:
+    """metav1.Condition parity (reference README.md:127, 310: rich Conditions
+    such as Provisioning/Ready/Deleting/Failed are a hardening requirement)."""
+
+    type: str
+    status: str  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+    observed_generation: int = 0
+
+
+def set_condition(
+    conditions: list[Condition],
+    ctype: str,
+    status: str,
+    reason: str = "",
+    message: str = "",
+    now: float | None = None,
+    observed_generation: int = 0,
+) -> None:
+    """Upsert a condition; transition time only changes when status flips."""
+    ts = time.time() if now is None else now
+    for c in conditions:
+        if c.type == ctype:
+            if c.status != status:
+                c.last_transition_time = ts
+            c.status = status
+            c.reason = reason
+            c.message = message
+            c.observed_generation = observed_generation
+            return
+    conditions.append(
+        Condition(ctype, status, reason, message, ts, observed_generation)
+    )
+
+
+def get_condition(conditions: list[Condition], ctype: str) -> Condition | None:
+    for c in conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+@dataclass
+class CustomResource:
+    """Base for all API objects stored in the (fake) API server."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    # Subclasses override.
+    api_version: str = "v1"
+    kind: str = "CustomResource"
+
+    def validate(self) -> None:
+        """Schema validation hook; raise ValidationError to reject a write."""
+        if not self.metadata.name:
+            raise ValidationError("metadata.name is required")
+
+    def deepcopy(self):
+        return copy.deepcopy(self)
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.metadata.namespace, self.metadata.name)

@@ -1,0 +1,23 @@
+from .base import (
+    CloudError,
+    AuthError,
+    CloudPoolBackend,
+)
+from .topology import TpuTopology, parse_accelerator_type, default_topology
+from .fake_azure import FakeAzureCloud, FakeAzureClient, azure_client_factory
+from .fake_cloudtpu import FakeCloudTpu, QueuedResource, cloudtpu_client_factory
+
+__all__ = [
+    "CloudError",
+    "AuthError",
+    "CloudPoolBackend",
+    "TpuTopology",
+    "parse_accelerator_type",
+    "default_topology",
+    "FakeAzureCloud",
+    "FakeAzureClient",
+    "azure_client_factory",
+    "FakeCloudTpu",
+    "QueuedResource",
+    "cloudtpu_client_factory",
+]

@@ -1,0 +1,44 @@
+"""The one cloud interface every pool reconciler programs against.
+
+The reference hides its Azure client construction behind an unshown factory
+(``getAzureVMClient``, reference README.md:179-185) — the natural fake seam
+(SURVEY §4).  We make that seam explicit: reconcilers depend only on this
+protocol, and backends (FakeAzure, FakeCloudTpu, a real Cloud TPU client)
+plug in behind it.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+class CloudError(Exception):
+    """Transient cloud-API failure; reconcilers translate these into
+    RequeueAfter retries (reference README.md:184-219 retry ladder)."""
+
+
+class AuthError(CloudError):
+    """Credential exchange failed (bad/missing secret or identity)."""
+
+
+@runtime_checkable
+class CloudPoolBackend(Protocol):
+    """list-by-tag / create / delete / readiness — the four verbs the
+    reconcile contract needs (reference README.md:187-240)."""
+
+    def list_resources(self, tags: dict[str, str]) -> list:
+        """Inventory strictly filtered by ownership tags — the anti-foot-gun
+        that prevents touching unmanaged resources (reference README.md:238)."""
+        ...
+
+    def create_resource(self, name: str, spec, tags: dict[str, str]):
+        """Idempotent create (re-creating an existing name is a no-op)."""
+        ...
+
+    def delete_resource(self, name: str) -> None:
+        """Idempotent delete including all attachments (the reference's
+        NIC + OS-disk cost-leak rule, README.md:239)."""
+        ...
+
+    def is_ready(self, resource) -> bool:
+        ...

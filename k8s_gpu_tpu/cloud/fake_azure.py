@@ -1,0 +1,166 @@
+"""Fake Azure Compute backend — envtest parity (BASELINE config 1).
+
+Simulates the slice of the Azure API the reference operator drives
+(reference README.md:27-30, 187-240): VM create (with NIC + OS disk
+attachments), tag-filtered list, delete (which must also delete NIC + disk
+— the cost-leak rule, README.md:239), provisioning-state transitions, and
+scripted fault injection for the retry-ladder tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from .base import AuthError, CloudError
+from ..utils.clock import Clock, RealClock
+
+VALID_CRED_KEYS = (
+    "AZURE_CLIENT_ID",
+    "AZURE_CLIENT_SECRET",
+    "AZURE_TENANT_ID",
+    "AZURE_SUBSCRIPTION_ID",
+)
+
+
+@dataclass
+class FakeVm:
+    name: str
+    vm_size: str = ""
+    location: str = ""
+    tags: dict[str, str] = field(default_factory=dict)
+    provisioning_state: str = "Creating"  # Creating -> Succeeded
+    nic: str = ""
+    disk: str = ""
+    created_at: float = 0.0
+
+
+@dataclass
+class FaultPlan:
+    """Scripted failures: consume-on-use counters per verb."""
+
+    fail_creates: int = 0
+    fail_deletes: int = 0
+    fail_lists: int = 0
+    fail_auth: int = 0
+
+
+class FakeAzureCloud:
+    """The cloud side: shared inventory of VMs/NICs/disks."""
+
+    def __init__(self, clock: Clock | None = None, provisioning_delay: float = 0.0):
+        self.clock = clock or RealClock()
+        self.provisioning_delay = provisioning_delay
+        self.vms: dict[str, FakeVm] = {}
+        self.nics: dict[str, str] = {}
+        self.disks: dict[str, str] = {}
+        self.faults = FaultPlan()
+        self.api_calls: list[str] = []
+        self._lock = threading.RLock()
+
+    def _settle(self) -> None:
+        now = self.clock.now()
+        for vm in self.vms.values():
+            if (
+                vm.provisioning_state == "Creating"
+                and now - vm.created_at >= self.provisioning_delay
+            ):
+                vm.provisioning_state = "Succeeded"
+
+    # -- verbs used by the client ------------------------------------------
+    def list_vms(self, tags: dict[str, str]) -> list[FakeVm]:
+        with self._lock:
+            self.api_calls.append("list")
+            if self.faults.fail_lists > 0:
+                self.faults.fail_lists -= 1
+                raise CloudError("injected: list VMs failed")
+            self._settle()
+            return [
+                FakeVm(**vars(vm))
+                for vm in self.vms.values()
+                if all(vm.tags.get(k) == v for k, v in tags.items())
+            ]
+
+    def create_vm(self, name: str, spec, tags: dict[str, str]) -> FakeVm:
+        with self._lock:
+            self.api_calls.append("create")
+            if self.faults.fail_creates > 0:
+                self.faults.fail_creates -= 1
+                raise CloudError("injected: create VM failed")
+            if name in self.vms:  # idempotency (reference README.md:240)
+                return self.vms[name]
+            vm = FakeVm(
+                name=name,
+                vm_size=getattr(spec, "vm_size", ""),
+                location=getattr(spec, "location", ""),
+                tags=dict(tags),
+                nic=f"{name}-nic",
+                disk=f"{name}-osdisk",
+                created_at=self.clock.now(),
+            )
+            self.vms[name] = vm
+            self.nics[vm.nic] = name
+            self.disks[vm.disk] = name
+            if self.provisioning_delay <= 0:
+                vm.provisioning_state = "Succeeded"
+            return vm
+
+    def delete_vm(self, name: str) -> None:
+        with self._lock:
+            self.api_calls.append("delete")
+            if self.faults.fail_deletes > 0:
+                self.faults.fail_deletes -= 1
+                raise CloudError("injected: delete VM failed")
+            vm = self.vms.pop(name, None)
+            if vm is None:
+                return  # idempotent
+            # The cost-leak rule: NIC and OS disk go with the VM
+            # (reference README.md:239).
+            self.nics.pop(vm.nic, None)
+            self.disks.pop(vm.disk, None)
+
+    @property
+    def leaked_attachments(self) -> int:
+        """NICs/disks whose VM no longer exists — must always be 0."""
+        with self._lock:
+            leaks = [n for n, vm in self.nics.items() if vm not in self.vms]
+            leaks += [d for d, vm in self.disks.items() if vm not in self.vms]
+            return len(leaks)
+
+
+class FakeAzureClient:
+    """Authenticated client bound to a FakeAzureCloud (the reference's
+    unshown ``getAzureVMClient`` product, README.md:179-185)."""
+
+    def __init__(self, cloud: FakeAzureCloud, creds: dict[str, str]):
+        missing = [k for k in VALID_CRED_KEYS if not creds.get(k)]
+        if missing:
+            raise AuthError(f"missing credential keys: {missing}")
+        if cloud.faults.fail_auth > 0:
+            cloud.faults.fail_auth -= 1
+            raise AuthError("injected: AAD token exchange failed")
+        self._cloud = cloud
+
+    # CloudPoolBackend protocol
+    def list_resources(self, tags: dict[str, str]) -> list[FakeVm]:
+        return self._cloud.list_vms(tags)
+
+    def create_resource(self, name: str, spec, tags: dict[str, str]) -> FakeVm:
+        return self._cloud.create_vm(name, spec, tags)
+
+    def delete_resource(self, name: str) -> None:
+        self._cloud.delete_vm(name)
+
+    def is_ready(self, resource: FakeVm) -> bool:
+        return resource.provisioning_state == "Succeeded"
+
+
+def azure_client_factory(cloud: FakeAzureCloud):
+    """Returns a factory(secret_data) -> FakeAzureClient, the seam the
+    reconciler uses (reads the credential Secret named in
+    ``spec.azureCredentialSecret``, reference README.md:107-109)."""
+
+    def factory(secret_data: dict[str, str]) -> FakeAzureClient:
+        return FakeAzureClient(cloud, secret_data)
+
+    return factory

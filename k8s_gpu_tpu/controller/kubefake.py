@@ -1,0 +1,206 @@
+"""In-memory Kubernetes API server — the envtest analogue (SURVEY §4 item 2).
+
+Provides the apimachinery semantics the reconcilers depend on:
+
+- typed object store keyed by (kind, namespace, name), deep-copied on every
+  read/write boundary (no shared mutable state with clients);
+- optimistic concurrency via resourceVersion (Conflict on stale writes);
+- a **status subresource** (``update_status`` bumps resourceVersion but not
+  generation; spec updates bump generation — matching
+  ``//+kubebuilder:subresource:status``, reference README.md:130-131);
+- finalizer-aware deletion (delete sets deletionTimestamp and waits for
+  finalizers to clear — the graceful-deletion mechanism the reference lists
+  as hardening, README.md:309);
+- label-selector list, and watch fan-out to subscribers (the event source
+  feeding controller work queues, reference README.md:170).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable
+
+from ..api.types import CustomResource, ValidationError
+
+
+class NotFound(Exception):
+    pass
+
+
+class Conflict(Exception):
+    """Stale resourceVersion — the optimistic-concurrency failure mode the
+    reference's status-update retry guards against (README.md:224-230)."""
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: CustomResource
+
+
+class FakeKube:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: dict[tuple[str, str, str], CustomResource] = {}
+        self._rv = 0
+        self._watchers: dict[str, list[Callable[[WatchEvent], None]]] = {}
+
+    # -- helpers -----------------------------------------------------------
+    def _key(self, kind: str, namespace: str, name: str) -> tuple[str, str, str]:
+        return (kind, namespace, name)
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _notify(self, etype: str, obj: CustomResource) -> None:
+        for cb in self._watchers.get(obj.kind, []) + self._watchers.get("*", []):
+            cb(WatchEvent(etype, obj.deepcopy()))
+
+    # -- CRUD --------------------------------------------------------------
+    def create(self, obj: CustomResource) -> CustomResource:
+        obj.validate()
+        with self._lock:
+            k = self._key(obj.kind, obj.metadata.namespace, obj.metadata.name)
+            if k in self._store:
+                raise Conflict(f"{obj.kind} {k[1]}/{k[2]} already exists")
+            stored = obj.deepcopy()
+            stored.metadata.uid = uuid.uuid4().hex
+            stored.metadata.resource_version = self._next_rv()
+            stored.metadata.generation = 1
+            stored.metadata.creation_timestamp = time.time()
+            self._store[k] = stored
+            self._notify("ADDED", stored)
+            return stored.deepcopy()
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> CustomResource:
+        with self._lock:
+            k = self._key(kind, namespace, name)
+            if k not in self._store:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return self._store[k].deepcopy()
+
+    def try_get(self, kind: str, name: str, namespace: str = "default"):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def update(self, obj: CustomResource) -> CustomResource:
+        """Spec/metadata update: bumps generation when spec changed."""
+        obj.validate()
+        with self._lock:
+            k = self._key(obj.kind, obj.metadata.namespace, obj.metadata.name)
+            cur = self._store.get(k)
+            if cur is None:
+                raise NotFound(f"{obj.kind} {k[1]}/{k[2]} not found")
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(
+                    f"stale resourceVersion {obj.metadata.resource_version} "
+                    f"(current {cur.metadata.resource_version})"
+                )
+            stored = obj.deepcopy()
+            stored.metadata.uid = cur.metadata.uid
+            stored.metadata.creation_timestamp = cur.metadata.creation_timestamp
+            spec_changed = getattr(obj, "spec", None) != getattr(cur, "spec", None)
+            stored.metadata.generation = cur.metadata.generation + (
+                1 if spec_changed else 0
+            )
+            # Status is a subresource: plain updates cannot change it.
+            if hasattr(cur, "status"):
+                stored.status = cur.deepcopy().status
+            # No-op writes don't bump resourceVersion or fire watch events
+            # (API-server semantics; also breaks status-write → watch →
+            # reconcile → status-write hot loops).
+            stored.metadata.resource_version = cur.metadata.resource_version
+            if stored == cur:
+                return stored
+            stored.metadata.resource_version = self._next_rv()
+            self._store[k] = stored
+            self._notify("MODIFIED", stored)
+            # Finalizer removal may complete a pending delete.
+            self._maybe_finalize_delete(k)
+            return stored.deepcopy() if k in self._store else stored
+
+    def update_status(self, obj: CustomResource) -> CustomResource:
+        """Status-subresource update: spec is untouched, generation frozen."""
+        with self._lock:
+            k = self._key(obj.kind, obj.metadata.namespace, obj.metadata.name)
+            cur = self._store.get(k)
+            if cur is None:
+                raise NotFound(f"{obj.kind} {k[1]}/{k[2]} not found")
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(
+                    f"stale resourceVersion {obj.metadata.resource_version} "
+                    f"(current {cur.metadata.resource_version})"
+                )
+            stored = cur.deepcopy()
+            stored.status = obj.deepcopy().status
+            if stored == cur:  # no-op status write (see update())
+                return stored
+            stored.metadata.resource_version = self._next_rv()
+            self._store[k] = stored
+            self._notify("MODIFIED", stored)
+            return stored.deepcopy()
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            k = self._key(kind, namespace, name)
+            cur = self._store.get(k)
+            if cur is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            if cur.metadata.finalizers:
+                if cur.metadata.deletion_timestamp is None:
+                    cur.metadata.deletion_timestamp = time.time()
+                    cur.metadata.resource_version = self._next_rv()
+                    self._notify("MODIFIED", cur)
+                return
+            del self._store[k]
+            self._notify("DELETED", cur)
+
+    def _maybe_finalize_delete(self, k: tuple[str, str, str]) -> None:
+        cur = self._store.get(k)
+        if (
+            cur is not None
+            and cur.metadata.deletion_timestamp is not None
+            and not cur.metadata.finalizers
+        ):
+            del self._store[k]
+            self._notify("DELETED", cur)
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[CustomResource]:
+        with self._lock:
+            out = []
+            for (knd, ns, _), obj in self._store.items():
+                if knd != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not all(
+                    obj.metadata.labels.get(lk) == lv
+                    for lk, lv in label_selector.items()
+                ):
+                    continue
+                out.append(obj.deepcopy())
+            return sorted(out, key=lambda o: (o.metadata.namespace, o.metadata.name))
+
+    # -- watch -------------------------------------------------------------
+    def watch(self, kind: str, callback: Callable[[WatchEvent], None]) -> None:
+        """Subscribe to events for *kind* ('*' = all kinds).  Existing objects
+        are replayed as ADDED (informer cache-sync semantics)."""
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(callback)
+            if kind == "*":
+                existing = [o for o in self._store.values()]
+            else:
+                existing = [o for (k, _, _), o in self._store.items() if k == kind]
+            for obj in existing:
+                callback(WatchEvent("ADDED", obj.deepcopy()))

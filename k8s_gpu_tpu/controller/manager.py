@@ -1,0 +1,180 @@
+"""Controller manager: watches → work queues → reconciler workers.
+
+The controller-runtime role in the reference's stack (reference
+README.md:162-236): each registered controller watches its kind, enqueues
+(namespace, name) keys, and worker threads invoke ``Reconciler.reconcile``
+with level-triggered semantics.  Results carry ``requeue_after`` — the
+reference's retry ladder (30 s auth / 20 s list / 40 s mutate errors,
+60 s steady-state resync; README.md:184,192,207,219,233-234) maps directly
+onto it.  Unhandled exceptions get per-key exponential backoff.
+
+``wait_idle`` gives tests (and bench.py) a deterministic quiescence point:
+all queues drained to "nothing due before the next scheduled resync".
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .kubefake import FakeKube, WatchEvent
+from .workqueue import RateLimitingQueue, ShutDown
+from ..utils.clock import Clock, RealClock
+from ..utils.metrics import MetricsRegistry, global_metrics
+
+log = logging.getLogger("k8s_gpu_tpu.controller")
+
+
+@dataclass(frozen=True)
+class Request:
+    namespace: str
+    name: str
+
+
+@dataclass
+class Result:
+    requeue_after: float | None = None
+    requeue: bool = False
+
+
+class Reconciler:
+    """Protocol: subclasses implement reconcile(request) -> Result."""
+
+    def reconcile(self, req: Request) -> Result:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+@dataclass
+class _Controller:
+    kind: str
+    reconciler: Reconciler
+    queue: RateLimitingQueue
+    workers: int = 1
+    threads: list = field(default_factory=list)
+
+
+class Manager:
+    def __init__(
+        self,
+        kube: FakeKube,
+        clock: Clock | None = None,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.kube = kube
+        self.clock = clock or RealClock()
+        self.metrics = metrics or global_metrics
+        self._controllers: dict[str, _Controller] = {}
+        self._started = False
+        self._stop = threading.Event()
+
+    def register(self, kind: str, reconciler: Reconciler, workers: int = 1) -> None:
+        if self._started:
+            raise RuntimeError("register before start()")
+        q = RateLimitingQueue(clock=self.clock)
+        self._controllers[kind] = _Controller(kind, reconciler, q, workers)
+
+    def start(self) -> None:
+        self._started = True
+        for ctl in self._controllers.values():
+            # Watch feeds the queue.  A generation-changed predicate filters
+            # status-only MODIFIED events (which our own status writes
+            # produce) so reconciles are driven by *meaningful* changes —
+            # controller-runtime's GenerationChangedPredicate; without it
+            # every status write would immediately re-trigger reconcile and
+            # defeat the retry ladder's timing.
+            def make_handler(queue: RateLimitingQueue):
+                seen: dict[Request, tuple] = {}
+
+                def signature(ev: WatchEvent) -> tuple:
+                    # Generation (spec) + deletionTimestamp only — finalizer,
+                    # label and status writes (our own included) don't
+                    # re-trigger; the periodic resync covers everything else.
+                    m = ev.obj.metadata
+                    return (m.generation, m.deletion_timestamp)
+
+                def handle(ev: WatchEvent) -> None:
+                    req = Request(ev.obj.metadata.namespace, ev.obj.metadata.name)
+                    if ev.type == "DELETED":
+                        seen.pop(req, None)
+                        queue.add(req)
+                        return
+                    sig = signature(ev)
+                    if ev.type == "MODIFIED" and seen.get(req) == sig:
+                        return  # status-only change; skip
+                    seen[req] = sig
+                    queue.add(req)
+
+                return handle
+
+            self.kube.watch(ctl.kind, make_handler(ctl.queue))
+            for i in range(ctl.workers):
+                t = threading.Thread(
+                    target=self._worker, args=(ctl,), name=f"{ctl.kind}-worker-{i}",
+                    daemon=True,
+                )
+                ctl.threads.append(t)
+                t.start()
+
+    def _worker(self, ctl: _Controller) -> None:
+        while not self._stop.is_set():
+            try:
+                req = ctl.queue.get()
+            except ShutDown:
+                return
+            t0 = time.perf_counter()
+            try:
+                res = ctl.reconciler.reconcile(req) or Result()
+                ctl.queue.forget(req)
+                ctl.queue.done(req)
+                if res.requeue_after is not None:
+                    ctl.queue.add_after(req, res.requeue_after)
+                elif res.requeue:
+                    ctl.queue.add(req)
+                self.metrics.inc("reconcile_total", kind=ctl.kind, result="ok")
+            except Exception:
+                log.exception("reconcile %s %s failed", ctl.kind, req)
+                ctl.queue.done(req)
+                ctl.queue.add_rate_limited(req)
+                self.metrics.inc("reconcile_total", kind=ctl.kind, result="error")
+            finally:
+                self.metrics.observe(
+                    "reconcile_duration_seconds",
+                    time.perf_counter() - t0,
+                    kind=ctl.kind,
+                )
+
+    def stop(self) -> None:
+        self._stop.set()
+        for ctl in self._controllers.values():
+            ctl.queue.shutdown()
+        for ctl in self._controllers.values():
+            for t in ctl.threads:
+                t.join(timeout=2)
+
+    # -- test/bench helpers ------------------------------------------------
+    def wait_idle(
+        self,
+        timeout: float = 30.0,
+        min_future_delay: float = 1.0,
+        predicate=None,
+    ) -> bool:
+        """Block (real time) until every queue is quiescent: nothing
+        processing and nothing scheduled within *min_future_delay* clock
+        seconds — i.e. only periodic resyncs remain.  Optionally also until
+        *predicate()* is true.  Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            quiet = all(
+                c.queue.idle_no_backlog()
+                and (
+                    (d := c.queue.next_deadline()) is None
+                    or d - self.clock.now() >= min_future_delay
+                )
+                for c in self._controllers.values()
+            )
+            if quiet and (predicate is None or predicate()):
+                return True
+            time.sleep(0.002)
+        return False

@@ -1,0 +1,4 @@
+from .azurevmpool import AzureVmPoolReconciler
+from .tpupodslice import TpuPodSliceReconciler
+
+__all__ = ["AzureVmPoolReconciler", "TpuPodSliceReconciler"]

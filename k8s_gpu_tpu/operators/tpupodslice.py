@@ -1,0 +1,342 @@
+"""TpuPodSlice reconciler — the TPU-native core loop (BASELINE north star).
+
+Keeps the reference's reconcile *contract* (desired-vs-observed diff, tag
+ownership, idempotency, status parity; reference README.md:167-240) but the
+observed state is a Cloud TPU **queued resource** rather than a VM list:
+
+    fetch CR → workload-identity client → list QRs by ownership tags
+      → ensure exactly one QR matching the spec (create / replace on drift)
+      → drive its lifecycle: ACCEPTED/WAITING/PROVISIONING → poll fast;
+        FAILED / SUSPENDED (preemption) → delete + recreate (self-healing,
+        SURVEY §5.3); ACTIVE → join hosts as cluster Nodes with
+        google.com/tpu capacity + ICI-topology labels (BASELINE config 3)
+      → status.ready_replicas = fully-healthy slices → requeue.
+
+Scale-down to 0 and graceful deletion tear down the QR *and* its Nodes —
+the reference's cost-leak rule (README.md:239) applied to TPU capacity.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api.core import Node
+from ..api.tpupodslice import SliceStatus, TpuPodSlice
+from ..api.types import set_condition
+from ..cloud.base import AuthError, CloudError
+from ..cloud.fake_cloudtpu import QueuedResource
+from ..cloud.topology import parse_accelerator_type
+from ..controller.events import EventRecorder
+from ..controller.kubefake import Conflict, FakeKube, NotFound
+from ..controller.manager import Reconciler, Request, Result
+from ..scheduling.labels import LABEL_POOL, TPU_RESOURCE, node_labels_for_host
+from ..utils.metrics import MetricsRegistry, global_metrics
+
+log = logging.getLogger("k8s_gpu_tpu.operators.tpupodslice")
+
+FINALIZER = "tpu.k8sgpu.dev/podslice-cleanup"
+
+AUTH_RETRY = 30.0
+LIST_RETRY = 20.0
+MUTATE_RETRY = 40.0
+PROVISION_POLL = 5.0  # fast poll while a QR is in-flight
+RESYNC = 60.0
+
+
+class TpuPodSliceReconciler(Reconciler):
+    def __init__(
+        self,
+        kube: FakeKube,
+        client_factory,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.kube = kube
+        self.client_factory = client_factory
+        self.recorder = EventRecorder(kube, "tpupodslice-controller")
+        self.metrics = metrics or global_metrics
+
+    @staticmethod
+    def tags_for(ps: TpuPodSlice) -> dict[str, str]:
+        return {
+            "managed-by": "tpupodslice-operator",
+            "owner": f"{ps.metadata.namespace}-{ps.metadata.name}",
+        }
+
+    @staticmethod
+    def qr_name(ps: TpuPodSlice) -> str:
+        return f"{ps.metadata.namespace}-{ps.metadata.name}-qr"
+
+    @staticmethod
+    def pool_id(ps: TpuPodSlice) -> str:
+        # Namespace-qualified: two same-named pools in different namespaces
+        # must never select each other's Nodes.
+        return f"{ps.metadata.namespace}.{ps.metadata.name}"
+
+    def reconcile(self, req: Request) -> Result:
+        ps = self.kube.try_get("TpuPodSlice", req.name, req.namespace)
+        if ps is None:
+            return Result()
+
+        if ps.metadata.deletion_timestamp is not None:
+            return self._finalize(ps)
+
+        if FINALIZER not in ps.metadata.finalizers:
+            ps.metadata.finalizers.append(FINALIZER)
+            try:
+                ps = self.kube.update(ps)
+            except Conflict:
+                return Result(requeue=True)
+
+        try:
+            client = self.client_factory(ps.spec.workload_identity)
+        except AuthError as e:
+            self._fail(ps, "AuthFailed", str(e))
+            return Result(requeue_after=AUTH_RETRY)
+
+        try:
+            qrs = client.list_resources(self.tags_for(ps))
+        except CloudError as e:
+            self._fail(ps, "ListFailed", str(e))
+            return Result(requeue_after=LIST_RETRY)
+
+        want_qr = ps.spec.slice_count > 0
+        qr = next((q for q in qrs if q.name == self.qr_name(ps)), None)
+        strays = [q for q in qrs if q.name != self.qr_name(ps)]
+
+        # Drift: spec changed underneath an existing QR → replace it.
+        drifted = qr is not None and (
+            qr.accelerator_type != ps.spec.accelerator_type
+            or qr.slice_count != ps.spec.slice_count
+            or qr.runtime_version != ps.spec.runtime_version
+            or qr.spot != ps.spec.spot
+            or qr.reserved != ps.spec.reserved
+        )
+        # Self-healing: provisioning failed or slice preempted → recreate.
+        broken = qr is not None and qr.state in ("FAILED", "SUSPENDED")
+
+        for stale in strays + ([qr] if (drifted or broken) else []):
+            try:
+                client.delete_resource(stale.name)
+            except CloudError as e:
+                self._fail(ps, "DeleteFailed", str(e))
+                return Result(requeue_after=MUTATE_RETRY)
+            self.recorder.event(
+                ps, "Warning" if broken else "Normal", "QueuedResourceDeleted",
+                f"deleted queued resource {stale.name} (state={stale.state})",
+            )
+            if stale is qr:
+                # Only the primary QR's nodes were ever joined; deleting a
+                # stray must not evict the healthy slice's nodes.
+                self._prune_nodes(ps, keep_hostnames=set())
+                qr = None
+
+        if want_qr and qr is None:
+            try:
+                qr = client.create_resource(
+                    self.qr_name(ps), ps.spec, self.tags_for(ps)
+                )
+            except CloudError as e:
+                self._fail(ps, "CreateFailed", str(e))
+                return Result(requeue_after=MUTATE_RETRY)
+            self.metrics.inc("cloud_resources_created_total", kind="QueuedResource")
+            self.recorder.event(
+                ps, "Normal", "QueuedResourceCreated",
+                f"created queued resource {qr.name} "
+                f"({ps.spec.accelerator_type} × {ps.spec.slice_count})",
+            )
+        elif not want_qr and qr is not None:
+            try:
+                client.delete_resource(qr.name)
+            except CloudError as e:
+                self._fail(ps, "DeleteFailed", str(e))
+                return Result(requeue_after=MUTATE_RETRY)
+            self.recorder.event(
+                ps, "Normal", "QueuedResourceDeleted",
+                f"scaled to zero: deleted {qr.name}",
+            )
+            qr = None
+
+        # -- project QR state into cluster state + status ------------------
+        return self._observe(ps, qr)
+
+    def _observe(self, ps: TpuPodSlice, qr: QueuedResource | None) -> Result:
+        gen = ps.metadata.generation
+        if qr is None:
+            self._prune_nodes(ps, keep_hostnames=set())
+            ps.status.ready_replicas = 0
+            ps.status.slices = []
+            ps.status.phase = "Paused" if ps.spec.slice_count == 0 else "Pending"
+            set_condition(
+                ps.status.conditions, "Ready",
+                "True" if ps.spec.slice_count == 0 else "False",
+                "ScaledToZero" if ps.spec.slice_count == 0 else "NoQueuedResource",
+                "", observed_generation=gen,
+            )
+            set_condition(
+                ps.status.conditions, "Failed", "False", "", "",
+                observed_generation=gen,
+            )
+            self._update_status(ps)
+            return Result(
+                requeue_after=RESYNC if ps.spec.slice_count == 0 else PROVISION_POLL
+            )
+
+        if qr.state != "ACTIVE":
+            ps.status.phase = {
+                "ACCEPTED": "Queued",
+                "WAITING_FOR_RESOURCES": "Queued",
+                "PROVISIONING": "Provisioning",
+                "FAILED": "Failed",
+                "SUSPENDED": "Preempted",
+            }.get(qr.state, qr.state)
+            ps.status.ready_replicas = 0
+            ps.status.slices = [
+                SliceStatus(name=f"{qr.name}-slice-{i}", state=qr.state)
+                for i in range(qr.slice_count)
+            ]
+            set_condition(
+                ps.status.conditions, "Ready", "False", qr.state,
+                qr.error or f"queued resource is {qr.state}",
+                observed_generation=gen,
+            )
+            set_condition(
+                ps.status.conditions, "Provisioning", "True", qr.state, "",
+                observed_generation=gen,
+            )
+            # A transient cloud error earlier must not read as Failed for the
+            # whole (healthy) provisioning window.
+            set_condition(
+                ps.status.conditions, "Failed", "False", "", "",
+                observed_generation=gen,
+            )
+            self._update_status(ps)
+            return Result(requeue_after=PROVISION_POLL)
+
+        # ACTIVE: join each slice's hosts as Nodes with topology labels.
+        topo = parse_accelerator_type(qr.accelerator_type)
+        keep: set[str] = set()
+        ready_slices = 0
+        slice_statuses: list[SliceStatus] = []
+        for idx, inv in enumerate(qr.slices):
+            nodes_ready = 0
+            for host in inv.hosts:
+                keep.add(host.hostname)
+                self._ensure_node(ps, host, topo, idx)
+                if host.healthy:
+                    nodes_ready += 1
+            healthy = inv.state == "ACTIVE" and nodes_ready == len(inv.hosts)
+            if healthy:
+                ready_slices += 1
+            slice_statuses.append(
+                SliceStatus(
+                    name=inv.name,
+                    state=inv.state,
+                    nodes_total=len(inv.hosts),
+                    nodes_ready=nodes_ready,
+                )
+            )
+        self._prune_nodes(ps, keep_hostnames=keep)
+
+        ps.status.ready_replicas = ready_slices
+        ps.status.slices = slice_statuses
+        ps.status.observed_generation = gen
+        all_ready = ready_slices == ps.spec.slice_count
+        ps.status.phase = "Ready" if all_ready else "Degraded"
+        set_condition(
+            ps.status.conditions, "Ready", "True" if all_ready else "False",
+            "AsExpected" if all_ready else "SlicesUnhealthy",
+            f"{ready_slices}/{ps.spec.slice_count} slices ready",
+            observed_generation=gen,
+        )
+        set_condition(
+            ps.status.conditions, "Provisioning", "False", "Idle", "",
+            observed_generation=gen,
+        )
+        set_condition(
+            ps.status.conditions, "Failed", "False", "", "",
+            observed_generation=gen,
+        )
+        self._update_status(ps)
+        self.metrics.set_gauge(
+            "pool_ready_replicas", ready_slices,
+            kind="TpuPodSlice", pool=ps.metadata.name,
+        )
+        return Result(requeue_after=RESYNC if all_ready else PROVISION_POLL)
+
+    # -- node lifecycle ----------------------------------------------------
+    def _ensure_node(self, ps: TpuPodSlice, host, topo, slice_index: int) -> None:
+        existing = self.kube.try_get("Node", host.hostname, "default")
+        labels = node_labels_for_host(host, topo, self.pool_id(ps), slice_index)
+        if existing is None:
+            node = Node()
+            node.metadata.name = host.hostname
+            node.metadata.namespace = "default"
+            node.metadata.labels = labels
+            node.capacity = {TPU_RESOURCE: host.chips}
+            node.allocatable = {TPU_RESOURCE: host.chips}
+            node.ready = host.healthy
+            self.kube.create(node)
+            self.recorder.event(
+                ps, "Normal", "NodeJoined",
+                f"node {host.hostname} joined with {host.chips} TPU chips",
+            )
+        elif existing.ready != host.healthy or existing.metadata.labels != labels:
+            existing.ready = host.healthy
+            existing.metadata.labels = labels
+            try:
+                self.kube.update(existing)
+            except Conflict:
+                pass
+
+    def _prune_nodes(self, ps: TpuPodSlice, keep_hostnames: set[str]) -> None:
+        for node in self.kube.list(
+            "Node", label_selector={LABEL_POOL: self.pool_id(ps)}
+        ):
+            if node.metadata.name not in keep_hostnames:
+                try:
+                    self.kube.delete("Node", node.metadata.name, "default")
+                except NotFound:
+                    pass
+
+    # -- deletion / errors -------------------------------------------------
+    def _finalize(self, ps: TpuPodSlice) -> Result:
+        if FINALIZER not in ps.metadata.finalizers:
+            return Result()
+        try:
+            client = self.client_factory(ps.spec.workload_identity)
+            for qr in client.list_resources(self.tags_for(ps)):
+                client.delete_resource(qr.name)
+                self.recorder.event(
+                    ps, "Normal", "QueuedResourceDeleted",
+                    f"finalizer: deleted {qr.name}",
+                )
+        except AuthError as e:
+            self._fail(ps, "FinalizeAuthFailed", str(e))
+            return Result(requeue_after=AUTH_RETRY)
+        except CloudError as e:
+            self._fail(ps, "FinalizeFailed", str(e))
+            return Result(requeue_after=MUTATE_RETRY)
+        self._prune_nodes(ps, keep_hostnames=set())
+        ps.metadata.finalizers.remove(FINALIZER)
+        try:
+            self.kube.update(ps)
+        except (Conflict, NotFound):
+            return Result(requeue=True)
+        return Result()
+
+    def _fail(self, ps: TpuPodSlice, reason: str, msg: str) -> None:
+        log.warning("podslice %s/%s: %s: %s",
+                    ps.metadata.namespace, ps.metadata.name, reason, msg)
+        set_condition(
+            ps.status.conditions, "Failed", "True", reason, msg,
+            observed_generation=ps.metadata.generation,
+        )
+        self._update_status(ps)
+        self.recorder.event(ps, "Warning", reason, msg)
+        self.metrics.inc("reconcile_errors_total", kind="TpuPodSlice", reason=reason)
+
+    def _update_status(self, ps: TpuPodSlice) -> None:
+        try:
+            self.kube.update_status(ps)
+        except (Conflict, NotFound):
+            pass

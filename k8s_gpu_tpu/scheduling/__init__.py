@@ -1,0 +1,31 @@
+from .labels import (
+    TPU_RESOURCE,
+    LABEL_ACCELERATOR,
+    LABEL_TOPOLOGY,
+    LABEL_SLICE,
+    LABEL_WORKER_ID,
+    LABEL_POOL,
+    LABEL_SLICE_INDEX,
+    node_labels_for_host,
+)
+from .placement import (
+    PlacementError,
+    validate_slice_nodes,
+    place_gang,
+    multislice_spread,
+)
+
+__all__ = [
+    "TPU_RESOURCE",
+    "LABEL_ACCELERATOR",
+    "LABEL_TOPOLOGY",
+    "LABEL_SLICE",
+    "LABEL_WORKER_ID",
+    "LABEL_POOL",
+    "LABEL_SLICE_INDEX",
+    "node_labels_for_host",
+    "PlacementError",
+    "validate_slice_nodes",
+    "place_gang",
+    "multislice_spread",
+]

@@ -1,0 +1,42 @@
+"""ICI-topology node labels + the libtpu device-plugin resource model.
+
+The reference's L1 exposes GPU capacity as ``nvidia.com/gpu`` via the NVIDIA
+device-plugin DaemonSet (GPU调度平台搭建.md:128-138).  The TPU-native
+equivalent (BASELINE config 3): nodes advertise ``google.com/tpu`` chips and
+carry ICI-topology labels so the scheduler can place pods slice-correctly —
+every worker of a job on hosts of the SAME slice, with worker ids matching
+the TPU runtime's expectations.
+"""
+
+from __future__ import annotations
+
+from ..cloud.fake_cloudtpu import TpuHost
+from ..cloud.topology import TpuTopology
+
+TPU_RESOURCE = "google.com/tpu"
+
+_D = "tpu.k8sgpu.dev"
+LABEL_ACCELERATOR = f"{_D}/accelerator-type"   # e.g. v5p-64
+LABEL_TOPOLOGY = f"{_D}/topology"              # e.g. 4x4x4 (ICI chip grid)
+LABEL_SLICE = f"{_D}/slice"                    # slice (pod) identity
+LABEL_WORKER_ID = f"{_D}/worker-id"            # host index within the slice
+LABEL_POOL = f"{_D}/pool"                      # owning TpuPodSlice CR
+LABEL_SLICE_INDEX = f"{_D}/slice-index"        # multislice ordinal (DCN rank)
+LABEL_HOST_BOUNDS = f"{_D}/host-bounds"        # chip subgrid per host, e.g. 2x2x1
+
+
+def node_labels_for_host(
+    host: TpuHost,
+    topo: TpuTopology,
+    pool_name: str,
+    slice_index: int,
+) -> dict[str, str]:
+    return {
+        LABEL_ACCELERATOR: topo.accelerator_type,
+        LABEL_TOPOLOGY: topo.topology_str,
+        LABEL_SLICE: host.slice_name,
+        LABEL_WORKER_ID: str(host.worker_id),
+        LABEL_POOL: pool_name,
+        LABEL_SLICE_INDEX: str(slice_index),
+        LABEL_HOST_BOUNDS: "x".join(str(b) for b in topo.host_bounds()),
+    }

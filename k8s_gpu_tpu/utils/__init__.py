@@ -1,0 +1,4 @@
+from .clock import Clock, RealClock, FakeClock
+from .metrics import MetricsRegistry, global_metrics
+
+__all__ = ["Clock", "RealClock", "FakeClock", "MetricsRegistry", "global_metrics"]

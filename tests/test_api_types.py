@@ -1,0 +1,87 @@
+"""CRD schema + validation parity tests (reference README.md:83-156)."""
+
+import pytest
+
+from k8s_gpu_tpu.api import (
+    AzureVmPool,
+    Condition,
+    TpuPodSlice,
+    ValidationError,
+    set_condition,
+    get_condition,
+)
+
+
+def make_pool(replicas=2) -> AzureVmPool:
+    p = AzureVmPool()
+    p.metadata.name = "gpu-pool"
+    p.spec.replicas = replicas
+    p.spec.resource_group_name = "rg"
+    p.spec.location = "eastus"
+    p.spec.vm_size = "Standard_NC4as_T4_v3"
+    p.spec.azure_credential_secret = "azure-creds"
+    return p
+
+
+def test_azurevmpool_spec_fields_parity():
+    # Every spec field from reference README.md:92-118 must exist.
+    p = make_pool()
+    assert p.spec.replicas == 2
+    assert p.spec.vnet_name == ""
+    assert p.spec.subnet_name == ""
+    assert p.spec.image_reference.publisher == "Canonical"
+    assert p.spec.image_reference.sku == "22_04-lts-gen2"
+    assert p.api_version == "compute.my.domain/v1alpha1"
+
+
+def test_replicas_minimum_zero_validation():
+    # kubebuilder:validation:Minimum=0 (reference README.md:94).
+    p = make_pool(replicas=-1)
+    with pytest.raises(ValidationError):
+        p.validate()
+    make_pool(replicas=0).validate()
+
+
+def test_printer_columns():
+    # Desired/Ready printcolumns (reference README.md:132-133).
+    p = make_pool(3)
+    p.status.ready_replicas = 1
+    assert p.printer_columns == {"Desired": 3, "Ready": 1}
+
+
+def test_condition_transition_time_only_changes_on_flip():
+    conds: list[Condition] = []
+    set_condition(conds, "Ready", "False", "Scaling", "", now=1.0)
+    set_condition(conds, "Ready", "False", "Scaling", "", now=2.0)
+    assert get_condition(conds, "Ready").last_transition_time == 1.0
+    set_condition(conds, "Ready", "True", "AsExpected", "", now=3.0)
+    assert get_condition(conds, "Ready").last_transition_time == 3.0
+
+
+def make_podslice(accel="v4-8", count=1) -> TpuPodSlice:
+    ps = TpuPodSlice()
+    ps.metadata.name = "trainer"
+    ps.spec.accelerator_type = accel
+    ps.spec.slice_count = count
+    return ps
+
+
+def test_tpupodslice_validation():
+    make_podslice().validate()
+    with pytest.raises(ValidationError):
+        make_podslice("v99-8").validate()
+    with pytest.raises(ValidationError):
+        make_podslice("v4-banana").validate()
+    bad = make_podslice()
+    bad.spec.slice_count = -1
+    with pytest.raises(ValidationError):
+        bad.validate()
+
+
+def test_tpupodslice_topology_consistency():
+    ps = make_podslice("v5p-64")
+    ps.spec.topology = "4x4x4"
+    ps.validate()
+    ps.spec.topology = "2x2x2"  # 8 chips != 64
+    with pytest.raises(ValidationError):
+        ps.validate()

@@ -1,0 +1,206 @@
+"""envtest-style integration: AzureVmPool replicas=2 reconcile with a fake
+Azure client, CPU-only — BASELINE config 1, and the retry-ladder /
+finalizer / leak contracts from reference README.md:167-240.
+"""
+
+import pytest
+
+from k8s_gpu_tpu.api import AzureVmPool, Secret
+from k8s_gpu_tpu.cloud import FakeAzureCloud, azure_client_factory
+from k8s_gpu_tpu.controller import FakeKube, Manager, NotFound
+from k8s_gpu_tpu.operators import AzureVmPoolReconciler
+from k8s_gpu_tpu.utils.clock import FakeClock
+
+CREDS = {
+    "AZURE_CLIENT_ID": "cid",
+    "AZURE_CLIENT_SECRET": "sec",
+    "AZURE_TENANT_ID": "tid",
+    "AZURE_SUBSCRIPTION_ID": "sub",
+}
+
+
+@pytest.fixture
+def harness(kube: FakeKube, clock: FakeClock):
+    cloud = FakeAzureCloud(clock=clock)
+    mgr = Manager(kube, clock=clock)
+    mgr.register(
+        "AzureVmPool", AzureVmPoolReconciler(kube, azure_client_factory(cloud))
+    )
+    mgr.start()
+    secret = Secret(data=dict(CREDS))
+    secret.metadata.name = "azure-creds"
+    kube.create(secret)
+    yield kube, clock, cloud, mgr
+    mgr.stop()
+
+
+def make_pool(replicas=2):
+    p = AzureVmPool()
+    p.metadata.name = "gpu-pool"
+    p.spec.replicas = replicas
+    p.spec.vm_size = "Standard_NC4as_T4_v3"
+    p.spec.location = "eastus"
+    p.spec.azure_credential_secret = "azure-creds"
+    return p
+
+
+def ready(kube, want):
+    def check():
+        p = kube.try_get("AzureVmPool", "gpu-pool")
+        return p is not None and p.status.ready_replicas == want
+
+    return check
+
+
+def test_replicas_2_reconciles_to_ready(harness):
+    """BASELINE config 1: replicas=2, 0→Ready with readyReplicas parity."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_pool(2))
+    assert mgr.wait_idle(predicate=ready(kube, 2))
+    pool = kube.get("AzureVmPool", "gpu-pool")
+    assert pool.status.ready_replicas == 2
+    assert [v.name for v in pool.status.vms] == ["gpu-pool-vm-0", "gpu-pool-vm-1"]
+    assert all(v.provisioning_state == "Succeeded" for v in pool.status.vms)
+    conds = {c.type: c.status for c in pool.status.conditions}
+    assert conds["Ready"] == "True"
+    assert conds["Failed"] == "False"
+    assert len(cloud.vms) == 2
+    # Ownership tags on every VM (reference README.md:238).
+    for vm in cloud.vms.values():
+        assert vm.tags["managed-by"] == "vmpool-operator"
+        assert vm.tags["owner"] == "default-gpu-pool"
+
+
+def test_scale_up_then_down_deletes_head_and_leaks_nothing(harness):
+    kube, clock, cloud, mgr = harness
+    kube.create(make_pool(1))
+    assert mgr.wait_idle(predicate=ready(kube, 1))
+    p = kube.get("AzureVmPool", "gpu-pool")
+    p.spec.replicas = 3
+    kube.update(p)
+    assert mgr.wait_idle(predicate=ready(kube, 3))
+    p = kube.get("AzureVmPool", "gpu-pool")
+    p.spec.replicas = 1
+    kube.update(p)
+    assert mgr.wait_idle(predicate=ready(kube, 1))
+    # NICs/disks must be deleted with their VMs (reference README.md:239).
+    assert cloud.leaked_attachments == 0
+    assert len(cloud.vms) == 1
+
+
+def test_unmanaged_vms_are_never_touched(harness):
+    """Tag isolation: the anti-foot-gun (reference README.md:238)."""
+    kube, clock, cloud, mgr = harness
+    cloud.create_vm("intruder", make_pool().spec, {"managed-by": "someone-else"})
+    kube.create(make_pool(0))
+    assert mgr.wait_idle(predicate=ready(kube, 0))
+    assert "intruder" in cloud.vms  # untouched
+
+
+def test_auth_error_retries_at_30s(harness):
+    """Retry ladder: auth failure → requeue 30 s (reference README.md:184)."""
+    kube, clock, cloud, mgr = harness
+    cloud.faults.fail_auth = 1
+    kube.create(make_pool(1))
+    assert mgr.wait_idle()
+    p = kube.get("AzureVmPool", "gpu-pool")
+    conds = {c.type: (c.status, c.reason) for c in p.status.conditions}
+    assert conds["Failed"] == ("True", "AuthFailed")
+    assert len(cloud.vms) == 0
+    clock.advance(30.5)  # the 30 s retry fires and succeeds
+    assert mgr.wait_idle(predicate=ready(kube, 1))
+
+
+def test_list_error_retries_at_20s(harness):
+    kube, clock, cloud, mgr = harness
+    cloud.faults.fail_lists = 1
+    kube.create(make_pool(1))
+    assert mgr.wait_idle()
+    assert kube.get("AzureVmPool", "gpu-pool").status.ready_replicas == 0
+    clock.advance(20.5)
+    assert mgr.wait_idle(predicate=ready(kube, 1))
+
+
+def test_create_error_retries_at_40s(harness):
+    kube, clock, cloud, mgr = harness
+    cloud.faults.fail_creates = 1
+    kube.create(make_pool(2))
+    assert mgr.wait_idle()
+    clock.advance(40.5)
+    assert mgr.wait_idle(predicate=ready(kube, 2))
+
+
+def test_missing_secret_sets_failed_condition(harness):
+    kube, clock, cloud, mgr = harness
+    p = make_pool(1)
+    p.spec.azure_credential_secret = "nope"
+    kube.create(p)
+    assert mgr.wait_idle()
+    conds = {c.type: (c.status, c.reason)
+             for c in kube.get("AzureVmPool", "gpu-pool").status.conditions}
+    assert conds["Failed"] == ("True", "AuthFailed")
+
+
+def test_periodic_resync_heals_out_of_band_drift(harness):
+    """Level-triggered self-healing: someone deletes a VM behind our back;
+    the 60 s resync (reference README.md:233-234) recreates it."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_pool(2))
+    assert mgr.wait_idle(predicate=ready(kube, 2))
+    cloud.delete_vm("gpu-pool-vm-0")  # out-of-band drift
+    clock.advance(61.0)
+    assert mgr.wait_idle(predicate=ready(kube, 2))
+    assert len(cloud.vms) == 2
+
+
+def test_finalizer_deletes_cloud_resources(harness):
+    """Graceful deletion (reference README.md:309): deleting the CR tears
+    down every managed VM before the object disappears."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_pool(2))
+    assert mgr.wait_idle(predicate=ready(kube, 2))
+    kube.delete("AzureVmPool", "gpu-pool")
+    assert mgr.wait_idle(
+        predicate=lambda: kube.try_get("AzureVmPool", "gpu-pool") is None
+    )
+    assert len(cloud.vms) == 0
+    assert cloud.leaked_attachments == 0
+
+
+def test_events_emitted_on_create_and_delete(harness):
+    """K8s Events on VM lifecycle (reference README.md:311)."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_pool(1))
+    assert mgr.wait_idle(predicate=ready(kube, 1))
+    reasons = [e.reason for e in kube.list("Event")]
+    assert "VmCreated" in reasons
+
+
+def test_idempotent_reconcile_no_churn(harness):
+    """Reconcile must converge: once Ready, further resyncs issue no
+    create/delete calls (reference README.md:240)."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_pool(2))
+    assert mgr.wait_idle(predicate=ready(kube, 2))
+    before = [c for c in cloud.api_calls if c in ("create", "delete")]
+    for _ in range(3):
+        clock.advance(61.0)
+        assert mgr.wait_idle()
+    after = [c for c in cloud.api_calls if c in ("create", "delete")]
+    assert before == after
+
+
+def test_slow_provisioning_reaches_ready_via_fast_poll(harness):
+    """VMs that take (fake) minutes to provision still converge, via the
+    5 s converge-poll while not Ready."""
+    kube, clock, cloud, mgr = harness
+    cloud.provisioning_delay = 120.0
+    kube.create(make_pool(2))
+    assert mgr.wait_idle()
+    assert kube.get("AzureVmPool", "gpu-pool").status.ready_replicas == 0
+    for _ in range(30):
+        clock.advance(5.1)
+        mgr.wait_idle()
+        if kube.get("AzureVmPool", "gpu-pool").status.ready_replicas == 2:
+            break
+    assert kube.get("AzureVmPool", "gpu-pool").status.ready_replicas == 2

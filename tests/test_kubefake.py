@@ -1,0 +1,91 @@
+"""apimachinery semantics of the in-memory API server (SURVEY §4 item 2)."""
+
+import pytest
+
+from k8s_gpu_tpu.api import AzureVmPool, Secret
+from k8s_gpu_tpu.controller import Conflict, FakeKube, NotFound
+
+
+def pool(name="p", replicas=1):
+    p = AzureVmPool()
+    p.metadata.name = name
+    p.spec.replicas = replicas
+    return p
+
+
+def test_create_get_roundtrip_deepcopies(kube: FakeKube):
+    created = kube.create(pool())
+    created.spec.replicas = 99  # mutate the returned copy
+    got = kube.get("AzureVmPool", "p")
+    assert got.spec.replicas == 1  # store unaffected
+    assert got.metadata.uid and got.metadata.resource_version > 0
+
+
+def test_update_requires_fresh_resource_version(kube: FakeKube):
+    kube.create(pool())
+    a = kube.get("AzureVmPool", "p")
+    b = kube.get("AzureVmPool", "p")
+    a.spec.replicas = 2
+    kube.update(a)
+    b.spec.replicas = 3
+    with pytest.raises(Conflict):
+        kube.update(b)
+
+
+def test_generation_bumps_on_spec_change_only(kube: FakeKube):
+    kube.create(pool())
+    obj = kube.get("AzureVmPool", "p")
+    assert obj.metadata.generation == 1
+    obj.spec.replicas = 5
+    obj = kube.update(obj)
+    assert obj.metadata.generation == 2
+    # Status update must NOT bump generation (subresource semantics,
+    # reference README.md:130-131).
+    obj.status.ready_replicas = 5
+    obj = kube.update_status(obj)
+    assert obj.metadata.generation == 2
+    assert kube.get("AzureVmPool", "p").status.ready_replicas == 5
+
+
+def test_plain_update_cannot_touch_status(kube: FakeKube):
+    kube.create(pool())
+    obj = kube.get("AzureVmPool", "p")
+    obj.status.ready_replicas = 42
+    kube.update(obj)
+    assert kube.get("AzureVmPool", "p").status.ready_replicas == 0
+
+
+def test_finalizer_blocks_deletion(kube: FakeKube):
+    p = pool()
+    p.metadata.finalizers = ["x/cleanup"]
+    kube.create(p)
+    kube.delete("AzureVmPool", "p")
+    obj = kube.get("AzureVmPool", "p")  # still there
+    assert obj.metadata.deletion_timestamp is not None
+    obj.metadata.finalizers = []
+    kube.update(obj)
+    with pytest.raises(NotFound):
+        kube.get("AzureVmPool", "p")
+
+
+def test_watch_replays_existing_and_streams(kube: FakeKube):
+    kube.create(pool("a"))
+    events = []
+    kube.watch("AzureVmPool", lambda ev: events.append((ev.type, ev.obj.metadata.name)))
+    kube.create(pool("b"))
+    kube.delete("AzureVmPool", "b")
+    assert ("ADDED", "a") in events
+    assert ("ADDED", "b") in events
+    assert ("DELETED", "b") in events
+
+
+def test_list_with_label_selector(kube: FakeKube):
+    s = Secret()
+    s.metadata.name = "s1"
+    s.metadata.labels = {"team": "ml"}
+    kube.create(s)
+    s2 = Secret()
+    s2.metadata.name = "s2"
+    kube.create(s2)
+    assert [o.metadata.name for o in kube.list("Secret", label_selector={"team": "ml"})] == ["s1"]
+    assert len(kube.list("Secret")) == 2

@@ -1,0 +1,137 @@
+"""Slice-correct placement: gang semantics + multislice anti-affinity
+(SURVEY §2.7; BASELINE configs 3-4), incl. review-found regressions."""
+
+import pytest
+
+from k8s_gpu_tpu.api.core import Node, Pod
+from k8s_gpu_tpu.cloud.topology import parse_accelerator_type
+from k8s_gpu_tpu.scheduling import (
+    LABEL_WORKER_ID,
+    PlacementError,
+    TPU_RESOURCE,
+    multislice_spread,
+    place_gang,
+    validate_slice_nodes,
+)
+from k8s_gpu_tpu.scheduling.labels import node_labels_for_host
+from k8s_gpu_tpu.cloud.fake_cloudtpu import TpuHost
+
+
+def make_slice_nodes(accel: str, slice_name: str, slice_index=0, pool="p"):
+    topo = parse_accelerator_type(accel)
+    nodes = []
+    for w in range(topo.hosts):
+        host = TpuHost(
+            hostname=f"{slice_name}-w{w}",
+            slice_name=slice_name,
+            worker_id=w,
+            chips=min(topo.generation.chips_per_host, topo.chips),
+        )
+        n = Node()
+        n.metadata.name = host.hostname
+        n.metadata.labels = node_labels_for_host(host, topo, pool, slice_index)
+        n.capacity = {TPU_RESOURCE: host.chips}
+        n.allocatable = {TPU_RESOURCE: host.chips}
+        n.ready = True
+        nodes.append(n)
+    return nodes
+
+
+def make_pods(n, prefix="job-w"):
+    pods = []
+    for i in range(n):
+        p = Pod()
+        p.metadata.name = f"{prefix}-{i}"
+        p.requests = {TPU_RESOURCE: 4}
+        pods.append(p)
+    return pods
+
+
+def test_validate_complete_slice():
+    validate_slice_nodes(make_slice_nodes("v5p-64", "s0"), "v5p-64")
+
+
+def test_validate_rejects_missing_host():
+    nodes = make_slice_nodes("v5p-64", "s0")[:-1]
+    with pytest.raises(PlacementError):
+        validate_slice_nodes(nodes, "v5p-64")
+
+
+def test_validate_rejects_mixed_slices():
+    nodes = make_slice_nodes("v4-8", "s0") + make_slice_nodes("v4-8", "s1")
+    with pytest.raises(PlacementError):
+        validate_slice_nodes(nodes, "v4-8")
+
+
+def test_gang_places_one_worker_per_host():
+    nodes = make_slice_nodes("v4-8", "s0")
+    pods = make_pods(2)
+    placement = place_gang(pods, nodes, "v4-8")
+    assert len(placement) == 2
+    assert set(placement.values()) == {n.metadata.name for n in nodes}
+
+
+def test_gang_worker_ordinals_align_numerically():
+    """Regression (code review): 16-worker gang must map pod ordinal i to
+    worker-id i — lexicographic name sort would send job-w-10 to host w2."""
+    nodes = make_slice_nodes("v5p-64", "s0")
+    pods = make_pods(16)
+    placement = place_gang(pods, nodes, "v5p-64")
+    node_by_name = {n.metadata.name: n for n in nodes}
+    for i in range(16):
+        assigned = node_by_name[placement[f"job-w-{i}"]]
+        assert int(assigned.metadata.labels[LABEL_WORKER_ID]) == i
+
+
+def test_gang_is_all_or_nothing():
+    nodes = make_slice_nodes("v5p-64", "s0")[:10]  # incomplete slice
+    with pytest.raises(PlacementError):
+        place_gang(make_pods(16), nodes, "v5p-64")
+
+
+def test_gang_wrong_worker_count_rejected():
+    nodes = make_slice_nodes("v4-8", "s0")
+    with pytest.raises(PlacementError):
+        place_gang(make_pods(3), nodes, "v4-8")
+
+
+def test_gang_skips_busy_slice():
+    busy = make_slice_nodes("v4-8", "s0")
+    for n in busy:
+        n.allocatable[TPU_RESOURCE] = 0
+    free = make_slice_nodes("v4-8", "s1")
+    placement = place_gang(make_pods(2), busy + free, "v4-8")
+    assert all(v.startswith("s1-") for v in placement.values())
+
+
+def test_multislice_spread_distinct_slices():
+    """BASELINE config 4: two worker groups land on two distinct slices."""
+    nodes = make_slice_nodes("v5e-256", "s0", 0) + make_slice_nodes(
+        "v5e-256", "s1", 1
+    )
+    groups = [make_pods(32, "g0-w"), make_pods(32, "g1-w")]
+    placement = multislice_spread(groups, nodes, "v5e-256")
+    node_by_name = {n.metadata.name: n for n in nodes}
+    g0_slices = {
+        node_by_name[placement[p.metadata.name]].metadata.labels["tpu.k8sgpu.dev/slice"]
+        for p in groups[0]
+    }
+    g1_slices = {
+        node_by_name[placement[p.metadata.name]].metadata.labels["tpu.k8sgpu.dev/slice"]
+        for p in groups[1]
+    }
+    assert len(g0_slices) == 1 and len(g1_slices) == 1
+    assert g0_slices != g1_slices
+
+
+def test_multislice_insufficient_slices_rejected():
+    nodes = make_slice_nodes("v4-8", "s0")
+    with pytest.raises(PlacementError):
+        multislice_spread([make_pods(2, "a"), make_pods(2, "b")], nodes, "v4-8")
+
+
+def test_host_bounds_v5e_is_2x4():
+    """Regression (code review): 8-chip hosts own a 2x4 subgrid, not 2x2."""
+    t = parse_accelerator_type("v5e-16")
+    assert t.host_bounds() == (2, 4)
+    assert parse_accelerator_type("v5p-64").host_bounds() == (2, 2, 1)

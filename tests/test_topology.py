@@ -1,0 +1,74 @@
+"""TPU accelerator-type catalog + ICI topology math (SURVEY §7 hard part 5)."""
+
+import pytest
+
+from k8s_gpu_tpu.cloud.topology import default_topology, parse_accelerator_type
+
+
+def test_v5p_64_is_4x4x4_16_hosts():
+    t = parse_accelerator_type("v5p-64")
+    assert t.chips == 64
+    assert t.topology == (4, 4, 4)
+    assert t.hosts == 16
+    assert not t.is_single_host
+
+
+def test_v4_8_single_host():
+    t = parse_accelerator_type("v4-8")
+    assert t.chips == 8
+    assert t.topology == (2, 2, 2)
+    assert t.hosts == 2  # 4 chips per v4 host
+
+
+def test_v5e_256_is_16x16():
+    t = parse_accelerator_type("v5e-256")
+    assert t.topology == (16, 16)
+    assert t.hosts == 32  # 8 chips per v5e host
+
+
+@pytest.mark.parametrize(
+    "accel,topo",
+    [
+        ("v4-16", (2, 2, 4)),
+        ("v4-32", (2, 4, 4)),
+        ("v5p-128", (4, 4, 8)),
+        ("v5p-512", (8, 8, 8)),
+        ("v5e-8", (2, 4)),
+        ("v5e-64", (8, 8)),
+        ("v6e-16", (4, 4)),
+    ],
+)
+def test_known_topologies(accel, topo):
+    assert parse_accelerator_type(accel).topology == topo
+
+
+def test_topology_chip_product_invariant():
+    for accel in ["v4-8", "v4-64", "v5p-64", "v5p-256", "v5e-128", "v6e-256"]:
+        t = parse_accelerator_type(accel)
+        prod = 1
+        for d in t.topology:
+            prod *= d
+        assert prod == t.chips
+
+
+def test_unknown_generation_rejected():
+    with pytest.raises(ValueError):
+        parse_accelerator_type("v3-8")
+    with pytest.raises(ValueError):
+        parse_accelerator_type("nonsense")
+    with pytest.raises(ValueError):
+        parse_accelerator_type("v4-0")
+
+
+def test_factored_topology_for_unlisted_sizes():
+    # Not in the known table → balanced factorization.
+    assert default_topology(216, 3) == (6, 6, 6)
+
+
+def test_host_bounds_cover_chips_per_host():
+    t = parse_accelerator_type("v5p-64")
+    b = t.host_bounds()
+    prod = 1
+    for d in b:
+        prod *= d
+    assert prod == t.generation.chips_per_host

@@ -1,0 +1,307 @@
+"""TpuPodSlice reconcile integration: queued-resource lifecycle, node joins
+with ICI-topology labels, multislice, preemption self-healing — BASELINE
+configs 2-4 on the fake Cloud TPU backend.
+"""
+
+import pytest
+
+from k8s_gpu_tpu.api import TpuPodSlice
+from k8s_gpu_tpu.cloud import FakeCloudTpu, cloudtpu_client_factory
+from k8s_gpu_tpu.controller import FakeKube, Manager
+from k8s_gpu_tpu.operators import TpuPodSliceReconciler
+from k8s_gpu_tpu.scheduling import (
+    LABEL_ACCELERATOR,
+    LABEL_SLICE,
+    LABEL_SLICE_INDEX,
+    LABEL_TOPOLOGY,
+    LABEL_WORKER_ID,
+    TPU_RESOURCE,
+)
+from k8s_gpu_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def harness(kube: FakeKube, clock: FakeClock):
+    cloud = FakeCloudTpu(clock=clock)
+    mgr = Manager(kube, clock=clock)
+    mgr.register(
+        "TpuPodSlice", TpuPodSliceReconciler(kube, cloudtpu_client_factory(cloud))
+    )
+    mgr.start()
+    yield kube, clock, cloud, mgr
+    mgr.stop()
+
+
+def make_ps(accel="v4-8", count=1, name="trainer"):
+    ps = TpuPodSlice()
+    ps.metadata.name = name
+    ps.spec.accelerator_type = accel
+    ps.spec.slice_count = count
+    return ps
+
+
+def phase(kube, want, name="trainer"):
+    def check():
+        ps = kube.try_get("TpuPodSlice", name)
+        return ps is not None and ps.status.phase == want
+
+    return check
+
+
+def test_v4_8_reconciles_to_ready(harness):
+    """BASELINE config 2: v4-8 single-slice 0→Ready."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v4-8"))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"))
+    ps = kube.get("TpuPodSlice", "trainer")
+    assert ps.status.ready_replicas == 1
+    assert ps.status.slices[0].nodes_ready == 2  # v4-8 = 2 hosts
+    nodes = kube.list("Node")
+    assert len(nodes) == 2
+    assert sum(n.capacity[TPU_RESOURCE] for n in nodes) == 8
+
+
+def test_v5p_64_node_labels_and_device_plugin(harness):
+    """BASELINE config 3: v5p-64 joins 16 nodes with ICI-topology labels and
+    google.com/tpu capacity."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v5p-64"))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"))
+    nodes = kube.list("Node")
+    assert len(nodes) == 16  # 64 chips / 4 per host
+    for n in nodes:
+        assert n.metadata.labels[LABEL_ACCELERATOR] == "v5p-64"
+        assert n.metadata.labels[LABEL_TOPOLOGY] == "4x4x4"
+        assert n.capacity[TPU_RESOURCE] == 4
+        assert n.ready
+    ids = sorted(int(n.metadata.labels[LABEL_WORKER_ID]) for n in nodes)
+    assert ids == list(range(16))
+    assert sum(n.capacity[TPU_RESOURCE] for n in nodes) == 64
+
+
+def test_multislice_2x_v5e_256(harness):
+    """BASELINE config 4: 2×v5e-256 multislice — distinct slice labels and
+    slice indices for DCN-aware anti-affinity."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v5e-256", count=2))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"), timeout=60)
+    ps = kube.get("TpuPodSlice", "trainer")
+    assert ps.status.ready_replicas == 2
+    nodes = kube.list("Node")
+    assert len(nodes) == 64  # 2 slices × 32 hosts
+    slices = {n.metadata.labels[LABEL_SLICE] for n in nodes}
+    assert len(slices) == 2
+    indices = {n.metadata.labels[LABEL_SLICE_INDEX] for n in nodes}
+    assert indices == {"0", "1"}
+
+
+def test_queued_then_provisioning_then_ready(harness):
+    """The QR ladder ACCEPTED→WAITING→PROVISIONING→ACTIVE is surfaced in
+    status.phase while the 5 s poll drives it forward."""
+    kube, clock, cloud, mgr = harness
+    cloud.accepted_delay = 10.0
+    cloud.provisioning_delay = 60.0
+    kube.create(make_ps("v4-8"))
+    assert mgr.wait_idle()
+    assert kube.get("TpuPodSlice", "trainer").status.phase == "Queued"
+    for _ in range(40):
+        clock.advance(5.1)
+        mgr.wait_idle()
+        if kube.get("TpuPodSlice", "trainer").status.phase == "Ready":
+            break
+    assert kube.get("TpuPodSlice", "trainer").status.phase == "Ready"
+
+
+def test_stockout_holds_in_queued(harness):
+    kube, clock, cloud, mgr = harness
+    cloud.faults.stockout = True
+    kube.create(make_ps("v4-8"))
+    assert mgr.wait_idle()
+    for _ in range(3):
+        clock.advance(5.1)
+        mgr.wait_idle()
+    assert kube.get("TpuPodSlice", "trainer").status.phase == "Queued"
+    cloud.faults.stockout = False
+    clock.advance(5.1)
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"))
+
+
+def test_provisioning_failure_recreates_qr(harness):
+    """FAILED queued resource → deleted and recreated (self-healing,
+    SURVEY §5.3)."""
+    kube, clock, cloud, mgr = harness
+    cloud.faults.fail_provisioning = 1
+    kube.create(make_ps("v4-8"))
+    for _ in range(10):
+        clock.advance(5.1)
+        mgr.wait_idle()
+        if kube.get("TpuPodSlice", "trainer").status.phase == "Ready":
+            break
+    assert kube.get("TpuPodSlice", "trainer").status.phase == "Ready"
+
+
+def test_preemption_recovers(harness):
+    """Spot preemption (SUSPENDED + unhealthy hosts) → recreate → Ready."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v4-8"))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"))
+    cloud.preempt_slice("default-trainer-qr")
+    clock.advance(61.0)  # resync notices
+    for _ in range(10):
+        clock.advance(5.1)
+        mgr.wait_idle()
+        if kube.get("TpuPodSlice", "trainer").status.phase == "Ready":
+            break
+    ps = kube.get("TpuPodSlice", "trainer")
+    assert ps.status.phase == "Ready"
+    assert ps.status.ready_replicas == 1
+
+
+def test_accelerator_change_replaces_qr_and_nodes(harness):
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v4-8"))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"))
+    ps = kube.get("TpuPodSlice", "trainer")
+    ps.spec.accelerator_type = "v5p-64"
+    kube.update(ps)
+    assert mgr.wait_idle(
+        predicate=lambda: (
+            kube.get("TpuPodSlice", "trainer").status.phase == "Ready"
+            and len(kube.list("Node")) == 16
+        ),
+        timeout=60,
+    )
+    for n in kube.list("Node"):
+        assert n.metadata.labels[LABEL_ACCELERATOR] == "v5p-64"
+
+
+def test_scale_to_zero_deletes_qr_and_nodes(harness):
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v4-8"))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"))
+    ps = kube.get("TpuPodSlice", "trainer")
+    ps.spec.slice_count = 0
+    kube.update(ps)
+    assert mgr.wait_idle(predicate=phase(kube, "Paused"))
+    assert len(cloud.queued_resources) == 0
+    assert len(kube.list("Node")) == 0
+
+
+def test_delete_cr_finalizes_everything(harness):
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v5p-64"))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"))
+    kube.delete("TpuPodSlice", "trainer")
+    assert mgr.wait_idle(
+        predicate=lambda: kube.try_get("TpuPodSlice", "trainer") is None
+    )
+    assert len(cloud.queued_resources) == 0
+    assert len(kube.list("Node")) == 0
+
+
+def test_status_readyreplicas_parity_printer_columns(harness):
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v5e-256", count=2))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"), timeout=60)
+    ps = kube.get("TpuPodSlice", "trainer")
+    cols = ps.printer_columns
+    assert cols["Desired"] == 2 and cols["Ready"] == 2
+    assert cols["Accelerator"] == "v5e-256"
+
+
+def test_runtime_version_drift_replaces_qr(harness):
+    """Regression (code review): editing runtime_version/spot/reserved must
+    replace the queued resource, not silently report Ready on the old one."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v4-8"))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"))
+    ps = kube.get("TpuPodSlice", "trainer")
+    ps.spec.runtime_version = "tpu-ubuntu2204-v2"
+    kube.update(ps)
+    assert mgr.wait_idle(
+        predicate=lambda: (
+            kube.get("TpuPodSlice", "trainer").status.phase == "Ready"
+            and all(
+                q.runtime_version == "tpu-ubuntu2204-v2"
+                for q in cloud.queued_resources.values()
+            )
+            and len(cloud.queued_resources) == 1
+        )
+    )
+
+
+def test_stray_qr_deletion_keeps_healthy_nodes(harness):
+    """Regression (code review): cleaning up a stray tag-matched QR must not
+    evict the healthy primary slice's nodes."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_ps("v4-8"))
+    assert mgr.wait_idle(predicate=phase(kube, "Ready"))
+    uids_before = {n.metadata.name: n.metadata.uid for n in kube.list("Node")}
+    cloud.create_queued_resource(
+        "stray", "v4-8", 1, "rt",
+        {"managed-by": "tpupodslice-operator", "owner": "default-trainer"},
+    )
+    clock.advance(61.0)
+    assert mgr.wait_idle(
+        predicate=lambda: len(cloud.queued_resources) == 1
+    )
+    uids_after = {n.metadata.name: n.metadata.uid for n in kube.list("Node")}
+    assert uids_before == uids_after  # same Node objects, never recreated
+
+
+def test_same_name_pools_in_two_namespaces_do_not_fight(harness):
+    """Regression (code review): ns1/trainer and ns2/trainer must own
+    disjoint node sets and never prune each other's."""
+    kube, clock, cloud, mgr = harness
+    a = make_ps("v4-8")
+    a.metadata.namespace = "ns1"
+    b = make_ps("v4-8")
+    b.metadata.namespace = "ns2"
+    kube.create(a)
+    kube.create(b)
+    assert mgr.wait_idle(
+        predicate=lambda: (
+            (pa := kube.try_get("TpuPodSlice", "trainer", "ns1")) is not None
+            and pa.status.phase == "Ready"
+            and (pb := kube.try_get("TpuPodSlice", "trainer", "ns2")) is not None
+            and pb.status.phase == "Ready"
+        ),
+        timeout=60,
+    )
+    nodes = kube.list("Node")
+    assert len(nodes) == 4  # 2 hosts per pool
+    pools = {n.metadata.labels["tpu.k8sgpu.dev/pool"] for n in nodes}
+    assert pools == {"ns1.trainer", "ns2.trainer"}
+    # A few resyncs later nothing has churned.
+    uids = {n.metadata.name: n.metadata.uid for n in nodes}
+    for _ in range(3):
+        clock.advance(61.0)
+        mgr.wait_idle()
+    assert {n.metadata.name: n.metadata.uid for n in kube.list("Node")} == uids
+
+
+def test_transient_failure_condition_clears_during_provisioning(harness):
+    """Regression (code review): a transient list error must not leave
+    Failed=True for the whole provisioning window."""
+    kube, clock, cloud, mgr = harness
+    cloud.provisioning_delay = 300.0
+    cloud.faults.fail_lists = 1
+    kube.create(make_ps("v4-8"))
+    assert mgr.wait_idle()
+    clock.advance(20.5)  # list retry fires, succeeds; QR still provisioning
+    assert mgr.wait_idle()
+    ps = kube.get("TpuPodSlice", "trainer")
+    conds = {c.type: c.status for c in ps.status.conditions}
+    assert ps.status.phase in ("Queued", "Provisioning")
+    assert conds.get("Failed") == "False"
+
+
+def test_malformed_topology_string_rejected(harness):
+    from k8s_gpu_tpu.api import ValidationError
+    import pytest as _pytest
+
+    kube, clock, cloud, mgr = harness
+    bad = make_ps("v4-8", name="bad")
+    bad.spec.topology = "2x2xbanana"
+    with _pytest.raises(ValidationError):
+        kube.create(bad)

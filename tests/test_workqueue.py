@@ -1,0 +1,86 @@
+"""Work-queue semantics: dedup, delayed adds, backoff, dirty re-add
+(SURVEY §7 hard part 2)."""
+
+import threading
+
+import pytest
+
+from k8s_gpu_tpu.controller.workqueue import RateLimitingQueue, ShutDown
+from k8s_gpu_tpu.utils.clock import FakeClock
+
+
+def test_fifo_and_dedup():
+    q = RateLimitingQueue(clock=FakeClock())
+    q.add("a")
+    q.add("b")
+    q.add("a")  # coalesced
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.get(block=False) is None
+
+
+def test_add_while_processing_marks_dirty():
+    q = RateLimitingQueue(clock=FakeClock())
+    q.add("a")
+    key = q.get()
+    q.add("a")  # event arrives mid-reconcile
+    assert q.get(block=False) is None  # not concurrently deliverable
+    q.done(key)
+    assert q.get(block=False) == "a"  # redelivered after done()
+
+
+def test_delayed_add_fires_after_clock_advance():
+    clock = FakeClock()
+    q = RateLimitingQueue(clock=clock)
+    q.add_after("a", 30.0)
+    assert q.get(block=False) is None
+    clock.advance(29.0)
+    assert q.get(block=False) is None
+    clock.advance(1.1)
+    assert q.get(block=False) == "a"
+
+
+def test_earlier_deadline_wins():
+    clock = FakeClock()
+    q = RateLimitingQueue(clock=clock)
+    q.add_after("a", 60.0)
+    q.add_after("a", 5.0)
+    clock.advance(6.0)
+    assert q.get(block=False) == "a"
+    q.done("a")
+    clock.advance(60.0)
+    assert q.get(block=False) is None  # the 60s entry was coalesced away
+
+
+def test_rate_limited_backoff_grows_and_forget_resets():
+    clock = FakeClock()
+    q = RateLimitingQueue(clock=clock, base_delay=1.0, max_delay=100.0)
+    for expected in (1.0, 2.0, 4.0):
+        q.add_rate_limited("a")
+        assert q.get(block=False) is None
+        clock.advance(expected * 0.9)
+        assert q.get(block=False) is None
+        clock.advance(expected * 0.2)
+        assert q.get(block=False) == "a"
+        q.done("a")
+    q.forget("a")
+    q.add_rate_limited("a")
+    clock.advance(1.1)
+    assert q.get(block=False) == "a"
+
+
+def test_blocking_get_wakes_on_add():
+    q = RateLimitingQueue(clock=FakeClock())
+    got = []
+    t = threading.Thread(target=lambda: got.append(q.get()))
+    t.start()
+    q.add("x")
+    t.join(timeout=5)
+    assert got == ["x"]
+
+
+def test_shutdown_raises():
+    q = RateLimitingQueue(clock=FakeClock())
+    q.shutdown()
+    with pytest.raises(ShutDown):
+        q.get()
