@@ -1,0 +1,4 @@
+from .transformer import TransformerConfig, TransformerLM
+from .cnn import CnnConfig, SmallCnn
+
+__all__ = ["TransformerConfig", "TransformerLM", "CnnConfig", "SmallCnn"]

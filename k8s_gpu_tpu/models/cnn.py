@@ -1,0 +1,81 @@
+"""Fashion-MNIST-class CNN — parity with the reference training workload.
+
+The reference's one concrete training script is a two-conv CNN on
+Fashion-MNIST with single-device and distributed modes
+(GPU调度平台搭建.md:557-636: model 570-582, single-device loop 584-604,
+distributed 606-611).  Rebuilt here as a functional JAX model; the
+"mode auto-selection" (:623-630) lives in train/runner.py where device
+count picks the mesh, not an env var.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class CnnConfig:
+    num_classes: int = 10
+    c1: int = 32
+    c2: int = 64
+    d_hidden: int = 128
+    in_hw: int = 28
+    dtype: object = jnp.bfloat16
+
+
+class SmallCnn:
+    def __init__(self, cfg: CnnConfig = CnnConfig()):
+        self.cfg = cfg
+
+    def init(self, key) -> dict:
+        cfg = self.cfg
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        # After two stride-2 maxpools: 28 -> 14 -> 7.
+        flat = (cfg.in_hw // 4) ** 2 * cfg.c2
+        he = lambda k, shape, fan: jax.random.normal(k, shape, jnp.float32) * (
+            2.0 / fan
+        ) ** 0.5
+        return {
+            "conv1": he(k1, (3, 3, 1, cfg.c1), 9),
+            "conv2": he(k2, (3, 3, cfg.c1, cfg.c2), 9 * cfg.c1),
+            "fc1": he(k3, (flat, cfg.d_hidden), flat),
+            "fc2": he(k4, (cfg.d_hidden, cfg.num_classes), cfg.d_hidden),
+        }
+
+    def logical_axes(self) -> dict:
+        return {
+            "conv1": (None, None, None, None),
+            "conv2": (None, None, None, None),
+            "fc1": (None, "mlp"),
+            "fc2": ("mlp", None),
+        }
+
+    def forward(self, params, images):
+        """images: [B, H, W, 1] → logits [B, classes]."""
+        dt = self.cfg.dtype
+        x = images.astype(dt)
+
+        def conv(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w.astype(dt), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+
+        def pool(x):
+            return jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+            )
+
+        x = pool(jax.nn.relu(conv(x, params["conv1"])))
+        x = pool(jax.nn.relu(conv(x, params["conv2"])))
+        x = x.reshape(x.shape[0], -1)
+        x = jax.nn.relu(x @ params["fc1"].astype(dt))
+        return (x @ params["fc2"].astype(dt)).astype(jnp.float32)
+
+    def loss(self, params, images, labels):
+        logits = self.forward(params, images)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()

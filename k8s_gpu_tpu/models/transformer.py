@@ -1,0 +1,243 @@
+"""Flagship model: decoder-only transformer LM, TPU-first.
+
+The reference's only training workload is a Fashion-MNIST CNN
+(GPU调度平台搭建.md:557-636 — kept at models/cnn.py for parity); the
+platform's *purpose* is large-model training, so the flagship exercises the
+full parallelism surface the framework provides:
+
+- params as plain pytrees with a parallel logical-axes tree → one rule
+  table re-lays-out the model (parallel/sharding.py);
+- layers stacked on a leading axis and driven by ``lax.scan`` (one traced
+  block → fast XLA compiles, and the natural substrate for pipeline stages);
+- bf16 compute / f32 params & accumulators (MXU-friendly);
+- heads/mlp sharded over 'tp', batch over 'dp', sequence over 'sp' with
+  ring attention (parallel/ring_attention.py), experts over 'ep'
+  (Switch-style top-1 MoE with capacity + dense dispatch einsums — no
+  dynamic shapes, XLA partitions the expert einsums into all-to-alls);
+- ``jax.checkpoint`` on the block for rematerialized backprop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..parallel.ring_attention import plain_causal_attention, ring_attention
+from ..parallel.sharding import ParamRules
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_head: int = 64
+    d_ff: int = 1376
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    # MoE: 0 or 1 = dense MLP; >1 = Switch top-1 MoE in every block.
+    num_experts: int = 0
+    capacity_factor: float = 1.25
+    dtype: object = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def moe(self) -> bool:
+        return self.num_experts > 1
+
+
+class TransformerLM:
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    # -- parameters --------------------------------------------------------
+    def init(self, key) -> dict:
+        cfg = self.cfg
+        k = iter(jax.random.split(key, 16))
+        D, H, Dh, F, L, V = (
+            cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff,
+            cfg.n_layers, cfg.vocab_size,
+        )
+
+        def norm(shape, key, scale):
+            return jax.random.normal(key, shape, jnp.float32) * scale
+
+        p = {
+            "embed": norm((V, D), next(k), 0.02),
+            "final_norm": jnp.ones((D,), jnp.float32),
+            "head": norm((D, V), next(k), D**-0.5),
+            "blocks": {
+                "ln1": jnp.ones((L, D), jnp.float32),
+                "ln2": jnp.ones((L, D), jnp.float32),
+                "wq": norm((L, D, H, Dh), next(k), D**-0.5),
+                "wk": norm((L, D, H, Dh), next(k), D**-0.5),
+                "wv": norm((L, D, H, Dh), next(k), D**-0.5),
+                "wo": norm((L, H, Dh, D), next(k), (H * Dh) ** -0.5),
+            },
+        }
+        if cfg.moe:
+            E = cfg.num_experts
+            p["blocks"]["gate"] = norm((L, D, E), next(k), D**-0.5)
+            p["blocks"]["e_wi_gate"] = norm((L, E, D, F), next(k), D**-0.5)
+            p["blocks"]["e_wi_up"] = norm((L, E, D, F), next(k), D**-0.5)
+            p["blocks"]["e_wo"] = norm((L, E, F, D), next(k), F**-0.5)
+        else:
+            p["blocks"]["wi_gate"] = norm((L, D, F), next(k), D**-0.5)
+            p["blocks"]["wi_up"] = norm((L, D, F), next(k), D**-0.5)
+            p["blocks"]["wo_mlp"] = norm((L, F, D), next(k), F**-0.5)
+        return p
+
+    def logical_axes(self) -> dict:
+        """Same-shape pytree of logical axis-name tuples ("layers" axis is
+        the scan axis; mapped to 'pp' stages when pipelining)."""
+        cfg = self.cfg
+        axes = {
+            "embed": ("vocab", "embed"),
+            "final_norm": ("embed",),
+            "head": ("embed", "vocab"),
+            "blocks": {
+                "ln1": ("stages", "embed"),
+                "ln2": ("stages", "embed"),
+                "wq": ("stages", "embed", "heads", "kv"),
+                "wk": ("stages", "embed", "heads", "kv"),
+                "wv": ("stages", "embed", "heads", "kv"),
+                "wo": ("stages", "heads", "kv", "embed"),
+            },
+        }
+        if cfg.moe:
+            axes["blocks"]["gate"] = ("stages", "embed", None)
+            axes["blocks"]["e_wi_gate"] = ("stages", "experts", "embed", "expert_mlp")
+            axes["blocks"]["e_wi_up"] = ("stages", "experts", "embed", "expert_mlp")
+            axes["blocks"]["e_wo"] = ("stages", "experts", "expert_mlp", "embed")
+        else:
+            axes["blocks"]["wi_gate"] = ("stages", "embed", "mlp")
+            axes["blocks"]["wi_up"] = ("stages", "embed", "mlp")
+            axes["blocks"]["wo_mlp"] = ("stages", "mlp", "embed")
+        return axes
+
+    # -- building blocks ---------------------------------------------------
+    @staticmethod
+    def _rmsnorm(x, scale):
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
+
+    def _rope(self, x, positions):
+        """x: [B, S, H, Dh]; rotary position embedding."""
+        cfg = self.cfg
+        half = cfg.d_head // 2
+        freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+        angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, half]
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+        return out.astype(x.dtype)
+
+    def _attention(self, x, lp, positions, mesh, seq_sharded):
+        cfg = self.cfg
+        dt = cfg.dtype
+        q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"].astype(dt))
+        q = self._rope(q, positions)
+        k = self._rope(k, positions)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
+        if seq_sharded:
+            o = ring_attention(q, k, v, mesh)
+        else:
+            o = plain_causal_attention(q, k, v)
+        o = o.transpose(0, 2, 1, 3)  # [B,S,H,Dh]
+        return jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
+
+    def _dense_mlp(self, x, lp):
+        dt = self.cfg.dtype
+        g = jnp.einsum("bsd,df->bsf", x, lp["wi_gate"].astype(dt))
+        u = jnp.einsum("bsd,df->bsf", x, lp["wi_up"].astype(dt))
+        return jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u, lp["wo_mlp"].astype(dt))
+
+    def _moe_mlp(self, x, lp):
+        """Switch-style top-1 MoE with capacity; dense dispatch einsums keep
+        shapes static so XLA can turn them into all-to-alls over 'ep'."""
+        cfg = self.cfg
+        dt = cfg.dtype
+        B, S, D = x.shape
+        E = cfg.num_experts
+        G = B * S
+        cap = max(1, int(cfg.capacity_factor * G / E))
+        xt = x.reshape(G, D)
+
+        logits = jnp.einsum("gd,de->ge", xt.astype(jnp.float32),
+                            lp["gate"].astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                      # [G]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)    # [G,E]
+        gate = (probs * onehot).sum(-1)                          # [G]
+        # Position of each token within its expert's buffer.
+        pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot     # [G,E]
+        pos = pos.sum(-1).astype(jnp.int32)                      # [G]
+        keep = pos < cap
+        dispatch = (
+            onehot[:, :, None]
+            * jax.nn.one_hot(pos, cap, dtype=jnp.float32)[:, None, :]
+            * keep[:, None, None]
+        )                                                        # [G,E,C]
+        expert_in = jnp.einsum("gec,gd->ecd", dispatch, xt.astype(jnp.float32))
+        expert_in = expert_in.astype(dt)
+        g = jnp.einsum("ecd,edf->ecf", expert_in, lp["e_wi_gate"].astype(dt))
+        u = jnp.einsum("ecd,edf->ecf", expert_in, lp["e_wi_up"].astype(dt))
+        out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, lp["e_wo"].astype(dt))
+        combine = dispatch * gate[:, None, None]
+        y = jnp.einsum("gec,ecd->gd", combine.astype(jnp.float32),
+                       out.astype(jnp.float32))
+        # Aux load-balancing loss (Switch eq. 4): encourages uniform routing.
+        density = onehot.mean(0)
+        density_proxy = probs.mean(0)
+        aux = (density * density_proxy).sum() * E
+        return y.reshape(B, S, D).astype(dt), aux
+
+    def _block(self, x, lp, positions, mesh, seq_sharded):
+        h = self._rmsnorm(x, lp["ln1"])
+        x = x + self._attention(h, lp, positions, mesh, seq_sharded)
+        h = self._rmsnorm(x, lp["ln2"])
+        if self.cfg.moe:
+            y, aux = self._moe_mlp(h, lp)
+            return x + y, aux
+        return x + self._dense_mlp(h, lp), jnp.float32(0)
+
+    # -- forward -----------------------------------------------------------
+    def forward(self, params, tokens, mesh: Mesh | None = None):
+        """tokens: [B, S] int32 → logits [B, S, V] (dtype f32), aux loss."""
+        cfg = self.cfg
+        dt = cfg.dtype
+        seq_sharded = mesh is not None and mesh.shape.get("sp", 1) > 1
+        B, S = tokens.shape
+        positions = jnp.arange(S)
+        x = params["embed"].astype(dt)[tokens]
+
+        block = partial(
+            self._scan_block, positions=positions, mesh=mesh,
+            seq_sharded=seq_sharded,
+        )
+        if cfg.remat:
+            block = jax.checkpoint(block)
+        (x, aux), _ = jax.lax.scan(block, (x, jnp.float32(0)), params["blocks"])
+        x = self._rmsnorm(x, params["final_norm"])
+        logits = jnp.einsum("bsd,dv->bsv", x, params["head"].astype(dt))
+        return logits.astype(jnp.float32), aux / cfg.n_layers
+
+    def _scan_block(self, carry, lp, *, positions, mesh, seq_sharded):
+        x, aux = carry
+        x, a = self._block(x, lp, positions, mesh, seq_sharded)
+        return (x, aux + a), None
+
+    def loss(self, params, tokens, targets, mesh: Mesh | None = None):
+        """Next-token cross-entropy (mean) + MoE aux loss."""
+        logits, aux = self.forward(params, tokens, mesh)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean() + 0.01 * aux

@@ -49,11 +49,13 @@ class TpuPodSliceReconciler(Reconciler):
         kube: FakeKube,
         client_factory,
         metrics: MetricsRegistry | None = None,
+        provision_poll: float = PROVISION_POLL,
     ):
         self.kube = kube
         self.client_factory = client_factory
         self.recorder = EventRecorder(kube, "tpupodslice-controller")
         self.metrics = metrics or global_metrics
+        self.provision_poll = provision_poll
 
     @staticmethod
     def tags_for(ps: TpuPodSlice) -> dict[str, str]:
@@ -178,7 +180,7 @@ class TpuPodSliceReconciler(Reconciler):
             )
             self._update_status(ps)
             return Result(
-                requeue_after=RESYNC if ps.spec.slice_count == 0 else PROVISION_POLL
+                requeue_after=RESYNC if ps.spec.slice_count == 0 else self.provision_poll
             )
 
         if qr.state != "ACTIVE":
@@ -210,7 +212,7 @@ class TpuPodSliceReconciler(Reconciler):
                 observed_generation=gen,
             )
             self._update_status(ps)
-            return Result(requeue_after=PROVISION_POLL)
+            return Result(requeue_after=self.provision_poll)
 
         # ACTIVE: join each slice's hosts as Nodes with topology labels.
         topo = parse_accelerator_type(qr.accelerator_type)
@@ -261,7 +263,7 @@ class TpuPodSliceReconciler(Reconciler):
             "pool_ready_replicas", ready_slices,
             kind="TpuPodSlice", pool=ps.metadata.name,
         )
-        return Result(requeue_after=RESYNC if all_ready else PROVISION_POLL)
+        return Result(requeue_after=RESYNC if all_ready else self.provision_poll)
 
     # -- node lifecycle ----------------------------------------------------
     def _ensure_node(self, ps: TpuPodSlice, host, topo, slice_index: int) -> None:

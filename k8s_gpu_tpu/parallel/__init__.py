@@ -1,0 +1,20 @@
+from .mesh import MeshConfig, build_mesh, mesh_from_devices
+from .sharding import (
+    ParamRules,
+    shard_params,
+    named_sharding,
+    logical_to_spec,
+)
+from .collectives import psum_smoke, all_reduce_bandwidth_probe
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "mesh_from_devices",
+    "ParamRules",
+    "shard_params",
+    "named_sharding",
+    "logical_to_spec",
+    "psum_smoke",
+    "all_reduce_bandwidth_probe",
+]

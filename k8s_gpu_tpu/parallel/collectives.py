@@ -1,0 +1,81 @@
+"""Collective smoke/probe jobs — the BASELINE acceptance workload.
+
+The north star ends with "runs a JAX psum smoke job in under 5 minutes"
+(BASELINE.json): these are those jobs.  ``psum_smoke`` is the acceptance
+probe a freshly-Ready slice runs; the bandwidth probe gives the ops side a
+first-order ICI health number (SURVEY §5.1 observability obligation).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def psum_smoke(mesh: Mesh | None = None) -> dict:
+    """All-reduce a per-device arange over every mesh axis and check the
+    result analytically.  Returns {ok, n_devices, wall_s}."""
+    if mesh is None:
+        devs = np.asarray(jax.devices())
+        mesh = Mesh(devs, ("all",))
+    n = mesh.size
+    axis_names = mesh.axis_names
+
+    def body(x):
+        return jax.lax.psum(x, axis_names)
+
+    shaped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P(axis_names),  # leading dim sharded over ALL mesh axes
+        out_specs=P(),
+    )
+    x = jnp.arange(n, dtype=jnp.float32)
+    t0 = time.perf_counter()
+    out = jax.jit(shaped)(x)
+    out.block_until_ready()
+    wall = time.perf_counter() - t0
+    expect = float(np.arange(n).sum())
+    ok = bool(np.allclose(np.asarray(out), expect))
+    return {"ok": ok, "n_devices": n, "wall_s": wall, "result": float(np.asarray(out).ravel()[0])}
+
+
+def all_reduce_bandwidth_probe(
+    mesh: Mesh | None = None, mib: int = 64, iters: int = 5
+) -> dict:
+    """Time a psum of a ~mib-MiB bf16 buffer; returns achieved algo-bandwidth
+    GB/s (2*(n-1)/n * bytes / t per all-reduce)."""
+    if mesh is None:
+        devs = np.asarray(jax.devices())
+        mesh = Mesh(devs, ("all",))
+    n = mesh.size
+    elems = mib * 1024 * 1024 // 2
+    sharding = NamedSharding(mesh, P(mesh.axis_names))
+    # Allocate directly sharded — materializing (n, elems) on one device
+    # first would OOM exactly the large slices this probe is meant to check.
+    x = jax.jit(
+        lambda: jnp.ones((n, elems), dtype=jnp.bfloat16), out_shardings=sharding
+    )()
+
+    @jax.jit
+    def reduce(x):
+        return jax.shard_map(
+            lambda s: jax.lax.psum(s, mesh.axis_names),
+            mesh=mesh,
+            in_specs=P(mesh.axis_names),
+            out_specs=P(),
+        )(x)
+
+    reduce(x).block_until_ready()  # warm compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = reduce(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    nbytes = elems * 2
+    algo_bw = 2 * (n - 1) / max(n, 1) * nbytes / dt / 1e9
+    return {"n_devices": n, "bytes": nbytes, "time_s": dt, "algo_gbps": algo_bw}

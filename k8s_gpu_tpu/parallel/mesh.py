@@ -1,0 +1,104 @@
+"""Device-mesh construction over ('dcn', 'ici') — the TPU-native replacement
+for the reference's NCCL/torchrun rendezvous (SURVEY §2.7, §5.8).
+
+The reference's distributed story is "the platform co-schedules pods and the
+framework inside does collectives" (GPU调度平台搭建.md:606-611).  Here the
+framework half is first-class: one mesh factory that lays out
+
+    (dp, pp, ep, sp, tp)
+
+logical axes over physical devices, with tp innermost (fastest-varying →
+adjacent chips → ICI neighbors, where all-reduce traffic is hottest) and dp
+outermost (maps to DCN across slices in multislice — gradient all-reduce
+tolerates DCN latency; the scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (DCN-tolerant) → innermost (ICI-hot).
+AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each logical axis; -1 on dp = absorb remaining devices."""
+
+    dp: int = -1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {"dp": self.dp, "pp": self.pp, "ep": self.ep,
+                 "sp": self.sp, "tp": self.tp}
+        fixed = 1
+        for a, s in sizes.items():
+            if s != -1:
+                if s <= 0:
+                    raise ValueError(f"axis {a} size must be positive, got {s}")
+                fixed *= s
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {fixed}"
+            )
+        for a, s in sizes.items():
+            if s == -1:
+                sizes[a] = n_devices // fixed
+                fixed *= sizes[a]
+        total = int(np.prod(list(sizes.values())))
+        if total != n_devices:
+            raise ValueError(
+                f"axis sizes {sizes} use {total} devices, have {n_devices}"
+            )
+        return sizes
+
+
+def mesh_from_devices(devices, config: MeshConfig) -> Mesh:
+    """Arrange *devices* (flat list) into a Mesh with the canonical axis
+    order.  Devices are assumed ICI-contiguous in order (true for
+    jax.devices() on a slice); tp is innermost so tp groups are ICI
+    neighbors."""
+    devices = np.asarray(devices)
+    sizes = config.resolve(devices.size)
+    grid = devices.reshape([sizes[a] for a in AXES])
+    return Mesh(grid, AXES)
+
+
+def build_mesh(config: MeshConfig | None = None, n_devices: int | None = None) -> Mesh:
+    """Build the standard training mesh from the current JAX devices.
+
+    ``n_devices`` limits to a prefix of jax.devices() (useful on a partially
+    used host).  With no config, everything goes to dp (pure data parallel).
+    """
+    config = config or MeshConfig()
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"want {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return mesh_from_devices(devs, config)
+
+
+def multislice_mesh(config: MeshConfig, num_slices: int) -> Mesh:
+    """Multislice layout: dp MUST span slices (DCN) and every other axis must
+    stay inside a slice (ICI) — the BASELINE config-4 invariant.  Validates
+    dp % num_slices == 0 and that per-slice axes fit in one slice."""
+    devs = jax.devices()
+    sizes = config.resolve(len(devs))
+    if sizes["dp"] % num_slices != 0:
+        raise ValueError(
+            f"dp={sizes['dp']} must be a multiple of num_slices={num_slices} "
+            "(dp is the only DCN-crossing axis)"
+        )
+    # dp % num_slices == 0 together with resolve()'s product check already
+    # implies pp*ep*sp*tp divides the per-slice device count (ici = n/dp and
+    # slices | dp  ⇒  ici | n/slices), so no further arithmetic check is
+    # needed: dp is the only axis whose groups cross slice (DCN) boundaries.
+    return mesh_from_devices(devs, config)

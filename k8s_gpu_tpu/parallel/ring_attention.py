@@ -1,0 +1,127 @@
+"""Ring attention over the 'sp' mesh axis — long-context sequence parallelism.
+
+The reference has no long-context story at all (SURVEY §5.7: "entirely
+absent"); this is the additive TPU-native capability: shard the sequence
+across devices, keep Q resident, and rotate K/V blocks around the ICI ring
+with ``ppermute`` while accumulating flash-style online softmax — attention
+memory per device drops from O(S²) to O(S·S/sp) and K/V transfer overlaps
+compute around the ring (Liu et al., Ring Attention; blockwise per-step
+math follows the standard streaming-softmax recurrence).
+
+Implementation notes (TPU/XLA-first):
+- ``lax.scan`` over ring steps (reverse-differentiable, unlike fori_loop);
+- masking is data-independent per step given the static block index, so the
+  whole ring is one traced loop — no dynamic shapes;
+- -1e30 stands in for -inf so fully-masked blocks can't NaN the softmax.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attend(q, k, v, scale, mask):
+    """One Q-block × K/V-block contribution: returns (scores_max, exp_scores,
+    exp@v) for the online-softmax accumulator.  q:[B,H,Sq,D] k,v:[B,H,Sk,D]
+    mask:[Sq,Sk] bool (True = attend)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.where(mask[None, None], s, -1e30)
+    return s
+
+
+def _ring_step(carry, step, *, axis_name, n_blocks, block_q, scale):
+    """One hop: attend local Q to the K/V block currently resident, fold into
+    the online-softmax state, then rotate K/V to the next device."""
+    o, m, l, k, v = carry
+    q = block_q
+    my = jax.lax.axis_index(axis_name)
+    # The K/V block we hold at `step` originated at device (my - step) mod n.
+    src = (my - step) % n_blocks
+
+    sq = q.shape[2]
+    sk = k.shape[2]
+    q_pos = my * sq + jnp.arange(sq)
+    k_pos = src * sk + jnp.arange(sk)
+    mask = q_pos[:, None] >= k_pos[None, :]  # causal, global positions
+
+    s = _block_attend(q, k, v, scale, mask)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l = l * alpha + p.sum(axis=-1)
+    o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(p.dtype))
+    m = m_new
+
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+    k = jax.lax.ppermute(k, axis_name, perm)
+    v = jax.lax.ppermute(v, axis_name, perm)
+    return (o, m, l, k, v), None
+
+
+def _ring_attention_local(q, k, v, *, axis_name, n_blocks, scale):
+    """Per-device body under shard_map: q,k,v are the local blocks
+    [B, H, S/sp, D]."""
+    b, h, sq, d = q.shape
+    acc_dtype = jnp.float32
+    o = jnp.zeros((b, h, sq, d), acc_dtype)
+    m = jnp.full((b, h, sq), -1e30, acc_dtype)
+    l = jnp.zeros((b, h, sq), acc_dtype)
+    qf = q.astype(acc_dtype)
+    step_fn = partial(
+        _ring_step, axis_name=axis_name, n_blocks=n_blocks,
+        block_q=qf, scale=scale,
+    )
+    (o, m, l, k, v), _ = jax.lax.scan(
+        step_fn, (o, m, l, k.astype(acc_dtype), v.astype(acc_dtype)),
+        jnp.arange(n_blocks),
+    )
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    batch_axes=("dp",),
+    head_axes=("tp",),
+) -> jax.Array:
+    """Causal self-attention with sequence sharded over *axis_name*.
+
+    q, k, v: [B, H, S, D] (global view; S sharded over sp, B over dp,
+    H over tp).  Returns [B, H, S, D] with the same sharding.
+    """
+    n_blocks = mesh.shape[axis_name]
+    scale = q.shape[-1] ** -0.5
+    spec = P(batch_axes, head_axes, axis_name, None)
+    body = partial(
+        _ring_attention_local,
+        axis_name=axis_name,
+        n_blocks=n_blocks,
+        scale=scale,
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def plain_causal_attention(q, k, v):
+    """Single-shard reference path: same math, no ring — used when sp == 1
+    and as the numerical oracle in tests."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    sq, sk = s.shape[-2], s.shape[-1]
+    mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,70 @@
+"""Logical-axis sharding rules → NamedSharding, the pjit recipe.
+
+Parameters and activations are annotated with *logical* axis names
+("embed", "heads", "mlp", "experts", ...); a rule table maps logical names
+to mesh axes.  This is the flax/t5x partitioning idiom, kept dependency-free:
+one table change re-lays-out the whole model (e.g. turn fsdp on by mapping
+"embed" → "dp").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# Default rule table: tp shards heads/mlp/vocab, ep shards experts,
+# sp shards sequence, dp shards batch.  "embed" unsharded by default
+# (flip to ("dp",) for zero/fsdp-style parameter sharding).
+DEFAULT_RULES: dict[str, Any] = {
+    "batch": "dp",
+    "seq": "sp",
+    "heads": "tp",
+    "kv": None,
+    "embed": None,
+    "embed_fsdp": "dp",   # used when fsdp param sharding is on
+    "mlp": "tp",
+    "vocab": "tp",
+    "experts": "ep",
+    "expert_mlp": "tp",
+    "stages": "pp",
+    None: None,
+}
+
+
+@dataclass
+class ParamRules:
+    rules: dict[str, Any] = field(default_factory=lambda: dict(DEFAULT_RULES))
+
+    def spec(self, logical_axes: tuple) -> P:
+        return P(*(self.rules.get(ax, None) for ax in logical_axes))
+
+    def sharding(self, mesh: Mesh, logical_axes: tuple) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes))
+
+
+def logical_to_spec(rules: ParamRules, logical_tree) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: rules.spec(axes),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def named_sharding(mesh: Mesh, *axes) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))
+
+
+def shard_params(params, logical_tree, mesh: Mesh, rules: ParamRules | None = None):
+    """Device-put a parameter pytree according to its logical axes."""
+    rules = rules or ParamRules()
+    shardings = jax.tree.map(
+        lambda axes: rules.sharding(mesh, axes),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    return jax.device_put(params, shardings)

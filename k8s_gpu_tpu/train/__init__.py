@@ -1,0 +1,3 @@
+from .runner import TrainConfig, Trainer, make_train_step
+
+__all__ = ["TrainConfig", "Trainer", "make_train_step"]

@@ -1,0 +1,16 @@
+"""psum smoke job — the BASELINE acceptance workload, CPU-simulated."""
+
+from k8s_gpu_tpu.parallel import MeshConfig, build_mesh, psum_smoke
+
+
+def test_psum_smoke_flat_mesh():
+    out = psum_smoke()
+    assert out["ok"], out
+    assert out["n_devices"] == 8
+    assert out["result"] == sum(range(8))
+
+
+def test_psum_smoke_training_mesh():
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, tp=2))
+    out = psum_smoke(mesh)
+    assert out["ok"], out

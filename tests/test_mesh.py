@@ -1,0 +1,53 @@
+"""Mesh construction over the virtual 8-device CPU platform (conftest sets
+xla_force_host_platform_device_count=8)."""
+
+import jax
+import pytest
+
+from k8s_gpu_tpu.parallel import MeshConfig, build_mesh
+from k8s_gpu_tpu.parallel.mesh import AXES, multislice_mesh
+
+
+def test_eight_virtual_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_default_config_all_dp():
+    mesh = build_mesh()
+    assert mesh.shape["dp"] == 8
+    assert mesh.axis_names == AXES
+
+
+def test_mixed_axes():
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, tp=2))
+    assert mesh.shape["dp"] == 2
+    assert mesh.shape["sp"] == 2
+    assert mesh.shape["tp"] == 2
+    assert mesh.size == 8
+
+
+def test_dp_absorbs_remainder():
+    mesh = build_mesh(MeshConfig(tp=4))
+    assert mesh.shape["dp"] == 2
+
+
+def test_indivisible_rejected():
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(dp=3))
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(dp=2, tp=3))
+
+
+def test_n_devices_prefix():
+    mesh = build_mesh(MeshConfig(tp=2), n_devices=4)
+    assert mesh.size == 4
+    assert mesh.shape["dp"] == 2
+
+
+def test_multislice_dp_must_span_slices():
+    # 2 slices of 4 devices: dp=2 (one per slice) * tp=4 → valid.
+    mesh = multislice_mesh(MeshConfig(dp=2, tp=4), num_slices=2)
+    assert mesh.shape["dp"] == 2
+    # dp=1 cannot span 2 slices.
+    with pytest.raises(ValueError):
+        multislice_mesh(MeshConfig(dp=1, tp=8), num_slices=2)

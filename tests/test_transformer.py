@@ -1,0 +1,123 @@
+"""Flagship transformer: forward/loss correctness and sharded training on
+the virtual 8-device mesh (dp/tp/sp; MoE for ep)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.parallel import MeshConfig, build_mesh
+from k8s_gpu_tpu.train import TrainConfig, Trainer
+
+FAST_TC = TrainConfig(learning_rate=1e-3, warmup_steps=1)
+
+TINY = TransformerConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_head=16,
+    d_ff=128, max_seq=64,
+)
+
+
+def batch(key, b=4, s=32, vocab=256):
+    toks = jax.random.randint(key, (b, s + 1), 0, vocab)
+    return toks[:, :-1], toks[:, 1:]
+
+
+def test_forward_shapes_and_dtype():
+    model = TransformerLM(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens, _ = batch(jax.random.PRNGKey(1))
+    logits, aux = model.forward(params, tokens)
+    assert logits.shape == (4, 32, 256)
+    assert logits.dtype == jnp.float32
+    assert float(aux) == 0.0  # dense model has no aux loss
+
+
+def test_logical_axes_tree_matches_params():
+    model = TransformerLM(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    axes = model.logical_axes()
+    flat_p = jax.tree.leaves(params)
+    flat_a = jax.tree.leaves(axes, is_leaf=lambda x: isinstance(x, tuple))
+    assert len(flat_p) == len(flat_a)
+    for p, a in zip(flat_p, flat_a):
+        assert p.ndim == len(a), (p.shape, a)
+
+
+def test_loss_decreases_single_device():
+    model = TransformerLM(TINY)
+    trainer = Trainer(model, mesh=build_mesh(MeshConfig(dp=1), n_devices=1), train_config=FAST_TC)
+    trainer.init(jax.random.PRNGKey(0))
+    tokens, targets = batch(jax.random.PRNGKey(1))
+    losses = [trainer.step(tokens, targets) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_training_dp_tp_sp_mesh():
+    """The full sharded train step compiles and runs on dp=2,sp=2,tp=2 —
+    ring attention active, heads/mlp sharded."""
+    model = TransformerLM(TINY)
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, tp=2))
+    trainer = Trainer(model, mesh=mesh, train_config=FAST_TC)
+    trainer.init(jax.random.PRNGKey(0))
+    tokens, targets = batch(jax.random.PRNGKey(1))
+    l0 = trainer.step(tokens, targets)
+    l1 = trainer.step(tokens, targets)
+    l2 = trainer.step(tokens, targets)
+    assert np.isfinite([l0, l1, l2]).all()
+    assert l2 < l0
+
+
+def test_sharded_matches_single_device_loss():
+    """pjit-sharded forward == single-device forward (numerics parity)."""
+    model = TransformerLM(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens, targets = batch(jax.random.PRNGKey(1))
+    mesh = build_mesh(MeshConfig(dp=2, sp=1, tp=2), n_devices=4)
+    single = float(model.loss(params, tokens, targets))
+    from k8s_gpu_tpu.parallel.sharding import ParamRules
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rules = ParamRules()
+    shardings = jax.tree.map(
+        lambda ax: rules.sharding(mesh, ax), model.logical_axes(),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    sp_params = jax.device_put(params, shardings)
+    sp_tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+    sp_targets = jax.device_put(targets, NamedSharding(mesh, P("dp", None)))
+    sharded = float(
+        jax.jit(lambda p, t, g: model.loss(p, t, g))(sp_params, sp_tokens, sp_targets)
+    )
+    assert abs(single - sharded) < 1e-2, (single, sharded)
+
+
+def test_moe_forward_and_training():
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_head=16,
+        d_ff=128, num_experts=4,
+    )
+    model = TransformerLM(cfg)
+    mesh = build_mesh(MeshConfig(dp=2, ep=2, tp=2))
+    trainer = Trainer(model, mesh=mesh, train_config=FAST_TC)
+    trainer.init(jax.random.PRNGKey(0))
+    tokens, targets = batch(jax.random.PRNGKey(1))
+    params = trainer.params
+    _, aux = model.forward(params, tokens)
+    assert float(aux) > 0.0  # MoE aux loss present
+    losses = [trainer.step(tokens, targets) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_remat_off_matches_on():
+    m_on = TransformerLM(TINY)
+    import dataclasses
+
+    m_off = TransformerLM(dataclasses.replace(TINY, remat=False))
+    params = m_on.init(jax.random.PRNGKey(0))
+    tokens, targets = batch(jax.random.PRNGKey(1))
+    assert abs(
+        float(m_on.loss(params, tokens, targets))
+        - float(m_off.loss(params, tokens, targets))
+    ) < 1e-5
