@@ -9,6 +9,7 @@ from .types import (
 from .azurevmpool import AzureVmPool, AzureVmPoolSpec, AzureVmPoolStatus, ImageReference
 from .tpupodslice import TpuPodSlice, TpuPodSliceSpec, TpuPodSliceStatus, SliceStatus
 from .core import Secret, Node, Event, Pod
+from .trainjob import TrainJob, TrainJobSpec, TrainJobStatus, AssetRef, EnvVar
 
 __all__ = [
     "ObjectMeta",
@@ -29,4 +30,9 @@ __all__ = [
     "Node",
     "Event",
     "Pod",
+    "TrainJob",
+    "TrainJobSpec",
+    "TrainJobStatus",
+    "AssetRef",
+    "EnvVar",
 ]

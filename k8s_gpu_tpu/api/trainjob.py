@@ -1,0 +1,88 @@
+"""TrainJob CRD — the training-job unit (the reference's Volcano Job role).
+
+The reference submits training as a Volcano ``Job`` with gang semantics
+(``minAvailable``, GPU调度平台搭建.md:638-675) expanded from a user template
+(:512-535).  On TPU the gang is the slice (SURVEY §2.7), so a TrainJob
+declares the *instance type* (→ accelerator type → worker count) and the
+reconciler places one worker per slice host atomically via
+scheduling.place_gang.  ``workload`` names a registered in-process JAX
+workload (train/registry.py) — the analogue of the reference's
+image+command pair, but compiled and run by this framework rather than a
+container runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .types import Condition, CustomResource, ValidationError
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class AssetRef:
+    """repository/dataset/model references with pinning — C27's
+    {space,id,hash/versionId} triples (GPU调度平台搭建.md:521-533)."""
+
+    space: str = ""
+    id: str = ""
+    version: str = ""  # ""= latest (hash ""==latest semantics, :525)
+
+
+@dataclass
+class TrainJobSpec:
+    title: str = ""
+    description: str = ""
+    image: str = ""
+    command: str = ""
+    env: list[EnvVar] = field(default_factory=list)
+    repository: list[AssetRef] = field(default_factory=list)
+    dataset: list[AssetRef] = field(default_factory=list)
+    model: list[AssetRef] = field(default_factory=list)
+    # single (one slice) | multislice (slice_count slices).
+    mode: str = "single"
+    instance_type: str = "tpu-v5e-8"
+    slice_count: int = 1
+    # Resolved by template expansion (server-side defaulting).
+    accelerator_type: str = ""
+    num_workers: int = 0
+    # In-process workload name (train/registry.py); "" = external command.
+    workload: str = ""
+    workload_args: dict = field(default_factory=dict)
+    # Max seconds in Pending-for-capacity before Failed (0 = wait forever).
+    queue_timeout_s: float = 0.0
+
+
+@dataclass
+class TrainJobStatus:
+    phase: str = "Pending"  # Pending|Placing|Running|Succeeded|Failed
+    message: str = ""
+    # pod/worker name → node name (gang placement result).
+    placements: dict[str, str] = field(default_factory=dict)
+    start_time: float = 0.0
+    completion_time: float = 0.0
+    conditions: list[Condition] = field(default_factory=list)
+    logs: list[str] = field(default_factory=list)
+    result: dict = field(default_factory=dict)
+
+
+@dataclass
+class TrainJob(CustomResource):
+    kind: str = "TrainJob"
+    api_version: str = "tpu.k8sgpu.dev/v1alpha1"
+    spec: TrainJobSpec = field(default_factory=TrainJobSpec)
+    status: TrainJobStatus = field(default_factory=TrainJobStatus)
+
+    def validate(self) -> None:
+        super().validate()
+        if self.spec.mode not in ("single", "multislice"):
+            raise ValidationError(f"mode must be single|multislice, got {self.spec.mode!r}")
+        if self.spec.slice_count < 1:
+            raise ValidationError("sliceCount must be >= 1")
+        if self.spec.mode == "single" and self.spec.slice_count != 1:
+            raise ValidationError("mode=single requires sliceCount=1")

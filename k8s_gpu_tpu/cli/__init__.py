@@ -1,0 +1,4 @@
+from .config import CliConfig, Context
+from .platform_local import LocalPlatform
+
+__all__ = ["CliConfig", "Context", "LocalPlatform"]

@@ -1,0 +1,83 @@
+"""CLI config file with contexts — C26 parity.
+
+The reference CLI keeps ``current-context`` + named contexts
+({host, token, space, user}) in ``~/.config/GoHai-cli/config.yaml``
+(GPU调度平台搭建.md:461-472).  Same schema here; the location honors
+``K8SGPU_CONFIG_DIR`` so tests and multi-env setups don't collide.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import yaml
+
+
+def config_dir() -> Path:
+    return Path(
+        os.environ.get(
+            "K8SGPU_CONFIG_DIR", os.path.expanduser("~/.config/k8sgpu-cli")
+        )
+    )
+
+
+@dataclass
+class Context:
+    name: str
+    host: str = "local"
+    token: str = ""
+    space: str = "default"
+    user: str = ""
+
+
+@dataclass
+class CliConfig:
+    current_context: str = ""
+    contexts: dict[str, Context] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls) -> "CliConfig":
+        path = config_dir() / "config.yaml"
+        if not path.exists():
+            return cls()
+        data = yaml.safe_load(path.read_text()) or {}
+        cfg = cls(current_context=data.get("current-context", ""))
+        for c in data.get("contexts", []):
+            ctx = Context(
+                name=c.get("name", ""),
+                host=c.get("host", "local"),
+                token=c.get("token", ""),
+                space=c.get("space", "default"),
+                user=c.get("user", ""),
+            )
+            cfg.contexts[ctx.name] = ctx
+        return cfg
+
+    def save(self) -> None:
+        d = config_dir()
+        d.mkdir(parents=True, exist_ok=True)
+        doc = {
+            "current-context": self.current_context,
+            "contexts": [
+                {
+                    "name": c.name,
+                    "host": c.host,
+                    "token": c.token,
+                    "space": c.space,
+                    "user": c.user,
+                }
+                for c in self.contexts.values()
+            ],
+        }
+        (d / "config.yaml").write_text(yaml.safe_dump(doc, sort_keys=False))
+
+    def current(self) -> Context | None:
+        return self.contexts.get(self.current_context)
+
+    def use(self, name: str) -> Context:
+        if name not in self.contexts:
+            raise KeyError(f"no such context {name!r}; have {sorted(self.contexts)}")
+        self.current_context = name
+        return self.contexts[name]

@@ -1,0 +1,336 @@
+"""The platform CLI — C26 verb parity (GPU调度平台搭建.md:447-552).
+
+Verbs: login, whoami, context list/new/use, repo init/push,
+trainjob template/create/list/logs (with --dry-run/--bare/-s),
+plus TPU-native extras: pool list/apply/delete, asset list/import.
+
+Run as ``python -m k8s_gpu_tpu.cli ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import secrets
+import sys
+import time
+from pathlib import Path
+
+from .config import CliConfig, Context
+from .platform_local import LocalPlatform
+
+TEMPLATE_SKELETON = """\
+title: my-train-job
+description: ""
+image: registry.example.com/train:latest
+command: python train.py
+env: []
+repository: []
+dataset: []
+model: []
+mode: single
+workload: lm-train
+spec:
+  singleInstanceType: tpu-v5e-8
+"""
+
+
+def _require_login(cfg: CliConfig) -> Context:
+    ctx = cfg.current()
+    if ctx is None or not ctx.token:
+        print("not logged in; run: login --user <you>", file=sys.stderr)
+        raise SystemExit(2)
+    return ctx
+
+
+# -- verb implementations --------------------------------------------------
+
+def cmd_login(args) -> int:
+    cfg = CliConfig.load()
+    name = args.context or "default"
+    ctx = cfg.contexts.get(name) or Context(name=name)
+    ctx.user = args.user
+    ctx.space = args.space or ctx.space
+    # The reference does an OIDC browser code flow (:474-479); the local
+    # platform has no IdP, so mint a session token directly.
+    ctx.token = secrets.token_hex(16)
+    cfg.contexts[name] = ctx
+    cfg.current_context = name
+    cfg.save()
+    print(f"logged in as {ctx.user} (context {name}, space {ctx.space})")
+    return 0
+
+
+def cmd_whoami(args) -> int:
+    ctx = _require_login(CliConfig.load())
+    print(f"user: {ctx.user}\nspace: {ctx.space}\ncontext: {ctx.name}\nhost: {ctx.host}")
+    return 0
+
+
+def cmd_context(args) -> int:
+    cfg = CliConfig.load()
+    if args.context_cmd == "list":
+        for name, c in sorted(cfg.contexts.items()):
+            marker = "*" if name == cfg.current_context else " "
+            print(f"{marker} {name}\thost={c.host}\tspace={c.space}\tuser={c.user}")
+        return 0
+    if args.context_cmd == "new":
+        cfg.contexts[args.name] = Context(
+            name=args.name, host=args.host, space=args.space, user=args.user
+        )
+        cfg.save()
+        print(f"context {args.name} created")
+        return 0
+    if args.context_cmd == "use":
+        try:
+            cfg.use(args.name)
+        except KeyError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        cfg.save()
+        print(f"switched to context {args.name}")
+        return 0
+    return 1
+
+
+def cmd_repo(args) -> int:
+    ctx = _require_login(CliConfig.load())
+    p = LocalPlatform()
+    try:
+        if args.repo_cmd == "init":
+            print(f"repo {args.name} ready in space {ctx.space} (push to upload)")
+            return 0
+        if args.repo_cmd == "push":
+            src = Path(args.path or ".")
+            asset = p.assets.import_path(ctx.space, "repository", args.name, src)
+            print(f"pushed {args.name} {asset.version} ({asset.size} bytes)")
+            return 0
+        return 1
+    finally:
+        p.close()
+
+
+def cmd_trainjob(args) -> int:
+    from ..platform.templates import (
+        TemplateError,
+        expand_template,
+        parse_template,
+        render_template,
+        render_yaml,
+    )
+
+    ctx = _require_login(CliConfig.load())
+    if args.trainjob_cmd == "template":
+        if args.source:
+            p = LocalPlatform()
+            try:
+                job = p.kube.try_get("TrainJob", args.source, ctx.space)
+                if job is None:
+                    print(f"no such job {args.source}", file=sys.stderr)
+                    return 1
+                print(render_template(job), end="")
+                return 0
+            finally:
+                p.close()
+        print(TEMPLATE_SKELETON, end="")
+        return 0
+
+    if args.trainjob_cmd == "create":
+        try:
+            tpl = parse_template(Path(args.file).read_text())
+            name = args.name or f"job-{int(time.time())}"
+            job = expand_template(tpl, name, namespace=ctx.space, bare=args.bare)
+        except (TemplateError, FileNotFoundError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if args.dry_run:
+            print(render_yaml(job), end="")
+            return 0
+        p = LocalPlatform()
+        try:
+            done = p.submit_job(job, wait=not args.no_wait)
+            print(f"{name}\t{done.status.phase}\t{done.status.message}")
+            return 0 if done.status.phase != "Failed" else 1
+        finally:
+            p.close(wait=not args.no_wait)
+
+    p = LocalPlatform()
+    try:
+        if args.trainjob_cmd == "list":
+            print("NAME\tPHASE\tACCEL\tWORKERS\tMESSAGE")
+            for j in p.kube.list("TrainJob", namespace=ctx.space):
+                print(
+                    f"{j.metadata.name}\t{j.status.phase}\t"
+                    f"{j.spec.accelerator_type}\t{j.spec.num_workers}\t"
+                    f"{j.status.message}"
+                )
+            return 0
+        if args.trainjob_cmd == "logs":
+            j = p.kube.try_get("TrainJob", args.job_id, ctx.space)
+            if j is None:
+                print(f"no such job {args.job_id}", file=sys.stderr)
+                return 1
+            for line in j.status.logs:
+                print(line)
+            if j.status.result:
+                print(f"result: {j.status.result}")
+            return 0
+        return 1
+    finally:
+        p.close()
+
+
+def cmd_pool(args) -> int:
+    from ..api.tpupodslice import TpuPodSlice
+
+    ctx = _require_login(CliConfig.load())
+    p = LocalPlatform()
+    try:
+        if args.pool_cmd == "list":
+            print("NAME\tACCEL\tDESIRED\tREADY\tPHASE")
+            for ps in p.kube.list("TpuPodSlice", namespace=ctx.space):
+                c = ps.printer_columns
+                print(
+                    f"{ps.metadata.name}\t{c['Accelerator']}\t{c['Desired']}\t"
+                    f"{c['Ready']}\t{c['Phase']}"
+                )
+            return 0
+        if args.pool_cmd == "apply":
+            existing = p.kube.try_get("TpuPodSlice", args.name, ctx.space)
+            if existing is None:
+                ps = TpuPodSlice()
+                ps.metadata.name = args.name
+                ps.metadata.namespace = ctx.space
+                ps.spec.accelerator_type = args.accelerator
+                ps.spec.slice_count = args.slices
+                p.kube.create(ps)
+            else:
+                existing.spec.accelerator_type = args.accelerator
+                existing.spec.slice_count = args.slices
+                p.kube.update(existing)
+            deadline = time.monotonic() + args.timeout
+            while time.monotonic() < deadline:
+                cur = p.kube.get("TpuPodSlice", args.name, ctx.space)
+                if cur.status.phase in ("Ready", "Paused"):
+                    break
+                time.sleep(0.05)
+            cur = p.kube.get("TpuPodSlice", args.name, ctx.space)
+            print(f"{args.name}\t{cur.status.phase}\tready={cur.status.ready_replicas}")
+            return 0 if cur.status.phase in ("Ready", "Paused") else 1
+        if args.pool_cmd == "delete":
+            from ..controller.kubefake import NotFound
+
+            try:
+                p.kube.delete("TpuPodSlice", args.name, ctx.space)
+            except NotFound:
+                print(f"no such pool {args.name}", file=sys.stderr)
+                return 1
+            p.settle()
+            print(f"{args.name} deleted")
+            return 0
+        return 1
+    finally:
+        p.close()
+
+
+def cmd_asset(args) -> int:
+    ctx = _require_login(CliConfig.load())
+    p = LocalPlatform()
+    try:
+        if args.asset_cmd == "list":
+            for kind, id in p.assets.list_assets(ctx.space, args.kind):
+                versions = p.assets.versions(ctx.space, kind, id)
+                print(f"{kind}\t{id}\t{','.join(versions)}")
+            return 0
+        if args.asset_cmd == "import":
+            a = p.assets.import_path(ctx.space, args.kind, args.id, args.path)
+            print(f"imported {args.kind}/{args.id} {a.version} ({a.size} bytes)")
+            return 0
+        return 1
+    finally:
+        p.close()
+
+
+# -- parser ----------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="k8sgpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_login = sub.add_parser("login", help="authenticate and store a context token")
+    p_login.add_argument("--user", required=True)
+    p_login.add_argument("--space", default="")
+    p_login.add_argument("--context", default="")
+    p_login.set_defaults(fn=cmd_login)
+
+    sub.add_parser("whoami", help="show current identity").set_defaults(fn=cmd_whoami)
+
+    p_ctx = sub.add_parser("context", help="manage contexts")
+    ctx_sub = p_ctx.add_subparsers(dest="context_cmd", required=True)
+    ctx_sub.add_parser("list")
+    p_new = ctx_sub.add_parser("new")
+    p_new.add_argument("name")
+    p_new.add_argument("--host", default="local")
+    p_new.add_argument("--space", default="default")
+    p_new.add_argument("--user", default="")
+    p_use = ctx_sub.add_parser("use")
+    p_use.add_argument("name")
+    p_ctx.set_defaults(fn=cmd_context)
+
+    p_repo = sub.add_parser("repo", help="code repositories")
+    repo_sub = p_repo.add_subparsers(dest="repo_cmd", required=True)
+    repo_sub.add_parser("init").add_argument("name")
+    p_push = repo_sub.add_parser("push")
+    p_push.add_argument("name")
+    p_push.add_argument("--path", default=".")
+    p_repo.set_defaults(fn=cmd_repo)
+
+    p_tj = sub.add_parser("trainjob", help="training jobs")
+    tj_sub = p_tj.add_subparsers(dest="trainjob_cmd", required=True)
+    p_tpl = tj_sub.add_parser("template")
+    p_tpl.add_argument("-s", "--source", default="", help="render template of existing job")
+    p_create = tj_sub.add_parser("create")
+    p_create.add_argument("-f", "--file", required=True)
+    p_create.add_argument("--name", default="")
+    p_create.add_argument("--dry-run", action="store_true")
+    p_create.add_argument("--bare", action="store_true")
+    p_create.add_argument("--no-wait", action="store_true")
+    tj_sub.add_parser("list")
+    p_logs = tj_sub.add_parser("logs")
+    p_logs.add_argument("job_id")
+    p_tj.set_defaults(fn=cmd_trainjob)
+
+    p_pool = sub.add_parser("pool", help="TPU pod-slice pools")
+    pool_sub = p_pool.add_subparsers(dest="pool_cmd", required=True)
+    pool_sub.add_parser("list")
+    p_apply = pool_sub.add_parser("apply")
+    p_apply.add_argument("name")
+    p_apply.add_argument("--accelerator", required=True)
+    p_apply.add_argument("--slices", type=int, default=1)
+    p_apply.add_argument("--timeout", type=float, default=60.0)
+    p_del = pool_sub.add_parser("delete")
+    p_del.add_argument("name")
+    p_pool.set_defaults(fn=cmd_pool)
+
+    p_asset = sub.add_parser("asset", help="datasets/models/repos")
+    asset_sub = p_asset.add_subparsers(dest="asset_cmd", required=True)
+    p_al = asset_sub.add_parser("list")
+    p_al.add_argument("--kind", default=None)
+    p_ai = asset_sub.add_parser("import")
+    p_ai.add_argument("--kind", required=True, choices=["dataset", "model", "repository"])
+    p_ai.add_argument("--id", required=True)
+    p_ai.add_argument("--path", required=True)
+    p_asset.set_defaults(fn=cmd_asset)
+
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except SystemExit as e:  # _require_login short-circuit
+        return int(e.code or 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

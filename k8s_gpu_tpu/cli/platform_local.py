@@ -1,0 +1,108 @@
+"""Local platform backend the CLI talks to.
+
+The reference CLI talks to GoHai-api over HTTPS (GPU调度平台搭建.md:474-552);
+this framework's control plane is in-process, so the CLI binds the same
+verbs to a locally persisted platform: FakeKube state pickled under a state
+dir, controllers (TpuPodSlice + TrainJob + autoscaler) spun up per
+invocation and drained to quiescence before state is saved.  Result: every
+CLI command behaves like a short-lived API server session with durable
+cluster state — and no network surface to secure for a single-user dev box.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from pathlib import Path
+
+from ..api.trainjob import TrainJob
+from ..cloud.fake_cloudtpu import FakeCloudTpu, cloudtpu_client_factory
+from ..controller.kubefake import FakeKube
+from ..controller.manager import Manager
+from ..operators import SliceAutoscaler, TpuPodSliceReconciler, TrainJobReconciler
+from ..platform.assets import AssetStore
+
+
+def state_dir() -> Path:
+    return Path(
+        os.environ.get(
+            "K8SGPU_STATE_DIR", os.path.expanduser("~/.local/state/k8sgpu")
+        )
+    )
+
+
+class LocalPlatform:
+    def __init__(self):
+        self.root = state_dir()
+        self.root.mkdir(parents=True, exist_ok=True)
+        # Exclusive lock for the whole invocation: the state files are a
+        # read-modify-write cycle, and concurrent CLI processes would
+        # otherwise clobber each other last-writer-wins.
+        import fcntl
+
+        self._lockfile = open(self.root / ".lock", "w")
+        fcntl.flock(self._lockfile, fcntl.LOCK_EX)
+        self.kube = FakeKube()
+        self._load()
+        self.cloud = self._load_cloud()
+        self.assets = AssetStore(self.root / "assets")
+        self.mgr = Manager(self.kube)
+        self.mgr.register(
+            "TpuPodSlice",
+            TpuPodSliceReconciler(
+                self.kube, cloudtpu_client_factory(self.cloud), provision_poll=0.05
+            ),
+        )
+        self.mgr.register("TrainJob", TrainJobReconciler(self.kube), name="trainjob")
+        self.mgr.register("TrainJob", SliceAutoscaler(self.kube), name="autoscaler")
+        self.mgr.start()
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> None:
+        f = self.root / "kube.pkl"
+        if f.exists():
+            self.kube.load(pickle.loads(f.read_bytes()))
+
+    def _load_cloud(self) -> FakeCloudTpu:
+        f = self.root / "cloud.pkl"
+        cloud = FakeCloudTpu()
+        if f.exists():
+            snap = pickle.loads(f.read_bytes())
+            cloud.queued_resources = snap
+        return cloud
+
+    def close(self, wait: bool = True) -> None:
+        """Persist state and release the lock.  ``wait=False`` skips the
+        drain (fire-and-forget submits): in-flight work is abandoned in
+        this process, and the level-triggered reconcilers resume it from
+        the persisted CR state on the next invocation."""
+        if wait:
+            self.mgr.wait_idle(timeout=30)
+        self.mgr.stop()
+        (self.root / "kube.pkl").write_bytes(pickle.dumps(self.kube.dump()))
+        (self.root / "cloud.pkl").write_bytes(
+            pickle.dumps(self.cloud.queued_resources)
+        )
+        import fcntl
+
+        fcntl.flock(self._lockfile, fcntl.LOCK_UN)
+        self._lockfile.close()
+
+    # -- verbs -------------------------------------------------------------
+    def settle(self, predicate=None, timeout: float = 60.0) -> bool:
+        return self.mgr.wait_idle(timeout=timeout, predicate=predicate)
+
+    def submit_job(self, job: TrainJob, wait: bool = True, timeout: float = 300.0):
+        self.kube.create(job)
+        if not wait:
+            return self.kube.get("TrainJob", job.metadata.name, job.metadata.namespace)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            cur = self.kube.get(
+                "TrainJob", job.metadata.name, job.metadata.namespace
+            )
+            if cur.status.phase in ("Succeeded", "Failed"):
+                return cur
+            time.sleep(0.05)
+        return self.kube.get("TrainJob", job.metadata.name, job.metadata.namespace)

@@ -192,6 +192,19 @@ class FakeKube:
                 out.append(obj.deepcopy())
             return sorted(out, key=lambda o: (o.metadata.namespace, o.metadata.name))
 
+    # -- persistence (CLI-local platform state) ----------------------------
+    def dump(self) -> dict:
+        """Snapshot for pickling (locks/watchers excluded)."""
+        with self._lock:
+            import copy
+
+            return {"store": copy.deepcopy(self._store), "rv": self._rv}
+
+    def load(self, snapshot: dict) -> None:
+        with self._lock:
+            self._store = snapshot["store"]
+            self._rv = snapshot["rv"]
+
     # -- watch -------------------------------------------------------------
     def watch(self, kind: str, callback: Callable[[WatchEvent], None]) -> None:
         """Subscribe to events for *kind* ('*' = all kinds).  Existing objects

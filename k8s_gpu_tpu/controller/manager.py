@@ -48,6 +48,7 @@ class Reconciler:
 
 @dataclass
 class _Controller:
+    name: str
     kind: str
     reconciler: Reconciler
     queue: RateLimitingQueue
@@ -69,11 +70,23 @@ class Manager:
         self._started = False
         self._stop = threading.Event()
 
-    def register(self, kind: str, reconciler: Reconciler, workers: int = 1) -> None:
+    def register(
+        self,
+        kind: str,
+        reconciler: Reconciler,
+        workers: int = 1,
+        name: str | None = None,
+    ) -> None:
+        """Register a controller watching *kind*.  ``name`` distinguishes
+        multiple controllers on the same kind (e.g. the TrainJob reconciler
+        and the autoscaler both watch TrainJob)."""
         if self._started:
             raise RuntimeError("register before start()")
+        name = name or kind
+        if name in self._controllers:
+            raise ValueError(f"controller {name!r} already registered")
         q = RateLimitingQueue(clock=self.clock)
-        self._controllers[kind] = _Controller(kind, reconciler, q, workers)
+        self._controllers[name] = _Controller(name, kind, reconciler, q, workers)
 
     def start(self) -> None:
         self._started = True

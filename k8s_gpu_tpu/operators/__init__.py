@@ -1,4 +1,6 @@
 from .azurevmpool import AzureVmPoolReconciler
 from .tpupodslice import TpuPodSliceReconciler
+from .trainjob import TrainJobReconciler
+from .autoscaler import SliceAutoscaler
 
-__all__ = ["AzureVmPoolReconciler", "TpuPodSliceReconciler"]
+__all__ = ["AzureVmPoolReconciler", "TpuPodSliceReconciler", "TrainJobReconciler", "SliceAutoscaler"]

@@ -1,0 +1,113 @@
+"""Slice autoscaler — scale-from-zero on pending jobs (BASELINE config 5).
+
+The reference has no autoscaling; the closest concept is the KEDA trigger
+named by BASELINE config 5.  Mechanism: this controller watches TrainJobs.
+When a job is Pending for capacity, it ensures an autoscale-managed
+TpuPodSlice for the job's accelerator type exists with enough slices
+(creating it from zero if needed).  When no live jobs need that
+accelerator anymore, it scales the pool back to zero — capacity follows
+the queue in both directions.
+
+Pools created here carry the ``autoscale`` label; user-managed pools are
+never touched (the reference's tag-isolation principle, README.md:238,
+applied one layer up).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api.tpupodslice import TpuPodSlice
+from ..controller.events import EventRecorder
+from ..controller.kubefake import Conflict, FakeKube
+from ..controller.manager import Reconciler, Request, Result
+from ..utils.metrics import MetricsRegistry, global_metrics
+
+log = logging.getLogger("k8s_gpu_tpu.operators.autoscaler")
+
+AUTOSCALE_LABEL = "tpu.k8sgpu.dev/autoscale"
+IDLE_RECHECK = 30.0
+
+
+class SliceAutoscaler(Reconciler):
+    def __init__(self, kube: FakeKube, metrics: MetricsRegistry | None = None):
+        self.kube = kube
+        self.recorder = EventRecorder(kube, "slice-autoscaler")
+        self.metrics = metrics or global_metrics
+
+    @staticmethod
+    def pool_name(accelerator_type: str) -> str:
+        return f"autoscale-{accelerator_type}"
+
+    def reconcile(self, req: Request) -> Result:
+        job = self.kube.try_get("TrainJob", req.name, req.namespace)
+        if job is None or not job.spec.accelerator_type:
+            return Result()
+
+        accel = job.spec.accelerator_type
+        demand = self._demand(accel, req.namespace)
+        pool = self.kube.try_get("TpuPodSlice", self.pool_name(accel), req.namespace)
+
+        if demand > 0:
+            if pool is None:
+                pool = TpuPodSlice()
+                pool.metadata.name = self.pool_name(accel)
+                pool.metadata.namespace = req.namespace
+                pool.metadata.labels[AUTOSCALE_LABEL] = "true"
+                pool.spec.accelerator_type = accel
+                pool.spec.slice_count = demand
+                try:
+                    self.kube.create(pool)
+                except Conflict:
+                    return Result(requeue=True)
+                self.recorder.event(
+                    job, "Normal", "ScaledFromZero",
+                    f"created pool {pool.metadata.name} with {demand} slice(s)",
+                )
+                self.metrics.inc("autoscale_scale_ups_total")
+            elif (
+                pool.metadata.labels.get(AUTOSCALE_LABEL) == "true"
+                and pool.spec.slice_count < demand
+            ):
+                pool.spec.slice_count = demand
+                try:
+                    self.kube.update(pool)
+                except Conflict:
+                    return Result(requeue=True)
+                self.recorder.event(
+                    job, "Normal", "ScaledUp",
+                    f"pool {pool.metadata.name} → {demand} slice(s)",
+                )
+                self.metrics.inc("autoscale_scale_ups_total")
+            # Re-check until the job gets placed (TrainJob reconciler races
+            # us to the capacity as it arrives).
+            return Result(requeue_after=5.0)
+
+        # No demand: scale an autoscale-managed pool back to zero.
+        if (
+            pool is not None
+            and pool.metadata.labels.get(AUTOSCALE_LABEL) == "true"
+            and pool.spec.slice_count != 0
+        ):
+            pool.spec.slice_count = 0
+            try:
+                self.kube.update(pool)
+            except Conflict:
+                return Result(requeue=True)
+            self.recorder.event(
+                pool, "Normal", "ScaledToZero",
+                f"no pending/running jobs need {accel}",
+            )
+            self.metrics.inc("autoscale_scale_downs_total")
+        return Result()
+
+    def _demand(self, accel: str, namespace: str) -> int:
+        """Max slices any live job for this accelerator needs."""
+        demand = 0
+        for j in self.kube.list("TrainJob", namespace=namespace):
+            if j.spec.accelerator_type != accel:
+                continue
+            if j.status.phase in ("Succeeded", "Failed"):
+                continue
+            demand = max(demand, j.spec.slice_count)
+        return demand

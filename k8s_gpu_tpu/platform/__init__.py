@@ -1,0 +1,24 @@
+from .instances import InstanceType, INSTANCE_CATALOG, resolve_instance_type
+from .templates import (
+    TrainJobTemplate,
+    TemplateError,
+    parse_template,
+    expand_template,
+    render_template,
+    render_yaml,
+)
+from .assets import AssetStore, Asset
+
+__all__ = [
+    "InstanceType",
+    "INSTANCE_CATALOG",
+    "resolve_instance_type",
+    "TrainJobTemplate",
+    "TemplateError",
+    "parse_template",
+    "expand_template",
+    "render_template",
+    "render_yaml",
+    "AssetStore",
+    "Asset",
+]

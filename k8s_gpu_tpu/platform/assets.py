@@ -1,0 +1,123 @@
+"""Versioned asset store — the MinIO/model-asset role (C11, C29, C30).
+
+The reference stores datasets/models in MinIO with versioned "assets"
+(``mc cp /output/*.pth ...``, GPU调度平台搭建.md:686-697) and imports via
+web/SFTP/REST (:701-744).  Here: a local content-addressed store with the
+same capability surface — spaces, named assets, monotonically versioned
+snapshots, import from a local path or bytes, export to a path — used by
+checkpointing (train/checkpoint.py) and the CLI's repo/asset verbs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class Asset:
+    space: str
+    id: str
+    version: str
+    kind: str  # dataset | model | repository
+    sha256: str
+    size: int
+    created_at: float
+    path: str
+
+
+class AssetStore:
+    """Directory layout: <root>/<space>/<kind>/<id>/<version>/payload + meta."""
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _dir(self, space: str, kind: str, id: str, version: str) -> Path:
+        return self.root / space / kind / id / version
+
+    # -- write -------------------------------------------------------------
+    def import_bytes(
+        self, space: str, kind: str, id: str, data: bytes
+    ) -> Asset:
+        version = f"v{len(self.versions(space, kind, id)) + 1}"
+        d = self._dir(space, kind, id, version)
+        d.mkdir(parents=True, exist_ok=True)
+        payload = d / "payload"
+        payload.write_bytes(data)
+        meta = Asset(
+            space=space,
+            id=id,
+            version=version,
+            kind=kind,
+            sha256=hashlib.sha256(data).hexdigest(),
+            size=len(data),
+            created_at=time.time(),
+            path=str(payload),
+        )
+        (d / "meta.json").write_text(json.dumps(vars(meta)))
+        return meta
+
+    def import_path(self, space: str, kind: str, id: str, src: str | Path) -> Asset:
+        """Import a file or directory (the reference's SFTP/lftp bulk path,
+        :707-734 — incremental dirs arrive as archives here)."""
+        src = Path(src)
+        if src.is_dir():
+            version = f"v{len(self.versions(space, kind, id)) + 1}"
+            d = self._dir(space, kind, id, version)
+            shutil.copytree(src, d / "payload")
+            size = sum(p.stat().st_size for p in (d / "payload").rglob("*") if p.is_file())
+            meta = Asset(space, id, version, kind, "", size, time.time(), str(d / "payload"))
+            (d / "meta.json").write_text(json.dumps(vars(meta)))
+            return meta
+        return self.import_bytes(space, kind, id, src.read_bytes())
+
+    # -- read --------------------------------------------------------------
+    def versions(self, space: str, kind: str, id: str) -> list[str]:
+        d = self.root / space / kind / id
+        if not d.exists():
+            return []
+        # Numeric ordering: lexicographic would make v9 "newer" than v10.
+        return sorted(
+            (p.name for p in d.iterdir() if p.is_dir()),
+            key=lambda v: (
+                int(v[1:]) if v[1:].isdigit() else float("inf"), v
+            ),
+        )
+
+    def get(self, space: str, kind: str, id: str, version: str = "") -> Asset:
+        """version '' = latest (the reference's hash-''-means-latest, :525)."""
+        vs = self.versions(space, kind, id)
+        if not vs:
+            raise KeyError(f"no asset {space}/{kind}/{id}")
+        v = version or vs[-1]
+        if v not in vs:
+            raise KeyError(f"no version {v} of {space}/{kind}/{id} (have {vs})")
+        meta = json.loads((self._dir(space, kind, id, v) / "meta.json").read_text())
+        return Asset(**meta)
+
+    def export(self, asset: Asset, dest: str | Path) -> Path:
+        dest = Path(dest)
+        src = Path(asset.path)
+        if src.is_dir():
+            shutil.copytree(src, dest, dirs_exist_ok=True)
+        else:
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy2(src, dest)
+        return dest
+
+    def list_assets(self, space: str, kind: str | None = None) -> list[tuple[str, str]]:
+        out = []
+        base = self.root / space
+        if not base.exists():
+            return out
+        for kdir in base.iterdir():
+            if kind and kdir.name != kind:
+                continue
+            for adir in kdir.iterdir():
+                out.append((kdir.name, adir.name))
+        return sorted(out)

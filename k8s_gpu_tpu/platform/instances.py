@@ -1,0 +1,72 @@
+"""Instance-type catalog — the capacity abstraction users pick in job specs.
+
+The reference encodes capacity as strings like ``gpu-1x-16c-32g-1gpu``
+(GPU调度平台搭建.md:535, 828-851: "the instance-type abstraction").  The
+TPU-native catalog maps such names to accelerator types + host shape, and
+keeps GPU-era aliases so reference job templates translate 1:1
+(SURVEY §5.6d → BASELINE configs' accelerator types).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..cloud.topology import parse_accelerator_type
+
+
+@dataclass(frozen=True)
+class InstanceType:
+    name: str
+    accelerator_type: str  # "" = CPU-only instance
+    cpu: int
+    memory_gb: int
+
+    @property
+    def workers(self) -> int:
+        """Host (worker pod) count for a job on this instance type."""
+        if not self.accelerator_type:
+            return 1
+        return parse_accelerator_type(self.accelerator_type).hosts
+
+    @property
+    def chips(self) -> int:
+        if not self.accelerator_type:
+            return 0
+        return parse_accelerator_type(self.accelerator_type).chips
+
+
+INSTANCE_CATALOG: dict[str, InstanceType] = {
+    # CPU-only (dev/preprocess).
+    "cpu-16c-32g": InstanceType("cpu-16c-32g", "", 16, 32),
+    # TPU instance types (BASELINE configs 2-4).
+    "tpu-v4-8": InstanceType("tpu-v4-8", "v4-8", 120, 192),
+    "tpu-v5e-8": InstanceType("tpu-v5e-8", "v5e-8", 112, 192),
+    "tpu-v5e-64": InstanceType("tpu-v5e-64", "v5e-64", 112, 192),
+    "tpu-v5e-256": InstanceType("tpu-v5e-256", "v5e-256", 112, 192),
+    "tpu-v5p-8": InstanceType("tpu-v5p-8", "v5p-8", 208, 448),
+    "tpu-v5p-64": InstanceType("tpu-v5p-64", "v5p-64", 208, 448),
+    "tpu-v6e-8": InstanceType("tpu-v6e-8", "v6e-8", 180, 720),
+}
+
+# Reference-era GPU names → nearest TPU types, so templates written against
+# the reference platform (gpu-1x-16c-32g-1gpu, :535) resolve unchanged.
+ALIASES: dict[str, str] = {
+    "gpu-1x-16c-32g-1gpu": "tpu-v5e-8",
+    "gpu-8x-96c-768g-8gpu": "tpu-v5p-8",
+}
+
+
+def resolve_instance_type(name: str) -> InstanceType:
+    canonical = ALIASES.get(name, name)
+    it = INSTANCE_CATALOG.get(canonical)
+    if it is None:
+        # Accept bare accelerator types ("v5p-64") as implicit instances.
+        try:
+            parse_accelerator_type(canonical)
+        except ValueError:
+            raise KeyError(
+                f"unknown instance type {name!r}; known: "
+                f"{sorted(INSTANCE_CATALOG) + sorted(ALIASES)}"
+            )
+        return InstanceType(canonical, canonical, 96, 192)
+    return it

@@ -1,0 +1,175 @@
+"""Train-job template schema + server-side expansion — C26/C27 parity.
+
+The reference's flow: users write a YAML template (title/image/command/env/
+repository/dataset/model/mode/spec.singleInstanceType,
+GPU调度平台搭建.md:512-535), and the platform expands it into a Volcano Job
+("platform-generated", :540-541) with ``--dry-run`` returning the YAML and
+``--bare`` skipping expansion (:537-552).  Here expansion resolves the
+instance type through the TPU catalog, fills accelerator/worker counts, and
+produces a TrainJob CR.
+"""
+
+from __future__ import annotations
+
+import io
+
+import yaml
+
+from ..api.trainjob import AssetRef, EnvVar, TrainJob, TrainJobSpec
+from .instances import resolve_instance_type
+
+
+class TemplateError(Exception):
+    pass
+
+
+# The template *is* its YAML schema; parse → TrainJobSpec-shaped dict.
+REQUIRED_FIELDS = ("title",)
+KNOWN_FIELDS = {
+    "title", "description", "image", "command", "env", "repository",
+    "dataset", "model", "mode", "spec", "workload", "workload_args",
+}
+
+
+class TrainJobTemplate(dict):
+    """Parsed template; dict subclass so round-tripping stays trivial."""
+
+
+def parse_template(text: str) -> TrainJobTemplate:
+    try:
+        data = yaml.safe_load(text)
+    except yaml.YAMLError as e:
+        raise TemplateError(f"invalid YAML: {e}") from e
+    if not isinstance(data, dict):
+        raise TemplateError("template must be a YAML mapping")
+    unknown = set(data) - KNOWN_FIELDS
+    if unknown:
+        raise TemplateError(f"unknown template fields: {sorted(unknown)}")
+    for f in REQUIRED_FIELDS:
+        if f not in data:
+            raise TemplateError(f"missing required field: {f}")
+    return TrainJobTemplate(data)
+
+
+def _asset_list(raw, version_key: str) -> list[AssetRef]:
+    out = []
+    for item in raw or []:
+        out.append(
+            AssetRef(
+                space=item.get("space", ""),
+                id=str(item.get("id", "")),
+                version=str(item.get(version_key, item.get("version", "")) or ""),
+            )
+        )
+    return out
+
+
+def expand_template(
+    tpl: TrainJobTemplate,
+    name: str,
+    namespace: str = "default",
+    bare: bool = False,
+) -> TrainJob:
+    """Template → TrainJob CR.  ``bare`` skips server-side defaulting
+    (the reference's --bare, :552): the spec is taken literally with no
+    catalog resolution."""
+    spec_block = tpl.get("spec") or {}
+    instance = spec_block.get("singleInstanceType") or spec_block.get(
+        "instanceType", "tpu-v5e-8"
+    )
+    mode = tpl.get("mode", "single")
+    slice_count = int(spec_block.get("sliceCount", 1))
+    job = TrainJob()
+    job.metadata.name = name
+    job.metadata.namespace = namespace
+    job.spec = TrainJobSpec(
+        title=tpl.get("title", ""),
+        description=tpl.get("description", ""),
+        image=tpl.get("image", ""),
+        command=tpl.get("command", ""),
+        env=[EnvVar(e.get("name", ""), str(e.get("value", "")))
+             for e in tpl.get("env") or []],
+        repository=_asset_list(tpl.get("repository"), "hash"),
+        dataset=_asset_list(tpl.get("dataset"), "versionId"),
+        model=_asset_list(tpl.get("model"), "versionId"),
+        mode=mode,
+        instance_type=instance,
+        slice_count=slice_count,
+        workload=tpl.get("workload", ""),
+        workload_args=tpl.get("workload_args") or {},
+    )
+    if bare:
+        # --bare submits the spec literally (expert mode): the template may
+        # carry acceleratorType/numWorkers directly under spec.
+        job.spec.accelerator_type = spec_block.get("acceleratorType", "")
+        job.spec.num_workers = int(spec_block.get("numWorkers", 0))
+    else:
+        try:
+            it = resolve_instance_type(instance)
+        except KeyError as e:
+            raise TemplateError(str(e)) from e
+        job.spec.accelerator_type = it.accelerator_type
+        job.spec.num_workers = it.workers * slice_count
+    job.validate()
+    return job
+
+
+def render_template(job: TrainJob) -> str:
+    """TrainJob → template-schema YAML (round-trippable through
+    parse_template — the ``trainjob template -s <job>`` verb, :546-551)."""
+    doc = {
+        "title": job.spec.title,
+        "description": job.spec.description,
+        "image": job.spec.image,
+        "command": job.spec.command,
+        "env": [{"name": e.name, "value": e.value} for e in job.spec.env],
+        "repository": [
+            {"space": r.space, "id": r.id, "hash": r.version}
+            for r in job.spec.repository
+        ],
+        "dataset": [
+            {"space": d.space, "id": d.id, "versionId": d.version}
+            for d in job.spec.dataset
+        ],
+        "model": [
+            {"space": m.space, "id": m.id, "versionId": m.version}
+            for m in job.spec.model
+        ],
+        "mode": job.spec.mode,
+        "workload": job.spec.workload,
+        "workload_args": job.spec.workload_args,
+        "spec": {
+            "singleInstanceType": job.spec.instance_type,
+            "sliceCount": job.spec.slice_count,
+        },
+    }
+    buf = io.StringIO()
+    yaml.safe_dump(doc, buf, sort_keys=False)
+    return buf.getvalue()
+
+
+def render_yaml(job: TrainJob) -> str:
+    """The --dry-run output: the expanded CR as YAML (:548-551)."""
+    doc = {
+        "apiVersion": job.api_version,
+        "kind": job.kind,
+        "metadata": {"name": job.metadata.name, "namespace": job.metadata.namespace},
+        "spec": {
+            "title": job.spec.title,
+            "image": job.spec.image,
+            "command": job.spec.command,
+            "env": [{"name": e.name, "value": e.value} for e in job.spec.env],
+            "repository": [vars(r) for r in job.spec.repository],
+            "dataset": [vars(d) for d in job.spec.dataset],
+            "model": [vars(m) for m in job.spec.model],
+            "mode": job.spec.mode,
+            "instanceType": job.spec.instance_type,
+            "acceleratorType": job.spec.accelerator_type,
+            "numWorkers": job.spec.num_workers,
+            "sliceCount": job.spec.slice_count,
+            "workload": job.spec.workload,
+        },
+    }
+    buf = io.StringIO()
+    yaml.safe_dump(doc, buf, sort_keys=False)
+    return buf.getvalue()

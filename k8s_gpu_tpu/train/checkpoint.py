@@ -1,0 +1,93 @@
+"""Checkpoint/resume — Orbax-backed training state persistence.
+
+The reference's checkpoint story is workload-level ``torch.save`` to
+``/output`` exported to MinIO as versioned model assets
+(GPU调度平台搭建.md:603, 686-697); SURVEY §5.4 names Orbax as the
+TPU-native obligation.  This wrapper persists {params, opt_state, step}
+with retention, restores onto the trainer's mesh shardings (so a resume
+onto a different mesh re-shards correctly), and can export a checkpoint
+into the platform AssetStore as a versioned model asset (C30 parity).
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..platform.assets import Asset, AssetStore
+
+log = logging.getLogger("k8s_gpu_tpu.train.checkpoint")
+
+
+class CheckpointManager:
+    def __init__(self, directory: str | Path, max_to_keep: int = 3):
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, params, opt_state) -> None:
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+        )
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, params_like, opt_state_like, step: int | None = None):
+        """Restore onto the sharding/structure of the *_like pytrees (pass
+        the trainer's freshly-initialized state to resume onto its mesh)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(params_like),
+                opt_state=ocp.args.StandardRestore(opt_state_like),
+            ),
+        )
+        return restored["params"], restored["opt_state"], step
+
+    def export_to_assets(
+        self, store: AssetStore, space: str, asset_id: str, step: int | None = None
+    ) -> Asset:
+        """Checkpoint → versioned model asset (the reference's /output →
+        MinIO export, :686-697)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("nothing to export")
+        src = self.directory / str(step)
+        return store.import_path(space, "model", asset_id, src)
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def attach_to_trainer(trainer, directory: str | Path, max_to_keep: int = 3):
+    """Convenience: returns (ckpt, save_fn(step), resume_fn()) bound to a
+    Trainer's params/opt_state."""
+    ckpt = CheckpointManager(directory, max_to_keep=max_to_keep)
+
+    def save(step: int) -> None:
+        ckpt.save(step, trainer.params, trainer.opt_state)
+
+    def resume() -> int:
+        params, opt_state, step = ckpt.restore(trainer.params, trainer.opt_state)
+        trainer.params = params
+        trainer.opt_state = opt_state
+        return step
+
+    return ckpt, save, resume

@@ -1,0 +1,103 @@
+"""In-process workload registry — what a TrainJob's ``workload`` names.
+
+The reference runs training as a container command (git clone + python
+train.py, GPU调度平台搭建.md:662-664).  This framework runs JAX workloads
+in-process (no container runtime in the loop): a workload is a callable
+``fn(job_spec, placements) -> dict`` registered by name.  The built-ins
+mirror the reference's catalogue: the psum smoke probe (BASELINE
+acceptance), the CNN trainer (C28 parity), and the flagship LM trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register_workload(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_workload(name: str) -> Callable:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown workload {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def known_workloads() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# -- built-ins -------------------------------------------------------------
+
+@register_workload("psum-smoke")
+def _psum_smoke(spec, placements) -> dict:
+    from ..parallel.collectives import psum_smoke
+
+    out = psum_smoke()
+    if not out["ok"]:
+        raise RuntimeError(f"psum smoke failed: {out}")
+    return out
+
+
+@register_workload("cnn-train")
+def _cnn_train(spec, placements) -> dict:
+    import jax
+
+    from ..models import SmallCnn
+    from ..parallel.mesh import MeshConfig, build_mesh
+    from .runner import TrainConfig, Trainer
+
+    args = spec.workload_args
+    steps = int(args.get("steps", 5))
+    batch = int(args.get("batch", 16))
+    model = SmallCnn()
+    trainer = Trainer(
+        model,
+        mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TrainConfig(warmup_steps=1, learning_rate=1e-3),
+    )
+    trainer.init(jax.random.PRNGKey(0))
+    ki, kl = jax.random.split(jax.random.PRNGKey(1))
+    labels = jax.random.randint(kl, (batch,), 0, 10)
+    images = (
+        jax.random.normal(ki, (batch, 28, 28, 1)) * 0.1
+        + labels[:, None, None, None] / 10.0
+    )
+    losses = [trainer.step(images, labels) for _ in range(steps)]
+    return {"first_loss": losses[0], "last_loss": losses[-1], "steps": steps}
+
+
+@register_workload("lm-train")
+def _lm_train(spec, placements) -> dict:
+    import jax
+
+    from ..models import TransformerConfig, TransformerLM
+    from ..parallel.mesh import MeshConfig, build_mesh
+    from .runner import TrainConfig, Trainer
+
+    args = spec.workload_args
+    steps = int(args.get("steps", 3))
+    cfg = TransformerConfig(
+        vocab_size=int(args.get("vocab", 256)),
+        d_model=int(args.get("d_model", 64)),
+        n_layers=int(args.get("layers", 2)),
+        n_heads=4,
+        d_head=16,
+        d_ff=int(args.get("d_ff", 128)),
+    )
+    model = TransformerLM(cfg)
+    trainer = Trainer(
+        model,
+        mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TrainConfig(warmup_steps=1, learning_rate=1e-3),
+    )
+    trainer.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size)
+    losses = [trainer.step(toks[:, :-1], toks[:, 1:]) for _ in range(steps)]
+    return {"first_loss": losses[0], "last_loss": losses[-1], "steps": steps}

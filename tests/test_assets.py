@@ -1,0 +1,61 @@
+"""Versioned asset store (C11/C29/C30 parity)."""
+
+import pytest
+
+from k8s_gpu_tpu.platform import AssetStore
+
+
+@pytest.fixture
+def store(tmp_path):
+    return AssetStore(tmp_path / "assets")
+
+
+def test_import_versions_monotonic(store):
+    a1 = store.import_bytes("ml", "model", "lm", b"weights-v1")
+    a2 = store.import_bytes("ml", "model", "lm", b"weights-v2")
+    assert (a1.version, a2.version) == ("v1", "v2")
+    assert store.versions("ml", "model", "lm") == ["v1", "v2"]
+
+
+def test_get_latest_and_pinned(store):
+    store.import_bytes("ml", "model", "lm", b"one")
+    store.import_bytes("ml", "model", "lm", b"two")
+    latest = store.get("ml", "model", "lm")  # "" = latest (:525 semantics)
+    assert latest.version == "v2"
+    pinned = store.get("ml", "model", "lm", "v1")
+    assert open(pinned.path, "rb").read() == b"one"
+
+
+def test_export_roundtrip(store, tmp_path):
+    store.import_bytes("ml", "dataset", "d", b"data")
+    out = store.export(store.get("ml", "dataset", "d"), tmp_path / "out.bin")
+    assert out.read_bytes() == b"data"
+
+
+def test_import_directory(store, tmp_path):
+    src = tmp_path / "repo"
+    (src / "sub").mkdir(parents=True)
+    (src / "train.py").write_text("print('hi')")
+    (src / "sub" / "util.py").write_text("x = 1")
+    a = store.import_path("ml", "repository", "code", src)
+    assert a.size > 0
+    dest = tmp_path / "checkout"
+    store.export(a, dest)
+    assert (dest / "sub" / "util.py").read_text() == "x = 1"
+
+
+def test_missing_asset_raises(store):
+    with pytest.raises(KeyError):
+        store.get("ml", "model", "nope")
+    store.import_bytes("ml", "model", "m", b"x")
+    with pytest.raises(KeyError):
+        store.get("ml", "model", "m", "v9")
+
+
+def test_latest_version_numeric_after_v10(store):
+    """Regression (code review): v10 must be newer than v9."""
+    for i in range(11):
+        store.import_bytes("ml", "model", "big", f"w{i}".encode())
+    assert store.versions("ml", "model", "big")[-1] == "v11"
+    latest = store.get("ml", "model", "big")
+    assert latest.version == "v11"

@@ -1,0 +1,113 @@
+"""Checkpoint/resume (SURVEY §5.4): save, restore onto a sharded mesh,
+retention, asset export."""
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.parallel import MeshConfig, build_mesh
+from k8s_gpu_tpu.platform import AssetStore
+from k8s_gpu_tpu.train import TrainConfig, Trainer
+from k8s_gpu_tpu.train.checkpoint import CheckpointManager, attach_to_trainer
+
+TINY = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_head=16, d_ff=64
+)
+TC = TrainConfig(learning_rate=1e-3, warmup_steps=1)
+
+
+def batch(key):
+    toks = jax.random.randint(key, (2, 17), 0, 128)
+    return toks[:, :-1], toks[:, 1:]
+
+
+def test_save_restore_roundtrip(tmp_path):
+    trainer = Trainer(
+        TransformerLM(TINY),
+        mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TC,
+    )
+    trainer.init(jax.random.PRNGKey(0))
+    toks, tgts = batch(jax.random.PRNGKey(1))
+    trainer.step(toks, tgts)
+    ckpt, save, resume = attach_to_trainer(trainer, tmp_path / "ckpt")
+    save(1)
+    want = jax.tree.map(np.asarray, trainer.params)
+    # Train further, then resume: params must return to the step-1 state.
+    trainer.step(toks, tgts)
+    step = resume()
+    assert step == 1
+    got = jax.tree.map(np.asarray, trainer.params)
+    jax.tree.map(np.testing.assert_array_equal, want, got)
+    ckpt.close()
+
+
+def test_restore_onto_sharded_mesh(tmp_path):
+    """Save from single-device, resume onto a dp2/tp2 mesh — re-sharding on
+    restore is the multislice-resume path."""
+    t1 = Trainer(
+        TransformerLM(TINY),
+        mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TC,
+    )
+    t1.init(jax.random.PRNGKey(0))
+    toks, tgts = batch(jax.random.PRNGKey(1))
+    t1.step(toks, tgts)
+    ckpt = CheckpointManager(tmp_path / "ckpt")
+    ckpt.save(5, t1.params, t1.opt_state)
+    want_loss = t1.step(toks, tgts)
+    ckpt.close()
+
+    t2 = Trainer(
+        TransformerLM(TINY),
+        mesh=build_mesh(MeshConfig(dp=2, tp=2), n_devices=4),
+        train_config=TC,
+    )
+    t2.init(jax.random.PRNGKey(42))  # different init, will be overwritten
+    ckpt2 = CheckpointManager(tmp_path / "ckpt")
+    params, opt_state, step = ckpt2.restore(t2.params, t2.opt_state)
+    t2.params, t2.opt_state = params, opt_state
+    assert step == 5
+    got_loss = t2.step(toks, tgts)
+    assert abs(got_loss - want_loss) < 2e-2, (got_loss, want_loss)
+    ckpt2.close()
+
+
+def test_retention_keeps_last_n(tmp_path):
+    trainer = Trainer(
+        TransformerLM(TINY),
+        mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TC,
+    )
+    trainer.init(jax.random.PRNGKey(0))
+    ckpt = CheckpointManager(tmp_path / "ckpt", max_to_keep=2)
+    for s in (1, 2, 3):
+        ckpt.save(s, trainer.params, trainer.opt_state)
+    assert ckpt.latest_step() == 3
+    steps = sorted(int(p.name) for p in (tmp_path / "ckpt").iterdir() if p.name.isdigit())
+    assert steps == [2, 3]
+    ckpt.close()
+
+
+def test_export_to_asset_store(tmp_path):
+    trainer = Trainer(
+        TransformerLM(TINY),
+        mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TC,
+    )
+    trainer.init(jax.random.PRNGKey(0))
+    ckpt = CheckpointManager(tmp_path / "ckpt")
+    ckpt.save(7, trainer.params, trainer.opt_state)
+    store = AssetStore(tmp_path / "assets")
+    asset = ckpt.export_to_assets(store, "ml", "flagship")
+    assert asset.version == "v1"
+    assert store.get("ml", "model", "flagship").size > 0
+    ckpt.close()
+
+
+def test_restore_missing_raises(tmp_path):
+    ckpt = CheckpointManager(tmp_path / "empty")
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(None, None)
+    ckpt.close()

@@ -1,0 +1,115 @@
+"""CLI verb parity (C26): login/context/whoami/trainjob/pool/asset flows
+against an isolated state dir."""
+
+import os
+
+import pytest
+
+from k8s_gpu_tpu.cli.main import main
+
+
+@pytest.fixture(autouse=True)
+def isolated_dirs(tmp_path, monkeypatch):
+    monkeypatch.setenv("K8SGPU_CONFIG_DIR", str(tmp_path / "config"))
+    monkeypatch.setenv("K8SGPU_STATE_DIR", str(tmp_path / "state"))
+    yield tmp_path
+
+
+def run(capsys, *argv):
+    code = main(list(argv))
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+def test_requires_login(capsys):
+    code, out, err = run(capsys, "whoami")
+    assert code == 2
+    assert "not logged in" in err
+
+
+def test_login_whoami_contexts(capsys):
+    code, out, _ = run(capsys, "login", "--user", "ada", "--space", "ml")
+    assert code == 0 and "logged in as ada" in out
+    code, out, _ = run(capsys, "whoami")
+    assert code == 0 and "user: ada" in out and "space: ml" in out
+    run(capsys, "context", "new", "prod", "--space", "prod-ml", "--user", "ada")
+    code, out, _ = run(capsys, "context", "list")
+    assert "prod" in out and "* default" in out
+    code, out, _ = run(capsys, "context", "use", "prod")
+    assert code == 0
+    code, out, err = run(capsys, "context", "use", "nope")
+    assert code == 1 and "no such context" in err
+
+
+def test_trainjob_template_skeleton(capsys):
+    run(capsys, "login", "--user", "ada")
+    code, out, _ = run(capsys, "trainjob", "template")
+    assert code == 0 and "singleInstanceType" in out
+
+
+def test_trainjob_dry_run_and_create(tmp_path, capsys):
+    run(capsys, "login", "--user", "ada")
+    tpl = tmp_path / "job.yaml"
+    tpl.write_text(
+        "title: smoke\nworkload: psum-smoke\nspec:\n  singleInstanceType: tpu-v4-8\n"
+    )
+    code, out, _ = run(capsys, "trainjob", "create", "-f", str(tpl), "--dry-run")
+    assert code == 0 and "acceleratorType: v4-8" in out
+    code, out, _ = run(
+        capsys, "trainjob", "create", "-f", str(tpl), "--name", "smoke1"
+    )
+    assert code == 0 and "Succeeded" in out
+    code, out, _ = run(capsys, "trainjob", "list")
+    assert "smoke1" in out and "Succeeded" in out
+    code, out, _ = run(capsys, "trainjob", "logs", "smoke1")
+    assert code == 0 and "result" in out
+
+
+def test_pool_apply_list_delete(capsys):
+    run(capsys, "login", "--user", "ada")
+    code, out, _ = run(
+        capsys, "pool", "apply", "p1", "--accelerator", "v5p-64"
+    )
+    assert code == 0 and "Ready" in out
+    code, out, _ = run(capsys, "pool", "list")
+    assert "v5p-64" in out
+    code, out, _ = run(capsys, "pool", "delete", "p1")
+    assert code == 0
+
+
+def test_pool_state_persists_across_invocations(capsys):
+    run(capsys, "login", "--user", "ada")
+    run(capsys, "pool", "apply", "p1", "--accelerator", "v4-8")
+    # Fresh platform instance (new CLI process equivalent) still sees it.
+    code, out, _ = run(capsys, "pool", "list")
+    assert "p1" in out and "Ready" in out
+
+
+def test_asset_import_and_list(tmp_path, capsys):
+    run(capsys, "login", "--user", "ada")
+    data = tmp_path / "weights.bin"
+    data.write_bytes(b"w" * 128)
+    code, out, _ = run(
+        capsys, "asset", "import", "--kind", "model", "--id", "lm",
+        "--path", str(data),
+    )
+    assert code == 0 and "v1" in out
+    code, out, _ = run(capsys, "asset", "list")
+    assert "model\tlm\tv1" in out
+
+
+def test_repo_push(tmp_path, capsys):
+    run(capsys, "login", "--user", "ada")
+    repo = tmp_path / "code"
+    repo.mkdir()
+    (repo / "train.py").write_text("print('x')")
+    code, out, _ = run(capsys, "repo", "push", "myrepo", "--path", str(repo))
+    assert code == 0 and "pushed myrepo v1" in out
+
+
+def test_bad_template_fails_cleanly(tmp_path, capsys):
+    run(capsys, "login", "--user", "ada")
+    tpl = tmp_path / "bad.yaml"
+    tpl.write_text("nonsense_field: 1\n")
+    code, _, err = run(capsys, "trainjob", "create", "-f", str(tpl))
+    assert code == 1 and "error:" in err

@@ -1,0 +1,120 @@
+"""Template schema + expansion parity (C26/C27: GPU调度平台搭建.md:512-552)."""
+
+import pytest
+import yaml
+
+from k8s_gpu_tpu.platform import (
+    TemplateError,
+    expand_template,
+    parse_template,
+    render_yaml,
+    resolve_instance_type,
+)
+
+TEMPLATE = """
+title: fashion-mnist-cnn
+description: reference workload
+image: registry.example.com/train:latest
+command: python train.py --epochs 5
+env:
+  - name: EPOCHS
+    value: "5"
+repository:
+  - space: ml-team
+    id: fashion-repo
+    hash: abc123
+dataset:
+  - space: ml-team
+    id: fashion-mnist
+    versionId: v3
+model: []
+mode: single
+workload: cnn-train
+spec:
+  singleInstanceType: tpu-v4-8
+"""
+
+
+def test_parse_and_expand():
+    tpl = parse_template(TEMPLATE)
+    job = expand_template(tpl, "job-1")
+    assert job.spec.title == "fashion-mnist-cnn"
+    assert job.spec.instance_type == "tpu-v4-8"
+    assert job.spec.accelerator_type == "v4-8"
+    assert job.spec.num_workers == 2  # v4-8 = 2 hosts
+    assert job.spec.env[0].name == "EPOCHS"
+    assert job.spec.repository[0].version == "abc123"
+    assert job.spec.dataset[0].version == "v3"
+
+
+def test_bare_skips_expansion():
+    job = expand_template(parse_template(TEMPLATE), "job-1", bare=True)
+    assert job.spec.accelerator_type == ""
+    assert job.spec.num_workers == 0
+
+
+def test_dry_run_renders_yaml():
+    job = expand_template(parse_template(TEMPLATE), "job-1")
+    doc = yaml.safe_load(render_yaml(job))
+    assert doc["kind"] == "TrainJob"
+    assert doc["spec"]["acceleratorType"] == "v4-8"
+    assert doc["spec"]["numWorkers"] == 2
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(TemplateError):
+        parse_template("title: x\nbogus: y\n")
+
+
+def test_missing_title_rejected():
+    with pytest.raises(TemplateError):
+        parse_template("description: no title\n")
+
+
+def test_invalid_yaml_rejected():
+    with pytest.raises(TemplateError):
+        parse_template("title: [unclosed\n")
+
+
+def test_unknown_instance_type_rejected():
+    with pytest.raises(TemplateError):
+        expand_template(
+            parse_template("title: x\nspec:\n  singleInstanceType: warp-drive\n"),
+            "j",
+        )
+
+
+def test_gpu_alias_resolves_to_tpu():
+    """Reference-era GPU instance strings (:535) map onto TPU types."""
+    it = resolve_instance_type("gpu-1x-16c-32g-1gpu")
+    assert it.accelerator_type == "v5e-8"
+
+
+def test_bare_accelerator_type_accepted():
+    it = resolve_instance_type("v5p-64")
+    assert it.workers == 16
+
+
+def test_template_of_job_round_trips():
+    """Regression (code review): `trainjob template -s` output must be
+    consumable by `trainjob create`."""
+    from k8s_gpu_tpu.platform import render_template
+
+    job = expand_template(parse_template(TEMPLATE), "orig")
+    text = render_template(job)
+    job2 = expand_template(parse_template(text), "copy")
+    assert job2.spec.accelerator_type == job.spec.accelerator_type
+    assert job2.spec.num_workers == job.spec.num_workers
+    assert job2.spec.dataset[0].version == "v3"
+
+
+def test_bare_with_explicit_accelerator_is_runnable():
+    """Regression (code review): --bare must be able to produce a
+    schedulable job via explicit spec fields."""
+    text = (
+        "title: expert\nworkload: psum-smoke\n"
+        "spec:\n  acceleratorType: v4-8\n  numWorkers: 2\n"
+    )
+    job = expand_template(parse_template(text), "j", bare=True)
+    assert job.spec.accelerator_type == "v4-8"
+    assert job.spec.num_workers == 2

@@ -1,0 +1,193 @@
+"""TrainJob gang scheduling + autoscaler: the end-to-end job path
+(SURVEY §3.2 call-stack parity) and scale-from-zero (BASELINE config 5)."""
+
+import pytest
+
+from k8s_gpu_tpu.api import TpuPodSlice, TrainJob
+from k8s_gpu_tpu.cloud import FakeCloudTpu, cloudtpu_client_factory
+from k8s_gpu_tpu.controller import FakeKube, Manager
+from k8s_gpu_tpu.operators import (
+    SliceAutoscaler,
+    TpuPodSliceReconciler,
+    TrainJobReconciler,
+)
+from k8s_gpu_tpu.platform import expand_template, parse_template
+from k8s_gpu_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def harness(kube: FakeKube, clock: FakeClock):
+    cloud = FakeCloudTpu(clock=clock)
+    mgr = Manager(kube, clock=clock)
+    mgr.register(
+        "TpuPodSlice", TpuPodSliceReconciler(kube, cloudtpu_client_factory(cloud))
+    )
+    mgr.register("TrainJob", TrainJobReconciler(kube), name="trainjob")
+    mgr.register("TrainJob", SliceAutoscaler(kube), name="autoscaler")
+    mgr.start()
+    yield kube, clock, cloud, mgr
+    mgr.stop()
+
+
+def make_pool(kube, accel="v4-8", count=1, name="pool"):
+    ps = TpuPodSlice()
+    ps.metadata.name = name
+    ps.spec.accelerator_type = accel
+    ps.spec.slice_count = count
+    kube.create(ps)
+
+
+def make_job(accel="v4-8", name="job1", workload="psum-smoke", slices=1):
+    job = TrainJob()
+    job.metadata.name = name
+    job.spec.accelerator_type = accel
+    job.spec.workload = workload
+    job.spec.slice_count = slices
+    job.spec.mode = "single" if slices == 1 else "multislice"
+    from k8s_gpu_tpu.cloud.topology import parse_accelerator_type
+
+    job.spec.num_workers = parse_accelerator_type(accel).hosts * slices
+    return job
+
+
+def wait_phase(kube, mgr, clock, name, want, ticks=40):
+    for _ in range(ticks):
+        mgr.wait_idle()
+        job = kube.try_get("TrainJob", name)
+        if job is not None and job.status.phase == want:
+            return job
+        clock.advance(5.1)
+    raise AssertionError(
+        f"{name} never reached {want}; now "
+        f"{kube.try_get('TrainJob', name).status.phase}: "
+        f"{kube.try_get('TrainJob', name).status.message}"
+    )
+
+
+def test_job_runs_on_existing_pool(harness):
+    """SURVEY §3.2: job submitted → gang placed on slice → workload runs →
+    Succeeded with result."""
+    kube, clock, cloud, mgr = harness
+    make_pool(kube, "v4-8")
+    kube.create(make_job("v4-8"))
+    job = wait_phase(kube, mgr, clock, "job1", "Succeeded")
+    assert job.status.result["ok"]
+    assert len(job.status.placements) == 2  # one per v4-8 host
+    assert len(set(job.status.placements.values())) == 2
+    pods = [p for p in kube.list("Pod") if p.metadata.labels.get("job") == "job1"]
+    assert all(p.phase == "Succeeded" for p in pods)
+
+
+def test_job_from_template_end_to_end(harness):
+    kube, clock, cloud, mgr = harness
+    make_pool(kube, "v4-8")
+    tpl = parse_template(
+        "title: t\nworkload: cnn-train\nspec:\n  singleInstanceType: tpu-v4-8\n"
+    )
+    job = expand_template(tpl, "tjob")
+    kube.create(job)
+    done = wait_phase(kube, mgr, clock, "tjob", "Succeeded")
+    assert done.status.result["last_loss"] < done.status.result["first_loss"]
+
+
+def test_job_pending_without_capacity_then_placed(harness):
+    kube, clock, cloud, mgr = harness
+    job = make_job("v4-8")
+    job.metadata.labels["no-autoscale"] = "true"
+    # No pool at all: job must sit Pending with a capacity message ...
+    kube.create(job)
+    mgr.wait_idle()
+    # The autoscaler will create capacity; before it reconciles the pool to
+    # Ready the job reports Pending.
+    cur = kube.get("TrainJob", "job1")
+    assert cur.status.phase in ("Pending", "Running", "Succeeded")
+
+
+def test_scale_from_zero_on_pending_job(harness):
+    """BASELINE config 5: no capacity anywhere → pending job triggers pool
+    creation from zero → job completes → pool scales back to zero."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_job("v4-8", name="cold-start"))
+    job = wait_phase(kube, mgr, clock, "cold-start", "Succeeded")
+    assert job.status.result["ok"]
+    pool = kube.get("TpuPodSlice", "autoscale-v4-8")
+    assert pool.metadata.labels["tpu.k8sgpu.dev/autoscale"] == "true"
+    # After success, autoscaler returns the pool to zero.
+    for _ in range(20):
+        mgr.wait_idle()
+        pool = kube.get("TpuPodSlice", "autoscale-v4-8")
+        if pool.spec.slice_count == 0:
+            break
+        clock.advance(5.1)
+    assert pool.spec.slice_count == 0
+
+
+def test_two_jobs_share_pool_capacity_serially(harness):
+    """Second gang must wait until the first releases the slice (capacity
+    accounting via running pods)."""
+    kube, clock, cloud, mgr = harness
+    make_pool(kube, "v4-8", count=1)
+    kube.create(make_job("v4-8", name="a"))
+    kube.create(make_job("v4-8", name="b"))
+    ja = wait_phase(kube, mgr, clock, "a", "Succeeded")
+    jb = wait_phase(kube, mgr, clock, "b", "Succeeded")
+    assert ja.status.placements and jb.status.placements
+
+
+def test_multislice_job_lands_on_distinct_slices(harness):
+    kube, clock, cloud, mgr = harness
+    make_pool(kube, "v4-8", count=2)
+    kube.create(make_job("v4-8", name="ms", slices=2))
+    job = wait_phase(kube, mgr, clock, "ms", "Succeeded")
+    nodes = {n.metadata.name: n for n in kube.list("Node")}
+    slices_used = {
+        nodes[nn].metadata.labels["tpu.k8sgpu.dev/slice"]
+        for nn in job.status.placements.values()
+    }
+    assert len(slices_used) == 2
+
+
+def test_workload_failure_marks_job_failed(harness):
+    from k8s_gpu_tpu.train.registry import register_workload
+
+    @register_workload("always-fails")
+    def _fail(spec, placements):
+        raise RuntimeError("boom")
+
+    kube, clock, cloud, mgr = harness
+    make_pool(kube, "v4-8")
+    kube.create(make_job("v4-8", name="bad", workload="always-fails"))
+    job = wait_phase(kube, mgr, clock, "bad", "Failed")
+    assert "boom" in job.status.message
+    pods = [p for p in kube.list("Pod") if p.metadata.labels.get("job") == "bad"]
+    assert all(p.phase == "Failed" for p in pods)
+
+
+def test_unexpanded_job_fails_cleanly(harness):
+    kube, clock, cloud, mgr = harness
+    job = TrainJob()
+    job.metadata.name = "raw"
+    kube.create(job)
+    j = wait_phase(kube, mgr, clock, "raw", "Failed", ticks=5)
+    assert "not expanded" in j.status.message
+
+
+def test_same_name_jobs_in_two_namespaces_account_capacity(harness):
+    """Regression (code review): ns-A job 'train' running must block ns-B
+    job 'train' from double-booking the same slice."""
+    kube, clock, cloud, mgr = harness
+    make_pool(kube, "v4-8", count=1)
+    ja = make_job("v4-8", name="train", workload="psum-smoke")
+    ja.metadata.namespace = "ns-a"
+    jb = make_job("v4-8", name="train", workload="psum-smoke")
+    jb.metadata.namespace = "ns-b"
+    kube.create(ja)
+    kube.create(jb)
+    for _ in range(40):
+        mgr.wait_idle()
+        a = kube.get("TrainJob", "train", "ns-a")
+        b = kube.get("TrainJob", "train", "ns-b")
+        if {a.status.phase, b.status.phase} == {"Succeeded"}:
+            break
+        clock.advance(5.1)
+    assert a.status.phase == "Succeeded" and b.status.phase == "Succeeded"
