@@ -135,21 +135,28 @@ def cmd_trainjob(args) -> int:
         return 0
 
     if args.trainjob_cmd == "create":
+        from ..api.types import ValidationError
+
         try:
             tpl = parse_template(Path(args.file).read_text())
             name = args.name or f"job-{int(time.time())}"
             job = expand_template(tpl, name, namespace=ctx.space, bare=args.bare)
-        except (TemplateError, FileNotFoundError) as e:
+        except (TemplateError, ValidationError, FileNotFoundError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
         if args.dry_run:
             print(render_yaml(job), end="")
             return 0
+        from ..controller.kubefake import Conflict
+
         p = LocalPlatform()
         try:
             done = p.submit_job(job, wait=not args.no_wait)
             print(f"{name}\t{done.status.phase}\t{done.status.message}")
             return 0 if done.status.phase != "Failed" else 1
+        except Conflict as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
         finally:
             p.close(wait=not args.no_wait)
 

@@ -45,6 +45,13 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     dtype: object = jnp.bfloat16
     remat: bool = True
+    # Pallas flash-attention kernel for the unsharded-sequence path
+    # (ops/attention.py); ring attention handles the sp-sharded path.
+    use_flash: bool = True
+    flash_block_q: int = 256
+    flash_block_k: int = 256
+    # Microbatches for the pipeline schedule (0 = one per stage).
+    pp_microbatches: int = 0
 
     @property
     def moe(self) -> bool:
@@ -149,6 +156,13 @@ class TransformerLM:
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
         if seq_sharded:
             o = ring_attention(q, k, v, mesh)
+        elif cfg.use_flash:
+            from ..ops.attention import flash_attention
+
+            o = flash_attention(
+                q, k, v, causal=True,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            )
         else:
             o = plain_causal_attention(q, k, v)
         o = o.transpose(0, 2, 1, 3)  # [B,S,H,Dh]
@@ -214,6 +228,8 @@ class TransformerLM:
         """tokens: [B, S] int32 → logits [B, S, V] (dtype f32), aux loss."""
         cfg = self.cfg
         dt = cfg.dtype
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+            return self._forward_pipelined(params, tokens, mesh)
         seq_sharded = mesh is not None and mesh.shape.get("sp", 1) > 1
         B, S = tokens.shape
         positions = jnp.arange(S)
@@ -234,6 +250,47 @@ class TransformerLM:
         x, aux = carry
         x, a = self._block(x, lp, positions, mesh, seq_sharded)
         return (x, aux + a), None
+
+    def _forward_pipelined(self, params, tokens, mesh: Mesh):
+        """pp > 1: blocks run as GPipe stages (parallel/pipeline.py);
+        embedding and head stay under GSPMD outside the pipeline."""
+        from ..parallel.pipeline import gpipe
+
+        cfg = self.cfg
+        if cfg.moe:
+            raise NotImplementedError("MoE with pipeline parallelism: use ep/tp")
+        if mesh.shape.get("sp", 1) > 1:
+            raise NotImplementedError("sp with pipeline parallelism")
+        dt = cfg.dtype
+        B, S = tokens.shape
+        x = params["embed"].astype(dt)[tokens]
+
+        def stage(block_params, x):
+            # Positions created inside the shard_map body: a closed-over
+            # array constant in a partial-manual shard_map miscompiles.
+            positions = jnp.arange(x.shape[1])
+
+            def scan_fn(carry, lp):
+                y, _ = self._block(carry, lp, positions, mesh, False)
+                return y, None
+
+            if cfg.remat:
+                scan_fn = jax.checkpoint(scan_fn)
+            out, _ = jax.lax.scan(scan_fn, x, block_params)
+            return out
+
+        from jax.sharding import PartitionSpec as PSpec
+
+        x = gpipe(
+            stage, params["blocks"], x, mesh,
+            num_microbatches=cfg.pp_microbatches or None,
+            # Batch stays dp-sharded inside the pipeline body; P() here
+            # would all-gather it and run the full batch on every dp group.
+            x_spec=PSpec("dp"),
+        )
+        x = self._rmsnorm(x, params["final_norm"])
+        logits = jnp.einsum("bsd,dv->bsv", x, params["head"].astype(dt))
+        return logits.astype(jnp.float32), jnp.float32(0)
 
     def loss(self, params, tokens, targets, mesh: Mesh | None = None):
         """Next-token cross-entropy (mean) + MoE aux loss."""

@@ -26,7 +26,6 @@ from ..utils.metrics import MetricsRegistry, global_metrics
 log = logging.getLogger("k8s_gpu_tpu.operators.autoscaler")
 
 AUTOSCALE_LABEL = "tpu.k8sgpu.dev/autoscale"
-IDLE_RECHECK = 30.0
 
 
 class SliceAutoscaler(Reconciler):
@@ -41,7 +40,11 @@ class SliceAutoscaler(Reconciler):
 
     def reconcile(self, req: Request) -> Result:
         job = self.kube.try_get("TrainJob", req.name, req.namespace)
-        if job is None or not job.spec.accelerator_type:
+        if job is None:
+            # Job deleted: its accelerator type is gone with it, so sweep
+            # every autoscale-managed pool in the namespace for zero demand.
+            return self._sweep_idle_pools(req.namespace)
+        if not job.spec.accelerator_type:
             return Result()
 
         accel = job.spec.accelerator_type
@@ -99,6 +102,24 @@ class SliceAutoscaler(Reconciler):
                 f"no pending/running jobs need {accel}",
             )
             self.metrics.inc("autoscale_scale_downs_total")
+        return Result()
+
+    def _sweep_idle_pools(self, namespace: str) -> Result:
+        for pool in self.kube.list("TpuPodSlice", namespace=namespace):
+            if pool.metadata.labels.get(AUTOSCALE_LABEL) != "true":
+                continue
+            if pool.spec.slice_count == 0:
+                continue
+            if self._demand(pool.spec.accelerator_type, namespace) == 0:
+                pool.spec.slice_count = 0
+                try:
+                    self.kube.update(pool)
+                except Conflict:
+                    return Result(requeue=True)
+                self.recorder.event(
+                    pool, "Normal", "ScaledToZero", "owning jobs deleted"
+                )
+                self.metrics.inc("autoscale_scale_downs_total")
         return Result()
 
     def _demand(self, accel: str, namespace: str) -> int:

@@ -34,6 +34,8 @@ log = logging.getLogger("k8s_gpu_tpu.operators.trainjob")
 
 CAPACITY_POLL = 2.0  # re-check placement while waiting for capacity
 
+FINALIZER = "tpu.k8sgpu.dev/trainjob-cleanup"
+
 
 class TrainJobReconciler(Reconciler):
     def __init__(
@@ -103,9 +105,24 @@ class TrainJobReconciler(Reconciler):
         if job is None:
             return Result()
         if job.metadata.deletion_timestamp is not None:
+            # Deleting a job must release its worker Pods (and with them the
+            # slice capacity _free_nodes accounts) before the object goes.
+            self._delete_pods(job)
+            if FINALIZER in job.metadata.finalizers:
+                job.metadata.finalizers.remove(FINALIZER)
+                try:
+                    self.kube.update(job)
+                except (Conflict, NotFound):
+                    return Result(requeue=True)
             return Result()
         if job.status.phase in ("Succeeded", "Failed"):
             return Result()
+        if FINALIZER not in job.metadata.finalizers:
+            job.metadata.finalizers.append(FINALIZER)
+            try:
+                job = self.kube.update(job)
+            except Conflict:
+                return Result(requeue=True)
 
         if not job.spec.accelerator_type or job.spec.num_workers <= 0:
             self._finish(job, "Failed",
@@ -213,6 +230,14 @@ class TrainJobReconciler(Reconciler):
         # here; record the intent (the reference's expansion target,
         # GPU调度平台搭建.md:662-664) and succeed as a no-op.
         return {"command": job.spec.command, "image": job.spec.image, "simulated": True}
+
+    def _delete_pods(self, job: TrainJob) -> None:
+        for p in self.kube.list("Pod", namespace=job.metadata.namespace):
+            if p.metadata.labels.get("job") == job.metadata.name:
+                try:
+                    self.kube.delete("Pod", p.metadata.name, p.metadata.namespace)
+                except NotFound:
+                    pass
 
     def _teardown_pods(self, job: TrainJob, phase: str) -> None:
         for p in self.kube.list("Pod", namespace=job.metadata.namespace):

@@ -1,0 +1,3 @@
+from .attention import flash_attention, reference_attention
+
+__all__ = ["flash_attention", "reference_attention"]

@@ -1,0 +1,165 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the flagship transformer (SURVEY §5.7 obligation: "a
+Pallas/blockwise attention kernel").  Streaming-softmax blockwise attention:
+Q tiles stay resident in VMEM while K/V tiles stream through, so attention
+memory is O(block_q · S) instead of O(S²) and the matmuls tile onto the MXU
+(128-aligned blocks, f32 accumulators, bf16-friendly inputs).
+
+Differentiation: the forward runs the kernel; the backward recomputes with
+the reference jnp implementation via ``jax.custom_vjp`` (correct and
+remat-friendly; a fused backward kernel is the next perf step).
+
+On CPU (tests) the same kernel runs under ``interpret=True`` so the kernel
+logic itself is exercised without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Numerical oracle: plain softmax attention.  [B,H,S,D] → [B,H,S,D]."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale):
+    """One (batch·head, q-block) program: stream K/V blocks, accumulate
+    online softmax in f32."""
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        # Only blocks that intersect the causal triangle for this q block.
+        last = (qi * block_q + block_q + block_k - 1) // block_k
+        upper = jnp.minimum(num_k_blocks, last)
+    else:
+        upper = num_k_blocks
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    assert s % bq == 0 and s % bk == 0, (
+        f"seq len {s} must be a multiple of block sizes ({bq}, {bk})"
+    )
+    scale = d**-0.5
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s, d)
+    vr = v.reshape(b * h, s, d)
+    kernel = functools.partial(
+        _flash_kernel, block_k=bk, seq_len=s, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d)
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Blockwise attention.  q,k,v: [B, H, S, D] → [B, H, S, D].
+
+    ``interpret=None`` auto-selects: compiled kernel on TPU, Pallas
+    interpreter elsewhere (tests).  Falls back to the reference path when
+    the sequence doesn't tile evenly.
+    """
+    s = q.shape[2]
+    bq, bk = min(block_q, s), min(block_k, s)
+    if s % bq != 0 or s % bk != 0:
+        return reference_attention(q, k, v, causal)
+    if interpret is None:
+        interpret = _auto_interpret()
+    return _flash(q, k, v, causal, bq, bk, interpret)

@@ -1,0 +1,118 @@
+"""GPipe-style pipeline parallelism over the 'pp' mesh axis.
+
+The reference names PP in a one-line explainer (GPU选型与优化指南.md:47) and
+implements nothing; here it is a real schedule: layers are stacked on a
+leading axis and sharded over 'pp' (each stage holds L/P contiguous
+blocks), the batch is split into M microbatches, and activations flow
+stage→stage+1 over the ICI ring via ``ppermute`` with the classic skewed
+schedule (M + P - 1 steps, P-1 bubble steps).  Built on ``shard_map`` with
+``axis_names={'pp'}`` so every other mesh axis (dp/tp) stays under GSPMD
+auto-partitioning *inside* the pipeline body.
+
+Reverse-mode differentiates through the whole schedule (scan + ppermute +
+dynamic_update_slice all have transposes), so one ``jax.grad`` gives
+pipeline-parallel backprop with the same skew in reverse.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def gpipe(
+    stage_fn,
+    stage_params,
+    x,
+    mesh: Mesh,
+    num_microbatches: int | None = None,
+    axis_name: str = "pp",
+    params_spec: P | None = None,
+    x_spec: P | None = None,
+):
+    """Run ``x`` through P pipeline stages.
+
+    stage_fn(params_slice, activation[mb, ...]) -> activation[mb, ...]
+      where params_slice is stage_params with the leading (layer) dim cut
+      to L/P.
+    stage_params: pytree with leaves shaped [L, ...], sharded over 'pp' on
+      the leading dim (params_spec default P('pp')).
+    x: [B, ...] activations.  Returns [B, ...] (replicated over 'pp').
+    """
+    pp = mesh.shape[axis_name]
+    if pp == 1:
+        return stage_fn(stage_params, x)
+    M = num_microbatches or pp
+
+    p_spec = params_spec or P(axis_name)
+    in_x_spec = x_spec or P()
+
+    def body(params, xfull):
+        # xfull is the LOCAL batch shard (B / prod(x_spec axes)).
+        idx = jax.lax.axis_index(axis_name)
+        is_first = idx == 0
+        is_last = idx == pp - 1
+        local_b = xfull.shape[0]
+        if local_b % M != 0:
+            raise ValueError(
+                f"local batch {local_b} not divisible by {M} microbatches"
+            )
+        xm = xfull.reshape((M, local_b // M) + xfull.shape[1:])
+
+        zeros = jnp.zeros_like(xm[0])
+        outputs0 = jnp.zeros_like(xm)
+
+        def step(carry, t):
+            recv, outputs = carry
+            # Stage 0 feeds microbatch t (while t < M); other stages consume
+            # what the previous stage sent last step.
+            feed = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            feed = jnp.where(t < M, feed, zeros)
+            inp = jnp.where(is_first, feed, recv)
+            out = stage_fn(params, inp)
+            # Last stage commits microbatch t-(P-1) when valid.
+            widx = t - (pp - 1)
+            valid = jnp.logical_and(is_last, widx >= 0)
+            committed = jax.lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.clip(widx, 0, M - 1), 0
+            )
+            outputs = jnp.where(valid, committed, outputs)
+            # Ring-shift activations to the next stage (no wraparound).
+            perm = [(i, i + 1) for i in range(pp - 1)]
+            recv = jax.lax.ppermute(out, axis_name, perm)
+            return (recv, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            step, (zeros, outputs0), jnp.arange(M + pp - 1)
+        )
+        # Only the last stage holds real outputs; psum replicates them.
+        # (f32 around the psum: XLA CPU's AllReducePromotion pass crashes on
+        # bf16 all-reduce — "Invalid binary instruction opcode copy".)
+        outputs = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
+        outputs = jax.lax.psum(outputs.astype(jnp.float32), axis_name)
+        outputs = outputs.astype(xfull.dtype)
+        return outputs.reshape((local_b,) + xfull.shape[1:])
+
+    # Axes named by x_spec (e.g. 'dp' batch sharding) must also be manual —
+    # partial-manual shard_map specs may only reference manual axes.  The
+    # cotangent psum for params (replicated over those axes) is inserted by
+    # shard_map's transpose, so dp gradients stay correct (verified against
+    # the sequential oracle in tests).
+    manual = {axis_name}
+    for ax in in_x_spec:
+        if ax is None:
+            continue
+        manual |= set(ax) if isinstance(ax, tuple) else {ax}
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(p_spec, in_x_spec),
+        out_specs=in_x_spec,
+        axis_names=manual,
+        check_vma=False,
+    )(stage_params, x)

@@ -40,14 +40,31 @@ class AssetStore:
     def _dir(self, space: str, kind: str, id: str, version: str) -> Path:
         return self.root / space / kind / id / version
 
+    def _next_version(self, space: str, kind: str, id: str) -> str:
+        # max+1 over existing numeric versions (count-based numbering would
+        # collide after a deletion or a crashed import).
+        nums = [
+            int(v[1:]) for v in self.versions(space, kind, id) if v[1:].isdigit()
+        ]
+        return f"v{max(nums, default=0) + 1}"
+
+    def _commit(self, staged: Path, final: Path) -> None:
+        """Atomic publish: versions become visible only via a rename, so a
+        crash mid-import never corrupts 'latest' resolution."""
+        final.parent.mkdir(parents=True, exist_ok=True)
+        staged.rename(final)
+
     # -- write -------------------------------------------------------------
     def import_bytes(
         self, space: str, kind: str, id: str, data: bytes
     ) -> Asset:
-        version = f"v{len(self.versions(space, kind, id)) + 1}"
+        version = self._next_version(space, kind, id)
         d = self._dir(space, kind, id, version)
-        d.mkdir(parents=True, exist_ok=True)
-        payload = d / "payload"
+        staged = d.parent / f".staging-{version}"
+        if staged.exists():
+            shutil.rmtree(staged)
+        staged.mkdir(parents=True)
+        payload = staged / "payload"
         payload.write_bytes(data)
         meta = Asset(
             space=space,
@@ -57,9 +74,10 @@ class AssetStore:
             sha256=hashlib.sha256(data).hexdigest(),
             size=len(data),
             created_at=time.time(),
-            path=str(payload),
+            path=str(d / "payload"),
         )
-        (d / "meta.json").write_text(json.dumps(vars(meta)))
+        (staged / "meta.json").write_text(json.dumps(vars(meta)))
+        self._commit(staged, d)
         return meta
 
     def import_path(self, space: str, kind: str, id: str, src: str | Path) -> Asset:
@@ -67,12 +85,21 @@ class AssetStore:
         :707-734 — incremental dirs arrive as archives here)."""
         src = Path(src)
         if src.is_dir():
-            version = f"v{len(self.versions(space, kind, id)) + 1}"
+            version = self._next_version(space, kind, id)
             d = self._dir(space, kind, id, version)
-            shutil.copytree(src, d / "payload")
-            size = sum(p.stat().st_size for p in (d / "payload").rglob("*") if p.is_file())
-            meta = Asset(space, id, version, kind, "", size, time.time(), str(d / "payload"))
-            (d / "meta.json").write_text(json.dumps(vars(meta)))
+            staged = d.parent / f".staging-{version}"
+            if staged.exists():
+                shutil.rmtree(staged)
+            shutil.copytree(src, staged / "payload")
+            size = sum(
+                p.stat().st_size
+                for p in (staged / "payload").rglob("*")
+                if p.is_file()
+            )
+            meta = Asset(space, id, version, kind, "", size, time.time(),
+                         str(d / "payload"))
+            (staged / "meta.json").write_text(json.dumps(vars(meta)))
+            self._commit(staged, d)
             return meta
         return self.import_bytes(space, kind, id, src.read_bytes())
 
@@ -82,8 +109,14 @@ class AssetStore:
         if not d.exists():
             return []
         # Numeric ordering: lexicographic would make v9 "newer" than v10.
+        # Only committed versions (meta.json present) count — staging dirs
+        # and crashed imports are invisible.
         return sorted(
-            (p.name for p in d.iterdir() if p.is_dir()),
+            (
+                p.name
+                for p in d.iterdir()
+                if p.is_dir() and (p / "meta.json").exists()
+            ),
             key=lambda v: (
                 int(v[1:]) if v[1:].isdigit() else float("inf"), v
             ),

@@ -168,6 +168,7 @@ def render_yaml(job: TrainJob) -> str:
             "numWorkers": job.spec.num_workers,
             "sliceCount": job.spec.slice_count,
             "workload": job.spec.workload,
+            "workloadArgs": job.spec.workload_args,
         },
     }
     buf = io.StringIO()

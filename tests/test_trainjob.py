@@ -90,17 +90,32 @@ def test_job_from_template_end_to_end(harness):
     assert done.status.result["last_loss"] < done.status.result["first_loss"]
 
 
-def test_job_pending_without_capacity_then_placed(harness):
-    kube, clock, cloud, mgr = harness
-    job = make_job("v4-8")
-    job.metadata.labels["no-autoscale"] = "true"
-    # No pool at all: job must sit Pending with a capacity message ...
-    kube.create(job)
-    mgr.wait_idle()
-    # The autoscaler will create capacity; before it reconciles the pool to
-    # Ready the job reports Pending.
-    cur = kube.get("TrainJob", "job1")
-    assert cur.status.phase in ("Pending", "Running", "Succeeded")
+def test_job_pending_without_capacity_reports_insufficient(kube, clock):
+    """Without the autoscaler registered, a job with no pool must surface
+    Pending + InsufficientCapacity, then place once a pool appears."""
+    cloud = FakeCloudTpu(clock=clock)
+    mgr = Manager(kube, clock=clock)
+    mgr.register(
+        "TpuPodSlice", TpuPodSliceReconciler(kube, cloudtpu_client_factory(cloud))
+    )
+    mgr.register("TrainJob", TrainJobReconciler(kube), name="trainjob")
+    mgr.start()
+    try:
+        kube.create(make_job("v4-8"))
+        assert mgr.wait_idle(
+            predicate=lambda: (
+                kube.get("TrainJob", "job1").status.phase == "Pending"
+            )
+        )
+        cur = kube.get("TrainJob", "job1")
+        assert "insufficient capacity" in cur.status.message
+        conds = {c.type: (c.status, c.reason) for c in cur.status.conditions}
+        assert conds["Schedulable"] == ("False", "InsufficientCapacity")
+        make_pool(kube, "v4-8")
+        job = wait_phase(kube, mgr, clock, "job1", "Succeeded")
+        assert job.status.result["ok"]
+    finally:
+        mgr.stop()
 
 
 def test_scale_from_zero_on_pending_job(harness):
@@ -191,3 +206,24 @@ def test_same_name_jobs_in_two_namespaces_account_capacity(harness):
             break
         clock.advance(5.1)
     assert a.status.phase == "Succeeded" and b.status.phase == "Succeeded"
+
+
+def test_delete_running_job_releases_pods_and_pool(harness):
+    """Regression (code review): deleting a job must remove its worker Pods
+    (freeing slice capacity) and let the autoscaler retire its pool."""
+    kube, clock, cloud, mgr = harness
+    kube.create(make_job("v4-8", name="doomed"))
+    wait_phase(kube, mgr, clock, "doomed", "Succeeded")
+    kube.delete("TrainJob", "doomed")
+    assert mgr.wait_idle(
+        predicate=lambda: kube.try_get("TrainJob", "doomed") is None
+    )
+    pods = [p for p in kube.list("Pod") if p.metadata.labels.get("job") == "doomed"]
+    assert pods == []
+    for _ in range(10):
+        mgr.wait_idle()
+        pool = kube.try_get("TpuPodSlice", "autoscale-v4-8")
+        if pool is not None and pool.spec.slice_count == 0:
+            break
+        clock.advance(5.1)
+    assert kube.get("TpuPodSlice", "autoscale-v4-8").spec.slice_count == 0
