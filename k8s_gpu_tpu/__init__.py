@@ -23,6 +23,9 @@ platform; see /root/repo/SURVEY.md), re-designed TPU-first:
 - ``ops``        — attention kernels (Pallas on TPU, jnp fallback).
 - ``train``      — training-job runner: distributed init, train loop,
                    checkpointing.
+- ``serve``      — inference engine: KV-cache prefill/decode for the
+                   flagship LM (replaces the reference's Ollama delegation,
+                   智能风控解决方案.md:196).
 - ``platform``   — job-template expansion, instance-type catalog, assets,
                    quota (GPU调度平台搭建.md:512-552, 686-744).
 - ``cli``        — GoHai-parity CLI verbs (GPU调度平台搭建.md:447-552).

@@ -174,15 +174,20 @@ class TransformerLM:
         u = jnp.einsum("bsd,df->bsf", x, lp["wi_up"].astype(dt))
         return jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u, lp["wo_mlp"].astype(dt))
 
-    def _moe_mlp(self, x, lp):
+    def _moe_mlp(self, x, lp, full_capacity=False):
         """Switch-style top-1 MoE with capacity; dense dispatch einsums keep
-        shapes static so XLA can turn them into all-to-alls over 'ep'."""
+        shapes static so XLA can turn them into all-to-alls over 'ep'.
+
+        ``full_capacity=True`` sizes every expert buffer to hold all tokens —
+        no drops.  Inference uses this: at decode G is tiny (B tokens), and
+        capacity dropping there would zero a request's MLP output based on
+        which expert *other* requests routed to."""
         cfg = self.cfg
         dt = cfg.dtype
         B, S, D = x.shape
         E = cfg.num_experts
         G = B * S
-        cap = max(1, int(cfg.capacity_factor * G / E))
+        cap = G if full_capacity else max(1, int(cfg.capacity_factor * G / E))
         xt = x.reshape(G, D)
 
         logits = jnp.einsum("gd,de->ge", xt.astype(jnp.float32),

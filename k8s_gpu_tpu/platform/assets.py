@@ -115,11 +115,12 @@ class AssetStore:
             (
                 p.name
                 for p in d.iterdir()
-                if p.is_dir() and (p / "meta.json").exists()
+                if p.is_dir()
+                and p.name.startswith("v")
+                and p.name[1:].isdigit()
+                and (p / "meta.json").exists()
             ),
-            key=lambda v: (
-                int(v[1:]) if v[1:].isdigit() else float("inf"), v
-            ),
+            key=lambda v: int(v[1:]),
         )
 
     def get(self, space: str, kind: str, id: str, version: str = "") -> Asset:
