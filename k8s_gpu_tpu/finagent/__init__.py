@@ -1,0 +1,38 @@
+"""Fin-Agent-Suite, TPU-native — the reference's one complete application.
+
+The reference documents "Fin-Agent-Suite" (智能风控解决方案.md:368-419): a
+FastAPI router-agent service where `POST /chat` triages a user query to a
+complaint agent (PostgreSQL read + insert + empathetic LLM reply,
+:268-306) or a marketing agent (RAG: embed → Milvus top-3 → context prompt
+→ LLM, :235-266), over a knowledge base ingested idempotently
+(:11-169: drop-and-recreate Milvus collection, 500/50 chunking, 1024-d
+embeddings, seeded behavior-log row).
+
+This package rebuilds that capability surface TPU-first, replacing each
+external service with an on-device or in-process equivalent:
+
+- Milvus            → ``vectorstore.VectorStore``: embeddings resident as a
+                      device array; search is one MXU matmul + top-k.
+- bge-large-zh-v1.5 → ``embed.TextEmbedder``: hashed char-ngram features ×
+                      a fixed random projection, computed in JAX (1024-d).
+- PostgreSQL        → ``sqlstore.SqlStore``: stdlib sqlite, same two tables
+                      and seed row.
+- Ollama qwen:72b   → ``llm.TpuLMClient``: the serve.InferenceEngine over a
+                      byte-level tokenizer (or ``llm.TemplateLM`` where a
+                      trained checkpoint isn't loaded).
+- FastAPI           → ``server``: stdlib http.server, same routes/JSON.
+"""
+
+from .agents import ChatResponse, FinAgentApp, QueryRequest
+from .embed import TextEmbedder
+from .ingest import ingest
+from .llm import TemplateLM, TpuLMClient
+from .splitter import recursive_split
+from .sqlstore import SqlStore
+from .vectorstore import VectorStore
+
+__all__ = [
+    "ChatResponse", "FinAgentApp", "QueryRequest", "TextEmbedder",
+    "ingest", "TemplateLM", "TpuLMClient", "recursive_split", "SqlStore",
+    "VectorStore",
+]

@@ -1,0 +1,69 @@
+"""Text embedder: hashed character-ngram features → JAX projection.
+
+The reference embeds with `bge-large-zh-v1.5` on CPU (智能风控解决方案.md:
+25, 36, 75 — 1024-d output).  This environment has zero egress, so instead
+of a downloaded encoder the embedder is a deterministic feature-hashing
+pipeline whose heavy step — the dense projection — runs in JAX on the
+accelerator:
+
+1. character n-grams (1..3) of the normalized text are hashed into a
+   ``n_features``-dim sparse count vector (pure Python, cheap);
+2. a fixed seeded Gaussian projection ``[n_features, dim]`` maps counts to
+   the embedding space (one matmul — batched, MXU-shaped);
+3. L2 normalization, so inner-product and L2 ranking agree.
+
+Same signature surface as the reference's SentenceTransformer usage:
+``encode(texts) -> [N, dim]``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+EMBEDDING_DIM = 1024  # parity: 智能风控解决方案.md:25
+
+
+def _ngrams(text: str, lo: int = 1, hi: int = 3):
+    t = " ".join(text.lower().split())
+    for n in range(lo, hi + 1):
+        for i in range(len(t) - n + 1):
+            yield t[i : i + n]
+
+
+class TextEmbedder:
+    def __init__(self, dim: int = EMBEDDING_DIM, n_features: int = 8192,
+                 seed: int = 0):
+        self.dim = dim
+        self.n_features = n_features
+        key = jax.random.PRNGKey(seed)
+        self._proj = jax.random.normal(
+            key, (n_features, dim), jnp.float32
+        ) * (n_features ** -0.5)
+        self._encode_jit = jax.jit(self._project)
+
+    def _project(self, counts):
+        x = counts @ self._proj
+        return x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-9)
+
+    def _hash_features(self, text: str) -> np.ndarray:
+        v = np.zeros((self.n_features,), np.float32)
+        for g in _ngrams(text):
+            h = int.from_bytes(
+                hashlib.blake2b(g.encode(), digest_size=8).digest(), "little"
+            )
+            # Signed hashing keeps E[collision noise] at zero.
+            v[h % self.n_features] += 1.0 if (h >> 63) & 1 else -1.0
+        n = np.linalg.norm(v)
+        return v / n if n else v
+
+    def encode(self, texts: str | list[str]) -> np.ndarray:
+        """texts → [N, dim] float32 (single string → [dim])."""
+        single = isinstance(texts, str)
+        batch = [texts] if single else list(texts)
+        counts = np.stack([self._hash_features(t) for t in batch])
+        out = np.asarray(self._encode_jit(jnp.asarray(counts)))
+        return out[0] if single else out

@@ -174,14 +174,19 @@ class TransformerLM:
         u = jnp.einsum("bsd,df->bsf", x, lp["wi_up"].astype(dt))
         return jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u, lp["wo_mlp"].astype(dt))
 
-    def _moe_mlp(self, x, lp, full_capacity=False):
+    def _moe_mlp(self, x, lp, full_capacity=False, token_mask=None):
         """Switch-style top-1 MoE with capacity; dense dispatch einsums keep
         shapes static so XLA can turn them into all-to-alls over 'ep'.
 
         ``full_capacity=True`` sizes every expert buffer to hold all tokens —
         no drops.  Inference uses this: at decode G is tiny (B tokens), and
         capacity dropping there would zero a request's MLP output based on
-        which expert *other* requests routed to."""
+        which expert *other* requests routed to.
+
+        ``token_mask`` [B, S] bool: False tokens (padding) are excluded from
+        routing — they consume no expert capacity and get zero MLP output.
+        Note cap is computed from the static padded G, so when capacity
+        binds, drop patterns can differ from an unpadded trace."""
         cfg = self.cfg
         dt = cfg.dtype
         B, S, D = x.shape
@@ -195,6 +200,8 @@ class TransformerLM:
         probs = jax.nn.softmax(logits, axis=-1)
         expert = jnp.argmax(probs, axis=-1)                      # [G]
         onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)    # [G,E]
+        if token_mask is not None:
+            onehot = onehot * token_mask.reshape(G, 1).astype(jnp.float32)
         gate = (probs * onehot).sum(-1)                          # [G]
         # Position of each token within its expert's buffer.
         pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot     # [G,E]

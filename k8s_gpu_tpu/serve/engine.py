@@ -23,7 +23,6 @@ implemented fresh rather than reusing the training path.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -105,8 +104,13 @@ class InferenceEngine:
             # Full capacity only at decode (query length 1): there G = B and
             # capacity dropping would couple independent requests.  Prefill
             # keeps the training forward's capped dispatch — same logits,
-            # same [G, E, cap] memory footprint.
-            y, _ = m._moe_mlp(h2, lp, full_capacity=x.shape[1] == 1)
+            # same [G, E, cap] memory footprint.  Padded query rows (their
+            # attention mask is all-False) are excluded from routing so they
+            # can't consume expert capacity ahead of real tokens.
+            y, _ = m._moe_mlp(
+                h2, lp, full_capacity=x.shape[1] == 1,
+                token_mask=mask.any(-1),
+            )
             x = x + y
         else:
             x = x + m._dense_mlp(h2, lp)
@@ -127,27 +131,49 @@ class InferenceEngine:
         return logits.astype(jnp.float32), {"k": ck, "v": cv}
 
     # -- public jittable pieces -------------------------------------------
-    def prefill(self, params, tokens):
-        """tokens [B, S] → (cache, last_logits [B, V]).  S must be ≤ max_seq."""
+    def prefill(self, params, tokens, pad_left=0):
+        """tokens [B, S] → (cache, last_logits [B, V]).  S must be ≤ max_seq.
+
+        ``pad_left`` (scalar, may be traced): number of leading positions
+        that are padding.  Callers bucket prompts to a few lengths and
+        left-pad — pad_left rides through the trace, so prompts of any true
+        length share one compiled program per bucket.  Padded slots are
+        excluded from attention and RoPE starts at the first real token.
+        """
         B, S = tokens.shape
+        pad_left = jnp.asarray(pad_left, jnp.int32)
         cache = _empty_cache(self.cfg, B, self.max_seq)
         x = params["embed"].astype(self.cfg.dtype)[tokens]
-        positions = jnp.arange(S)
+        q_idx = jnp.arange(S)
+        positions = jnp.maximum(q_idx - pad_left, 0)  # RoPE positions
         t = jnp.arange(self.max_seq)
-        mask = (t[None, :] <= positions[:, None]) & (t[None, :] < S)
+        mask = (
+            (t[None, :] <= q_idx[:, None])
+            & (t[None, :] < S)
+            & (t[None, :] >= pad_left)
+        )
         mask = jnp.broadcast_to(mask, (B, S, self.max_seq))
         logits, cache = self._run_blocks(params, x, cache, positions, 0, mask)
         return cache, logits[:, -1]
 
-    def decode_step(self, params, cache, pos, token):
-        """token [B] at absolute position pos (scalar) → (cache, logits [B,V])."""
+    def decode_step(self, params, cache, pos, token, rope_pos=None,
+                    kv_start=0):
+        """token [B] at cache position pos (scalar) → (cache, logits [B,V]).
+        ``rope_pos`` is the rotary position (defaults to pos; differs when
+        the prompt was left-padded); ``kv_start`` masks cache slots below it.
+        """
         B = token.shape[0]
         x = params["embed"].astype(self.cfg.dtype)[token][:, None]  # [B,1,D]
-        positions = pos[None] if jnp.ndim(pos) == 0 else pos
-        positions = jnp.asarray(positions).reshape(1)
+        pos = jnp.asarray(pos, jnp.int32).reshape(())
+        rope = pos if rope_pos is None else jnp.asarray(rope_pos, jnp.int32).reshape(())
+        kv_start = jnp.asarray(kv_start, jnp.int32)
         t = jnp.arange(self.max_seq)
-        mask = jnp.broadcast_to((t <= positions[0])[None, None], (B, 1, self.max_seq))
-        logits, cache = self._run_blocks(params, x, cache, positions, positions[0], mask)
+        mask = jnp.broadcast_to(
+            ((t <= pos) & (t >= kv_start))[None, None], (B, 1, self.max_seq)
+        )
+        logits, cache = self._run_blocks(
+            params, x, cache, rope[None], pos, mask
+        )
         return cache, logits[:, 0]
 
     # -- sampling ----------------------------------------------------------
@@ -162,10 +188,10 @@ class InferenceEngine:
         return jax.random.categorical(key, logits, axis=-1)
 
     # -- generate ----------------------------------------------------------
-    def _generate(self, params, prompt, key, *, max_new_tokens: int,
-                  sampling: SamplingConfig):
+    def _generate(self, params, prompt, key, pad_left, *,
+                  max_new_tokens: int, sampling: SamplingConfig):
         B, S = prompt.shape
-        cache, last_logits = self.prefill(params, prompt)
+        cache, last_logits = self.prefill(params, prompt, pad_left)
         key, k0 = jax.random.split(key)
         first = self._sample(last_logits, k0, sampling)
         valid0 = first != sampling.eos_id
@@ -174,7 +200,10 @@ class InferenceEngine:
         def step(carry, i):
             cache, token, done, k = carry
             k, sub = jax.random.split(k)
-            cache, logits = self.decode_step(params, cache, S + i, token)
+            cache, logits = self.decode_step(
+                params, cache, S + i, token,
+                rope_pos=S + i - pad_left, kv_start=pad_left,
+            )
             nxt = self._sample(logits, sub, sampling)
             valid = ~done & (nxt != sampling.eos_id)
             feed = jnp.where(done, sampling.pad_id, nxt)
@@ -200,9 +229,10 @@ class InferenceEngine:
 
     def generate(self, params, prompt, *, max_new_tokens: int = 32,
                  sampling: SamplingConfig = SamplingConfig(),
-                 key=None) -> DecodeOutput:
+                 key=None, pad_left: int = 0) -> DecodeOutput:
         """prompt [B, S] int32 → DecodeOutput.  Requires
-        S + max_new_tokens ≤ max_seq."""
+        S + max_new_tokens ≤ max_seq.  ``pad_left``: leading padding count
+        when the caller bucketed the prompt (see prefill)."""
         B, S = prompt.shape
         if S + max_new_tokens > self.max_seq:
             raise ValueError(
@@ -212,7 +242,7 @@ class InferenceEngine:
         if key is None:
             key = jax.random.PRNGKey(0)
         out = self._generate_jit(
-            params, prompt, key, max_new_tokens=max_new_tokens,
-            sampling=sampling,
+            params, prompt, key, jnp.asarray(pad_left, jnp.int32),
+            max_new_tokens=max_new_tokens, sampling=sampling,
         )
         return DecodeOutput(**out)

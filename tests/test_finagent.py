@@ -1,0 +1,241 @@
+"""Fin-Agent-Suite equivalent: ingest idempotency, on-device vector search
+exactness, router/agent behavior, and the HTTP acceptance flow — mirroring
+the reference's curl test plan (智能风控解决方案.md:500-520) and its
+re-runnable data-init fixture (:47-52, 117-158).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.finagent import (
+    FinAgentApp, QueryRequest, SqlStore, TemplateLM, TextEmbedder,
+    VectorStore, ingest, recursive_split,
+)
+from k8s_gpu_tpu.finagent.agents import COMPLAINT_AGENT, MARKETING_AGENT
+from k8s_gpu_tpu.finagent.server import serve_background
+
+KB_DOCS = {
+    "products/gold.md": (
+        "# 贵金属产品\n\n我们的贵金属产品包括黄金积存和白银账户。"
+        "黄金积存支持每日定投，起投金额为1克。\n\n"
+        "White-gold savings products support daily automatic investment."
+    ),
+    "products/loans.md": (
+        "# 贷款产品\n\n个人消费贷款年利率低至3.4%，最高额度50万元。\n\n"
+        "Personal loans have annual rates from 3.4 percent."
+    ),
+    "faq.md": "# 常见问题\n\n如何重置密码？请前往设置页面点击重置。",
+}
+
+
+@pytest.fixture
+def kb(tmp_path):
+    for rel, text in KB_DOCS.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text, encoding="utf-8")
+    return tmp_path
+
+
+@pytest.fixture(scope="module")
+def embedder():
+    return TextEmbedder(dim=64, n_features=1024)  # small for test speed
+
+
+@pytest.fixture
+def app(kb, embedder):
+    vectors = VectorStore()
+    sql = SqlStore()
+    ingest(kb, vectors, sql, embedder=embedder)
+    return FinAgentApp(embedder=embedder, vectors=vectors, sql=sql,
+                       llm=TemplateLM())
+
+
+# -- embedder ---------------------------------------------------------------
+
+def test_embedder_deterministic_and_normalized(embedder):
+    a = embedder.encode("黄金积存产品")
+    b = embedder.encode("黄金积存产品")
+    np.testing.assert_allclose(a, b)
+    assert a.shape == (64,)
+    assert abs(np.linalg.norm(a) - 1.0) < 1e-5
+
+
+def test_embedder_ranks_related_text_closer(embedder):
+    q = embedder.encode("贵金属 黄金")
+    gold = embedder.encode("贵金属产品包括黄金积存")
+    loan = embedder.encode("个人消费贷款年利率")
+    assert float(q @ gold) > float(q @ loan)
+
+
+# -- vector store -----------------------------------------------------------
+
+def test_vectorstore_l2_search_is_exact(embedder):
+    rng = np.random.default_rng(0)
+    emb = rng.normal(size=(50, 64)).astype(np.float32)
+    vs = VectorStore()
+    coll = vs.create_collection("c", dim=64)
+    coll.insert([f"t{i}" for i in range(50)], emb)
+    coll.flush()
+    q = rng.normal(size=(64,)).astype(np.float32)
+    hits = coll.search(q, limit=5, metric="L2")
+    ref = np.argsort(((emb - q) ** 2).sum(-1))[:5]
+    assert [h.id for h in hits] == ref.tolist()
+    # distances are real L2 and ascending
+    d = [h.distance for h in hits]
+    assert d == sorted(d)
+    np.testing.assert_allclose(
+        d[0], np.linalg.norm(emb[ref[0]] - q), rtol=1e-4
+    )
+
+
+def test_vectorstore_drop_if_exists_idempotency():
+    vs = VectorStore()
+    vs.create_collection("k", dim=8)
+    assert vs.has_collection("k")
+    vs.drop_collection("k")
+    assert not vs.has_collection("k")
+    vs.drop_collection("k")  # dropping absent collection is fine
+    c = vs.create_collection("k", dim=8)
+    assert c.num_entities == 0
+
+
+# -- splitter ---------------------------------------------------------------
+
+def test_recursive_split_sizes_and_coverage():
+    text = "\n\n".join(
+        f"Paragraph {i}: " + "word " * 60 for i in range(8)
+    )
+    chunks = recursive_split(text, chunk_size=200, chunk_overlap=30)
+    assert len(chunks) > 1
+    assert all(len(c) <= 200 + 30 for c in chunks)
+    for i in range(8):  # no paragraph lost
+        assert any(f"Paragraph {i}:" in c for c in chunks)
+
+
+# -- sql store --------------------------------------------------------------
+
+def test_sqlstore_seed_and_idempotent_setup():
+    sql = SqlStore()
+    ev = sql.latest_failed_event("user_123")
+    assert ev is not None and "Face ID" in ev.details
+    sql.insert_complaint("user_123", "无法登录")
+    assert len(sql.complaints("user_123")) == 1
+    sql.setup()  # drop-and-recreate wipes complaints, keeps the seed
+    assert sql.complaints("user_123") == []
+    assert sql.latest_failed_event("user_123") is not None
+
+
+# -- ingest -----------------------------------------------------------------
+
+def test_ingest_idempotent_rerun(kb, embedder):
+    vectors, sql = VectorStore(), SqlStore()
+    r1 = ingest(kb, vectors, sql, embedder=embedder)
+    n1 = vectors.collection("financial_knowledge").num_entities
+    r2 = ingest(kb, vectors, sql, embedder=embedder)
+    n2 = vectors.collection("financial_knowledge").num_entities
+    assert r1["num_chunks"] == r2["num_chunks"] == n1 == n2 > 0
+
+
+# -- agents / router --------------------------------------------------------
+
+def test_router_complaint_path_records_and_verifies(app):
+    resp = app.chat(QueryRequest(query="我无法登录，人脸识别失败了，我要投诉"))
+    assert resp.agent == COMPLAINT_AGENT
+    # The complaint was recorded and the verified log fact reached the LLM.
+    assert len(app.sql.complaints("user_123")) == 1
+    prompt = app.llm.calls[-1]
+    assert "Face ID" in prompt and "2025-05-04" in prompt
+
+
+def test_router_marketing_path_uses_rag_context(app):
+    resp = app.chat(QueryRequest(query="介绍一下你们的贵金属黄金产品"))
+    assert resp.agent == MARKETING_AGENT
+    prompt = app.llm.calls[-1]
+    assert "背景知识" in prompt and "黄金积存" in prompt
+    assert app.sql.complaints() == []  # marketing path writes nothing
+
+
+def test_unknown_user_complaint_still_recorded(app):
+    resp = app.chat(QueryRequest(query="transfer failed twice", user_id="u9"))
+    assert resp.agent == COMPLAINT_AGENT
+    assert len(app.sql.complaints("u9")) == 1
+    assert "未查询到相关用户行为日志" in app.llm.calls[-1]
+
+
+def test_extension_contract_new_agent(app):
+    app.extra_routes["余额"] = (
+        "查询专员", lambda req: f"balance for {req.user_id}"
+    )
+    resp = app.chat(QueryRequest(query="查询余额", user_id="u1"))
+    assert resp.agent == "查询专员"
+    assert resp.response == "balance for u1"
+
+
+def test_tpu_lm_client_generates_through_decode_path(app):
+    """The real LLM seam: byte tokenizer → InferenceEngine → bytes.
+    Random params, so only the mechanics are asserted."""
+    import dataclasses
+
+    from k8s_gpu_tpu.finagent.llm import TpuLMClient
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+
+    model = TransformerLM(TransformerConfig(
+        vocab_size=259, d_model=32, n_layers=2, n_heads=2, d_head=16,
+        d_ff=64, max_seq=128, use_flash=False,
+    ))
+    lm = TpuLMClient(model=model, max_new_tokens=8)
+    out = lm.chat("你好")
+    assert isinstance(out, str)
+    app2 = dataclasses.replace(app, llm=lm)
+    resp = app2.chat(QueryRequest(query="介绍产品"))
+    assert resp.agent == MARKETING_AGENT
+
+
+# -- HTTP acceptance (reference curl plan :500-520) -------------------------
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/chat",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_acceptance_flow(app):
+    srv, port = serve_background(app)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as r:
+            assert json.loads(r.read())["status"] == "Fin-Agent-Suite is running."
+        code, body = _post(port, {"query": "介绍贵金属产品"})
+        assert code == 200 and body["agent"] == MARKETING_AGENT
+        code, body = _post(port, {"query": "登录失败，我要投诉",
+                                  "user_id": "user_123"})
+        assert code == 200 and body["agent"] == COMPLAINT_AGENT
+        # 422 on missing query (FastAPI parity)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/chat", data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 422"
+        except urllib.error.HTTPError as e:
+            assert e.code == 422
+        # valid JSON but not an object → 422 too (FastAPI parity)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/chat", data=b'"query string"',
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 422"
+        except urllib.error.HTTPError as e:
+            assert e.code == 422
+    finally:
+        srv.shutdown()
