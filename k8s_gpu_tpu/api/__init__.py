@@ -10,6 +10,7 @@ from .azurevmpool import AzureVmPool, AzureVmPoolSpec, AzureVmPoolStatus, ImageR
 from .tpupodslice import TpuPodSlice, TpuPodSliceSpec, TpuPodSliceStatus, SliceStatus
 from .core import Secret, Node, Event, Pod
 from .trainjob import TrainJob, TrainJobSpec, TrainJobStatus, AssetRef, EnvVar
+from .tenancy import LimitRange, Namespace, ResourceQuota, RoleBinding
 
 __all__ = [
     "ObjectMeta",
@@ -35,4 +36,8 @@ __all__ = [
     "TrainJobStatus",
     "AssetRef",
     "EnvVar",
+    "LimitRange",
+    "Namespace",
+    "ResourceQuota",
+    "RoleBinding",
 ]

@@ -1,0 +1,100 @@
+"""Tenancy kinds — the multi-tenant model the reference specifies in prose:
+"Namespace + RBAC per Space, least-privilege, ResourceQuota/LimitRange with
+quota alerting" (GPU调度平台搭建.md:37, 43, 802; SURVEY §2.3 C15).
+
+A *Space* is the user-facing tenancy unit; it materializes as a Namespace
+plus RoleBindings plus an optional ResourceQuota — exactly the mapping the
+reference describes, with TPU chips (``google.com/tpu``) as the metered
+accelerator resource instead of ``nvidia.com/gpu``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .types import Condition, CustomResource, ValidationError
+
+
+@dataclass
+class Namespace(CustomResource):
+    """Cluster-scoped; stored under namespace "" by convention."""
+
+    kind: str = "Namespace"
+    api_version: str = "v1"
+    phase: str = "Active"  # Active | Terminating
+
+    def validate(self) -> None:
+        super().validate()
+        if self.metadata.namespace != "":
+            raise ValidationError("Namespace is cluster-scoped (namespace must be '')")
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: dict[str, int] = field(default_factory=dict)
+    used: dict[str, int] = field(default_factory=dict)
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class ResourceQuotaSpec:
+    """``hard`` keys: extended resources (``google.com/tpu``) and object
+    counts (``count/pods``, ``count/trainjobs``, ``count/tpupodslices``)."""
+
+    hard: dict[str, int] = field(default_factory=dict)
+    # Fraction of any hard limit at which the alert condition fires
+    # (the reference's "quota usage alert threshold", GPU调度平台搭建.md:802).
+    alert_threshold: float = 0.9
+
+
+@dataclass
+class ResourceQuota(CustomResource):
+    kind: str = "ResourceQuota"
+    api_version: str = "v1"
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+    def validate(self) -> None:
+        super().validate()
+        for k, v in self.spec.hard.items():
+            if v < 0:
+                raise ValidationError(f"hard[{k}] must be >= 0")
+        if not 0 < self.spec.alert_threshold <= 1:
+            raise ValidationError("alertThreshold must be in (0, 1]")
+
+
+@dataclass
+class LimitRangeSpec:
+    """Per-pod defaulting/ceiling for the TPU chip request."""
+
+    default_tpu: int = 0  # applied when a pod requests no chips
+    max_tpu: int = 0  # 0 = unlimited
+
+
+@dataclass
+class LimitRange(CustomResource):
+    kind: str = "LimitRange"
+    api_version: str = "v1"
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
+
+
+@dataclass
+class RoleBinding(CustomResource):
+    """Binds a user or group to a named role within the binding's namespace.
+    Roles are the fixed least-privilege set in auth/rbac.py (the reference
+    names no custom Role objects, only the pattern; GPU调度平台搭建.md:43)."""
+
+    kind: str = "RoleBinding"
+    api_version: str = "rbac.authorization.k8s.io/v1"
+    role: str = ""  # space-admin | space-user | space-viewer | cluster-admin
+    subject_user: str = ""
+    subject_group: str = ""
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.role:
+            raise ValidationError("role is required")
+        if bool(self.subject_user) == bool(self.subject_group):
+            raise ValidationError(
+                "exactly one of subjectUser / subjectGroup is required"
+            )

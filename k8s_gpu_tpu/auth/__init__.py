@@ -1,0 +1,31 @@
+"""Identity, tenancy, and quota — the reference's Keycloak+LDAP SSO and
+Namespace/RBAC/"Space" model (GPU调度平台搭建.md:241-270, 37, 43, 802;
+SURVEY §2.3 C14-C15), in-process."""
+
+from .directory import AuthError, User, UserDirectory
+from .oidc import TokenIssuer
+from .quota import QuotaEnforcer, QuotaReconciler, compute_usage
+from .rbac import (
+    AuthorizedKube,
+    CLUSTER_ADMIN_GROUP,
+    Forbidden,
+    Identity,
+    ROLE_RULES,
+    SpaceManager,
+)
+
+__all__ = [
+    "AuthError",
+    "AuthorizedKube",
+    "CLUSTER_ADMIN_GROUP",
+    "Forbidden",
+    "Identity",
+    "QuotaEnforcer",
+    "QuotaReconciler",
+    "ROLE_RULES",
+    "SpaceManager",
+    "TokenIssuer",
+    "User",
+    "UserDirectory",
+    "compute_usage",
+]

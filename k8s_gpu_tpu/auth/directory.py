@@ -1,0 +1,81 @@
+"""User directory — the LDAP/AD role in the reference's SSO stack.
+
+The reference federates Keycloak to an enterprise LDAP/AD for accounts and
+group sync (GPU调度平台搭建.md:241-266).  Here the directory is a small
+salted-hash store with group membership — the same contract (authenticate,
+look up groups) without the wire protocol.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass, field
+
+
+class AuthError(Exception):
+    pass
+
+
+@dataclass
+class User:
+    username: str
+    email: str = ""
+    groups: list[str] = field(default_factory=list)
+    password_salt: bytes = b""
+    password_hash: bytes = b""
+
+
+def _hash(password: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 10_000)
+
+
+class UserDirectory:
+    """In-process account store with LDAP-like semantics: bind (authenticate)
+    and search (get user + groups)."""
+
+    def __init__(self):
+        self._users: dict[str, User] = {}
+
+    def add_user(
+        self,
+        username: str,
+        password: str,
+        groups: list[str] | None = None,
+        email: str = "",
+    ) -> User:
+        salt = os.urandom(16)
+        user = User(
+            username=username,
+            email=email or f"{username}@example.com",
+            groups=list(groups or []),
+            password_salt=salt,
+            password_hash=_hash(password, salt),
+        )
+        self._users[username] = user
+        return user
+
+    def authenticate(self, username: str, password: str) -> User:
+        """The LDAP "bind" — constant-time compare on a salted PBKDF2 hash."""
+        user = self._users.get(username)
+        if user is None:
+            raise AuthError(f"unknown user {username!r}")
+        if not hmac.compare_digest(_hash(password, user.password_salt),
+                                   user.password_hash):
+            raise AuthError("invalid credentials")
+        return user
+
+    def get(self, username: str) -> User:
+        user = self._users.get(username)
+        if user is None:
+            raise AuthError(f"unknown user {username!r}")
+        return user
+
+    def add_to_group(self, username: str, group: str) -> None:
+        user = self.get(username)
+        if group not in user.groups:
+            user.groups.append(group)
+
+    def users(self) -> list[User]:
+        return sorted(self._users.values(), key=lambda u: u.username)

@@ -1,0 +1,123 @@
+"""OIDC-style token service — the Keycloak role in the reference's SSO stack.
+
+The reference deploys Keycloak with two OIDC clients, ``GoHai-portal`` (web)
+and ``GoHai-cli`` (device/auth-code flow), backed by LDAP
+(GPU调度平台搭建.md:241-270).  This module implements the same contract
+in-process: registered clients, an authorization-code flow, HMAC-SHA256
+signed JWT-shaped tokens with expiry, and verification that yields the
+identity claims (sub, groups) the RBAC layer authorizes against.
+
+No external crypto deps: tokens are ``b64(header).b64(payload).b64(hmac)``
+— structurally a JWT with ``alg: HS256`` — signed with an issuer secret.
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import json
+import os
+import secrets
+import time
+from dataclasses import dataclass, field
+from hashlib import sha256
+
+from .directory import AuthError, User, UserDirectory
+
+DEFAULT_TTL = 8 * 3600.0  # seconds; a working-day session
+CODE_TTL = 120.0  # authorization codes are single-use and short-lived
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+@dataclass
+class PendingCode:
+    username: str
+    client_id: str
+    expires: float
+
+
+@dataclass
+class TokenIssuer:
+    """Issues and verifies bearer tokens for registered OIDC clients."""
+
+    directory: UserDirectory
+    secret: bytes = field(default_factory=lambda: os.urandom(32))
+    issuer: str = "tpu-platform"
+    clients: set[str] = field(default_factory=lambda: {"tpu-portal", "tpu-cli"})
+    _codes: dict[str, PendingCode] = field(default_factory=dict)
+
+    # -- auth-code flow ----------------------------------------------------
+    def authorize(self, username: str, password: str, client_id: str) -> str:
+        """Browser-side half of the code flow: authenticate against the
+        directory, return a single-use authorization code."""
+        if client_id not in self.clients:
+            raise AuthError(f"unknown client {client_id!r}")
+        self.directory.authenticate(username, password)
+        # Purge abandoned codes so the dict is bounded by the flow rate.
+        now = time.time()
+        for stale in [c for c, p in self._codes.items() if now > p.expires]:
+            del self._codes[stale]
+        code = secrets.token_urlsafe(24)
+        self._codes[code] = PendingCode(username, client_id, time.time() + CODE_TTL)
+        return code
+
+    def exchange_code(self, code: str, client_id: str) -> str:
+        """Token-endpoint half: swap the code for a signed access token."""
+        pending = self._codes.pop(code, None)
+        if pending is None or pending.client_id != client_id:
+            raise AuthError("invalid authorization code")
+        if time.time() > pending.expires:
+            raise AuthError("authorization code expired")
+        return self.issue(self.directory.get(pending.username), client_id)
+
+    # -- tokens ------------------------------------------------------------
+    def issue(self, user: User, client_id: str, ttl: float = DEFAULT_TTL) -> str:
+        now = time.time()
+        header = {"alg": "HS256", "typ": "JWT"}
+        payload = {
+            "iss": self.issuer,
+            "aud": client_id,
+            "sub": user.username,
+            "email": user.email,
+            "groups": sorted(user.groups),
+            "iat": now,
+            "exp": now + ttl,
+        }
+        signing_input = (
+            _b64(json.dumps(header, sort_keys=True).encode())
+            + "."
+            + _b64(json.dumps(payload, sort_keys=True).encode())
+        )
+        sig = hmac.new(self.secret, signing_input.encode(), sha256).digest()
+        return signing_input + "." + _b64(sig)
+
+    def verify(self, token: str, audience: str | None = None) -> dict:
+        """Validate signature + expiry (+ audience when given); return the
+        claims dict."""
+        try:
+            signing_input, _, sig_part = token.rpartition(".")
+            expected = hmac.new(self.secret, signing_input.encode(), sha256).digest()
+            if not hmac.compare_digest(expected, _unb64(sig_part)):
+                raise AuthError("bad signature")
+            payload = json.loads(_unb64(signing_input.split(".")[1]))
+        except AuthError:
+            raise
+        except Exception as e:
+            raise AuthError(f"malformed token: {e}") from e
+        if payload.get("iss") != self.issuer:
+            raise AuthError("wrong issuer")
+        if time.time() > float(payload.get("exp", 0)):
+            raise AuthError("token expired")
+        if audience is not None and payload.get("aud") != audience:
+            raise AuthError(
+                f"audience mismatch: token for {payload.get('aud')!r}, "
+                f"expected {audience!r}"
+            )
+        return payload

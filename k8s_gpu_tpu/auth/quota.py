@@ -1,0 +1,155 @@
+"""ResourceQuota enforcement + alerting (reference GPU调度平台搭建.md:802:
+"ResourceQuota/LimitRange ... quota usage alerting"; SURVEY §2.3 C15).
+
+Two halves, mirroring the real apiserver/controller split:
+
+- ``QuotaEnforcer`` — synchronous admission: rejects a create that would
+  push a namespace over any ``hard`` limit (TPU chips or object counts),
+  and applies LimitRange defaulting/ceiling to pod chip requests.
+  Registered into ``FakeKube.admission``.
+- ``QuotaReconciler`` — asynchronous accounting: recomputes
+  ``status.used``, and raises the ``AlertActive`` condition + a Warning
+  Event when usage crosses ``spec.alertThreshold`` of a hard limit.
+"""
+
+from __future__ import annotations
+
+from ..api.tenancy import LimitRange, ResourceQuota
+from ..api.types import CustomResource, ValidationError, set_condition
+from ..controller.events import EventRecorder
+from ..controller.kubefake import Conflict, FakeKube, NotFound
+from ..controller.manager import Reconciler, Request, Result
+
+TPU_RESOURCE = "google.com/tpu"
+RESYNC = 5.0
+
+# Kinds metered by count/<plural> quota keys.
+_COUNTED = {
+    "Pod": "count/pods",
+    "TrainJob": "count/trainjobs",
+    "TpuPodSlice": "count/tpupodslices",
+    "DevEnv": "count/devenvs",
+}
+
+_LIVE_POD_PHASES = ("Pending", "Running")
+
+
+def compute_usage(kube: FakeKube, namespace: str) -> dict[str, int]:
+    used: dict[str, int] = {}
+    for kind, key in _COUNTED.items():
+        objs = kube.list(kind, namespace=namespace)
+        if kind == "Pod":
+            objs = [p for p in objs if p.phase in _LIVE_POD_PHASES]
+            used[TPU_RESOURCE] = sum(p.requests.get(TPU_RESOURCE, 0) for p in objs)
+        used[key] = len(objs)
+    return used
+
+
+class QuotaEnforcer:
+    """Admission callback: ``kube.admission.append(QuotaEnforcer(kube))``."""
+
+    def __init__(self, kube: FakeKube):
+        self.kube = kube
+
+    def __call__(self, op: str, obj: CustomResource) -> None:
+        ns = obj.metadata.namespace
+        if obj.kind == "Pod":
+            self._apply_limit_range(ns, obj)
+        quotas = self.kube.list("ResourceQuota", namespace=ns)
+        if not quotas:
+            return
+        used = compute_usage(self.kube, ns)
+        # Project the usage the write would add on top of current usage.
+        delta: dict[str, int] = {}
+        if op == "create":
+            if obj.kind in _COUNTED:
+                delta[_COUNTED[obj.kind]] = 1
+            if obj.kind == "Pod" and obj.phase in _LIVE_POD_PHASES:
+                delta[TPU_RESOURCE] = obj.requests.get(TPU_RESOURCE, 0)
+        elif obj.kind == "Pod":
+            # Updates can't change counts, but can grow a pod's chip request
+            # (or resurrect a finished pod); meter the increase over the
+            # stored copy, which compute_usage already counted.
+            cur = self.kube.try_get("Pod", obj.metadata.name, ns)
+            old = (
+                cur.requests.get(TPU_RESOURCE, 0)
+                if cur is not None and cur.phase in _LIVE_POD_PHASES
+                else 0
+            )
+            new = (
+                obj.requests.get(TPU_RESOURCE, 0)
+                if obj.phase in _LIVE_POD_PHASES
+                else 0
+            )
+            if new > old:
+                delta[TPU_RESOURCE] = new - old
+            else:
+                return
+        else:
+            return
+        for rq in quotas:
+            for key, hard in rq.spec.hard.items():
+                projected = used.get(key, 0) + delta.get(key, 0)
+                if projected > hard:
+                    raise ValidationError(
+                        f"exceeded quota {rq.metadata.name!r} in {ns!r}: "
+                        f"{key} {projected} > hard {hard}"
+                    )
+
+    def _apply_limit_range(self, ns: str, pod) -> None:
+        for lr in self.kube.list("LimitRange", namespace=ns):
+            assert isinstance(lr, LimitRange)
+            req = pod.requests.get(TPU_RESOURCE, 0)
+            if req == 0 and lr.spec.default_tpu:
+                pod.requests[TPU_RESOURCE] = lr.spec.default_tpu
+            elif lr.spec.max_tpu and req > lr.spec.max_tpu:
+                raise ValidationError(
+                    f"pod chip request {req} exceeds LimitRange max "
+                    f"{lr.spec.max_tpu} in {ns!r}"
+                )
+
+
+class QuotaReconciler(Reconciler):
+    """Keeps ``status.used`` current and fires threshold alerts."""
+
+    def __init__(self, kube: FakeKube, resync: float = RESYNC):
+        self.kube = kube
+        self.recorder = EventRecorder(kube, "quota-controller")
+        self.resync = resync
+
+    def reconcile(self, req: Request) -> Result:
+        rq = self.kube.try_get("ResourceQuota", req.name, req.namespace)
+        if rq is None or not isinstance(rq, ResourceQuota):
+            return Result()
+        used = compute_usage(self.kube, req.namespace)
+        rq.status.hard = dict(rq.spec.hard)
+        rq.status.used = {k: used.get(k, 0) for k in rq.spec.hard}
+        hot = [
+            f"{k}={rq.status.used[k]}/{h}"
+            for k, h in rq.spec.hard.items()
+            if h > 0 and rq.status.used[k] >= rq.spec.alert_threshold * h
+        ]
+        was_alerting = any(
+            c.type == "AlertActive" and c.status == "True"
+            for c in rq.status.conditions
+        )
+        if hot:
+            set_condition(
+                rq.status.conditions, "AlertActive", "True", "QuotaNearLimit",
+                ", ".join(hot), observed_generation=rq.metadata.generation,
+            )
+        else:
+            set_condition(
+                rq.status.conditions, "AlertActive", "False", "WithinLimits", "",
+                observed_generation=rq.metadata.generation,
+            )
+        try:
+            self.kube.update_status(rq)
+        except (Conflict, NotFound):
+            return Result(requeue=True)
+        if hot and not was_alerting:
+            self.recorder.event(
+                rq, "Warning", "QuotaNearLimit",
+                f"usage at/above {rq.spec.alert_threshold:.0%}: {', '.join(hot)}",
+            )
+        return Result(requeue_after=self.resync)

@@ -1,0 +1,166 @@
+"""Spaces + RBAC — the reference's tenancy pattern made executable.
+
+The reference's model: every tenant "Space" is a Namespace with per-space
+RBAC, least-privilege by default (GPU调度平台搭建.md:37, 43).  Here a Space
+materializes as Namespace + owner RoleBinding + optional ResourceQuota, and
+``AuthorizedKube`` is the API-server admission seam that enforces the
+bindings on every verb — the piece the reference delegates to the real
+kube-apiserver.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..api.tenancy import Namespace, ResourceQuota, RoleBinding
+from ..api.types import CustomResource
+from ..controller.kubefake import FakeKube
+from .directory import AuthError
+
+READ_VERBS = frozenset({"get", "list", "watch"})
+WRITE_VERBS = frozenset({"create", "update", "delete"})
+
+# Least-privilege role table (fixed roles; the reference names the pattern,
+# not custom Role objects).  Kind "*" = any kind.
+ROLE_RULES: dict[str, dict[str, frozenset[str]]] = {
+    "space-viewer": {"*": READ_VERBS},
+    "space-user": {
+        "*": READ_VERBS,
+        "TrainJob": READ_VERBS | WRITE_VERBS,
+        "DevEnv": READ_VERBS | WRITE_VERBS,
+        "Secret": READ_VERBS | WRITE_VERBS,
+    },
+    "space-admin": {"*": READ_VERBS | WRITE_VERBS},
+}
+
+CLUSTER_ADMIN_GROUP = "platform-admins"
+
+
+class Forbidden(AuthError):
+    pass
+
+
+@dataclass(frozen=True)
+class Identity:
+    """Verified identity (from TokenIssuer.verify claims)."""
+
+    username: str
+    groups: frozenset[str] = frozenset()
+
+    @classmethod
+    def from_claims(cls, claims: dict) -> "Identity":
+        return cls(claims["sub"], frozenset(claims.get("groups", ())))
+
+    @property
+    def is_cluster_admin(self) -> bool:
+        return CLUSTER_ADMIN_GROUP in self.groups
+
+
+class SpaceManager:
+    """Creates and administers Spaces (Namespace + RoleBindings + quota)."""
+
+    def __init__(self, kube: FakeKube):
+        self.kube = kube
+
+    def create_space(
+        self,
+        name: str,
+        owner: str,
+        quota_hard: dict[str, int] | None = None,
+    ) -> Namespace:
+        ns = Namespace()
+        ns.metadata.name = name
+        ns.metadata.namespace = ""
+        ns.metadata.labels["space"] = name
+        created = self.kube.create(ns)
+        self.grant(name, owner, "space-admin")
+        if quota_hard:
+            rq = ResourceQuota()
+            rq.metadata.name = "space-quota"
+            rq.metadata.namespace = name
+            rq.spec.hard = dict(quota_hard)
+            self.kube.create(rq)
+        return created
+
+    def grant(self, space: str, subject: str, role: str, group: bool = False) -> None:
+        if role not in ROLE_RULES:
+            raise ValueError(f"unknown role {role!r}")
+        rb = RoleBinding()
+        rb.metadata.name = f"{role}-{'g-' if group else ''}{subject}"
+        rb.metadata.namespace = space
+        rb.role = role
+        if group:
+            rb.subject_group = subject
+        else:
+            rb.subject_user = subject
+        self.kube.create(rb)
+
+    def spaces_for(self, ident: Identity) -> list[str]:
+        out = set()
+        for rb in self.kube.list("RoleBinding"):
+            if rb.subject_user == ident.username or rb.subject_group in ident.groups:
+                out.add(rb.metadata.namespace)
+        return sorted(out)
+
+    # -- authorization -----------------------------------------------------
+    def allowed(self, ident: Identity, verb: str, kind: str, namespace: str) -> bool:
+        if ident.is_cluster_admin:
+            return True
+        for rb in self.kube.list("RoleBinding", namespace=namespace):
+            if not (
+                rb.subject_user == ident.username
+                or (rb.subject_group and rb.subject_group in ident.groups)
+            ):
+                continue
+            rules = ROLE_RULES.get(rb.role, {})
+            # Additive grants, like real RBAC: any matching rule allows.
+            if verb in rules.get(kind, ()) or verb in rules.get("*", ()):
+                return True
+        return False
+
+
+class AuthorizedKube:
+    """A FakeKube facade that enforces RBAC for one verified identity —
+    what the CLI/API service hands each request after token verification."""
+
+    def __init__(self, kube: FakeKube, spaces: SpaceManager, ident: Identity):
+        self._kube = kube
+        self._spaces = spaces
+        self.ident = ident
+
+    def _check(self, verb: str, kind: str, namespace: str) -> None:
+        if not self._spaces.allowed(self.ident, verb, kind, namespace):
+            raise Forbidden(
+                f"user {self.ident.username!r} cannot {verb} {kind} "
+                f"in namespace {namespace!r}"
+            )
+
+    def create(self, obj: CustomResource) -> CustomResource:
+        self._check("create", obj.kind, obj.metadata.namespace)
+        return self._kube.create(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        self._check("get", kind, namespace)
+        return self._kube.get(kind, name, namespace)
+
+    def update(self, obj: CustomResource) -> CustomResource:
+        self._check("update", obj.kind, obj.metadata.namespace)
+        return self._kube.update(obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        self._check("delete", kind, namespace)
+        self._kube.delete(kind, name, namespace)
+
+    def list(self, kind: str, namespace: str | None = None, **kw):
+        if namespace is None:
+            # Cross-namespace list returns only namespaces the identity can
+            # read (the UI's "my spaces" view).
+            out = []
+            for obj in self._kube.list(kind, **kw):
+                if self._spaces.allowed(
+                    self.ident, "list", kind, obj.metadata.namespace
+                ):
+                    out.append(obj)
+            return out
+        self._check("list", kind, namespace)
+        return self._kube.list(kind, namespace=namespace, **kw)

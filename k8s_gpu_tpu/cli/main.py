@@ -10,7 +10,6 @@ Run as ``python -m k8s_gpu_tpu.cli ...``.
 from __future__ import annotations
 
 import argparse
-import secrets
 import sys
 import time
 from pathlib import Path
@@ -51,8 +50,10 @@ def cmd_login(args) -> int:
     ctx.user = args.user
     ctx.space = args.space or ctx.space
     # The reference does an OIDC browser code flow (:474-479); the local
-    # platform has no IdP, so mint a session token directly.
-    ctx.token = secrets.token_hex(16)
+    # platform is its own IdP, so mint a signed session token directly.
+    from .platform_local import issue_token
+
+    ctx.token = issue_token(args.user)
     cfg.contexts[name] = ctx
     cfg.current_context = name
     cfg.save()
@@ -62,7 +63,18 @@ def cmd_login(args) -> int:
 
 def cmd_whoami(args) -> int:
     ctx = _require_login(CliConfig.load())
-    print(f"user: {ctx.user}\nspace: {ctx.space}\ncontext: {ctx.name}\nhost: {ctx.host}")
+    from ..auth.directory import AuthError
+    from .platform_local import verify_token
+
+    try:
+        claims = verify_token(ctx.token)
+        verified = f"verified (expires in {claims['exp'] - time.time():.0f}s)"
+    except AuthError as e:
+        verified = f"INVALID token: {e}"
+    print(
+        f"user: {ctx.user}\nspace: {ctx.space}\ncontext: {ctx.name}\n"
+        f"host: {ctx.host}\ntoken: {verified}"
+    )
     return 0
 
 

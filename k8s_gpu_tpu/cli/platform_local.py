@@ -32,6 +32,36 @@ def state_dir() -> Path:
     )
 
 
+def _auth_key() -> bytes:
+    """Persistent signing key (the Keycloak-realm-key role,
+    GPU调度平台搭建.md:241-270).  Standalone so token mint/verify — pure
+    HMAC — never boots the platform or takes its exclusive lock."""
+    root = state_dir()
+    root.mkdir(parents=True, exist_ok=True)
+    keyfile = root / "auth.key"
+    if not keyfile.exists():
+        keyfile.write_bytes(os.urandom(32))
+        keyfile.chmod(0o600)
+    return keyfile.read_bytes()
+
+
+def issue_token(username: str, groups: list[str] | None = None) -> str:
+    """Dev login: the local box IS the identity (no password prompt), but
+    the token is a real signed credential verify_token checks."""
+    from ..auth.directory import User
+    from ..auth.oidc import TokenIssuer
+
+    issuer = TokenIssuer(directory=None, secret=_auth_key())
+    return issuer.issue(User(username=username, groups=list(groups or [])), "tpu-cli")
+
+
+def verify_token(token: str) -> dict:
+    from ..auth.oidc import TokenIssuer
+
+    issuer = TokenIssuer(directory=None, secret=_auth_key())
+    return issuer.verify(token, audience="tpu-cli")
+
+
 class LocalPlatform:
     def __init__(self):
         self.root = state_dir()

@@ -47,6 +47,10 @@ class FakeKube:
         self._store: dict[tuple[str, str, str], CustomResource] = {}
         self._rv = 0
         self._watchers: dict[str, list[Callable[[WatchEvent], None]]] = {}
+        # Admission chain: callables (op, obj) invoked before a create/update
+        # is stored; raising rejects the write (quota/limit-range seam,
+        # auth/quota.py).  May mutate obj (defaulting webhook semantics).
+        self.admission: list[Callable[[str, CustomResource], None]] = []
 
     # -- helpers -----------------------------------------------------------
     def _key(self, kind: str, namespace: str, name: str) -> tuple[str, str, str]:
@@ -66,7 +70,12 @@ class FakeKube:
         with self._lock:
             k = self._key(obj.kind, obj.metadata.namespace, obj.metadata.name)
             if k in self._store:
+                # Conflict wins over admission: operators rely on
+                # create-if-absent (`except Conflict: requeue`), and a quota
+                # error here would double-count the existing object.
                 raise Conflict(f"{obj.kind} {k[1]}/{k[2]} already exists")
+            for admit in self.admission:
+                admit("create", obj)
             stored = obj.deepcopy()
             stored.metadata.uid = uuid.uuid4().hex
             stored.metadata.resource_version = self._next_rv()
@@ -102,6 +111,8 @@ class FakeKube:
                     f"stale resourceVersion {obj.metadata.resource_version} "
                     f"(current {cur.metadata.resource_version})"
                 )
+            for admit in self.admission:
+                admit("update", obj)
             stored = obj.deepcopy()
             stored.metadata.uid = cur.metadata.uid
             stored.metadata.creation_timestamp = cur.metadata.creation_timestamp

@@ -17,6 +17,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from .embed import TextEmbedder
+from .ingest import COLLECTION_NAME
 from .llm import LMClient
 from .sqlstore import SqlStore
 from .vectorstore import VectorStore
@@ -50,7 +51,7 @@ class FinAgentApp:
     vectors: VectorStore
     sql: SqlStore
     llm: LMClient
-    collection_name: str = "financial_knowledge"
+    collection_name: str = COLLECTION_NAME
     top_k: int = 3  # reference :246
     extra_routes: dict = field(default_factory=dict)  # keyword → handler
 

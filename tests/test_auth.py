@@ -1,0 +1,98 @@
+"""Identity: directory bind, OIDC code flow, token verify (C14)."""
+
+import time
+
+import pytest
+
+from k8s_gpu_tpu.auth import AuthError, TokenIssuer, UserDirectory
+
+
+@pytest.fixture
+def directory():
+    d = UserDirectory()
+    d.add_user("alice", "s3cret", groups=["ml-team"])
+    d.add_user("bob", "hunter2")
+    return d
+
+
+@pytest.fixture
+def issuer(directory):
+    return TokenIssuer(directory)
+
+
+def test_directory_bind(directory):
+    u = directory.authenticate("alice", "s3cret")
+    assert u.groups == ["ml-team"]
+    with pytest.raises(AuthError):
+        directory.authenticate("alice", "wrong")
+    with pytest.raises(AuthError):
+        directory.authenticate("nobody", "x")
+
+
+def test_group_membership(directory):
+    directory.add_to_group("bob", "ml-team")
+    directory.add_to_group("bob", "ml-team")  # idempotent
+    assert directory.get("bob").groups == ["ml-team"]
+
+
+def test_code_flow_roundtrip(issuer):
+    code = issuer.authorize("alice", "s3cret", "tpu-cli")
+    token = issuer.exchange_code(code, "tpu-cli")
+    claims = issuer.verify(token)
+    assert claims["sub"] == "alice"
+    assert claims["groups"] == ["ml-team"]
+    assert claims["aud"] == "tpu-cli"
+
+
+def test_code_single_use(issuer):
+    code = issuer.authorize("alice", "s3cret", "tpu-cli")
+    issuer.exchange_code(code, "tpu-cli")
+    with pytest.raises(AuthError):
+        issuer.exchange_code(code, "tpu-cli")
+
+
+def test_code_client_binding(issuer):
+    code = issuer.authorize("alice", "s3cret", "tpu-portal")
+    with pytest.raises(AuthError):
+        issuer.exchange_code(code, "tpu-cli")
+
+
+def test_unknown_client_rejected(issuer):
+    with pytest.raises(AuthError):
+        issuer.authorize("alice", "s3cret", "evil-client")
+
+
+def test_token_tamper_rejected(issuer, directory):
+    token = issuer.issue(directory.get("alice"), "tpu-cli")
+    head, _, sig = token.rpartition(".")
+    with pytest.raises(AuthError):
+        issuer.verify(head + ".AAAA")
+    # Payload swap without re-signing must fail too.
+    parts = token.split(".")
+    forged = ".".join([parts[0], parts[1][:-2] + "xx", parts[2]])
+    with pytest.raises(AuthError):
+        issuer.verify(forged)
+
+
+def test_token_expiry(issuer, directory):
+    token = issuer.issue(directory.get("alice"), "tpu-cli", ttl=0.05)
+    issuer.verify(token)
+    time.sleep(0.06)
+    with pytest.raises(AuthError):
+        issuer.verify(token)
+
+
+def test_audience_checked_at_verify(issuer, directory):
+    token = issuer.issue(directory.get("alice"), "tpu-portal")
+    issuer.verify(token)  # no expected audience: any client's token
+    issuer.verify(token, audience="tpu-portal")
+    with pytest.raises(AuthError, match="audience"):
+        issuer.verify(token, audience="tpu-cli")
+
+
+def test_cross_issuer_rejected(directory):
+    a = TokenIssuer(directory)
+    b = TokenIssuer(directory)  # different secret
+    token = a.issue(directory.get("alice"), "tpu-cli")
+    with pytest.raises(AuthError):
+        b.verify(token)

@@ -1,0 +1,202 @@
+"""Tenancy: Spaces, RBAC enforcement, ResourceQuota/LimitRange (C15)."""
+
+import pytest
+
+from k8s_gpu_tpu.api import (
+    LimitRange,
+    Pod,
+    ResourceQuota,
+    TrainJob,
+    ValidationError,
+)
+from k8s_gpu_tpu.auth import (
+    AuthorizedKube,
+    Forbidden,
+    Identity,
+    QuotaEnforcer,
+    QuotaReconciler,
+    SpaceManager,
+)
+from k8s_gpu_tpu.controller.kubefake import FakeKube
+from k8s_gpu_tpu.controller.manager import Request
+
+
+@pytest.fixture
+def kube():
+    return FakeKube()
+
+
+@pytest.fixture
+def spaces(kube):
+    return SpaceManager(kube)
+
+
+def _pod(name, ns, chips=4, phase="Running"):
+    p = Pod()
+    p.metadata.name = name
+    p.metadata.namespace = ns
+    p.requests = {"google.com/tpu": chips}
+    p.phase = phase
+    return p
+
+
+def _job(name, ns):
+    j = TrainJob()
+    j.metadata.name = name
+    j.metadata.namespace = ns
+    return j
+
+
+# -- spaces + RBAC ----------------------------------------------------------
+
+def test_create_space_materializes(kube, spaces):
+    spaces.create_space("ml-team", owner="alice",
+                        quota_hard={"google.com/tpu": 8})
+    assert kube.get("Namespace", "ml-team", "").metadata.labels["space"] == "ml-team"
+    assert kube.get("ResourceQuota", "space-quota", "ml-team").spec.hard == {
+        "google.com/tpu": 8
+    }
+    ident = Identity("alice")
+    assert spaces.allowed(ident, "create", "TrainJob", "ml-team")
+    assert spaces.spaces_for(ident) == ["ml-team"]
+
+
+def test_rbac_least_privilege(kube, spaces):
+    spaces.create_space("ml-team", owner="alice")
+    spaces.grant("ml-team", "bob", "space-user")
+    spaces.grant("ml-team", "carol", "space-viewer")
+    bob, carol = Identity("bob"), Identity("carol")
+    # space-user: write TrainJob/DevEnv/Secret, read everything.
+    assert spaces.allowed(bob, "create", "TrainJob", "ml-team")
+    assert spaces.allowed(bob, "list", "Pod", "ml-team")
+    assert not spaces.allowed(bob, "create", "TpuPodSlice", "ml-team")
+    # space-viewer: read only.
+    assert spaces.allowed(carol, "get", "TrainJob", "ml-team")
+    assert not spaces.allowed(carol, "create", "TrainJob", "ml-team")
+    # No bindings elsewhere.
+    assert not spaces.allowed(bob, "get", "TrainJob", "other-ns")
+
+
+def test_group_binding_and_cluster_admin(kube, spaces):
+    spaces.create_space("ml-team", owner="alice")
+    spaces.grant("ml-team", "researchers", "space-user", group=True)
+    member = Identity("dave", frozenset({"researchers"}))
+    assert spaces.allowed(member, "create", "TrainJob", "ml-team")
+    root = Identity("root", frozenset({"platform-admins"}))
+    assert spaces.allowed(root, "delete", "TpuPodSlice", "anywhere")
+
+
+def test_authorized_kube_enforces(kube, spaces):
+    spaces.create_space("ml-team", owner="alice")
+    spaces.grant("ml-team", "carol", "space-viewer")
+    viewer = AuthorizedKube(kube, spaces, Identity("carol"))
+    with pytest.raises(Forbidden):
+        viewer.create(_job("j1", "ml-team"))
+    admin = AuthorizedKube(kube, spaces, Identity("alice"))
+    admin.create(_job("j1", "ml-team"))
+    assert viewer.get("TrainJob", "j1", "ml-team").metadata.name == "j1"
+    with pytest.raises(Forbidden):
+        viewer.delete("TrainJob", "j1", "ml-team")
+
+
+def test_authorized_list_scopes_to_visible_namespaces(kube, spaces):
+    spaces.create_space("team-a", owner="alice")
+    spaces.create_space("team-b", owner="bob")
+    kube.create(_job("ja", "team-a"))
+    kube.create(_job("jb", "team-b"))
+    mine = AuthorizedKube(kube, spaces, Identity("alice")).list("TrainJob")
+    assert [j.metadata.namespace for j in mine] == ["team-a"]
+
+
+# -- quota ------------------------------------------------------------------
+
+def test_quota_blocks_over_chip_limit(kube, spaces):
+    kube.admission.append(QuotaEnforcer(kube))
+    spaces.create_space("ml-team", owner="alice",
+                        quota_hard={"google.com/tpu": 8})
+    kube.create(_pod("p1", "ml-team", chips=4))
+    kube.create(_pod("p2", "ml-team", chips=4))
+    with pytest.raises(ValidationError, match="exceeded quota"):
+        kube.create(_pod("p3", "ml-team", chips=1))
+    # Finished pods release chips.
+    done = kube.get("Pod", "p1", "ml-team")
+    done.phase = "Succeeded"
+    kube.update(done)
+    kube.create(_pod("p3", "ml-team", chips=4))
+
+
+def test_quota_object_counts(kube, spaces):
+    kube.admission.append(QuotaEnforcer(kube))
+    spaces.create_space("ml-team", owner="alice",
+                        quota_hard={"count/trainjobs": 2})
+    kube.create(_job("j1", "ml-team"))
+    kube.create(_job("j2", "ml-team"))
+    with pytest.raises(ValidationError, match="count/trainjobs"):
+        kube.create(_job("j3", "ml-team"))
+    # Other namespaces unaffected.
+    kube.create(_job("j3", "elsewhere"))
+
+
+def test_limit_range_defaulting_and_ceiling(kube):
+    kube.admission.append(QuotaEnforcer(kube))
+    lr = LimitRange()
+    lr.metadata.name = "limits"
+    lr.metadata.namespace = "ml-team"
+    lr.spec.default_tpu = 4
+    lr.spec.max_tpu = 8
+    kube.create(lr)
+    p = _pod("p1", "ml-team", chips=0)
+    p.requests = {}
+    kube.create(p)
+    assert kube.get("Pod", "p1", "ml-team").requests["google.com/tpu"] == 4
+    with pytest.raises(ValidationError, match="LimitRange max"):
+        kube.create(_pod("p2", "ml-team", chips=16))
+
+
+def test_quota_enforced_on_pod_update(kube, spaces):
+    kube.admission.append(QuotaEnforcer(kube))
+    spaces.create_space("ml-team", owner="alice",
+                        quota_hard={"google.com/tpu": 8})
+    kube.create(_pod("p1", "ml-team", chips=4))
+    grown = kube.get("Pod", "p1", "ml-team")
+    grown.requests["google.com/tpu"] = 100
+    with pytest.raises(ValidationError, match="exceeded quota"):
+        kube.update(grown)
+    # Shrinking or finishing is always allowed.
+    shrunk = kube.get("Pod", "p1", "ml-team")
+    shrunk.phase = "Succeeded"
+    kube.update(shrunk)
+
+
+def test_conflict_wins_over_quota(kube, spaces):
+    from k8s_gpu_tpu.controller.kubefake import Conflict
+
+    kube.admission.append(QuotaEnforcer(kube))
+    spaces.create_space("ml-team", owner="alice",
+                        quota_hard={"count/trainjobs": 1})
+    kube.create(_job("j1", "ml-team"))
+    # Re-creating an existing object at the quota ceiling must surface
+    # Conflict (the operators' create-if-absent contract), not a quota error.
+    with pytest.raises(Conflict):
+        kube.create(_job("j1", "ml-team"))
+
+
+def test_quota_reconciler_status_and_alert(kube, spaces):
+    spaces.create_space("ml-team", owner="alice",
+                        quota_hard={"google.com/tpu": 8})
+    kube.create(_pod("p1", "ml-team", chips=8))
+    rec = QuotaReconciler(kube)
+    rec.reconcile(Request("ml-team", "space-quota"))
+    rq = kube.get("ResourceQuota", "space-quota", "ml-team")
+    assert rq.status.used["google.com/tpu"] == 8
+    alert = [c for c in rq.status.conditions if c.type == "AlertActive"][0]
+    assert alert.status == "True"
+    events = [e for e in kube.list("Event", namespace="ml-team")
+              if e.reason == "QuotaNearLimit"]
+    assert events
+    # Dropping below threshold clears the alert.
+    kube.delete("Pod", "p1", "ml-team")
+    rec.reconcile(Request("ml-team", "space-quota"))
+    rq = kube.get("ResourceQuota", "space-quota", "ml-team")
+    alert = [c for c in rq.status.conditions if c.type == "AlertActive"][0]
+    assert alert.status == "False"
