@@ -11,6 +11,7 @@ from .tpupodslice import TpuPodSlice, TpuPodSliceSpec, TpuPodSliceStatus, SliceS
 from .core import Secret, Node, Event, Pod
 from .trainjob import TrainJob, TrainJobSpec, TrainJobStatus, AssetRef, EnvVar
 from .tenancy import LimitRange, Namespace, ResourceQuota, RoleBinding
+from .queue import DEFAULT_QUEUE, SchedulingQueue, SchedulingQueueSpec
 
 __all__ = [
     "ObjectMeta",
@@ -40,4 +41,7 @@ __all__ = [
     "Namespace",
     "ResourceQuota",
     "RoleBinding",
+    "DEFAULT_QUEUE",
+    "SchedulingQueue",
+    "SchedulingQueueSpec",
 ]

@@ -1,0 +1,57 @@
+"""SchedulingQueue CRD — the Volcano ``queue`` role (reference
+GPU调度平台搭建.md:273-287: Volcano's batch scheduler with per-tenant queues;
+the training Job template names ``queue: default`` at :650).
+
+On TPU the *gang* half of Volcano is structural (a slice is an atomic
+capacity unit, SURVEY §2.7), so what remains queue-shaped is *admission
+ordering and capacity sharing*: jobs reference a queue; within a queue
+admission is priority-then-FIFO; a queue may cap the TPU chips its running
+jobs hold (the ResourceQuota-like share Volcano queues carry via
+``spec.capability``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .types import CustomResource, ValidationError
+
+DEFAULT_QUEUE = "default"
+
+
+@dataclass
+class SchedulingQueueSpec:
+    # Max TPU chips running jobs in this queue may hold; 0 = uncapped.
+    cap_tpu: int = 0
+    # Relative weight, recorded for operators/dashboards (cross-queue
+    # arbitration is by contention on cluster capacity, not enforced shares).
+    weight: int = 1
+    # A closed queue admits no new jobs (existing ones keep running).
+    closed: bool = False
+
+
+@dataclass
+class SchedulingQueueStatus:
+    pending: int = 0
+    running: int = 0
+    completed: int = 0
+    chips_in_use: int = 0
+
+
+@dataclass
+class SchedulingQueue(CustomResource):
+    kind: str = "SchedulingQueue"
+    api_version: str = "scheduling.tpu.k8sgpu.dev/v1alpha1"
+    spec: SchedulingQueueSpec = field(default_factory=SchedulingQueueSpec)
+    status: SchedulingQueueStatus = field(default_factory=SchedulingQueueStatus)
+
+    def validate(self) -> None:
+        super().validate()
+        if self.metadata.namespace != "":
+            raise ValidationError(
+                "SchedulingQueue is cluster-scoped (namespace must be '')"
+            )
+        if self.spec.cap_tpu < 0:
+            raise ValidationError("capTpu must be >= 0")
+        if self.spec.weight < 1:
+            raise ValidationError("weight must be >= 1")

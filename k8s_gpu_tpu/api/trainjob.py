@@ -44,6 +44,10 @@ class TrainJobSpec:
     repository: list[AssetRef] = field(default_factory=list)
     dataset: list[AssetRef] = field(default_factory=list)
     model: list[AssetRef] = field(default_factory=list)
+    # Scheduling queue (Volcano `queue:` parity, GPU调度平台搭建.md:650) and
+    # priority within it (higher admits first; FIFO among equals).
+    queue: str = "default"
+    priority: int = 0
     # single (one slice) | multislice (slice_count slices).
     mode: str = "single"
     instance_type: str = "tpu-v5e-8"

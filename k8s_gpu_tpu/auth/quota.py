@@ -58,7 +58,6 @@ class QuotaEnforcer:
         quotas = self.kube.list("ResourceQuota", namespace=ns)
         if not quotas:
             return
-        used = compute_usage(self.kube, ns)
         # Project the usage the write would add on top of current usage.
         delta: dict[str, int] = {}
         if op == "create":
@@ -87,9 +86,19 @@ class QuotaEnforcer:
                 return
         else:
             return
+        # Only writes that grow a tracked resource are gated — untracked
+        # kinds (Events, Secrets, ...) must keep working even when a
+        # namespace is already over a freshly-lowered hard limit.
+        if not delta:
+            return
+        used = compute_usage(self.kube, ns)
         for rq in quotas:
+            # Gate only the resources this write grows (apiserver semantics:
+            # being over one limit doesn't block writes to other resources).
             for key, hard in rq.spec.hard.items():
-                projected = used.get(key, 0) + delta.get(key, 0)
+                if key not in delta:
+                    continue
+                projected = used.get(key, 0) + delta[key]
                 if projected > hard:
                     raise ValidationError(
                         f"exceeded quota {rq.metadata.name!r} in {ns!r}: "

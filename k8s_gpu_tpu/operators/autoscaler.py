@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 
 from ..api.tpupodslice import TpuPodSlice
+from ..api.types import get_condition
 from ..controller.events import EventRecorder
 from ..controller.kubefake import Conflict, FakeKube
 from ..controller.manager import Reconciler, Request, Result
@@ -129,6 +130,11 @@ class SliceAutoscaler(Reconciler):
             if j.spec.accelerator_type != accel:
                 continue
             if j.status.phase in ("Succeeded", "Failed"):
+                continue
+            # Queue-blocked jobs (behind the head, over queue cap, closed
+            # queue) can't use capacity yet — don't provision for them.
+            adm = get_condition(j.status.conditions, "Admitted")
+            if adm is not None and adm.status == "False":
                 continue
             demand = max(demand, j.spec.slice_count)
         return demand

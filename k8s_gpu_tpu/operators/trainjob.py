@@ -27,6 +27,7 @@ from ..controller.kubefake import Conflict, FakeKube, NotFound
 from ..controller.manager import Reconciler, Request, Result
 from ..scheduling.labels import LABEL_ACCELERATOR, LABEL_SLICE, TPU_RESOURCE
 from ..scheduling.placement import PlacementError, multislice_spread, place_gang
+from ..scheduling.queueing import QueueAdmitter
 from ..train.registry import get_workload
 from ..utils.metrics import MetricsRegistry, global_metrics
 
@@ -46,6 +47,7 @@ class TrainJobReconciler(Reconciler):
     ):
         self.kube = kube
         self.recorder = EventRecorder(kube, "trainjob-controller")
+        self.admitter = QueueAdmitter(kube)
         self.metrics = metrics or global_metrics
         # Tests can disable in-process execution to inspect placement state.
         self.run_workloads = run_workloads
@@ -129,6 +131,34 @@ class TrainJobReconciler(Reconciler):
                          "spec not expanded: missing acceleratorType/numWorkers")
             return Result()
 
+        # Queue admission gates pod creation: a queued job holds no capacity
+        # (Volcano's admit-before-gang ordering, GPU调度平台搭建.md:273-287).
+        if job.status.phase in ("", "Pending"):
+            decision = self.admitter.decide(job)
+            if not decision.admit:
+                if decision.fatal:
+                    self._finish(job, "Failed", f"unschedulable: {decision.reason}")
+                    return Result()
+                msg = f"queued: {decision.reason}"
+                if job.status.message != msg or job.status.phase != "Pending":
+                    job.status.phase = "Pending"
+                    job.status.message = msg
+                    set_condition(
+                        job.status.conditions, "Admitted", "False",
+                        "QueueBlocked", decision.reason,
+                        observed_generation=job.metadata.generation,
+                    )
+                    self._update_status(job)
+                if self._queue_timed_out(job):
+                    self._finish(job, "Failed", "queue timeout waiting for admission")
+                    return Result()
+                return Result(requeue_after=CAPACITY_POLL)
+            set_condition(
+                job.status.conditions, "Admitted", "True", "QueueAdmitted",
+                f"queue {job.spec.queue or 'default'}",
+                observed_generation=job.metadata.generation,
+            )
+
         pods = self._worker_pods(job)
         unbound = [p for p in pods if not p.node_name]
         if unbound:
@@ -146,12 +176,7 @@ class TrainJobReconciler(Reconciler):
                         observed_generation=job.metadata.generation,
                     )
                     self._update_status(job)
-                if (
-                    job.spec.queue_timeout_s > 0
-                    and job.metadata.creation_timestamp > 0
-                    and time.time() - job.metadata.creation_timestamp
-                    > job.spec.queue_timeout_s
-                ):
+                if self._queue_timed_out(job):
                     self._finish(job, "Failed", "queue timeout waiting for capacity")
                     return Result()
                 return Result(requeue_after=CAPACITY_POLL)
@@ -202,6 +227,15 @@ class TrainJobReconciler(Reconciler):
         self._finish(job, "Succeeded", "completed")
         self.metrics.inc("trainjobs_total", result="succeeded")
         return Result()
+
+    @staticmethod
+    def _queue_timed_out(job: TrainJob) -> bool:
+        return (
+            job.spec.queue_timeout_s > 0
+            and job.metadata.creation_timestamp > 0
+            and time.time() - job.metadata.creation_timestamp
+            > job.spec.queue_timeout_s
+        )
 
     def _place(self, job: TrainJob, pods: list[Pod]) -> dict[str, str]:
         nodes = self._free_nodes(job)

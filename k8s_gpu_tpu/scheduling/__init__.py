@@ -14,6 +14,7 @@ from .placement import (
     place_gang,
     multislice_spread,
 )
+from .queueing import AdmissionDecision, QueueAdmitter, QueueReconciler, job_chips
 
 __all__ = [
     "TPU_RESOURCE",
@@ -28,4 +29,8 @@ __all__ = [
     "validate_slice_nodes",
     "place_gang",
     "multislice_spread",
+    "AdmissionDecision",
+    "QueueAdmitter",
+    "QueueReconciler",
+    "job_chips",
 ]

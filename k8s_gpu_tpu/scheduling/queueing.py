@@ -1,0 +1,150 @@
+"""Queue admission — the scheduling half of the reference's Volcano layer
+(GPU调度平台搭建.md:273-287).
+
+Volcano's pipeline is: job enters a queue → scheduler picks the next job by
+queue share/priority/FIFO → gang-admits all its pods.  Here the gang step
+is placement (scheduling/placement.py); this module is the *pick the next
+job* step: priority-then-FIFO within a queue, per-queue chip caps, and
+closed-queue draining.  The TrainJob reconciler consults ``QueueAdmitter``
+before creating worker pods, so a queued job holds no capacity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..api.queue import DEFAULT_QUEUE, SchedulingQueue
+from ..api.trainjob import TrainJob
+from ..cloud.topology import parse_accelerator_type
+from ..controller.kubefake import Conflict, FakeKube, NotFound
+from ..controller.manager import Reconciler, Request, Result
+
+RESYNC = 5.0
+
+# Jobs holding (or about to hold) capacity, and jobs awaiting admission.
+_HOLDING_PHASES = ("Placing", "Running")
+_WAITING_PHASES = ("", "Pending")
+
+
+def job_chips(job: TrainJob) -> int:
+    """Total TPU chips the job's gang occupies when running."""
+    if not job.spec.accelerator_type:
+        return 0
+    return parse_accelerator_type(job.spec.accelerator_type).chips * max(
+        1, job.spec.slice_count
+    )
+
+
+def _fifo_key(job: TrainJob):
+    return (-job.spec.priority, job.metadata.creation_timestamp,
+            job.metadata.namespace, job.metadata.name)
+
+
+@dataclass
+class AdmissionDecision:
+    admit: bool
+    reason: str = ""
+    # Unsatisfiable no matter what (e.g. needs more chips than the queue's
+    # cap can ever grant): the reconciler fails the job instead of polling,
+    # so it can't wedge the queue via head-of-line blocking.
+    fatal: bool = False
+
+
+class QueueAdmitter:
+    def __init__(self, kube: FakeKube):
+        self.kube = kube
+
+    def _queue(self, name: str) -> SchedulingQueue | None:
+        q = self.kube.try_get("SchedulingQueue", name, "")
+        if q is None and name == DEFAULT_QUEUE:
+            # The default queue exists implicitly, open and uncapped
+            # (Volcano ships a default queue out of the box).
+            return SchedulingQueue()
+        return q
+
+    def decide(self, job: TrainJob) -> AdmissionDecision:
+        qname = job.spec.queue or DEFAULT_QUEUE
+        q = self._queue(qname)
+        if q is None:
+            return AdmissionDecision(False, f"unknown queue {qname!r}")
+        if q.spec.closed:
+            return AdmissionDecision(False, f"queue {qname!r} is closed")
+
+        need = job_chips(job)
+        if q.spec.cap_tpu > 0 and need > q.spec.cap_tpu:
+            return AdmissionDecision(
+                False,
+                f"job needs {need} chips but queue {qname!r} caps at "
+                f"{q.spec.cap_tpu}",
+                fatal=True,
+            )
+
+        jobs = [
+            j for j in self.kube.list("TrainJob")
+            if (j.spec.queue or DEFAULT_QUEUE) == qname
+        ]
+        # Priority-then-FIFO: only the head of the waiting line may admit.
+        # Unsatisfiable jobs are excluded — the reconciler is about to fail
+        # them, and they must not block the line meanwhile.
+        waiting = sorted(
+            (
+                j for j in jobs
+                if j.status.phase in _WAITING_PHASES
+                and not (q.spec.cap_tpu > 0 and job_chips(j) > q.spec.cap_tpu)
+            ),
+            key=_fifo_key,
+        )
+        me = (job.metadata.namespace, job.metadata.name)
+        if waiting and (waiting[0].metadata.namespace,
+                        waiting[0].metadata.name) != me:
+            head = waiting[0]
+            return AdmissionDecision(
+                False,
+                f"behind {head.metadata.namespace}/{head.metadata.name} "
+                f"in queue {qname!r}",
+            )
+        if q.spec.cap_tpu > 0:
+            in_use = sum(
+                job_chips(j) for j in jobs if j.status.phase in _HOLDING_PHASES
+            )
+            if in_use + need > q.spec.cap_tpu:
+                return AdmissionDecision(
+                    False,
+                    f"queue {qname!r} chip cap: {in_use}+{need} > "
+                    f"{q.spec.cap_tpu}",
+                )
+        return AdmissionDecision(True)
+
+
+class QueueReconciler(Reconciler):
+    """Keeps SchedulingQueue status (pending/running/completed/chips) live."""
+
+    def __init__(self, kube: FakeKube, resync: float = RESYNC):
+        self.kube = kube
+        self.resync = resync
+
+    def reconcile(self, req: Request) -> Result:
+        q = self.kube.try_get("SchedulingQueue", req.name, "")
+        if q is None:
+            return Result()
+        jobs = [
+            j for j in self.kube.list("TrainJob")
+            if (j.spec.queue or DEFAULT_QUEUE) == req.name
+        ]
+        q.status.pending = sum(
+            1 for j in jobs if j.status.phase in _WAITING_PHASES
+        )
+        q.status.running = sum(
+            1 for j in jobs if j.status.phase in _HOLDING_PHASES
+        )
+        q.status.completed = sum(
+            1 for j in jobs if j.status.phase in ("Succeeded", "Failed")
+        )
+        q.status.chips_in_use = sum(
+            job_chips(j) for j in jobs if j.status.phase in _HOLDING_PHASES
+        )
+        try:
+            self.kube.update_status(q)
+        except (Conflict, NotFound):
+            return Result(requeue=True)
+        return Result(requeue_after=self.resync)

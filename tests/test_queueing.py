@@ -1,0 +1,207 @@
+"""SchedulingQueue admission: priority-then-FIFO, chip caps, closed queues
+(C16 — the Volcano queue role, GPU调度平台搭建.md:273-287, 650)."""
+
+import pytest
+
+from k8s_gpu_tpu.api import SchedulingQueue, TrainJob
+from k8s_gpu_tpu.controller import FakeKube
+from k8s_gpu_tpu.controller.manager import Request
+from k8s_gpu_tpu.scheduling import QueueAdmitter, QueueReconciler, job_chips
+
+
+@pytest.fixture
+def admitter(kube: FakeKube):
+    return QueueAdmitter(kube)
+
+
+def make_queue(kube, name, cap_tpu=0, closed=False):
+    q = SchedulingQueue()
+    q.metadata.name = name
+    q.metadata.namespace = ""
+    q.spec.cap_tpu = cap_tpu
+    q.spec.closed = closed
+    kube.create(q)
+
+
+def make_job(kube, name, queue="default", priority=0, accel="v4-8",
+             phase="", slices=1):
+    j = TrainJob()
+    j.metadata.name = name
+    j.spec.queue = queue
+    j.spec.priority = priority
+    j.spec.accelerator_type = accel
+    j.spec.slice_count = slices
+    j.spec.mode = "single" if slices == 1 else "multislice"
+    j.spec.num_workers = 1
+    created = kube.create(j)
+    if phase:
+        created.status.phase = phase
+        kube.update_status(created)
+    return kube.get("TrainJob", name)
+
+
+def test_job_chips(kube):
+    j = make_job(kube, "j1", accel="v4-8")
+    assert job_chips(j) == 8
+    m = make_job(kube, "m1", accel="v5e-8", slices=4)
+    assert job_chips(m) == 32
+
+
+def test_default_queue_implicit(kube, admitter):
+    j = make_job(kube, "j1")
+    assert admitter.decide(j).admit
+
+
+def test_unknown_queue_denied(kube, admitter):
+    j = make_job(kube, "j1", queue="nope")
+    d = admitter.decide(j)
+    assert not d.admit and "unknown queue" in d.reason
+
+
+def test_closed_queue_denied(kube, admitter):
+    make_queue(kube, "drain", closed=True)
+    j = make_job(kube, "j1", queue="drain")
+    d = admitter.decide(j)
+    assert not d.admit and "closed" in d.reason
+
+
+def test_fifo_within_queue(kube, admitter):
+    first = make_job(kube, "first")
+    second = make_job(kube, "second")
+    assert admitter.decide(first).admit
+    d = admitter.decide(second)
+    assert not d.admit and "behind default/first" in d.reason
+
+
+def test_priority_jumps_fifo(kube, admitter):
+    make_job(kube, "old", priority=0)
+    vip = make_job(kube, "vip", priority=10)
+    assert admitter.decide(vip).admit
+    old = kube.get("TrainJob", "old")
+    assert not admitter.decide(old).admit
+
+
+def test_chip_cap_blocks_and_releases(kube, admitter):
+    make_queue(kube, "team-q", cap_tpu=8)
+    make_job(kube, "running", queue="team-q", phase="Running")
+    j = make_job(kube, "j1", queue="team-q")
+    d = admitter.decide(j)
+    assert not d.admit and "chip cap" in d.reason
+    # Completion releases the queue's share.
+    done = kube.get("TrainJob", "running")
+    done.status.phase = "Succeeded"
+    kube.update_status(done)
+    assert admitter.decide(kube.get("TrainJob", "j1")).admit
+
+
+def test_oversized_job_is_fatal_not_wedging(kube, admitter):
+    """A job that can never fit the queue cap is rejected fatally and does
+    not head-of-line-block jobs behind it."""
+    make_queue(kube, "small", cap_tpu=8)
+    big = make_job(kube, "big", queue="small", accel="v4-16")  # 16 chips
+    d = admitter.decide(big)
+    assert not d.admit and d.fatal
+    ok = make_job(kube, "ok", queue="small", accel="v4-8")
+    assert admitter.decide(ok).admit
+
+
+def test_queue_namespace_pinned(kube):
+    from k8s_gpu_tpu.api import ValidationError
+
+    q = SchedulingQueue()
+    q.metadata.name = "q"  # ObjectMeta defaults namespace to "default"
+    with pytest.raises(ValidationError, match="cluster-scoped"):
+        kube.create(q)
+
+
+def test_queue_timeout_applies_to_admission_block(kube, clock):
+    """queue_timeout_s fires for queue-blocked jobs, not just
+    capacity-blocked ones."""
+    from k8s_gpu_tpu.controller import Manager
+    from k8s_gpu_tpu.operators import TrainJobReconciler
+
+    mgr = Manager(kube, clock=clock)
+    mgr.register("TrainJob", TrainJobReconciler(kube), name="trainjob")
+    mgr.start()
+    try:
+        make_queue(kube, "drain", closed=True)
+        j = TrainJob()
+        j.metadata.name = "j1"
+        j.spec.queue = "drain"
+        j.spec.accelerator_type = "v4-8"
+        j.spec.num_workers = 2
+        j.spec.queue_timeout_s = 0.5
+        kube.create(j)
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            mgr.wait_idle()
+            cur = kube.get("TrainJob", "j1")
+            if cur.status.phase == "Failed":
+                break
+            clock.advance(5.1)
+        assert cur.status.phase == "Failed"
+        assert "timeout" in cur.status.message
+    finally:
+        mgr.stop()
+
+
+def test_queue_status_reconcile(kube):
+    make_queue(kube, "team-q", cap_tpu=32)
+    make_job(kube, "r1", queue="team-q", phase="Running")
+    make_job(kube, "p1", queue="team-q")
+    make_job(kube, "s1", queue="team-q", phase="Succeeded")
+    QueueReconciler(kube).reconcile(Request("", "team-q"))
+    q = kube.get("SchedulingQueue", "team-q", "")
+    assert (q.status.running, q.status.pending, q.status.completed) == (1, 1, 1)
+    assert q.status.chips_in_use == 8
+
+
+def test_reconciler_integration_fifo_order(kube, clock):
+    """Through the live TrainJob reconciler: a capped queue runs jobs one
+    at a time in FIFO order; the blocked job carries Admitted=False."""
+    from k8s_gpu_tpu.api import TpuPodSlice
+    from k8s_gpu_tpu.api.types import get_condition
+    from k8s_gpu_tpu.cloud import FakeCloudTpu, cloudtpu_client_factory
+    from k8s_gpu_tpu.controller import Manager
+    from k8s_gpu_tpu.operators import TpuPodSliceReconciler, TrainJobReconciler
+    from k8s_gpu_tpu.cloud.topology import parse_accelerator_type
+
+    cloud = FakeCloudTpu(clock=clock)
+    mgr = Manager(kube, clock=clock)
+    mgr.register(
+        "TpuPodSlice", TpuPodSliceReconciler(kube, cloudtpu_client_factory(cloud))
+    )
+    mgr.register("TrainJob", TrainJobReconciler(kube), name="trainjob")
+    mgr.start()
+    try:
+        make_queue(kube, "team-q", cap_tpu=8)
+        ps = TpuPodSlice()
+        ps.metadata.name = "pool"
+        ps.spec.accelerator_type = "v4-8"
+        kube.create(ps)
+        for name in ("first", "second"):
+            j = TrainJob()
+            j.metadata.name = name
+            j.spec.queue = "team-q"
+            j.spec.accelerator_type = "v4-8"
+            j.spec.workload = "psum-smoke"
+            j.spec.num_workers = parse_accelerator_type("v4-8").hosts
+            kube.create(j)
+        for _ in range(60):
+            mgr.wait_idle()
+            jobs = {n: kube.get("TrainJob", n) for n in ("first", "second")}
+            if all(j.status.phase == "Succeeded" for j in jobs.values()):
+                break
+            clock.advance(5.1)
+        else:
+            raise AssertionError(
+                {n: (j.status.phase, j.status.message) for n, j in jobs.items()}
+            )
+        first, second = jobs["first"], jobs["second"]
+        assert first.status.completion_time <= second.status.start_time
+        adm = get_condition(second.status.conditions, "Admitted")
+        assert adm is not None and adm.status == "True"  # finally admitted
+    finally:
+        mgr.stop()

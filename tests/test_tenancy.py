@@ -137,6 +137,34 @@ def test_quota_object_counts(kube, spaces):
     kube.create(_job("j3", "elsewhere"))
 
 
+def test_untracked_kinds_unaffected_by_exceeded_quota(kube, spaces):
+    """A namespace already over a (freshly lowered) hard limit must still
+    accept writes that don't grow a tracked resource — Events especially,
+    or the alerting that reports the overage could never be recorded."""
+    from k8s_gpu_tpu.api import Event, ResourceQuota, Secret
+
+    kube.admission.append(QuotaEnforcer(kube))
+    kube.create(_pod("p1", "ml-team", chips=8))
+    rq = ResourceQuota()
+    rq.metadata.name = "space-quota"
+    rq.metadata.namespace = "ml-team"
+    rq.spec.hard = {"google.com/tpu": 4}  # already exceeded by p1
+    kube.create(rq)
+    ev = Event()
+    ev.metadata.name = "ev1"
+    ev.metadata.namespace = "ml-team"
+    kube.create(ev)
+    s = Secret()
+    s.metadata.name = "s1"
+    s.metadata.namespace = "ml-team"
+    kube.create(s)
+    # Counted kinds whose own limits aren't set are also unaffected.
+    kube.create(_job("j1", "ml-team"))
+    # But growing the over-limit resource stays blocked.
+    with pytest.raises(ValidationError, match="exceeded quota"):
+        kube.create(_pod("p2", "ml-team", chips=1))
+
+
 def test_limit_range_defaulting_and_ceiling(kube):
     kube.admission.append(QuotaEnforcer(kube))
     lr = LimitRange()
