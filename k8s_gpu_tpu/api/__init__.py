@@ -8,7 +8,8 @@ from .types import (
 )
 from .azurevmpool import AzureVmPool, AzureVmPoolSpec, AzureVmPoolStatus, ImageReference
 from .tpupodslice import TpuPodSlice, TpuPodSliceSpec, TpuPodSliceStatus, SliceStatus
-from .core import Secret, Node, Event, Pod
+from .core import Secret, Node, Event, Pod, PersistentVolumeClaim
+from .devenv import DevEnv, DevEnvSpec, DevEnvStatus
 from .trainjob import TrainJob, TrainJobSpec, TrainJobStatus, AssetRef, EnvVar
 from .tenancy import LimitRange, Namespace, ResourceQuota, RoleBinding
 from .queue import DEFAULT_QUEUE, SchedulingQueue, SchedulingQueueSpec
@@ -44,4 +45,8 @@ __all__ = [
     "DEFAULT_QUEUE",
     "SchedulingQueue",
     "SchedulingQueueSpec",
+    "PersistentVolumeClaim",
+    "DevEnv",
+    "DevEnvSpec",
+    "DevEnvStatus",
 ]

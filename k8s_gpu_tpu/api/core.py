@@ -55,9 +55,29 @@ class Pod(CustomResource):
 
     kind: str = "Pod"
     api_version: str = "v1"
+    image: str = ""
+    command: str = ""
     requests: dict[str, int] = field(default_factory=dict)
     node_selector: dict[str, str] = field(default_factory=dict)
     node_name: str = ""
     phase: str = "Pending"  # Pending | Running | Succeeded | Failed
     # Pod-group id for gang semantics / multislice spread (SURVEY §2.7).
     group: str = ""
+    # mountPath → volume source ref ("pvc:<name>" | "secret:<name>"), the
+    # minimal volumes model the devenv pod template needs
+    # (GPU调度平台搭建.md:341-368: workspace PVC + SSH-key Secret mounts).
+    mounts: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PersistentVolumeClaim(CustomResource):
+    """RWX workspace claim (reference C12: 200Gi ReadWriteMany /workspace,
+    GPU调度平台搭建.md:181-224).  No provisioner here — a created claim is
+    Bound; what matters to the platform is identity + persistence semantics
+    (devenv pods come and go, the claim stays)."""
+
+    kind: str = "PersistentVolumeClaim"
+    api_version: str = "v1"
+    access_modes: list[str] = field(default_factory=lambda: ["ReadWriteMany"])
+    capacity: str = "200Gi"
+    phase: str = "Bound"

@@ -1,0 +1,59 @@
+"""DevEnv CRD — per-user persistent development environments (C21-C24).
+
+The reference's devenv-controller materializes a pod ``devenv-<username>``
+from a documented template (GPU调度平台搭建.md:341-372): micromamba base
+image with sshd as PID 1 (:314-339), the shared RWX workspace PVC mounted
+at ``/workspace``, and the user's SSH public key injected as Secret
+``user-ssh-<username>`` mounted into ``/root/.ssh`` (:369-372, 417).
+Access is SSH on a dedicated endpoint (:418) with VSCode Remote-SSH on
+top (:419); conda environments persist across pod restarts because
+micromamba's dirs are redirected into the workspace (:374-406).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .types import Condition, CustomResource, ValidationError
+
+DEFAULT_IMAGE = "registry.local/tpu-platform/mamba-base:latest"
+WORKSPACE_PVC = "workspace-pvc"
+SSH_PORT = 2022
+
+
+@dataclass
+class DevEnvSpec:
+    username: str = ""
+    image: str = DEFAULT_IMAGE
+    ssh_public_key: str = ""
+    workspace_pvc: str = WORKSPACE_PVC
+    # Chip-less by default: devenvs are CPU boxes next to the accelerators
+    # (the reference's devenv template requests no GPU, :341-368); set > 0
+    # for a debug env with attached chips.
+    tpu_chips: int = 0
+
+
+@dataclass
+class DevEnvStatus:
+    phase: str = "Pending"  # Pending | Ready | Terminating
+    pod_name: str = ""
+    ssh_endpoint: str = ""
+    message: str = ""
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class DevEnv(CustomResource):
+    kind: str = "DevEnv"
+    api_version: str = "tpu.k8sgpu.dev/v1alpha1"
+    spec: DevEnvSpec = field(default_factory=DevEnvSpec)
+    status: DevEnvStatus = field(default_factory=DevEnvStatus)
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.spec.username:
+            raise ValidationError("spec.username is required")
+        if not self.spec.ssh_public_key:
+            raise ValidationError("spec.sshPublicKey is required")
+        if self.spec.tpu_chips < 0:
+            raise ValidationError("spec.tpuChips must be >= 0")

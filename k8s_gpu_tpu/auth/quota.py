@@ -64,7 +64,9 @@ class QuotaEnforcer:
             if obj.kind in _COUNTED:
                 delta[_COUNTED[obj.kind]] = 1
             if obj.kind == "Pod" and obj.phase in _LIVE_POD_PHASES:
-                delta[TPU_RESOURCE] = obj.requests.get(TPU_RESOURCE, 0)
+                chips = obj.requests.get(TPU_RESOURCE, 0)
+                if chips > 0:  # a zero delta must not gate on the chip limit
+                    delta[TPU_RESOURCE] = chips
         elif obj.kind == "Pod":
             # Updates can't change counts, but can grow a pod's chip request
             # (or resurrect a finished pod); meter the increase over the

@@ -269,6 +269,60 @@ def cmd_asset(args) -> int:
         p.close()
 
 
+def cmd_devenv(args) -> int:
+    from ..api.devenv import DevEnv
+    from ..controller.kubefake import NotFound
+
+    ctx = _require_login(CliConfig.load())
+    p = LocalPlatform()
+    try:
+        if args.devenv_cmd == "create":
+            try:
+                pubkey = (
+                    Path(args.pubkey).read_text().strip() if args.pubkey else ""
+                )
+            except OSError as e:
+                print(f"error: cannot read pubkey: {e}", file=sys.stderr)
+                return 1
+            name = args.name or f"env-{args.user or ctx.user}"
+            env = p.kube.try_get("DevEnv", name, ctx.space)
+            if env is None and not pubkey:
+                print("--pubkey is required to create a devenv", file=sys.stderr)
+                return 2
+            if env is None:
+                env = DevEnv()
+                env.metadata.name = name
+                env.metadata.namespace = ctx.space
+                env.spec.username = args.user or ctx.user
+                env.spec.ssh_public_key = pubkey
+                p.kube.create(env)
+            else:
+                env.spec.ssh_public_key = pubkey or env.spec.ssh_public_key
+                p.kube.update(env)
+            p.settle()
+            cur = p.kube.get("DevEnv", name, ctx.space)
+            print(f"{name}\t{cur.status.phase}\tssh: {cur.status.ssh_endpoint}")
+            return 0 if cur.status.phase == "Ready" else 1
+        if args.devenv_cmd == "list":
+            print("NAME\tUSER\tPHASE\tSSH")
+            for e in p.kube.list("DevEnv", namespace=ctx.space):
+                print(f"{e.metadata.name}\t{e.spec.username}\t"
+                      f"{e.status.phase}\t{e.status.ssh_endpoint}")
+            return 0
+        if args.devenv_cmd == "delete":
+            try:
+                p.kube.delete("DevEnv", args.name, ctx.space)
+            except NotFound:
+                print(f"no such devenv {args.name}", file=sys.stderr)
+                return 1
+            p.settle()
+            print(f"{args.name} deleted (workspace PVC retained)")
+            return 0
+        return 1
+    finally:
+        p.close()
+
+
 # -- parser ----------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -294,6 +348,16 @@ def build_parser() -> argparse.ArgumentParser:
     p_use = ctx_sub.add_parser("use")
     p_use.add_argument("name")
     p_ctx.set_defaults(fn=cmd_context)
+
+    p_env = sub.add_parser("devenv", help="persistent dev environments")
+    env_sub = p_env.add_subparsers(dest="devenv_cmd", required=True)
+    p_ec = env_sub.add_parser("create")
+    p_ec.add_argument("--name", default="")
+    p_ec.add_argument("--user", default="")
+    p_ec.add_argument("--pubkey", default="", help="path to SSH public key")
+    env_sub.add_parser("list")
+    env_sub.add_parser("delete").add_argument("name")
+    p_env.set_defaults(fn=cmd_devenv)
 
     p_repo = sub.add_parser("repo", help="code repositories")
     repo_sub = p_repo.add_subparsers(dest="repo_cmd", required=True)

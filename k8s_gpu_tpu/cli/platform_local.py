@@ -20,7 +20,12 @@ from ..api.trainjob import TrainJob
 from ..cloud.fake_cloudtpu import FakeCloudTpu, cloudtpu_client_factory
 from ..controller.kubefake import FakeKube
 from ..controller.manager import Manager
-from ..operators import SliceAutoscaler, TpuPodSliceReconciler, TrainJobReconciler
+from ..operators import (
+    DevEnvReconciler,
+    SliceAutoscaler,
+    TpuPodSliceReconciler,
+    TrainJobReconciler,
+)
 from ..platform.assets import AssetStore
 
 
@@ -86,6 +91,10 @@ class LocalPlatform:
         )
         self.mgr.register("TrainJob", TrainJobReconciler(self.kube), name="trainjob")
         self.mgr.register("TrainJob", SliceAutoscaler(self.kube), name="autoscaler")
+        self.mgr.register("DevEnv", DevEnvReconciler(self.kube))
+        from ..scheduling.queueing import QueueReconciler
+
+        self.mgr.register("SchedulingQueue", QueueReconciler(self.kube))
         self.mgr.start()
 
     # -- persistence -------------------------------------------------------

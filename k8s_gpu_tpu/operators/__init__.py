@@ -2,5 +2,12 @@ from .azurevmpool import AzureVmPoolReconciler
 from .tpupodslice import TpuPodSliceReconciler
 from .trainjob import TrainJobReconciler
 from .autoscaler import SliceAutoscaler
+from .devenv import DevEnvReconciler
 
-__all__ = ["AzureVmPoolReconciler", "TpuPodSliceReconciler", "TrainJobReconciler", "SliceAutoscaler"]
+__all__ = [
+    "AzureVmPoolReconciler",
+    "TpuPodSliceReconciler",
+    "TrainJobReconciler",
+    "SliceAutoscaler",
+    "DevEnvReconciler",
+]

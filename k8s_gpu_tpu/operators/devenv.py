@@ -1,0 +1,203 @@
+"""DevEnv reconciler — the reference's devenv-controller (C22, C24;
+GPU调度平台搭建.md:341-372, 408-419), one of the four named-but-never-built
+GoHai components (:889).
+
+Reconcile contract: for a DevEnv, ensure (1) the user's SSH key Secret
+``user-ssh-<username>`` exists and tracks spec (key rotation updates it,
+:417), (2) the shared workspace PVC exists (created on first use, C12
+parity), (3) pod ``devenv-<username>`` runs the devenv image with the
+workspace and SSH-key mounts plus the micromamba persistence config
+(:374-406).  Deletion tears down pod + Secret but NEVER the PVC — conda
+envs and checkouts must survive devenv recreation (:374-383).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api.core import PersistentVolumeClaim, Pod, Secret
+from ..api.devenv import SSH_PORT, DevEnv
+from ..api.types import set_condition
+from ..controller.events import EventRecorder
+from ..controller.kubefake import Conflict, FakeKube, NotFound
+from ..controller.manager import Reconciler, Request, Result
+from ..scheduling.labels import TPU_RESOURCE
+
+log = logging.getLogger("k8s_gpu_tpu.operators.devenv")
+
+FINALIZER = "tpu.k8sgpu.dev/devenv-cleanup"
+
+# micromamba persistence (C23): envs/pkgs under the workspace mount so they
+# survive pod restarts (GPU调度平台搭建.md:374-406, 812-826).
+MAMBARC = """\
+envs_dirs:
+  - /workspace/.conda/envs
+pkgs_dirs:
+  - /workspace/.conda/pkgs
+"""
+
+
+def pod_name(env: DevEnv) -> str:
+    return f"devenv-{env.spec.username}"
+
+
+def secret_name(env: DevEnv) -> str:
+    return f"user-ssh-{env.spec.username}"
+
+
+def ssh_endpoint(env: DevEnv) -> str:
+    """The reference's dedicated SSH ingress (:418):
+    ``ssh -p 2022 <name>.ssh.tpu-platform.example.com``."""
+    return f"{env.metadata.name}.ssh.tpu-platform.example.com:{SSH_PORT}"
+
+
+class DevEnvReconciler(Reconciler):
+    def __init__(self, kube: FakeKube):
+        self.kube = kube
+        self.recorder = EventRecorder(kube, "devenv-controller")
+
+    def reconcile(self, req: Request) -> Result:
+        env = self.kube.try_get("DevEnv", req.name, req.namespace)
+        if env is None:
+            return Result()
+        if env.metadata.deletion_timestamp is not None:
+            return self._teardown(env)
+        if FINALIZER not in env.metadata.finalizers:
+            env.metadata.finalizers.append(FINALIZER)
+            try:
+                env = self.kube.update(env)
+            except Conflict:
+                return Result(requeue=True)
+
+        # One DevEnv per username per namespace: pod/secret names derive
+        # from the username (reference template naming, :341-372), so a
+        # second DevEnv claiming the same username would silently overwrite
+        # the first user's key and share its pod.
+        owner = self._username_owner(env)
+        if owner is not None and owner != env.metadata.name:
+            env.status.phase = "Failed"
+            env.status.message = (
+                f"username {env.spec.username!r} already claimed by "
+                f"devenv {owner!r}"
+            )
+            set_condition(
+                env.status.conditions, "Ready", "False", "UsernameConflict",
+                env.status.message,
+                observed_generation=env.metadata.generation,
+            )
+            try:
+                self.kube.update_status(env)
+            except (Conflict, NotFound):
+                return Result(requeue=True)
+            return Result()
+
+        self._ensure_secret(env)
+        self._ensure_pvc(env)
+        created = self._ensure_pod(env)
+
+        env.status.phase = "Ready"
+        env.status.pod_name = pod_name(env)
+        env.status.ssh_endpoint = ssh_endpoint(env)
+        env.status.message = ""
+        set_condition(
+            env.status.conditions, "Ready", "True", "PodRunning",
+            f"pod {pod_name(env)} up; ssh via {ssh_endpoint(env)}",
+            observed_generation=env.metadata.generation,
+        )
+        try:
+            self.kube.update_status(env)
+        except (Conflict, NotFound):
+            return Result(requeue=True)
+        if created:
+            self.recorder.event(
+                env, "Normal", "DevEnvReady",
+                f"pod {pod_name(env)} created for {env.spec.username}",
+            )
+        return Result()
+
+    # -- parts -------------------------------------------------------------
+    def _username_owner(self, env: DevEnv) -> str | None:
+        """Which DevEnv (by the ownership label) holds this username's
+        pod/secret; None when unclaimed."""
+        for kind, name in (("Pod", pod_name(env)),
+                           ("Secret", secret_name(env))):
+            obj = self.kube.try_get(kind, name, env.metadata.namespace)
+            if obj is not None:
+                return obj.metadata.labels.get("devenv", "")
+        return None
+
+    def _ensure_secret(self, env: DevEnv) -> None:
+        """Create or rotate the authorized_keys Secret (:369-372, 417)."""
+        want = {"authorized_keys": env.spec.ssh_public_key, "mambarc": MAMBARC}
+        cur = self.kube.try_get("Secret", secret_name(env), env.metadata.namespace)
+        if cur is None:
+            s = Secret()
+            s.metadata.name = secret_name(env)
+            s.metadata.namespace = env.metadata.namespace
+            s.metadata.labels = {"devenv": env.metadata.name}
+            s.data = want
+            try:
+                self.kube.create(s)
+            except Conflict:
+                pass
+        elif cur.data != want:
+            cur.data = want
+            try:
+                self.kube.update(cur)
+            except Conflict:
+                pass
+            self.recorder.event(env, "Normal", "SSHKeyRotated",
+                                f"secret {secret_name(env)} updated")
+
+    def _ensure_pvc(self, env: DevEnv) -> None:
+        if self.kube.try_get(
+            "PersistentVolumeClaim", env.spec.workspace_pvc,
+            env.metadata.namespace,
+        ) is None:
+            pvc = PersistentVolumeClaim()
+            pvc.metadata.name = env.spec.workspace_pvc
+            pvc.metadata.namespace = env.metadata.namespace
+            try:
+                self.kube.create(pvc)
+            except Conflict:
+                pass
+
+    def _ensure_pod(self, env: DevEnv) -> bool:
+        """Returns True when the pod was created this pass."""
+        if self.kube.try_get("Pod", pod_name(env), env.metadata.namespace):
+            return False
+        p = Pod()
+        p.metadata.name = pod_name(env)
+        p.metadata.namespace = env.metadata.namespace
+        p.metadata.labels = {"devenv": env.metadata.name,
+                             "user": env.spec.username}
+        p.image = env.spec.image
+        p.command = "/usr/sbin/sshd -D"  # sshd as PID 1 (:331)
+        p.mounts = {
+            "/workspace": f"pvc:{env.spec.workspace_pvc}",
+            "/root/.ssh": f"secret:{secret_name(env)}",
+        }
+        if env.spec.tpu_chips:
+            p.requests[TPU_RESOURCE] = env.spec.tpu_chips
+        p.phase = "Running"
+        try:
+            self.kube.create(p)
+        except Conflict:
+            return False
+        return True
+
+    def _teardown(self, env: DevEnv) -> Result:
+        """Pod + Secret go; the workspace PVC stays (persistence, :374-383)."""
+        for kind, name in (("Pod", pod_name(env)),
+                           ("Secret", secret_name(env))):
+            try:
+                self.kube.delete(kind, name, env.metadata.namespace)
+            except NotFound:
+                pass
+        if FINALIZER in env.metadata.finalizers:
+            env.metadata.finalizers.remove(FINALIZER)
+            try:
+                self.kube.update(env)
+            except (Conflict, NotFound):
+                return Result(requeue=True)
+        return Result()

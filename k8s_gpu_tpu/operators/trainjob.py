@@ -133,7 +133,10 @@ class TrainJobReconciler(Reconciler):
 
         # Queue admission gates pod creation: a queued job holds no capacity
         # (Volcano's admit-before-gang ordering, GPU调度平台搭建.md:273-287).
-        if job.status.phase in ("", "Pending"):
+        # Admit-once: a job whose worker pods already exist is past the gate —
+        # revoking admission then (queue closed, higher-priority arrival)
+        # would strand pods that still count against namespace quota.
+        if job.status.phase in ("", "Pending") and not self._has_pods(job):
             decision = self.admitter.decide(job)
             if not decision.admit:
                 if decision.fatal:
@@ -177,6 +180,9 @@ class TrainJobReconciler(Reconciler):
                     )
                     self._update_status(job)
                 if self._queue_timed_out(job):
+                    # The unbound worker pods created for placement count
+                    # against quota — release them with the job.
+                    self._delete_pods(job)
                     self._finish(job, "Failed", "queue timeout waiting for capacity")
                     return Result()
                 return Result(requeue_after=CAPACITY_POLL)
@@ -227,6 +233,12 @@ class TrainJobReconciler(Reconciler):
         self._finish(job, "Succeeded", "completed")
         self.metrics.inc("trainjobs_total", result="succeeded")
         return Result()
+
+    def _has_pods(self, job: TrainJob) -> bool:
+        return any(
+            p.metadata.labels.get("job") == job.metadata.name
+            for p in self.kube.list("Pod", namespace=job.metadata.namespace)
+        )
 
     @staticmethod
     def _queue_timed_out(job: TrainJob) -> bool:

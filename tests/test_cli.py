@@ -113,3 +113,18 @@ def test_bad_template_fails_cleanly(tmp_path, capsys):
     tpl.write_text("nonsense_field: 1\n")
     code, _, err = run(capsys, "trainjob", "create", "-f", str(tpl))
     assert code == 1 and "error:" in err
+
+
+def test_devenv_flow(tmp_path, capsys):
+    run(capsys, "login", "--user", "ada")
+    key = tmp_path / "id_ed25519.pub"
+    key.write_text("ssh-ed25519 AAAA ada@laptop\n")
+    code, out, err = run(capsys, "devenv", "create", "--pubkey", str(key))
+    assert code == 0 and "Ready" in out and ":2022" in out, (out, err)
+    code, out, _ = run(capsys, "devenv", "list")
+    assert "env-ada" in out and "ada" in out
+    code, out, _ = run(capsys, "devenv", "delete", "env-ada")
+    assert code == 0 and "PVC retained" in out
+    # Creating without a key for a new env is a usage error.
+    code, _, err = run(capsys, "devenv", "create", "--name", "env-2")
+    assert code == 2 and "pubkey" in err

@@ -1,0 +1,150 @@
+"""DevEnv lifecycle: SSH-key Secret, workspace PVC persistence, pod render,
+key rotation, teardown (C21-C24; GPU调度平台搭建.md:314-419)."""
+
+import pytest
+
+from k8s_gpu_tpu.api import DevEnv
+from k8s_gpu_tpu.controller import FakeKube, Manager
+from k8s_gpu_tpu.operators import DevEnvReconciler
+from k8s_gpu_tpu.operators.devenv import MAMBARC
+
+PUBKEY = "ssh-ed25519 AAAAC3Nz alice@laptop"
+
+
+@pytest.fixture
+def harness(kube: FakeKube, clock):
+    mgr = Manager(kube, clock=clock)
+    mgr.register("DevEnv", DevEnvReconciler(kube))
+    mgr.start()
+    yield kube, mgr
+    mgr.stop()
+
+
+def make_env(kube, name="env-alice", user="alice", key=PUBKEY, ns="default"):
+    env = DevEnv()
+    env.metadata.name = name
+    env.metadata.namespace = ns
+    env.spec.username = user
+    env.spec.ssh_public_key = key
+    return kube.create(env)
+
+
+def wait_ready(kube, mgr, name="env-alice", ns="default"):
+    assert mgr.wait_idle(
+        predicate=lambda: kube.get("DevEnv", name, ns).status.phase == "Ready"
+    )
+    return kube.get("DevEnv", name, ns)
+
+
+def test_validation():
+    from k8s_gpu_tpu.api import ValidationError
+
+    env = DevEnv()
+    env.metadata.name = "e"
+    with pytest.raises(ValidationError, match="username"):
+        env.validate()
+    env.spec.username = "alice"
+    with pytest.raises(ValidationError, match="sshPublicKey"):
+        env.validate()
+
+
+def test_devenv_materializes(harness):
+    kube, mgr = harness
+    make_env(kube)
+    env = wait_ready(kube, mgr)
+    # Secret carries the key and the micromamba persistence config (C23).
+    s = kube.get("Secret", "user-ssh-alice")
+    assert s.data["authorized_keys"] == PUBKEY
+    assert "/workspace/.conda/envs" in s.data["mambarc"]
+    assert s.data["mambarc"] == MAMBARC
+    # Workspace PVC exists, RWX (C12 parity).
+    pvc = kube.get("PersistentVolumeClaim", "workspace-pvc")
+    assert pvc.access_modes == ["ReadWriteMany"]
+    # Pod renders the reference template (C22): sshd PID 1 + both mounts.
+    pod = kube.get("Pod", "devenv-alice")
+    assert pod.command.startswith("/usr/sbin/sshd")
+    assert pod.mounts["/workspace"] == "pvc:workspace-pvc"
+    assert pod.mounts["/root/.ssh"] == "secret:user-ssh-alice"
+    assert pod.phase == "Running"
+    # Status surfaces the SSH endpoint (C24).
+    assert env.status.ssh_endpoint.endswith(":2022")
+    assert env.status.pod_name == "devenv-alice"
+
+
+def test_key_rotation_updates_secret(harness):
+    kube, mgr = harness
+    make_env(kube)
+    wait_ready(kube, mgr)
+    env = kube.get("DevEnv", "env-alice")
+    env.spec.ssh_public_key = "ssh-ed25519 NEWKEY alice@desktop"
+    kube.update(env)
+    assert mgr.wait_idle(
+        predicate=lambda: kube.get("Secret", "user-ssh-alice").data[
+            "authorized_keys"
+        ].startswith("ssh-ed25519 NEWKEY")
+    )
+    events = [e for e in kube.list("Event") if e.reason == "SSHKeyRotated"]
+    assert events
+
+
+def test_teardown_keeps_pvc(harness):
+    """Deleting the devenv removes pod + secret but the workspace PVC (and
+    the conda envs inside it) survives for the next devenv (:374-383)."""
+    kube, mgr = harness
+    make_env(kube)
+    wait_ready(kube, mgr)
+    kube.delete("DevEnv", "env-alice")
+    assert mgr.wait_idle(
+        predicate=lambda: kube.try_get("DevEnv", "env-alice") is None
+    )
+    assert kube.try_get("Pod", "devenv-alice") is None
+    assert kube.try_get("Secret", "user-ssh-alice") is None
+    assert kube.get("PersistentVolumeClaim", "workspace-pvc") is not None
+    # Recreation binds the same claim.
+    make_env(kube, key="ssh-ed25519 BBBB alice@new")
+    env = wait_ready(kube, mgr)
+    assert env.status.phase == "Ready"
+
+
+def test_two_users_share_workspace_pvc(harness):
+    kube, mgr = harness
+    make_env(kube, name="env-alice", user="alice")
+    make_env(kube, name="env-bob", user="bob",
+             key="ssh-ed25519 CCCC bob@box")
+    wait_ready(kube, mgr, "env-alice")
+    wait_ready(kube, mgr, "env-bob")
+    assert len(kube.list("PersistentVolumeClaim")) == 1
+    assert {p.metadata.name for p in kube.list("Pod")} == {
+        "devenv-alice", "devenv-bob"
+    }
+
+
+def test_duplicate_username_rejected(harness):
+    """A second DevEnv claiming an already-owned username must fail instead
+    of overwriting the first user's key and sharing its pod."""
+    kube, mgr = harness
+    make_env(kube, name="env-a", user="ada")
+    assert mgr.wait_idle(
+        predicate=lambda: kube.get("DevEnv", "env-a").status.phase == "Ready"
+    )
+    make_env(kube, name="env-b", user="ada", key="ssh-ed25519 EVIL other")
+    assert mgr.wait_idle(
+        predicate=lambda: kube.get("DevEnv", "env-b").status.phase == "Failed"
+    )
+    b = kube.get("DevEnv", "env-b")
+    assert "already claimed" in b.status.message
+    # The original key was not clobbered.
+    assert kube.get("Secret", "user-ssh-ada").data["authorized_keys"] == PUBKEY
+
+
+def test_devenv_with_chips_requests_tpu(harness):
+    kube, mgr = harness
+    env = DevEnv()
+    env.metadata.name = "env-debug"
+    env.spec.username = "alice"
+    env.spec.ssh_public_key = PUBKEY
+    env.spec.tpu_chips = 4
+    kube.create(env)
+    wait_ready(kube, mgr, "env-debug")
+    pod = kube.get("Pod", "devenv-alice")
+    assert pod.requests["google.com/tpu"] == 4

@@ -160,6 +160,8 @@ def test_untracked_kinds_unaffected_by_exceeded_quota(kube, spaces):
     kube.create(s)
     # Counted kinds whose own limits aren't set are also unaffected.
     kube.create(_job("j1", "ml-team"))
+    # Chip-less pods (devenv pods) don't gate on the exceeded chip limit.
+    kube.create(_pod("p-noTPU", "ml-team", chips=0))
     # But growing the over-limit resource stays blocked.
     with pytest.raises(ValidationError, match="exceeded quota"):
         kube.create(_pod("p2", "ml-team", chips=1))
