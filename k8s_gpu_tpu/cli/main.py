@@ -302,7 +302,11 @@ def cmd_devenv(args) -> int:
             p.settle()
             cur = p.kube.get("DevEnv", name, ctx.space)
             print(f"{name}\t{cur.status.phase}\tssh: {cur.status.ssh_endpoint}")
-            return 0 if cur.status.phase == "Ready" else 1
+            if cur.status.phase != "Ready":
+                if cur.status.message:
+                    print(f"error: {cur.status.message}", file=sys.stderr)
+                return 1
+            return 0
         if args.devenv_cmd == "list":
             print("NAME\tUSER\tPHASE\tSSH")
             for e in p.kube.list("DevEnv", namespace=ctx.space):

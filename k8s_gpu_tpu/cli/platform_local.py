@@ -67,8 +67,31 @@ def verify_token(token: str) -> dict:
     return issuer.verify(token, audience="tpu-cli")
 
 
+_log_handler_attached = False
+
+
+def _attach_log_shipping() -> None:
+    """Ship all framework logs into the global LogStore (the Fluent Bit →
+    Loki role, GPU调度平台搭建.md:798-800) exactly once per process."""
+    global _log_handler_attached
+    if _log_handler_attached:
+        return
+    import logging
+
+    from ..utils import LogStoreHandler, global_logstore
+
+    lg = logging.getLogger("k8s_gpu_tpu")
+    lg.addHandler(LogStoreHandler(global_logstore, {"component": "platform"}))
+    # INFO-level reconcile activity must reach the store even when the
+    # process's root logger stays at the default WARNING.
+    if lg.level == logging.NOTSET:
+        lg.setLevel(logging.INFO)
+    _log_handler_attached = True
+
+
 class LocalPlatform:
     def __init__(self):
+        _attach_log_shipping()
         self.root = state_dir()
         self.root.mkdir(parents=True, exist_ok=True)
         # Exclusive lock for the whole invocation: the state files are a
@@ -95,6 +118,14 @@ class LocalPlatform:
         from ..scheduling.queueing import QueueReconciler
 
         self.mgr.register("SchedulingQueue", QueueReconciler(self.kube))
+        from ..operators import ResourceGC
+
+        # GC sweeps all namespaces per pass; registering on both job and
+        # devenv kinds guarantees a trigger even in namespaces that only
+        # ever see one of them.
+        gc = ResourceGC(self.kube, keep_finished=20)
+        self.mgr.register("TrainJob", gc, name="gc")
+        self.mgr.register("DevEnv", gc, name="gc-devenv")
         self.mgr.start()
 
     # -- persistence -------------------------------------------------------

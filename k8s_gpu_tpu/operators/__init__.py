@@ -3,6 +3,7 @@ from .tpupodslice import TpuPodSliceReconciler
 from .trainjob import TrainJobReconciler
 from .autoscaler import SliceAutoscaler
 from .devenv import DevEnvReconciler
+from .gc import ResourceGC
 
 __all__ = [
     "AzureVmPoolReconciler",
@@ -10,4 +11,5 @@ __all__ = [
     "TrainJobReconciler",
     "SliceAutoscaler",
     "DevEnvReconciler",
+    "ResourceGC",
 ]

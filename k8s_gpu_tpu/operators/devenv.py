@@ -187,9 +187,16 @@ class DevEnvReconciler(Reconciler):
         return True
 
     def _teardown(self, env: DevEnv) -> Result:
-        """Pod + Secret go; the workspace PVC stays (persistence, :374-383)."""
+        """Pod + Secret go; the workspace PVC stays (persistence, :374-383).
+        Only objects this DevEnv owns (by label) are touched — deleting a
+        Failed duplicate must not destroy the rightful owner's environment."""
         for kind, name in (("Pod", pod_name(env)),
                            ("Secret", secret_name(env))):
+            obj = self.kube.try_get(kind, name, env.metadata.namespace)
+            if obj is None:
+                continue
+            if obj.metadata.labels.get("devenv") != env.metadata.name:
+                continue
             try:
                 self.kube.delete(kind, name, env.metadata.namespace)
             except NotFound:

@@ -1,0 +1,110 @@
+"""Garbage collection — the reference's housekeeping policy: "periodically
+clean up unused PVCs and completed training Jobs, keeping the most recent
+N records" (GPU调度平台搭建.md:806).
+
+``ResourceGC`` watches TrainJobs and, per namespace, (1) deletes finished
+jobs beyond the newest ``keep_finished`` (their finalizer releases worker
+pods), and (2) expires Events past ``event_ttl_s`` (the apiserver's event
+TTL role).  Workspace PVCs are deliberately NOT collected — the devenv
+contract is that workspaces persist (operators/devenv.py); only PVCs with
+the ``gc`` label opt in.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from ..controller.kubefake import FakeKube, NotFound
+from ..controller.manager import Reconciler, Request, Result
+from ..utils.metrics import MetricsRegistry, global_metrics
+
+log = logging.getLogger("k8s_gpu_tpu.operators.gc")
+
+GC_LABEL = "tpu.k8sgpu.dev/gc"  # opt-in for PVC collection
+
+_FINISHED = ("Succeeded", "Failed")
+
+
+class ResourceGC(Reconciler):
+    def __init__(
+        self,
+        kube: FakeKube,
+        keep_finished: int = 5,
+        event_ttl_s: float = 3600.0,
+        resync: float = 60.0,
+        metrics: MetricsRegistry | None = None,
+        now_fn=time.time,
+    ):
+        self.kube = kube
+        self.keep_finished = keep_finished
+        self.event_ttl_s = event_ttl_s
+        self.resync = resync
+        self.metrics = metrics or global_metrics
+        # Injectable *wall* clock: creation timestamps are time.time(), so
+        # utils.clock.Clock (monotonic) would compare incompatible scales.
+        self.now_fn = now_fn
+
+    def reconcile(self, req: Request) -> Result:
+        # Sweep every namespace, whatever kind/namespace triggered us: GC
+        # must cover namespaces whose own watched kind never fires (e.g. a
+        # devenv-only namespace accumulating Events).
+        namespaces: set[str] = set()
+        for kind in ("TrainJob", "Event", "PersistentVolumeClaim"):
+            namespaces.update(
+                o.metadata.namespace for o in self.kube.list(kind)
+            )
+        for ns in sorted(namespaces):
+            self._gc_jobs(ns)
+            self._gc_events(ns)
+            self._gc_opted_in_pvcs(ns)
+        return Result(requeue_after=self.resync)
+
+    def _gc_jobs(self, ns: str) -> None:
+        finished = [
+            j for j in self.kube.list("TrainJob", namespace=ns)
+            if j.status.phase in _FINISHED
+        ]
+        finished.sort(key=lambda j: j.status.completion_time, reverse=True)
+        for j in finished[self.keep_finished:]:
+            log.info("gc: pruning finished job %s/%s", ns, j.metadata.name)
+            try:
+                self.kube.delete("TrainJob", j.metadata.name, ns)
+            except NotFound:
+                continue
+            self.metrics.inc("gc_deleted_total", kind="TrainJob")
+
+    def _gc_events(self, ns: str) -> None:
+        cutoff = self.now_fn() - self.event_ttl_s
+        for e in self.kube.list("Event", namespace=ns):
+            if e.metadata.creation_timestamp < cutoff:
+                try:
+                    self.kube.delete("Event", e.metadata.name, ns)
+                except NotFound:
+                    continue
+                self.metrics.inc("gc_deleted_total", kind="Event")
+
+    def _gc_opted_in_pvcs(self, ns: str) -> None:
+        """Only PVCs labeled for GC and referenced by no live pod."""
+        pods = self.kube.list("Pod", namespace=ns)
+        in_use = {
+            src.split(":", 1)[1]
+            for p in pods
+            if p.phase in ("Pending", "Running")
+            # getattr: pods unpickled from pre-`mounts` platform state lack
+            # the attribute (dataclass default_factory leaves no class attr).
+            for src in getattr(p, "mounts", {}).values()
+            if src.startswith("pvc:")
+        }
+        for pvc in self.kube.list("PersistentVolumeClaim", namespace=ns):
+            if pvc.metadata.labels.get(GC_LABEL) != "true":
+                continue
+            if pvc.metadata.name in in_use:
+                continue
+            try:
+                self.kube.delete(
+                    "PersistentVolumeClaim", pvc.metadata.name, ns
+                )
+            except NotFound:
+                continue
+            self.metrics.inc("gc_deleted_total", kind="PersistentVolumeClaim")

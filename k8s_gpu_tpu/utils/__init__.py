@@ -1,4 +1,17 @@
 from .clock import Clock, RealClock, FakeClock
 from .metrics import MetricsRegistry, global_metrics
+from .logstore import LogEntry, LogStore, LogStoreHandler, global_logstore
+from .obs import MetricsServer
 
-__all__ = ["Clock", "RealClock", "FakeClock", "MetricsRegistry", "global_metrics"]
+__all__ = [
+    "Clock",
+    "RealClock",
+    "FakeClock",
+    "MetricsRegistry",
+    "global_metrics",
+    "LogEntry",
+    "LogStore",
+    "LogStoreHandler",
+    "global_logstore",
+    "MetricsServer",
+]

@@ -1,0 +1,121 @@
+"""Log aggregation — the stdout → Fluent Bit → Loki pipeline of the
+reference (GPU调度平台搭建.md:798-800: container stdout shipped to
+Loki/Elasticsearch, queried per job/pod from Grafana), in-process.
+
+``LogStore`` holds bounded label-indexed streams with Loki-style selector
+queries; ``LogStoreHandler`` is the Fluent Bit role — a ``logging.Handler``
+that ships every controller log record into the store, labeled by logger
+and level, so platform logs are queryable the way the reference's ops
+manual describes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    ts: float
+    line: str
+    labels: tuple  # sorted (key, value) pairs
+
+
+class LogStore:
+    """Bounded, label-indexed log streams.
+
+    A *stream* is a unique label set (Loki semantics).  Each stream keeps
+    the newest ``max_lines_per_stream`` entries; queries select streams by
+    exact label match and optionally filter by substring and time range.
+    """
+
+    def __init__(self, max_lines_per_stream: int = 10_000,
+                 max_streams: int = 1_000):
+        self._lock = threading.Lock()
+        self._streams: dict[tuple, deque[LogEntry]] = {}
+        self.max_lines_per_stream = max_lines_per_stream
+        self.max_streams = max_streams
+        self.dropped_streams = 0
+
+    @staticmethod
+    def _key(labels: dict[str, str]) -> tuple:
+        return tuple(sorted(labels.items()))
+
+    def push(self, labels: dict[str, str], line: str,
+             ts: float | None = None) -> None:
+        key = self._key(labels)
+        with self._lock:
+            stream = self._streams.get(key)
+            if stream is None:
+                if len(self._streams) >= self.max_streams:
+                    # Evict the stream with the oldest newest-entry (the
+                    # quietest one) instead of refusing new streams.
+                    victim = min(
+                        self._streams,
+                        key=lambda k: self._streams[k][-1].ts
+                        if self._streams[k] else 0,
+                    )
+                    del self._streams[victim]
+                    self.dropped_streams += 1
+                stream = self._streams[key] = deque(
+                    maxlen=self.max_lines_per_stream
+                )
+            stream.append(LogEntry(ts if ts is not None else time.time(),
+                                   line, key))
+
+    def query(
+        self,
+        selector: dict[str, str] | None = None,
+        contains: str = "",
+        since: float = 0.0,
+        limit: int = 1_000,
+    ) -> list[LogEntry]:
+        """Streams whose labels are a superset of *selector*, newest last."""
+        sel = (selector or {}).items()
+        out: list[LogEntry] = []
+        with self._lock:
+            for key, stream in self._streams.items():
+                labels = dict(key)
+                if not all(labels.get(k) == v for k, v in sel):
+                    continue
+                for e in stream:
+                    if e.ts < since:
+                        continue
+                    if contains and contains not in e.line:
+                        continue
+                    out.append(e)
+        out.sort(key=lambda e: e.ts)
+        return out[-limit:]
+
+    def streams(self) -> list[dict[str, str]]:
+        with self._lock:
+            return [dict(k) for k in self._streams]
+
+
+class LogStoreHandler(logging.Handler):
+    """The Fluent Bit role: ships log records into a LogStore, labeled by
+    logger name and level (+ any static labels, e.g. component/namespace)."""
+
+    def __init__(self, store: LogStore,
+                 static_labels: dict[str, str] | None = None):
+        super().__init__()
+        self.store = store
+        self.static_labels = dict(static_labels or {})
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            labels = {
+                "logger": record.name,
+                "level": record.levelname.lower(),
+                **self.static_labels,
+            }
+            self.store.push(labels, self.format(record), ts=record.created)
+        except Exception:  # a logging path must never raise into callers
+            self.handleError(record)
+
+
+global_logstore = LogStore()

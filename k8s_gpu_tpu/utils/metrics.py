@@ -90,6 +90,15 @@ class MetricsRegistry:
             for (name, labels), v in sorted(self._gauges.items()):
                 lines.append(f"{name}{_fmt(labels)} {v}")
             for (name, labels), h in sorted(self._hists.items()):
+                cum = 0
+                for b, c in zip(h.buckets, h.counts):
+                    cum += c
+                    lines.append(
+                        f"{name}_bucket{_fmt(labels + (('le', f'{b:g}'),))} {cum}"
+                    )
+                lines.append(
+                    f"{name}_bucket{_fmt(labels + (('le', '+Inf'),))} {h.n}"
+                )
                 lines.append(f"{name}_count{_fmt(labels)} {h.n}")
                 lines.append(f"{name}_sum{_fmt(labels)} {h.total}")
         return "\n".join(lines) + "\n"

@@ -1,0 +1,85 @@
+"""Observability HTTP surface — the Prometheus-scrape side of C32.
+
+The reference specifies Prometheus monitoring of GPU utilization, queue
+length and storage usage plus quota alerting (GPU调度平台搭建.md:798-807)
+but ships no endpoint.  Here the controller manager's metrics registry is
+served on a real ``/metrics`` endpoint (text exposition format) with
+``/healthz``/``/readyz`` probes — what a Prometheus in the cluster would
+scrape off this control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .metrics import MetricsRegistry, global_metrics
+
+
+class MetricsServer:
+    """Serves /metrics, /healthz, /readyz on a daemon thread.
+
+    ``port=0`` binds an ephemeral port (tests); ``.port`` is the bound one.
+    ``ready_check`` lets the owner gate readiness (e.g. manager started).
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ready_check=None,
+    ):
+        self.registry = registry or global_metrics
+        self.started_at = time.time()
+        self._ready_check = ready_check
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path == "/metrics":
+                    body = outer.registry.render().encode()
+                    self._send(200, body, "text/plain; version=0.0.4")
+                elif self.path == "/healthz":
+                    body = json.dumps(
+                        {"ok": True, "uptime_s": time.time() - outer.started_at}
+                    ).encode()
+                    self._send(200, body, "application/json")
+                elif self.path == "/readyz":
+                    ready = (
+                        outer._ready_check() if outer._ready_check else True
+                    )
+                    self._send(
+                        200 if ready else 503,
+                        json.dumps({"ready": bool(ready)}).encode(),
+                        "application/json",
+                    )
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-server", daemon=True
+        )
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)

@@ -137,6 +137,26 @@ def test_duplicate_username_rejected(harness):
     assert kube.get("Secret", "user-ssh-ada").data["authorized_keys"] == PUBKEY
 
 
+def test_deleting_failed_duplicate_preserves_owner(harness):
+    """Deleting the losing duplicate must not tear down the winner's
+    pod/secret (teardown honors the ownership label)."""
+    kube, mgr = harness
+    make_env(kube, name="env-a", user="ada")
+    assert mgr.wait_idle(
+        predicate=lambda: kube.get("DevEnv", "env-a").status.phase == "Ready"
+    )
+    make_env(kube, name="env-b", user="ada", key="ssh-ed25519 EVIL other")
+    assert mgr.wait_idle(
+        predicate=lambda: kube.get("DevEnv", "env-b").status.phase == "Failed"
+    )
+    kube.delete("DevEnv", "env-b")
+    assert mgr.wait_idle(
+        predicate=lambda: kube.try_get("DevEnv", "env-b") is None
+    )
+    assert kube.get("Pod", "devenv-ada") is not None
+    assert kube.get("Secret", "user-ssh-ada").data["authorized_keys"] == PUBKEY
+
+
 def test_devenv_with_chips_requests_tpu(harness):
     kube, mgr = harness
     env = DevEnv()

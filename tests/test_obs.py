@@ -1,0 +1,167 @@
+"""Observability (C32): /metrics endpoint, log aggregation, GC controller."""
+
+import json
+import logging
+import time
+import urllib.request
+
+import pytest
+
+from k8s_gpu_tpu.api import Event, PersistentVolumeClaim, Pod, TrainJob
+from k8s_gpu_tpu.controller import FakeKube
+from k8s_gpu_tpu.controller.manager import Request
+from k8s_gpu_tpu.operators import ResourceGC
+from k8s_gpu_tpu.operators.gc import GC_LABEL
+from k8s_gpu_tpu.utils import (
+    LogStore,
+    LogStoreHandler,
+    MetricsRegistry,
+    MetricsServer,
+)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.read().decode()
+
+
+# -- metrics endpoint -------------------------------------------------------
+
+def test_metrics_server_endpoints():
+    reg = MetricsRegistry()
+    reg.inc("reconcile_total", kind="TpuPodSlice", result="ok")
+    reg.observe("reconcile_duration_seconds", 0.02, kind="TpuPodSlice")
+    ready = {"ok": False}
+    srv = MetricsServer(reg, ready_check=lambda: ready["ok"]).start()
+    try:
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200
+        assert 'reconcile_total{kind="TpuPodSlice",result="ok"} 1.0' in body
+        # Histogram exposition: cumulative buckets + count + sum.
+        assert 'le="0.05"' in body
+        assert 'le="+Inf"' in body
+        assert "reconcile_duration_seconds_count" in body
+        code, body = _get(srv.port, "/healthz")
+        assert code == 200 and json.loads(body)["ok"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/readyz")
+        assert ei.value.code == 503
+        ready["ok"] = True
+        code, body = _get(srv.port, "/readyz")
+        assert code == 200
+        with pytest.raises(urllib.error.HTTPError):
+            _get(srv.port, "/nope")
+    finally:
+        srv.stop()
+
+
+# -- log store --------------------------------------------------------------
+
+def test_logstore_selector_query():
+    store = LogStore()
+    store.push({"job": "j1", "pod": "w0"}, "step 1 loss 2.3", ts=1.0)
+    store.push({"job": "j1", "pod": "w1"}, "step 1 loss 2.4", ts=2.0)
+    store.push({"job": "j2", "pod": "w0"}, "other", ts=3.0)
+    got = store.query({"job": "j1"})
+    assert [e.line for e in got] == ["step 1 loss 2.3", "step 1 loss 2.4"]
+    assert store.query({"job": "j1", "pod": "w1"})[0].line.endswith("2.4")
+    assert store.query(contains="loss", since=1.5)[0].line.endswith("2.4")
+    assert len(store.streams()) == 3
+
+
+def test_logstore_bounded():
+    store = LogStore(max_lines_per_stream=5, max_streams=2)
+    for i in range(10):
+        store.push({"s": "a"}, f"line {i}", ts=float(i))
+    assert [e.line for e in store.query({"s": "a"})] == [
+        f"line {i}" for i in range(5, 10)
+    ]
+    store.push({"s": "b"}, "b0", ts=20.0)
+    store.push({"s": "c"}, "c0", ts=21.0)  # evicts quietest stream (a)
+    assert store.dropped_streams == 1
+    assert len(store.streams()) == 2
+    assert store.query({"s": "c"})
+
+
+def test_logging_handler_ships_records():
+    store = LogStore()
+    handler = LogStoreHandler(store, {"component": "controller"})
+    lg = logging.getLogger("test.obs.ship")
+    lg.addHandler(handler)
+    lg.setLevel(logging.INFO)
+    try:
+        lg.info("reconciled %s", "demo")
+        lg.warning("requeue")
+    finally:
+        lg.removeHandler(handler)
+    assert [e.line for e in store.query({"level": "info"})] == [
+        "reconciled demo"
+    ]
+    got = store.query({"logger": "test.obs.ship", "component": "controller"})
+    assert len(got) == 2
+
+
+# -- GC ---------------------------------------------------------------------
+
+def _finished_job(kube, name, t, phase="Succeeded"):
+    j = TrainJob()
+    j.metadata.name = name
+    created = kube.create(j)
+    created.status.phase = phase
+    created.status.completion_time = t
+    kube.update_status(created)
+
+
+def test_gc_keeps_last_n_jobs(kube: FakeKube):
+    for i in range(8):
+        _finished_job(kube, f"job-{i}", t=float(i))
+    live = TrainJob()
+    live.metadata.name = "running"
+    kube.create(live)
+    ResourceGC(kube, keep_finished=3).reconcile(Request("default", "job-0"))
+    names = {j.metadata.name for j in kube.list("TrainJob")}
+    # Newest 3 finished jobs + the unfinished one survive.
+    assert names == {"job-5", "job-6", "job-7", "running"}
+
+
+def test_gc_expires_old_events(kube: FakeKube):
+    old = Event()
+    old.metadata.name = "old-ev"
+    kube.create(old)
+    # Deterministic wall clock: "now" is 2h after the event was stamped.
+    frozen_now = time.time() + 7200
+    gc = ResourceGC(kube, event_ttl_s=3600, now_fn=lambda: frozen_now)
+    fresh = Event()
+    fresh.metadata.name = "fresh-ev"
+    created = kube.create(fresh)
+    # fresh-ev is "30 min old" at frozen_now: nudge its stamp forward.
+    snap = kube.dump()
+    for obj in snap["store"].values():
+        if obj.metadata.name == "fresh-ev":
+            obj.metadata.creation_timestamp = frozen_now - 1800
+    kube.load(snap)
+    gc.reconcile(Request("default", "x"))
+    names = {e.metadata.name for e in kube.list("Event")}
+    assert names == {"fresh-ev"}
+
+
+def test_gc_pvc_opt_in_and_in_use(kube: FakeKube):
+    keep = PersistentVolumeClaim()
+    keep.metadata.name = "workspace-pvc"  # no GC label → never collected
+    kube.create(keep)
+    tagged = PersistentVolumeClaim()
+    tagged.metadata.name = "scratch"
+    tagged.metadata.labels[GC_LABEL] = "true"
+    kube.create(tagged)
+    used = PersistentVolumeClaim()
+    used.metadata.name = "scratch-used"
+    used.metadata.labels[GC_LABEL] = "true"
+    kube.create(used)
+    p = Pod()
+    p.metadata.name = "p1"
+    p.phase = "Running"
+    p.mounts = {"/scratch": "pvc:scratch-used"}
+    kube.create(p)
+    ResourceGC(kube).reconcile(Request("default", "x"))
+    names = {c.metadata.name for c in kube.list("PersistentVolumeClaim")}
+    assert names == {"workspace-pvc", "scratch-used"}
