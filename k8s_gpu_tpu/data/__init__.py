@@ -1,0 +1,5 @@
+"""Host-side data pipeline: native tokenized-batch loader + Python fallback."""
+
+from .loader import TokenLoader, native_available, write_tokens
+
+__all__ = ["TokenLoader", "native_available", "write_tokens"]

@@ -1,0 +1,215 @@
+"""Tokenized-batch loader: ctypes binding over the native C++ prefetcher
+(native/dataloader.cc), with a bit-exact pure-Python fallback.
+
+The reference feeds training from torchvision's DataLoader inside the pod
+(reference GPU调度平台搭建.md:584-604).  Here the loader is framework-level:
+each JAX process (host) opens the same flat int32 token file with its own
+``shard=(process_index, process_count)`` and sees only its data-parallel
+shard — the host-side half of SPMD data parallelism, with the device-side
+half being the trainer's ``P('dp')`` batch sharding.
+
+Both backends draw the same splitmix64 Fisher-Yates permutation per epoch,
+so a run is reproducible regardless of which backend (or how many prefetch
+threads) served it; tests assert batch-for-batch parity.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_MASK = (1 << 64) - 1
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libk8sgputpu.so"
+
+_lib = None
+_lib_tried = False
+
+
+def _load_native():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        if not _LIB_PATH.exists():
+            subprocess.run(
+                ["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                capture_output=True, timeout=120,
+            )
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.dl_open.restype = ctypes.c_void_p
+        lib.dl_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.dl_next_batch.restype = ctypes.c_int64
+        lib.dl_next_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.dl_num_local_samples.restype = ctypes.c_uint64
+        lib.dl_num_local_samples.argtypes = [ctypes.c_void_p]
+        lib.dl_batches_per_epoch.restype = ctypes.c_uint64
+        lib.dl_batches_per_epoch.argtypes = [ctypes.c_void_p]
+        lib.dl_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+def write_tokens(path: str | Path, tokens) -> Path:
+    """Write a flat little-endian int32 token file (the loader's format)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.asarray(tokens, dtype="<i4").tofile(path)
+    return path
+
+
+def _splitmix64(state: int) -> tuple[int, int]:
+    state = (state + 0x9E3779B97F4A7C15) & _MASK
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+    return state, z ^ (z >> 31)
+
+
+def epoch_permutation(n: int, seed: int, epoch: int) -> np.ndarray:
+    """The exact permutation native/dataloader.cc::epoch_perm computes."""
+    perm = np.arange(n, dtype=np.uint64)
+    state = (seed ^ ((epoch * 0xD1B54A32D192ED03 + 1) & _MASK)) & _MASK
+    for i in range(n - 1, 0, -1):
+        state, r = _splitmix64(state)
+        j = r % (i + 1)
+        perm[i], perm[j] = perm[j], perm[i]
+    return perm
+
+
+class TokenLoader:
+    """Iterates (inputs, targets) int32 batches of shape (batch, seq_len).
+
+    backend: 'auto' (native if buildable, else python), 'native', 'python'.
+    shard: (shard_id, num_shards) — this host's slice of the sample space.
+    """
+
+    def __init__(
+        self,
+        path: str | Path,
+        seq_len: int,
+        batch_size: int,
+        shard: tuple[int, int] = (0, 1),
+        seed: int = 0,
+        shuffle: bool = True,
+        backend: str = "auto",
+        prefetch_depth: int = 4,
+        n_threads: int = 2,
+    ):
+        self.path = Path(path)
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self.shard_id, self.num_shards = shard
+        self.seed = seed
+        self.shuffle = shuffle
+        self._handle = None
+        self._epoch = 0
+        self._cursor = 0
+
+        n_tokens = self.path.stat().st_size // 4
+        n_samples = n_tokens // (seq_len + 1)
+        self.num_local = max(
+            0, (n_samples - self.shard_id + self.num_shards - 1) // self.num_shards
+        )
+        self.batches_per_epoch = self.num_local // batch_size
+        if self.batches_per_epoch == 0:
+            raise ValueError(
+                f"shard {shard} has {self.num_local} samples < one batch "
+                f"of {batch_size}"
+            )
+
+        if backend == "auto":
+            backend = "native" if native_available() else "python"
+        if backend == "native":
+            lib = _load_native()
+            if lib is None:
+                raise RuntimeError("native loader unavailable (build failed?)")
+            self._handle = lib.dl_open(
+                os.fsencode(str(self.path)), seq_len, batch_size,
+                self.shard_id, self.num_shards, seed, int(shuffle),
+                prefetch_depth, n_threads,
+            )
+            if not self._handle:
+                raise RuntimeError(f"dl_open failed for {self.path}")
+            self._lib = lib
+        else:
+            # Python fallback: mmapped random access, same permutation.
+            self._mm = np.memmap(self.path, dtype="<i4", mode="r")
+            self._perm = None
+        self.backend = backend
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> tuple[np.ndarray, np.ndarray]:
+        w = self.seq_len + 1
+        if self._handle is not None:
+            buf = np.empty(self.batch_size * w, dtype=np.int32)
+            epoch = self._lib.dl_next_batch(
+                self._handle, buf.ctypes.data_as(ctypes.c_void_p)
+            )
+            if epoch < 0:
+                raise StopIteration
+            self._epoch = int(epoch)
+            full = buf.reshape(self.batch_size, w)
+        else:
+            if self._cursor == 0 and self.shuffle:
+                self._perm = epoch_permutation(
+                    self.num_local, self.seed, self._epoch
+                )
+            b = self._cursor
+            rows = np.arange(
+                b * self.batch_size, (b + 1) * self.batch_size, dtype=np.uint64
+            )
+            if self.shuffle:
+                rows = self._perm[rows]
+            global_rows = rows * np.uint64(self.num_shards) + np.uint64(
+                self.shard_id
+            )
+            full = np.stack(
+                [self._mm[int(g) * w : (int(g) + 1) * w] for g in global_rows]
+            )
+            self._cursor += 1
+            if self._cursor >= self.batches_per_epoch:
+                self._cursor = 0
+                self._epoch += 1
+        return full[:, :-1].copy(), full[:, 1:].copy()
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dl_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

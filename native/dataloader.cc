@@ -1,0 +1,260 @@
+// Native tokenized-batch loader for the training runner.
+//
+// The reference's data path is torchvision's FashionMNIST DataLoader inside
+// the training pod (reference GPU调度平台搭建.md:584-604) — host-side, Python,
+// per-worker. The TPU-native equivalent keeps the host CPU out of the step
+// path: an mmapped flat token file, per-host sharding (each JAX process loads
+// only its data-parallel shard), deterministic epoch shuffling, and
+// background producer threads that keep a bounded ring of ready batches so
+// the device never waits on Python.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment). The
+// Python fallback in k8s_gpu_tpu/data/loader.py mirrors the splitmix64 +
+// Fisher-Yates stream bit-for-bit; tests assert batch parity.
+//
+// File format: little-endian int32 tokens, no header. Sample i is the
+// half-open token window [i*(seq_len+1), (i+1)*(seq_len+1)); the trailing
+// partial window is dropped. Host `shard_id` of `num_shards` owns samples
+// with index % num_shards == shard_id.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t* state) {
+  *state += 0x9E3779B97F4A7C15ULL;
+  uint64_t z = *state;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// Deterministic permutation of [0, n) for (seed, epoch). The Python
+// fallback reimplements exactly this.
+void epoch_perm(std::vector<uint64_t>& perm, uint64_t n, uint64_t seed,
+                uint64_t epoch) {
+  perm.resize(n);
+  for (uint64_t i = 0; i < n; ++i) perm[i] = i;
+  uint64_t state = seed ^ (epoch * 0xD1B54A32D192ED03ULL + 1);
+  for (uint64_t i = n - 1; i >= 1; --i) {
+    uint64_t j = splitmix64(&state) % (i + 1);
+    std::swap(perm[i], perm[j]);
+  }
+}
+
+enum SlotState : int { kEmpty = 0, kFilling = 1, kFull = 2 };
+
+struct Slot {
+  std::vector<int32_t> data;
+  int state = kEmpty;
+  uint64_t batch_index = 0;
+};
+
+struct Loader {
+  // Immutable after open.
+  int fd = -1;
+  const int32_t* tokens = nullptr;
+  size_t map_bytes = 0;
+  uint64_t seq_len = 0;       // sample width is seq_len + 1
+  uint64_t batch = 0;
+  uint64_t num_local = 0;     // samples owned by this shard
+  uint64_t shard_id = 0, num_shards = 1;
+  uint64_t seed = 0;
+  bool shuffle = true;
+  uint64_t batches_per_epoch = 0;
+
+  // Prefetch machinery.
+  std::vector<Slot> ring;
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  uint64_t next_to_claim = 0;    // producers claim batch indices from here
+  uint64_t next_to_consume = 0;  // consumer reads in index order
+  bool stopping = false;
+  std::vector<std::thread> workers;
+
+  // Permutations are epoch-keyed and shared_ptr-held: a producer still
+  // filling from epoch e must keep its perm alive while faster producers
+  // have already moved the cache on to e+1, e+2, ...
+  std::mutex perm_mu;
+  std::map<uint64_t, std::shared_ptr<const std::vector<uint64_t>>> perm_cache;
+
+  std::shared_ptr<const std::vector<uint64_t>> perm_for(uint64_t epoch) {
+    std::lock_guard<std::mutex> lk(perm_mu);
+    auto it = perm_cache.find(epoch);
+    if (it != perm_cache.end()) return it->second;
+    auto p = std::make_shared<std::vector<uint64_t>>();
+    epoch_perm(*p, num_local, seed, epoch);
+    perm_cache[epoch] = p;
+    while (perm_cache.size() > 4) perm_cache.erase(perm_cache.begin());
+    return perm_cache[epoch];
+  }
+
+  // sample -> global index in the token file
+  inline uint64_t global_sample(uint64_t local_idx) const {
+    return local_idx * num_shards + shard_id;
+  }
+
+  void fill_batch(uint64_t batch_index, int32_t* out) {
+    const uint64_t epoch = batch_index / batches_per_epoch;
+    const uint64_t b = batch_index % batches_per_epoch;
+    const uint64_t width = seq_len + 1;
+    std::shared_ptr<const std::vector<uint64_t>> perm;
+    if (shuffle) perm = perm_for(epoch);
+    for (uint64_t r = 0; r < batch; ++r) {
+      uint64_t local = b * batch + r;
+      if (shuffle) local = (*perm)[local];
+      const uint64_t g = global_sample(local);
+      std::memcpy(out + r * width, tokens + g * width,
+                  width * sizeof(int32_t));
+    }
+  }
+
+  void worker() {
+    for (;;) {
+      uint64_t idx = 0;
+      Slot* slot = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        // idx/slot must be re-read on every wake: another producer may
+        // have claimed the index this thread was waiting on.
+        cv_produce.wait(lk, [&] {
+          if (stopping) return true;
+          idx = next_to_claim;
+          slot = &ring[idx % ring.size()];
+          return slot->state == kEmpty;
+        });
+        if (stopping) return;
+        slot->state = kFilling;
+        slot->batch_index = idx;
+        next_to_claim++;
+      }
+      fill_batch(idx, slot->data.data());
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        slot->state = kFull;
+      }
+      cv_consume.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns a handle, or null on error. Errors: unopenable file, or fewer
+// local samples than one batch.
+void* dl_open(const char* path, uint64_t seq_len, uint64_t batch,
+              uint64_t shard_id, uint64_t num_shards, uint64_t seed,
+              int shuffle, uint64_t prefetch_depth, uint64_t n_threads) {
+  if (seq_len == 0 || batch == 0 || num_shards == 0 || shard_id >= num_shards)
+    return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* L = new Loader();
+  L->fd = fd;
+  L->map_bytes = static_cast<size_t>(st.st_size);
+  void* m = mmap(nullptr, L->map_bytes, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (m == MAP_FAILED) {
+    ::close(fd);
+    delete L;
+    return nullptr;
+  }
+  madvise(m, L->map_bytes, MADV_WILLNEED);
+  L->tokens = static_cast<const int32_t*>(m);
+  L->seq_len = seq_len;
+  L->batch = batch;
+  L->shard_id = shard_id;
+  L->num_shards = num_shards;
+  L->seed = seed;
+  L->shuffle = shuffle != 0;
+
+  const uint64_t n_tokens = L->map_bytes / sizeof(int32_t);
+  const uint64_t n_samples = n_tokens / (seq_len + 1);
+  // Shard s owns ceil((n_samples - s) / num_shards) samples.
+  L->num_local =
+      n_samples > shard_id ? (n_samples - shard_id + num_shards - 1) / num_shards
+                           : 0;
+  L->batches_per_epoch = L->num_local / batch;  // drop-last
+  if (L->batches_per_epoch == 0) {
+    munmap(const_cast<int32_t*>(L->tokens), L->map_bytes);
+    ::close(fd);
+    delete L;
+    return nullptr;
+  }
+
+  if (prefetch_depth == 0) prefetch_depth = 4;
+  if (n_threads == 0) n_threads = 2;
+  if (n_threads > prefetch_depth) n_threads = prefetch_depth;
+  L->ring.resize(prefetch_depth);
+  for (auto& s : L->ring) s.data.resize(batch * (seq_len + 1));
+  for (uint64_t t = 0; t < n_threads; ++t)
+    L->workers.emplace_back(&Loader::worker, L);
+  return L;
+}
+
+uint64_t dl_num_local_samples(void* h) {
+  return static_cast<Loader*>(h)->num_local;
+}
+
+uint64_t dl_batches_per_epoch(void* h) {
+  return static_cast<Loader*>(h)->batches_per_epoch;
+}
+
+// Blocks until the next batch is ready, copies batch*(seq_len+1) int32s
+// into `out`, and returns the epoch the batch belongs to.
+int64_t dl_next_batch(void* h, int32_t* out) {
+  auto* L = static_cast<Loader*>(h);
+  const uint64_t idx = L->next_to_consume;
+  Slot* slot = &L->ring[idx % L->ring.size()];
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_consume.wait(lk, [&] {
+      return L->stopping ||
+             (slot->state == kFull && slot->batch_index == idx);
+    });
+    if (L->stopping) return -1;
+  }
+  std::memcpy(out, slot->data.data(),
+              slot->data.size() * sizeof(int32_t));
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    slot->state = kEmpty;
+    L->next_to_consume++;
+  }
+  L->cv_produce.notify_all();
+  return static_cast<int64_t>(idx / L->batches_per_epoch);
+}
+
+void dl_close(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stopping = true;
+  }
+  L->cv_produce.notify_all();
+  L->cv_consume.notify_all();
+  for (auto& t : L->workers) t.join();
+  munmap(const_cast<int32_t*>(L->tokens), L->map_bytes);
+  ::close(L->fd);
+  delete L;
+}
+
+}  // extern "C"

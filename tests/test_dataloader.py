@@ -1,0 +1,99 @@
+"""Native C++ data loader vs the bit-exact Python fallback (SURVEY §7;
+the reference's in-pod DataLoader role, GPU调度平台搭建.md:584-604)."""
+
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.data import TokenLoader, native_available, write_tokens
+from k8s_gpu_tpu.data.loader import epoch_permutation
+
+SEQ = 8
+BATCH = 4
+
+
+@pytest.fixture
+def token_file(tmp_path):
+    # 40 samples of width SEQ+1 = 360 tokens, values = their index.
+    return write_tokens(tmp_path / "toks.bin", np.arange(40 * (SEQ + 1)))
+
+
+def collect(loader, n):
+    out = []
+    for _ in range(n):
+        x, y = next(loader)
+        out.append((x.copy(), y.copy()))
+    return out
+
+
+def test_python_backend_shapes_and_shift(token_file):
+    with TokenLoader(token_file, SEQ, BATCH, backend="python",
+                     shuffle=False) as dl:
+        x, y = next(dl)
+        assert x.shape == (BATCH, SEQ) and y.shape == (BATCH, SEQ)
+        # Targets are inputs shifted by one within each sample window.
+        assert (y[:, :-1] == x[:, 1:]).all()
+        assert x[0, 0] == 0 and x[1, 0] == SEQ + 1
+
+
+def test_drop_last_and_epoch_rollover(token_file):
+    # 40 samples / batch 4 = 10 batches per epoch.
+    with TokenLoader(token_file, SEQ, BATCH, backend="python",
+                     shuffle=False) as dl:
+        assert dl.batches_per_epoch == 10
+        collect(dl, 10)
+        assert dl.epoch == 1
+
+
+def test_sharding_partitions_samples(token_file):
+    seen = set()
+    for sid in range(2):
+        with TokenLoader(token_file, SEQ, BATCH, shard=(sid, 2),
+                         backend="python", shuffle=False) as dl:
+            assert dl.num_local == 20
+            for x, _ in collect(dl, dl.batches_per_epoch):
+                seen.update(int(v) for v in x[:, 0])
+    # Every sample's first token appears exactly once across both shards.
+    assert seen == {i * (SEQ + 1) for i in range(40)}
+
+
+def test_shuffle_deterministic_and_epoch_varying():
+    p0 = epoch_permutation(16, seed=7, epoch=0)
+    p0b = epoch_permutation(16, seed=7, epoch=0)
+    p1 = epoch_permutation(16, seed=7, epoch=1)
+    q0 = epoch_permutation(16, seed=8, epoch=0)
+    assert (p0 == p0b).all()
+    assert not (p0 == p1).all()
+    assert not (p0 == q0).all()
+    assert sorted(p0.tolist()) == list(range(16))
+
+
+@pytest.mark.skipif(not native_available(), reason="native lib not buildable")
+def test_native_matches_python_exactly(token_file):
+    n_batches = 25  # crosses 2 epoch boundaries (10 per epoch)
+    with TokenLoader(token_file, SEQ, BATCH, backend="python", seed=42) as py:
+        ref = collect(py, n_batches)
+    with TokenLoader(token_file, SEQ, BATCH, backend="native", seed=42,
+                     prefetch_depth=4, n_threads=3) as nat:
+        got = collect(nat, n_batches)
+    for (rx, ry), (gx, gy) in zip(ref, got):
+        np.testing.assert_array_equal(rx, gx)
+        np.testing.assert_array_equal(ry, gy)
+
+
+@pytest.mark.skipif(not native_available(), reason="native lib not buildable")
+def test_native_sharded_shuffled_parity(token_file):
+    for sid in range(2):
+        with TokenLoader(token_file, SEQ, BATCH, shard=(sid, 2),
+                         backend="python", seed=3) as py:
+            ref = collect(py, 12)
+        with TokenLoader(token_file, SEQ, BATCH, shard=(sid, 2),
+                         backend="native", seed=3) as nat:
+            got = collect(nat, 12)
+        for (rx, _), (gx, _) in zip(ref, got):
+            np.testing.assert_array_equal(rx, gx)
+
+
+def test_too_small_shard_raises(tmp_path):
+    f = write_tokens(tmp_path / "tiny.bin", np.arange(2 * (SEQ + 1)))
+    with pytest.raises(ValueError):
+        TokenLoader(f, SEQ, BATCH, backend="python")
