@@ -327,6 +327,71 @@ def cmd_devenv(args) -> int:
         p.close()
 
 
+def cmd_obs(args) -> int:
+    """Observability surface (C32): query persisted platform logs (the
+    Loki role), dump the last metrics exposition, or serve /metrics."""
+    import json
+
+    from .platform_local import state_dir
+
+    _require_login(CliConfig.load())
+    if args.obs_cmd == "logs":
+        logfile = state_dir() / "logs.jsonl"
+        if not logfile.exists():
+            print("no logs persisted yet", file=sys.stderr)
+            return 1
+        selector = {}
+        for kv in args.selector or []:
+            if "=" not in kv:
+                print(f"bad selector {kv!r}: expected key=value", file=sys.stderr)
+                return 2
+            k, v = kv.split("=", 1)
+            selector[k] = v
+        # Hydrate a LogStore so selector/contains/tail semantics are the
+        # single implementation in utils/logstore.py.
+        from ..utils import LogStore
+
+        store = LogStore()
+        for raw in logfile.read_text().splitlines():
+            e = json.loads(raw)
+            store.push(e.get("labels", {}), e["line"], ts=e["ts"])
+        if args.tail <= 0:
+            return 0
+        for e in store.query(selector, contains=args.contains, limit=args.tail):
+            lvl = dict(e.labels).get("level", "?")
+            print(f"{time.strftime('%H:%M:%S', time.localtime(e.ts))} "
+                  f"[{lvl}] {e.line}")
+        return 0
+    if args.obs_cmd == "metrics":
+        prom = state_dir() / "metrics.prom"
+        if not prom.exists():
+            print("no metrics snapshot yet", file=sys.stderr)
+            return 1
+        print(prom.read_text(), end="")
+        return 0
+    if args.obs_cmd == "serve":
+        from ..utils.obs import MetricsServer
+
+        # Boot the platform to refresh state/metrics, then RELEASE it before
+        # serving: holding its exclusive lock for the serve duration would
+        # block every other CLI invocation.  The endpoint serves this
+        # process's metrics registry (a snapshot after close).
+        p = LocalPlatform()
+        p.settle()
+        p.close()
+        srv = MetricsServer(port=args.port).start()
+        print(f"serving /metrics /healthz /readyz on :{srv.port}")
+        deadline = time.monotonic() + args.for_seconds if args.for_seconds else None
+        try:
+            while deadline is None or time.monotonic() < deadline:
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            pass
+        srv.stop()
+        return 0
+    return 1
+
+
 # -- parser ----------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -407,6 +472,20 @@ def build_parser() -> argparse.ArgumentParser:
     p_ai.add_argument("--id", required=True)
     p_ai.add_argument("--path", required=True)
     p_asset.set_defaults(fn=cmd_asset)
+
+    p_obs = sub.add_parser("obs", help="platform logs and metrics")
+    obs_sub = p_obs.add_subparsers(dest="obs_cmd", required=True)
+    p_ol = obs_sub.add_parser("logs")
+    p_ol.add_argument("--tail", type=int, default=50)
+    p_ol.add_argument("--contains", default="")
+    p_ol.add_argument("-l", "--selector", action="append",
+                      help="label filter key=value (repeatable)")
+    obs_sub.add_parser("metrics")
+    p_os = obs_sub.add_parser("serve")
+    p_os.add_argument("--port", type=int, default=0)
+    p_os.add_argument("--for-seconds", type=float, default=0.0,
+                      help="exit after N seconds (0 = until interrupted)")
+    p_obs.set_defaults(fn=cmd_obs)
 
     return ap
 

@@ -68,6 +68,7 @@ def verify_token(token: str) -> dict:
 
 
 _log_handler_attached = False
+_logs_persisted_until = 0.0
 
 
 def _attach_log_shipping() -> None:
@@ -120,12 +121,10 @@ class LocalPlatform:
         self.mgr.register("SchedulingQueue", QueueReconciler(self.kube))
         from ..operators import ResourceGC
 
-        # GC sweeps all namespaces per pass; registering on both job and
-        # devenv kinds guarantees a trigger even in namespaces that only
-        # ever see one of them.
-        gc = ResourceGC(self.kube, keep_finished=20)
-        self.mgr.register("TrainJob", gc, name="gc")
-        self.mgr.register("DevEnv", gc, name="gc-devenv")
+        # GC watches '*': any kind's churn (slices and VM pools emit Events
+        # too) triggers a sweep, and the in-reconciler debounce collapses
+        # the startup replay storm to one sweep.
+        self.mgr.register("*", ResourceGC(self.kube, keep_finished=20), name="gc")
         self.mgr.start()
 
     # -- persistence -------------------------------------------------------
@@ -154,10 +153,49 @@ class LocalPlatform:
         (self.root / "cloud.pkl").write_bytes(
             pickle.dumps(self.cloud.queued_resources)
         )
+        self._persist_observability()
         import fcntl
 
         fcntl.flock(self._lockfile, fcntl.LOCK_UN)
         self._lockfile.close()
+
+    MAX_PERSISTED_LOG_LINES = 10_000
+
+    def _persist_observability(self) -> None:
+        """Durable half of the Loki/Prometheus role (C32): each invocation
+        appends its shipped logs to logs.jsonl (bounded) and snapshots the
+        metrics exposition, so `obs logs` / `obs metrics` can query the
+        platform's history from a later process."""
+        import json
+
+        from ..utils import global_logstore
+        from ..utils.metrics import global_metrics
+
+        logfile = self.root / "logs.jsonl"
+        lines = []
+        if logfile.exists():
+            lines = logfile.read_text().splitlines()
+        # High-water mark so multiple platform sessions in one process
+        # (tests) don't re-append the same entries.  Strictly-greater
+        # filter: adding an epsilon to a time.time()-magnitude float is a
+        # no-op (ulp ≈ 2.4e-7), which would re-persist the last entry.
+        global _logs_persisted_until
+        entries = [
+            e
+            for e in global_logstore.query(limit=self.MAX_PERSISTED_LOG_LINES)
+            if e.ts > _logs_persisted_until
+        ]
+        for e in entries:
+            lines.append(
+                json.dumps({"ts": e.ts, "line": e.line, "labels": dict(e.labels)})
+            )
+        if entries:
+            _logs_persisted_until = entries[-1].ts
+        logfile.write_text(
+            "\n".join(lines[-self.MAX_PERSISTED_LOG_LINES:]) + "\n"
+            if lines else ""
+        )
+        (self.root / "metrics.prom").write_text(global_metrics.render())
 
     # -- verbs -------------------------------------------------------------
     def settle(self, predicate=None, timeout: float = 60.0) -> bool:

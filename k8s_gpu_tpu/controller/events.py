@@ -1,13 +1,19 @@
 """Event recorder — K8s Events on resource create/delete (the hardening item
-the reference lists at README.md:311)."""
+the reference lists at README.md:311).  Every event is also a structured
+log line (the reference's log-every-reconcile-step contract,
+README.md:171-232), so the log pipeline (utils/logstore.py, `obs logs`)
+carries the same operator-activity stream `kubectl describe` would show."""
 
 from __future__ import annotations
 
+import logging
 import uuid
 
 from ..api.core import Event
 from ..api.types import CustomResource
 from .kubefake import FakeKube
+
+log = logging.getLogger("k8s_gpu_tpu.controller.events")
 
 
 class EventRecorder:
@@ -18,6 +24,10 @@ class EventRecorder:
     def event(
         self, obj: CustomResource, etype: str, reason: str, message: str
     ) -> None:
+        (log.warning if etype == "Warning" else log.info)(
+            "%s %s/%s %s: %s", obj.kind, obj.metadata.namespace,
+            obj.metadata.name, reason, message,
+        )
         ev = Event(
             involved_kind=obj.kind,
             involved_name=obj.metadata.name,

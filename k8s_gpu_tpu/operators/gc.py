@@ -13,6 +13,7 @@ the ``gc`` label opt in.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 
 from ..controller.kubefake import FakeKube, NotFound
@@ -35,6 +36,7 @@ class ResourceGC(Reconciler):
         resync: float = 60.0,
         metrics: MetricsRegistry | None = None,
         now_fn=time.time,
+        min_sweep_interval: float | None = None,
     ):
         self.kube = kube
         self.keep_finished = keep_finished
@@ -44,11 +46,26 @@ class ResourceGC(Reconciler):
         # Injectable *wall* clock: creation timestamps are time.time(), so
         # utils.clock.Clock (monotonic) would compare incompatible scales.
         self.now_fn = now_fn
+        # Debounce: watch replay at manager start delivers one event per
+        # existing object, and each sweep is global — one per interval is
+        # enough.  Pass min_sweep_interval=0 to disable (tests that sweep
+        # repeatedly under a frozen clock).
+        self.min_sweep_interval = (
+            min(5.0, resync / 4) if min_sweep_interval is None
+            else min_sweep_interval
+        )
+        self._last_sweep = float("-inf")
+        self._sweep_lock = threading.Lock()
 
     def reconcile(self, req: Request) -> Result:
         # Sweep every namespace, whatever kind/namespace triggered us: GC
         # must cover namespaces whose own watched kind never fires (e.g. a
         # devenv-only namespace accumulating Events).
+        now = self.now_fn()
+        with self._sweep_lock:
+            if now - self._last_sweep < self.min_sweep_interval:
+                return Result(requeue_after=self.resync)
+            self._last_sweep = now
         namespaces: set[str] = set()
         for kind in ("TrainJob", "Event", "PersistentVolumeClaim"):
             namespaces.update(
@@ -64,6 +81,9 @@ class ResourceGC(Reconciler):
         finished = [
             j for j in self.kube.list("TrainJob", namespace=ns)
             if j.status.phase in _FINISHED
+            # Already-deleting jobs linger until their finalizer clears;
+            # re-deleting would double-count gc_deleted_total every sweep.
+            and j.metadata.deletion_timestamp is None
         ]
         finished.sort(key=lambda j: j.status.completion_time, reverse=True)
         for j in finished[self.keep_finished:]:

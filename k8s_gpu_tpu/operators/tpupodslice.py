@@ -56,6 +56,7 @@ class TpuPodSliceReconciler(Reconciler):
         self.recorder = EventRecorder(kube, "tpupodslice-controller")
         self.metrics = metrics or global_metrics
         self.provision_poll = provision_poll
+        self._last_phase: dict[tuple[str, str], str] = {}
 
     @staticmethod
     def tags_for(ps: TpuPodSlice) -> dict[str, str]:
@@ -77,6 +78,9 @@ class TpuPodSliceReconciler(Reconciler):
     def reconcile(self, req: Request) -> Result:
         ps = self.kube.try_get("TpuPodSlice", req.name, req.namespace)
         if ps is None:
+            # Drop phase-transition memory so a recreated slice with the
+            # same name logs its transitions from scratch.
+            self._last_phase.pop((req.namespace, req.name), None)
             return Result()
 
         if ps.metadata.deletion_timestamp is not None:
@@ -338,6 +342,15 @@ class TpuPodSliceReconciler(Reconciler):
         self.metrics.inc("reconcile_errors_total", kind="TpuPodSlice", reason=reason)
 
     def _update_status(self, ps: TpuPodSlice) -> None:
+        key = (ps.metadata.namespace, ps.metadata.name)
+        prev = self._last_phase.get(key)
+        if ps.status.phase != prev:
+            log.info(
+                "podslice %s/%s: %s -> %s (%d/%d slices ready)",
+                ps.metadata.namespace, ps.metadata.name, prev or "∅",
+                ps.status.phase, ps.status.ready_replicas, ps.spec.slice_count,
+            )
+            self._last_phase[key] = ps.status.phase
         try:
             self.kube.update_status(ps)
         except (Conflict, NotFound):

@@ -128,3 +128,16 @@ def test_devenv_flow(tmp_path, capsys):
     # Creating without a key for a new env is a usage error.
     code, _, err = run(capsys, "devenv", "create", "--name", "env-2")
     assert code == 2 and "pubkey" in err
+
+
+def test_obs_logs_and_metrics(capsys):
+    run(capsys, "login", "--user", "ada")
+    # Drive the platform so reconcile logs/metrics are generated+persisted.
+    code, out, _ = run(capsys, "pool", "apply", "p1", "--accelerator", "v4-8")
+    assert code == 0
+    code, out, _ = run(capsys, "obs", "logs", "--tail", "200")
+    assert code == 0 and "p1" in out
+    code, out, _ = run(capsys, "obs", "logs", "-l", "level=info")
+    assert code == 0
+    code, out, _ = run(capsys, "obs", "metrics")
+    assert code == 0 and "reconcile_total" in out

@@ -165,3 +165,38 @@ def test_gc_pvc_opt_in_and_in_use(kube: FakeKube):
     ResourceGC(kube).reconcile(Request("default", "x"))
     names = {c.metadata.name for c in kube.list("PersistentVolumeClaim")}
     assert names == {"workspace-pvc", "scratch-used"}
+
+
+def test_gc_debounce_collapses_event_storm(kube: FakeKube):
+    """Startup watch replay delivers one event per object; only one global
+    sweep should run per interval (review finding: N redundant sweeps)."""
+    frozen = [1000.0]
+    gc = ResourceGC(kube, keep_finished=0, now_fn=lambda: frozen[0])
+    for i in range(4):
+        _finished_job(kube, f"j{i}", t=float(i))
+    gc.reconcile(Request("default", "j0"))
+    assert kube.list("TrainJob") == []
+    # Second trigger inside the debounce window: no sweep (new finished job
+    # survives until the interval elapses).
+    _finished_job(kube, "late", t=9.0)
+    gc.reconcile(Request("default", "late"))
+    assert {j.metadata.name for j in kube.list("TrainJob")} == {"late"}
+    frozen[0] += gc.min_sweep_interval + 1
+    gc.reconcile(Request("default", "late"))
+    assert kube.list("TrainJob") == []
+
+
+def test_gc_skips_already_deleting_jobs(kube: FakeKube):
+    """Jobs held by a finalizer must not be re-deleted/re-counted."""
+    from k8s_gpu_tpu.utils.metrics import MetricsRegistry
+
+    _finished_job(kube, "old", t=1.0)
+    held = kube.get("TrainJob", "old")
+    held.metadata.finalizers.append("test/hold")
+    kube.update(held)
+    m = MetricsRegistry()
+    gc = ResourceGC(kube, keep_finished=0, metrics=m, min_sweep_interval=0.0)
+    gc.reconcile(Request("default", "old"))
+    gc.reconcile(Request("default", "old"))
+    # Deleted once; second sweep sees deletion_timestamp and skips.
+    assert m.counter("gc_deleted_total", kind="TrainJob") == 1
