@@ -70,6 +70,41 @@ class Pod(CustomResource):
 
 
 @dataclass
+class DeploymentSpec:
+    image: str = ""
+    replicas: int = 1
+    command: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class DeploymentStatus:
+    ready_replicas: int = 0
+
+
+@dataclass
+class Deployment(CustomResource):
+    """Minimal Deployment: what the platform Helm chart deploys (GoHai-api /
+    GoHai-controller / devenv-controller, GPU调度平台搭建.md:853-865).  A
+    small controller materializes ``spec.replicas`` Pods and mirrors
+    readiness.  Spec/status are real subobjects so spec writes bump
+    generation and pass the manager's generation-changed predicate (a flat
+    kind would never re-trigger its controller on upgrade)."""
+
+    kind: str = "Deployment"
+    api_version: str = "apps/v1"
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+    def validate(self) -> None:
+        super().validate()
+        if self.spec.replicas < 0:
+            from .types import ValidationError
+
+            raise ValidationError("replicas must be >= 0")
+
+
+@dataclass
 class PersistentVolumeClaim(CustomResource):
     """RWX workspace claim (reference C12: 200Gi ReadWriteMany /workspace,
     GPU调度平台搭建.md:181-224).  No provisioner here — a created claim is

@@ -327,6 +327,44 @@ def cmd_devenv(args) -> int:
         p.close()
 
 
+def cmd_ci(args) -> int:
+    """CI/CD verbs (C31): run the build/push/deploy|train pipeline on a
+    pushed repo asset, and inspect release history."""
+    ctx = _require_login(CliConfig.load())
+    p = LocalPlatform()
+    try:
+        if args.ci_cmd == "run":
+            from ..platform.cicd import Ref
+
+            ref = (
+                Ref(args.tag, is_tag=True) if args.tag else Ref(args.branch)
+            )
+            run = p.pipeline_runner().run(ctx.space or "default", args.repo,
+                                          ref, namespace=ctx.space or "default")
+            print(f"pipeline {run.repo} @ "
+                  f"{'tag ' if ref.is_tag else ''}{ref.name}: {run.status}")
+            for s in run.stages:
+                print(f"  {s.stage:7s} {s.status}")
+                for line in s.log:
+                    print(f"          {line}")
+            if run.status == "success":
+                p.settle()
+            return 0 if run.status == "success" else 1
+        if args.ci_cmd == "releases":
+            hist = p.releases.history(args.name, ctx.space or "default")
+            if not hist:
+                print(f"no release {args.name}", file=sys.stderr)
+                return 1
+            print("REVISION\tCHART\tSTATUS\tVALUES")
+            for r in hist:
+                print(f"{r.revision}\t{r.chart}-{r.chart_version}\t"
+                      f"{r.status}\t{r.values}")
+            return 0
+        return 1
+    finally:
+        p.close()
+
+
 def cmd_obs(args) -> int:
     """Observability surface (C32): query persisted platform logs (the
     Loki role), dump the last metrics exposition, or serve /metrics."""
@@ -472,6 +510,17 @@ def build_parser() -> argparse.ArgumentParser:
     p_ai.add_argument("--id", required=True)
     p_ai.add_argument("--path", required=True)
     p_asset.set_defaults(fn=cmd_asset)
+
+    p_ci = sub.add_parser("ci", help="CI/CD pipelines and releases")
+    ci_sub = p_ci.add_subparsers(dest="ci_cmd", required=True)
+    p_run = ci_sub.add_parser("run")
+    p_run.add_argument("--repo", required=True)
+    ref_group = p_run.add_mutually_exclusive_group()
+    ref_group.add_argument("--branch", default="main")
+    ref_group.add_argument("--tag", default="")
+    p_rel = ci_sub.add_parser("releases")
+    p_rel.add_argument("name")
+    p_ci.set_defaults(fn=cmd_ci)
 
     p_obs = sub.add_parser("obs", help="platform logs and metrics")
     obs_sub = p_obs.add_subparsers(dest="obs_cmd", required=True)

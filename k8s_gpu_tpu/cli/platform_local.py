@@ -106,7 +106,12 @@ class LocalPlatform:
         self._load()
         self.cloud = self._load_cloud()
         self.assets = AssetStore(self.root / "assets")
+        self.registry = self._load_registry()
+        from ..platform.release import DeploymentReconciler, ReleaseManager
+
+        self.releases = ReleaseManager(self.kube)
         self.mgr = Manager(self.kube)
+        self.mgr.register("Deployment", DeploymentReconciler(self.kube))
         self.mgr.register(
             "TpuPodSlice",
             TpuPodSliceReconciler(
@@ -141,6 +146,24 @@ class LocalPlatform:
             cloud.queued_resources = snap
         return cloud
 
+    def _load_registry(self):
+        from ..platform.registry import ImageRegistry
+
+        reg = ImageRegistry()
+        f = self.root / "registry.pkl"
+        if f.exists():
+            reg.load(pickle.loads(f.read_bytes()))
+        return reg
+
+    def pipeline_runner(self):
+        from ..platform.cicd import PipelineRunner
+        from ..platform.release import gohai_platform_chart
+
+        return PipelineRunner(
+            self.kube, self.registry, self.releases, self.assets,
+            platform_chart=gohai_platform_chart(),
+        )
+
     def close(self, wait: bool = True) -> None:
         """Persist state and release the lock.  ``wait=False`` skips the
         drain (fire-and-forget submits): in-flight work is abandoned in
@@ -152,6 +175,9 @@ class LocalPlatform:
         (self.root / "kube.pkl").write_bytes(pickle.dumps(self.kube.dump()))
         (self.root / "cloud.pkl").write_bytes(
             pickle.dumps(self.cloud.queued_resources)
+        )
+        (self.root / "registry.pkl").write_bytes(
+            pickle.dumps(self.registry.dump())
         )
         self._persist_observability()
         import fcntl

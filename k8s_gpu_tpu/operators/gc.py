@@ -63,8 +63,14 @@ class ResourceGC(Reconciler):
         # devenv-only namespace accumulating Events).
         now = self.now_fn()
         with self._sweep_lock:
-            if now - self._last_sweep < self.min_sweep_interval:
-                return Result(requeue_after=self.resync)
+            elapsed = now - self._last_sweep
+            if elapsed < self.min_sweep_interval:
+                # Retry when the debounce window ends, not a full resync
+                # later — garbage arriving just after a sweep would
+                # otherwise wait ~12x the debounce latency.
+                return Result(
+                    requeue_after=self.min_sweep_interval - elapsed
+                )
             self._last_sweep = now
         namespaces: set[str] = set()
         for kind in ("TrainJob", "Event", "PersistentVolumeClaim"):

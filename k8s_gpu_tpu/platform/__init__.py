@@ -8,6 +8,22 @@ from .templates import (
     render_yaml,
 )
 from .assets import AssetStore, Asset
+from .registry import (
+    ImageManifest,
+    ImageRegistry,
+    ImmutableTagError,
+    RegistryError,
+    ScanPolicyError,
+)
+from .release import (
+    Chart,
+    DeploymentReconciler,
+    Release,
+    ReleaseError,
+    ReleaseManager,
+    gohai_platform_chart,
+)
+from .cicd import PipelineRun, PipelineRunner, Ref, StageResult
 
 __all__ = [
     "InstanceType",
@@ -21,4 +37,19 @@ __all__ = [
     "render_yaml",
     "AssetStore",
     "Asset",
+    "ImageManifest",
+    "ImageRegistry",
+    "ImmutableTagError",
+    "RegistryError",
+    "ScanPolicyError",
+    "Chart",
+    "DeploymentReconciler",
+    "Release",
+    "ReleaseError",
+    "ReleaseManager",
+    "gohai_platform_chart",
+    "PipelineRun",
+    "PipelineRunner",
+    "Ref",
+    "StageResult",
 ]

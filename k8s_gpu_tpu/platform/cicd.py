@@ -1,0 +1,208 @@
+"""CI/CD pipeline — the GitLab-CI role (C31, GPU调度平台搭建.md:748-794).
+
+The reference pipeline: stages build → push → deploy → train, where a push
+to ``main`` builds+pushes the image and ``helm upgrade``s the platform, and
+a *tag* push additionally ``kubectl apply``s a training job (:784-789).
+Here the same ref-driven rules run in-process: the "docker build" is a
+deterministic image payload derived from the repo asset's content, "push"
+goes to the ImageRegistry (scan policy enforced), "deploy" is a
+ReleaseManager upgrade, and "train" creates a TrainJob from the repo's
+``train_job.yaml`` template — continuing as the trainjob call stack
+(SURVEY §3.4 → §3.2).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..api.trainjob import TrainJob
+from ..controller.kubefake import FakeKube
+from .assets import AssetStore
+from .registry import ImageRegistry, RegistryError, ScanPolicyError
+from .release import Chart, ReleaseManager
+from .templates import TemplateError, expand_template, parse_template
+
+STAGES = ("build", "push", "deploy", "train")
+
+
+@dataclass(frozen=True)
+class Ref:
+    """A git-ish ref: branch push or tag push."""
+
+    name: str
+    is_tag: bool = False
+
+    @property
+    def image_tag(self) -> str:
+        return self.name if self.is_tag else f"{self.name}-latest"
+
+
+@dataclass
+class StageResult:
+    stage: str
+    status: str  # success | failed | skipped
+    log: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PipelineRun:
+    repo: str
+    ref: Ref
+    stages: list[StageResult] = field(default_factory=list)
+    started_at: float = field(default_factory=time.time)
+
+    @property
+    def status(self) -> str:
+        if any(s.status == "failed" for s in self.stages):
+            return "failed"
+        return "success"
+
+    def stage(self, name: str) -> StageResult:
+        return next(s for s in self.stages if s.stage == name)
+
+
+class PipelineRunner:
+    """Rules (the reference's `only:`): branch `main` → build/push/deploy;
+    tags → build/push/train.  Other branches → build/push only."""
+
+    def __init__(
+        self,
+        kube: FakeKube,
+        registry: ImageRegistry,
+        releases: ReleaseManager,
+        assets: AssetStore,
+        platform_chart: Chart | None = None,
+        deploy_release: str = "gohai",
+        main_branch: str = "main",
+    ):
+        self.kube = kube
+        self.registry = registry
+        self.releases = releases
+        self.assets = assets
+        self.platform_chart = platform_chart
+        self.deploy_release = deploy_release
+        self.main_branch = main_branch
+
+    def stages_for(self, ref: Ref) -> list[str]:
+        if ref.is_tag:
+            return ["build", "push", "train"]
+        if ref.name == self.main_branch:
+            return ["build", "push", "deploy"]
+        return ["build", "push"]
+
+    def run(self, space: str, repo_id: str, ref: Ref,
+            namespace: str = "default") -> PipelineRun:
+        run = PipelineRun(repo=f"{space}/{repo_id}", ref=ref)
+        planned = self.stages_for(ref)
+        ctx: dict = {}
+        failed = False
+        for stage in STAGES:
+            if failed or stage not in planned:
+                run.stages.append(StageResult(stage, "skipped"))
+                continue
+            res = StageResult(stage, "success")
+            run.stages.append(res)
+            try:
+                getattr(self, f"_stage_{stage}")(ctx, space, repo_id, ref,
+                                                 namespace, res)
+            except Exception as e:  # a failed stage fails the pipeline
+                res.status = "failed"
+                res.log.append(f"error: {e}")
+                failed = True
+        return run
+
+    # -- stages ------------------------------------------------------------
+    def _stage_build(self, ctx, space, repo_id, ref, namespace,
+                     res: StageResult) -> None:
+        asset = self.assets.get(space, "repository", repo_id)
+        payload = Path(asset.path)
+        digest = hashlib.sha256()
+        files = 0
+        if payload.is_dir():
+            for p in sorted(payload.rglob("*")):
+                if p.is_file():
+                    digest.update(p.relative_to(payload).as_posix().encode())
+                    digest.update(p.read_bytes())
+                    files += 1
+        else:
+            digest.update(payload.read_bytes())
+            files = 1
+        # The "image": a manifest of the build inputs.  Deterministic, so
+        # rebuilding an unchanged repo produces an identical digest (layer
+        # cache semantics).
+        ctx["image_content"] = (
+            f"image:{space}/{repo_id}@{asset.version}\n"
+            f"source-sha256:{digest.hexdigest()}\n"
+        ).encode() + self._maybe_payload_markers(payload)
+        res.log.append(
+            f"built image from {files} file(s) of {space}/{repo_id} "
+            f"{asset.version}"
+        )
+        ctx["repo_dir"] = payload
+
+    @staticmethod
+    def _maybe_payload_markers(payload: Path) -> bytes:
+        """Propagate scanner-relevant content into the image payload (the
+        image inherits its layers' vulnerabilities)."""
+        chunks = []
+        if payload.is_dir():
+            for p in sorted(payload.rglob("*")):
+                if p.is_file() and p.suffix in (".txt", ".cfg", ""):
+                    data = p.read_bytes()
+                    if b"CVE-" in data:
+                        chunks.append(data)
+        return b"".join(chunks)
+
+    def _stage_push(self, ctx, space, repo_id, ref, namespace,
+                    res: StageResult) -> None:
+        m = self.registry.push(space, repo_id, ref.image_tag,
+                               ctx["image_content"])
+        if m.scan_status == "Failed":
+            raise ScanPolicyError(
+                f"scan failed: {', '.join(m.scan_findings)}"
+            )
+        ctx["image_ref"] = f"{space}/{repo_id}:{ref.image_tag}"
+        res.log.append(f"pushed {ctx['image_ref']} ({m.digest[:19]}…, "
+                       f"scan={m.scan_status})")
+
+    def _stage_deploy(self, ctx, space, repo_id, ref, namespace,
+                      res: StageResult) -> None:
+        if self.platform_chart is None:
+            raise RegistryError("no platform chart configured for deploy")
+        rel = self.releases.upgrade(
+            self.platform_chart, self.deploy_release, namespace,
+            values={"image": ctx["image_ref"]},
+        )
+        res.log.append(
+            f"helm upgrade {rel.name} → revision {rel.revision} "
+            f"(image {ctx['image_ref']})"
+        )
+
+    def _stage_train(self, ctx, space, repo_id, ref, namespace,
+                     res: StageResult) -> None:
+        tpl_path = ctx["repo_dir"] / "train_job.yaml"
+        if not tpl_path.exists():
+            raise TemplateError(
+                f"repo {space}/{repo_id} has no train_job.yaml"
+            )
+        tpl = parse_template(tpl_path.read_text())
+        job_name = f"ci-{repo_id}-{ref.name}".replace(".", "-")
+        job: TrainJob = expand_template(tpl, job_name, namespace)
+        job.metadata.labels["ci-ref"] = ref.name
+        job.spec.image = ctx.get("image_ref", job.spec.image)
+        # kubectl-apply semantics: a retried tag pipeline upserts rather
+        # than failing on Conflict.
+        existing = self.kube.try_get("TrainJob", job.metadata.name, namespace)
+        if existing is None:
+            self.kube.create(job)
+            res.log.append(f"created TrainJob {job.metadata.name}")
+        else:
+            job.metadata.resource_version = existing.metadata.resource_version
+            job.metadata.creation_timestamp = (
+                existing.metadata.creation_timestamp
+            )
+            self.kube.update(job)
+            res.log.append(f"configured TrainJob {job.metadata.name}")

@@ -1,0 +1,165 @@
+"""Private image registry — the Harbor role (C10, GPU调度平台搭建.md:146-168)
+plus the image-scanning policy the ops manual requires (:798-807).
+
+Content-addressed blob store + tag → digest manifests, organized the way
+Harbor is: project / repository / tag.  ``scan_on_push`` runs the injected
+scanner at push time (the Trivy role) and ``pull`` enforces the policy —
+an image whose scan failed cannot be pulled (Harbor's "prevent vulnerable
+images from running").
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+
+class RegistryError(Exception):
+    pass
+
+
+class ImmutableTagError(RegistryError):
+    pass
+
+
+class ScanPolicyError(RegistryError):
+    pass
+
+
+@dataclass
+class ImageManifest:
+    project: str
+    repository: str
+    tag: str
+    digest: str  # sha256:<hex> of content
+    size: int
+    created_at: float
+    scan_status: str = "Pending"  # Pending | Passed | Failed
+    scan_findings: list[str] = field(default_factory=list)
+
+
+def default_scanner(content: bytes) -> list[str]:
+    """Deterministic stand-in scanner: flags known-bad markers in the image
+    payload (tests inject real findings through this seam)."""
+    findings = []
+    if b"CVE-" in content:
+        findings.append("embedded CVE marker")
+    return findings
+
+
+class ImageRegistry:
+    def __init__(
+        self,
+        scan_on_push: bool = True,
+        scanner=default_scanner,
+        immutable_tags: bool = False,
+    ):
+        self.scan_on_push = scan_on_push
+        self.scanner = scanner
+        self.immutable_tags = immutable_tags
+        self._blobs: dict[str, bytes] = {}  # digest -> content
+        self._manifests: dict[tuple[str, str, str], ImageManifest] = {}
+
+    # -- write -------------------------------------------------------------
+    def push(
+        self, project: str, repository: str, tag: str, content: bytes
+    ) -> ImageManifest:
+        key = (project, repository, tag)
+        digest = "sha256:" + hashlib.sha256(content).hexdigest()
+        existing = self._manifests.get(key)
+        if existing is not None and self.immutable_tags:
+            if existing.digest != digest:
+                raise ImmutableTagError(
+                    f"{project}/{repository}:{tag} is immutable "
+                    f"(held {existing.digest[:19]}…)"
+                )
+            return existing
+        self._blobs[digest] = content
+        m = ImageManifest(
+            project=project,
+            repository=repository,
+            tag=tag,
+            digest=digest,
+            size=len(content),
+            created_at=time.time(),
+        )
+        if self.scan_on_push:
+            findings = list(self.scanner(content))
+            m.scan_findings = findings
+            m.scan_status = "Failed" if findings else "Passed"
+        self._manifests[key] = m
+        return m
+
+    def delete_tag(self, project: str, repository: str, tag: str) -> None:
+        if (project, repository, tag) not in self._manifests:
+            raise RegistryError(f"no such tag {project}/{repository}:{tag}")
+        del self._manifests[(project, repository, tag)]
+
+    def gc_blobs(self) -> int:
+        """Remove blobs no manifest references; returns count removed."""
+        live = {m.digest for m in self._manifests.values()}
+        dead = [d for d in self._blobs if d not in live]
+        for d in dead:
+            del self._blobs[d]
+        return len(dead)
+
+    # -- read --------------------------------------------------------------
+    def resolve(self, ref: str) -> ImageManifest:
+        """ref = 'project/repository:tag' or 'project/repository@sha256:…'."""
+        if "@" in ref:
+            path, digest = ref.split("@", 1)
+            project, repository = self._split_path(path)
+            for m in self._manifests.values():
+                if (m.project, m.repository, m.digest) == (
+                    project, repository, digest
+                ):
+                    return m
+            raise RegistryError(f"no manifest {ref}")
+        path, _, tag = ref.rpartition(":")
+        if not path:
+            raise RegistryError(f"image ref {ref!r} needs ':tag' or '@digest'")
+        project, repository = self._split_path(path)
+        m = self._manifests.get((project, repository, tag))
+        if m is None:
+            raise RegistryError(f"no manifest {ref}")
+        return m
+
+    def pull(self, ref: str) -> bytes:
+        m = self.resolve(ref)
+        if m.scan_status == "Failed":
+            raise ScanPolicyError(
+                f"{ref} blocked by scan policy: {', '.join(m.scan_findings)}"
+            )
+        return self._blobs[m.digest]
+
+    @staticmethod
+    def _split_path(path: str) -> tuple[str, str]:
+        if "/" not in path:
+            raise RegistryError(
+                f"image path {path!r} must be 'project/repository'"
+            )
+        project, repository = path.split("/", 1)
+        return project, repository
+
+    def list_repositories(self, project: str) -> list[str]:
+        return sorted(
+            {m.repository for m in self._manifests.values() if m.project == project}
+        )
+
+    def list_tags(self, project: str, repository: str) -> list[ImageManifest]:
+        return sorted(
+            (
+                m for m in self._manifests.values()
+                if (m.project, m.repository) == (project, repository)
+            ),
+            key=lambda m: m.created_at,
+        )
+
+    # -- persistence seam (LocalPlatform pickles these) --------------------
+    def dump(self) -> dict:
+        return {"blobs": dict(self._blobs), "manifests": dict(self._manifests)}
+
+    def load(self, snap: dict) -> None:
+        self._blobs = dict(snap["blobs"])
+        self._manifests = dict(snap["manifests"])

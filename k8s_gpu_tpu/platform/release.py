@@ -1,0 +1,319 @@
+"""Release packaging — the Helm-chart role (C33, GPU调度平台搭建.md:853-865:
+``charts/GoHai/`` with api/controller/devenv deployments, storage PVC,
+ingress).
+
+A ``Chart`` is a values schema + a render function producing typed CRs (no
+text templating: the manifests this platform "deploys" are dataclasses, so
+rendering is a function of merged values).  ``ReleaseManager`` is the Helm
+lifecycle: install / upgrade (three-way: create new, update changed, delete
+vanished) / uninstall / rollback, with each revision's full manifest
+recorded in a Secret exactly the way Helm stores releases
+(``sh.helm.release.v1.<name>.v<rev>``) — so release history survives in
+cluster state, not in the client.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..api.core import Deployment, PersistentVolumeClaim, Secret
+from ..api.types import CustomResource
+from ..controller.kubefake import Conflict, FakeKube, NotFound
+from ..controller.manager import Reconciler, Request, Result
+
+RELEASE_LABEL = "tpu.k8sgpu.dev/release"
+REVISION_LABEL = "tpu.k8sgpu.dev/release-revision"
+
+
+class ReleaseError(Exception):
+    pass
+
+
+def deep_merge(base: dict, overlay: dict) -> dict:
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+@dataclass
+class Chart:
+    name: str
+    version: str
+    values: dict  # defaults
+    render: Callable[[dict, str, str], list[CustomResource]]
+    # render(merged_values, release_name, namespace) -> manifests
+
+
+@dataclass
+class Release:
+    name: str
+    namespace: str
+    chart: str
+    chart_version: str
+    revision: int
+    values: dict
+    manifest_keys: list  # [(kind, name), ...]
+    status: str = "deployed"  # deployed | superseded | uninstalled
+    deployed_at: float = field(default_factory=time.time)
+
+
+class ReleaseManager:
+    def __init__(self, kube: FakeKube):
+        self.kube = kube
+
+    # -- helm verbs --------------------------------------------------------
+    def install(
+        self, chart: Chart, name: str, namespace: str = "default",
+        values: dict | None = None,
+    ) -> Release:
+        if self._latest(name, namespace) is not None:
+            raise ReleaseError(f"release {name} already exists; use upgrade")
+        return self._deploy(chart, name, namespace, values or {}, revision=1)
+
+    def upgrade(
+        self, chart: Chart, name: str, namespace: str = "default",
+        values: dict | None = None,
+    ) -> Release:
+        prev = self._latest(name, namespace)
+        if prev is None:
+            # helm upgrade --install semantics: callers of the CI deploy
+            # stage shouldn't care whether this is the first rollout.
+            return self._deploy(chart, name, namespace, values or {}, revision=1)
+        return self._deploy(
+            chart, name, namespace, values or {},
+            revision=prev.revision + 1, prev=prev,
+        )
+
+    def rollback(self, chart: Chart, name: str, namespace: str = "default",
+                 revision: int | None = None) -> Release:
+        """Re-deploys the *values* of an earlier revision.  Charts render
+        deterministically from values (no stored-manifest codec needed), so
+        the caller supplies the chart, as with upgrade."""
+        history = self.history(name, namespace)
+        if not history:
+            raise ReleaseError(f"no release {name}")
+        cur = history[-1]
+        target_rev = revision if revision is not None else cur.revision - 1
+        target = next((r for r in history if r.revision == target_rev), None)
+        if target is None:
+            raise ReleaseError(f"no revision {target_rev} of {name}")
+        return self._deploy(
+            chart, name, namespace, target.values,
+            revision=cur.revision + 1, prev=cur,
+        )
+
+    def uninstall(self, name: str, namespace: str = "default") -> None:
+        cur = self._latest(name, namespace)
+        if cur is None:
+            raise ReleaseError(f"no release {name}")
+        for kind, obj_name in cur.manifest_keys:
+            try:
+                self.kube.delete(kind, obj_name, namespace)
+            except NotFound:
+                pass
+        for rec in self._records(name, namespace):
+            self.kube.delete("Secret", rec.metadata.name, namespace)
+
+    def history(self, name: str, namespace: str = "default") -> list[Release]:
+        return [self._parse(r) for r in self._records(name, namespace)]
+
+    # -- internals ---------------------------------------------------------
+    def _deploy(
+        self, chart: Chart, name: str, namespace: str, values: dict,
+        revision: int, prev: Release | None = None,
+    ) -> Release:
+        merged = deep_merge(chart.values, values)
+        manifests = chart.render(merged, name, namespace)
+        keys = []
+        for obj in manifests:
+            obj.metadata.namespace = namespace
+            obj.metadata.labels[RELEASE_LABEL] = name
+            obj.metadata.labels[REVISION_LABEL] = str(revision)
+            keys.append((obj.kind, obj.metadata.name))
+            existing = self.kube.try_get(obj.kind, obj.metadata.name, namespace)
+            if existing is None:
+                self.kube.create(obj)
+            else:
+                if RELEASE_LABEL in existing.metadata.labels and (
+                    existing.metadata.labels[RELEASE_LABEL] != name
+                ):
+                    raise ReleaseError(
+                        f"{obj.kind}/{obj.metadata.name} is owned by release "
+                        f"{existing.metadata.labels[RELEASE_LABEL]}"
+                    )
+                obj.metadata.resource_version = existing.metadata.resource_version
+                obj.metadata.creation_timestamp = (
+                    existing.metadata.creation_timestamp
+                )
+                try:
+                    self.kube.update(obj)
+                except Conflict as e:
+                    raise ReleaseError(f"conflict updating {obj.kind}: {e}")
+        # Three-way prune: objects in prev but not in the new manifest.
+        if prev is not None:
+            gone = set(map(tuple, prev.manifest_keys)) - set(keys)
+            for kind, obj_name in gone:
+                try:
+                    self.kube.delete(kind, obj_name, namespace)
+                except NotFound:
+                    pass
+            self._mark_superseded(prev, namespace)
+        rel = Release(
+            name=name, namespace=namespace, chart=chart.name,
+            chart_version=chart.version, revision=revision,
+            values=values, manifest_keys=keys,
+        )
+        self._record(rel)
+        return rel
+
+    def _record(self, rel: Release) -> None:
+        s = Secret()
+        s.metadata.name = f"sh.helm.release.v1.{rel.name}.v{rel.revision}"
+        s.metadata.namespace = rel.namespace
+        s.metadata.labels[RELEASE_LABEL] = rel.name
+        s.data["release"] = json.dumps(
+            {
+                "name": rel.name, "namespace": rel.namespace,
+                "chart": rel.chart, "chart_version": rel.chart_version,
+                "revision": rel.revision, "values": rel.values,
+                "manifest_keys": rel.manifest_keys, "status": rel.status,
+                "deployed_at": rel.deployed_at,
+            }
+        )
+        self.kube.create(s)
+
+    def _records(self, name: str, namespace: str) -> list[Secret]:
+        prefix = f"sh.helm.release.v1.{name}.v"
+        out = [
+            s for s in self.kube.list("Secret", namespace=namespace)
+            if s.metadata.name.startswith(prefix)
+        ]
+        return sorted(out, key=lambda s: int(s.metadata.name.rsplit(".v", 1)[1]))
+
+    @staticmethod
+    def _parse(record: Secret) -> Release:
+        d = json.loads(record.data["release"])
+        return Release(
+            name=d["name"], namespace=d["namespace"], chart=d["chart"],
+            chart_version=d["chart_version"], revision=d["revision"],
+            values=d["values"],
+            manifest_keys=[tuple(k) for k in d["manifest_keys"]],
+            status=d["status"], deployed_at=d["deployed_at"],
+        )
+
+    def _latest(self, name: str, namespace: str) -> Release | None:
+        hist = self.history(name, namespace)
+        return hist[-1] if hist else None
+
+    def _mark_superseded(self, prev: Release, namespace: str) -> None:
+        rec_name = f"sh.helm.release.v1.{prev.name}.v{prev.revision}"
+        rec = self.kube.try_get("Secret", rec_name, namespace)
+        if rec is not None:
+            d = json.loads(rec.data["release"])
+            d["status"] = "superseded"
+            rec.data["release"] = json.dumps(d)
+            try:
+                self.kube.update(rec)
+            except (Conflict, NotFound):
+                pass
+
+# -- the platform's own chart (the charts/GoHai layout, :853-865) ----------
+
+def gohai_platform_chart() -> Chart:
+    defaults = {
+        "image": "platform/gohai:latest",
+        "api": {"replicas": 2},
+        "controller": {"replicas": 1},
+        "devenvController": {"replicas": 1},
+        "workspace": {"size": "200Gi"},
+    }
+
+    def render(v: dict, name: str, namespace: str) -> list[CustomResource]:
+        out: list[CustomResource] = []
+        for comp, key in (
+            ("api", "api"),
+            ("controller", "controller"),
+            ("devenv-controller", "devenvController"),
+        ):
+            d = Deployment()
+            d.metadata.name = f"{name}-{comp}"
+            d.spec.image = v["image"]
+            d.spec.replicas = int(v[key]["replicas"])
+            out.append(d)
+        pvc = PersistentVolumeClaim()
+        pvc.metadata.name = f"{name}-workspace"
+        pvc.capacity = v["workspace"]["size"]
+        out.append(pvc)
+        return out
+
+    return Chart(name="gohai", version="0.1.0", values=defaults, render=render)
+
+
+# -- deployment controller -------------------------------------------------
+
+class DeploymentReconciler(Reconciler):
+    """Materializes a Deployment's replicas as Pods (the kubelet/replicaset
+    role collapsed to one step in the fake cluster) and mirrors readiness."""
+
+    def __init__(self, kube: FakeKube):
+        self.kube = kube
+
+    def reconcile(self, req: Request) -> Result:
+        dep = self.kube.try_get("Deployment", req.name, req.namespace)
+        pods = [
+            p for p in self.kube.list("Pod", namespace=req.namespace)
+            if p.metadata.labels.get("deployment") == req.name
+        ]
+        if dep is None or dep.metadata.deletion_timestamp is not None:
+            for p in pods:
+                try:
+                    self.kube.delete("Pod", p.metadata.name, req.namespace)
+                except NotFound:
+                    pass
+            return Result()
+        want = dep.spec.replicas
+        # Replace pods whose image drifted (rolling update, collapsed).
+        for p in pods:
+            if p.image != dep.spec.image:
+                try:
+                    self.kube.delete("Pod", p.metadata.name, req.namespace)
+                except NotFound:
+                    pass
+        pods = [p for p in pods if p.image == dep.spec.image]
+        for i in range(len(pods), want):
+            from ..api.core import Pod
+
+            p = Pod()
+            p.metadata.name = f"{req.name}-{i}-{dep.metadata.generation}"
+            p.metadata.namespace = req.namespace
+            p.metadata.labels["deployment"] = req.name
+            p.image = dep.spec.image
+            p.command = dep.spec.command
+            p.phase = "Running"
+            try:
+                self.kube.create(p)
+            except Conflict:
+                pass
+        for p in pods[want:]:
+            try:
+                self.kube.delete("Pod", p.metadata.name, req.namespace)
+            except NotFound:
+                pass
+        running = [
+            p for p in self.kube.list("Pod", namespace=req.namespace)
+            if p.metadata.labels.get("deployment") == req.name
+            and p.phase == "Running" and p.image == dep.spec.image
+        ]
+        dep.status.ready_replicas = min(len(running), want)
+        try:
+            self.kube.update_status(dep)
+        except (Conflict, NotFound):
+            pass
+        return Result(requeue_after=60.0)

@@ -1,0 +1,236 @@
+"""Image registry (C10), Helm-role releases (C33), and CI/CD pipeline (C31):
+the reference's build→push→deploy on main, build→push→train on tags
+(GPU调度平台搭建.md:748-794)."""
+
+import pytest
+
+from k8s_gpu_tpu.controller import FakeKube, Manager
+from k8s_gpu_tpu.platform import (
+    AssetStore,
+    Chart,
+    DeploymentReconciler,
+    ImageRegistry,
+    ImmutableTagError,
+    PipelineRunner,
+    Ref,
+    ReleaseError,
+    ReleaseManager,
+    ScanPolicyError,
+    gohai_platform_chart,
+)
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_push_pull_roundtrip():
+    reg = ImageRegistry()
+    m = reg.push("ml", "train", "v1", b"layer-data")
+    assert m.digest.startswith("sha256:") and m.scan_status == "Passed"
+    assert reg.pull("ml/train:v1") == b"layer-data"
+    assert reg.pull(f"ml/train@{m.digest}") == b"layer-data"
+    assert [t.tag for t in reg.list_tags("ml", "train")] == ["v1"]
+    assert reg.list_repositories("ml") == ["train"]
+
+
+def test_registry_scan_policy_blocks_pull():
+    reg = ImageRegistry()
+    m = reg.push("ml", "train", "bad", b"contains CVE-2026-0001 marker")
+    assert m.scan_status == "Failed"
+    with pytest.raises(ScanPolicyError):
+        reg.pull("ml/train:bad")
+
+
+def test_registry_immutable_tags():
+    reg = ImageRegistry(immutable_tags=True)
+    reg.push("ml", "train", "v1", b"a")
+    reg.push("ml", "train", "v1", b"a")  # same digest: idempotent
+    with pytest.raises(ImmutableTagError):
+        reg.push("ml", "train", "v1", b"b")
+
+
+def test_registry_blob_gc():
+    reg = ImageRegistry()
+    reg.push("ml", "train", "v1", b"a")
+    reg.push("ml", "train", "v2", b"b")
+    reg.delete_tag("ml", "train", "v1")
+    assert reg.gc_blobs() == 1
+    assert reg.pull("ml/train:v2") == b"b"
+
+
+# -- releases ---------------------------------------------------------------
+
+def test_release_install_upgrade_prune_and_history(kube: FakeKube):
+    rm = ReleaseManager(kube)
+    chart = gohai_platform_chart()
+    rel = rm.install(chart, "gohai", "default", {"image": "ml/train:v1"})
+    assert rel.revision == 1
+    deps = kube.list("Deployment")
+    assert {d.metadata.name for d in deps} == {
+        "gohai-api", "gohai-controller", "gohai-devenv-controller"
+    }
+    assert all(d.spec.image == "ml/train:v1" for d in deps)
+    assert kube.get("Deployment", "gohai-api").spec.replicas == 2
+
+    rel2 = rm.upgrade(chart, "gohai", "default",
+                      {"image": "ml/train:v2", "api": {"replicas": 3}})
+    assert rel2.revision == 2
+    api = kube.get("Deployment", "gohai-api")
+    assert api.spec.image == "ml/train:v2" and api.spec.replicas == 3
+    hist = rm.history("gohai")
+    assert [r.revision for r in hist] == [1, 2]
+    assert hist[0].status == "superseded" and hist[1].status == "deployed"
+
+
+def test_release_upgrade_prunes_vanished_objects(kube: FakeKube):
+    from k8s_gpu_tpu.api.core import Deployment
+
+    def render_two(v, name, ns):
+        a, b = Deployment(), Deployment()
+        a.metadata.name, b.metadata.name = f"{name}-a", f"{name}-b"
+        return [a, b] if v.get("both", True) else [a]
+
+    chart = Chart("two", "0.1", {"both": True}, render_two)
+    rm = ReleaseManager(kube)
+    rm.install(chart, "r1")
+    assert kube.try_get("Deployment", "r1-b") is not None
+    rm.upgrade(chart, "r1", values={"both": False})
+    assert kube.try_get("Deployment", "r1-b") is None
+    assert kube.try_get("Deployment", "r1-a") is not None
+
+
+def test_release_rollback_and_uninstall(kube: FakeKube):
+    rm = ReleaseManager(kube)
+    chart = gohai_platform_chart()
+    rm.install(chart, "gohai", values={"image": "ml/train:v1"})
+    rm.upgrade(chart, "gohai", values={"image": "ml/train:v2"})
+    rel3 = rm.rollback(chart, "gohai")
+    assert rel3.revision == 3
+    assert kube.get("Deployment", "gohai-api").spec.image == "ml/train:v1"
+    rm.uninstall("gohai")
+    assert kube.list("Deployment") == []
+    assert rm.history("gohai") == []
+    with pytest.raises(ReleaseError):
+        rm.uninstall("gohai")
+
+
+def test_release_refuses_foreign_objects(kube: FakeKube):
+    rm = ReleaseManager(kube)
+    chart = gohai_platform_chart()
+    rm.install(chart, "gohai")
+    with pytest.raises(ReleaseError):
+        rm.install(chart, "gohai")  # exists
+    # A second release rendering colliding names is refused.
+    other = Chart(
+        "evil", "0.1", {},
+        lambda v, n, ns: gohai_platform_chart().render(
+            gohai_platform_chart().values, "gohai", ns
+        ),
+    )
+    with pytest.raises(ReleaseError):
+        rm.install(other, "intruder")
+
+
+def test_deployment_reconciler_materializes_pods(kube: FakeKube, manager: Manager):
+    manager.register("Deployment", DeploymentReconciler(kube))
+    manager.start()
+    rm = ReleaseManager(kube)
+    rm.install(gohai_platform_chart(), "gohai")
+    assert manager.wait_idle(timeout=10)
+    api = kube.get("Deployment", "gohai-api")
+    assert api.status.ready_replicas == 2
+    pods = [p for p in kube.list("Pod")
+            if p.metadata.labels.get("deployment") == "gohai-api"]
+    assert len(pods) == 2 and all(p.phase == "Running" for p in pods)
+
+
+# -- pipeline ---------------------------------------------------------------
+
+@pytest.fixture
+def pipeline(kube, tmp_path):
+    assets = AssetStore(tmp_path / "assets")
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    (repo / "train.py").write_text("print('train')\n")
+    (repo / "train_job.yaml").write_text(
+        "title: ci-train\nworkload: psum-smoke\n"
+        "spec:\n  singleInstanceType: tpu-v4-8\n"
+    )
+    assets.import_path("ml", "repository", "demo", repo)
+    reg = ImageRegistry()
+    runner = PipelineRunner(
+        kube, reg, ReleaseManager(kube), assets,
+        platform_chart=gohai_platform_chart(),
+    )
+    return runner, reg, repo, assets
+
+
+def test_pipeline_main_branch_deploys(pipeline, kube):
+    runner, reg, _, _ = pipeline
+    run = runner.run("ml", "demo", Ref("main"))
+    assert run.status == "success"
+    assert [s.status for s in run.stages] == [
+        "success", "success", "success", "skipped"
+    ]
+    assert kube.get("Deployment", "gohai-api").spec.image == "ml/demo:main-latest"
+    assert reg.resolve("ml/demo:main-latest").scan_status == "Passed"
+
+
+def test_pipeline_tag_trains(pipeline, kube):
+    runner, _, _, _ = pipeline
+    run = runner.run("ml", "demo", Ref("v1.0", is_tag=True))
+    assert run.status == "success"
+    assert run.stage("deploy").status == "skipped"
+    assert run.stage("train").status == "success"
+    job = kube.get("TrainJob", "ci-demo-v1-0")
+    assert job.spec.image == "ml/demo:v1.0"
+    assert job.spec.accelerator_type == "v4-8"
+
+
+def test_pipeline_feature_branch_builds_only(pipeline):
+    runner, _, _, _ = pipeline
+    run = runner.run("ml", "demo", Ref("feature-x"))
+    assert [s.status for s in run.stages] == [
+        "success", "success", "skipped", "skipped"
+    ]
+
+
+def test_pipeline_scan_failure_stops_before_deploy(pipeline, kube):
+    runner, _, repo, assets = pipeline
+    (repo / "deps.txt").write_text("libfoo CVE-2026-1234\n")
+    assets.import_path("ml", "repository", "demo", repo)
+    run = runner.run("ml", "demo", Ref("main"))
+    assert run.status == "failed"
+    assert run.stage("push").status == "failed"
+    assert run.stage("deploy").status == "skipped"
+    assert kube.try_get("Deployment", "gohai-api") is None
+
+
+def test_pipeline_rebuild_is_deterministic(pipeline):
+    runner, reg, _, _ = pipeline
+    runner.run("ml", "demo", Ref("main"))
+    d1 = reg.resolve("ml/demo:main-latest").digest
+    runner.run("ml", "demo", Ref("main"))
+    assert reg.resolve("ml/demo:main-latest").digest == d1
+
+
+def test_upgrade_rolls_pods_in_same_session(kube: FakeKube, manager: Manager):
+    """Spec subobject regression: the upgrade's MODIFIED event must pass the
+    generation predicate so pods roll without waiting for resync."""
+    manager.register("Deployment", DeploymentReconciler(kube))
+    manager.start()
+    rm = ReleaseManager(kube)
+    chart = gohai_platform_chart()
+    rm.install(chart, "gohai", values={"image": "ml/t:v1"})
+    assert manager.wait_idle(timeout=10)
+    rm.upgrade(chart, "gohai", values={"image": "ml/t:v2"})
+    assert manager.wait_idle(timeout=10)
+    pods = [p for p in kube.list("Pod")
+            if p.metadata.labels.get("deployment") == "gohai-api"]
+    assert pods and all(p.image == "ml/t:v2" for p in pods)
+
+
+def test_pipeline_tag_rerun_upserts(pipeline, kube):
+    runner, _, _, _ = pipeline
+    assert runner.run("ml", "demo", Ref("v1", is_tag=True)).status == "success"
+    run2 = runner.run("ml", "demo", Ref("v1", is_tag=True))
+    assert run2.status == "success"
+    assert "configured" in run2.stage("train").log[0]

@@ -141,3 +141,21 @@ def test_obs_logs_and_metrics(capsys):
     assert code == 0
     code, out, _ = run(capsys, "obs", "metrics")
     assert code == 0 and "reconcile_total" in out
+
+
+def test_ci_run_and_releases(tmp_path, capsys):
+    run(capsys, "login", "--user", "ada", "--space", "ml")
+    repo = tmp_path / "proj"
+    repo.mkdir()
+    (repo / "train.py").write_text("print('hi')\n")
+    (repo / "train_job.yaml").write_text(
+        "title: ci\nworkload: psum-smoke\nspec:\n  singleInstanceType: tpu-v4-8\n"
+    )
+    code, out, _ = run(capsys, "repo", "push", "proj", "--path", str(repo))
+    assert code == 0
+    code, out, _ = run(capsys, "ci", "run", "--repo", "proj")
+    assert code == 0 and "deploy  success" in out
+    code, out, _ = run(capsys, "ci", "releases", "gohai")
+    assert code == 0 and "deployed" in out
+    code, out, _ = run(capsys, "ci", "run", "--repo", "proj", "--tag", "v1")
+    assert code == 0 and "train   success" in out
