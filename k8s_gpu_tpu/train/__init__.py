@@ -1,3 +1,12 @@
 from .runner import TrainConfig, Trainer, make_train_step
+from .lora import LoraAdapter, LoraConfig, LoraModel, num_params
 
-__all__ = ["TrainConfig", "Trainer", "make_train_step"]
+__all__ = [
+    "TrainConfig",
+    "Trainer",
+    "make_train_step",
+    "LoraAdapter",
+    "LoraConfig",
+    "LoraModel",
+    "num_params",
+]

@@ -1,0 +1,171 @@
+"""LoRA parameter-efficient fine-tuning — the capability the reference's
+fine-tuning explainer prescribes (AIStudio/02_通用技术方案/模型研发/
+模型微调最佳实践.md:19-33: LoRA/QLoRA for adapting large models on limited
+hardware).
+
+TPU-first shape: adapters are a *separate pytree* (base params stay frozen
+and can be donated/sharded however the base run laid them out); the
+low-rank delta is merged functionally inside the loss, so one jitted train
+step differentiates only the adapter leaves and XLA fuses the
+``W + scale·(A@B)`` materialization into the consuming matmuls.  The rank
+axis is a logical axis ("lora") that the rule table leaves replicated,
+while A inherits the base weight's input-axis sharding and B its
+output-axis sharding — adapters follow the model's tp/pp layout
+automatically.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+# For each adaptable leaf under "blocks": how many trailing dims are the
+# matmul *input* (after the leading "stages"/layer axis).  wq (L,D,H,Dh)
+# maps D -> H*Dh, wo (L,H,Dh,D) maps H*Dh -> D, etc.
+_BLOCK_TARGETS: dict[str, int] = {
+    "wq": 1, "wk": 1, "wv": 1, "wo": 2,
+    "wi_gate": 1, "wi_up": 1, "wo_mlp": 1,
+}
+# Top-level leaves: (n_input_dims, no leading layer axis).
+_TOP_TARGETS: dict[str, int] = {"head": 1}
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # Which leaves get adapters; default = attention projections (the
+    # standard LoRA recipe).
+    targets: tuple = ("wq", "wk", "wv", "wo")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _split_dims(name: str, shape: tuple, in_blocks: bool) -> tuple | None:
+    """(batch_dims, in_dims, out_dims) for an adaptable leaf, else None."""
+    table = _BLOCK_TARGETS if in_blocks else _TOP_TARGETS
+    n_in = table.get(name)
+    if n_in is None:
+        return None
+    if in_blocks:
+        return shape[:1], shape[1 : 1 + n_in], shape[1 + n_in :]
+    return (), shape[:n_in], shape[n_in:]
+
+
+class LoraAdapter:
+    """Builds/merges adapters for a TransformerLM-shaped param tree."""
+
+    def __init__(self, cfg: LoraConfig):
+        self.cfg = cfg
+
+    # -- init --------------------------------------------------------------
+    def init(self, key, base_params: dict) -> dict:
+        """A ~ N(0, 0.02), B = 0 — the delta starts at exactly zero, so
+        step 0 of fine-tuning reproduces the base model."""
+        r = self.cfg.rank
+        out: dict = {"blocks": {}}
+        keys = iter(jax.random.split(key, 64))
+        for name, w in base_params["blocks"].items():
+            dims = _split_dims(name, w.shape, in_blocks=True)
+            if dims is None or name not in self.cfg.targets:
+                continue
+            batch, din, dout = dims
+            fin, fout = math.prod(din), math.prod(dout)
+            out["blocks"][name] = {
+                "a": jax.random.normal(next(keys), (*batch, fin, r),
+                                       jnp.float32) * 0.02,
+                "b": jnp.zeros((*batch, r, fout), jnp.float32),
+            }
+        for name, w in base_params.items():
+            if name == "blocks" or not hasattr(w, "shape"):
+                continue
+            dims = _split_dims(name, w.shape, in_blocks=False)
+            if dims is None or name not in self.cfg.targets:
+                continue
+            _, din, dout = dims
+            fin, fout = math.prod(din), math.prod(dout)
+            out[name] = {
+                "a": jax.random.normal(next(keys), (fin, r), jnp.float32) * 0.02,
+                "b": jnp.zeros((r, fout), jnp.float32),
+            }
+        if not out["blocks"] and len(out) == 1:
+            raise ValueError(
+                f"no adaptable targets among {self.cfg.targets}"
+            )
+        return out
+
+    def logical_axes(self, base_axes: dict) -> dict:
+        """A inherits the base leaf's input axes (flattened to the first),
+        B its output axes; the rank axis is 'lora' (replicated)."""
+        out: dict = {"blocks": {}}
+        for name, axes in base_axes["blocks"].items():
+            if name not in self.cfg.targets or name not in _BLOCK_TARGETS:
+                continue
+            n_in = _BLOCK_TARGETS[name]
+            out["blocks"][name] = {
+                "a": (axes[0], axes[1], "lora"),
+                "b": (axes[0], "lora", axes[1 + n_in]),
+            }
+        for name, axes in base_axes.items():
+            if name == "blocks" or not isinstance(axes, tuple):
+                continue
+            if name not in self.cfg.targets or name not in _TOP_TARGETS:
+                continue
+            n_in = _TOP_TARGETS[name]
+            out[name] = {"a": (axes[0], "lora"), "b": ("lora", axes[n_in])}
+        return out
+
+    # -- merge -------------------------------------------------------------
+    def merge(self, base_params: dict, lora_params: dict) -> dict:
+        """base + scale·(A@B), reshaped to each leaf's original shape.
+        Functional: returns a new tree, base untouched."""
+        scale = self.cfg.scale
+        merged = dict(base_params)
+        merged["blocks"] = dict(base_params["blocks"])
+        for name, ab in lora_params.get("blocks", {}).items():
+            w = base_params["blocks"][name]
+            delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"]) * scale
+            merged["blocks"][name] = w + delta.reshape(w.shape).astype(w.dtype)
+        for name, ab in lora_params.items():
+            if name == "blocks":
+                continue
+            w = base_params[name]
+            delta = (ab["a"] @ ab["b"]) * scale
+            merged[name] = w + delta.reshape(w.shape).astype(w.dtype)
+        return merged
+
+
+class LoraModel:
+    """Trainer-compatible adapter view of a frozen base model: init() makes
+    adapter params, loss() differentiates w.r.t. adapters only.  Drop-in for
+    train.Trainer — ``Trainer(LoraModel(model, base_params))`` fine-tunes."""
+
+    def __init__(self, model, base_params: dict,
+                 cfg: LoraConfig | None = None):
+        self.model = model
+        self.base_params = base_params
+        self.cfg = cfg or LoraConfig()
+        self.adapter = LoraAdapter(self.cfg)
+
+    def init(self, key) -> dict:
+        return self.adapter.init(key, self.base_params)
+
+    def logical_axes(self) -> dict:
+        return self.adapter.logical_axes(self.model.logical_axes())
+
+    def loss(self, lora_params, tokens, targets, mesh=None):
+        merged = self.adapter.merge(self.base_params, lora_params)
+        return self.model.loss(merged, tokens, targets, mesh=mesh)
+
+    def merged_params(self, lora_params) -> dict:
+        """Bake the adapters in (for serving / export)."""
+        return self.adapter.merge(self.base_params, lora_params)
+
+
+def num_params(tree) -> int:
+    return sum(int(jnp.size(x)) for x in jax.tree.leaves(tree))

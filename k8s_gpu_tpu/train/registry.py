@@ -101,3 +101,46 @@ def _lm_train(spec, placements) -> dict:
     toks = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size)
     losses = [trainer.step(toks[:, :-1], toks[:, 1:]) for _ in range(steps)]
     return {"first_loss": losses[0], "last_loss": losses[-1], "steps": steps}
+
+
+@register_workload("lora-finetune")
+def _lora_finetune(spec, placements) -> dict:
+    """Parameter-efficient fine-tuning of the flagship LM (the reference's
+    fine-tuning-best-practices capability, 模型微调最佳实践.md:19-33):
+    a frozen base + LoRA adapters trained on the job's data."""
+    import jax
+
+    from ..models import TransformerConfig, TransformerLM
+    from ..parallel.mesh import MeshConfig, build_mesh
+    from .lora import LoraConfig, LoraModel, num_params
+    from .runner import TrainConfig, Trainer
+
+    args = spec.workload_args
+    steps = int(args.get("steps", 3))
+    cfg = TransformerConfig(
+        vocab_size=int(args.get("vocab", 256)),
+        d_model=int(args.get("d_model", 64)),
+        n_layers=int(args.get("layers", 2)),
+        n_heads=4,
+        d_head=16,
+        d_ff=int(args.get("d_ff", 128)),
+    )
+    base = TransformerLM(cfg)
+    base_params = base.init(jax.random.PRNGKey(0))
+    lm = LoraModel(base, base_params, LoraConfig(
+        rank=int(args.get("rank", 8))))
+    trainer = Trainer(
+        lm,
+        mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TrainConfig(warmup_steps=1, learning_rate=5e-3),
+    )
+    trainer.init(jax.random.PRNGKey(1))
+    toks = jax.random.randint(jax.random.PRNGKey(2), (4, 33), 0, cfg.vocab_size)
+    losses = [trainer.step(toks[:, :-1], toks[:, 1:]) for _ in range(steps)]
+    return {
+        "first_loss": losses[0],
+        "last_loss": losses[-1],
+        "steps": steps,
+        "adapter_params": num_params(trainer.params),
+        "base_params": num_params(base_params),
+    }
