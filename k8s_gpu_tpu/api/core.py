@@ -57,6 +57,7 @@ class Pod(CustomResource):
     api_version: str = "v1"
     image: str = ""
     command: str = ""
+    env: dict[str, str] = field(default_factory=dict)
     requests: dict[str, int] = field(default_factory=dict)
     node_selector: dict[str, str] = field(default_factory=dict)
     node_name: str = ""

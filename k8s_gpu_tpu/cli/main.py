@@ -295,13 +295,23 @@ def cmd_devenv(args) -> int:
                 env.metadata.namespace = ctx.space
                 env.spec.username = args.user or ctx.user
                 env.spec.ssh_public_key = pubkey
+                env.spec.tpu_chips = args.chips or 0
                 p.kube.create(env)
             else:
                 env.spec.ssh_public_key = pubkey or env.spec.ssh_public_key
+                if args.chips is not None:  # --chips 0 releases the grant
+                    env.spec.tpu_chips = args.chips
                 p.kube.update(env)
             p.settle()
             cur = p.kube.get("DevEnv", name, ctx.space)
-            print(f"{name}\t{cur.status.phase}\tssh: {cur.status.ssh_endpoint}")
+            chips = ""
+            if cur.status.phase == "Ready" and cur.spec.tpu_chips:
+                pod = p.kube.try_get("Pod", cur.status.pod_name, ctx.space)
+                if pod is not None and pod.env.get("TPU_VISIBLE_CHIPS"):
+                    chips = (f"\tchips: {pod.env['TPU_VISIBLE_CHIPS']} "
+                             f"on {pod.node_name}")
+            print(f"{name}\t{cur.status.phase}\t"
+                  f"ssh: {cur.status.ssh_endpoint}{chips}")
             if cur.status.phase != "Ready":
                 if cur.status.message:
                     print(f"error: {cur.status.message}", file=sys.stderr)
@@ -462,6 +472,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_ec.add_argument("--name", default="")
     p_ec.add_argument("--user", default="")
     p_ec.add_argument("--pubkey", default="", help="path to SSH public key")
+    p_ec.add_argument("--chips", type=int, default=None,
+                      help="TPU chips to carve out of a shared host "
+                           "(0 releases an existing grant)")
     env_sub.add_parser("list")
     env_sub.add_parser("delete").add_argument("name")
     p_env.set_defaults(fn=cmd_devenv)

@@ -22,6 +22,8 @@ from ..controller.events import EventRecorder
 from ..controller.kubefake import Conflict, FakeKube, NotFound
 from ..controller.manager import Reconciler, Request, Result
 from ..scheduling.labels import TPU_RESOURCE
+from ..scheduling.placement import PlacementError
+from ..scheduling.sharing import ChipAllocator
 
 log = logging.getLogger("k8s_gpu_tpu.operators.devenv")
 
@@ -93,7 +95,22 @@ class DevEnvReconciler(Reconciler):
 
         self._ensure_secret(env)
         self._ensure_pvc(env)
-        created = self._ensure_pod(env)
+        try:
+            created = self._ensure_pod(env)
+        except PlacementError as e:
+            # No host has enough free chips: stay Pending and retry — a
+            # pool scale-up or a released devenv unblocks us.
+            env.status.phase = "Pending"
+            env.status.message = str(e)
+            set_condition(
+                env.status.conditions, "Ready", "False", "NoTpuCapacity",
+                str(e), observed_generation=env.metadata.generation,
+            )
+            try:
+                self.kube.update_status(env)
+            except (Conflict, NotFound):
+                pass
+            return Result(requeue_after=15.0)
 
         env.status.phase = "Ready"
         env.status.pod_name = pod_name(env)
@@ -164,8 +181,22 @@ class DevEnvReconciler(Reconciler):
 
     def _ensure_pod(self, env: DevEnv) -> bool:
         """Returns True when the pod was created this pass."""
-        if self.kube.try_get("Pod", pod_name(env), env.metadata.namespace):
-            return False
+        cur = self.kube.try_get("Pod", pod_name(env), env.metadata.namespace)
+        if cur is not None:
+            # Chip-count drift (user changed --chips): the pod must be
+            # replaced — grants are immutable for a running pod.
+            if cur.requests.get(TPU_RESOURCE, 0) != env.spec.tpu_chips:
+                freed = cur.node_name if cur.env.get("TPU_VISIBLE_CHIPS") else ""
+                try:
+                    self.kube.delete(
+                        "Pod", cur.metadata.name, env.metadata.namespace
+                    )
+                except NotFound:
+                    pass
+                if freed:
+                    self._resync_allocatable(freed)
+            else:
+                return False
         p = Pod()
         p.metadata.name = pod_name(env)
         p.metadata.namespace = env.metadata.namespace
@@ -179,6 +210,7 @@ class DevEnvReconciler(Reconciler):
         }
         if env.spec.tpu_chips:
             p.requests[TPU_RESOURCE] = env.spec.tpu_chips
+            self._grant_chips(env, p)
         p.phase = "Running"
         try:
             self.kube.create(p)
@@ -186,10 +218,50 @@ class DevEnvReconciler(Reconciler):
             return False
         return True
 
+    def _grant_chips(self, env: DevEnv, p: Pod) -> None:
+        """Chip-granular sharing (the HAMi role, scheduling/sharing.py):
+        carve spec.tpu_chips chips out of a TPU host and pin the pod to it
+        with TPU_VISIBLE_CHIPS.  Allocator state is re-derived from live
+        pods — level-triggered, nothing to persist."""
+        all_pods = self.kube.list("Pod")  # all namespaces: any tenant's
+        # grants and gang workers occupy real chips
+        # Hosts running gang workers (TPU requests bound by node_name but no
+        # chip grant) are whole-host-owned — never carve chips from them.
+        gang_hosts = {
+            pod.node_name
+            for pod in all_pods
+            if pod.node_name
+            and pod.phase in ("Pending", "Running")
+            and pod.requests.get(TPU_RESOURCE, 0) > 0
+            and not pod.env.get("TPU_VISIBLE_CHIPS")
+        }
+        nodes = [
+            n for n in self.kube.list("Node")
+            if n.capacity.get(TPU_RESOURCE, 0) > 0
+            and n.metadata.name not in gang_hosts
+        ]
+        allocator = ChipAllocator.from_pods(all_pods, nodes)
+        alloc = allocator.allocate(p.metadata.name, env.spec.tpu_chips, nodes)
+        p.node_name = alloc.node
+        p.env.update(alloc.env)
+        # Persist the host's reduced allocatable so gang placement and
+        # quota observe the carve-out.
+        for n in nodes:
+            if n.metadata.name == alloc.node:
+                try:
+                    self.kube.update(n)
+                except Conflict:
+                    pass
+        self.recorder.event(
+            env, "Normal", "ChipsAllocated",
+            f"granted chips {alloc.env['TPU_VISIBLE_CHIPS']} on {alloc.node}",
+        )
+
     def _teardown(self, env: DevEnv) -> Result:
         """Pod + Secret go; the workspace PVC stays (persistence, :374-383).
         Only objects this DevEnv owns (by label) are touched — deleting a
         Failed duplicate must not destroy the rightful owner's environment."""
+        freed_node = ""
         for kind, name in (("Pod", pod_name(env)),
                            ("Secret", secret_name(env))):
             obj = self.kube.try_get(kind, name, env.metadata.namespace)
@@ -197,10 +269,14 @@ class DevEnvReconciler(Reconciler):
                 continue
             if obj.metadata.labels.get("devenv") != env.metadata.name:
                 continue
+            if kind == "Pod" and obj.env.get("TPU_VISIBLE_CHIPS"):
+                freed_node = obj.node_name
             try:
                 self.kube.delete(kind, name, env.metadata.namespace)
             except NotFound:
                 pass
+        if freed_node:
+            self._resync_allocatable(freed_node)
         if FINALIZER in env.metadata.finalizers:
             env.metadata.finalizers.remove(FINALIZER)
             try:
@@ -208,3 +284,15 @@ class DevEnvReconciler(Reconciler):
             except (Conflict, NotFound):
                 return Result(requeue=True)
         return Result()
+
+    def _resync_allocatable(self, node_name: str) -> None:
+        """Recompute a host's allocatable chips from surviving grants."""
+        node = self.kube.try_get("Node", node_name, "default")
+        if node is None:
+            return
+        allocator = ChipAllocator.from_pods(self.kube.list("Pod"), [node])
+        allocator.sync_nodes([node])
+        try:
+            self.kube.update(node)
+        except (Conflict, NotFound):
+            pass

@@ -190,10 +190,14 @@ class ReleaseManager:
         self.kube.create(s)
 
     def _records(self, name: str, namespace: str) -> list[Secret]:
-        prefix = f"sh.helm.release.v1.{name}.v"
+        # Label equality, not name prefix: release "app.v2"'s records start
+        # with "sh.helm.release.v1.app.v" and would contaminate "app".
         out = [
-            s for s in self.kube.list("Secret", namespace=namespace)
-            if s.metadata.name.startswith(prefix)
+            s for s in self.kube.list(
+                "Secret", namespace=namespace,
+                label_selector={RELEASE_LABEL: name},
+            )
+            if s.metadata.name.startswith("sh.helm.release.v1.")
         ]
         return sorted(out, key=lambda s: int(s.metadata.name.rsplit(".v", 1)[1]))
 
@@ -279,14 +283,18 @@ class DeploymentReconciler(Reconciler):
                     pass
             return Result()
         want = dep.spec.replicas
-        # Replace pods whose image drifted (rolling update, collapsed).
+
+        def matches_spec(p) -> bool:
+            return p.image == dep.spec.image and p.env == dep.spec.env
+
+        # Replace pods whose image/env drifted (rolling update, collapsed).
         for p in pods:
-            if p.image != dep.spec.image:
+            if not matches_spec(p):
                 try:
                     self.kube.delete("Pod", p.metadata.name, req.namespace)
                 except NotFound:
                     pass
-        pods = [p for p in pods if p.image == dep.spec.image]
+        pods = [p for p in pods if matches_spec(p)]
         for i in range(len(pods), want):
             from ..api.core import Pod
 
@@ -296,6 +304,7 @@ class DeploymentReconciler(Reconciler):
             p.metadata.labels["deployment"] = req.name
             p.image = dep.spec.image
             p.command = dep.spec.command
+            p.env = dict(dep.spec.env)
             p.phase = "Running"
             try:
                 self.kube.create(p)
@@ -309,7 +318,7 @@ class DeploymentReconciler(Reconciler):
         running = [
             p for p in self.kube.list("Pod", namespace=req.namespace)
             if p.metadata.labels.get("deployment") == req.name
-            and p.phase == "Running" and p.image == dep.spec.image
+            and p.phase == "Running" and matches_spec(p)
         ]
         dep.status.ready_replicas = min(len(running), want)
         try:

@@ -15,6 +15,7 @@ from .placement import (
     multislice_spread,
 )
 from .queueing import AdmissionDecision, QueueAdmitter, QueueReconciler, job_chips
+from .sharing import ChipAllocation, ChipAllocator
 
 __all__ = [
     "TPU_RESOURCE",
@@ -33,4 +34,6 @@ __all__ = [
     "QueueAdmitter",
     "QueueReconciler",
     "job_chips",
+    "ChipAllocation",
+    "ChipAllocator",
 ]

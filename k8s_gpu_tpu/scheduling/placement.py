@@ -89,6 +89,10 @@ def place_gang(
             continue
         if not n.ready:
             continue
+        # Gang workers own their whole host: a host with any chips carved
+        # out for shared sub-host pods (scheduling/sharing.py) is ineligible.
+        if n.allocatable.get(TPU_RESOURCE, 0) != n.capacity.get(TPU_RESOURCE, 0):
+            continue
         if n.allocatable.get(TPU_RESOURCE, 0) <= 0:
             continue
         sl = n.metadata.labels.get(LABEL_SLICE)

@@ -234,3 +234,41 @@ def test_pipeline_tag_rerun_upserts(pipeline, kube):
     run2 = runner.run("ml", "demo", Ref("v1", is_tag=True))
     assert run2.status == "success"
     assert "configured" in run2.stage("train").log[0]
+
+
+def test_release_names_do_not_cross_contaminate(kube: FakeKube):
+    """history('app') must not absorb release 'app.v2''s records."""
+    rm = ReleaseManager(kube)
+    chart = gohai_platform_chart()
+    rm.install(chart, "app")
+    rm.install(chart2 := Chart("other", "0.1", {}, lambda v, n, ns: []),
+               "app.v2")
+    rm.upgrade(chart2, "app.v2")
+    assert [r.revision for r in rm.history("app")] == [1]
+    assert [r.revision for r in rm.history("app.v2")] == [1, 2]
+
+
+def test_deployment_env_propagates_and_rolls(kube: FakeKube, manager: Manager):
+    from k8s_gpu_tpu.api.core import Deployment
+
+    def render(v, name, ns):
+        d = Deployment()
+        d.metadata.name = f"{name}-svc"
+        d.spec.image = "img:1"
+        d.spec.env = dict(v.get("env", {}))
+        return [d]
+
+    chart = Chart("envd", "0.1", {"env": {"A": "1"}}, render)
+    manager.register("Deployment", DeploymentReconciler(kube))
+    manager.start()
+    rm = ReleaseManager(kube)
+    rm.install(chart, "r")
+    assert manager.wait_idle(timeout=10)
+    pods = [p for p in kube.list("Pod")
+            if p.metadata.labels.get("deployment") == "r-svc"]
+    assert pods and pods[0].env == {"A": "1"}
+    rm.upgrade(chart, "r", values={"env": {"A": "2"}})
+    assert manager.wait_idle(timeout=10)
+    pods = [p for p in kube.list("Pod")
+            if p.metadata.labels.get("deployment") == "r-svc"]
+    assert pods and all(p.env == {"A": "2"} for p in pods)

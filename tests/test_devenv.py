@@ -158,6 +158,10 @@ def test_deleting_failed_duplicate_preserves_owner(harness):
 
 
 def test_devenv_with_chips_requests_tpu(harness):
+    """A chip-requesting devenv gets a real carve-out (scheduling/sharing.py)
+    once a TPU host exists — and stays Pending without capacity."""
+    from k8s_gpu_tpu.api.core import Node
+
     kube, mgr = harness
     env = DevEnv()
     env.metadata.name = "env-debug"
@@ -165,6 +169,21 @@ def test_devenv_with_chips_requests_tpu(harness):
     env.spec.ssh_public_key = PUBKEY
     env.spec.tpu_chips = 4
     kube.create(env)
+    assert mgr.wait_idle(
+        predicate=lambda: kube.get("DevEnv", "env-debug").status.phase
+        == "Pending"
+    )
+    n = Node()
+    n.metadata.name = "tpu-host"
+    n.capacity = {"google.com/tpu": 4}
+    n.allocatable = {"google.com/tpu": 4}
+    n.ready = True
+    kube.create(n)
+    # Wake the controller (spec touch): the retry is requeue_after=15s on a
+    # FakeClock, so advance past it instead of waiting wall-clock.
+    mgr.clock.advance(16)
     wait_ready(kube, mgr, "env-debug")
     pod = kube.get("Pod", "devenv-alice")
     assert pod.requests["google.com/tpu"] == 4
+    assert pod.env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    assert pod.node_name == "tpu-host"

@@ -1,0 +1,221 @@
+"""Chip-granular sharing — the HAMi role (C17), TPU-flavored: disjoint
+TPU_VISIBLE_CHIPS grants, best-fit anti-fragmentation, gang isolation, and
+the DevEnv integration (a 1-chip debug env on a shared host)."""
+
+import pytest
+
+from k8s_gpu_tpu.api.core import Node, Pod
+from k8s_gpu_tpu.api.devenv import DevEnv
+from k8s_gpu_tpu.controller import FakeKube, Manager
+from k8s_gpu_tpu.operators import DevEnvReconciler, TpuPodSliceReconciler
+from k8s_gpu_tpu.scheduling import (
+    ChipAllocator,
+    PlacementError,
+    place_gang,
+    TPU_RESOURCE,
+)
+from k8s_gpu_tpu.scheduling.labels import (
+    LABEL_ACCELERATOR,
+    LABEL_SLICE,
+    LABEL_WORKER_ID,
+)
+
+
+def tpu_node(name, chips=4, slice_name="s0", worker=0, accel="v4-8"):
+    n = Node()
+    n.metadata.name = name
+    n.capacity = {TPU_RESOURCE: chips}
+    n.allocatable = {TPU_RESOURCE: chips}
+    n.ready = True
+    n.metadata.labels = {
+        LABEL_ACCELERATOR: accel,
+        LABEL_SLICE: slice_name,
+        LABEL_WORKER_ID: str(worker),
+    }
+    return n
+
+
+def test_allocations_are_disjoint_and_env_shaped():
+    nodes = [tpu_node("n0")]
+    alloc = ChipAllocator()
+    a = alloc.allocate("p1", 2, nodes)
+    b = alloc.allocate("p2", 2, nodes)
+    assert set(a.chip_ids) & set(b.chip_ids) == set()
+    assert a.env["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert b.env["TPU_VISIBLE_CHIPS"] == "2,3"
+    assert nodes[0].allocatable[TPU_RESOURCE] == 0
+    with pytest.raises(PlacementError):
+        alloc.allocate("p3", 1, nodes)
+
+
+def test_best_fit_packs_fragmented_host_first():
+    n0, n1 = tpu_node("n0"), tpu_node("n1")
+    alloc = ChipAllocator()
+    alloc.allocate("p1", 3, [n0, n1])  # n0 now has 1 free chip
+    a = alloc.allocate("p2", 1, [n0, n1])
+    # 1-chip request goes to the fragmented host, keeping n1 pristine.
+    assert a.node == "n0"
+    assert n1.allocatable[TPU_RESOURCE] == 4
+
+
+def test_release_restores_capacity():
+    nodes = [tpu_node("n0")]
+    alloc = ChipAllocator()
+    alloc.allocate("p1", 4, nodes)
+    alloc.release("p1", nodes)
+    assert nodes[0].allocatable[TPU_RESOURCE] == 4
+    alloc.allocate("p2", 4, nodes)  # full host available again
+
+
+def test_from_pods_rebuilds_state_and_detects_double_grant():
+    nodes = [tpu_node("n0")]
+    p = Pod()
+    p.metadata.name = "p1"
+    p.node_name = "n0"
+    p.env = {"TPU_VISIBLE_CHIPS": "0,1"}
+    p.phase = "Running"
+    alloc = ChipAllocator.from_pods([p], nodes)
+    assert nodes[0].allocatable[TPU_RESOURCE] == 2
+    clash = Pod()
+    clash.metadata.name = "p2"
+    clash.node_name = "n0"
+    clash.env = {"TPU_VISIBLE_CHIPS": "1,2"}
+    clash.phase = "Running"
+    with pytest.raises(PlacementError):
+        ChipAllocator.from_pods([p, clash], nodes)
+
+
+def test_gang_placement_skips_shared_hosts():
+    # Slice s0's worker 0 has a carve-out; the 2-host gang must not use s0.
+    s0 = [tpu_node("a0", slice_name="s0", worker=0),
+          tpu_node("a1", slice_name="s0", worker=1)]
+    s1 = [tpu_node("b0", slice_name="s1", worker=0),
+          tpu_node("b1", slice_name="s1", worker=1)]
+    ChipAllocator().allocate("dev", 1, [s0[0]])
+    pods = []
+    for i in range(2):
+        p = Pod()
+        p.metadata.name = f"job-w-{i}"
+        pods.append(p)
+    placed = place_gang(pods, s0 + s1, "v4-8")
+    assert set(placed.values()) == {"b0", "b1"}
+
+
+def test_devenv_with_chips_end_to_end(kube: FakeKube, manager: Manager):
+    from k8s_gpu_tpu.api import TpuPodSlice
+    from k8s_gpu_tpu.cloud import FakeCloudTpu, cloudtpu_client_factory
+
+    cloud = FakeCloudTpu()
+    manager.register(
+        "TpuPodSlice",
+        TpuPodSliceReconciler(kube, cloudtpu_client_factory(cloud),
+                              provision_poll=0.02),
+    )
+    manager.register("DevEnv", DevEnvReconciler(kube))
+    manager.start()
+    ps = TpuPodSlice()
+    ps.metadata.name = "pool"
+    ps.spec.accelerator_type = "v4-8"
+    kube.create(ps)
+    assert manager.wait_idle(
+        timeout=20,
+        predicate=lambda: kube.get("TpuPodSlice", "pool").status.phase == "Ready",
+    )
+
+    env = DevEnv()
+    env.metadata.name = "dbg"
+    env.spec.username = "ada"
+    env.spec.ssh_public_key = "ssh-ed25519 AAAA ada"
+    env.spec.tpu_chips = 1
+    kube.create(env)
+    assert manager.wait_idle(
+        timeout=10,
+        predicate=lambda: kube.get("DevEnv", "dbg").status.phase == "Ready",
+    )
+    pod = kube.get("Pod", "devenv-ada")
+    assert pod.env["TPU_VISIBLE_CHIPS"] == "0"
+    assert pod.node_name
+    node = kube.get("Node", pod.node_name, "default")
+    assert node.allocatable[TPU_RESOURCE] == node.capacity[TPU_RESOURCE] - 1
+
+    # Teardown restores the chip.
+    kube.delete("DevEnv", "dbg")
+    assert manager.wait_idle(
+        timeout=10,
+        predicate=lambda: kube.try_get("Pod", "devenv-ada") is None,
+    )
+    node = kube.get("Node", pod.node_name, "default")
+    assert node.allocatable[TPU_RESOURCE] == node.capacity[TPU_RESOURCE]
+
+
+def test_devenv_pending_when_no_chips(kube: FakeKube, manager: Manager):
+    manager.register("DevEnv", DevEnvReconciler(kube))
+    manager.start()
+    env = DevEnv()
+    env.metadata.name = "dbg"
+    env.spec.username = "ada"
+    env.spec.ssh_public_key = "ssh-ed25519 AAAA ada"
+    env.spec.tpu_chips = 2
+    kube.create(env)
+    assert manager.wait_idle(
+        timeout=10,
+        predicate=lambda: kube.get("DevEnv", "dbg").status.phase == "Pending",
+    )
+    cur = kube.get("DevEnv", "dbg")
+    assert "free chip" in cur.status.message
+
+
+def test_grant_skips_gang_occupied_hosts(kube: FakeKube, manager: Manager):
+    """A host whose chips are held by a gang worker (TPU requests, no chip
+    grant) must never be carved up for a devenv."""
+    n_busy = tpu_node("busy0")
+    n_free = tpu_node("free0", slice_name="s1")
+    kube.create(n_busy)
+    kube.create(n_free)
+    gang = Pod()
+    gang.metadata.name = "job-w-0"
+    gang.node_name = "busy0"
+    gang.requests = {TPU_RESOURCE: 4}
+    gang.phase = "Running"
+    kube.create(gang)
+    manager.register("DevEnv", DevEnvReconciler(kube))
+    manager.start()
+    env = DevEnv()
+    env.metadata.name = "dbg"
+    env.spec.username = "ada"
+    env.spec.ssh_public_key = "ssh-ed25519 AAAA ada"
+    env.spec.tpu_chips = 1
+    kube.create(env)
+    assert manager.wait_idle(
+        timeout=10,
+        predicate=lambda: kube.get("DevEnv", "dbg").status.phase == "Ready",
+    )
+    assert kube.get("Pod", "devenv-ada").node_name == "free0"
+
+
+def test_chip_count_drift_replaces_pod(kube: FakeKube, manager: Manager):
+    kube.create(tpu_node("n0"))
+    manager.register("DevEnv", DevEnvReconciler(kube))
+    manager.start()
+    env = DevEnv()
+    env.metadata.name = "dbg"
+    env.spec.username = "ada"
+    env.spec.ssh_public_key = "ssh-ed25519 AAAA ada"
+    env.spec.tpu_chips = 1
+    kube.create(env)
+    assert manager.wait_idle(
+        timeout=10,
+        predicate=lambda: kube.get("DevEnv", "dbg").status.phase == "Ready",
+    )
+    cur = kube.get("DevEnv", "dbg")
+    cur.spec.tpu_chips = 3
+    kube.update(cur)
+    assert manager.wait_idle(
+        timeout=10,
+        predicate=lambda: kube.get("Pod", "devenv-ada").requests.get(
+            TPU_RESOURCE) == 3,
+    )
+    pod = kube.get("Pod", "devenv-ada")
+    assert pod.env["TPU_VISIBLE_CHIPS"] == "0,1,2"
+    node = kube.get("Node", "n0", "default")
+    assert node.allocatable[TPU_RESOURCE] == 1
