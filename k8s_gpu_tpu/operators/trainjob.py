@@ -28,7 +28,6 @@ from ..controller.manager import Reconciler, Request, Result
 from ..scheduling.labels import LABEL_ACCELERATOR, LABEL_SLICE, TPU_RESOURCE
 from ..scheduling.placement import PlacementError, multislice_spread, place_gang
 from ..scheduling.queueing import QueueAdmitter
-from ..train.registry import get_workload
 from ..utils.metrics import MetricsRegistry, global_metrics
 
 log = logging.getLogger("k8s_gpu_tpu.operators.trainjob")
@@ -59,6 +58,17 @@ class TrainJobReconciler(Reconciler):
 
     def _worker_pods(self, job: TrainJob) -> list[Pod]:
         accel = parse_accelerator_type(job.spec.accelerator_type)
+        # Rendezvous env — the Kubeflow-operator PET_* role
+        # (GPU调度平台搭建.md:606-630): worker 0's pod is the coordinator;
+        # inside the pod, parallel/multihost.initialize_from_env() joins
+        # the slice-wide JAX runtime.  (utils.rendezvous is the jax-free
+        # half — the controller must not load the JAX runtime.)
+        from ..utils.rendezvous import rendezvous_env
+
+        envs = rendezvous_env(
+            job.spec.num_workers,
+            coordinator_host=f"{self.pod_name(job, 0)}.{job.metadata.namespace}",
+        )
         pods = []
         for i in range(job.spec.num_workers):
             name = self.pod_name(job, i)
@@ -69,6 +79,7 @@ class TrainJobReconciler(Reconciler):
                 pod.metadata.namespace = job.metadata.namespace
                 pod.metadata.labels = {"job": job.metadata.name}
                 pod.group = job.metadata.name
+                pod.env = envs[i].as_env()
                 pod.requests = {
                     TPU_RESOURCE: min(
                         accel.generation.chips_per_host, accel.chips
@@ -265,6 +276,10 @@ class TrainJobReconciler(Reconciler):
 
     def _execute(self, job: TrainJob) -> dict:
         if job.spec.workload:
+            # Lazy: pulling the workload registry loads the JAX runtime;
+            # the controller itself must stay control-plane-light.
+            from ..train.registry import get_workload
+
             fn = get_workload(job.spec.workload)
             t0 = time.perf_counter()
             result = fn(job.spec, job.status.placements)

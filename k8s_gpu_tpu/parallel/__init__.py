@@ -6,6 +6,12 @@ from .sharding import (
     logical_to_spec,
 )
 from .collectives import psum_smoke, all_reduce_bandwidth_probe
+from .multihost import (
+    HostEnv,
+    initialize_from_env,
+    rendezvous_env,
+    spawn_local_cluster,
+)
 
 __all__ = [
     "MeshConfig",
@@ -17,4 +23,8 @@ __all__ = [
     "logical_to_spec",
     "psum_smoke",
     "all_reduce_bandwidth_probe",
+    "HostEnv",
+    "initialize_from_env",
+    "rendezvous_env",
+    "spawn_local_cluster",
 ]

@@ -1,0 +1,236 @@
+"""Multi-host process orchestration — the torchrun/PET_NNODES rendezvous
+role (reference GPU调度平台搭建.md:606-630), JAX-native.
+
+On a TPU slice every host runs the same program; ``jax.distributed
+.initialize`` connects them to a coordinator, after which ``jax.devices()``
+spans the whole slice and one pjit program drives global collectives.  The
+platform's side of the contract is env injection (the Kubeflow-operator
+role): the trainjob controller renders one pod per host with
+``TPU_COORDINATOR_ADDRESS / TPU_PROCESS_ID / TPU_PROCESS_COUNT`` —
+the analogue of torch elastic's ``PET_*`` variables — and this module
+consumes them inside the workload.
+
+``spawn_local_cluster`` is the test/simulation half (SURVEY §4 item 3:
+"multi-host paths tested with a spawned-process coordinator on
+localhost"): it forks N processes, each pinned to CPU with K virtual
+devices, initializes the distributed runtime across them, runs a caller
+function, and collects results — multi-host semantics (global device
+count, cross-process collectives) without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+from pathlib import Path
+
+# Pure contract lives in utils/rendezvous.py (jax-free, control-plane
+# importable); re-exported here for workload-side callers.
+from ..utils.rendezvous import (  # noqa: F401
+    ENV_COORDINATOR,
+    ENV_PROCESS_COUNT,
+    ENV_PROCESS_ID,
+    HostEnv,
+    rendezvous_env,
+)
+
+
+def initialize_from_env() -> bool:
+    """Inside a workload pod: join the slice-wide runtime if rendezvous env
+    is present.  Returns True when running multi-process."""
+    addr = os.environ.get(ENV_COORDINATOR)
+    if not addr:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(os.environ[ENV_PROCESS_COUNT]),
+        process_id=int(os.environ[ENV_PROCESS_ID]),
+    )
+    return True
+
+
+# -- built-in multi-host workloads (top-level: picklable by reference) -----
+
+def workload_device_report() -> dict:
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+    }
+
+
+def workload_global_psum() -> dict:
+    """Each process contributes (process_index + 1) per local device; the
+    global sum proves collectives cross the process boundary."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    local = np.full(
+        (jax.local_device_count(),), float(jax.process_index() + 1), np.float32
+    )
+    garr = multihost_utils.host_local_array_to_global_array(local, mesh, P("dp"))
+    total = jax.jit(
+        jnp.sum, out_shardings=NamedSharding(mesh, P())
+    )(garr)
+    return {"sum": float(total), "global_devices": jax.device_count()}
+
+
+def workload_train_step() -> dict:
+    """One dp-sharded flagship train step over the GLOBAL mesh: every
+    process feeds its local batch shard, XLA all-reduces gradients across
+    processes; identical loss on every process proves a coherent update."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    from ..models import TransformerConfig, TransformerLM
+    from .mesh import MeshConfig, mesh_from_devices
+    from ..train import TrainConfig, Trainer
+
+    mesh = mesh_from_devices(jax.devices(), MeshConfig(dp=-1))
+    model = TransformerLM(
+        TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=4, d_head=8,
+            d_ff=64, max_seq=32, use_flash=False,
+        )
+    )
+    trainer = Trainer(model, mesh=mesh,
+                      train_config=TrainConfig(warmup_steps=1))
+    trainer.init(jax.random.PRNGKey(0))
+
+    # Per-process local shard of the global batch (2 rows per device),
+    # deterministic per process so the run is reproducible.
+    rng = np.random.default_rng(jax.process_index())
+    local = rng.integers(
+        0, 128, size=(2 * jax.local_device_count(), 33), dtype=np.int32
+    )
+
+    def to_global(arr):
+        return multihost_utils.host_local_array_to_global_array(
+            arr, mesh, P("dp")
+        )
+
+    trainer.batch_specs = (P("dp"), P("dp"))
+    loss = trainer.step(to_global(local[:, :-1]), to_global(local[:, 1:]))
+    return {"loss": float(loss), "global_devices": jax.device_count()}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+_WORKER_TEMPLATE = """\
+import os, pickle, sys
+
+# CPU with K virtual devices BEFORE jax import (multi-host simulation).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count={devices_per_host}"
+).strip()
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, {repo_root!r})
+from k8s_gpu_tpu.parallel.multihost import initialize_from_env
+
+assert initialize_from_env(), "rendezvous env missing"
+
+fn = pickle.loads(open({fn_path!r}, "rb").read())
+out = fn()
+with open({out_path!r} + ".tmp", "wb") as f:
+    pickle.dump(out, f)
+os.replace({out_path!r} + ".tmp", {out_path!r})
+"""
+
+
+def spawn_local_cluster(
+    fn,
+    num_processes: int = 2,
+    devices_per_host: int = 4,
+    timeout: float = 180.0,
+) -> list:
+    """Run ``fn()`` in *num_processes* JAX processes joined through a local
+    coordinator; returns each process's (pickled) return value, ordered by
+    process id.  ``fn`` must be picklable (top-level function)."""
+    port = _free_port()
+    envs = rendezvous_env(num_processes, port=port)
+    repo_root = str(Path(__file__).resolve().parent.parent.parent)
+    with tempfile.TemporaryDirectory() as td:
+        fn_path = str(Path(td) / "fn.pkl")
+        Path(fn_path).write_bytes(pickle.dumps(fn))
+        procs = []
+        outs = []
+        for env in envs:
+            out_path = str(Path(td) / f"out-{env.process_id}.pkl")
+            outs.append(out_path)
+            script = _WORKER_TEMPLATE.format(
+                devices_per_host=devices_per_host,
+                repo_root=repo_root,
+                fn_path=fn_path,
+                out_path=out_path,
+            )
+            penv = dict(os.environ)
+            penv.update(env.as_env())
+            # A worker must not inherit the parent's single-device pin.
+            penv.pop("JAX_PLATFORMS", None)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", script],
+                    env=penv,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                )
+            )
+        results = []
+        failed = []
+        # One shared deadline across ALL workers: a crashed coordinator
+        # leaves the others hung in jax.distributed.initialize, and
+        # per-process timeouts would stack to N x timeout before reporting.
+        deadline = time.monotonic() + timeout
+        for p, env in zip(procs, envs):
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                _, err = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                _, err = p.communicate()
+                failed.append((env.process_id, "timeout", err))
+                continue
+            if p.returncode != 0:
+                failed.append((env.process_id, f"rc={p.returncode}", err))
+                # Fail fast: the cluster is dead without this worker.
+                deadline = min(deadline, time.monotonic() + 10.0)
+        if failed:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+            msgs = "\n".join(
+                f"worker {pid} {why}:\n"
+                + textwrap.indent((err or b"").decode(errors="replace")[-2000:], "  ")
+                for pid, why, err in failed
+            )
+            raise RuntimeError(f"multihost workers failed:\n{msgs}")
+        for out_path in outs:
+            results.append(pickle.loads(Path(out_path).read_bytes()))
+        return results

@@ -2,6 +2,7 @@ from .clock import Clock, RealClock, FakeClock
 from .metrics import MetricsRegistry, global_metrics
 from .logstore import LogEntry, LogStore, LogStoreHandler, global_logstore
 from .obs import MetricsServer
+from .profiling import profile_trainer, step_annotation, trace, trace_files
 
 __all__ = [
     "Clock",
@@ -14,4 +15,8 @@ __all__ = [
     "LogStoreHandler",
     "global_logstore",
     "MetricsServer",
+    "trace",
+    "step_annotation",
+    "profile_trainer",
+    "trace_files",
 ]

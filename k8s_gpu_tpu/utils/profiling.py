@@ -1,0 +1,64 @@
+"""Profiling — the tracing half of SURVEY §5.1 ("JAX profiler traces,
+XLA/TensorBoard"), absent from the reference (stdout logs only;
+GPU调度平台搭建.md:798-807 monitors utilization, never traces).
+
+Thin, dependency-free wrappers over ``jax.profiler``: a trace context that
+captures device/XLA activity into a TensorBoard-readable directory, step
+annotations so train steps show as named rows, and a helper that profiles
+N steps of a Trainer.  On TPU the trace includes per-op device timing and
+HBM usage — the tool for verifying the MXU is actually busy.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from pathlib import Path
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | Path):
+    """Capture a profiler trace into *log_dir* (view with TensorBoard's
+    profile plugin, or xprof)."""
+    import jax  # lazy: utils is imported by the jax-free control plane
+
+    log_dir = Path(log_dir)
+    log_dir.mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(name: str, step: int):
+    """Marks a training step in the trace timeline."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+def profile_trainer(trainer, data_iter, steps: int,
+                    log_dir: str | Path) -> dict:
+    """Profile *steps* steps (after one un-traced warmup/compile step so the
+    trace shows steady-state device time, not compilation).  Returns
+    {trace_dir, steps, mean_step_s}."""
+    batch = next(data_iter)
+    trainer.step(*batch)  # compile outside the trace
+    t0 = time.perf_counter()
+    with trace(log_dir) as d:
+        for i in range(steps):
+            with step_annotation("train", i):
+                batch = next(data_iter)
+                trainer.step(*batch)
+    wall = time.perf_counter() - t0
+    return {
+        "trace_dir": str(d),
+        "steps": steps,
+        "mean_step_s": wall / max(1, steps),
+    }
+
+
+def trace_files(log_dir: str | Path) -> list[Path]:
+    """The .xplane.pb artifacts a capture produced (empty = no capture)."""
+    return sorted(Path(log_dir).rglob("*.xplane.pb"))

@@ -1,0 +1,42 @@
+"""Rendezvous env contract — the pure (jax-free) half of multi-host
+orchestration, importable by the control plane.
+
+The trainjob controller injects these variables into worker pods (the
+Kubeflow-operator PET_* role, reference GPU调度平台搭建.md:606-630); the
+workload side (`parallel/multihost.py`) consumes them with
+``jax.distributed.initialize``.  Split out so reconcilers never import
+the JAX runtime just to render pod env.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+ENV_COORDINATOR = "TPU_COORDINATOR_ADDRESS"
+ENV_PROCESS_ID = "TPU_PROCESS_ID"
+ENV_PROCESS_COUNT = "TPU_PROCESS_COUNT"
+
+
+@dataclass(frozen=True)
+class HostEnv:
+    """The per-host rendezvous env the trainjob controller injects."""
+
+    coordinator_address: str
+    process_id: int
+    process_count: int
+
+    def as_env(self) -> dict[str, str]:
+        return {
+            ENV_COORDINATOR: self.coordinator_address,
+            ENV_PROCESS_ID: str(self.process_id),
+            ENV_PROCESS_COUNT: str(self.process_count),
+        }
+
+
+def rendezvous_env(
+    hosts: int, coordinator_host: str = "localhost", port: int = 8476
+) -> list[HostEnv]:
+    """Env for each of *hosts* workers; worker 0's host is the coordinator
+    (the torchrun master_addr convention)."""
+    addr = f"{coordinator_host}:{port}"
+    return [HostEnv(addr, i, hosts) for i in range(hosts)]

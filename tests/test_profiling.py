@@ -1,0 +1,37 @@
+"""Profiler traces (SURVEY §5.1): capture produces TensorBoard-readable
+xplane artifacts; profile_trainer excludes compile from the trace window."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_gpu_tpu.utils import profile_trainer, trace, trace_files
+
+
+def test_trace_captures_xplane(tmp_path):
+    with trace(tmp_path / "tb"):
+        jax.jit(lambda x: (x @ x).sum())(jnp.ones((64, 64))).block_until_ready()
+    assert trace_files(tmp_path / "tb"), "no .xplane.pb produced"
+
+
+def test_profile_trainer(tmp_path):
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+    from k8s_gpu_tpu.parallel import MeshConfig
+    from k8s_gpu_tpu.parallel.mesh import build_mesh
+    from k8s_gpu_tpu.train import TrainConfig, Trainer
+
+    model = TransformerLM(TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=16,
+        d_ff=64, max_seq=16, use_flash=False))
+    trainer = Trainer(model, mesh=build_mesh(MeshConfig(dp=2), n_devices=2),
+                      train_config=TrainConfig(warmup_steps=1))
+    trainer.init(jax.random.PRNGKey(0))
+    toks = np.random.default_rng(0).integers(0, 64, (4, 17), dtype=np.int32)
+
+    def it():
+        while True:
+            yield toks[:, :-1], toks[:, 1:]
+
+    out = profile_trainer(trainer, it(), steps=3, log_dir=tmp_path / "prof")
+    assert out["steps"] == 3 and out["mean_step_s"] > 0
+    assert trace_files(out["trace_dir"])
