@@ -46,8 +46,11 @@ class TransformerConfig:
     dtype: object = jnp.bfloat16
     remat: bool = True
     # Pallas flash-attention kernel for the unsharded-sequence path
-    # (ops/attention.py); ring attention handles the sp-sharded path.
+    # (ops/attention.py); the sp-sharded path uses sp_attention:
+    # "ring" (ppermute streaming, any head count) or "ulysses"
+    # (all-to-all head regrouping, needs heads/tp divisible by sp).
     use_flash: bool = True
+    sp_attention: str = "ring"
     flash_block_q: int = 256
     flash_block_k: int = 256
     # Microbatches for the pipeline schedule (0 = one per stage).
@@ -155,7 +158,17 @@ class TransformerLM:
         k = self._rope(k, positions)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
         if seq_sharded:
-            o = ring_attention(q, k, v, mesh)
+            if cfg.sp_attention == "ulysses":
+                from ..parallel.ulysses import ulysses_attention
+
+                o = ulysses_attention(q, k, v, mesh)
+            elif cfg.sp_attention == "ring":
+                o = ring_attention(q, k, v, mesh)
+            else:
+                raise ValueError(
+                    f"unknown sp_attention {cfg.sp_attention!r}; "
+                    "expected 'ring' or 'ulysses'"
+                )
         elif cfg.use_flash:
             from ..ops.attention import flash_attention
 

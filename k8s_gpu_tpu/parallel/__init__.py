@@ -6,6 +6,7 @@ from .sharding import (
     logical_to_spec,
 )
 from .collectives import psum_smoke, all_reduce_bandwidth_probe
+from .ulysses import ulysses_attention
 from .multihost import (
     HostEnv,
     initialize_from_env,
@@ -23,6 +24,7 @@ __all__ = [
     "logical_to_spec",
     "psum_smoke",
     "all_reduce_bandwidth_probe",
+    "ulysses_attention",
     "HostEnv",
     "initialize_from_env",
     "rendezvous_env",

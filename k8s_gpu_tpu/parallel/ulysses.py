@@ -1,0 +1,87 @@
+"""Ulysses-style all-to-all sequence parallelism over the 'sp' mesh axis.
+
+The second long-context strategy next to ring attention (SURVEY §5.7 asks
+for "ring attention or all-to-all sequence/context parallelism"; this
+framework ships both).  DeepSpeed-Ulysses (Jacobs et al.) re-shards
+*around* attention instead of streaming K/V:
+
+    [B, H, S/P, D]  --all_to_all-->  [B, H/P, S, D]
+         (seq-sharded)                   (head-sharded, full sequence)
+
+Each device then runs ordinary causal attention for its H/P heads over
+the FULL sequence — any attention kernel drops in unchanged — and a
+second all-to-all restores sequence sharding for the rest of the block.
+
+Trade-off vs ring: two all-to-alls (cheap on ICI's all-to-all-friendly
+torus) instead of P ppermute hops, and exact attention with no online
+softmax — but it requires heads % sp == 0, and per-device attention
+memory is O(S·S/heads-group) rather than ring's O(S·S/sp).  Pick ring
+when S is extreme, Ulysses when the head count divides cleanly (the
+TransformerConfig.sp_attention switch).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring_attention import plain_causal_attention
+
+
+def _ulysses_local(q, k, v, *, axis_name):
+    """Per-device body under shard_map: inputs are the local sequence
+    blocks [B, H, S/P, D]."""
+    def seq_to_heads(x):
+        # [B, H, S/P, D] -> [B, H/P, S, D]: split heads across the group,
+        # gather the full sequence.
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    o = plain_causal_attention(q, k, v)
+    return heads_to_seq(o)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    batch_axes=("dp",),
+    head_axes=("tp",),
+) -> jax.Array:
+    """Causal self-attention with sequence sharded over *axis_name*.
+
+    Same contract as ring_attention: q,k,v [B, H, S, D] global view with
+    S over sp, B over dp, H over tp; returns the same sharding.  Requires
+    the local head count to be divisible by mesh.shape[axis_name].
+    """
+    sp = mesh.shape[axis_name]
+    tp = 1
+    for ax in head_axes:
+        tp *= mesh.shape.get(ax, 1)
+    local_heads = q.shape[1] // tp
+    if local_heads % sp != 0:
+        raise ValueError(
+            f"ulysses needs local heads ({q.shape[1]}/{tp}={local_heads}) "
+            f"divisible by sp={sp}; use ring attention instead"
+        )
+    spec = P(batch_axes, head_axes, axis_name, None)
+    body = partial(_ulysses_local, axis_name=axis_name)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
