@@ -85,11 +85,14 @@ def decode_probe(model, params) -> dict:
     # Warmup with the SAME static args as the timed call: max_new_tokens
     # is a static jit arg, so a different value would recompile inside
     # the timed region.
-    engine.generate(params, prompt, max_new_tokens=n_new)
+    jax.block_until_ready(
+        engine.generate(params, prompt, max_new_tokens=n_new).tokens
+    )
     t0 = time.perf_counter()
     out = engine.generate(params, prompt, max_new_tokens=n_new)
+    # TPU dispatch is async: without the sync this measures enqueue time.
+    jax.block_until_ready(out.tokens)
     dt = time.perf_counter() - t0
-    del out
     return {"decode_tokens_per_s": n_new / dt}
 
 

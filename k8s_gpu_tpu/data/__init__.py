@@ -1,5 +1,6 @@
-"""Host-side data pipeline: native tokenized-batch loader + Python fallback."""
+"""Host-side data pipeline: native tokenized-batch loader + BPE tokenizer."""
 
 from .loader import TokenLoader, native_available, write_tokens
+from .tokenizer import BpeTokenizer
 
-__all__ = ["TokenLoader", "native_available", "write_tokens"]
+__all__ = ["TokenLoader", "native_available", "write_tokens", "BpeTokenizer"]

@@ -38,11 +38,17 @@ def _load_native():
         return _lib
     _lib_tried = True
     try:
-        if not _LIB_PATH.exists():
+        # Always invoke make: it is incremental (no-op when fresh) and
+        # rebuilds a stale .so from before a source was added — loading a
+        # stale library would fail later with missing symbols.
+        try:
             subprocess.run(
                 ["make", "-s"], cwd=_NATIVE_DIR, check=True,
                 capture_output=True, timeout=120,
             )
+        except Exception:
+            if not _LIB_PATH.exists():
+                raise
         lib = ctypes.CDLL(str(_LIB_PATH))
         lib.dl_open.restype = ctypes.c_void_p
         lib.dl_open.argtypes = [

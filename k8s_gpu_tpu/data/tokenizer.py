@@ -1,0 +1,232 @@
+"""Byte-level BPE tokenizer: ctypes binding over native/tokenizer.cc with
+a bit-exact Python fallback.
+
+Completes the host data pipeline: raw text → ``BpeTokenizer.encode`` →
+``write_tokens`` → the native batch loader (loader.py).  Both backends run
+the identical deterministic algorithm (most-frequent pair, ties to the
+smallest pair, left-to-right greedy application), so a vocabulary trained
+by either encodes identically under both — tests assert it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from pathlib import Path
+
+import numpy as np
+
+from .loader import _load_native
+
+_tok_configured = False
+
+
+def _lib():
+    """The shared native library, with tokenizer prototypes configured."""
+    global _tok_configured
+    lib = _load_native()
+    if lib is None:
+        return None
+    if not hasattr(lib, "tok_train"):
+        # Stale prebuilt library without the tokenizer symbols (and make
+        # could not refresh it): fall back to the Python implementation.
+        return None
+    if not _tok_configured:
+        lib.tok_train.restype = ctypes.c_void_p
+        lib.tok_train.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64
+        ]
+        lib.tok_num_merges.restype = ctypes.c_uint64
+        lib.tok_num_merges.argtypes = [ctypes.c_void_p]
+        lib.tok_merges.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.tok_from_merges.restype = ctypes.c_void_p
+        lib.tok_from_merges.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.tok_encode.restype = ctypes.c_int64
+        lib.tok_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p
+        ]
+        lib.tok_decode.restype = ctypes.c_int64
+        lib.tok_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.tok_free.argtypes = [ctypes.c_void_p]
+        _tok_configured = True
+    return lib
+
+
+# -- pure-Python reference algorithm (mirrors tokenizer.cc exactly) --------
+
+def _train_merges_python(data: bytes, vocab_size: int) -> list[tuple[int, int]]:
+    toks = list(data)
+    merges: list[tuple[int, int]] = []
+    next_id = 256
+    while next_id < vocab_size:
+        counts: dict[tuple[int, int], int] = {}
+        for a, b in zip(toks, toks[1:]):
+            counts[(a, b)] = counts.get((a, b), 0) + 1
+        best, best_n = None, 1
+        # sorted(): the C++ side iterates an ordered map, so ties resolve
+        # to the smallest pair there; match it.
+        for p in sorted(counts):
+            if counts[p] > best_n:
+                best, best_n = p, counts[p]
+        if best is None:
+            break
+        merges.append(best)
+        toks = _apply_merge(toks, best, next_id)
+        next_id += 1
+    return merges
+
+
+def _apply_merge(toks: list[int], pair: tuple[int, int], new_id: int) -> list[int]:
+    out = []
+    i = 0
+    while i < len(toks):
+        if i + 1 < len(toks) and (toks[i], toks[i + 1]) == pair:
+            out.append(new_id)
+            i += 2
+        else:
+            out.append(toks[i])
+            i += 1
+    return out
+
+
+def _encode_python(data: bytes, rank: dict[tuple[int, int], int]) -> list[int]:
+    toks = list(data)
+    while True:
+        best_rank, best = None, None
+        for p in zip(toks, toks[1:]):
+            r = rank.get(p)
+            if r is not None and (best_rank is None or r < best_rank):
+                best_rank, best = r, p
+        if best is None:
+            return toks
+        toks = _apply_merge(toks, best, 256 + best_rank)
+
+
+class BpeTokenizer:
+    """vocab = 256 byte tokens + one token per merge."""
+
+    def __init__(self, merges: list[tuple[int, int]], backend: str = "auto"):
+        self.merges = [tuple(m) for m in merges]
+        self.rank = {p: i for i, p in enumerate(self.merges)}
+        if backend == "auto":
+            backend = "native" if _lib() is not None else "python"
+        self.backend = backend
+        self._handle = None
+        if backend == "native":
+            lib = _lib()
+            if lib is None:
+                raise RuntimeError("native tokenizer unavailable")
+            flat = np.asarray(self.merges, dtype=np.int32).reshape(-1)
+            self._handle = lib.tok_from_merges(
+                flat.ctypes.data_as(ctypes.c_void_p), len(self.merges)
+            )
+
+    # -- training ----------------------------------------------------------
+    @classmethod
+    def train(cls, text: str | bytes, vocab_size: int,
+              backend: str = "auto") -> "BpeTokenizer":
+        data = text.encode() if isinstance(text, str) else text
+        if backend == "auto":
+            backend = "native" if _lib() is not None else "python"
+        if backend == "native":
+            lib = _lib()
+            if lib is None:
+                raise RuntimeError("native tokenizer unavailable")
+            h = lib.tok_train(data, len(data), vocab_size)
+            n = lib.tok_num_merges(h)
+            flat = np.empty(2 * n, dtype=np.int32)
+            lib.tok_merges(h, flat.ctypes.data_as(ctypes.c_void_p))
+            lib.tok_free(h)
+            merges = [tuple(p) for p in flat.reshape(-1, 2).tolist()]
+        else:
+            merges = _train_merges_python(data, vocab_size)
+        return cls(merges, backend=backend)
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges)
+
+    # -- encode/decode -----------------------------------------------------
+    def encode(self, text: str | bytes) -> np.ndarray:
+        data = text.encode() if isinstance(text, str) else text
+        if not data:
+            return np.empty(0, dtype=np.int32)
+        if self._handle is not None:
+            out = np.empty(len(data), dtype=np.int32)
+            n = _lib().tok_encode(
+                self._handle, data, len(data),
+                out.ctypes.data_as(ctypes.c_void_p),
+            )
+            return out[:n].copy()
+        return np.asarray(_encode_python(data, self.rank), dtype=np.int32)
+
+    def decode(self, tokens) -> str:
+        # ascontiguousarray: a strided view's ctypes pointer would read
+        # adjacent memory the caller never passed.
+        toks = np.ascontiguousarray(tokens, dtype=np.int32)
+        if toks.size == 0:
+            return ""
+        if toks.min() < 0 or toks.max() >= self.vocab_size:
+            raise ValueError(
+                f"token ids outside [0, {self.vocab_size}): "
+                f"[{toks.min()}, {toks.max()}]"
+            )
+        if self._handle is not None:
+            cap = int(self._expansion_lengths()[toks].sum()) + 1
+            buf = ctypes.create_string_buffer(cap)
+            n = _lib().tok_decode(
+                self._handle, toks.ctypes.data_as(ctypes.c_void_p),
+                toks.size, buf, cap,
+            )
+            if n < 0:
+                raise ValueError("invalid token id or buffer too small")
+            return buf.raw[:n].decode(errors="replace")
+        out = bytearray()
+        for t in toks.tolist():
+            stack = [t]
+            while stack:
+                cur = stack.pop()
+                if cur < 256:
+                    if cur < 0:
+                        raise ValueError(f"invalid token id {cur}")
+                    out.append(cur)
+                else:
+                    m = cur - 256
+                    if m >= len(self.merges):
+                        raise ValueError(f"invalid token id {cur}")
+                    left, right = self.merges[m]
+                    stack.append(right)
+                    stack.append(left)
+        return bytes(out).decode(errors="replace")
+
+    def _expansion_lengths(self) -> np.ndarray:
+        """Decoded byte length per token id (exact decode-buffer sizing)."""
+        if not hasattr(self, "_exp_lens"):
+            lens = np.ones(self.vocab_size, dtype=np.int64)
+            for m, (a, b) in enumerate(self.merges):
+                lens[256 + m] = lens[a] + lens[b]
+            self._exp_lens = lens
+        return self._exp_lens
+
+    # -- persistence (vocabulary as a versionable artifact) ----------------
+    def save(self, path: str | Path) -> Path:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps({"merges": self.merges}))
+        return path
+
+    @classmethod
+    def load(cls, path: str | Path, backend: str = "auto") -> "BpeTokenizer":
+        merges = json.loads(Path(path).read_text())["merges"]
+        return cls([tuple(m) for m in merges], backend=backend)
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            if self._handle is not None:
+                _lib().tok_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass

@@ -1,0 +1,189 @@
+// Native byte-level BPE tokenizer — the text→tokens front of the data
+// pipeline (feeds the token files native/dataloader.cc consumes).
+//
+// The reference's workloads take pre-tokenized torchvision datasets
+// (GPU调度平台搭建.md:584-604); an LM platform needs its own tokenizer, and
+// BPE training/encoding is a byte-crunching loop that belongs in native
+// code. C ABI for ctypes; k8s_gpu_tpu/data/tokenizer.py mirrors the exact
+// algorithm in Python (tests assert merge-table and encoding parity).
+//
+// Algorithm (deterministic on purpose, so both implementations agree):
+// - byte-level: base vocabulary is the 256 byte values;
+// - training: repeatedly count adjacent pairs, merge the most frequent
+//   (ties -> smallest (left, right) pair), left-to-right greedy apply;
+// - encoding: repeatedly merge the present pair with the lowest rank
+//   until no mergeable pair remains.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <queue>
+#include <utility>
+#include <vector>
+
+namespace {
+
+using Pair = std::pair<int32_t, int32_t>;
+
+struct Tokenizer {
+  // merges[i] = the pair merged into token id (256 + i).
+  std::vector<Pair> merges;
+  std::map<Pair, int32_t> rank;  // pair -> merge index (lower = earlier)
+
+  void index() {
+    rank.clear();
+    for (size_t i = 0; i < merges.size(); ++i)
+      rank[merges[i]] = static_cast<int32_t>(i);
+  }
+};
+
+// Left-to-right greedy application of one merge.
+void apply_merge(std::vector<int32_t>& toks, Pair p, int32_t new_id) {
+  size_t w = 0;
+  for (size_t i = 0; i < toks.size();) {
+    if (i + 1 < toks.size() && toks[i] == p.first && toks[i + 1] == p.second) {
+      toks[w++] = new_id;
+      i += 2;
+    } else {
+      toks[w++] = toks[i++];
+    }
+  }
+  toks.resize(w);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Train on a UTF-8/byte buffer; returns a handle. vocab_size includes the
+// 256 byte tokens (so vocab_size - 256 merges at most). Training stops
+// early when no pair occurs twice.
+void* tok_train(const uint8_t* text, uint64_t len, uint64_t vocab_size) {
+  auto* T = new Tokenizer();
+  std::vector<int32_t> toks(text, text + len);
+  int32_t next_id = 256;
+  while (static_cast<uint64_t>(next_id) < vocab_size) {
+    std::map<Pair, uint64_t> counts;  // ordered: deterministic ties
+    for (size_t i = 0; i + 1 < toks.size(); ++i)
+      counts[{toks[i], toks[i + 1]}]++;
+    Pair best{-1, -1};
+    uint64_t best_n = 1;  // require >= 2 occurrences
+    for (const auto& [p, n] : counts) {
+      if (n > best_n) {  // strict >: first (smallest) pair wins ties
+        best = p;
+        best_n = n;
+      }
+    }
+    if (best.first < 0) break;
+    T->merges.push_back(best);
+    apply_merge(toks, best, next_id);
+    ++next_id;
+  }
+  T->index();
+  return T;
+}
+
+uint64_t tok_num_merges(void* h) {
+  return static_cast<Tokenizer*>(h)->merges.size();
+}
+
+// Copies merges as flat (left, right) int32 pairs.
+void tok_merges(void* h, int32_t* out) {
+  auto* T = static_cast<Tokenizer*>(h);
+  for (size_t i = 0; i < T->merges.size(); ++i) {
+    out[2 * i] = T->merges[i].first;
+    out[2 * i + 1] = T->merges[i].second;
+  }
+}
+
+void* tok_from_merges(const int32_t* pairs, uint64_t n) {
+  auto* T = new Tokenizer();
+  T->merges.reserve(n);
+  for (uint64_t i = 0; i < n; ++i)
+    T->merges.emplace_back(pairs[2 * i], pairs[2 * i + 1]);
+  T->index();
+  return T;
+}
+
+// Encode bytes -> tokens. Returns token count (<= len). out must hold
+// at least len entries.
+//
+// O(n log n): doubly-linked token list + min-heap of (rank, position)
+// candidates with lazy invalidation. Popping in (rank, pos) order
+// reproduces the reference sweep semantics exactly: ranks are unique per
+// pair, occurrences of the winning pair merge left-to-right, and pairs
+// created by a merge only compete under their own (later-found) rank.
+int64_t tok_encode(void* h, const uint8_t* text, uint64_t len, int32_t* out) {
+  auto* T = static_cast<Tokenizer*>(h);
+  if (len == 0) return 0;
+  const size_t n = len;
+  std::vector<int32_t> tok(text, text + len);
+  std::vector<int64_t> prev(n), next(n);
+  for (size_t i = 0; i < n; ++i) {
+    prev[i] = static_cast<int64_t>(i) - 1;
+    next[i] = (i + 1 < n) ? static_cast<int64_t>(i + 1) : -1;
+  }
+  std::vector<char> alive(n, 1);
+
+  using Entry = std::pair<int32_t, int64_t>;  // (rank, left position)
+  std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap;
+  auto push_pair = [&](int64_t i) {
+    if (i < 0 || next[i] < 0) return;
+    auto it = T->rank.find({tok[i], tok[next[i]]});
+    if (it != T->rank.end()) heap.emplace(it->second, i);
+  };
+  for (size_t i = 0; i + 1 < n; ++i) push_pair(static_cast<int64_t>(i));
+
+  while (!heap.empty()) {
+    auto [rank, i] = heap.top();
+    heap.pop();
+    // Lazy validation: the entry may refer to consumed nodes or a pair
+    // that changed since it was pushed.
+    if (!alive[i]) continue;
+    int64_t j = next[i];
+    if (j < 0 || !alive[j]) continue;
+    const Pair& p = T->merges[rank];
+    if (tok[i] != p.first || tok[j] != p.second) continue;
+    tok[i] = 256 + rank;
+    alive[j] = 0;
+    next[i] = next[j];
+    if (next[j] >= 0) prev[next[j]] = i;
+    push_pair(prev[i]);
+    push_pair(i);
+  }
+
+  int64_t w = 0;
+  for (int64_t i = 0; i >= 0; i = next[i])
+    if (alive[i]) out[w++] = tok[i];
+  return w;
+}
+
+// Decode tokens -> bytes. Returns byte count, or -1 if out_cap is too
+// small (call again with a bigger buffer) or a token id is invalid.
+int64_t tok_decode(void* h, const int32_t* toks, uint64_t n, uint8_t* out,
+                   uint64_t out_cap) {
+  auto* T = static_cast<Tokenizer*>(h);
+  std::vector<int32_t> stack;
+  size_t w = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    stack.push_back(toks[i]);
+    while (!stack.empty()) {
+      int32_t t = stack.back();
+      stack.pop_back();
+      if (t < 256) {
+        if (t < 0 || w >= out_cap) return -1;
+        out[w++] = static_cast<uint8_t>(t);
+      } else {
+        size_t m = static_cast<size_t>(t - 256);
+        if (m >= T->merges.size()) return -1;
+        stack.push_back(T->merges[m].second);  // LIFO: left pops first
+        stack.push_back(T->merges[m].first);
+      }
+    }
+  }
+  return static_cast<int64_t>(w);
+}
+
+void tok_free(void* h) { delete static_cast<Tokenizer*>(h); }
+
+}  // extern "C"
