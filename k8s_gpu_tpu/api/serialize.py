@@ -1,0 +1,166 @@
+"""YAML/dict manifests for every API kind — the ``kubectl apply -f`` wire
+format (the reference's user surface: sample CRs applied as YAML, reference
+README.md:265-289; the BASELINE north star is literally ``kubectl apply -f
+tpupodslice.yaml``).
+
+One generic dataclass codec: fields serialize camelCased (k8s convention),
+nested dataclasses and lists of dataclasses recurse via type hints, and
+deserialization rejects unknown fields (kubebuilder strict-schema
+behavior) so a typo'd manifest fails loudly instead of silently dropping
+the field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import types as _types
+import typing
+
+import yaml
+
+from .types import CustomResource, ValidationError
+
+_KIND_REGISTRY: dict[str, type] = {}
+
+
+def register_kind(cls: type) -> type:
+    _KIND_REGISTRY[cls().kind if dataclasses.is_dataclass(cls) else cls.kind] = cls
+    return cls
+
+
+def known_kinds() -> list[str]:
+    _ensure_registry()
+    return sorted(_KIND_REGISTRY)
+
+
+def _ensure_registry() -> None:
+    if _KIND_REGISTRY:
+        return
+    from . import core, azurevmpool, devenv, queue, tenancy, tpupodslice, trainjob
+
+    for mod in (core, azurevmpool, devenv, queue, tenancy, tpupodslice, trainjob):
+        for name in dir(mod):
+            obj = getattr(mod, name)
+            if (
+                isinstance(obj, type)
+                and dataclasses.is_dataclass(obj)
+                and issubclass(obj, CustomResource)
+                and obj is not CustomResource
+            ):
+                _KIND_REGISTRY[obj().kind] = obj
+
+
+def _camel(s: str) -> str:
+    head, *rest = s.split("_")
+    return head + "".join(p.title() for p in rest)
+
+
+def _snake(s: str) -> str:
+    return re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", s).lower()
+
+
+def _encode(value):
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        out = {}
+        for f in dataclasses.fields(value):
+            v = getattr(value, f.name)
+            out[_camel(f.name)] = _encode(v)
+        return out
+    if isinstance(value, (list, tuple)):
+        return [_encode(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _encode(v) for k, v in value.items()}
+    return value
+
+
+def _decode_into(cls: type, data: dict, path: str):
+    hints = typing.get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, raw in data.items():
+        name = _snake(key)
+        if name not in fields:
+            raise ValidationError(f"unknown field {path}.{key}")
+        kwargs[name] = _decode_value(hints.get(name), raw, f"{path}.{key}")
+    return cls(**kwargs)
+
+
+def _decode_value(hint, raw, path: str):
+    origin = typing.get_origin(hint)
+    if origin in (typing.Union, _types.UnionType):
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        hint = args[0] if args else None
+        origin = typing.get_origin(hint)
+    if hint is not None and dataclasses.is_dataclass(hint):
+        if not isinstance(raw, dict):
+            raise ValidationError(f"{path} must be a mapping")
+        return _decode_into(hint, raw, path)
+    if origin in (list, tuple):
+        (elem,) = typing.get_args(hint) or (None,)
+        if not isinstance(raw, list):
+            raise ValidationError(f"{path} must be a list")
+        return [
+            _decode_value(elem, v, f"{path}[{i}]") for i, v in enumerate(raw)
+        ]
+    return raw
+
+
+# -- public API ------------------------------------------------------------
+
+def to_manifest(obj: CustomResource) -> dict:
+    """CR -> kubectl-shaped dict: apiVersion/kind/metadata/spec[/status]."""
+    out = {"apiVersion": obj.api_version, "kind": obj.kind}
+    meta = {"name": obj.metadata.name, "namespace": obj.metadata.namespace}
+    if obj.metadata.labels:
+        meta["labels"] = dict(obj.metadata.labels)
+    if obj.metadata.annotations:
+        meta["annotations"] = dict(obj.metadata.annotations)
+    out["metadata"] = meta
+    for f in dataclasses.fields(obj):
+        if f.name in ("metadata", "api_version", "kind"):
+            continue
+        out[_camel(f.name)] = _encode(getattr(obj, f.name))
+    return out
+
+
+def to_yaml(obj: CustomResource) -> str:
+    return yaml.safe_dump(to_manifest(obj), sort_keys=False)
+
+
+def from_manifest(doc: dict) -> CustomResource:
+    _ensure_registry()
+    if not isinstance(doc, dict):
+        raise ValidationError("manifest must be a mapping")
+    kind = doc.get("kind")
+    cls = _KIND_REGISTRY.get(kind)
+    if cls is None:
+        raise ValidationError(
+            f"unknown kind {kind!r}; known: {sorted(_KIND_REGISTRY)}"
+        )
+    obj = cls()
+    meta = doc.get("metadata") or {}
+    obj.metadata.name = meta.get("name", "")
+    obj.metadata.namespace = meta.get("namespace", "default")
+    obj.metadata.labels = dict(meta.get("labels") or {})
+    obj.metadata.annotations = dict(meta.get("annotations") or {})
+    hints = typing.get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    for key, raw in doc.items():
+        if key in ("apiVersion", "kind", "metadata", "status"):
+            continue  # status is controller-owned; ignore on apply
+        name = _snake(key)
+        if name not in fields:
+            raise ValidationError(f"unknown field .{key} for kind {kind}")
+        setattr(obj, name, _decode_value(hints.get(name), raw, f".{key}"))
+    return obj
+
+
+def load_manifests(text: str) -> list[CustomResource]:
+    """Parse a (possibly multi-document) YAML stream of manifests."""
+    out = []
+    for doc in yaml.safe_load_all(text):
+        if doc is None:
+            continue
+        out.append(from_manifest(doc))
+    return out

@@ -337,6 +337,104 @@ def cmd_devenv(args) -> int:
         p.close()
 
 
+def cmd_apply(args) -> int:
+    """kubectl-style manifest verbs: apply -f (create-or-update), get,
+    delete — the reference's core UX (README.md:287-289: `kubectl apply`
+    the sample CR, observe with `kubectl get azurevmpool`)."""
+    from ..api.serialize import known_kinds, load_manifests, to_yaml
+    from ..api.types import ValidationError
+    from ..controller.kubefake import Conflict, NotFound
+
+    ctx = _require_login(CliConfig.load())
+    p = LocalPlatform()
+    try:
+        if args.file_cmd == "apply":
+            try:
+                objs = load_manifests(Path(args.file).read_text())
+            except (OSError, ValidationError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            for obj in objs:
+                if not obj.metadata.namespace or obj.metadata.namespace == "default":
+                    obj.metadata.namespace = ctx.space or "default"
+                # Retry on Conflict: background reconcilers may bump the
+                # resourceVersion between read and write.
+                for attempt in range(5):
+                    cur = p.kube.try_get(
+                        obj.kind, obj.metadata.name, obj.metadata.namespace
+                    )
+                    try:
+                        if cur is None:
+                            p.kube.create(obj)
+                            print(f"{obj.kind.lower()}/{obj.metadata.name} "
+                                  "created")
+                        else:
+                            obj.metadata.resource_version = (
+                                cur.metadata.resource_version
+                            )
+                            obj.metadata.creation_timestamp = (
+                                cur.metadata.creation_timestamp
+                            )
+                            obj.metadata.finalizers = list(
+                                cur.metadata.finalizers
+                            )
+                            p.kube.update(obj)
+                            print(f"{obj.kind.lower()}/{obj.metadata.name} "
+                                  "configured")
+                        break
+                    except ValidationError as e:
+                        print(f"error: {obj.kind}/{obj.metadata.name}: {e}",
+                              file=sys.stderr)
+                        return 1
+                    except Conflict:
+                        if attempt == 4:
+                            print(f"error: {obj.kind}/{obj.metadata.name}: "
+                                  "conflict persisted after retries",
+                                  file=sys.stderr)
+                            return 1
+            if args.wait:
+                p.settle()
+            return 0
+        if args.file_cmd == "get":
+            kind = args.kind
+            if kind not in known_kinds():
+                print(f"unknown kind {kind!r}; known: {known_kinds()}",
+                      file=sys.stderr)
+                return 1
+            ns = ctx.space or "default"
+            if args.name:
+                obj = p.kube.try_get(kind, args.name, ns) or p.kube.try_get(
+                    kind, args.name, "default"
+                )
+                if obj is None:
+                    print(f"{kind} {args.name!r} not found", file=sys.stderr)
+                    return 1
+                print(to_yaml(obj), end="")
+                return 0
+            objs = p.kube.list(kind, namespace=None)
+            print("NAMESPACE\tNAME\tPHASE")
+            for o in objs:
+                phase = getattr(getattr(o, "status", None), "phase", "-")
+                print(f"{o.metadata.namespace}\t{o.metadata.name}\t{phase}")
+            return 0
+        if args.file_cmd == "delete":
+            ns = ctx.space or "default"
+            try:
+                p.kube.delete(args.kind, args.name, ns)
+            except NotFound:
+                try:
+                    p.kube.delete(args.kind, args.name, "default")
+                except NotFound:
+                    print(f"{args.kind} {args.name!r} not found", file=sys.stderr)
+                    return 1
+            p.settle()
+            print(f"{args.kind.lower()}/{args.name} deleted")
+            return 0
+        return 1
+    finally:
+        p.close()
+
+
 def cmd_ci(args) -> int:
     """CI/CD verbs (C31): run the build/push/deploy|train pipeline on a
     pushed repo asset, and inspect release history."""
@@ -523,6 +621,21 @@ def build_parser() -> argparse.ArgumentParser:
     p_ai.add_argument("--id", required=True)
     p_ai.add_argument("--path", required=True)
     p_asset.set_defaults(fn=cmd_asset)
+
+    p_apply = sub.add_parser("apply", help="apply a YAML manifest (kubectl-style)")
+    p_apply.add_argument("-f", "--file", required=True)
+    p_apply.add_argument("--no-wait", dest="wait", action="store_false")
+    p_apply.set_defaults(fn=cmd_apply, file_cmd="apply")
+
+    p_get = sub.add_parser("get", help="get resources by kind")
+    p_get.add_argument("kind")
+    p_get.add_argument("name", nargs="?", default="")
+    p_get.set_defaults(fn=cmd_apply, file_cmd="get")
+
+    p_del = sub.add_parser("delete", help="delete a resource")
+    p_del.add_argument("kind")
+    p_del.add_argument("name")
+    p_del.set_defaults(fn=cmd_apply, file_cmd="delete")
 
     p_ci = sub.add_parser("ci", help="CI/CD pipelines and releases")
     ci_sub = p_ci.add_subparsers(dest="ci_cmd", required=True)

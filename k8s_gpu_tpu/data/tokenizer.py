@@ -110,6 +110,15 @@ class BpeTokenizer:
 
     def __init__(self, merges: list[tuple[int, int]], backend: str = "auto"):
         self.merges = [tuple(m) for m in merges]
+        # Each merge may only reference byte tokens or EARLIER merges —
+        # a forward/self reference (corrupted vocab file) would make
+        # decode() recurse forever.
+        for i, (a, b) in enumerate(self.merges):
+            if not (0 <= a < 256 + i and 0 <= b < 256 + i):
+                raise ValueError(
+                    f"invalid merge table: merges[{i}]=({a},{b}) references "
+                    f"ids >= {256 + i}"
+                )
         self.rank = {p: i for i, p in enumerate(self.merges)}
         if backend == "auto":
             backend = "native" if _lib() is not None else "python"

@@ -159,3 +159,27 @@ def test_ci_run_and_releases(tmp_path, capsys):
     assert code == 0 and "deployed" in out
     code, out, _ = run(capsys, "ci", "run", "--repo", "proj", "--tag", "v1")
     assert code == 0 and "train   success" in out
+
+
+def test_apply_get_delete_manifest(tmp_path, capsys):
+    run(capsys, "login", "--user", "ada")
+    f = tmp_path / "slice.yaml"
+    f.write_text(
+        "apiVersion: tpu.k8sgpu.dev/v1alpha1\nkind: TpuPodSlice\n"
+        "metadata:\n  name: demo\nspec:\n  acceleratorType: v4-8\n"
+    )
+    code, out, _ = run(capsys, "apply", "-f", str(f))
+    assert code == 0 and "tpupodslice/demo created" in out
+    code, out, _ = run(capsys, "get", "TpuPodSlice", "demo")
+    assert code == 0 and "phase: Ready" in out
+    # Re-apply with a spec change: configured, reconciled.
+    f.write_text(f.read_text().replace("acceleratorType: v4-8",
+                                       "acceleratorType: v5p-8"))
+    code, out, _ = run(capsys, "apply", "-f", str(f))
+    assert code == 0 and "configured" in out
+    code, out, _ = run(capsys, "get", "TpuPodSlice", "demo")
+    assert "v5p-8" in out
+    code, out, _ = run(capsys, "delete", "TpuPodSlice", "demo")
+    assert code == 0
+    code, out, err = run(capsys, "get", "TpuPodSlice", "demo")
+    assert code == 1 and "not found" in err

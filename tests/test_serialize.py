@@ -1,0 +1,99 @@
+"""YAML manifests + kubectl-style apply/get/delete (the north-star UX:
+`kubectl apply -f tpupodslice.yaml`, reference README.md:287-296)."""
+
+from pathlib import Path
+
+import pytest
+
+from k8s_gpu_tpu.api import TpuPodSlice, ValidationError
+from k8s_gpu_tpu.api.serialize import (
+    from_manifest,
+    known_kinds,
+    load_manifests,
+    to_manifest,
+    to_yaml,
+)
+
+SAMPLES = Path(__file__).resolve().parent.parent / "config" / "samples"
+
+
+def test_roundtrip_tpupodslice():
+    ps = TpuPodSlice()
+    ps.metadata.name = "p"
+    ps.spec.accelerator_type = "v5p-64"
+    ps.spec.slice_count = 2
+    ps.metadata.labels["team"] = "ml"
+    doc = to_manifest(ps)
+    assert doc["kind"] == "TpuPodSlice"
+    assert doc["spec"]["acceleratorType"] == "v5p-64"
+    again = from_manifest(doc)
+    assert again.spec.accelerator_type == "v5p-64"
+    assert again.spec.slice_count == 2
+    assert again.metadata.labels == {"team": "ml"}
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(ValidationError, match="unknown field"):
+        from_manifest({
+            "kind": "TpuPodSlice",
+            "metadata": {"name": "x"},
+            "spec": {"acceleratorTyp": "v4-8"},
+        })
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValidationError, match="unknown kind"):
+        from_manifest({"kind": "Nope", "metadata": {"name": "x"}})
+
+
+def test_status_ignored_on_apply():
+    obj = from_manifest({
+        "kind": "TpuPodSlice",
+        "metadata": {"name": "x"},
+        "spec": {"acceleratorType": "v4-8"},
+        "status": {"phase": "Ready", "readyReplicas": 99},
+    })
+    assert obj.status.phase == "Pending"
+
+
+def test_all_sample_manifests_parse_and_roundtrip():
+    for f in sorted(SAMPLES.glob("*.yaml")):
+        for obj in load_manifests(f.read_text()):
+            obj.validate()
+            again = load_manifests(to_yaml(obj))
+            assert len(again) == 1 and again[0].kind == obj.kind
+
+
+def test_known_kinds_cover_platform():
+    kinds = known_kinds()
+    for k in ("TpuPodSlice", "AzureVmPool", "TrainJob", "DevEnv",
+              "SchedulingQueue", "Node", "Pod", "Secret", "Deployment"):
+        assert k in kinds
+
+
+def test_nested_condition_list_roundtrip():
+    from k8s_gpu_tpu.api.types import set_condition
+
+    ps = TpuPodSlice()
+    ps.metadata.name = "c"
+    ps.spec.accelerator_type = "v4-8"
+    set_condition(ps.status.conditions, "Ready", "True", "AsExpected", "ok")
+    doc = to_manifest(ps)
+    assert doc["status"]["conditions"][0]["type"] == "Ready"
+
+
+def test_apply_invalid_spec_is_clean_error(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("K8SGPU_CONFIG_DIR", str(tmp_path / "cfg"))
+    monkeypatch.setenv("K8SGPU_STATE_DIR", str(tmp_path / "state"))
+    from k8s_gpu_tpu.cli.main import main
+
+    main(["login", "--user", "ada"])
+    capsys.readouterr()
+    f = tmp_path / "bad.yaml"
+    f.write_text(
+        "kind: TpuPodSlice\nmetadata:\n  name: bad\n"
+        "spec:\n  acceleratorType: bogus-9\n"
+    )
+    code = main(["apply", "-f", str(f)])
+    err = capsys.readouterr().err
+    assert code == 1 and "bad" in err and "Traceback" not in err

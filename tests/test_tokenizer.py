@@ -91,3 +91,10 @@ def test_strided_view_decodes_correctly():
     # A strided view must decode its OWN elements, not adjacent memory.
     assert tok.decode(ids[::2]) == BpeTokenizer(
         tok.merges, backend="python").decode(np.ascontiguousarray(ids[::2]))
+
+
+def test_corrupt_merge_table_rejected():
+    with pytest.raises(ValueError, match="invalid merge table"):
+        BpeTokenizer([(256, 256)], backend="python")
+    with pytest.raises(ValueError, match="invalid merge table"):
+        BpeTokenizer([(97, 98), (300, 97)], backend="python")
