@@ -1,12 +1,6 @@
-"""Inference/serving subsystem: KV-cache autoregressive decoding.
-
-The reference serves its LLM through a local Ollama server
-(智能风控解决方案.md:196, 219-223 — `qwen:72b` behind an OpenAI-compatible
-client); this package is the TPU-native equivalent: the flagship
-TransformerLM compiled into a prefill + single-token decode loop with a
-static-shape KV cache, suitable for jit on one chip or pjit over a mesh.
-"""
+"""Serving: KV-cache inference engine + the LM HTTP server."""
 
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
+from .server import LmServer
 
-__all__ = ["InferenceEngine", "SamplingConfig", "DecodeOutput"]
+__all__ = ["InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer"]

@@ -1,0 +1,161 @@
+"""LM serving HTTP surface: the model-serving counterpart of the
+Fin-Agent service (reference 智能风控解决方案.md:175-331 serves agents over
+FastAPI; here the platform's own LM serves over the same stdlib-HTTP shape
+as utils/obs.py).
+
+POST /generate  {"prompt": "text", "max_new_tokens": N}  -> {"text", ...}
+POST /tokenize  {"text": "..."}                          -> {"ids": [...]}
+GET  /healthz, /readyz
+
+One InferenceEngine (KV-cache decode) + one BpeTokenizer; requests are
+served sequentially per process — batching belongs to the engine layer,
+and a pod-slice deployment scales replicas behind the platform ingress.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+
+from ..data.tokenizer import BpeTokenizer
+from .engine import InferenceEngine, SamplingConfig
+
+
+def _next_pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class LmServer:
+    """port=0 binds an ephemeral port (tests); ``.port`` is the bound one."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        tokenizer: BpeTokenizer,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_new_tokens_cap: int = 256,
+    ):
+        self.engine = InferenceEngine(model)
+        self.params = params
+        self.tokenizer = tokenizer
+        self.started_at = time.time()
+        self.cap = max_new_tokens_cap
+        # The jitted decode graph is shared; serialize device access.
+        self._gen_lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path == "/healthz":
+                    self._json(200, {"ok": True,
+                                     "uptime_s": time.time() - outer.started_at})
+                elif self.path == "/readyz":
+                    self._json(200, {"ready": True})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return self._json(400, {"error": "invalid JSON body"})
+                if not isinstance(body, dict):
+                    return self._json(400, {"error": "body must be an object"})
+                if self.path == "/generate":
+                    return self._generate(body)
+                if self.path == "/tokenize":
+                    text = body.get("text", "")
+                    if not isinstance(text, str):
+                        return self._json(400, {"error": "text must be a string"})
+                    ids = outer.tokenizer.encode(text)
+                    return self._json(200, {"ids": ids.tolist(),
+                                            "count": int(ids.size)})
+                return self._json(404, {"error": "not found"})
+
+            def _generate(self, body):
+                prompt = body.get("prompt", "")
+                if not isinstance(prompt, str) or not prompt:
+                    return self._json(400, {"error": "prompt (string) required"})
+                try:
+                    want = int(body.get("max_new_tokens", 32))
+                    temperature = float(body.get("temperature", 0.0))
+                    seed = int(body.get("seed", 0))
+                except (TypeError, ValueError) as e:
+                    return self._json(400, {"error": f"bad parameter: {e}"})
+                ids = outer.tokenizer.encode(prompt)
+                # Bucket prompt length AND decode budget to powers of two:
+                # the decode graph's shape is (prompt_bucket, n_new_bucket),
+                # so compile count stays O(log² max_seq) instead of one
+                # multi-second retrace per distinct prompt length — all
+                # while holding the generation lock.
+                bucket = _next_pow2(max(int(ids.size), 8))
+                room = outer.engine.max_seq - bucket
+                if ids.size >= outer.engine.max_seq or room < 1:
+                    return self._json(400, {
+                        "error": f"prompt too long ({ids.size} tokens, "
+                                 f"max {outer.engine.max_seq - 1})"
+                    })
+                want = max(1, min(want, outer.cap, room))
+                n_new = min(_next_pow2(want), room)
+                pad = bucket - int(ids.size)
+                padded = jnp.zeros((1, bucket), jnp.int32).at[:, pad:].set(
+                    jnp.asarray(ids, jnp.int32)[None, :]
+                )
+                t0 = time.perf_counter()
+                with outer._gen_lock:
+                    out = outer.engine.generate(
+                        outer.params,
+                        padded,
+                        max_new_tokens=n_new,
+                        sampling=SamplingConfig(temperature=temperature),
+                        key=jax.random.PRNGKey(seed),
+                        pad_left=pad,
+                    )
+                    toks = jax.device_get(out.tokens[0])
+                    length = min(int(jax.device_get(out.lengths[0])), want)
+                dt = time.perf_counter() - t0
+                gen_ids = toks[:length].tolist()
+                return self._json(200, {
+                    "text": outer.tokenizer.decode(gen_ids),
+                    "ids": gen_ids,
+                    "prompt_tokens": int(ids.size),
+                    "generated_tokens": length,
+                    "tokens_per_s": round(length / dt, 2) if dt > 0 else 0.0,
+                })
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="lm-server", daemon=True
+        )
+
+    def start(self) -> "LmServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)

@@ -103,6 +103,31 @@ def _lm_train(spec, placements) -> dict:
     return {"first_loss": losses[0], "last_loss": losses[-1], "steps": steps}
 
 
+@register_workload("dist-psum-smoke")
+def _dist_psum(spec, placements) -> dict:
+    """Multi-PROCESS psum: N coordinated JAX processes over a local
+    coordinator (parallel/multihost.py) — the platform's worker-pod
+    rendezvous contract executed for real, not in-process.  The slice
+    analogue of the reference's torchrun distributed stub
+    (GPU调度平台搭建.md:606-611)."""
+    from ..parallel.multihost import spawn_local_cluster, workload_global_psum
+
+    args = spec.workload_args
+    procs = int(args.get("processes", 2))
+    devices = int(args.get("devices_per_host", 2))
+    out = spawn_local_cluster(
+        workload_global_psum, num_processes=procs, devices_per_host=devices
+    )
+    expected = sum((i + 1) * devices for i in range(procs))
+    if any(r["sum"] != expected for r in out):
+        raise RuntimeError(f"cross-process psum mismatch: {out}")
+    return {
+        "processes": procs,
+        "global_devices": out[0]["global_devices"],
+        "psum": out[0]["sum"],
+    }
+
+
 @register_workload("lora-finetune")
 def _lora_finetune(spec, placements) -> dict:
     """Parameter-efficient fine-tuning of the flagship LM (the reference's
