@@ -55,6 +55,10 @@ class TrainJobSpec:
     # Resolved by template expansion (server-side defaulting).
     accelerator_type: str = ""
     num_workers: int = 0
+    # Sub-host job (the reference's 1gpu instance-type semantics,
+    # GPU调度平台搭建.md:535): > 0 = run ONE worker on a chip carve-out
+    # (scheduling/sharing.py) instead of a whole-slice gang.
+    shared_chips: int = 0
     # In-process workload name (train/registry.py); "" = external command.
     workload: str = ""
     workload_args: dict = field(default_factory=dict)

@@ -23,7 +23,7 @@ from ..controller.kubefake import Conflict, FakeKube, NotFound
 from ..controller.manager import Reconciler, Request, Result
 from ..scheduling.labels import TPU_RESOURCE
 from ..scheduling.placement import PlacementError
-from ..scheduling.sharing import ChipAllocator
+from ..scheduling.sharing import grant_chips_from_cluster, resync_node_chips
 
 log = logging.getLogger("k8s_gpu_tpu.operators.devenv")
 
@@ -223,35 +223,11 @@ class DevEnvReconciler(Reconciler):
         carve spec.tpu_chips chips out of a TPU host and pin the pod to it
         with TPU_VISIBLE_CHIPS.  Allocator state is re-derived from live
         pods — level-triggered, nothing to persist."""
-        all_pods = self.kube.list("Pod")  # all namespaces: any tenant's
-        # grants and gang workers occupy real chips
-        # Hosts running gang workers (TPU requests bound by node_name but no
-        # chip grant) are whole-host-owned — never carve chips from them.
-        gang_hosts = {
-            pod.node_name
-            for pod in all_pods
-            if pod.node_name
-            and pod.phase in ("Pending", "Running")
-            and pod.requests.get(TPU_RESOURCE, 0) > 0
-            and not pod.env.get("TPU_VISIBLE_CHIPS")
-        }
-        nodes = [
-            n for n in self.kube.list("Node")
-            if n.capacity.get(TPU_RESOURCE, 0) > 0
-            and n.metadata.name not in gang_hosts
-        ]
-        allocator = ChipAllocator.from_pods(all_pods, nodes)
-        alloc = allocator.allocate(p.metadata.name, env.spec.tpu_chips, nodes)
+        alloc = grant_chips_from_cluster(
+            self.kube, p.metadata.name, env.spec.tpu_chips
+        )
         p.node_name = alloc.node
         p.env.update(alloc.env)
-        # Persist the host's reduced allocatable so gang placement and
-        # quota observe the carve-out.
-        for n in nodes:
-            if n.metadata.name == alloc.node:
-                try:
-                    self.kube.update(n)
-                except Conflict:
-                    pass
         self.recorder.event(
             env, "Normal", "ChipsAllocated",
             f"granted chips {alloc.env['TPU_VISIBLE_CHIPS']} on {alloc.node}",
@@ -286,13 +262,4 @@ class DevEnvReconciler(Reconciler):
         return Result()
 
     def _resync_allocatable(self, node_name: str) -> None:
-        """Recompute a host's allocatable chips from surviving grants."""
-        node = self.kube.try_get("Node", node_name, "default")
-        if node is None:
-            return
-        allocator = ChipAllocator.from_pods(self.kube.list("Pod"), [node])
-        allocator.sync_nodes([node])
-        try:
-            self.kube.update(node)
-        except (Conflict, NotFound):
-            pass
+        resync_node_chips(self.kube, node_name)

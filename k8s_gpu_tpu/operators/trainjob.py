@@ -57,6 +57,19 @@ class TrainJobReconciler(Reconciler):
         return f"{job.metadata.name}-w-{i}"
 
     def _worker_pods(self, job: TrainJob) -> list[Pod]:
+        if job.spec.shared_chips > 0:
+            # One worker on a chip carve-out: no gang, no rendezvous.
+            name = self.pod_name(job, 0)
+            pod = self.kube.try_get("Pod", name, job.metadata.namespace)
+            if pod is None:
+                pod = Pod()
+                pod.metadata.name = name
+                pod.metadata.namespace = job.metadata.namespace
+                pod.metadata.labels = {"job": job.metadata.name}
+                pod.group = job.metadata.name
+                pod.requests = {TPU_RESOURCE: job.spec.shared_chips}
+                pod = self.kube.create(pod)
+            return [pod]
         accel = parse_accelerator_type(job.spec.accelerator_type)
         # Rendezvous env — the Kubeflow-operator PET_* role
         # (GPU调度平台搭建.md:606-630): worker 0's pod is the coordinator;
@@ -137,7 +150,13 @@ class TrainJobReconciler(Reconciler):
             except Conflict:
                 return Result(requeue=True)
 
-        if not job.spec.accelerator_type or job.spec.num_workers <= 0:
+        if job.spec.shared_chips > 0:
+            if job.spec.num_workers > 1:
+                self._finish(job, "Failed",
+                             "sharedChips jobs run exactly one worker")
+                return Result()
+            job.spec.num_workers = 1
+        elif not job.spec.accelerator_type or job.spec.num_workers <= 0:
             self._finish(job, "Failed",
                          "spec not expanded: missing acceleratorType/numWorkers")
             return Result()
@@ -261,6 +280,20 @@ class TrainJobReconciler(Reconciler):
         )
 
     def _place(self, job: TrainJob, pods: list[Pod]) -> dict[str, str]:
+        if job.spec.shared_chips > 0:
+            from ..scheduling.sharing import grant_chips_from_cluster
+
+            (pod,) = pods
+            alloc = grant_chips_from_cluster(
+                self.kube, pod.metadata.name, job.spec.shared_chips
+            )
+            # The grant env rides the same pod update that binds node_name.
+            pod.env.update(alloc.env)
+            self.recorder.event(
+                job, "Normal", "ChipsAllocated",
+                f"granted chips {alloc.env['TPU_VISIBLE_CHIPS']} on {alloc.node}",
+            )
+            return {pod.metadata.name: alloc.node}
         nodes = self._free_nodes(job)
         if job.spec.slice_count > 1:
             from ..scheduling.placement import _ordinal_key
@@ -293,21 +326,38 @@ class TrainJobReconciler(Reconciler):
         return {"command": job.spec.command, "image": job.spec.image, "simulated": True}
 
     def _delete_pods(self, job: TrainJob) -> None:
+        freed: set[str] = set()
         for p in self.kube.list("Pod", namespace=job.metadata.namespace):
             if p.metadata.labels.get("job") == job.metadata.name:
+                if p.env.get("TPU_VISIBLE_CHIPS") and p.node_name:
+                    freed.add(p.node_name)
                 try:
                     self.kube.delete("Pod", p.metadata.name, p.metadata.namespace)
                 except NotFound:
                     pass
+        self._release_chips(freed)
 
     def _teardown_pods(self, job: TrainJob, phase: str) -> None:
+        freed: set[str] = set()
         for p in self.kube.list("Pod", namespace=job.metadata.namespace):
             if p.metadata.labels.get("job") == job.metadata.name:
+                if p.env.get("TPU_VISIBLE_CHIPS") and p.node_name:
+                    freed.add(p.node_name)
                 p.phase = phase
                 try:
                     self.kube.update(p)
                 except (Conflict, NotFound):
                     pass
+        self._release_chips(freed)
+
+    def _release_chips(self, node_names: set[str]) -> None:
+        """Restore allocatable on hosts whose chip grants just ended."""
+        if not node_names:
+            return
+        from ..scheduling.sharing import resync_node_chips
+
+        for name in node_names:
+            resync_node_chips(self.kube, name)
 
     def _finish(self, job: TrainJob, phase: str, message: str) -> None:
         job.status.phase = phase

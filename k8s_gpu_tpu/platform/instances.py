@@ -20,16 +20,22 @@ class InstanceType:
     accelerator_type: str  # "" = CPU-only instance
     cpu: int
     memory_gb: int
+    # > 0 = sub-host instance: one worker on a chip carve-out of a shared
+    # host (the reference's 1gpu instance types, :535) — accelerator_type
+    # is empty, any TPU host with free chips serves it.
+    shared_chips: int = 0
 
     @property
     def workers(self) -> int:
         """Host (worker pod) count for a job on this instance type."""
-        if not self.accelerator_type:
+        if self.shared_chips or not self.accelerator_type:
             return 1
         return parse_accelerator_type(self.accelerator_type).hosts
 
     @property
     def chips(self) -> int:
+        if self.shared_chips:
+            return self.shared_chips
         if not self.accelerator_type:
             return 0
         return parse_accelerator_type(self.accelerator_type).chips
@@ -46,12 +52,16 @@ INSTANCE_CATALOG: dict[str, InstanceType] = {
     "tpu-v5p-8": InstanceType("tpu-v5p-8", "v5p-8", 208, 448),
     "tpu-v5p-64": InstanceType("tpu-v5p-64", "v5p-64", 208, 448),
     "tpu-v6e-8": InstanceType("tpu-v6e-8", "v6e-8", 180, 720),
+    # Sub-host (chip carve-out) instances — the HAMi/1gpu role.
+    "tpu-1chip": InstanceType("tpu-1chip", "", 24, 48, shared_chips=1),
+    "tpu-2chip": InstanceType("tpu-2chip", "", 48, 96, shared_chips=2),
 }
 
 # Reference-era GPU names → nearest TPU types, so templates written against
 # the reference platform (gpu-1x-16c-32g-1gpu, :535) resolve unchanged.
 ALIASES: dict[str, str] = {
-    "gpu-1x-16c-32g-1gpu": "tpu-v5e-8",
+    # The reference's single-GPU instance is a sub-host share, not a slice.
+    "gpu-1x-16c-32g-1gpu": "tpu-1chip",
     "gpu-8x-96c-768g-8gpu": "tpu-v5p-8",
 }
 

@@ -110,6 +110,7 @@ def expand_template(
             raise TemplateError(str(e)) from e
         job.spec.accelerator_type = it.accelerator_type
         job.spec.num_workers = it.workers * slice_count
+        job.spec.shared_chips = it.shared_chips
     job.validate()
     return job
 

@@ -27,7 +27,9 @@ _WAITING_PHASES = ("", "Pending")
 
 
 def job_chips(job: TrainJob) -> int:
-    """Total TPU chips the job's gang occupies when running."""
+    """Total TPU chips the job occupies when running."""
+    if job.spec.shared_chips:
+        return job.spec.shared_chips
     if not job.spec.accelerator_type:
         return 0
     return parse_accelerator_type(job.spec.accelerator_type).chips * max(

@@ -23,6 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..api.core import Node
+from ..controller.kubefake import Conflict, NotFound
 from .labels import LABEL_SLICE, TPU_RESOURCE
 from .placement import PlacementError
 
@@ -161,6 +162,19 @@ class ChipAllocator:
         st = self._hosts[node.metadata.name]
         node.allocatable[TPU_RESOURCE] = len(st.free_chips)
 
+    @staticmethod
+    def gang_hosts(pods) -> set[str]:
+        """Hosts owned whole by gang workers: bound pods with TPU requests
+        but no chip grant.  Never carve chips from these."""
+        return {
+            p.node_name
+            for p in pods
+            if p.node_name
+            and p.phase in ("Pending", "Running")
+            and p.requests.get(TPU_RESOURCE, 0) > 0
+            and not p.env.get("TPU_VISIBLE_CHIPS")
+        }
+
     def sync_nodes(self, nodes: list[Node]) -> None:
         """Write allocatable = capacity − used for every given node (also
         nodes with zero grants — needed to restore a fully-freed host)."""
@@ -186,3 +200,42 @@ class ChipAllocator:
                 if sl:
                     out.add(sl)
         return out
+
+
+# -- cluster-level helpers (shared by the devenv + trainjob controllers) ---
+
+def grant_chips_from_cluster(kube, pod_name: str, chips: int) -> ChipAllocation:
+    """Allocate *chips* on some TPU host using live cluster state: the
+    allocator is rebuilt from existing grants (level-triggered), gang-owned
+    hosts are excluded, and the chosen node's reduced allocatable is
+    persisted so gang placement and quota observe the carve-out."""
+    all_pods = kube.list("Pod")
+    gang = ChipAllocator.gang_hosts(all_pods)
+    nodes = [
+        n for n in kube.list("Node")
+        if n.capacity.get(TPU_RESOURCE, 0) > 0
+        and n.metadata.name not in gang
+    ]
+    allocator = ChipAllocator.from_pods(all_pods, nodes)
+    alloc = allocator.allocate(pod_name, chips, nodes)
+    for n in nodes:
+        if n.metadata.name == alloc.node:
+            try:
+                kube.update(n)
+            except (Conflict, NotFound):
+                pass
+    return alloc
+
+
+def resync_node_chips(kube, node_name: str) -> None:
+    """Recompute one host's allocatable from surviving grants (call after
+    deleting a granted pod)."""
+    node = kube.try_get("Node", node_name, "default")
+    if node is None:
+        return
+    allocator = ChipAllocator.from_pods(kube.list("Pod"), [node])
+    allocator.sync_nodes([node])
+    try:
+        kube.update(node)
+    except (Conflict, NotFound):
+        pass

@@ -219,3 +219,100 @@ def test_chip_count_drift_replaces_pod(kube: FakeKube, manager: Manager):
     assert pod.env["TPU_VISIBLE_CHIPS"] == "0,1,2"
     node = kube.get("Node", "n0", "default")
     assert node.allocatable[TPU_RESOURCE] == 1
+
+
+def _tpu_pool_node(kube, name, slice_name="s0", worker=0):
+    n = tpu_node(name, slice_name=slice_name, worker=worker)
+    kube.create(n)
+    return n
+
+
+def test_shared_chip_trainjob_end_to_end(kube: FakeKube, manager: Manager):
+    """A 1-chip job (the reference's 1gpu instance type) carves a chip out
+    of a shared host instead of taking a whole slice."""
+    from k8s_gpu_tpu.api.trainjob import TrainJob
+    from k8s_gpu_tpu.operators import TrainJobReconciler
+    from k8s_gpu_tpu.platform import expand_template, parse_template
+
+    _tpu_pool_node(kube, "host0")
+    manager.register("TrainJob", TrainJobReconciler(kube))
+    manager.start()
+
+    tpl = parse_template(
+        "title: tiny\nworkload: psum-smoke\n"
+        "spec:\n  singleInstanceType: gpu-1x-16c-32g-1gpu\n"
+    )
+    job = expand_template(tpl, "tiny")
+    assert job.spec.shared_chips == 1 and job.spec.num_workers == 1
+    kube.create(job)
+    assert manager.wait_idle(
+        timeout=30,
+        predicate=lambda: kube.get("TrainJob", "tiny").status.phase
+        in ("Succeeded", "Failed"),
+    )
+    done = kube.get("TrainJob", "tiny")
+    assert done.status.phase == "Succeeded", done.status.message
+    assert done.status.placements == {"tiny-w-0": "host0"}
+    # Grant released after completion.
+    node = kube.get("Node", "host0", "default")
+    assert node.allocatable[TPU_RESOURCE] == 4
+
+
+def test_shared_job_waits_then_runs_when_chips_free(
+    kube: FakeKube, manager: Manager
+):
+    from k8s_gpu_tpu.api.trainjob import TrainJob
+    from k8s_gpu_tpu.operators import TrainJobReconciler
+
+    manager.register("TrainJob", TrainJobReconciler(kube))
+    manager.start()
+    job = TrainJob()
+    job.metadata.name = "waits"
+    job.spec.shared_chips = 2
+    job.spec.workload = "psum-smoke"
+    kube.create(job)
+    assert manager.wait_idle(
+        timeout=10,
+        predicate=lambda: "insufficient capacity"
+        in kube.get("TrainJob", "waits").status.message,
+    )
+    _tpu_pool_node(kube, "late-host")
+    # CAPACITY_POLL is 2s on the fixture's FakeClock: advance past it so
+    # the retry fires and sees the new host.
+    manager.clock.advance(3)
+    assert manager.wait_idle(
+        timeout=30,
+        predicate=lambda: kube.get("TrainJob", "waits").status.phase
+        == "Succeeded",
+    )
+
+
+def test_shared_and_gang_jobs_coexist(kube: FakeKube, manager: Manager):
+    """A shared job on slice s0 must not block a gang on pristine s1, and
+    the gang's hosts must be invisible to later shared jobs."""
+    from k8s_gpu_tpu.api.trainjob import TrainJob
+    from k8s_gpu_tpu.operators import TrainJobReconciler
+
+    for i, (name, sl) in enumerate([("a0", "s0"), ("a1", "s0"),
+                                    ("b0", "s1"), ("b1", "s1")]):
+        _tpu_pool_node(kube, name, slice_name=sl, worker=i % 2)
+    manager.register("TrainJob", TrainJobReconciler(kube, run_workloads=False))
+    manager.start()
+
+    shared = TrainJob()
+    shared.metadata.name = "small"
+    shared.spec.shared_chips = 1
+    kube.create(shared)
+    gang = TrainJob()
+    gang.metadata.name = "big"
+    gang.spec.accelerator_type = "v4-8"
+    gang.spec.num_workers = 2
+    kube.create(gang)
+    assert manager.wait_idle(
+        timeout=20,
+        predicate=lambda: kube.get("TrainJob", "big").status.phase == "Running"
+        and kube.get("TrainJob", "small").status.phase == "Running",
+    )
+    small_node = kube.get("TrainJob", "small").status.placements["small-w-0"]
+    gang_nodes = set(kube.get("TrainJob", "big").status.placements.values())
+    assert small_node not in gang_nodes

@@ -85,9 +85,11 @@ def test_unknown_instance_type_rejected():
 
 
 def test_gpu_alias_resolves_to_tpu():
-    """Reference-era GPU instance strings (:535) map onto TPU types."""
+    """Reference-era GPU instance strings (:535) map onto TPU capacity:
+    a single-GPU instance becomes a single-chip carve-out, not a slice."""
     it = resolve_instance_type("gpu-1x-16c-32g-1gpu")
-    assert it.accelerator_type == "v5e-8"
+    assert it.shared_chips == 1 and it.workers == 1 and it.chips == 1
+    assert resolve_instance_type("gpu-8x-96c-768g-8gpu").accelerator_type == "v5p-8"
 
 
 def test_bare_accelerator_type_accepted():
