@@ -90,19 +90,41 @@ def _decode_value(hint, raw, path: str):
     origin = typing.get_origin(hint)
     if origin in (typing.Union, _types.UnionType):
         args = [a for a in typing.get_args(hint) if a is not type(None)]
-        hint = args[0] if args else None
-        origin = typing.get_origin(hint)
+        if len(args) == 1:
+            return _decode_value(args[0], raw, path)
+        # Multi-arm union: try each arm, not just the first — a
+        # non-Optional union's later arms must remain reachable.
+        last: ValidationError | None = None
+        for arm in args:
+            try:
+                return _decode_value(arm, raw, path)
+            except ValidationError as e:
+                last = e
+        raise last or ValidationError(f"{path}: no union arm matched")
     if hint is not None and dataclasses.is_dataclass(hint):
         if not isinstance(raw, dict):
             raise ValidationError(f"{path} must be a mapping")
         return _decode_into(hint, raw, path)
     if origin in (list, tuple):
-        (elem,) = typing.get_args(hint) or (None,)
         if not isinstance(raw, list):
             raise ValidationError(f"{path} must be a list")
-        return [
+        args = typing.get_args(hint)
+        if origin is tuple and len(args) > 1 and args[-1] is not Ellipsis:
+            # Heterogeneous tuple[A, B, ...]: per-position element hints.
+            if len(raw) != len(args):
+                raise ValidationError(
+                    f"{path} must have {len(args)} items, got {len(raw)}"
+                )
+            return tuple(
+                _decode_value(a, v, f"{path}[{i}]")
+                for i, (a, v) in enumerate(zip(args, raw))
+            )
+        elem = args[0] if args else None
+        vals = [
             _decode_value(elem, v, f"{path}[{i}]") for i, v in enumerate(raw)
         ]
+        # Fields typed tuple[...] must round-trip as tuples, not lists.
+        return tuple(vals) if origin is tuple else vals
     return raw
 
 

@@ -64,6 +64,15 @@ class TrainJobSpec:
     workload_args: dict = field(default_factory=dict)
     # Max seconds in Pending-for-capacity before Failed (0 = wait forever).
     queue_timeout_s: float = 0.0
+    # Elastic recovery (SURVEY §5.3-5.4; restartPolicy parity with
+    # GPU调度平台搭建.md:668): OnFailure re-places the gang and re-runs the
+    # workload, which resumes from its latest checkpoint.  Never = one shot.
+    restart_policy: str = "Never"
+    max_restarts: int = 3
+    # Periodic checkpoint cadence for checkpoint-aware workloads (0 = off);
+    # dir "" resolves to a stable per-job path so restarts find it.
+    checkpoint_interval_steps: int = 0
+    checkpoint_dir: str = ""
 
 
 @dataclass
@@ -74,6 +83,13 @@ class TrainJobStatus:
     placements: dict[str, str] = field(default_factory=dict)
     start_time: float = 0.0
     completion_time: float = 0.0
+    # Elastic-recovery bookkeeping: restart count, last step the workload
+    # reported, last checkpointed step, and the step resumed from (0 = a
+    # fresh start).
+    restarts: int = 0
+    progress_step: int = 0
+    checkpoint_step: int = 0
+    resumed_from_step: int = 0
     conditions: list[Condition] = field(default_factory=list)
     logs: list[str] = field(default_factory=list)
     result: dict = field(default_factory=dict)
@@ -94,3 +110,12 @@ class TrainJob(CustomResource):
             raise ValidationError("sliceCount must be >= 1")
         if self.spec.mode == "single" and self.spec.slice_count != 1:
             raise ValidationError("mode=single requires sliceCount=1")
+        if self.spec.restart_policy not in ("Never", "OnFailure"):
+            raise ValidationError(
+                f"restartPolicy must be Never|OnFailure, got "
+                f"{self.spec.restart_policy!r}"
+            )
+        if self.spec.max_restarts < 0:
+            raise ValidationError("maxRestarts must be >= 0")
+        if self.spec.checkpoint_interval_steps < 0:
+            raise ValidationError("checkpointIntervalSteps must be >= 0")

@@ -1,0 +1,78 @@
+"""Workload execution contract — the channel between the TrainJob operator
+and an in-process workload.
+
+The reference's elastic story is pod-level ``restartPolicy: OnFailure``
+plus checkpoint files under ``/output`` (GPU调度平台搭建.md:668, 686-697);
+SURVEY §5.3-5.4 demand the end-to-end version: periodic save → preemption
+→ re-place → auto-resume from the latest step.  A workload that accepts a
+third argument receives a :class:`WorkloadContext`; through it the
+workload reports progress/checkpoints into the job status and is told —
+via :class:`WorkloadInterrupted` from :meth:`WorkloadContext.heartbeat` —
+when the slice under it was preempted, so the operator can re-place the
+gang and the workload can resume instead of restarting from step 0.
+
+This module is deliberately JAX-free: the controller imports it without
+loading the ML runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+class WorkloadInterrupted(RuntimeError):
+    """The gang's placement vanished mid-run (slice preempted / nodes
+    pruned).  The operator treats this as restartable, not fatal."""
+
+
+@dataclass
+class WorkloadContext:
+    """Handed to 3-arg workloads: ``fn(spec, placements, ctx)``.
+
+    checkpoint_dir / checkpoint_interval come from the job spec (resolved
+    to a stable per-job default by the operator so a restarted job finds
+    its own checkpoints).  ``heartbeat(step)`` should be called once per
+    training step: it publishes progress to the job status and raises
+    WorkloadInterrupted when any placement node is gone.
+    """
+
+    checkpoint_dir: str = ""
+    checkpoint_interval: int = 0
+    placements: dict[str, str] = field(default_factory=dict)
+    # Node identity (name → uid) captured at placement time: a preempted
+    # slice's nodes may be recreated under the SAME names within
+    # milliseconds, so liveness alone can miss the preemption — the uid
+    # changing is the reliable "this is not the host you were placed on".
+    node_uids: dict[str, str] = field(default_factory=dict)
+    # Injected by the operator; kept as callables so this module stays
+    # free of controller imports (and trivially fake-able in tests).
+    _node_uid: Callable[[str], str | None] | None = None
+    _patch_status: Callable[[Callable[[Any], None]], None] | None = None
+
+    def heartbeat(self, step: int) -> None:
+        self._set_status("progress_step", step)
+        if self._node_uid is None:
+            return
+        lost = []
+        for node in sorted(set(self.placements.values())):
+            uid = self._node_uid(node)
+            want = self.node_uids.get(node)
+            if uid is None:
+                lost.append(f"{node} (gone)")
+            elif want and uid != want:
+                lost.append(f"{node} (replaced)")
+        if lost:
+            raise WorkloadInterrupted(
+                f"placement node(s) lost at step {step}: {', '.join(lost)}"
+            )
+
+    def record_checkpoint(self, step: int) -> None:
+        self._set_status("checkpoint_step", step)
+
+    def record_resume(self, step: int) -> None:
+        self._set_status("resumed_from_step", step)
+
+    def _set_status(self, attr: str, value: int) -> None:
+        if self._patch_status is not None:
+            self._patch_status(lambda status: setattr(status, attr, value))

@@ -127,6 +127,7 @@ class TokenLoader:
         self.shuffle = shuffle
         self._handle = None
         self._epoch = 0
+        self._next_epoch = 0
         self._cursor = 0
 
         n_tokens = self.path.stat().st_size // 4
@@ -179,7 +180,7 @@ class TokenLoader:
         else:
             if self._cursor == 0 and self.shuffle:
                 self._perm = epoch_permutation(
-                    self.num_local, self.seed, self._epoch
+                    self.num_local, self.seed, self._next_epoch
                 )
             b = self._cursor
             rows = np.arange(
@@ -193,10 +194,14 @@ class TokenLoader:
             full = np.stack(
                 [self._mm[int(g) * w : (int(g) + 1) * w] for g in global_rows]
             )
+            # .epoch reports the epoch the just-returned batch belongs to,
+            # matching dl_next_batch's return value (the native path) —
+            # epoch-keyed logic must not depend on backend choice.
+            self._epoch = self._next_epoch
             self._cursor += 1
             if self._cursor >= self.batches_per_epoch:
                 self._cursor = 0
-                self._epoch += 1
+                self._next_epoch += 1
         return full[:, :-1].copy(), full[:, 1:].copy()
 
     @property

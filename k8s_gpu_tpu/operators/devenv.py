@@ -208,13 +208,20 @@ class DevEnvReconciler(Reconciler):
             "/workspace": f"pvc:{env.spec.workspace_pvc}",
             "/root/.ssh": f"secret:{secret_name(env)}",
         }
+        granted_node = ""
         if env.spec.tpu_chips:
             p.requests[TPU_RESOURCE] = env.spec.tpu_chips
             self._grant_chips(env, p)
+            granted_node = p.node_name
         p.phase = "Running"
         try:
             self.kube.create(p)
         except Conflict:
+            # The grant reserved allocatable on the node but the pod that
+            # would hold it never materialized — resync the node so the
+            # capacity isn't leaked until some unrelated release.
+            if granted_node:
+                self._resync_allocatable(granted_node)
             return False
         return True
 

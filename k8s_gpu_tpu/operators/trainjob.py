@@ -17,10 +17,12 @@ from __future__ import annotations
 
 import logging
 import time
+from pathlib import Path
 
 from ..api.core import Pod
 from ..api.trainjob import TrainJob
 from ..api.types import set_condition
+from ..api.workload import WorkloadContext, WorkloadInterrupted
 from ..cloud.topology import parse_accelerator_type
 from ..controller.events import EventRecorder
 from ..controller.kubefake import Conflict, FakeKube, NotFound
@@ -134,6 +136,7 @@ class TrainJobReconciler(Reconciler):
             # Deleting a job must release its worker Pods (and with them the
             # slice capacity _free_nodes accounts) before the object goes.
             self._delete_pods(job)
+            self._cleanup_default_ckpt(job)
             if FINALIZER in job.metadata.finalizers:
                 job.metadata.finalizers.remove(FINALIZER)
                 try:
@@ -247,7 +250,45 @@ class TrainJobReconciler(Reconciler):
 
         try:
             result = self._execute(job)
-        except Exception as e:  # workload failure → job Failed
+        except Exception as e:
+            # Elastic recovery (SURVEY §5.3-5.4): a restartable job is
+            # re-queued — pods released, placements cleared — so the next
+            # pass re-places the gang (onto the self-healed slice) and the
+            # workload resumes from its latest checkpoint.  Fatal otherwise.
+            job = self.kube.get("TrainJob", req.name, req.namespace)
+            if (
+                job.spec.restart_policy == "OnFailure"
+                and job.status.restarts < job.spec.max_restarts
+            ):
+                kind = (
+                    "preempted" if isinstance(e, WorkloadInterrupted)
+                    else "failed"
+                )
+                log.warning(
+                    "job %s workload %s; restarting (%d/%d): %s",
+                    job.metadata.name, kind, job.status.restarts + 1,
+                    job.spec.max_restarts, e,
+                )
+                self._delete_pods(job)
+                job.status.restarts += 1
+                job.status.phase = "Pending"
+                job.status.placements = {}
+                job.status.message = (
+                    f"restarting after workload {kind} "
+                    f"({job.status.restarts}/{job.spec.max_restarts}): {e}"
+                )
+                set_condition(
+                    job.status.conditions, "Interrupted", "True",
+                    "WorkloadInterrupted" if kind == "preempted"
+                    else "WorkloadError",
+                    str(e), observed_generation=job.metadata.generation,
+                )
+                self._update_status(job)
+                self.recorder.event(
+                    job, "Warning", "Restarting", job.status.message
+                )
+                self.metrics.inc("trainjob_restarts_total", kind=kind)
+                return Result(requeue_after=CAPACITY_POLL)
             log.exception("job %s workload failed", job.metadata.name)
             self._teardown_pods(job, "Failed")
             self._finish(job, "Failed", f"workload error: {e}")
@@ -307,15 +348,52 @@ class TrainJobReconciler(Reconciler):
             return multislice_spread(groups, nodes, job.spec.accelerator_type)
         return place_gang(pods, nodes, job.spec.accelerator_type)
 
+    def _workload_context(self, job: TrainJob) -> WorkloadContext:
+        name, ns = job.metadata.name, job.metadata.namespace
+
+        def node_uid(node_name: str) -> str | None:
+            node = self.kube.try_get("Node", node_name)
+            return None if node is None else node.metadata.uid
+
+        def patch_status(mutate) -> None:
+            try:
+                cur = self.kube.get("TrainJob", name, ns)
+                mutate(cur.status)
+                self.kube.update_status(cur)
+            except (Conflict, NotFound):
+                pass  # progress reporting is best-effort
+
+        ckpt_dir = job.spec.checkpoint_dir
+        if not ckpt_dir and job.spec.checkpoint_interval_steps:
+            ckpt_dir = str(self._default_ckpt_dir(job))
+        placements = dict(job.status.placements)
+        return WorkloadContext(
+            checkpoint_dir=ckpt_dir,
+            checkpoint_interval=job.spec.checkpoint_interval_steps,
+            placements=placements,
+            node_uids={
+                n: uid for n in set(placements.values())
+                if (uid := node_uid(n)) is not None
+            },
+            _node_uid=node_uid,
+            _patch_status=patch_status,
+        )
+
     def _execute(self, job: TrainJob) -> dict:
         if job.spec.workload:
             # Lazy: pulling the workload registry loads the JAX runtime;
             # the controller itself must stay control-plane-light.
+            import inspect
+
             from ..train.registry import get_workload
 
             fn = get_workload(job.spec.workload)
             t0 = time.perf_counter()
-            result = fn(job.spec, job.status.placements)
+            if len(inspect.signature(fn).parameters) >= 3:
+                result = fn(job.spec, job.status.placements,
+                            self._workload_context(job))
+            else:
+                result = fn(job.spec, job.status.placements)
             self.metrics.observe(
                 "trainjob_workload_seconds", time.perf_counter() - t0
             )
@@ -359,6 +437,28 @@ class TrainJobReconciler(Reconciler):
         for name in node_names:
             resync_node_chips(self.kube, name)
 
+    @staticmethod
+    def _default_ckpt_dir(job: TrainJob) -> Path:
+        """Stable per-job default so a restarted job finds its own
+        checkpoints (the reference's per-job /output contract)."""
+        import tempfile
+
+        return (
+            Path(tempfile.gettempdir()) / "k8s_gpu_tpu_ckpt"
+            / f"{job.metadata.namespace}-{job.metadata.name}"
+        )
+
+    def _cleanup_default_ckpt(self, job: TrainJob) -> None:
+        """Remove the DERIVED checkpoint dir when a job terminates — a
+        later job re-created under the same name must start fresh, not
+        silently resume a predecessor's state.  User-specified dirs are
+        the user's to manage."""
+        if job.spec.checkpoint_dir or not job.spec.checkpoint_interval_steps:
+            return
+        import shutil
+
+        shutil.rmtree(self._default_ckpt_dir(job), ignore_errors=True)
+
     def _finish(self, job: TrainJob, phase: str, message: str) -> None:
         job.status.phase = phase
         job.status.message = message
@@ -368,6 +468,17 @@ class TrainJobReconciler(Reconciler):
             "True" if phase == "Succeeded" else "False",
             phase, message, observed_generation=job.metadata.generation,
         )
+        if phase == "Succeeded" and any(
+            c.type == "Interrupted" for c in job.status.conditions
+        ):
+            # The standard condition contract: flip back once it no longer
+            # holds — a recovered-and-completed job is not interrupted.
+            set_condition(
+                job.status.conditions, "Interrupted", "False", "Recovered",
+                f"completed after {job.status.restarts} restart(s)",
+                observed_generation=job.metadata.generation,
+            )
+        self._cleanup_default_ckpt(job)
         self._update_status(job)
         self.recorder.event(
             job, "Normal" if phase == "Succeeded" else "Warning", phase, message

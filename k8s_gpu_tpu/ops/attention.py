@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — fused forward AND backward.
 
 The hot op of the flagship transformer (SURVEY §5.7 obligation: "a
 Pallas/blockwise attention kernel").  Streaming-softmax blockwise attention:
@@ -6,11 +6,14 @@ Q tiles stay resident in VMEM while K/V tiles stream through, so attention
 memory is O(block_q · S) instead of O(S²) and the matmuls tile onto the MXU
 (128-aligned blocks, f32 accumulators, bf16-friendly inputs).
 
-Differentiation: the forward runs the kernel; the backward recomputes with
-the reference jnp implementation via ``jax.custom_vjp`` (correct and
-remat-friendly; a fused backward kernel is the next perf step).
+Differentiation is fully kernelized: the forward kernel also emits the
+per-row logsumexp; the backward recomputes probability blocks from
+(q, k, lse) inside two Pallas kernels — one producing dq (grid over q
+blocks) and one producing dk/dv (grid over k blocks) — so training never
+materializes the O(S²) score matrix either.  The only non-kernel work in
+the backward is the elementwise delta = rowsum(dO ⊙ O), which XLA fuses.
 
-On CPU (tests) the same kernel runs under ``interpret=True`` so the kernel
+On CPU (tests) the same kernels run under ``interpret=True`` so the kernel
 logic itself is exercised without TPU hardware.
 """
 
@@ -21,7 +24,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -40,9 +42,10 @@ def reference_attention(q, k, v, causal: bool = True):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
+                causal, scale):
     """One (batch·head, q-block) program: stream K/V blocks, accumulate
-    online softmax in f32."""
+    online softmax in f32, emit the output block and its logsumexp row."""
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     q = q_ref[0].astype(jnp.float32)  # [bq, D]
@@ -88,6 +91,113 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale
 
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # lse is stored [bh, S, 1]: the trailing singleton keeps the block shape
+    # legal for Mosaic's (8, 128)-tiling rule without lane broadcasting.
+    lse_ref[0] = (m + jnp.log(l))[:, None]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, *,
+                   block_k, seq_len, causal, scale):
+    """dq for one (batch·head, q-block): stream K/V, recompute p from lse,
+    accumulate dq = Σ_j ds_j · k_j in f32."""
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0]      # [bq] f32
+    delta = delta_ref[0][:, 0]  # [bq] f32
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        last = (qi * block_q + block_q + block_k - 1) // block_k
+        upper = jnp.minimum(num_k_blocks, last)
+    else:
+        upper = num_k_blocks
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                       # [bq, bk]
+        dp = jax.lax.dot_general(
+            g, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                   # [bq, bk]
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    dq_ref[0] = jax.lax.fori_loop(0, upper, body, dq0).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, seq_len, causal, scale):
+    """dk/dv for one (batch·head, k-block): stream Q/dO blocks from the
+    first causally-relevant q block, recompute p, accumulate in f32."""
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    kb = k_ref[0].astype(jnp.float32)  # [bk, D]
+    vb = v_ref[0].astype(jnp.float32)
+
+    num_q_blocks = seq_len // block_q
+    # For causal attention, q blocks strictly above this k block's diagonal
+    # contribute nothing — start the stream at the diagonal.
+    lower = (ki * block_k) // block_q if causal else 0
+
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        gb = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_b = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta_b = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                           # [bq, bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_b[:, None])                     # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, gb, (((0,), (0,)), ((), ())),                # pᵀ · dO
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            gb, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_b[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),               # dsᵀ · q
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    z = jnp.zeros((block_k, kb.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
@@ -102,9 +212,9 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     kr = k.reshape(b * h, s, d)
     vr = v.reshape(b * h, s, d)
     kernel = functools.partial(
-        _flash_kernel, block_k=bk, seq_len=s, causal=causal, scale=scale
+        _fwd_kernel, block_k=bk, seq_len=s, causal=causal, scale=scale
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // bq),
         in_specs=[
@@ -112,11 +222,85 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d), lse
+
+
+def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    scale = d**-0.5
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s, d)
+    vr = v.reshape(b * h, s, d)
+    gr = g.reshape(b * h, s, d)
+    # delta_i = Σ_d dO_i ⊙ O_i — elementwise, XLA fuses it; keeping it out
+    # of the kernels avoids a third pass over K/V.
+    delta = jnp.sum(
+        gr.astype(jnp.float32) * o.reshape(b * h, s, d).astype(jnp.float32),
+        axis=-1,
+        keepdims=True,
+    )  # [bh, s, 1], matching the lse layout
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, block_k=bk, seq_len=s, causal=causal, scale=scale
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, s, d)
+    )(qr, kr, vr, gr, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, block_q=bq, seq_len=s, causal=causal, scale=scale
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, s, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, s, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, s, 1), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse, delta)
+
+    return (
+        dq.reshape(b, h, s, d),
+        dk.reshape(b, h, s, d),
+        dv.reshape(b, h, s, d),
+    )
 
 
 def _auto_interpret() -> bool:
@@ -125,17 +309,20 @@ def _auto_interpret() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_backward(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -33,6 +33,29 @@ def _next_pow2(n: int) -> int:
     return b
 
 
+def _prompt_bucket(n_tokens: int, max_seq: int) -> int | None:
+    """Smallest compile bucket >= n_tokens that still leaves decode room.
+
+    Power-of-two buckets up to max_seq/2 keep the compile count
+    O(log max_seq); two fixed long-prompt buckets (¾·max_seq and
+    max_seq-8) extend serving capacity to max_seq-8 tokens instead of
+    silently rejecting everything past max_seq/2.  Returns None when the
+    prompt can't fit with at least 8 tokens of decode room — callers
+    report max_seq-8 as the true limit.
+    """
+    candidates = []
+    b = 8
+    while b <= max_seq // 2:
+        candidates.append(b)
+        b *= 2
+    candidates.append((3 * max_seq // 4) // 8 * 8)
+    candidates.append(max_seq - 8)
+    for c in sorted(set(candidates)):
+        if c >= n_tokens and c < max_seq:
+            return c
+    return None
+
+
 class LmServer:
     """port=0 binds an ephemeral port (tests); ``.port`` is the bound one."""
 
@@ -99,13 +122,13 @@ class LmServer:
                 # so compile count stays O(log² max_seq) instead of one
                 # multi-second retrace per distinct prompt length — all
                 # while holding the generation lock.
-                bucket = _next_pow2(max(int(ids.size), 8))
-                room = outer.engine.max_seq - bucket
-                if ids.size >= outer.engine.max_seq or room < 1:
+                bucket = _prompt_bucket(int(ids.size), outer.engine.max_seq)
+                if bucket is None:
                     return self._json(400, {
                         "error": f"prompt too long ({ids.size} tokens, "
-                                 f"max {outer.engine.max_seq - 1})"
+                                 f"max {outer.engine.max_seq - 8})"
                     })
+                room = outer.engine.max_seq - bucket
                 want = max(1, min(want, outer.cap, room))
                 n_new = min(_next_pow2(want), room)
                 pad = bucket - int(ids.size)

@@ -169,3 +169,81 @@ def _lora_finetune(spec, placements) -> dict:
         "adapter_params": num_params(trainer.params),
         "base_params": num_params(base_params),
     }
+
+
+@register_workload("lm-train-ckpt")
+def _lm_train_ckpt(spec, placements, ctx=None) -> dict:
+    """Checkpoint-aware flagship LM training — the end-to-end elastic story
+    (SURVEY §5.3-5.4): periodic Orbax save every ctx.checkpoint_interval
+    steps; on (re)start, resume from the latest checkpoint if one exists.
+    Per-step data is derived from the step index (fold_in), so a resumed
+    run recomputes the exact step sequence an uninterrupted run would —
+    the loss curve continues instead of restarting.
+    """
+    import jax
+
+    from ..models import TransformerConfig, TransformerLM
+    from ..parallel.mesh import MeshConfig, build_mesh
+    from .checkpoint import attach_to_trainer
+    from .runner import TrainConfig, Trainer
+
+    args = spec.workload_args
+    steps = int(args.get("steps", 10))
+    batch = int(args.get("batch", 4))
+    cfg = TransformerConfig(
+        vocab_size=int(args.get("vocab", 256)),
+        d_model=int(args.get("d_model", 64)),
+        n_layers=int(args.get("layers", 2)),
+        n_heads=4,
+        d_head=16,
+        d_ff=int(args.get("d_ff", 128)),
+    )
+    model = TransformerLM(cfg)
+    trainer = Trainer(
+        model,
+        mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TrainConfig(warmup_steps=1, learning_rate=1e-3),
+    )
+    trainer.init(jax.random.PRNGKey(0))
+
+    ckpt_dir = (ctx.checkpoint_dir if ctx else "") or args.get(
+        "checkpoint_dir", ""
+    )
+    interval = (ctx.checkpoint_interval if ctx else 0) or int(
+        args.get("interval", 0)
+    )
+    if not ckpt_dir:
+        raise ValueError("lm-train-ckpt needs a checkpoint dir "
+                         "(spec.checkpoint_dir or workload_args.checkpoint_dir)")
+    ckpt, save, resume = attach_to_trainer(trainer, ckpt_dir)
+    try:
+        start = 0
+        if ckpt.latest_step() is not None:
+            start = resume()
+            if ctx:
+                ctx.record_resume(start)
+        data_key = jax.random.PRNGKey(int(args.get("data_seed", 7)))
+        first = last = None
+        for step in range(start + 1, steps + 1):
+            sk = jax.random.fold_in(data_key, step)
+            toks = jax.random.randint(sk, (batch, 33), 0, cfg.vocab_size)
+            loss = trainer.step(toks[:, :-1], toks[:, 1:])
+            first = loss if first is None else first
+            last = loss
+            # Save before the heartbeat: if the slice died during this
+            # step, the checkpoint that just completed is the resume point.
+            if interval and step % interval == 0:
+                save(step)
+                if ctx:
+                    ctx.record_checkpoint(step)
+            if ctx:
+                ctx.heartbeat(step)
+    finally:
+        ckpt.close()
+    return {
+        "steps": steps,
+        "start_step": start,
+        "resumed": start > 0,
+        "first_loss": first,
+        "last_loss": last,
+    }

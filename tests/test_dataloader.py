@@ -41,6 +41,11 @@ def test_drop_last_and_epoch_rollover(token_file):
                      shuffle=False) as dl:
         assert dl.batches_per_epoch == 10
         collect(dl, 10)
+        # .epoch reports the epoch of the just-returned batch (matching
+        # the native dl_next_batch contract): the 10th batch still belongs
+        # to epoch 0; the 11th is the first of epoch 1.
+        assert dl.epoch == 0
+        collect(dl, 1)
         assert dl.epoch == 1
 
 

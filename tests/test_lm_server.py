@@ -110,3 +110,15 @@ def test_left_pad_bucketing_matches_unpadded(server):
     )
     direct_ids = jax.device_get(direct.tokens[0])[:6].tolist()
     assert out["ids"] == direct_ids
+
+
+def test_prompt_bucket_top_half_not_rejected():
+    """ADVICE r1: prompts longer than max_seq/2 must still bucket (the old
+    pow2-only scheme silently halved capacity)."""
+    from k8s_gpu_tpu.serve.server import _prompt_bucket
+
+    assert _prompt_bucket(10, 64) == 16
+    assert _prompt_bucket(33, 64) == 48       # top half: ¾ bucket
+    assert _prompt_bucket(50, 64) == 56       # near-full: max_seq-8 bucket
+    assert _prompt_bucket(56, 64) == 56
+    assert _prompt_bucket(57, 64) is None     # true limit is max_seq-8

@@ -68,3 +68,100 @@ def test_causality_no_future_leak():
     pert = np.asarray(jax.jit(lambda *a: ring_attention(*a, mesh))(q, k2, v2))
     np.testing.assert_allclose(base[:, :, :-1], pert[:, :, :-1], atol=1e-5)
     assert not np.allclose(base[:, :, -1], pert[:, :, -1])
+
+
+def _naive_ring(q, k, v, mesh, axis_name="sp"):
+    """The r1 implementation: every hop computes the full block einsum and
+    masks afterwards — the FLOP baseline the zigzag schedule halves."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+    n = mesh.shape[axis_name]
+    scale = q.shape[-1] ** -0.5
+
+    def body(q, k, v):
+        b, h, sq, d = q.shape
+        o = jnp.zeros((b, h, sq, d), jnp.float32)
+        m = jnp.full((b, h, sq), -1e30, jnp.float32)
+        l = jnp.zeros((b, h, sq), jnp.float32)
+        my = jax.lax.axis_index(axis_name)
+
+        def step(carry, t):
+            o, m, l, k, v = carry
+            src = (my - t) % n
+            q_pos = my * sq + jnp.arange(sq)
+            k_pos = src * sq + jnp.arange(sq)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) * scale
+            s = jnp.where(mask[None, None], s, -1e30)
+            m_new = jnp.maximum(m, s.max(-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l = l * alpha + p.sum(-1)
+            o = o * alpha[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            return (o, m_new, l, jax.lax.ppermute(k, axis_name, perm),
+                    jax.lax.ppermute(v, axis_name, perm)), None
+
+        (o, m, l, _, _), _ = jax.lax.scan(step, (o, m, l, k, v), jnp.arange(n))
+        return (o / l[..., None]).astype(q.dtype)
+
+    spec = P(("dp",), ("tp",), axis_name, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _matmul_flops(jaxpr, mult=1):
+    """Count dot_general FLOPs in a jaxpr, multiplying scan bodies by their
+    trip count (XLA's cost_analysis counts loop bodies once, which would
+    hide the per-hop saving)."""
+    import math
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            lhs = eqn.invars[0].aval.shape
+            rhs = eqn.invars[1].aval.shape
+            (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+            batch = math.prod(lhs[i] for i in lb)
+            kdim = math.prod(lhs[i] for i in lc)
+            m = math.prod(
+                lhs[i] for i in range(len(lhs)) if i not in lc and i not in lb
+            )
+            n = math.prod(
+                rhs[i] for i in range(len(rhs)) if i not in rc and i not in rb
+            )
+            total += 2 * batch * m * n * kdim * mult
+        inner_mult = (
+            mult * eqn.params["length"]
+            if eqn.primitive.name == "scan"
+            else mult
+        )
+        for p in eqn.params.values():
+            inner = p if hasattr(p, "eqns") else getattr(p, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                total += _matmul_flops(inner, inner_mult)
+    return total
+
+
+def test_zigzag_halves_flops_at_sp8():
+    """VERDICT r1 item #4 'done' criterion: per-step FLOPs ~halved at sp=8
+    vs the mask-after-full-einsum ring."""
+    sp = 8
+    mesh = build_mesh(MeshConfig(dp=1, sp=sp, tp=1), n_devices=sp)
+    q, k, v = make_qkv(jax.random.PRNGKey(5), b=1, h=2, s=512, d=64)
+
+    zig = lambda q, k, v: ring_attention(q, k, v, mesh)
+    naive = lambda q, k, v: _naive_ring(q, k, v, mesh)
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(zig)(q, k, v)),
+        np.asarray(jax.jit(naive)(q, k, v)),
+        atol=2e-5,
+    )
+
+    fz = _matmul_flops(jax.make_jaxpr(zig)(q, k, v).jaxpr)
+    fn = _matmul_flops(jax.make_jaxpr(naive)(q, k, v).jaxpr)
+    # Exact accounting: naive does n full block pairs per device; zigzag
+    # does the local causal prologue (1 full pair) + 2 half-pairs on each
+    # of the n-1 hops = (n+1)/2 full-pair equivalents → ratio 9/16 at n=8.
+    assert fz < 0.6 * fn, f"zigzag flops {fz} not ~half of naive {fn}"

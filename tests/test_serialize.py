@@ -97,3 +97,25 @@ def test_apply_invalid_spec_is_clean_error(tmp_path, monkeypatch, capsys):
     code = main(["apply", "-f", str(f)])
     err = capsys.readouterr().err
     assert code == 1 and "bad" in err and "Traceback" not in err
+
+
+def test_tuple_and_union_decode():
+    """ADVICE r1: tuple-typed fields round-trip as tuples; non-Optional
+    unions try every arm, not just the first."""
+    import dataclasses
+    from k8s_gpu_tpu.api.serialize import _decode_value
+
+    assert _decode_value(tuple[int, ...], [1, 2, 3], "x") == (1, 2, 3)
+    assert _decode_value(tuple[int], [4], "x") == (4,)
+    assert _decode_value(list[int], [1, 2], "x") == [1, 2]
+
+    @dataclasses.dataclass
+    class Inner:
+        a: int = 0
+
+    # Union whose first arm fails (dataclass wants a mapping) must fall
+    # through to the list arm.
+    got = _decode_value(Inner | list[int], [1, 2], "x")
+    assert got == [1, 2]
+    got = _decode_value(Inner | list[int], {"a": 5}, "x")
+    assert got == Inner(a=5)

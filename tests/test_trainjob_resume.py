@@ -1,0 +1,215 @@
+"""Elastic recovery end-to-end (SURVEY §5.3-5.4; VERDICT r1 item #3):
+periodic checkpoint → slice preemption mid-training → slice self-heals →
+job re-places and RESUMES from the latest checkpoint — the loss curve
+continues instead of restarting from step 0.
+
+Runs on the real clock: the workload trains in a reconciler worker thread
+while the slice reconciler concurrently notices the SUSPENDED queued
+resource and prunes/recreates nodes.
+"""
+
+import time
+
+import pytest
+
+import k8s_gpu_tpu.operators.tpupodslice as tps_mod
+import k8s_gpu_tpu.operators.trainjob as tj_mod
+from k8s_gpu_tpu.api import TpuPodSlice, TrainJob
+from k8s_gpu_tpu.cloud import FakeCloudTpu, cloudtpu_client_factory
+from k8s_gpu_tpu.cloud.topology import parse_accelerator_type
+from k8s_gpu_tpu.controller import FakeKube, Manager
+from k8s_gpu_tpu.operators import TpuPodSliceReconciler, TrainJobReconciler
+
+ACCEL = "v4-8"  # one host → one worker pod
+
+WORKLOAD_ARGS = {
+    "steps": 200, "d_model": 32, "layers": 1, "d_ff": 64, "batch": 2,
+    "vocab": 64,
+}
+
+
+@pytest.fixture
+def live(monkeypatch):
+    # Real-clock harness with tight polling so preemption → prune →
+    # re-place all happens within the test budget.
+    monkeypatch.setattr(tps_mod, "RESYNC", 0.05)
+    monkeypatch.setattr(tj_mod, "CAPACITY_POLL", 0.05)
+    kube = FakeKube()
+    cloud = FakeCloudTpu()
+    mgr = Manager(kube)
+    mgr.register(
+        "TpuPodSlice",
+        TpuPodSliceReconciler(
+            kube, cloudtpu_client_factory(cloud), provision_poll=0.01
+        ),
+    )
+    mgr.register("TrainJob", TrainJobReconciler(kube))
+    mgr.start()
+    yield kube, cloud, mgr
+    mgr.stop()
+
+
+def _wait(cond, timeout=60.0, what="condition"):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {what}")
+
+
+def _make_job(name, tmp_path, interval=5):
+    job = TrainJob()
+    job.metadata.name = name
+    job.spec.accelerator_type = ACCEL
+    job.spec.num_workers = parse_accelerator_type(ACCEL).hosts
+    job.spec.workload = "lm-train-ckpt"
+    job.spec.workload_args = dict(WORKLOAD_ARGS)
+    job.spec.restart_policy = "OnFailure"
+    job.spec.checkpoint_interval_steps = interval
+    job.spec.checkpoint_dir = str(tmp_path / f"ck-{name}")
+    return job
+
+
+def test_preempted_job_resumes_from_checkpoint(live, tmp_path):
+    kube, cloud, mgr = live
+    ps = TpuPodSlice()
+    ps.metadata.name = "pool"
+    ps.spec.accelerator_type = ACCEL
+    kube.create(ps)
+    _wait(lambda: kube.get("TpuPodSlice", "pool").status.phase == "Ready",
+          what="slice Ready")
+
+    kube.create(_make_job("elastic", tmp_path))
+    # Let training make some progress past the first checkpoint...
+    _wait(
+        lambda: kube.get("TrainJob", "elastic").status.progress_step >= 8,
+        timeout=120, what="training progress",
+    )
+    # ...then yank the slice out from under it (spot preemption).
+    cloud.preempt_slice("default-pool-qr")
+
+    _wait(
+        lambda: kube.get("TrainJob", "elastic").status.phase == "Succeeded",
+        timeout=180, what="job Succeeded after preemption",
+    )
+    job = kube.get("TrainJob", "elastic")
+    assert job.status.restarts == 1
+    assert job.status.result["resumed"]  # status floats bools → 1.0
+    # It resumed from a periodic checkpoint, not from scratch.
+    assert job.status.result["start_step"] >= 5
+    assert job.status.resumed_from_step == job.status.result["start_step"]
+    assert job.status.checkpoint_step >= job.status.resumed_from_step
+    assert job.status.result["steps"] == WORKLOAD_ARGS["steps"]
+    # The slice healed underneath it.
+    assert kube.get("TpuPodSlice", "pool").status.phase == "Ready"
+
+
+def test_loss_curve_continues_not_restarts(live, tmp_path):
+    """The resumed run must land where an uninterrupted run lands: per-step
+    data is derived from the step index and state comes from the
+    checkpoint, so the final loss matches a control job exactly."""
+    kube, cloud, mgr = live
+    ps = TpuPodSlice()
+    ps.metadata.name = "pool"
+    ps.spec.accelerator_type = ACCEL
+    kube.create(ps)
+    _wait(lambda: kube.get("TpuPodSlice", "pool").status.phase == "Ready",
+          what="slice Ready")
+
+    kube.create(_make_job("interrupted", tmp_path))
+    _wait(
+        lambda: kube.get("TrainJob", "interrupted").status.progress_step >= 8,
+        timeout=120, what="training progress",
+    )
+    cloud.preempt_slice("default-pool-qr")
+    _wait(
+        lambda: kube.get("TrainJob", "interrupted").status.phase
+        == "Succeeded",
+        timeout=180, what="interrupted job Succeeded",
+    )
+
+    kube.create(_make_job("control", tmp_path))
+    _wait(
+        lambda: kube.get("TrainJob", "control").status.phase == "Succeeded",
+        timeout=180, what="control job Succeeded",
+    )
+
+    a = kube.get("TrainJob", "interrupted").status
+    b = kube.get("TrainJob", "control").status
+    assert a.restarts == 1 and b.restarts == 0
+    assert a.result["resumed"] and not b.result["resumed"]
+    assert a.result["last_loss"] == pytest.approx(
+        b.result["last_loss"], abs=1e-4
+    )
+
+
+def test_restart_policy_never_fails_on_preemption(live, tmp_path):
+    """Without OnFailure the old behavior stands: preemption → Failed."""
+    kube, cloud, mgr = live
+    ps = TpuPodSlice()
+    ps.metadata.name = "pool"
+    ps.spec.accelerator_type = ACCEL
+    kube.create(ps)
+    _wait(lambda: kube.get("TpuPodSlice", "pool").status.phase == "Ready",
+          what="slice Ready")
+
+    job = _make_job("oneshot", tmp_path)
+    job.spec.restart_policy = "Never"
+    kube.create(job)
+    _wait(
+        lambda: kube.get("TrainJob", "oneshot").status.progress_step >= 8,
+        timeout=120, what="training progress",
+    )
+    cloud.preempt_slice("default-pool-qr")
+    _wait(
+        lambda: kube.get("TrainJob", "oneshot").status.phase == "Failed",
+        timeout=180, what="job Failed",
+    )
+    job = kube.get("TrainJob", "oneshot")
+    assert job.status.restarts == 0
+    assert "placement node(s) lost" in job.status.message
+
+
+def test_recreated_job_starts_fresh_and_conditions_clear(live, tmp_path):
+    """A completed job's derived checkpoint dir is cleaned up (a re-created
+    same-name job must not silently resume its predecessor) and a recovered
+    job's Interrupted condition flips back to False on success."""
+    kube, cloud, mgr = live
+    ps = TpuPodSlice()
+    ps.metadata.name = "pool"
+    ps.spec.accelerator_type = ACCEL
+    kube.create(ps)
+    _wait(lambda: kube.get("TpuPodSlice", "pool").status.phase == "Ready",
+          what="slice Ready")
+
+    job = _make_job("fresh", tmp_path)
+    job.spec.checkpoint_dir = ""  # use the derived default dir
+    job.spec.workload_args = dict(WORKLOAD_ARGS, steps=12)
+    kube.create(job)
+    _wait(
+        lambda: kube.get("TrainJob", "fresh").status.progress_step >= 4,
+        timeout=120, what="training progress",
+    )
+    cloud.preempt_slice("default-pool-qr")
+    _wait(lambda: kube.get("TrainJob", "fresh").status.phase == "Succeeded",
+          timeout=180, what="job Succeeded")
+    done = kube.get("TrainJob", "fresh")
+    interrupted = next(
+        c for c in done.status.conditions if c.type == "Interrupted"
+    )
+    assert interrupted.status == "False" and interrupted.reason == "Recovered"
+
+    # Same name, new job: must train from step 0, not resume at 12.
+    kube.delete("TrainJob", "fresh")
+    _wait(lambda: kube.try_get("TrainJob", "fresh") is None,
+          what="job deleted")
+    job2 = _make_job("fresh", tmp_path)
+    job2.spec.checkpoint_dir = ""
+    job2.spec.workload_args = dict(WORKLOAD_ARGS, steps=12)
+    kube.create(job2)
+    _wait(lambda: kube.get("TrainJob", "fresh").status.phase == "Succeeded",
+          timeout=180, what="re-created job Succeeded")
+    again = kube.get("TrainJob", "fresh")
+    assert not again.status.result["resumed"]
+    assert again.status.result["start_step"] == 0
