@@ -1,17 +1,32 @@
-"""Benchmark: the BASELINE.json graded metric, end-to-end.
+"""Benchmark: the BASELINE.json graded metric + compute-bound ML performance.
 
-Measures `kubectl apply`→Ready reconcile wall-clock for TpuPodSlice v5p-8
-and v5p-64 (status.readyReplicas parity checked), then runs the JAX psum
-smoke job and a flagship-transformer train step on the real attached
-device — the north-star acceptance ("v5p-64 from 0→Ready + psum smoke in
-under 5 minutes", BASELINE.json).  vs_baseline is 300 s (the 5-minute
-target) divided by our total: > 1.0 means faster than the target.
+Two halves, one JSON line:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+1. **Platform half** (BASELINE.json graded metric): `kubectl apply`→Ready
+   reconcile wall-clock for TpuPodSlice v5p-8 and v5p-64 (readyReplicas
+   parity checked), then the JAX psum smoke — the north-star acceptance
+   ("v5p-64 from 0→Ready + psum smoke in under 5 minutes").
+2. **Compute half**: a compute-bound train bench on the flagship
+   transformer (302M params, seq 2048, bf16, Pallas flash attention) that
+   reports **MFU** against the attached chip's peak bf16 FLOP/s, plus a
+   kernel micro-bench timing flash fwd/fwd+bwd at 4x16x2048x128 against
+   the jnp oracle and the bundled `jax.experimental.pallas.ops.tpu`
+   reference kernel.
+
+Timing hygiene (two lessons encoded here):
+- compile happens in a warmup pass and is reported separately
+  (``compile_s``); the headline window measures steady state only;
+- on the tunneled TPU platform ``block_until_ready`` can return before
+  execution finishes, so every timed window ends with a device→host
+  scalar fetch (``float(...)``/``np.asarray``), which cannot lie.
+
+vs_baseline is 300 s (the 5-minute north-star budget) divided by the
+headline: > 1.0 means faster than the target.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -19,9 +34,9 @@ import time
 
 
 def _enable_compile_cache() -> None:
-    """Persistent XLA compilation cache: the first bench run pays the
-    ~20-40s TPU compile, later runs hit the cache and measure the
-    framework, not the compiler."""
+    """Persistent XLA compilation cache: the first bench run pays the TPU
+    compile, later runs hit the cache and measure the framework, not the
+    compiler.  (Compile is *also* excluded from the headline by warmup.)"""
     cache = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".jax_compile_cache"
     )
@@ -33,6 +48,20 @@ def _enable_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax: cache unavailable, bench still correct
+
+
+# Peak dense bf16 FLOP/s by device kind (public spec sheets).  Used only to
+# turn measured FLOP/s into MFU; unknown kinds report mfu=0.0 and the raw
+# FLOP/s stands on its own.
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # Trillium
+    "TPU v6e": 918e12,
+}
 
 
 def reconcile_to_ready(accel: str, slice_count: int = 1) -> tuple[float, int]:
@@ -73,8 +102,196 @@ def reconcile_to_ready(accel: str, slice_count: int = 1) -> tuple[float, int]:
     return dt, ready
 
 
+# -- compute half -----------------------------------------------------------
+
+def _flagship_config(on_tpu: bool):
+    """302M-param decoder LM on TPU (compute-bound: fills the MXU at
+    d_model=1024, d_head=128, seq 2048); a ~4M toy on CPU so the bench
+    still completes everywhere."""
+    from k8s_gpu_tpu.models import TransformerConfig
+
+    if on_tpu:
+        return TransformerConfig(
+            vocab_size=16384, d_model=1024, n_layers=16, n_heads=8,
+            d_head=128, d_ff=4096, max_seq=2048,
+            use_flash=True, flash_block_q=512, flash_block_k=512,
+        ), 16  # batch
+    return TransformerConfig(
+        vocab_size=2048, d_model=256, n_layers=4, n_heads=8, d_head=32,
+        d_ff=704, max_seq=256,
+    ), 8
+
+
+def model_flops_per_step(cfg, n_params: int, batch: int) -> float:
+    """Analytic model FLOPs for one fwd+bwd step (PaLM appendix-B
+    convention): 6·N per token for the matmul path + attention scores
+    12·B·H·Dh·S²·L, halved for causality.  Remat recompute is *not*
+    counted — MFU measures useful model FLOPs."""
+    tokens = batch * cfg.max_seq
+    matmul = 6.0 * n_params * tokens
+    attn = 12.0 * batch * cfg.n_heads * cfg.d_head * cfg.max_seq ** 2 * cfg.n_layers / 2.0
+    return matmul + attn
+
+
+def train_bench() -> dict:
+    """Steady-state train-step timing on the flagship; returns timings plus
+    the model handle for the decode probe.  Each step syncs on float(loss),
+    so the window is honest under the tunneled platform."""
+    import jax
+
+    from k8s_gpu_tpu.models import TransformerLM
+    from k8s_gpu_tpu.parallel.mesh import MeshConfig, mesh_from_devices
+    from k8s_gpu_tpu.train import TrainConfig, Trainer
+
+    devs = jax.devices()
+    on_tpu = devs[0].platform == "tpu"
+    cfg, batch = _flagship_config(on_tpu)
+    model = TransformerLM(cfg)
+    mesh = mesh_from_devices(devs[:1], MeshConfig(dp=1))
+    trainer = Trainer(model, mesh=mesh, train_config=TrainConfig(warmup_steps=1))
+
+    t0 = time.perf_counter()
+    trainer.init(jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(trainer.params))
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, cfg.max_seq + 1), 0, cfg.vocab_size
+    )
+    first_loss = trainer.step(toks[:, :-1], toks[:, 1:])  # compile + warmup
+    compile_s = time.perf_counter() - t0
+
+    n_steps = 8
+    t1 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = trainer.step(toks[:, :-1], toks[:, 1:])
+    steady_s = time.perf_counter() - t1
+
+    step_s = steady_s / n_steps
+    flops = model_flops_per_step(cfg, n_params, batch)
+    flops_per_s = flops / step_s
+    peak = PEAK_BF16_FLOPS.get(devs[0].device_kind, 0.0)
+    return {
+        "model": model,
+        "trainer": trainer,
+        "timings": {
+            "params_m": round(n_params / 1e6, 1),
+            "seq_len": cfg.max_seq,
+            "batch": batch,
+            "train_step_s": step_s,
+            "train_tokens_per_s": batch * cfg.max_seq / step_s,
+            "model_flops_per_step": flops,
+            "model_flops_per_s": flops_per_s,
+            "mfu": (flops_per_s / peak) if peak else 0.0,
+            "device_kind": devs[0].device_kind,
+            "peak_bf16_flops": peak,
+            "compile_s": compile_s,
+            "train_steady_window_s": steady_s,
+            "first_loss": float(first_loss),
+            "last_loss": float(loss),
+        },
+    }
+
+
+def kernel_bench() -> dict:
+    """Flash-attention micro-bench at 4x16x2048x128 (the VERDICT r2 shape):
+    our Pallas kernels vs the jnp oracle vs the bundled
+    jax.experimental.pallas.ops.tpu reference.  TPU-only (the interpreter
+    path would take minutes on CPU for nothing).
+
+    Iterates on-device inside one jit (chained so XLA cannot hoist the body)
+    and ends with a scalar fetch — per-iteration cost is honest even though
+    block_until_ready is unreliable through the tunnel."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "kernel bench requires a TPU device"}
+
+    from k8s_gpu_tpu.ops.attention import flash_attention, reference_attention
+
+    B, H, S, D = 4, 16, 2048, 128
+    n_iter = 10
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.bfloat16)
+
+    def time_fwd(attn_fn):
+        @jax.jit
+        def run(q, k, v):
+            def body(i, acc):
+                # Data-dep on acc so XLA can't hoist the body; cast back to
+                # q's dtype — bare `q + f32 scalar` would promote the whole
+                # bench to f32.
+                o = attn_fn(q + (acc * 1e-12).astype(q.dtype), k, v)
+                return acc + o[0, 0, 0, 0].astype(jnp.float32)
+            return lax.fori_loop(0, n_iter, body, jnp.float32(0))
+
+        float(run(q, k, v))  # compile + warm
+        t0 = time.perf_counter()
+        float(run(q, k, v))  # the fetch is the sync point
+        return (time.perf_counter() - t0) / n_iter
+
+    def time_fwdbwd(attn_fn):
+        def loss(q, k, v):
+            o = attn_fn(q, k, v).astype(jnp.float32)
+            return jnp.mean(o * o)  # dense cotangent: full bwd exercised
+
+        g = jax.grad(loss, argnums=(0, 1, 2))
+
+        @jax.jit
+        def run(q, k, v):
+            def body(i, acc):
+                dq, _, _ = g(q + (acc * 1e-12).astype(q.dtype), k, v)
+                return acc + dq[0, 0, 0, 0].astype(jnp.float32)
+            return lax.fori_loop(0, n_iter, body, jnp.float32(0))
+
+        float(run(q, k, v))
+        t0 = time.perf_counter()
+        float(run(q, k, v))
+        return (time.perf_counter() - t0) / n_iter
+
+    ours = functools.partial(
+        flash_attention, causal=True, block_q=512, block_k=512
+    )
+    oracle = functools.partial(reference_attention, causal=True)
+    res = {"shape": f"{B}x{H}x{S}x{D}"}
+    # The micro-bench is diagnostic: one failing kernel must not cost the
+    # graded platform metric — record the error and move on.
+    for name, timer, fn in (
+        ("fwd_ours_ms", time_fwd, ours),
+        ("fwd_oracle_ms", time_fwd, oracle),
+        ("fwdbwd_ours_ms", time_fwdbwd, ours),
+        ("fwdbwd_oracle_ms", time_fwdbwd, oracle),
+    ):
+        try:
+            res[name] = timer(fn) * 1e3
+        except Exception as e:
+            res[name + "_error"] = str(e)[:200]
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as bundled,
+        )
+
+        bf = functools.partial(bundled, causal=True)
+        res["fwd_pallas_ref_ms"] = time_fwd(bf) * 1e3
+        res["fwdbwd_pallas_ref_ms"] = time_fwdbwd(bf) * 1e3
+    except Exception as e:  # bundled kernel absent/incompatible: not our bug
+        res["pallas_ref_error"] = str(e)[:200]
+    # Causal attention FLOPs: QK^T and PV, 2·B·H·S²·D each, half masked out.
+    fwd_flops = 2 * 2 * B * H * S * S * D / 2
+    if "fwd_ours_ms" in res:
+        res["fwd_tflops_per_s"] = fwd_flops / (res["fwd_ours_ms"] / 1e3) / 1e12
+    if "fwdbwd_ours_ms" in res:
+        res["fwdbwd_tflops_per_s"] = (
+            3.5 * fwd_flops / (res["fwdbwd_ours_ms"] / 1e3) / 1e12
+        )
+    return res
+
+
 def decode_probe(model, params) -> dict:
-    """KV-cache decode throughput on the flagship config (serving half)."""
+    """KV-cache decode throughput on the flagship (serving half)."""
+    import numpy as np
     import jax
 
     from k8s_gpu_tpu.serve import InferenceEngine
@@ -82,87 +299,64 @@ def decode_probe(model, params) -> dict:
     engine = InferenceEngine(model)
     prompt = jax.numpy.zeros((1, 33), jax.numpy.int32)
     n_new = 64
-    # Warmup with the SAME static args as the timed call: max_new_tokens
-    # is a static jit arg, so a different value would recompile inside
-    # the timed region.
-    jax.block_until_ready(
-        engine.generate(params, prompt, max_new_tokens=n_new).tokens
-    )
+    # Warmup with the SAME static args as the timed call (max_new_tokens is
+    # a static jit arg — a different value would recompile in the window).
+    np.asarray(engine.generate(params, prompt, max_new_tokens=n_new).tokens)
     t0 = time.perf_counter()
     out = engine.generate(params, prompt, max_new_tokens=n_new)
-    # TPU dispatch is async: without the sync this measures enqueue time.
-    jax.block_until_ready(out.tokens)
+    # The host fetch is the sync point (block_until_ready is unreliable
+    # through the tunnel).
+    np.asarray(out.tokens)
     dt = time.perf_counter() - t0
     return {"decode_tokens_per_s": n_new / dt}
 
 
-def device_smoke() -> dict:
-    """psum smoke + one flagship train step on the real attached device."""
+def main() -> None:
+    _enable_compile_cache()
     import jax
 
+    t_v5p8, _ = reconcile_to_ready("v5p-8")
+    t_v5p64, _ = reconcile_to_ready("v5p-64")
+
     from k8s_gpu_tpu.parallel import psum_smoke
-    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
-    from k8s_gpu_tpu.train import TrainConfig, Trainer
-    from k8s_gpu_tpu.parallel.mesh import mesh_from_devices, MeshConfig
 
     t0 = time.perf_counter()
     smoke = psum_smoke()
     if not smoke["ok"]:
         raise RuntimeError(f"psum smoke failed: {smoke}")
+    psum_s = time.perf_counter() - t0
 
-    devs = jax.devices()
-    mesh = mesh_from_devices(devs[:1], MeshConfig(dp=1))
-    model = TransformerLM(
-        TransformerConfig(
-            vocab_size=2048, d_model=256, n_layers=4, n_heads=8, d_head=32,
-            d_ff=704, max_seq=256,
-        )
-    )
-    trainer = Trainer(model, mesh=mesh, train_config=TrainConfig(warmup_steps=1))
-    trainer.init(jax.random.PRNGKey(0))
-    key = jax.random.PRNGKey(1)
-    toks = jax.random.randint(key, (8, 257), 0, 2048)
-    loss0 = trainer.step(toks[:, :-1], toks[:, 1:])  # includes compile
-    t_compile = time.perf_counter() - t0
-    n_steps = 10
-    t1 = time.perf_counter()
-    for _ in range(n_steps):
-        loss = trainer.step(toks[:, :-1], toks[:, 1:])
-    t_steps = time.perf_counter() - t1
-    tokens_per_s = 8 * 256 * n_steps / t_steps
-    # Headline window closes BEFORE the serving probe: the graded metric
-    # is "apply -> Ready -> psum/train smoke", not decode compile time.
-    smoke_total_s = time.perf_counter() - t0
-    decode = decode_probe(model, trainer.params)
-    return {
-        **decode,
-        "psum_wall_s": smoke["wall_s"],
-        "smoke_total_s": smoke_total_s,
-        "train_step_s": t_steps / n_steps,
-        "train_tokens_per_s": tokens_per_s,
-        "platform": devs[0].platform,
-        "first_loss": float(loss0),
-        "last_loss": float(loss),
-        "compile_s": t_compile,
-    }
+    tb = train_bench()
+    kern = kernel_bench()
+    decode = decode_probe(tb["model"], tb["trainer"].params)
 
-
-def main() -> None:
-    _enable_compile_cache()
-    t_v5p8, _ = reconcile_to_ready("v5p-8")
-    t_v5p64, _ = reconcile_to_ready("v5p-64")
-    smoke = device_smoke()
-    total = t_v5p64 + smoke["smoke_total_s"]
-    baseline_s = 300.0  # north-star budget: apply -> Ready -> psum < 5 min
+    # Headline: apply→Ready + psum + the steady-state train window.  Compile
+    # is warmup (reported in detail.compile_s), not part of the metric.
+    timings = tb["timings"]
+    headline = t_v5p64 + psum_s + timings["train_steady_window_s"]
+    baseline_s = 300.0  # north-star budget: apply → Ready → smoke < 5 min
+    rnd = lambda v: round(v, 5) if isinstance(v, float) else v
     out = {
         "metric": "v5p64_apply_to_ready_plus_device_smoke_s",
-        "value": round(total, 4),
+        "value": round(headline, 4),
         "unit": "s",
-        "vs_baseline": round(baseline_s / total, 2),
+        "vs_baseline": round(baseline_s / headline, 2),
         "detail": {
+            # Composition changed in r3: compile moved out of the headline
+            # into warmup (compile_s below) and the train window runs the
+            # 302M flagship, not the r1/r2 4M toy — r1/r2 headline values
+            # are not directly comparable.
+            "headline_composition": (
+                "reconcile_v5p64 + psum + 8-step steady train window; "
+                "compile excluded (since r3)"
+            ),
             "reconcile_0_to_ready_v5p8_s": round(t_v5p8, 4),
             "reconcile_0_to_ready_v5p64_s": round(t_v5p64, 4),
-            **{k: (round(v, 5) if isinstance(v, float) else v) for k, v in smoke.items()},
+            "psum_wall_s": round(psum_s, 4),
+            "platform": jax.devices()[0].platform,
+            **{k: rnd(v) for k, v in timings.items()},
+            **{k: rnd(v) for k, v in decode.items()},
+            "flash_kernel_4x16x2048x128": {k: rnd(v) for k, v in kern.items()},
         },
     }
     print(json.dumps(out))
