@@ -137,13 +137,19 @@ class TransformerLM:
         return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
 
     def _rope(self, x, positions):
-        """x: [B, S, H, Dh]; rotary position embedding."""
+        """x: [B, S, H, Dh]; rotary position embedding.
+
+        ``positions`` is [S] (shared across the batch — training/prefill) or
+        [B, S] (per-row — continuous-batching decode, where each slot sits
+        at its own sequence position)."""
         cfg = self.cfg
         half = cfg.d_head // 2
         freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-        angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, half]
-        cos = jnp.cos(angles)[None, :, None, :]
-        sin = jnp.sin(angles)[None, :, None, :]
+        angles = positions[..., :, None].astype(jnp.float32) * freqs  # [...,S,half]
+        if angles.ndim == 2:
+            angles = angles[None]  # shared positions: broadcast over batch
+        cos = jnp.cos(angles)[:, :, None, :]  # [1|B, S, 1, half]
+        sin = jnp.sin(angles)[:, :, None, :]
         x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
         out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
         return out.astype(x.dtype)

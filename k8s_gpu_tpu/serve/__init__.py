@@ -1,6 +1,10 @@
-"""Serving: KV-cache inference engine + the LM HTTP server."""
+"""Serving: KV-cache inference engine, continuous batcher, LM HTTP server."""
 
+from .batcher import ContinuousBatcher, RequestHandle
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
 from .server import LmServer
 
-__all__ = ["InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer"]
+__all__ = [
+    "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
+    "ContinuousBatcher", "RequestHandle",
+]

@@ -1,0 +1,408 @@
+"""Continuous batching: admit requests into a *running* decode.
+
+The serving path the reference delegates to Ollama (智能风控解决方案.md:196)
+is rebuilt here TPU-style: one statically-shaped decode program over a fixed
+pool of batch slots, with requests admitted at round boundaries instead of
+queueing behind each other (the vLLM/Orca scheduling idea, re-done for XLA's
+static-shape world):
+
+- the KV cache is allocated once at [L, slots, H, max_seq, Dh]; a request
+  occupies one slot row from admission to completion;
+- **prefill** runs per request at a bucketed prompt length (O(log max_seq)
+  compiles) on a [1, bucket] shape; the row is spliced into the pool cache
+  and the slot's decode state is set — all inside one donated jit, so
+  admission never blocks the scheduler on a host fetch;
+- **decode** runs ``steps_per_round`` steps per dispatch as one on-device
+  ``lax.scan`` over ``InferenceEngine.decode_step_multi`` — every row sits
+  at its own position, so rows admitted at different times interleave in
+  the same program.  Idle rows compute garbage that is never read — the
+  price of static shapes, and far cheaper than a retrace;
+- **latency hiding**: all decode state (cache, next-token, positions, PRNG
+  keys) lives on the device and flows from one dispatch to the next, so
+  the scheduler can keep ``pipeline_depth`` rounds in flight and only
+  block when *fetching tokens for emission* — the round-trip cost of the
+  fetch overlaps the next round's compute (essential on a tunneled TPU,
+  where each host<->device trip costs ~100 ms).
+
+Host-side bookkeeping (emitted counts, budgets, EOS) trails the device by
+up to ``pipeline_depth`` rounds: a finished request's slot keeps computing
+garbage for those rounds before it is noticed and freed.  That is the
+standard price of speculation and costs capacity, never correctness.
+
+Sharded serving: pass ``mesh`` — the pool cache is constrained to
+P(None, 'dp', 'tp', None, None) and tp-sharded params make every projection
+matmul tp-parallel (engine docstring).  ``params`` should already carry the
+mesh shardings (shard_params / Trainer.init do this).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .engine import InferenceEngine, _empty_cache
+
+log = logging.getLogger("k8s_gpu_tpu.serve")
+
+
+def prompt_bucket(n_tokens: int, max_seq: int) -> int | None:
+    """Smallest compile bucket >= n_tokens that still leaves decode room.
+
+    Power-of-two buckets up to max_seq/2 keep the compile count
+    O(log max_seq); two fixed long-prompt buckets (3/4·max_seq and
+    max_seq-8) extend serving capacity to max_seq-8 tokens.  Returns None
+    when the prompt can't fit with at least 8 tokens of decode room."""
+    candidates = []
+    b = 8
+    while b <= max_seq // 2:
+        candidates.append(b)
+        b *= 2
+    candidates.append((3 * max_seq // 4) // 8 * 8)
+    candidates.append(max_seq - 8)
+    for c in sorted(set(candidates)):
+        if c >= n_tokens and c < max_seq:
+            return c
+    return None
+
+
+@dataclass
+class _Request:
+    ids: np.ndarray          # prompt token ids, unpadded
+    max_new: int
+    temperature: float
+    seed: int
+    out: queue.Queue = field(default_factory=queue.Queue)
+    slot: int = -1
+    emitted: int = 0
+
+
+class RequestHandle:
+    """Caller's view of an in-flight request: iterate tokens as they
+    stream; ``result()`` blocks for the full list."""
+
+    def __init__(self, req: _Request):
+        self._req = req
+
+    def __iter__(self):
+        while True:
+            tok = self._req.out.get()
+            if tok is None:
+                return
+            yield tok
+
+    def result(self) -> list[int]:
+        return list(self)
+
+
+class ContinuousBatcher:
+    """Fixed-slot continuous batching over one InferenceEngine.
+
+    ``eos_id`` retires a request early; ``slots`` bounds concurrent decode
+    width (the static batch of the decode program).  ``top_k`` is global
+    (per-request top_k would make the sampling shape request-dependent).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        slots: int = 8,
+        mesh: Mesh | None = None,
+        max_seq: int | None = None,
+        eos_id: int = -1,
+        steps_per_round: int = 8,
+        pipeline_depth: int = 2,
+    ):
+        self.engine = InferenceEngine(model, max_seq=max_seq, mesh=mesh)
+        self.params = params
+        self.slots = slots
+        self.eos_id = eos_id
+        self.steps_per_round = max(1, int(steps_per_round))
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        cfg = self.engine.cfg
+
+        # Device-resident decode state: flows dispatch-to-dispatch without
+        # touching the host (the latency-hiding invariant).
+        self._dev = {
+            "cache": self.engine._constrain_cache(
+                _empty_cache(cfg, slots, self.engine.max_seq)
+            ),
+            "token": jnp.zeros(slots, jnp.int32),
+            "pos": jnp.zeros(slots, jnp.int32),
+            "rope": jnp.zeros(slots, jnp.int32),
+            "start": jnp.zeros(slots, jnp.int32),
+            "temps": jnp.zeros(slots, jnp.float32),
+            "keys": jax.vmap(jax.random.PRNGKey)(
+                jnp.zeros(slots, jnp.uint32)
+            ),
+        }
+        # Host-side scheduler state.  No position mirror is needed: submit
+        # clamps max_new to the decode room, so the budget always retires a
+        # slot before its writes could run past max_seq (out-of-bounds
+        # scatter writes in a final round's garbage tail are dropped by
+        # XLA's scatter semantics and never emitted).
+        self._active: list[_Request | None] = [None] * slots
+        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._dead = False
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._round_count = 0
+        # (round, slot) per emitted token; bounded — it's interleaving
+        # observability, not an audit log.
+        self._interleave_log: collections.deque = collections.deque(
+            maxlen=4096
+        )
+        self._admit_jit = jax.jit(self._admit_dev, donate_argnums=(1,))
+        self._round_jit = jax.jit(self._round_dev, donate_argnums=(1,))
+        self._thread = threading.Thread(
+            target=self._loop, name="continuous-batcher", daemon=True
+        )
+
+    # -- device programs ---------------------------------------------------
+    def _admit_dev(self, params, dev, padded, slot, temp, key, pad):
+        """Prefill one request on a [1, bucket] shape, splice its cache row
+        into the pool, seat its decode state at *slot*, and sample the
+        first token — all on device (no host fetch on the admit path).
+        ``pad`` is traced: prompts of every length within a bucket share
+        one compiled program (the O(log max_seq) compile story)."""
+        row_cache, last_logits = self.engine.prefill(
+            params, padded, pad_left=pad
+        )
+        bucket = padded.shape[1]
+        cache = jax.tree.map(
+            lambda p, r: jax.lax.dynamic_update_slice(
+                p, r.astype(p.dtype), (0, slot, 0, 0, 0)
+            ),
+            dev["cache"],
+            row_cache,
+        )
+        key, sub = jax.random.split(key)
+        greedy = jnp.argmax(last_logits[0]).astype(jnp.int32)
+        sampled = jax.random.categorical(
+            sub, last_logits[0] / jnp.maximum(temp, 1e-6)
+        ).astype(jnp.int32)
+        first = jnp.where(temp > 0, sampled, greedy)
+        return {
+            "cache": cache,
+            "token": dev["token"].at[slot].set(first),
+            "pos": dev["pos"].at[slot].set(bucket),
+            "rope": dev["rope"].at[slot].set(bucket - pad),
+            "start": dev["start"].at[slot].set(pad),
+            "temps": dev["temps"].at[slot].set(temp),
+            "keys": dev["keys"].at[slot].set(key),
+        }, first
+
+    def _round_dev(self, params, dev):
+        """One scheduler round: ``steps_per_round`` batched decode steps as
+        a single on-device scan.  Returns (new_dev, tokens [T, B]).  Rows
+        that hit EOS/budget mid-round produce garbage tails the host drops
+        when it retires the slot."""
+        temps = dev["temps"]
+        kv_start = dev["start"]
+
+        def one(carry, _):
+            cache, token, pos, rope, keys = carry
+            cache, logits = self.engine.decode_step_multi(
+                params, cache, token, pos, rope, kv_start
+            )
+            split = jax.vmap(jax.random.split)(keys)     # [B, 2, 2]
+            new_keys, subs = split[:, 0], split[:, 1]
+            greedy = jnp.argmax(logits, axis=-1)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.vmap(
+                lambda k, l: jax.random.categorical(k, l)
+            )(subs, scaled)
+            nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            return (cache, nxt, pos + 1, rope + 1, new_keys), nxt
+
+        (cache, token, pos, rope, keys), toks = jax.lax.scan(
+            one,
+            (dev["cache"], dev["token"], dev["pos"], dev["rope"],
+             dev["keys"]),
+            length=self.steps_per_round,
+        )
+        return {
+            "cache": cache, "token": token, "pos": pos, "rope": rope,
+            "start": kv_start, "temps": temps, "keys": keys,
+        }, toks
+
+    # -- public surface ----------------------------------------------------
+    def start(self) -> "ContinuousBatcher":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    def submit(
+        self,
+        ids,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> RequestHandle:
+        """Queue a request; returns a handle streaming generated ids.
+        Raises ValueError when the prompt cannot fit."""
+        ids = np.asarray(ids, np.int32).ravel()
+        bucket = prompt_bucket(int(ids.size), self.engine.max_seq)
+        if bucket is None:
+            raise ValueError(
+                f"prompt too long ({ids.size} tokens, "
+                f"max {self.engine.max_seq - 8})"
+            )
+        room = self.engine.max_seq - bucket
+        if self._dead:
+            raise RuntimeError(
+                "batcher scheduler died (see logs); restart the server"
+            )
+        req = _Request(
+            ids=ids,
+            max_new=max(1, min(int(max_new_tokens), room)),
+            temperature=float(temperature),
+            seed=int(seed),
+        )
+        self._pending.put(req)
+        self._wake.set()
+        return RequestHandle(req)
+
+    @property
+    def steps_taken(self) -> int:
+        return self._round_count
+
+    @property
+    def interleave_log(self) -> list[tuple[int, int]]:
+        """(round, slot) per emitted token — lets tests prove two requests
+        shared the same decode rounds."""
+        return list(self._interleave_log)
+
+    # -- scheduler ---------------------------------------------------------
+    def _free_slot(self) -> int:
+        for i, r in enumerate(self._active):
+            if r is None:
+                return i
+        return -1
+
+    def _dispatch_admit(self, req: _Request, slot: int) -> tuple:
+        bucket = prompt_bucket(int(req.ids.size), self.engine.max_seq)
+        pad = bucket - int(req.ids.size)
+        padded = jnp.zeros((1, bucket), jnp.int32).at[0, pad:].set(
+            jnp.asarray(req.ids)
+        )
+        self._dev, first = self._admit_jit(
+            self.params, self._dev, padded, jnp.int32(slot),
+            jnp.float32(req.temperature),
+            jax.random.PRNGKey(req.seed), jnp.int32(pad),
+        )
+        req.slot = slot
+        self._active[slot] = req
+        return ("admit", req, first)
+
+    def _dispatch_round(self) -> tuple:
+        # Snapshot (slot, request) identity: by the time this round is
+        # processed the slot may have been retired AND re-admitted to a new
+        # request, whose stream must not receive this round's tokens.
+        live = [(i, r) for i, r in enumerate(self._active) if r is not None]
+        self._dev, toks = self._round_jit(self.params, self._dev)
+        self._round_count += 1
+        return ("round", self._round_count, live, toks)
+
+    def _emit(self, req: _Request, tok: int, round_id: int) -> None:
+        req.emitted += 1
+        self._interleave_log.append((round_id, req.slot))
+        req.out.put(int(tok))
+
+    def _retire(self, slot: int) -> None:
+        req = self._active[slot]
+        if req is not None:
+            req.out.put(None)  # completion sentinel
+        self._active[slot] = None
+
+    def _process(self, item: tuple) -> None:
+        """Consume one in-flight item — the only place the scheduler blocks
+        on the device."""
+        if item[0] == "admit":
+            _, req, first_dev = item
+            if self._active[req.slot] is not req:
+                return  # already retired
+            first = int(np.asarray(first_dev))
+            hit_eos = self.eos_id >= 0 and first == self.eos_id
+            if not hit_eos:
+                self._emit(req, first, self._round_count)
+            if hit_eos or req.emitted >= req.max_new:
+                self._retire(req.slot)
+            return
+        _, round_id, live, toks_dev = item
+        toks = np.asarray(toks_dev)  # [T, B] — the blocking fetch
+        n_steps = toks.shape[0]
+        for i, req in live:
+            if self._active[i] is not req:
+                continue  # retired (or slot re-admitted) mid-flight
+            done = False
+            for t in range(n_steps):
+                tok = int(toks[t, i])
+                if self.eos_id >= 0 and tok == self.eos_id:
+                    done = True
+                    break
+                self._emit(req, tok, round_id)
+                if req.emitted >= req.max_new:
+                    done = True
+                    break
+            if done:
+                self._retire(i)
+
+    def _loop(self) -> None:
+        inflight: collections.deque = collections.deque()
+        try:
+            while not self._stop.is_set():
+                any_active = any(r is not None for r in self._active)
+                if not any_active and self._pending.empty() and not inflight:
+                    self._wake.wait(timeout=0.1)
+                    self._wake.clear()
+                    continue
+                # Admission: fill free slots from the pending queue.  When
+                # all slots are busy, catching up on in-flight work below
+                # is what eventually frees one.
+                while True:
+                    slot = self._free_slot()
+                    if slot < 0:
+                        break
+                    try:
+                        req = self._pending.get_nowait()
+                    except queue.Empty:
+                        break
+                    inflight.append(self._dispatch_admit(req, slot))
+                # Keep the device busy: dispatch the next round before
+                # fetching results of previous ones.
+                if any(r is not None for r in self._active):
+                    inflight.append(self._dispatch_round())
+                # Catch up to the pipeline depth (or fully, when idle).
+                while inflight and (
+                    len(inflight) > self.pipeline_depth
+                    or not any(r is not None for r in self._active)
+                ):
+                    self._process(inflight.popleft())
+        except Exception:
+            self._dead = True
+            log.exception("batcher scheduler died; draining requests")
+        finally:
+            # Drain on ANY exit — crashed schedulers must not leave
+            # callers blocked on .result() forever.
+            for r in self._active:
+                if r is not None:
+                    r.out.put(None)
+            while True:
+                try:
+                    self._pending.get_nowait().out.put(None)
+                except queue.Empty:
+                    break

@@ -26,6 +26,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.transformer import TransformerConfig, TransformerLM
 
@@ -62,13 +63,36 @@ class InferenceEngine:
     shape bucket changes.
     """
 
-    def __init__(self, model: TransformerLM, max_seq: int | None = None):
+    def __init__(
+        self,
+        model: TransformerLM,
+        max_seq: int | None = None,
+        mesh: Mesh | None = None,
+    ):
+        """``mesh``: shard serving over devices — heads ('tp') on the KV
+        cache and, via the params' own shardings, the projection matmuls;
+        batch rows over 'dp'.  XLA propagates the annotations through the
+        decode scan, so tp-sharded serving is the same program with
+        sharding constraints attached (the GSPMD idiom, not a rewrite)."""
         self.model = model
         self.cfg = model.cfg
         self.max_seq = max_seq or self.cfg.max_seq
+        self.mesh = mesh
         self._generate_jit = jax.jit(
             self._generate,
             static_argnames=("max_new_tokens", "sampling"),
+        )
+
+    def _constrain_cache(self, cache):
+        """KV cache [L, B, H, T, Dh]: batch over dp, heads over tp."""
+        if self.mesh is None:
+            return cache
+        spec = P(None, "dp", "tp", None, None)
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(self.mesh, spec)
+            ),
+            cache,
         )
 
     # -- cache-aware blocks ------------------------------------------------
@@ -84,7 +108,11 @@ class InferenceEngine:
     def _block_cached(self, x, lp, cache_k, cache_v, positions, start, mask):
         """One transformer block over query slice x [B,Sq,D] with the K/V for
         the slice written into the layer cache at ``start``.  Returns
-        (x_out, new_cache_k, new_cache_v)."""
+        (x_out, new_cache_k, new_cache_v).
+
+        ``start`` is a scalar (all rows write at the same offset — prefill
+        and uniform decode) or a [B] vector (each row writes at its own
+        position — continuous batching; requires Sq == 1)."""
         m = self.model
         dt = self.cfg.dtype
         h = m._rmsnorm(x, lp["ln1"])
@@ -95,8 +123,15 @@ class InferenceEngine:
         k = m._rope(k, positions)
         k = k.transpose(0, 2, 1, 3)  # [B,H,Sq,Dh]
         v = v.transpose(0, 2, 1, 3)
-        cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, start, 0))
-        cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, start, 0))
+        if jnp.ndim(start) == 0:
+            cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, start, 0))
+            cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, start, 0))
+        else:
+            # Per-row scatter: row b writes its single new K/V at start[b].
+            assert x.shape[1] == 1, "per-row cache writes require Sq == 1"
+            rows = jnp.arange(x.shape[0])
+            cache_k = cache_k.at[rows, :, start].set(k[:, :, 0, :])
+            cache_v = cache_v.at[rows, :, start].set(v[:, :, 0, :])
         o = self._attend_cached(q, cache_k, cache_v, mask)
         x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
         h2 = m._rmsnorm(x, lp["ln2"])
@@ -142,7 +177,7 @@ class InferenceEngine:
         """
         B, S = tokens.shape
         pad_left = jnp.asarray(pad_left, jnp.int32)
-        cache = _empty_cache(self.cfg, B, self.max_seq)
+        cache = self._constrain_cache(_empty_cache(self.cfg, B, self.max_seq))
         x = params["embed"].astype(self.cfg.dtype)[tokens]
         q_idx = jnp.arange(S)
         positions = jnp.maximum(q_idx - pad_left, 0)  # RoPE positions
@@ -173,6 +208,27 @@ class InferenceEngine:
         )
         logits, cache = self._run_blocks(
             params, x, cache, rope[None], pos, mask
+        )
+        return cache, logits[:, 0]
+
+    def decode_step_multi(self, params, cache, token, pos, rope_pos, kv_start):
+        """One decode step where every batch row sits at its *own* cache
+        position — the continuous-batching kernel.
+
+        token [B]; pos/rope_pos/kv_start [B] int32.  Row b attends to cache
+        slots [kv_start[b], pos[b]] and writes its new K/V at pos[b].
+        Returns (cache, logits [B, V]).  Idle rows are the caller's business:
+        their outputs are valid numbers that simply go unused."""
+        B = token.shape[0]
+        x = params["embed"].astype(self.cfg.dtype)[token][:, None]  # [B,1,D]
+        pos = jnp.asarray(pos, jnp.int32)
+        t = jnp.arange(self.max_seq)
+        mask = (
+            (t[None, :] <= pos[:, None]) & (t[None, :] >= kv_start[:, None])
+        )[:, None, :]  # [B, 1, T]
+        logits, cache = self._run_blocks(
+            params, x, cache, jnp.asarray(rope_pos, jnp.int32)[:, None], pos,
+            mask,
         )
         return cache, logits[:, 0]
 

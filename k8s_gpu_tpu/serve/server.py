@@ -3,13 +3,15 @@ Fin-Agent service (reference 智能风控解决方案.md:175-331 serves agents o
 FastAPI; here the platform's own LM serves over the same stdlib-HTTP shape
 as utils/obs.py).
 
-POST /generate  {"prompt": "text", "max_new_tokens": N}  -> {"text", ...}
-POST /tokenize  {"text": "..."}                          -> {"ids": [...]}
+POST /generate  {"prompt": "text", "max_new_tokens": N[, "stream": true]}
+                -> {"text", ...} or newline-delimited JSON token events
+POST /tokenize  {"text": "..."}  -> {"ids": [...]}
 GET  /healthz, /readyz
 
-One InferenceEngine (KV-cache decode) + one BpeTokenizer; requests are
-served sequentially per process — batching belongs to the engine layer,
-and a pod-slice deployment scales replicas behind the platform ingress.
+Requests are admitted into a shared ContinuousBatcher: concurrent requests
+decode *interleaved* in one statically-shaped device program instead of
+queueing behind each other (serve/batcher.py), and ``"stream": true``
+returns tokens as they are produced.  Pass ``mesh`` for tp-sharded serving.
 """
 
 from __future__ import annotations
@@ -19,41 +21,8 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-import jax
-import jax.numpy as jnp
-
 from ..data.tokenizer import BpeTokenizer
-from .engine import InferenceEngine, SamplingConfig
-
-
-def _next_pow2(n: int) -> int:
-    b = 1
-    while b < n:
-        b *= 2
-    return b
-
-
-def _prompt_bucket(n_tokens: int, max_seq: int) -> int | None:
-    """Smallest compile bucket >= n_tokens that still leaves decode room.
-
-    Power-of-two buckets up to max_seq/2 keep the compile count
-    O(log max_seq); two fixed long-prompt buckets (¾·max_seq and
-    max_seq-8) extend serving capacity to max_seq-8 tokens instead of
-    silently rejecting everything past max_seq/2.  Returns None when the
-    prompt can't fit with at least 8 tokens of decode room — callers
-    report max_seq-8 as the true limit.
-    """
-    candidates = []
-    b = 8
-    while b <= max_seq // 2:
-        candidates.append(b)
-        b *= 2
-    candidates.append((3 * max_seq // 4) // 8 * 8)
-    candidates.append(max_seq - 8)
-    for c in sorted(set(candidates)):
-        if c >= n_tokens and c < max_seq:
-            return c
-    return None
+from .batcher import ContinuousBatcher
 
 
 class LmServer:
@@ -67,14 +36,15 @@ class LmServer:
         host: str = "127.0.0.1",
         port: int = 0,
         max_new_tokens_cap: int = 256,
+        slots: int = 4,
+        mesh=None,
     ):
-        self.engine = InferenceEngine(model)
-        self.params = params
+        self.batcher = ContinuousBatcher(
+            model, params, slots=slots, mesh=mesh
+        )
         self.tokenizer = tokenizer
         self.started_at = time.time()
         self.cap = max_new_tokens_cap
-        # The jitted decode graph is shared; serialize device access.
-        self._gen_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -116,46 +86,55 @@ class LmServer:
                     seed = int(body.get("seed", 0))
                 except (TypeError, ValueError) as e:
                     return self._json(400, {"error": f"bad parameter: {e}"})
+                stream = bool(body.get("stream", False))
                 ids = outer.tokenizer.encode(prompt)
-                # Bucket prompt length AND decode budget to powers of two:
-                # the decode graph's shape is (prompt_bucket, n_new_bucket),
-                # so compile count stays O(log² max_seq) instead of one
-                # multi-second retrace per distinct prompt length — all
-                # while holding the generation lock.
-                bucket = _prompt_bucket(int(ids.size), outer.engine.max_seq)
-                if bucket is None:
-                    return self._json(400, {
-                        "error": f"prompt too long ({ids.size} tokens, "
-                                 f"max {outer.engine.max_seq - 8})"
-                    })
-                room = outer.engine.max_seq - bucket
-                want = max(1, min(want, outer.cap, room))
-                n_new = min(_next_pow2(want), room)
-                pad = bucket - int(ids.size)
-                padded = jnp.zeros((1, bucket), jnp.int32).at[:, pad:].set(
-                    jnp.asarray(ids, jnp.int32)[None, :]
-                )
                 t0 = time.perf_counter()
-                with outer._gen_lock:
-                    out = outer.engine.generate(
-                        outer.params,
-                        padded,
-                        max_new_tokens=n_new,
-                        sampling=SamplingConfig(temperature=temperature),
-                        key=jax.random.PRNGKey(seed),
-                        pad_left=pad,
+                try:
+                    handle = outer.batcher.submit(
+                        ids,
+                        max_new_tokens=max(1, min(want, outer.cap)),
+                        temperature=temperature,
+                        seed=seed,
                     )
-                    toks = jax.device_get(out.tokens[0])
-                    length = min(int(jax.device_get(out.lengths[0])), want)
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                if stream:
+                    return self._stream(handle, ids, t0)
+                gen_ids = handle.result()
                 dt = time.perf_counter() - t0
-                gen_ids = toks[:length].tolist()
                 return self._json(200, {
                     "text": outer.tokenizer.decode(gen_ids),
                     "ids": gen_ids,
                     "prompt_tokens": int(ids.size),
-                    "generated_tokens": length,
-                    "tokens_per_s": round(length / dt, 2) if dt > 0 else 0.0,
+                    "generated_tokens": len(gen_ids),
+                    "tokens_per_s": round(len(gen_ids) / dt, 2) if dt > 0 else 0.0,
                 })
+
+            def _stream(self, handle, prompt_ids, t0):
+                """Newline-delimited JSON: one {"id": ...} event per token
+                as the batcher produces it, then a summary event.  No
+                Content-Length — the connection closes when done (HTTP/1.0
+                framing, matching the stdlib default)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("X-Accel-Buffering", "no")
+                self.end_headers()
+                gen_ids = []
+                for tok in handle:
+                    gen_ids.append(tok)
+                    self.wfile.write(
+                        (json.dumps({"id": tok}) + "\n").encode()
+                    )
+                    self.wfile.flush()
+                dt = time.perf_counter() - t0
+                self.wfile.write((json.dumps({
+                    "done": True,
+                    "text": outer.tokenizer.decode(gen_ids),
+                    "prompt_tokens": int(len(prompt_ids)),
+                    "generated_tokens": len(gen_ids),
+                    "tokens_per_s": round(len(gen_ids) / dt, 2) if dt > 0 else 0.0,
+                }) + "\n").encode())
+                self.wfile.flush()
 
             def _json(self, code: int, payload: dict) -> None:
                 body = json.dumps(payload).encode()
@@ -175,6 +154,7 @@ class LmServer:
         )
 
     def start(self) -> "LmServer":
+        self.batcher.start()
         self._thread.start()
         return self
 
@@ -182,3 +162,4 @@ class LmServer:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=2)
+        self.batcher.stop()

@@ -105,8 +105,9 @@ def test_left_pad_bucketing_matches_unpadded(server):
                       {"prompt": "the cat", "max_new_tokens": 6})
     assert code == 200
     ids = server.tokenizer.encode("the cat")
-    direct = server.engine.generate(
-        server.params, jnp.asarray(ids, jnp.int32)[None, :], max_new_tokens=8
+    direct = server.batcher.engine.generate(
+        server.batcher.params, jnp.asarray(ids, jnp.int32)[None, :],
+        max_new_tokens=8,
     )
     direct_ids = jax.device_get(direct.tokens[0])[:6].tolist()
     assert out["ids"] == direct_ids
@@ -115,10 +116,58 @@ def test_left_pad_bucketing_matches_unpadded(server):
 def test_prompt_bucket_top_half_not_rejected():
     """ADVICE r1: prompts longer than max_seq/2 must still bucket (the old
     pow2-only scheme silently halved capacity)."""
-    from k8s_gpu_tpu.serve.server import _prompt_bucket
+    from k8s_gpu_tpu.serve.batcher import prompt_bucket
 
-    assert _prompt_bucket(10, 64) == 16
-    assert _prompt_bucket(33, 64) == 48       # top half: ¾ bucket
-    assert _prompt_bucket(50, 64) == 56       # near-full: max_seq-8 bucket
-    assert _prompt_bucket(56, 64) == 56
-    assert _prompt_bucket(57, 64) is None     # true limit is max_seq-8
+    assert prompt_bucket(10, 64) == 16
+    assert prompt_bucket(33, 64) == 48       # top half: ¾ bucket
+    assert prompt_bucket(50, 64) == 56       # near-full: max_seq-8 bucket
+    assert prompt_bucket(56, 64) == 56
+    assert prompt_bucket(57, 64) is None     # true limit is max_seq-8
+
+
+def test_streaming_generate(server):
+    """stream:true returns newline-delimited JSON: one {"id"} event per
+    token, then a summary event — and the ids match the non-streaming
+    greedy response."""
+    code, plain = _post(server, "/generate",
+                        {"prompt": "the cat", "max_new_tokens": 5})
+    assert code == 200
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/generate",
+        data=json.dumps({"prompt": "the cat", "max_new_tokens": 5,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "application/x-ndjson"
+        lines = [json.loads(l) for l in r.read().splitlines() if l.strip()]
+    *events, summary = lines
+    assert summary["done"] is True
+    assert [e["id"] for e in events] == plain["ids"]
+    assert summary["generated_tokens"] == len(events)
+    assert summary["text"] == plain["text"]
+
+
+def test_concurrent_http_requests_interleave(server):
+    """Two HTTP generates in flight at once: the batcher must interleave
+    them (shared decode steps), not serialize."""
+    import threading
+
+    results = {}
+
+    def go(name, prompt, n):
+        results[name] = _post(server, "/generate",
+                              {"prompt": prompt, "max_new_tokens": n})
+
+    before = server.batcher.steps_taken
+    ta = threading.Thread(target=go, args=("a", "the cat sat", 24))
+    tb = threading.Thread(target=go, args=("b", "the dog", 24))
+    ta.start(); tb.start(); ta.join(); tb.join()
+    assert results["a"][0] == 200 and results["b"][0] == 200
+    log = [e for e in server.batcher.interleave_log if e[0] >= before]
+    slots = {s for _, s in log}
+    assert len(slots) >= 2
+    steps = {s: {st for st, sl in log if sl == s} for s in slots}
+    vals = list(steps.values())
+    assert vals[0] & vals[1], "requests were serialized, not interleaved"
