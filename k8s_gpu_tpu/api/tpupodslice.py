@@ -82,6 +82,13 @@ class TpuPodSlice(CustomResource):
         super().validate()
         if self.spec.slice_count < 0:
             raise ValidationError("spec.sliceCount must be >= 0")
+        if self.spec.spot and self.spec.reserved:
+            # Mirrors the wire contract (cloud/wire.py): the API's tier
+            # selector is spot XOR guaranteed — rejecting here keeps the
+            # reconciler from ever building an unroutable create.
+            raise ValidationError(
+                "spec.spot and spec.reserved are mutually exclusive"
+            )
         try:
             info = parse_accelerator_type(self.spec.accelerator_type)
         except ValueError as e:

@@ -4,8 +4,14 @@ from .base import (
     CloudPoolBackend,
 )
 from .topology import TpuTopology, parse_accelerator_type, default_topology
+from .types import QueuedResource, SliceInventory, TpuHost
 from .fake_azure import FakeAzureCloud, FakeAzureClient, azure_client_factory
-from .fake_cloudtpu import FakeCloudTpu, QueuedResource, cloudtpu_client_factory
+from .fake_cloudtpu import FakeCloudTpu, cloudtpu_client_factory
+from .cloudtpu import (
+    CloudTpuClient,
+    MetadataIdentity,
+    real_cloudtpu_client_factory,
+)
 
 __all__ = [
     "CloudError",
@@ -14,10 +20,15 @@ __all__ = [
     "TpuTopology",
     "parse_accelerator_type",
     "default_topology",
+    "QueuedResource",
+    "SliceInventory",
+    "TpuHost",
     "FakeAzureCloud",
     "FakeAzureClient",
     "azure_client_factory",
     "FakeCloudTpu",
-    "QueuedResource",
     "cloudtpu_client_factory",
+    "CloudTpuClient",
+    "MetadataIdentity",
+    "real_cloudtpu_client_factory",
 ]

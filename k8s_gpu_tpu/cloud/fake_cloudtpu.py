@@ -18,50 +18,16 @@ math, and preemption/partial-failure can be injected per slice.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
+from . import wire
 from .base import AuthError, CloudError
 from .topology import TpuTopology, parse_accelerator_type
+from .types import QueuedResource, SliceInventory, TpuHost
 from ..utils.clock import Clock, RealClock
 
 # State-machine ordering (index = progress).
 _LADDER = ["ACCEPTED", "WAITING_FOR_RESOURCES", "PROVISIONING", "ACTIVE"]
-
-
-@dataclass
-class TpuHost:
-    """One TPU host VM (worker) inside a slice."""
-
-    hostname: str
-    slice_name: str
-    worker_id: int
-    chips: int
-    internal_ip: str = ""
-    healthy: bool = True
-
-
-@dataclass
-class SliceInventory:
-    name: str
-    accelerator_type: str
-    topology: str
-    hosts: list[TpuHost] = field(default_factory=list)
-    state: str = "PROVISIONING"  # per-slice state once the QR activates
-
-
-@dataclass
-class QueuedResource:
-    name: str
-    accelerator_type: str
-    slice_count: int
-    runtime_version: str
-    tags: dict[str, str] = field(default_factory=dict)
-    state: str = "ACCEPTED"
-    created_at: float = 0.0
-    slices: list[SliceInventory] = field(default_factory=list)
-    error: str = ""
-    spot: bool = False
-    reserved: bool = False
 
 
 @dataclass
@@ -163,17 +129,29 @@ class FakeCloudTpu:
                 raise CloudError("injected: queuedResources.create failed")
             if name in self.queued_resources:  # idempotent
                 return self.queued_resources[name]
-            parse_accelerator_type(accelerator_type)  # validate
-            qr = QueuedResource(
+            # Round-trip through the REAL wire schema (cloud/wire.py): the
+            # create is built, validated, and parsed with the exact code
+            # the real client puts on the wire — schema drift between fake
+            # and real API is a test failure, not a production surprise.
+            payload = wire.build_create_payload(
+                project="fake-project",
+                zone="fake-zone",
                 name=name,
                 accelerator_type=accelerator_type,
                 slice_count=slice_count,
                 runtime_version=runtime_version,
-                tags=dict(tags),
-                created_at=self.clock.now(),
+                labels=tags,
                 spot=spot,
                 reserved=reserved,
             )
+            wire.validate_create_payload(payload)
+            qr = wire.parse_queued_resource(
+                wire.build_qr_resource(
+                    project="fake-project", zone="fake-zone", name=name,
+                    payload=payload,
+                )
+            )
+            qr.created_at = self.clock.now()
             self.queued_resources[name] = qr
             if self.accepted_delay <= 0 and self.provisioning_delay <= 0:
                 self._settle()

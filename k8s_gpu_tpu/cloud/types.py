@@ -1,0 +1,47 @@
+"""Cloud-TPU inventory types shared by the real client and the fake.
+
+One set of dataclasses means the reconciler (operators/tpupodslice.py) is
+backend-agnostic by construction: whatever `list_resources` returns — parsed
+from real queuedResources REST JSON (cloud/cloudtpu.py) or synthesized by
+the state-machine fake (cloud/fake_cloudtpu.py) — it is the same shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TpuHost:
+    """One TPU host VM (worker) inside a slice."""
+
+    hostname: str
+    slice_name: str
+    worker_id: int
+    chips: int
+    internal_ip: str = ""
+    healthy: bool = True
+
+
+@dataclass
+class SliceInventory:
+    name: str
+    accelerator_type: str
+    topology: str
+    hosts: list[TpuHost] = field(default_factory=list)
+    state: str = "PROVISIONING"  # per-slice state once the QR activates
+
+
+@dataclass
+class QueuedResource:
+    name: str
+    accelerator_type: str
+    slice_count: int
+    runtime_version: str
+    tags: dict[str, str] = field(default_factory=dict)
+    state: str = "ACCEPTED"
+    created_at: float = 0.0
+    slices: list[SliceInventory] = field(default_factory=list)
+    error: str = ""
+    spot: bool = False
+    reserved: bool = False

@@ -82,24 +82,41 @@ class _Request:
     out: queue.Queue = field(default_factory=queue.Queue)
     slot: int = -1
     emitted: int = 0
+    # True when the stream ended because the batcher crashed/stopped, not
+    # because of EOS/budget — servers map this to a 5xx, not a 200.
+    aborted: bool = False
 
 
 class RequestHandle:
     """Caller's view of an in-flight request: iterate tokens as they
-    stream; ``result()`` blocks for the full list."""
+    stream; ``result()`` blocks for the full list.  Tokens are cached, so
+    re-iterating (or calling result() after iterating) replays them
+    instead of deadlocking on the consumed queue.  Single consuming
+    thread at a time."""
 
     def __init__(self, req: _Request):
         self._req = req
+        self._tokens: list[int] = []
+        self._done = False
 
     def __iter__(self):
-        while True:
+        yield from self._tokens  # replay what was already consumed
+        while not self._done:
             tok = self._req.out.get()
             if tok is None:
+                self._done = True
                 return
+            self._tokens.append(tok)
             yield tok
 
     def result(self) -> list[int]:
         return list(self)
+
+    @property
+    def aborted(self) -> bool:
+        """True when the stream was cut by batcher shutdown/crash — the
+        token list is then a truncation, not a completed generation."""
+        return self._req.aborted
 
 
 class ContinuousBatcher:
@@ -153,6 +170,10 @@ class ContinuousBatcher:
         self._active: list[_Request | None] = [None] * slots
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._dead = False
+        # Serializes submit() against the end-of-life drain: either a
+        # request lands in _pending before the drain empties it, or submit
+        # sees _dead and raises — never an undrained orphan.
+        self._lifecycle = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._round_count = 0
@@ -262,17 +283,18 @@ class ContinuousBatcher:
                 f"max {self.engine.max_seq - 8})"
             )
         room = self.engine.max_seq - bucket
-        if self._dead:
-            raise RuntimeError(
-                "batcher scheduler died (see logs); restart the server"
-            )
         req = _Request(
             ids=ids,
             max_new=max(1, min(int(max_new_tokens), room)),
             temperature=float(temperature),
             seed=int(seed),
         )
-        self._pending.put(req)
+        with self._lifecycle:
+            if self._dead:
+                raise RuntimeError(
+                    "batcher scheduler is stopped; restart the server"
+                )
+            self._pending.put(req)
         self._wake.set()
         return RequestHandle(req)
 
@@ -393,16 +415,22 @@ class ContinuousBatcher:
                 ):
                     self._process(inflight.popleft())
         except Exception:
-            self._dead = True
             log.exception("batcher scheduler died; draining requests")
         finally:
-            # Drain on ANY exit — crashed schedulers must not leave
-            # callers blocked on .result() forever.
-            for r in self._active:
-                if r is not None:
+            # Drain on ANY exit — crashed/stopped schedulers must not
+            # leave callers blocked on .result() forever, and drained
+            # requests are marked aborted so servers report 5xx, not a
+            # silently truncated 200.
+            with self._lifecycle:
+                self._dead = True
+                for r in self._active:
+                    if r is not None:
+                        r.aborted = True
+                        r.out.put(None)
+                while True:
+                    try:
+                        r = self._pending.get_nowait()
+                    except queue.Empty:
+                        break
+                    r.aborted = True
                     r.out.put(None)
-            while True:
-                try:
-                    self._pending.get_nowait().out.put(None)
-                except queue.Empty:
-                    break

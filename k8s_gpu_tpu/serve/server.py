@@ -98,9 +98,17 @@ class LmServer:
                     )
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
+                except RuntimeError as e:  # scheduler dead: clean 503
+                    return self._json(503, {"error": str(e)})
                 if stream:
                     return self._stream(handle, ids, t0)
                 gen_ids = handle.result()
+                if handle.aborted:
+                    return self._json(503, {
+                        "error": "generation aborted: server shutting down "
+                                 "or batcher crashed",
+                        "ids": gen_ids,
+                    })
                 dt = time.perf_counter() - t0
                 return self._json(200, {
                     "text": outer.tokenizer.decode(gen_ids),
@@ -127,13 +135,22 @@ class LmServer:
                     )
                     self.wfile.flush()
                 dt = time.perf_counter() - t0
-                self.wfile.write((json.dumps({
-                    "done": True,
-                    "text": outer.tokenizer.decode(gen_ids),
-                    "prompt_tokens": int(len(prompt_ids)),
-                    "generated_tokens": len(gen_ids),
-                    "tokens_per_s": round(len(gen_ids) / dt, 2) if dt > 0 else 0.0,
-                }) + "\n").encode())
+                if handle.aborted:
+                    # The stream already carries tokens; the terminal event
+                    # must say they are a truncation, not a completion.
+                    summary = {"done": False,
+                               "error": "generation aborted: server "
+                                        "shutting down or batcher crashed"}
+                else:
+                    summary = {
+                        "done": True,
+                        "text": outer.tokenizer.decode(gen_ids),
+                        "prompt_tokens": int(len(prompt_ids)),
+                        "generated_tokens": len(gen_ids),
+                        "tokens_per_s": round(len(gen_ids) / dt, 2)
+                        if dt > 0 else 0.0,
+                    }
+                self.wfile.write((json.dumps(summary) + "\n").encode())
                 self.wfile.flush()
 
             def _json(self, code: int, payload: dict) -> None:

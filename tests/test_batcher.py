@@ -183,3 +183,28 @@ def test_engine_mesh_generate_matches_unsharded(setup):
     a = eng_s.generate(sharded, prompt, max_new_tokens=5)
     c = eng_u.generate(params, prompt, max_new_tokens=5)
     np.testing.assert_array_equal(np.asarray(a.tokens), np.asarray(c.tokens))
+
+
+def test_submit_after_stop_raises_and_inflight_marked_aborted(setup):
+    """Lifecycle: stop() drains waiting requests with aborted=True, and a
+    later submit fails fast instead of deadlocking the caller."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2).start()
+    h = b.submit([5, 9], max_new_tokens=50)
+    b.stop()
+    h.result()  # must return (possibly truncated), never hang
+    with pytest.raises(RuntimeError, match="stopped"):
+        b.submit([1], max_new_tokens=2)
+
+
+def test_handle_reiteration_replays(setup):
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2).start()
+    try:
+        h = b.submit([5, 9, 17], max_new_tokens=4)
+        first = list(h)
+        again = h.result()
+        assert first == again and len(first) == 4
+        assert h.aborted is False
+    finally:
+        b.stop()

