@@ -53,8 +53,15 @@ class TransformerConfig:
     sp_attention: str = "ring"
     flash_block_q: int = 256
     flash_block_k: int = 256
-    # Microbatches for the pipeline schedule (0 = one per stage).
+    # Microbatches for the pipeline schedule (0 = schedule default: pp for
+    # gpipe, 2·pp for 1f1b).
     pp_microbatches: int = 0
+    # Pipeline schedule for TRAINING: "1f1b" (O(pp) activation memory,
+    # parallel/pipeline.py:one_f_one_b) or "gpipe" (jax.grad through the
+    # forward schedule, O(microbatches) memory).  Forward-only inference
+    # always uses the gpipe forward schedule — without a backward there is
+    # nothing for 1F1B to interleave.
+    pp_schedule: str = "1f1b"
 
     @property
     def moe(self) -> bool:
@@ -169,7 +176,10 @@ class TransformerLM:
 
                 o = ulysses_attention(q, k, v, mesh)
             elif cfg.sp_attention == "ring":
-                o = ring_attention(q, k, v, mesh)
+                o = ring_attention(
+                    q, k, v, mesh,
+                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                )
             else:
                 raise ValueError(
                     f"unknown sp_attention {cfg.sp_attention!r}; "
@@ -288,13 +298,28 @@ class TransformerLM:
         from ..parallel.pipeline import gpipe
 
         cfg = self.cfg
-        if cfg.moe:
-            raise NotImplementedError("MoE with pipeline parallelism: use ep/tp")
-        if mesh.shape.get("sp", 1) > 1:
-            raise NotImplementedError("sp with pipeline parallelism")
+        self._check_pp_composition(mesh)
         dt = cfg.dtype
         B, S = tokens.shape
         x = params["embed"].astype(dt)[tokens]
+
+        from jax.sharding import PartitionSpec as PSpec
+
+        x = gpipe(
+            self._pp_stage_fn(mesh), params["blocks"], x, mesh,
+            num_microbatches=cfg.pp_microbatches or None,
+            # Batch stays dp-sharded inside the pipeline body; P() here
+            # would all-gather it and run the full batch on every dp group.
+            x_spec=PSpec("dp"),
+        )
+        x = self._rmsnorm(x, params["final_norm"])
+        logits = jnp.einsum("bsd,dv->bsv", x, params["head"].astype(dt))
+        return logits.astype(jnp.float32), jnp.float32(0)
+
+    def _pp_stage_fn(self, mesh: Mesh):
+        """One pipeline stage: scan the local L/P blocks (shared by the
+        gpipe forward and the 1F1B train schedule)."""
+        cfg = self.cfg
 
         def stage(block_params, x):
             # Positions created inside the shard_map body: a closed-over
@@ -310,18 +335,89 @@ class TransformerLM:
             out, _ = jax.lax.scan(scan_fn, x, block_params)
             return out
 
+        return stage
+
+    def _check_pp_composition(self, mesh: Mesh) -> None:
+        """Unsupported pp compositions, with the design reason for each.
+
+        **MoE + pp**: the Switch router's capacity dispatch is a global
+        all-to-all over 'ep' *per block*; inside a pipeline stage (manual
+        over 'pp', microbatched) the expert einsums would all-to-all on
+        every microbatch tick, serializing expert exchange against the
+        pipeline ring and erasing the bubble-hiding the schedule exists
+        for.  The supported layout for MoE is ep×tp×dp (the dryrun's
+        "moe dp/ep/tp" config): experts shard the MLP, pipeline stays off.
+        **sp + pp**: ring attention rotates K/V around 'sp' with one
+        ppermute per hop per block; under pp each stage would run its own
+        ring per microbatch — sp·M collectives per layer — and zigzag
+        causality assumes the whole sequence's blocks advance in lockstep,
+        which microbatching breaks.  Long sequences compose with pipeline
+        via tp (shard heads) + remat instead.
+        """
+        if self.cfg.moe:
+            raise NotImplementedError(
+                "MoE composes with ep/tp/dp, not pp — the per-block expert "
+                "all-to-all would serialize against the pipeline ring "
+                "(see _check_pp_composition docstring)"
+            )
+        if mesh.shape.get("sp", 1) > 1:
+            raise NotImplementedError(
+                "sequence parallelism composes with dp/tp, not pp — ring "
+                "attention's lockstep K/V rotation breaks under "
+                "microbatching (see _check_pp_composition docstring)"
+            )
+
+    def pipeline_value_and_grad(self, params, tokens, targets, mesh: Mesh):
+        """(loss, grads) via the 1F1B schedule (pp > 1 training path).
+
+        The embedding lookup runs outside the pipeline under GSPMD; its
+        gradient is assembled from the pipeline's input cotangent by a
+        scatter-add over the token ids.  Blocks run as 1F1B stages; the
+        final norm + head + cross-entropy are the fused last-stage tail.
+        Not routed through jax.grad — one_f_one_b returns gradients
+        explicitly (see parallel/pipeline.py for why).
+        """
         from jax.sharding import PartitionSpec as PSpec
 
-        x = gpipe(
-            stage, params["blocks"], x, mesh,
+        from ..parallel.pipeline import one_f_one_b
+
+        cfg = self.cfg
+        self._check_pp_composition(mesh)
+        dt = cfg.dtype
+        x = params["embed"].astype(dt)[tokens]
+
+        def tail_loss_fn(tail, y, tgt):
+            final_norm, head = tail
+            h = self._rmsnorm(y, final_norm)
+            logits = jnp.einsum("bsd,dv->bsv", h, head.astype(dt))
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            return nll.mean()
+
+        loss, dblocks, (dnorm, dhead), dx = one_f_one_b(
+            self._pp_stage_fn(mesh),
+            params["blocks"],
+            (params["final_norm"], params["head"]),
+            tail_loss_fn,
+            x,
+            targets,
+            mesh,
             num_microbatches=cfg.pp_microbatches or None,
-            # Batch stays dp-sharded inside the pipeline body; P() here
-            # would all-gather it and run the full batch on every dp group.
             x_spec=PSpec("dp"),
         )
-        x = self._rmsnorm(x, params["final_norm"])
-        logits = jnp.einsum("bsd,dv->bsv", x, params["head"].astype(dt))
-        return logits.astype(jnp.float32), jnp.float32(0)
+        # Embedding grad: scatter-add the input cotangent over token ids
+        # (the transpose of the gather the pipeline never saw).
+        dembed = (
+            jnp.zeros(params["embed"].shape, jnp.float32)
+            .at[tokens].add(dx.astype(jnp.float32))
+        )
+        grads = {
+            "embed": dembed,
+            "final_norm": dnorm,
+            "head": dhead,
+            "blocks": dblocks,
+        }
+        return loss, grads
 
     def loss(self, params, tokens, targets, mesh: Mesh | None = None):
         """Next-token cross-entropy (mean) + MoE aux loss."""

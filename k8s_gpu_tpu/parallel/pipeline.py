@@ -116,3 +116,213 @@ def gpipe(
         axis_names=manual,
         check_vma=False,
     )(stage_params, x)
+
+
+def one_f_one_b(
+    stage_fn,
+    stage_params,
+    tail_params,
+    tail_loss_fn,
+    x,
+    targets,
+    mesh: Mesh,
+    num_microbatches: int | None = None,
+    axis_name: str = "pp",
+    params_spec: P | None = None,
+    x_spec: P | None = None,
+):
+    """1F1B pipeline schedule producing loss AND gradients in one pass.
+
+    GPipe differentiates its forward schedule with ``jax.grad``, which by
+    construction runs all M microbatch forwards before any backward — the
+    autodiff tape holds **M + P - 1** stage inputs per stage.  1F1B
+    interleaves each microbatch's backward as soon as its forward clears
+    the pipe, so only **2P - 1** stage inputs are ever live (the
+    collective-pipelining bound; Megatron's asynchronous P-deep buffer is
+    not reachable under lockstep SPMD without paying a ~1/3 throughput
+    penalty from unbalanced F/B ticks).  Activation memory per stage drops
+    from O(M·mb) to O(P·mb) at the same tick count (M + 2P - 2 vs
+    M + P - 1, bubble 2(P-1)/M) — which is what lets microbatch counts
+    scale to amortize the bubble without scaling memory.
+
+    Because fwd and bwd must interleave inside ONE loop, this cannot be
+    expressed as jax.grad of a forward schedule: the scan body calls
+    ``jax.vjp`` per stage per tick (recompute-from-saved-input, the remat
+    policy every pp implementation uses) and gradients are accumulated
+    explicitly.  Schedule (tick i, stage s, microbatch j):
+
+        F(j) at i = s + j                 (skewed fill, like GPipe)
+        B(j) at i = (2P - 2 - s) + j      (cotangent arrives one hop/tick)
+
+    The last stage computes ``tail_loss_fn`` (norm + head + loss) fused
+    into its backward, seeding the cotangent locally — F and B of the same
+    microbatch share its tick there.
+
+    stage_fn(stage_params_slice, act[mb,...]) -> act[mb,...]
+    tail_loss_fn(tail_params, act[mb,...], tgt[mb,...]) -> scalar mean loss
+    Returns (loss, d_stage_params, d_tail_params, dx) — loss/d_tail
+    replicated, d_stage_params 'pp'-sharded like stage_params, dx sharded
+    like x.
+    """
+    pp = mesh.shape[axis_name]
+    if pp == 1:
+        raise ValueError("one_f_one_b needs pp > 1; use the plain path")
+    K = 2 * pp - 1  # live stage-input bound
+    p_spec = params_spec or P(axis_name)
+    in_x_spec = x_spec or P()
+
+    batch_axes = []
+    for ax in in_x_spec:
+        if ax is not None:
+            batch_axes.extend(ax if isinstance(ax, tuple) else (ax,))
+
+    def body(params, tail, xfull, tgt):
+        idx = jax.lax.axis_index(axis_name)
+        is_first = idx == 0
+        is_last = idx == pp - 1
+        local_b = xfull.shape[0]
+        # Default microbatch count adapts to the (static) local batch:
+        # prefer 2·pp (bubble 2(P-1)/M halves vs M=pp) but fall back to pp
+        # so any batch a gpipe-default config could run still runs here.
+        if num_microbatches:
+            M = num_microbatches
+        else:
+            M = 2 * pp if local_b % (2 * pp) == 0 else pp
+        if local_b % M != 0:
+            raise ValueError(
+                f"local batch {local_b} not divisible by {M} microbatches"
+            )
+        mb = local_b // M
+        xm = xfull.reshape((M, mb) + xfull.shape[1:])
+        tm = tgt.reshape((M, mb) + tgt.shape[1:])
+        # Replication factor over the other manual (batch) axes: the global
+        # loss is the mean over all batch shards, so every per-shard
+        # cotangent is pre-scaled by 1/(M·n_rep).
+        n_rep = 1
+        if batch_axes:
+            n_rep = jax.lax.psum(1, tuple(batch_axes))
+        seed = jnp.float32(1.0) / (M * n_rep)
+
+        zeros_mb = jnp.zeros_like(xm[0])
+        store0 = jnp.zeros((K,) + tuple(xm.shape[1:]), xfull.dtype)
+        dxm0 = jnp.zeros_like(xm)
+        zero_dp = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        zero_dt = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), tail
+        )
+
+        fwd_perm = [(d, d + 1) for d in range(pp - 1)]
+        bwd_perm = [(d, d - 1) for d in range(1, pp)]
+
+        def tick(carry, i):
+            fwd_recv, bwd_recv, store, dxm, dparams, dtail, loss_acc = carry
+
+            # ---- forward phase -------------------------------------------
+            jf = i - idx
+            f_valid = (jf >= 0) & (jf < M)
+            jfc = jnp.clip(jf, 0, M - 1)
+            inp = jnp.where(
+                is_first,
+                jax.lax.dynamic_index_in_dim(xm, jfc, 0, keepdims=False),
+                fwd_recv,
+            )
+            store = jax.lax.cond(
+                f_valid,
+                lambda s: jax.lax.dynamic_update_index_in_dim(
+                    s, inp, jfc % K, 0
+                ),
+                lambda s: s,
+                store,
+            )
+            # The last stage's forward runs fused into its backward (same
+            # tick) — computing it here too would double its flops.
+            out = jax.lax.cond(
+                f_valid & jnp.logical_not(is_last),
+                lambda: stage_fn(params, inp),
+                lambda: zeros_mb,
+            )
+            fwd_recv = jax.lax.ppermute(out, axis_name, fwd_perm)
+
+            # ---- backward phase ------------------------------------------
+            jb = i - (2 * pp - 2 - idx)
+            b_valid = (jb >= 0) & (jb < M)
+            jbc = jnp.clip(jb, 0, M - 1)
+            saved = jax.lax.dynamic_index_in_dim(store, jbc % K, 0,
+                                                 keepdims=False)
+            tgt_mb = jax.lax.dynamic_index_in_dim(tm, jbc, 0, keepdims=False)
+
+            def last_bwd(operands):
+                saved, tgt_mb, _ = operands
+
+                def f(p, tl, a):
+                    return tail_loss_fn(tl, stage_fn(p, a), tgt_mb)
+
+                loss_j, vjp = jax.vjp(f, params, tail, saved)
+                dp_, dt_, dinp = vjp(seed)
+                return dp_, dt_, dinp, loss_j / M
+
+            def mid_bwd(operands):
+                saved, _, cot = operands
+
+                def f(p, a):
+                    return stage_fn(p, a)
+
+                _, vjp = jax.vjp(f, params, saved)
+                dp_, dinp = vjp(cot)
+                return dp_, zero_dt, dinp, jnp.float32(0)
+
+            def no_bwd(operands):
+                return zero_dp, zero_dt, zeros_mb, jnp.float32(0)
+
+            dp_, dt_, dinp, loss_j = jax.lax.cond(
+                b_valid,
+                lambda ops: jax.lax.cond(is_last, last_bwd, mid_bwd, ops),
+                no_bwd,
+                (saved, tgt_mb, bwd_recv),
+            )
+            dparams = jax.tree.map(jnp.add, dparams, dp_)
+            dtail = jax.tree.map(jnp.add, dtail, dt_)
+            loss_acc = loss_acc + loss_j
+            dxm = jax.lax.cond(
+                b_valid & is_first,
+                lambda d: jax.lax.dynamic_update_index_in_dim(
+                    d, dinp, jbc, 0
+                ),
+                lambda d: d,
+                dxm,
+            )
+            bwd_recv = jax.lax.ppermute(dinp, axis_name, bwd_perm)
+            return (fwd_recv, bwd_recv, store, dxm, dparams, dtail,
+                    loss_acc), None
+
+        carry0 = (zeros_mb, zeros_mb, store0, dxm0, zero_dp, zero_dt,
+                  jnp.float32(0))
+        (fwd_recv, bwd_recv, store, dxm, dparams, dtail, loss_acc), _ = (
+            jax.lax.scan(tick, carry0, jnp.arange(M + 2 * pp - 2))
+        )
+
+        all_axes = tuple([axis_name] + batch_axes)
+        loss = jax.lax.psum(loss_acc, all_axes) / n_rep
+        # d_tail contributed only by the last stage of each batch group;
+        # d_stage_params are per-stage but summed over batch groups.
+        dtail = jax.lax.psum(dtail, all_axes)
+        if batch_axes:
+            dparams = jax.lax.psum(dparams, tuple(batch_axes))
+        # dx is real only on stage 0 (f32 around the psum: XLA CPU's
+        # AllReducePromotion crashes on bf16 all-reduce).
+        dx = jax.lax.psum(
+            dxm.reshape(xfull.shape).astype(jnp.float32), axis_name
+        ).astype(xfull.dtype)
+        return loss, dparams, dtail, dx
+
+    manual = {axis_name, *batch_axes}
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(p_spec, P(), in_x_spec, in_x_spec),
+        out_specs=(P(), p_spec, P(), in_x_spec),
+        axis_names=manual,
+        check_vma=False,
+    )(stage_params, tail_params, x, targets)

@@ -61,6 +61,23 @@ def make_train_step(loss_fn, optimizer):
     return step
 
 
+def make_pipeline_train_step(model, optimizer, mesh):
+    """Train step for models exposing ``pipeline_value_and_grad`` (the
+    1F1B path): gradients come from the schedule itself, not jax.grad —
+    fwd and bwd of different microbatches interleave in one loop, which
+    autodiff of a forward cannot express (parallel/pipeline.py)."""
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = model.pipeline_value_and_grad(
+            params, tokens, targets, mesh
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
 class Trainer:
     """Shards params + batch over a mesh and drives the jitted step.
 
@@ -148,11 +165,31 @@ class Trainer:
             for b, spec in zip(batch, specs)
         )
 
+    def _use_1f1b(self) -> bool:
+        if self.mesh.shape.get("pp", 1) <= 1:
+            return False
+        sched = getattr(
+            getattr(self.model, "cfg", None), "pp_schedule", "gpipe"
+        )
+        if sched == "1f1b":
+            return hasattr(self.model, "pipeline_value_and_grad")
+        if sched == "gpipe":
+            return False
+        # A typo'd schedule silently training gpipe would quietly forfeit
+        # the O(pp) activation memory the user selected — fail loudly.
+        raise ValueError(
+            f"unknown pp_schedule {sched!r}; expected '1f1b' or 'gpipe'"
+        )
+
     def step(self, *batch):
         if self._step is None:
-            self._step = jax.jit(
-                make_train_step(self._loss, self.optimizer), donate_argnums=(0, 1)
-            )
+            if self._use_1f1b():
+                step_fn = make_pipeline_train_step(
+                    self.model, self.optimizer, self.mesh
+                )
+            else:
+                step_fn = make_train_step(self._loss, self.optimizer)
+            self._step = jax.jit(step_fn, donate_argnums=(0, 1))
         batch = self.shard_batch(*batch)
         t0 = time.perf_counter()
         self.params, self.opt_state, loss = self._step(
