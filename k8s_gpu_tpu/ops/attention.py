@@ -30,6 +30,13 @@ NEG_INF = -1e30
 
 def reference_attention(q, k, v, causal: bool = True):
     """Numerical oracle: plain softmax attention.  [B,H,S,D] → [B,H,S,D]."""
+    return reference_attention_lse(q, k, v, causal)[0]
+
+
+def reference_attention_lse(q, k, v, causal: bool = True):
+    """Oracle returning (out, lse [B,H,S]) — the same contract as the
+    kernelized path, differentiable by plain AD (the fallback when shapes
+    don't tile)."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
@@ -39,7 +46,8 @@ def reference_attention(q, k, v, causal: bool = True):
         mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return out, jax.scipy.special.logsumexp(s, axis=-1)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
@@ -235,7 +243,8 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     return out.reshape(b, h, s, d), lse
 
 
-def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+                    g_lse=None):
     b, h, s, d = q.shape
     bq = min(block_q, s)
     bk = min(block_k, s)
@@ -245,12 +254,16 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     vr = v.reshape(b * h, s, d)
     gr = g.reshape(b * h, s, d)
     # delta_i = Σ_d dO_i ⊙ O_i — elementwise, XLA fuses it; keeping it out
-    # of the kernels avoids a third pass over K/V.
+    # of the kernels avoids a third pass over K/V.  An lse cotangent enters
+    # here: ds_ij gains p_ij·g_lse_i, which is exactly delta → delta-g_lse
+    # in the kernels' ds = p·(dp - delta) expression.
     delta = jnp.sum(
         gr.astype(jnp.float32) * o.reshape(b * h, s, d).astype(jnp.float32),
         axis=-1,
         keepdims=True,
     )  # [bh, s, 1], matching the lse layout
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, block_k=bk, seq_len=s, causal=causal, scale=scale
@@ -309,19 +322,27 @@ def _auto_interpret() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out
+    """Returns (out, lse [B,H,S]).  lse is a first-class differentiable
+    output: ring attention merges per-hop block outputs through it, so its
+    cotangent must reach q/k — d lse_i/d s_ij = p_ij folds into the
+    backward as an extra (dp - (delta - g_lse)) term, i.e. the existing
+    kernels run unchanged with delta shifted by -g_lse."""
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, lse.reshape(q.shape[0], q.shape[1], q.shape[2])
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    primal = (out, lse.reshape(q.shape[0], q.shape[1], q.shape[2]))
+    return primal, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
+    g_o, g_lse = g
     return _flash_backward(
-        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+        q, k, v, o, lse, g_o, causal, block_q, block_k, interpret,
+        g_lse=g_lse.reshape(lse.shape),
     )
 
 
@@ -343,10 +364,29 @@ def flash_attention(
     interpreter elsewhere (tests).  Falls back to the reference path when
     the sequence doesn't tile evenly.
     """
+    return flash_attention_lse(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )[0]
+
+
+def flash_attention_lse(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Blockwise attention returning (out, lse [B, H, S]) — the contract
+    ring attention needs to merge per-hop block results (the online-
+    softmax combine is a function of normalized outputs + logsumexps).
+    Same fallback/auto-interpret rules as flash_attention."""
     s = q.shape[2]
     bq, bk = min(block_q, s), min(block_k, s)
     if s % bq != 0 or s % bk != 0:
-        return reference_attention(q, k, v, causal)
+        return reference_attention_lse(q, k, v, causal)
     if interpret is None:
         interpret = _auto_interpret()
     return _flash(q, k, v, causal, bq, bk, interpret)
