@@ -1,0 +1,298 @@
+"""Property-based reconcile fuzzing (SURVEY §5.2, §7 hard part 2; VERDICT
+r2 #6): randomized fault plans × event interleavings × concurrent spec
+edits, checked against the reconcile contract's invariants.
+
+The homegrown controller runtime is exactly where interleaving bugs live —
+the reference leans on controller-runtime for all of this (SURVEY §5.2).
+Scenarios drive the reconciler SYNCHRONOUSLY (no Manager threads): each
+event mutates cluster/cloud/spec state, then the reconciler runs some
+number of times.  After the storm, faults clear and the loop must converge:
+
+  I1  phase reaches Ready (slice_count>0) / Paused (==0)
+  I2  status.readyReplicas == spec.sliceCount (the BASELINE parity metric)
+  I3  exactly one owned queued resource (no duplicates, no strays)
+  I4  cluster Nodes == the active QR's hosts, topology-labeled (no orphans)
+  I5  re-reconcile at steady state is a no-op (no cloud mutations, no
+      object writes)
+  I6  delete converges to nothing: finalizer removes the QR and all Nodes
+"""
+
+import pytest
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+from k8s_gpu_tpu.api import TpuPodSlice
+from k8s_gpu_tpu.cloud import FakeCloudTpu, cloudtpu_client_factory
+from k8s_gpu_tpu.controller import FakeKube
+from k8s_gpu_tpu.controller.kubefake import Conflict
+from k8s_gpu_tpu.operators import TpuPodSliceReconciler
+from k8s_gpu_tpu.operators.tpupodslice import Request
+
+ACCELS = ["v4-8", "v5p-8", "v5p-64", "v5e-16"]
+RUNTIMES = ["tpu-ubuntu2204-base", "tpu-vm-v4-base"]
+
+# Event vocabulary: (name, needs_qr)
+EVENTS = st.sampled_from([
+    "reconcile",
+    "reconcile_twice",
+    "edit_accel",
+    "edit_slice_count",
+    "edit_runtime",
+    "preempt",
+    "fault_create",
+    "fault_delete",
+    "fault_list",
+    "fault_auth",
+    "fault_provisioning",
+    "stockout_on",
+    "stockout_off",
+])
+
+
+class Scenario:
+    def __init__(self):
+        self.kube = FakeKube()
+        self.cloud = FakeCloudTpu()
+        self.rec = TpuPodSliceReconciler(
+            self.kube, cloudtpu_client_factory(self.cloud)
+        )
+        ps = TpuPodSlice()
+        ps.metadata.name = "fuzz"
+        ps.spec.accelerator_type = "v4-8"
+        self.kube.create(ps)
+        self.req = Request(name="fuzz", namespace="default")
+
+    def reconcile(self):
+        try:
+            self.rec.reconcile(self.req)
+        except Conflict:
+            pass  # a requeue would retry; the loop below reconciles again
+
+    def edit(self, fn):
+        """Concurrent spec edit: read-modify-write with conflict retry."""
+        for _ in range(5):
+            ps = self.kube.get("TpuPodSlice", "fuzz")
+            fn(ps)
+            try:
+                self.kube.update(ps)
+                return
+            except Conflict:
+                continue
+        raise AssertionError("spec edit failed 5 conflicts in a row")
+
+    def apply(self, event, draw):
+        if event == "reconcile":
+            self.reconcile()
+        elif event == "reconcile_twice":
+            self.reconcile()
+            self.reconcile()
+        elif event == "edit_accel":
+            accel = draw(st.sampled_from(ACCELS))
+            self.edit(lambda ps: setattr(ps.spec, "accelerator_type", accel))
+        elif event == "edit_slice_count":
+            n = draw(st.integers(min_value=0, max_value=2))
+            self.edit(lambda ps: setattr(ps.spec, "slice_count", n))
+        elif event == "edit_runtime":
+            rt = draw(st.sampled_from(RUNTIMES))
+            self.edit(lambda ps: setattr(ps.spec, "runtime_version", rt))
+        elif event == "preempt":
+            qrs = [
+                q for q in self.cloud.queued_resources.values()
+                if q.state == "ACTIVE" and q.slices
+            ]
+            if qrs:
+                self.cloud.preempt_slice(qrs[0].name, 0)
+        elif event == "fault_create":
+            self.cloud.faults.fail_creates += draw(
+                st.integers(min_value=1, max_value=2))
+        elif event == "fault_delete":
+            self.cloud.faults.fail_deletes += draw(
+                st.integers(min_value=1, max_value=2))
+        elif event == "fault_list":
+            self.cloud.faults.fail_lists += draw(
+                st.integers(min_value=1, max_value=2))
+        elif event == "fault_auth":
+            self.cloud.faults.fail_auth += draw(
+                st.integers(min_value=1, max_value=2))
+        elif event == "fault_provisioning":
+            self.cloud.faults.fail_provisioning += 1
+        elif event == "stockout_on":
+            self.cloud.faults.stockout = True
+        elif event == "stockout_off":
+            self.cloud.faults.stockout = False
+
+    # -- invariants --------------------------------------------------------
+    def clear_faults(self):
+        f = self.cloud.faults
+        f.fail_creates = f.fail_deletes = f.fail_lists = f.fail_auth = 0
+        f.fail_provisioning = 0
+        f.stockout = False
+
+    def converge(self, max_iters=60):
+        for _ in range(max_iters):
+            self.reconcile()
+            ps = self.kube.try_get("TpuPodSlice", "fuzz")
+            if ps is None:
+                return None
+            want = "Paused" if ps.spec.slice_count == 0 else "Ready"
+            if ps.status.phase == want:
+                return ps
+        raise AssertionError(
+            f"did not converge: phase={ps.status.phase} "
+            f"spec={ps.spec.slice_count}x{ps.spec.accelerator_type} "
+            f"qrs={[(q.name, q.state) for q in self.cloud.queued_resources.values()]}"
+        )
+
+    def owned_qrs(self):
+        return [
+            q for q in self.cloud.queued_resources.values()
+            if q.tags.get("owner") == "default-fuzz"
+        ]
+
+    def pool_nodes(self):
+        return [
+            n for n in self.kube.list("Node")
+            if n.metadata.labels.get("tpu.k8sgpu.dev/pool") == "default.fuzz"
+        ]
+
+    def check_invariants(self):
+        ps = self.converge()  # I1
+        assert ps.status.ready_replicas == ps.spec.slice_count  # I2
+        qrs = self.owned_qrs()
+        if ps.spec.slice_count == 0:
+            assert qrs == [], f"scaled to zero but QRs remain: {qrs}"  # I3
+            assert self.pool_nodes() == []  # I4
+        else:
+            assert len(qrs) == 1, f"duplicate/stray QRs: {qrs}"  # I3
+            qr = qrs[0]
+            assert qr.state == "ACTIVE"
+            assert qr.accelerator_type == ps.spec.accelerator_type
+            want_hosts = {
+                h.hostname for inv in qr.slices for h in inv.hosts
+            }
+            got_hosts = {n.metadata.name for n in self.pool_nodes()}
+            assert got_hosts == want_hosts, (  # I4: no orphans, none missing
+                f"nodes {got_hosts} != hosts {want_hosts}"
+            )
+        # I5: steady state is a no-op — no cloud mutations, no writes.
+        calls_before = list(self.cloud.api_calls)
+        rv_before = self.kube.get("TpuPodSlice", "fuzz").metadata.resource_version
+        self.reconcile()
+        new_calls = self.cloud.api_calls[len(calls_before):]
+        assert all(c == "list" for c in new_calls), (
+            f"steady-state reconcile mutated the cloud: {new_calls}"
+        )
+        assert (
+            self.kube.get("TpuPodSlice", "fuzz").metadata.resource_version
+            == rv_before
+        ), "steady-state reconcile wrote the object"
+        # I6: delete tears everything down.
+        self.kube.delete("TpuPodSlice", "fuzz")
+        for _ in range(20):
+            self.reconcile()
+            if self.kube.try_get("TpuPodSlice", "fuzz") is None:
+                break
+        assert self.kube.try_get("TpuPodSlice", "fuzz") is None
+        assert self.owned_qrs() == [], "finalizer leaked queued resources"
+        assert self.pool_nodes() == [], "finalizer leaked nodes"
+
+
+@settings(
+    max_examples=120,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large],
+)
+@given(data=st.data())
+def test_reconcile_converges_under_fault_and_edit_storms(data):
+    """120 randomized storms of faults, preemptions, and concurrent spec
+    edits interleaved with reconciles — every one must satisfy I1-I6."""
+    sc = Scenario()
+    events = data.draw(st.lists(EVENTS, min_size=3, max_size=14))
+    for ev in events:
+        sc.apply(ev, data.draw)
+    sc.clear_faults()
+    sc.check_invariants()
+
+
+@settings(max_examples=60, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(
+    accel=st.sampled_from(ACCELS),
+    slice_count=st.integers(min_value=1, max_value=3),
+    n_preempts=st.integers(min_value=0, max_value=3),
+    fail_provisioning=st.integers(min_value=0, max_value=2),
+)
+def test_self_heal_always_recovers(accel, slice_count, n_preempts,
+                                   fail_provisioning):
+    """60 randomized break-fix cycles: provisioning failures then repeated
+    preemptions; each cycle must self-heal back to Ready with a fresh
+    ACTIVE queued resource and full node parity."""
+    sc = Scenario()
+    sc.edit(lambda ps: (
+        setattr(ps.spec, "accelerator_type", accel),
+        setattr(ps.spec, "slice_count", slice_count),
+    ))
+    sc.cloud.faults.fail_provisioning = fail_provisioning
+    ps = sc.converge()
+    assert ps.status.ready_replicas == slice_count
+    for _ in range(n_preempts):
+        qr = sc.owned_qrs()[0]
+        sc.cloud.preempt_slice(qr.name, 0)
+        ps = sc.converge()
+        assert ps.status.ready_replicas == slice_count
+    sc.check_invariants()
+
+
+@settings(max_examples=60, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(data=st.data())
+def test_chip_allocator_never_leaks_capacity(data):
+    """60 randomized allocate/release interleavings on shared nodes: used
+    chips always equal the sum of live allocations, never exceed capacity,
+    and full release returns every chip (the HAMi-sharing leak class the
+    devenv Conflict bug lived in)."""
+    from k8s_gpu_tpu.api.core import Node
+    from k8s_gpu_tpu.scheduling.sharing import ChipAllocator
+
+    n_nodes = data.draw(st.integers(min_value=1, max_value=3))
+    cap = data.draw(st.sampled_from([4, 8]))
+    nodes = []
+    for i in range(n_nodes):
+        n = Node()
+        n.metadata.name = f"node-{i}"
+        n.capacity = {"google.com/tpu": cap}
+        n.ready = True
+        nodes.append(n)
+    alloc = ChipAllocator()
+    alloc.sync_nodes(nodes)
+    live: dict[str, int] = {}
+    for step in range(data.draw(st.integers(min_value=1, max_value=25))):
+        do_alloc = data.draw(st.booleans()) or not live
+        if do_alloc:
+            want = data.draw(st.integers(min_value=1, max_value=cap))
+            name = f"pod-{step}"
+            from k8s_gpu_tpu.scheduling.placement import PlacementError
+
+            total_free = n_nodes * cap - sum(live.values())
+            try:
+                alloc.allocate(name, want, nodes)
+                live[name] = want
+            except PlacementError:
+                # Legal only when no single host can fit the request.
+                per_host_free = [
+                    cap - alloc.used_chips(n.metadata.name) for n in nodes
+                ]
+                assert want > max(per_host_free), (
+                    f"refused {want} chips with per-host free "
+                    f"{per_host_free} (total {total_free})"
+                )
+        else:
+            name = data.draw(st.sampled_from(sorted(live)))
+            alloc.release(name, nodes)
+            del live[name]
+        used = sum(alloc.used_chips(n.metadata.name) for n in nodes)
+        assert used == sum(live.values()), "capacity leak"
+        for n in nodes:
+            assert alloc.used_chips(n.metadata.name) <= cap
+    for name in sorted(live):
+        alloc.release(name, nodes)
+    assert sum(alloc.used_chips(n.metadata.name) for n in nodes) == 0
