@@ -1,0 +1,188 @@
+"""Per-kind OpenAPI-style schema generation from the dataclass codec — the
+``make manifests generate`` analogue (reference README.md:157-160: CRD
+manifests are generated from the Go types' kubebuilder markers; here the
+dataclasses ARE the markers).
+
+Two consumers:
+- ``cli apply --validate`` / ``cli schema``: validate a manifest against
+  the schema BEFORE it touches the API server, with schema-derived
+  messages (field path + expected type), and export schemas to files.
+- ``GET /api/v1/schemas`` on the platform API server.
+
+Schemas are strict (``additionalProperties: false``) — matching the
+codec's unknown-field rejection (api/serialize.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+import types as _types
+import typing
+
+from .serialize import _camel, known_kinds, _KIND_REGISTRY, _ensure_registry
+
+_PRIMITIVES = {
+    str: {"type": "string"},
+    int: {"type": "integer"},
+    float: {"type": "number"},
+    bool: {"type": "boolean"},
+}
+
+
+def _hint_schema(hint) -> dict:
+    origin = typing.get_origin(hint)
+    if origin in (typing.Union, _types.UnionType):
+        arms = [a for a in typing.get_args(hint) if a is not type(None)]
+        if len(arms) == 1:
+            s = _hint_schema(arms[0])
+            s["nullable"] = True
+            return s
+        return {"oneOf": [_hint_schema(a) for a in arms]}
+    if hint in _PRIMITIVES:
+        return dict(_PRIMITIVES[hint])
+    if dataclasses.is_dataclass(hint):
+        return _dataclass_schema(hint)
+    if origin is dict:
+        args = typing.get_args(hint)
+        return {
+            "type": "object",
+            "additionalProperties": _hint_schema(args[1]) if len(args) == 2
+            else True,
+        }
+    if origin in (list, tuple):
+        args = typing.get_args(hint)
+        elem = args[0] if args else None
+        return {
+            "type": "array",
+            "items": _hint_schema(elem) if elem is not None else {},
+        }
+    return {}  # Any / unannotated: unconstrained
+
+
+def _dataclass_schema(cls) -> dict:
+    hints = typing.get_type_hints(cls)
+    props = {}
+    for f in dataclasses.fields(cls):
+        s = _hint_schema(hints.get(f.name))
+        doc = None
+        props[_camel(f.name)] = s if doc is None else {**s, "description": doc}
+    return {
+        "type": "object",
+        "properties": props,
+        "additionalProperties": False,
+    }
+
+
+def schema_for_kind(kind: str) -> dict:
+    """OpenAPI-style object schema for one registered kind (top-level
+    manifest shape: apiVersion/kind/metadata/spec/...)."""
+    _ensure_registry()
+    cls = _KIND_REGISTRY.get(kind)
+    if cls is None:
+        raise KeyError(f"unknown kind {kind!r}; known: {known_kinds()}")
+    hints = typing.get_type_hints(cls)
+    props = {
+        "apiVersion": {"type": "string"},
+        "kind": {"type": "string", "enum": [kind]},
+        "metadata": _hint_schema(hints["metadata"]),
+    }
+    for f in dataclasses.fields(cls):
+        if f.name in ("metadata", "api_version", "kind"):
+            continue
+        props[_camel(f.name)] = _hint_schema(hints.get(f.name))
+    return {
+        "type": "object",
+        "title": kind,
+        "properties": props,
+        "required": ["apiVersion", "kind", "metadata"],
+        "additionalProperties": False,
+    }
+
+
+def all_schemas() -> dict[str, dict]:
+    return {kind: schema_for_kind(kind) for kind in known_kinds()}
+
+
+# -- validation -------------------------------------------------------------
+
+def _type_ok(value, schema: dict) -> bool:
+    t = schema.get("type")
+    if t == "string":
+        return isinstance(value, str)
+    if t == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if t == "number":
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if t == "boolean":
+        return isinstance(value, bool)
+    if t == "object":
+        return isinstance(value, dict)
+    if t == "array":
+        return isinstance(value, list)
+    return True
+
+
+def _validate(value, schema: dict, path: str, errors: list[str]) -> None:
+    if value is None:
+        if schema.get("nullable"):
+            return
+        # None for a typed field: report as a type error below.
+    if "oneOf" in schema:
+        for arm in schema["oneOf"]:
+            trial: list[str] = []
+            _validate(value, arm, path, trial)
+            if not trial:
+                return
+        errors.append(f"{path}: matches no allowed form")
+        return
+    if "enum" in schema and value not in schema["enum"]:
+        errors.append(f"{path}: must be one of {schema['enum']}, got {value!r}")
+        return
+    if not _type_ok(value, schema):
+        errors.append(
+            f"{path}: expected {schema.get('type')}, got "
+            f"{type(value).__name__}"
+        )
+        return
+    t = schema.get("type")
+    if t == "object" and isinstance(value, dict):
+        props = schema.get("properties")
+        if props is not None:
+            for key, sub in value.items():
+                if key in props:
+                    _validate(sub, props[key], f"{path}.{key}", errors)
+                elif not schema.get("additionalProperties", True):
+                    allowed = ", ".join(sorted(props))
+                    errors.append(
+                        f"{path}.{key}: unknown field (allowed: {allowed})"
+                    )
+            for req in schema.get("required", []):
+                if req not in value:
+                    errors.append(f"{path}.{req}: required field missing")
+        else:
+            ap = schema.get("additionalProperties")
+            if isinstance(ap, dict):
+                for key, sub in value.items():
+                    _validate(sub, ap, f"{path}.{key}", errors)
+    elif t == "array" and isinstance(value, list):
+        items = schema.get("items") or {}
+        for i, sub in enumerate(value):
+            _validate(sub, items, f"{path}[{i}]", errors)
+
+
+def validate_manifest(doc) -> list[str]:
+    """Schema-validate one manifest dict.  Returns error strings with
+    field paths ('' = valid).  ``status`` is stripped first — it is
+    controller-owned and ignored on apply (api/serialize.py)."""
+    if not isinstance(doc, dict):
+        return ["manifest must be a mapping"]
+    kind = doc.get("kind")
+    _ensure_registry()
+    if not isinstance(kind, str) or kind not in _KIND_REGISTRY:
+        return [
+            f".kind: unknown kind {kind!r} (known: {known_kinds()})"
+        ]
+    schema = schema_for_kind(kind)
+    doc = {k: v for k, v in doc.items() if k != "status"}
+    errors: list[str] = []
+    _validate(doc, schema, "", errors)
+    return errors

@@ -341,7 +341,7 @@ def cmd_apply(args) -> int:
     """kubectl-style manifest verbs: apply -f (create-or-update), get,
     delete — the reference's core UX (README.md:287-289: `kubectl apply`
     the sample CR, observe with `kubectl get azurevmpool`)."""
-    from ..api.serialize import known_kinds, load_manifests, to_yaml
+    from ..api.serialize import known_kinds, to_yaml
     from ..api.types import ValidationError
     from ..controller.kubefake import Conflict, NotFound
 
@@ -349,9 +349,35 @@ def cmd_apply(args) -> int:
     p = LocalPlatform()
     try:
         if args.file_cmd == "apply":
+            import yaml as _yaml
+
+            from ..api.serialize import from_manifest
+
             try:
-                objs = load_manifests(Path(args.file).read_text())
-            except (OSError, ValidationError) as e:
+                text = Path(args.file).read_text()
+                docs = [d for d in _yaml.safe_load_all(text) if d is not None]
+            except (OSError, _yaml.YAMLError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            if getattr(args, "validate", False):
+                # Schema validation BEFORE decode: every violation in every
+                # document reported with its field path (the generated-CRD
+                # validation the reference gets from `make manifests`,
+                # README.md:157-160).
+                from ..api.schema import validate_manifest
+
+                failed = False
+                for i, doc in enumerate(docs):
+                    for err in validate_manifest(doc):
+                        sep = "" if err.startswith(".") else ": "
+                        print(f"error: document {i}{sep}{err}",
+                              file=sys.stderr)
+                        failed = True
+                if failed:
+                    return 1
+            try:
+                objs = [from_manifest(d) for d in docs]
+            except ValidationError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 1
             for obj in objs:
@@ -433,6 +459,33 @@ def cmd_apply(args) -> int:
         return 1
     finally:
         p.close()
+
+
+def cmd_schema(args) -> int:
+    """Export per-kind schemas generated from the dataclass codec — the
+    ``make manifests generate`` analogue (reference README.md:157-160)."""
+    import json as _json
+
+    from ..api.schema import all_schemas, schema_for_kind
+
+    try:
+        schemas = (
+            {args.kind: schema_for_kind(args.kind)} if args.kind
+            else all_schemas()
+        )
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 1
+    if args.out_dir:
+        out = Path(args.out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        for kind, schema in schemas.items():
+            (out / f"{kind}.json").write_text(_json.dumps(schema, indent=2))
+            print(f"wrote {out / f'{kind}.json'}")
+        return 0
+    for kind, schema in schemas.items():
+        print(_json.dumps(schema, indent=2))
+    return 0
 
 
 def cmd_ci(args) -> int:
@@ -625,7 +678,18 @@ def build_parser() -> argparse.ArgumentParser:
     p_apply = sub.add_parser("apply", help="apply a YAML manifest (kubectl-style)")
     p_apply.add_argument("-f", "--file", required=True)
     p_apply.add_argument("--no-wait", dest="wait", action="store_false")
+    p_apply.add_argument(
+        "--validate", action="store_true",
+        help="schema-validate the manifest before applying",
+    )
     p_apply.set_defaults(fn=cmd_apply, file_cmd="apply")
+
+    p_schema = sub.add_parser(
+        "schema", help="export generated CRD schemas (make-manifests analogue)"
+    )
+    p_schema.add_argument("kind", nargs="?", help="one kind; omit for all")
+    p_schema.add_argument("-o", "--out-dir", help="write <Kind>.json files")
+    p_schema.set_defaults(fn=cmd_schema)
 
     p_get = sub.add_parser("get", help="get resources by kind")
     p_get.add_argument("kind")

@@ -8,6 +8,8 @@ from .templates import (
     render_yaml,
 )
 from .assets import AssetStore, Asset
+from .apiserver import PlatformApiServer
+from .sshgate import SshGateway
 from .registry import (
     ImageManifest,
     ImageRegistry,
@@ -37,6 +39,8 @@ __all__ = [
     "render_yaml",
     "AssetStore",
     "Asset",
+    "PlatformApiServer",
+    "SshGateway",
     "ImageManifest",
     "ImageRegistry",
     "ImmutableTagError",

@@ -1,0 +1,241 @@
+"""Platform REST API: asset import/list + schema export over HTTP.
+
+The reference's GoHai-api exposes ``POST /api/v1/assets/import`` for
+HuggingFace/S3 pulls and web upload with a <2 GB limit
+(GPU调度平台搭建.md:701-744); this is that surface, TPU-platform-flavored,
+on the same stdlib-HTTP shape as serve/server.py and utils/obs.py:
+
+  POST /api/v1/assets/import
+      application/octet-stream + query params (space/kind/id): direct
+      upload — `curl --data-binary @model.bin '...?space=ml&kind=model
+      &id=m1'`
+      application/json {"space","kind","id","source":{...}}: pull-style
+      import.  Source types: {"type":"local","path":...},
+      {"type":"huggingface","repo":...,"file":...[,"revision"]},
+      {"type":"s3","bucket":...,"key":...[,"endpoint"]}.
+  GET  /api/v1/assets?space=ml[&kind=model]          → ids + versions
+  GET  /api/v1/assets/{space}/{kind}/{id}            → version metadata
+  GET  /api/v1/schemas[/{kind}]                      → CRD schemas
+  GET  /healthz
+
+Remote fetchers build the exact public URLs but the byte transport is
+injectable (``url_fetch``) — the zero-egress test seam, same pattern as
+cloud/cloudtpu.py's Transport.  Auth: pass ``verify_token`` (the OIDC
+verifier) to require ``Authorization: Bearer`` on every /api route."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from ..api.schema import all_schemas, schema_for_kind
+from .assets import AssetStore
+
+MAX_UPLOAD = 2 * 1024**3  # the reference's <2 GB web-upload limit (:703-705)
+
+
+def default_url_fetch(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.read()
+
+
+def huggingface_url(source: dict) -> str:
+    repo = source["repo"]
+    file = source["file"]
+    rev = source.get("revision", "main")
+    return f"https://huggingface.co/{repo}/resolve/{rev}/{file}"
+
+
+def s3_url(source: dict) -> str:
+    endpoint = source.get("endpoint", "https://s3.amazonaws.com")
+    return f"{endpoint.rstrip('/')}/{source['bucket']}/{source['key']}"
+
+
+class PlatformApiServer:
+    """port=0 binds an ephemeral port (tests); ``.port`` is the bound one."""
+
+    def __init__(
+        self,
+        assets: AssetStore,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        url_fetch: Callable[[str], bytes] | None = None,
+        verify_token: Callable[[str], object] | None = None,
+        max_upload: int = MAX_UPLOAD,
+    ):
+        self.assets = assets
+        self.url_fetch = url_fetch or default_url_fetch
+        self.verify_token = verify_token
+        self.max_upload = max_upload
+        self.started_at = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _authed(self) -> bool:
+                if outer.verify_token is None:
+                    return True
+                header = self.headers.get("Authorization", "")
+                if not header.startswith("Bearer "):
+                    self._json(401, {"error": "Bearer token required"})
+                    return False
+                try:
+                    outer.verify_token(header[len("Bearer "):])
+                except Exception as e:
+                    self._json(401, {"error": f"invalid token: {e}"})
+                    return False
+                return True
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                from urllib.parse import parse_qs, urlparse
+
+                u = urlparse(self.path)
+                if u.path == "/healthz":
+                    return self._json(200, {
+                        "ok": True, "uptime_s": time.time() - outer.started_at,
+                    })
+                if not self._authed():
+                    return
+                if u.path == "/api/v1/schemas":
+                    return self._json(200, all_schemas())
+                if u.path.startswith("/api/v1/schemas/"):
+                    kind = u.path.rsplit("/", 1)[-1]
+                    try:
+                        return self._json(200, schema_for_kind(kind))
+                    except KeyError as e:
+                        return self._json(404, {"error": str(e.args[0])})
+                if u.path == "/api/v1/assets":
+                    q = parse_qs(u.query)
+                    space = (q.get("space") or [""])[0]
+                    if not space:
+                        return self._json(400, {"error": "space required"})
+                    kind = (q.get("kind") or [None])[0]
+                    out = []
+                    for k, id in outer.assets.list_assets(space, kind):
+                        out.append({
+                            "kind": k, "id": id,
+                            "versions": outer.assets.versions(space, k, id),
+                        })
+                    return self._json(200, {"assets": out})
+                if u.path.startswith("/api/v1/assets/"):
+                    parts = u.path[len("/api/v1/assets/"):].split("/")
+                    if len(parts) == 3:
+                        space, kind, id = parts
+                        try:
+                            a = outer.assets.get(space, kind, id)
+                        except KeyError as e:
+                            return self._json(404, {"error": str(e)})
+                        return self._json(200, vars(a))
+                return self._json(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                from urllib.parse import parse_qs, urlparse
+
+                if not self._authed():
+                    return
+                u = urlparse(self.path)
+                if u.path != "/api/v1/assets/import":
+                    return self._json(404, {"error": "not found"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    return self._json(400, {"error": "bad Content-Length"})
+                if n > outer.max_upload:
+                    return self._json(413, {
+                        "error": f"upload {n} bytes exceeds the "
+                                 f"{outer.max_upload}-byte limit"
+                    })
+                body = self.rfile.read(n)
+                ctype = self.headers.get("Content-Type", "")
+                if ctype.startswith("application/json"):
+                    return self._import_source(body)
+                # Direct upload: body IS the payload, identity in the query.
+                q = parse_qs(u.query)
+                missing = [k for k in ("space", "kind", "id") if not q.get(k)]
+                if missing:
+                    return self._json(400, {
+                        "error": f"query params required: {missing}"
+                    })
+                a = outer.assets.import_bytes(
+                    q["space"][0], q["kind"][0], q["id"][0], body
+                )
+                return self._json(200, vars(a))
+
+            def _import_source(self, body: bytes):
+                try:
+                    doc = json.loads(body or b"{}")
+                except json.JSONDecodeError:
+                    return self._json(400, {"error": "invalid JSON body"})
+                if not isinstance(doc, dict):
+                    return self._json(400, {"error": "body must be an object"})
+                missing = [
+                    k for k in ("space", "kind", "id", "source")
+                    if not doc.get(k)
+                ]
+                if missing:
+                    return self._json(400, {
+                        "error": f"fields required: {missing}"
+                    })
+                source = doc["source"]
+                stype = source.get("type")
+                try:
+                    if stype == "local":
+                        a = outer.assets.import_path(
+                            doc["space"], doc["kind"], doc["id"],
+                            source["path"],
+                        )
+                        return self._json(200, vars(a))
+                    if stype == "huggingface":
+                        url = huggingface_url(source)
+                    elif stype == "s3":
+                        url = s3_url(source)
+                    else:
+                        return self._json(400, {
+                            "error": f"unknown source type {stype!r}; "
+                                     "expected local|huggingface|s3"
+                        })
+                    data = outer.url_fetch(url)
+                except KeyError as e:
+                    return self._json(400, {
+                        "error": f"source field required: {e.args[0]}"
+                    })
+                except OSError as e:
+                    return self._json(502, {"error": f"fetch failed: {e}"})
+                if len(data) > outer.max_upload:
+                    return self._json(413, {
+                        "error": f"fetched {len(data)} bytes exceeds the "
+                                 f"{outer.max_upload}-byte limit"
+                    })
+                a = outer.assets.import_bytes(
+                    doc["space"], doc["kind"], doc["id"], data
+                )
+                return self._json(200, {**vars(a), "source_url": url})
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="platform-api", daemon=True
+        )
+
+    def start(self) -> "PlatformApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)
