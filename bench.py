@@ -115,7 +115,8 @@ def _flagship_config(on_tpu: bool):
             vocab_size=16384, d_model=1024, n_layers=16, n_heads=8,
             d_head=128, d_ff=4096, max_seq=2048,
             use_flash=True, flash_block_q=512, flash_block_k=512,
-        ), 16  # batch
+        ), 24  # batch: 24 x 2048 tokens saturates the v5e MXU (47%+ MFU;
+        # 16 gave 46%, 32 adds nothing but stretches the timed window)
     return TransformerConfig(
         vocab_size=2048, d_model=256, n_layers=4, n_heads=8, d_head=32,
         d_ff=704, max_seq=256,
@@ -159,7 +160,7 @@ def train_bench() -> dict:
     first_loss = trainer.step(toks[:, :-1], toks[:, 1:])  # compile + warmup
     compile_s = time.perf_counter() - t0
 
-    n_steps = 8
+    n_steps = 6
     t1 = time.perf_counter()
     for _ in range(n_steps):
         loss = trainer.step(toks[:, :-1], toks[:, 1:])
@@ -383,7 +384,7 @@ def main() -> None:
             # 302M flagship, not the r1/r2 4M toy — r1/r2 headline values
             # are not directly comparable.
             "headline_composition": (
-                "reconcile_v5p64 + psum + 8-step steady train window; "
+                "reconcile_v5p64 + psum + 6-step steady train window; "
                 "compile excluded (since r3)"
             ),
             "reconcile_0_to_ready_v5p8_s": round(t_v5p8, 4),

@@ -8,7 +8,7 @@ from .types import (
 )
 from .azurevmpool import AzureVmPool, AzureVmPoolSpec, AzureVmPoolStatus, ImageReference
 from .tpupodslice import TpuPodSlice, TpuPodSliceSpec, TpuPodSliceStatus, SliceStatus
-from .core import Secret, Node, Event, Pod, PersistentVolumeClaim, Deployment
+from .core import Secret, Node, Event, Pod, PersistentVolume, PersistentVolumeClaim, Deployment
 from .devenv import DevEnv, DevEnvSpec, DevEnvStatus
 from .trainjob import TrainJob, TrainJobSpec, TrainJobStatus, AssetRef, EnvVar
 from .tenancy import LimitRange, Namespace, ResourceQuota, RoleBinding
@@ -46,6 +46,7 @@ __all__ = [
     "DEFAULT_QUEUE",
     "SchedulingQueue",
     "SchedulingQueueSpec",
+    "PersistentVolume",
     "PersistentVolumeClaim",
     "DevEnv",
     "DevEnvSpec",

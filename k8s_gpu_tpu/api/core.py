@@ -108,12 +108,40 @@ class Deployment(CustomResource):
 @dataclass
 class PersistentVolumeClaim(CustomResource):
     """RWX workspace claim (reference C12: 200Gi ReadWriteMany /workspace,
-    GPU调度平台搭建.md:181-224).  No provisioner here — a created claim is
-    Bound; what matters to the platform is identity + persistence semantics
-    (devenv pods come and go, the claim stays)."""
+    GPU调度平台搭建.md:181-224).
+
+    Two provisioning modes:
+    - ``storage_class == ""``: statically Bound on creation (the round-1
+      behavior — identity + persistence semantics are what matter to
+      devenv/GC flows);
+    - ``storage_class`` set: dynamically provisioned by the
+      StorageProvisioner against a replicated pool (the Rook-Ceph
+      alternative, C13, GPU调度平台搭建.md:226-237) — phase runs
+      Pending → Bound with ``volume_name`` pointing at the PV."""
 
     kind: str = "PersistentVolumeClaim"
     api_version: str = "v1"
     access_modes: list[str] = field(default_factory=lambda: ["ReadWriteMany"])
     capacity: str = "200Gi"
     phase: str = "Bound"
+    storage_class: str = ""
+    volume_name: str = ""
+
+
+@dataclass
+class PersistentVolume(CustomResource):
+    """A provisioned volume backing one claim (the Ceph RBD image /
+    CephFS subvolume analogue).  Cluster-scoped in k8s; namespaced here
+    like everything else in the in-memory API server."""
+
+    kind: str = "PersistentVolume"
+    api_version: str = "v1"
+    capacity: str = ""
+    storage_class: str = ""
+    access_modes: list[str] = field(default_factory=list)
+    reclaim_policy: str = "Delete"  # Delete | Retain
+    phase: str = "Available"        # Available | Bound | Released
+    claim_namespace: str = ""
+    claim_name: str = ""
+    pool: str = ""                  # backing pool name
+    replicas: int = 1               # replication factor charged to the pool

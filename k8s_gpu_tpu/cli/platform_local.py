@@ -124,6 +124,19 @@ class LocalPlatform:
         from ..scheduling.queueing import QueueReconciler
 
         self.mgr.register("SchedulingQueue", QueueReconciler(self.kube))
+        # Dynamic storage (C13): dev-box pools sized generously — capacity
+        # enforcement matters, exact numbers don't.  Usage is re-derived
+        # from live PVs (the pickled cluster state), not persisted.
+        from ..platform.bulkstore import StoragePool, StorageProvisioner
+
+        storage = StorageProvisioner(self.kube)
+        ceph = storage.pools.setdefault("ceph", StoragePool("ceph"))
+        nfs = storage.pools.setdefault("nfs", StoragePool("nfs"))
+        for i in range(3):
+            ceph.add_device(f"osd-{i}", "500Gi")
+        nfs.add_device("nfs-server", "1Ti")
+        storage.resync_pools()
+        self.mgr.register("PersistentVolumeClaim", storage)
         from ..operators import ResourceGC
 
         # GC watches '*': any kind's churn (slices and VM pools emit Events

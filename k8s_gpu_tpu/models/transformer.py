@@ -51,8 +51,10 @@ class TransformerConfig:
     # (all-to-all head regrouping, needs heads/tp divisible by sp).
     use_flash: bool = True
     sp_attention: str = "ring"
-    flash_block_q: int = 256
-    flash_block_k: int = 256
+    # 0 = shape-aware auto-selection (ops/attention.py:default_flash_blocks,
+    # tuned on-chip: 512x512 at seq 2048 / d_head 128).
+    flash_block_q: int = 0
+    flash_block_k: int = 0
     # Microbatches for the pipeline schedule (0 = schedule default: pp for
     # gpipe, 2·pp for 1f1b).
     pp_microbatches: int = 0
@@ -178,7 +180,8 @@ class TransformerLM:
             elif cfg.sp_attention == "ring":
                 o = ring_attention(
                     q, k, v, mesh,
-                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                    block_q=cfg.flash_block_q or None,
+                    block_k=cfg.flash_block_k or None,
                 )
             else:
                 raise ValueError(
@@ -190,7 +193,8 @@ class TransformerLM:
 
             o = flash_attention(
                 q, k, v, causal=True,
-                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                block_q=cfg.flash_block_q or None,
+                block_k=cfg.flash_block_k or None,
             )
         else:
             o = plain_causal_attention(q, k, v)

@@ -349,17 +349,34 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def default_flash_blocks(seq_len: int) -> tuple[int, int]:
+    """Shape-aware block defaults, measured on the v5e chip (BENCH r3):
+    512x512 beats 256x256 and 128x128 at seq 2048 / d_head 128 (45.8 →
+    47.8% end-to-end train MFU; q=1024 and k=1024 variants measured worse).
+    Shorter sequences take the largest power-of-two divisor ≤ 512 so the
+    kernel always tiles exactly."""
+    def pick(cap: int) -> int:
+        b = 1
+        while b * 2 <= min(cap, seq_len) and seq_len % (b * 2) == 0:
+            b *= 2
+        return b
+
+    b = pick(512)
+    return b, b
+
+
 def flash_attention(
     q,
     k,
     v,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Blockwise attention.  q,k,v: [B, H, S, D] → [B, H, S, D].
 
+    ``block_q/block_k=None`` auto-selects via ``default_flash_blocks``;
     ``interpret=None`` auto-selects: compiled kernel on TPU, Pallas
     interpreter elsewhere (tests).  Falls back to the reference path when
     the sequence doesn't tile evenly.
@@ -375,15 +392,23 @@ def flash_attention_lse(
     k,
     v,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Blockwise attention returning (out, lse [B, H, S]) — the contract
     ring attention needs to merge per-hop block results (the online-
     softmax combine is a function of normalized outputs + logsumexps).
-    Same fallback/auto-interpret rules as flash_attention."""
+    Same auto-block/fallback/auto-interpret rules as flash_attention."""
     s = q.shape[2]
+    if block_q is None or block_k is None:
+        auto_q, auto_k = default_flash_blocks(s)
+        block_q = block_q or auto_q
+        block_k = block_k or auto_k
+        if min(block_q, block_k) < 8:
+            # Degenerate tiling (odd/short seq): the einsum oracle beats a
+            # 1-wide kernel.
+            return reference_attention_lse(q, k, v, causal)
     bq, bk = min(block_q, s), min(block_k, s)
     if s % bq != 0 or s % bk != 0:
         return reference_attention_lse(q, k, v, causal)

@@ -187,14 +187,15 @@ def ring_attention(
     axis_name: str = "sp",
     batch_axes=("dp",),
     head_axes=("tp",),
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jax.Array:
     """Causal self-attention with sequence sharded over *axis_name*.
 
     q, k, v: [B, H, S, D] (global view; S sharded over sp, B over dp,
     H over tp).  Returns [B, H, S, D] with the same sharding.  Per-hop
-    block attends run the Pallas flash kernel with these block sizes.
+    block attends run the Pallas flash kernel with these block sizes
+    (None = shape-aware auto-selection).
     """
     n_blocks = mesh.shape[axis_name]
     spec = P(batch_axes, head_axes, axis_name, None)

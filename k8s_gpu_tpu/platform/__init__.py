@@ -10,6 +10,12 @@ from .templates import (
 from .assets import AssetStore, Asset
 from .apiserver import PlatformApiServer
 from .sshgate import SshGateway
+from .bulkstore import (
+    StorageClass,
+    StoragePool,
+    StorageProvisioner,
+    parse_quantity,
+)
 from .registry import (
     ImageManifest,
     ImageRegistry,
@@ -41,6 +47,10 @@ __all__ = [
     "Asset",
     "PlatformApiServer",
     "SshGateway",
+    "StorageClass",
+    "StoragePool",
+    "StorageProvisioner",
+    "parse_quantity",
     "ImageManifest",
     "ImageRegistry",
     "ImmutableTagError",

@@ -113,12 +113,18 @@ class PlatformApiServer:
                     if not space:
                         return self._json(400, {"error": "space required"})
                     kind = (q.get("kind") or [None])[0]
-                    out = []
-                    for k, id in outer.assets.list_assets(space, kind):
-                        out.append({
-                            "kind": k, "id": id,
-                            "versions": outer.assets.versions(space, k, id),
-                        })
+                    try:
+                        out = [
+                            {
+                                "kind": k, "id": id,
+                                "versions": outer.assets.versions(
+                                    space, k, id
+                                ),
+                            }
+                            for k, id in outer.assets.list_assets(space, kind)
+                        ]
+                    except ValueError as e:  # unsafe space/kind
+                        return self._json(400, {"error": str(e)})
                     return self._json(200, {"assets": out})
                 if u.path.startswith("/api/v1/assets/"):
                     parts = u.path[len("/api/v1/assets/"):].split("/")
@@ -128,6 +134,8 @@ class PlatformApiServer:
                             a = outer.assets.get(space, kind, id)
                         except KeyError as e:
                             return self._json(404, {"error": str(e)})
+                        except ValueError as e:
+                            return self._json(400, {"error": str(e)})
                         return self._json(200, vars(a))
                 return self._json(404, {"error": "not found"})
 
@@ -159,9 +167,12 @@ class PlatformApiServer:
                     return self._json(400, {
                         "error": f"query params required: {missing}"
                     })
-                a = outer.assets.import_bytes(
-                    q["space"][0], q["kind"][0], q["id"][0], body
-                )
+                try:
+                    a = outer.assets.import_bytes(
+                        q["space"][0], q["kind"][0], q["id"][0], body
+                    )
+                except ValueError as e:  # unsafe space/kind/id
+                    return self._json(400, {"error": str(e)})
                 return self._json(200, vars(a))
 
             def _import_source(self, body: bytes):
@@ -202,6 +213,8 @@ class PlatformApiServer:
                     return self._json(400, {
                         "error": f"source field required: {e.args[0]}"
                     })
+                except ValueError as e:  # unsafe space/kind/id
+                    return self._json(400, {"error": str(e)})
                 except OSError as e:
                     return self._json(502, {"error": f"fetch failed: {e}"})
                 if len(data) > outer.max_upload:
@@ -209,9 +222,12 @@ class PlatformApiServer:
                         "error": f"fetched {len(data)} bytes exceeds the "
                                  f"{outer.max_upload}-byte limit"
                     })
-                a = outer.assets.import_bytes(
-                    doc["space"], doc["kind"], doc["id"], data
-                )
+                try:
+                    a = outer.assets.import_bytes(
+                        doc["space"], doc["kind"], doc["id"], data
+                    )
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
                 return self._json(200, {**vars(a), "source_url": url})
 
             def _json(self, code: int, payload) -> None:

@@ -12,10 +12,25 @@ from __future__ import annotations
 
 import hashlib
 import json
+import re
 import shutil
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
+
+# space/kind/id become directory names; with network surfaces (REST
+# import, ssh PUT) forwarding client strings here, anything outside this
+# set — and especially '..' — must be rejected, not resolved.
+_SAFE_COMPONENT = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,127}$")
+
+
+def _check_components(*parts: str) -> None:
+    for p in parts:
+        if not _SAFE_COMPONENT.match(p) or ".." in p:
+            raise ValueError(
+                f"unsafe path component {p!r}: must match "
+                "[A-Za-z0-9][A-Za-z0-9._-]* and not contain '..'"
+            )
 
 
 @dataclass
@@ -38,6 +53,7 @@ class AssetStore:
         self.root.mkdir(parents=True, exist_ok=True)
 
     def _dir(self, space: str, kind: str, id: str, version: str) -> Path:
+        _check_components(space, kind, id, version)
         return self.root / space / kind / id / version
 
     def _next_version(self, space: str, kind: str, id: str) -> str:
@@ -82,8 +98,31 @@ class AssetStore:
 
     def import_path(self, space: str, kind: str, id: str, src: str | Path) -> Asset:
         """Import a file or directory (the reference's SFTP/lftp bulk path,
-        :707-734 — incremental dirs arrive as archives here)."""
+        :707-734 — incremental dirs arrive as archives here).  Files are
+        streamed + hashed in 1 MiB chunks — this is the no-size-cap bulk
+        route, so payloads must never be RAM-resident."""
         src = Path(src)
+        if src.is_file():
+            version = self._next_version(space, kind, id)
+            d = self._dir(space, kind, id, version)
+            staged = d.parent / f".staging-{version}"
+            if staged.exists():
+                shutil.rmtree(staged)
+            staged.mkdir(parents=True)
+            payload = staged / "payload"
+            h = hashlib.sha256()
+            with open(src, "rb") as fin, open(payload, "wb") as fout:
+                for chunk in iter(lambda: fin.read(1 << 20), b""):
+                    h.update(chunk)
+                    fout.write(chunk)
+            meta = Asset(
+                space=space, id=id, version=version, kind=kind,
+                sha256=h.hexdigest(), size=payload.stat().st_size,
+                created_at=time.time(), path=str(d / "payload"),
+            )
+            (staged / "meta.json").write_text(json.dumps(vars(meta)))
+            self._commit(staged, d)
+            return meta
         if src.is_dir():
             version = self._next_version(space, kind, id)
             d = self._dir(space, kind, id, version)
@@ -101,10 +140,11 @@ class AssetStore:
             (staged / "meta.json").write_text(json.dumps(vars(meta)))
             self._commit(staged, d)
             return meta
-        return self.import_bytes(space, kind, id, src.read_bytes())
+        raise FileNotFoundError(f"no such file or directory: {src}")
 
     # -- read --------------------------------------------------------------
     def versions(self, space: str, kind: str, id: str) -> list[str]:
+        _check_components(space, kind, id)
         d = self.root / space / kind / id
         if not d.exists():
             return []
@@ -145,6 +185,7 @@ class AssetStore:
         return dest
 
     def list_assets(self, space: str, kind: str | None = None) -> list[tuple[str, str]]:
+        _check_components(space, *((kind,) if kind else ()))
         out = []
         base = self.root / space
         if not base.exists():

@@ -1,0 +1,288 @@
+"""Replicated bulk storage: the Rook-Ceph alternative (C13,
+GPU调度平台搭建.md:226-237), the last unimplemented reference component.
+
+The reference offers Rook-Ceph as the large-scale option next to NFS
+(:181-224): block devices (RBD) and a shared filesystem (CephFS) carved
+out of replicated pools across storage nodes.  The capability surface
+rebuilt here:
+
+- **StorageClass**: named class → (pool, access modes, replication,
+  reclaim policy).  Defaults mirror the reference's storage menu:
+  ``workspace-nfs`` (RWX, 1x — the NFS role), ``ceph-block`` (RWO, 3x),
+  ``ceph-fs`` (RWX, 3x).
+- **StoragePool**: raw capacity contributed by OSD-style backing devices;
+  a claim of size S at replication R charges R·S raw bytes (the Ceph
+  replicated-pool cost model).  Losing backing devices degrades the pool:
+  new provisioning needs at least ``replicas`` devices up (write quorum),
+  while existing volumes stay Bound (data loss modeling is out of scope —
+  what the platform needs is the capacity/health contract).
+- **StorageProvisioner**: a reconciler binding class-bearing PVCs to
+  freshly provisioned PVs (Pending → Bound), refusing politely when the
+  pool is exhausted or degraded (Pending + Events — capacity arriving
+  later unblocks on resync), and reclaiming on claim deletion per the
+  class policy (Delete frees pool bytes; Retain leaves a Released PV).
+
+Classless PVCs keep the round-1 static behavior (created Bound) — the
+devenv/GC flows are untouched.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from ..api.core import PersistentVolume, PersistentVolumeClaim
+from ..controller.events import EventRecorder
+from ..controller.kubefake import Conflict, FakeKube, NotFound
+from ..controller.manager import Reconciler, Request, Result
+
+_UNITS = {
+    "": 1, "K": 10**3, "M": 10**6, "G": 10**9, "T": 10**12,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+}
+
+
+def parse_quantity(s: str) -> int:
+    """'200Gi' → bytes (the k8s resource.Quantity subset the platform
+    uses)."""
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)([KMGT]i?)?", str(s).strip())
+    if not m:
+        raise ValueError(f"malformed quantity {s!r}")
+    return int(float(m.group(1)) * _UNITS[m.group(2) or ""])
+
+
+@dataclass(frozen=True)
+class StorageClass:
+    name: str
+    pool: str
+    access_modes: tuple[str, ...]
+    replicas: int = 1
+    reclaim_policy: str = "Delete"
+
+
+@dataclass
+class StoragePool:
+    """Raw capacity from named backing devices (the OSD set)."""
+
+    name: str
+    devices: dict[str, int] = field(default_factory=dict)  # name -> bytes
+    down: set[str] = field(default_factory=set)
+    used: int = 0
+
+    def add_device(self, name: str, capacity: str | int) -> None:
+        self.devices[name] = (
+            capacity if isinstance(capacity, int) else parse_quantity(capacity)
+        )
+
+    def fail_device(self, name: str) -> None:
+        self.down.add(name)
+
+    def restore_device(self, name: str) -> None:
+        self.down.discard(name)
+
+    @property
+    def devices_up(self) -> int:
+        return len([d for d in self.devices if d not in self.down])
+
+    @property
+    def raw_capacity(self) -> int:
+        return sum(
+            c for d, c in self.devices.items() if d not in self.down
+        )
+
+    def free(self) -> int:
+        return max(0, self.raw_capacity - self.used)
+
+
+DEFAULT_CLASSES = (
+    StorageClass("workspace-nfs", pool="nfs",
+                 access_modes=("ReadWriteMany",), replicas=1),
+    StorageClass("ceph-block", pool="ceph",
+                 access_modes=("ReadWriteOnce",), replicas=3),
+    StorageClass("ceph-fs", pool="ceph",
+                 access_modes=("ReadWriteMany",), replicas=3),
+)
+
+
+class StorageProvisioner(Reconciler):
+    """Reconciles class-bearing PVCs against pools; level-triggered, so a
+    Pending claim retries on every resync until capacity appears."""
+
+    RETRY = 15.0
+
+    def __init__(self, kube: FakeKube, classes=DEFAULT_CLASSES,
+                 pools: dict[str, StoragePool] | None = None):
+        self.kube = kube
+        self.classes = {c.name: c for c in classes}
+        self.pools = pools or {}
+        self.recorder = EventRecorder(kube, "storage-provisioner")
+
+    def resync_pools(self) -> None:
+        """Recompute pool usage from live PVs — the restart/recovery path.
+        Pool accounting is in-memory; the PVs in the cluster are the
+        durable record (Released PVs keep their charge: Retain means the
+        bytes are still spoken for until an operator reclaims them)."""
+        for pool in self.pools.values():
+            pool.used = 0
+        for pv in self.kube.list("PersistentVolume"):
+            if pv.phase in ("Bound", "Released") and pv.pool:
+                pool = self.pools.setdefault(pv.pool, StoragePool(pv.pool))
+                pool.used += parse_quantity(pv.capacity) * pv.replicas
+
+    def pool_for(self, cls: StorageClass) -> StoragePool:
+        if cls.pool not in self.pools:
+            self.pools[cls.pool] = StoragePool(cls.pool)
+        return self.pools[cls.pool]
+
+    @staticmethod
+    def pv_name(pvc: PersistentVolumeClaim) -> str:
+        return f"pv-{pvc.metadata.namespace}-{pvc.metadata.name}"
+
+    def reconcile(self, req: Request) -> Result:
+        pvc = self.kube.try_get(
+            "PersistentVolumeClaim", req.name, req.namespace
+        )
+        if pvc is None:
+            return self._reclaim_orphans(req)
+        if not pvc.storage_class:
+            return Result()  # static claims are not ours
+        cls = self.classes.get(pvc.storage_class)
+        if cls is None:
+            self._pend(pvc, "UnknownStorageClass",
+                       f"no storage class {pvc.storage_class!r} "
+                       f"(have {sorted(self.classes)})")
+            return Result()
+        if pvc.volume_name:
+            return Result()  # already bound
+
+        mode_ok = any(m in cls.access_modes for m in pvc.access_modes)
+        if not mode_ok:
+            self._pend(pvc, "UnsupportedAccessMode",
+                       f"class {cls.name} supports {list(cls.access_modes)}, "
+                       f"claim wants {pvc.access_modes}")
+            return Result()
+
+        size = parse_quantity(pvc.capacity)
+        pool = self.pool_for(cls)
+        if pool.devices_up < cls.replicas:
+            self._pend(pvc, "PoolDegraded",
+                       f"pool {pool.name}: {pool.devices_up} device(s) up, "
+                       f"need {cls.replicas} for write quorum")
+            return Result(requeue_after=self.RETRY)
+        cost = size * cls.replicas
+        if cost > pool.free():
+            self._pend(pvc, "PoolExhausted",
+                       f"pool {pool.name}: need {cost} raw bytes "
+                       f"({size} x {cls.replicas} replicas), "
+                       f"free {pool.free()}")
+            return Result(requeue_after=self.RETRY)
+
+        pv = PersistentVolume()
+        pv.metadata.name = self.pv_name(pvc)
+        pv.metadata.namespace = pvc.metadata.namespace
+        pv.capacity = pvc.capacity
+        pv.storage_class = cls.name
+        pv.access_modes = list(pvc.access_modes)
+        pv.reclaim_policy = cls.reclaim_policy
+        pv.phase = "Bound"
+        pv.claim_namespace = pvc.metadata.namespace
+        pv.claim_name = pvc.metadata.name
+        pv.pool = pool.name
+        pv.replicas = cls.replicas
+        charged = False
+        try:
+            self.kube.create(pv)
+            pool.used += cost  # charge exactly once, on the create we made
+            charged = True
+        except Conflict:
+            # A PV of this name already exists — e.g. a same-named claim
+            # was deleted and recreated before/without reclaim (Retain
+            # leaves Released PVs forever).  Adopt it only if it matches
+            # this claim exactly and is still charged; anything else needs
+            # reclaim/operator action, NOT a silent rebind to stale bytes.
+            existing = self.kube.try_get(
+                "PersistentVolume", pv.metadata.name, pv.metadata.namespace
+            )
+            if existing is None:
+                return Result(requeue=True)  # raced a delete; retry
+            if not (
+                existing.phase == "Bound"
+                and existing.claim_name == pvc.metadata.name
+                and existing.claim_namespace == pvc.metadata.namespace
+                and existing.storage_class == cls.name
+                and existing.capacity == pvc.capacity
+            ):
+                self._pend(pvc, "StalePersistentVolume",
+                           f"pv {existing.metadata.name} exists with "
+                           f"phase={existing.phase} class="
+                           f"{existing.storage_class} cap="
+                           f"{existing.capacity}; reclaim it first")
+                return Result(requeue_after=self.RETRY)
+            pv = existing  # matching PV from a previous pass: already charged
+
+        pvc.volume_name = pv.metadata.name
+        pvc.phase = "Bound"
+        try:
+            self.kube.update(pvc)
+        except (Conflict, NotFound):
+            # Unwind only what this pass charged; the requeue re-provisions
+            # consistently.
+            if charged:
+                pool.used -= cost
+                try:
+                    self.kube.delete(
+                        "PersistentVolume", pv.metadata.name,
+                        pv.metadata.namespace,
+                    )
+                except NotFound:
+                    pass
+            return Result(requeue=True)
+        self.recorder.event(
+            pvc, "Normal", "Provisioned",
+            f"bound to {pv.metadata.name} ({pvc.capacity} x {cls.replicas} "
+            f"replicas from pool {pool.name})",
+        )
+        return Result()
+
+    # -- reclaim -----------------------------------------------------------
+    def _reclaim_orphans(self, req: Request) -> Result:
+        """The claim is gone: apply the PV's reclaim policy."""
+        pv = self.kube.try_get(
+            "PersistentVolume", f"pv-{req.namespace}-{req.name}",
+            req.namespace,
+        )
+        if pv is None or pv.phase == "Released":
+            return Result()
+        cost = parse_quantity(pv.capacity) * pv.replicas
+        pool = self.pools.get(pv.pool)
+        if pv.reclaim_policy == "Retain":
+            pv.phase = "Released"
+            try:
+                self.kube.update(pv)
+            except (Conflict, NotFound):
+                return Result(requeue=True)
+            return Result()
+        try:
+            self.kube.delete(
+                "PersistentVolume", pv.metadata.name, pv.metadata.namespace
+            )
+        except NotFound:
+            return Result()
+        if pool is not None:
+            pool.used = max(0, pool.used - cost)
+        return Result()
+
+    def _pend(self, pvc: PersistentVolumeClaim, reason: str, msg: str) -> None:
+        # One event per distinct reason (claims are often born Pending, so
+        # phase transitions can't gate this); the annotation survives
+        # provisioner restarts.
+        ann = "storage.k8sgpu.dev/pending-reason"
+        changed = pvc.metadata.annotations.get(ann) != reason
+        pvc.phase = "Pending"
+        pvc.metadata.annotations[ann] = reason
+        try:
+            self.kube.update(pvc)
+        except (Conflict, NotFound):
+            return
+        if changed:
+            self.recorder.event(pvc, "Warning", reason, msg)

@@ -18,6 +18,10 @@ the gateway's job is the reference's ingress routing + key check):
     S: OK <session banner>\n   |   DENIED <reason>\n
     then a minimal session loop:
     C: EXEC <cmd>\n   → S: <one-line result>\n   (hostname/whoami/chips)
+    C: PUT <space> <kind> <id> <size>\n + <size> raw bytes
+                      → S: OK imported ...\n   (the SFTP bulk-upload role,
+                        :707-734 — big transfers ride the authenticated
+                        ssh channel, NOT the web path with its <2 GB cap)
     C: EXIT\n         → S: BYE\n  (connection closes)
 
 Auth checks live cluster state on every connection: the DevEnv's pod
@@ -41,9 +45,15 @@ class SshGateway:
     """port=0 binds an ephemeral port (tests); ``.port`` is the bound one."""
 
     def __init__(self, kube: FakeKube, host: str = "127.0.0.1",
-                 port: int = 0, namespace: str = "default"):
+                 port: int = 0, namespace: str = "default", assets=None):
+        """``assets``: an AssetStore enabling PUT bulk uploads (the SFTP
+        role); None disables the verb.  Tenancy note: PUT trusts the
+        authenticated username for auditing only — space-level quota/RBAC
+        enforcement belongs to the platform layer (auth/), same as the
+        reference's GoHai-api front door."""
         self.kube = kube
         self.namespace = namespace
+        self.assets = assets
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -88,8 +98,53 @@ class SshGateway:
                         self.wfile.write(
                             (outer._exec(username, pod, cmd) + "\n").encode()
                         )
+                    elif line.startswith("PUT "):
+                        self.wfile.write(
+                            (self._put(line) + "\n").encode()
+                        )
                     else:
                         self.wfile.write(b"ERR unknown command\n")
+
+            def _put(self, line: str) -> str:
+                if outer.assets is None:
+                    return "ERR uploads disabled (no asset store)"
+                parts = line.split()
+                if len(parts) != 5:
+                    return "ERR usage: PUT <space> <kind> <id> <size>"
+                _, space, kind, id, size_s = parts
+                try:
+                    size = int(size_s)
+                except ValueError:
+                    return "ERR size must be an integer"
+                if size < 0:
+                    return "ERR size must be >= 0"
+                # Stream to a spooled temp file: this is the no-cap bulk
+                # channel, so the payload must never be held in memory
+                # (a 10 GB PUT at 2x in RAM would OOM the gateway).
+                import tempfile
+                from pathlib import Path
+
+                with tempfile.NamedTemporaryFile(
+                    delete=False, prefix=".ssh-upload-"
+                ) as tmp:
+                    remaining = size
+                    while remaining:
+                        chunk = self.rfile.read(min(remaining, 1 << 20))
+                        if not chunk:
+                            Path(tmp.name).unlink(missing_ok=True)
+                            return "ERR connection closed mid-upload"
+                        tmp.write(chunk)
+                        remaining -= len(chunk)
+                try:
+                    a = outer.assets.import_path(space, kind, id, tmp.name)
+                except ValueError as e:  # unsafe space/kind/id
+                    return f"ERR {e}"
+                finally:
+                    Path(tmp.name).unlink(missing_ok=True)
+                return (
+                    f"OK imported {kind}/{id} {a.version} "
+                    f"({a.size} bytes, sha256 {a.sha256[:12]})"
+                )
 
         self._server = socketserver.ThreadingTCPServer(
             (host, port), Handler, bind_and_activate=True

@@ -1,0 +1,216 @@
+"""Replicated bulk storage (C13, the Rook-Ceph alternative,
+GPU调度平台搭建.md:226-237): class-based provisioning, replication-aware
+capacity accounting, degradation, reclaim policies — and the static
+(classless) PVC path staying untouched."""
+
+import pytest
+
+from k8s_gpu_tpu.api.core import PersistentVolumeClaim
+from k8s_gpu_tpu.controller import FakeKube
+from k8s_gpu_tpu.controller.manager import Request
+from k8s_gpu_tpu.platform.bulkstore import (
+    StoragePool,
+    StorageProvisioner,
+    parse_quantity,
+)
+
+
+def make(kube, name, capacity="10Gi", storage_class="ceph-block",
+         modes=("ReadWriteOnce",)):
+    pvc = PersistentVolumeClaim()
+    pvc.metadata.name = name
+    pvc.capacity = capacity
+    pvc.storage_class = storage_class
+    pvc.access_modes = list(modes)
+    pvc.phase = "Pending"
+    kube.create(pvc)
+    return pvc
+
+
+@pytest.fixture()
+def setup():
+    kube = FakeKube()
+    prov = StorageProvisioner(kube)
+    ceph = prov.pools.setdefault("ceph", StoragePool("ceph"))
+    for i in range(3):
+        ceph.add_device(f"osd-{i}", "100Gi")
+    return kube, prov, ceph
+
+
+def r(prov, name):
+    return prov.reconcile(Request(name=name, namespace="default"))
+
+
+def test_parse_quantity():
+    assert parse_quantity("200Gi") == 200 * 2**30
+    assert parse_quantity("1T") == 10**12
+    assert parse_quantity("512") == 512
+    with pytest.raises(ValueError):
+        parse_quantity("10GB")
+
+
+def test_provision_bind_and_replicated_accounting(setup):
+    kube, prov, ceph = setup
+    make(kube, "data")
+    r(prov, "data")
+    pvc = kube.get("PersistentVolumeClaim", "data")
+    assert pvc.phase == "Bound" and pvc.volume_name == "pv-default-data"
+    pv = kube.get("PersistentVolume", "pv-default-data")
+    assert pv.phase == "Bound" and pv.replicas == 3
+    # 10Gi at 3x replication charges 30Gi raw (the Ceph cost model).
+    assert ceph.used == 3 * parse_quantity("10Gi")
+
+
+def test_exhaustion_pends_then_unblocks(setup):
+    kube, prov, ceph = setup
+    make(kube, "big", capacity="90Gi")   # 270Gi raw of 300Gi
+    r(prov, "big")
+    make(kube, "more", capacity="20Gi")  # needs 60Gi raw, only 30 free
+    res = r(prov, "more")
+    pvc = kube.get("PersistentVolumeClaim", "more")
+    assert pvc.phase == "Pending" and res.requeue_after
+    events = [e for e in kube.list("Event")
+              if e.reason == "PoolExhausted"]
+    assert events and "replicas" in events[0].message
+    # Capacity arrives (new OSD) → the level-triggered retry binds it.
+    ceph.add_device("osd-3", "100Gi")
+    r(prov, "more")
+    assert kube.get("PersistentVolumeClaim", "more").phase == "Bound"
+
+
+def test_degraded_pool_blocks_new_but_keeps_existing(setup):
+    kube, prov, ceph = setup
+    make(kube, "before")
+    r(prov, "before")
+    ceph.fail_device("osd-0")
+    ceph.fail_device("osd-1")  # 1 device up < 3 replicas: no write quorum
+    make(kube, "after")
+    r(prov, "after")
+    assert kube.get("PersistentVolumeClaim", "before").phase == "Bound"
+    assert kube.get("PersistentVolumeClaim", "after").phase == "Pending"
+    assert any(e.reason == "PoolDegraded" for e in kube.list("Event"))
+    ceph.restore_device("osd-0")
+    ceph.restore_device("osd-1")
+    r(prov, "after")
+    assert kube.get("PersistentVolumeClaim", "after").phase == "Bound"
+
+
+def test_reclaim_delete_frees_capacity(setup):
+    kube, prov, ceph = setup
+    make(kube, "temp")
+    r(prov, "temp")
+    used = ceph.used
+    assert used > 0
+    kube.delete("PersistentVolumeClaim", "temp")
+    r(prov, "temp")  # claim gone → reclaim pass
+    assert ceph.used == 0
+    assert kube.try_get("PersistentVolume", "pv-default-temp") is None
+
+
+def test_reclaim_retain_releases_pv(setup):
+    kube, prov, ceph = setup
+    from k8s_gpu_tpu.platform.bulkstore import StorageClass
+
+    prov.classes["keep"] = StorageClass(
+        "keep", pool="ceph", access_modes=("ReadWriteOnce",),
+        replicas=2, reclaim_policy="Retain",
+    )
+    make(kube, "precious", storage_class="keep")
+    r(prov, "precious")
+    kube.delete("PersistentVolumeClaim", "precious")
+    r(prov, "precious")
+    pv = kube.get("PersistentVolume", "pv-default-precious")
+    assert pv.phase == "Released"
+    assert ceph.used == 2 * parse_quantity("10Gi")  # Retain keeps the bytes
+
+
+def test_access_mode_mismatch_and_unknown_class(setup):
+    kube, prov, ceph = setup
+    make(kube, "rwx-on-block", modes=("ReadWriteMany",))  # block is RWO
+    r(prov, "rwx-on-block")
+    assert kube.get("PersistentVolumeClaim", "rwx-on-block").phase == "Pending"
+    make(kube, "lost", storage_class="nope")
+    r(prov, "lost")
+    assert any(e.reason == "UnknownStorageClass" for e in kube.list("Event"))
+
+
+def test_cephfs_rwx_and_nfs_classes(setup):
+    kube, prov, ceph = setup
+    nfs = prov.pools.setdefault("nfs", StoragePool("nfs"))
+    nfs.add_device("nfs-server", "500Gi")
+    make(kube, "shared", storage_class="ceph-fs", modes=("ReadWriteMany",))
+    make(kube, "ws", storage_class="workspace-nfs", modes=("ReadWriteMany",))
+    r(prov, "shared")
+    r(prov, "ws")
+    assert kube.get("PersistentVolumeClaim", "shared").phase == "Bound"
+    assert kube.get("PersistentVolumeClaim", "ws").phase == "Bound"
+    assert nfs.used == parse_quantity("10Gi")  # 1x replication on nfs
+
+
+def test_classless_pvc_untouched(setup):
+    kube, prov, _ = setup
+    pvc = PersistentVolumeClaim()
+    pvc.metadata.name = "static"
+    kube.create(pvc)
+    rv = kube.get("PersistentVolumeClaim", "static").metadata.resource_version
+    r(prov, "static")
+    cur = kube.get("PersistentVolumeClaim", "static")
+    assert cur.phase == "Bound" and cur.metadata.resource_version == rv
+
+
+def test_idempotent_reconcile(setup):
+    kube, prov, ceph = setup
+    make(kube, "once")
+    r(prov, "once")
+    used = ceph.used
+    r(prov, "once")
+    r(prov, "once")
+    assert ceph.used == used  # no double-charge
+    assert len(kube.list("PersistentVolume")) == 1
+
+
+def test_recreated_claim_does_not_double_charge_or_steal_stale_pv(setup):
+    """Review finding: delete + recreate of a same-named claim must not
+    silently adopt the old PV or charge the pool twice."""
+    from k8s_gpu_tpu.platform.bulkstore import StorageClass
+
+    kube, prov, ceph = setup
+    prov.classes["keep"] = StorageClass(
+        "keep", pool="ceph", access_modes=("ReadWriteOnce",),
+        replicas=2, reclaim_policy="Retain",
+    )
+    make(kube, "data", storage_class="keep")
+    r(prov, "data")
+    kube.delete("PersistentVolumeClaim", "data")
+    r(prov, "data")  # reclaim: Retain → Released PV stays, charge stays
+    used_after_release = ceph.used
+    make(kube, "data", storage_class="keep")  # same name, new claim
+    r(prov, "data")
+    cur = kube.get("PersistentVolumeClaim", "data")
+    assert cur.phase == "Pending", "must not bind to a Released PV"
+    assert ceph.used == used_after_release, "no double charge"
+    assert any(e.reason == "StalePersistentVolume" for e in kube.list("Event"))
+
+
+def test_resync_pools_rederives_usage(setup):
+    kube, prov, ceph = setup
+    make(kube, "a")
+    r(prov, "a")
+    want = ceph.used
+    ceph.used = 0  # simulate a restarted provisioner with fresh memory
+    prov.resync_pools()
+    assert ceph.used == want
+
+
+def test_unsafe_asset_components_rejected(tmp_path):
+    """Review finding: space/kind/id become directory names and now arrive
+    from network clients — traversal must be rejected, not resolved."""
+    from k8s_gpu_tpu.platform import AssetStore
+
+    store = AssetStore(tmp_path / "assets")
+    for bad in ("../../etc", "a/b", "..", ".hidden", ""):
+        with pytest.raises(ValueError):
+            store.import_bytes(bad, "model", "x", b"data")
+        with pytest.raises(ValueError):
+            store.import_bytes("ml", "model", bad, b"data")
+    store.import_bytes("ml", "model", "ok-1.2_3", b"data")  # safe chars fine

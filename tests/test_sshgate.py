@@ -119,3 +119,72 @@ def test_teardown_stops_accepting(cluster):
     c.line(); c.send("SSH-2.0-x"); c.send(f"AUTH ada {KEY}")
     assert "no running devenv" in c.line()
     c.close()
+
+
+def test_sftp_style_bulk_upload(tmp_path, cluster):
+    """C29's SFTP half: a big payload rides the authenticated ssh channel
+    into the versioned asset store — no web-upload size cap on this path
+    (GPU调度平台搭建.md:707-734)."""
+    from k8s_gpu_tpu.platform import AssetStore
+
+    kube, rec, gw = cluster
+    gw.stop()
+    store = AssetStore(tmp_path / "assets")
+    gw2 = SshGateway(kube, assets=store).start()
+    try:
+        c = Client(gw2.port)
+        c.line()
+        c.send("SSH-2.0-testclient")
+        c.send(f"AUTH ada {KEY}")
+        assert c.line().startswith("OK")
+        c.line()  # welcome
+        payload = b"model-bytes " * 500_000  # ~6 MB, one shot
+        c.send(f"PUT ml model big-model {len(payload)}")
+        c.f.write(payload)
+        c.f.flush()
+        reply = c.line()
+        assert reply.startswith("OK imported model/big-model v1")
+        a = store.get("ml", "model", "big-model")
+        assert a.size == len(payload)
+        with open(a.path, "rb") as f:
+            assert f.read() == payload
+        # Second upload versions.
+        c.send("PUT ml model big-model 3")
+        c.f.write(b"xyz")
+        c.f.flush()
+        assert "v2" in c.line()
+        c.send("EXIT")
+        c.close()
+    finally:
+        gw2.stop()
+
+
+def test_put_without_store_or_bad_args(cluster):
+    kube, rec, gw = cluster
+    c = Client(gw.port)
+    c.line(); c.send("SSH-2.0-x"); c.send(f"AUTH ada {KEY}")
+    assert c.line().startswith("OK")
+    c.line()
+    c.send("PUT ml model x 10")
+    assert "uploads disabled" in c.line()
+    c.close()
+
+
+def test_put_traversal_rejected(tmp_path, cluster):
+    """Review finding: PUT must not resolve '..' into filesystem paths."""
+    from k8s_gpu_tpu.platform import AssetStore
+
+    kube, rec, gw = cluster
+    gw.stop()
+    gw2 = SshGateway(kube, assets=AssetStore(tmp_path / "assets")).start()
+    try:
+        c = Client(gw2.port)
+        c.line(); c.send("SSH-2.0-x"); c.send(f"AUTH ada {KEY}")
+        assert c.line().startswith("OK")
+        c.line()
+        c.send("PUT ../../evil model x 4")
+        c.f.write(b"boom"); c.f.flush()
+        assert c.line().startswith("ERR unsafe path component")
+        assert not (tmp_path / "evil").exists()
+    finally:
+        gw2.stop()
