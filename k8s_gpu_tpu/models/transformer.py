@@ -176,7 +176,11 @@ class TransformerLM:
             if cfg.sp_attention == "ulysses":
                 from ..parallel.ulysses import ulysses_attention
 
-                o = ulysses_attention(q, k, v, mesh)
+                o = ulysses_attention(
+                    q, k, v, mesh,
+                    block_q=cfg.flash_block_q or None,
+                    block_k=cfg.flash_block_k or None,
+                )
             elif cfg.sp_attention == "ring":
                 o = ring_attention(
                     q, k, v, mesh,

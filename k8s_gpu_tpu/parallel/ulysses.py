@@ -27,10 +27,8 @@ from functools import partial
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .ring_attention import plain_causal_attention
 
-
-def _ulysses_local(q, k, v, *, axis_name):
+def _ulysses_local(q, k, v, *, axis_name, block_q, block_k):
     """Per-device body under shard_map: inputs are the local sequence
     blocks [B, H, S/P, D]."""
     def seq_to_heads(x):
@@ -46,7 +44,13 @@ def _ulysses_local(q, k, v, *, axis_name):
         )
 
     q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    o = plain_causal_attention(q, k, v)
+    # The local attend is full-sequence ordinary causal attention — the
+    # Pallas flash kernel drops in directly (O(block·S) memory; falls back
+    # to the einsum oracle when the sequence doesn't tile).
+    from ..ops.attention import flash_attention
+
+    o = flash_attention(q, k, v, causal=True, block_q=block_q,
+                        block_k=block_k)
     return heads_to_seq(o)
 
 
@@ -59,12 +63,15 @@ def ulysses_attention(
     axis_name: str = "sp",
     batch_axes=("dp",),
     head_axes=("tp",),
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jax.Array:
     """Causal self-attention with sequence sharded over *axis_name*.
 
     Same contract as ring_attention: q,k,v [B, H, S, D] global view with
     S over sp, B over dp, H over tp; returns the same sharding.  Requires
     the local head count to be divisible by mesh.shape[axis_name].
+    Block sizes feed the flash kernel (None = shape-aware auto).
     """
     sp = mesh.shape[axis_name]
     tp = 1
@@ -77,7 +84,8 @@ def ulysses_attention(
             f"divisible by sp={sp}; use ring attention instead"
         )
     spec = P(batch_axes, head_axes, axis_name, None)
-    body = partial(_ulysses_local, axis_name=axis_name)
+    body = partial(_ulysses_local, axis_name=axis_name,
+                   block_q=block_q, block_k=block_k)
     return jax.shard_map(
         body,
         mesh=mesh,
