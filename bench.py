@@ -33,6 +33,62 @@ import sys
 import time
 
 
+def _device_preflight(timeout_s: float = 90.0) -> bool:
+    """Probe TPU *backend initialization* in a SUBPROCESS with a timeout.
+
+    A wedged accelerator tunnel hangs ``jax.devices()`` forever (observed
+    live: ``import jax`` succeeded but the first backend touch blocked on
+    the unresponsive remote chip pool).  The bench must degrade to the
+    CPU fallback and still print its one JSON line rather than hang the
+    driver.  Set ``K8SGPU_BENCH_SKIP_PREFLIGHT=1`` to skip the probe and
+    its extra jax+plugin init (~10-30 s on healthy hardware).
+
+    Hang-safety details: child stdio goes to a temp FILE, not pipes —
+    after a timeout kill, ``subprocess.run`` would otherwise block
+    draining pipe FDs inherited by orphaned plugin helpers; files need no
+    drain, and the captured stderr still explains non-hang failures."""
+    if os.environ.get("K8SGPU_BENCH_SKIP_PREFLIGHT") == "1":
+        return True
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryFile() as errf:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                stdout=subprocess.DEVNULL,
+                stderr=errf,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"bench: device preflight hung >{timeout_s}s; "
+                "falling back to CPU",
+                file=sys.stderr,
+            )
+            return False
+        if r.returncode != 0:
+            errf.seek(0)
+            print(
+                "bench: device preflight failed; falling back to CPU:\n"
+                + errf.read().decode("utf-8", "replace")[-2000:],
+                file=sys.stderr,
+            )
+            return False
+    return True
+
+
+def _pin_cpu() -> None:
+    """Both pinning mechanisms: the env var covers a plain jax, the config
+    update covers this host's sitecustomize which pins the TPU plugin
+    programmatically (importing jax is safe — the observed wedge is at
+    backend init, which the 'cpu' platform setting never reaches)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache: the first bench run pays the TPU
     compile, later runs hit the cache and measure the framework, not the
@@ -348,6 +404,9 @@ def batched_decode_probe(model, params) -> dict:
 
 
 def main() -> None:
+    device_ok = _device_preflight()
+    if not device_ok:
+        _pin_cpu()  # wedged tunnel: finish on CPU instead of hanging
     _enable_compile_cache()
     import jax
 
@@ -391,6 +450,7 @@ def main() -> None:
             "reconcile_0_to_ready_v5p64_s": round(t_v5p64, 4),
             "psum_wall_s": round(psum_s, 4),
             "platform": jax.devices()[0].platform,
+            "device_preflight_ok": device_ok,
             **{k: rnd(v) for k, v in timings.items()},
             **{k: rnd(v) for k, v in decode.items()},
             "flash_kernel_4x16x2048x128": {k: rnd(v) for k, v in kern.items()},
