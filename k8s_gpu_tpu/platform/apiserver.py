@@ -33,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from ..api.schema import all_schemas, schema_for_kind
+from ..utils.obs import RequestMetricsMixin
 from .assets import AssetStore
 
 MAX_UPLOAD = 2 * 1024**3  # the reference's <2 GB web-upload limit (:703-705)
@@ -74,7 +75,15 @@ class PlatformApiServer:
         self.started_at = time.time()
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
+            metrics_server_label = "platform-api"
+            known_routes = (  # longest prefixes first
+                "/api/v1/assets/import",
+                "/api/v1/assets",
+                "/api/v1/schemas",
+                "/healthz",
+            )
+
             def _authed(self) -> bool:
                 if outer.verify_token is None:
                     return True
@@ -89,7 +98,7 @@ class PlatformApiServer:
                     return False
                 return True
 
-            def do_GET(self):  # noqa: N802 (stdlib API name)
+            def _get(self):
                 from urllib.parse import parse_qs, urlparse
 
                 u = urlparse(self.path)
@@ -139,7 +148,7 @@ class PlatformApiServer:
                         return self._json(200, vars(a))
                 return self._json(404, {"error": "not found"})
 
-            def do_POST(self):  # noqa: N802
+            def _post(self):
                 from urllib.parse import parse_qs, urlparse
 
                 if not self._authed():
@@ -231,6 +240,7 @@ class PlatformApiServer:
                 return self._json(200, {**vars(a), "source_url": url})
 
             def _json(self, code: int, payload) -> None:
+                self._last_code = code
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")

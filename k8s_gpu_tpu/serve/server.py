@@ -22,6 +22,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..data.tokenizer import BpeTokenizer
+from ..utils.obs import RequestMetricsMixin
 from .batcher import ContinuousBatcher
 
 
@@ -47,8 +48,11 @@ class LmServer:
         self.cap = max_new_tokens_cap
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (stdlib API name)
+        class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
+            metrics_server_label = "lm-server"
+            known_routes = ("/generate", "/tokenize", "/healthz", "/readyz")
+
+            def _get(self):
                 if self.path == "/healthz":
                     self._json(200, {"ok": True,
                                      "uptime_s": time.time() - outer.started_at})
@@ -57,7 +61,7 @@ class LmServer:
                 else:
                     self._json(404, {"error": "not found"})
 
-            def do_POST(self):  # noqa: N802
+            def _post(self):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
@@ -123,6 +127,7 @@ class LmServer:
                 as the batcher produces it, then a summary event.  No
                 Content-Length — the connection closes when done (HTTP/1.0
                 framing, matching the stdlib default)."""
+                self._last_code = 200
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("X-Accel-Buffering", "no")
@@ -154,6 +159,7 @@ class LmServer:
                 self.wfile.flush()
 
             def _json(self, code: int, payload: dict) -> None:
+                self._last_code = code
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")

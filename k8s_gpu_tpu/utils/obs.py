@@ -83,3 +83,50 @@ class MetricsServer:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=2)
+
+
+class RequestMetricsMixin:
+    """Request instrumentation for stdlib ``BaseHTTPRequestHandler``s
+    (C32): counts by route/method/code + latency histograms into the
+    shared registry.  Subclasses set ``metrics_server_label`` and
+    ``known_routes`` (longest-prefix matched; anything else collapses to
+    the fixed label "other" — an attacker scanning paths must not be able
+    to mint unbounded metric series in the never-evicting registry), then
+    implement ``_get``/``_post`` and set ``self._last_code`` when
+    responding.
+
+    Metrics are recorded in a ``finally`` AFTER the response bytes go out
+    (the latency must include the write) — scrapers may observe a served
+    response a beat before its counter lands."""
+
+    metrics_server_label = "http"
+    known_routes: tuple[str, ...] = ()
+
+    def _route(self) -> str:
+        path = self.path.split("?")[0]
+        for r in self.known_routes:  # declare longest prefixes first
+            if path == r or path.startswith(r.rstrip("/") + "/"):
+                return r
+        return "other"
+
+    def _timed(self, method: str, impl) -> None:
+        self._last_code = 0
+        route = self._route()
+        t0 = time.time()
+        try:
+            impl()
+        finally:
+            global_metrics.inc(
+                "http_requests_total", server=self.metrics_server_label,
+                method=method, route=route, code=str(self._last_code),
+            )
+            global_metrics.observe(
+                "http_request_seconds", time.time() - t0,
+                server=self.metrics_server_label, route=route,
+            )
+
+    def do_GET(self):  # noqa: N802 (stdlib API name)
+        self._timed("GET", self._get)
+
+    def do_POST(self):  # noqa: N802
+        self._timed("POST", self._post)

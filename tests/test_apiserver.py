@@ -146,3 +146,33 @@ def test_bearer_auth_when_verifier_set(tmp_path):
         assert code == 200
     finally:
         srv.stop()
+
+
+def test_request_metrics_recorded(server):
+    """C32: every request lands in the shared metrics registry with
+    route/method/code labels + a latency histogram.  Counters land in a
+    finally AFTER the response is written, so poll briefly."""
+    import time
+
+    from k8s_gpu_tpu.utils.metrics import global_metrics
+
+    _req(server, "GET", "/api/v1/schemas")
+    _req(server, "POST", "/api/v1/assets/import", body=b"not json")
+    _req(server, "GET", "/totally/unknown/deep/path")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        rendered = global_metrics.render()
+        if (
+            'route="/api/v1/schemas"' in rendered
+            and 'route="/api/v1/assets/import"' in rendered
+            and 'code="400"' in rendered
+            and 'route="other"' in rendered  # unknown paths collapse
+        ):
+            break
+        time.sleep(0.02)
+    assert 'http_requests_total{' in rendered
+    assert 'route="/api/v1/schemas"' in rendered
+    assert 'code="400"' in rendered
+    assert 'route="other"' in rendered
+    assert "/totally" not in rendered, "raw paths must not become labels"
+    assert "http_request_seconds" in rendered

@@ -183,3 +183,26 @@ def test_apply_get_delete_manifest(tmp_path, capsys):
     assert code == 0
     code, out, err = run(capsys, "get", "TpuPodSlice", "demo")
     assert code == 1 and "not found" in err
+
+
+def test_apply_provisions_class_pvc(tmp_path, capsys):
+    """Integration: the assembled local platform dynamically provisions a
+    class-bearing PVC applied as a manifest — provisioner registered,
+    pools exist, usage resynced (C13 through the CLI front door)."""
+    run(capsys, "login", "--user", "ada", "--space", "ml")
+    manifest = tmp_path / "pvc.yaml"
+    manifest.write_text(
+        "apiVersion: v1\n"
+        "kind: PersistentVolumeClaim\n"
+        "metadata: {name: corpus}\n"
+        "capacity: 50Gi\n"
+        "storageClass: ceph-fs\n"
+        "accessModes: [ReadWriteMany]\n"
+        "phase: Pending\n"
+    )
+    code, out, err = run(capsys, "apply", "-f", str(manifest), "--validate")
+    assert code == 0, err
+    code, out, _ = run(capsys, "get", "PersistentVolumeClaim", "corpus")
+    assert "Bound" in out and "pv-ml-corpus" in out
+    code, out, _ = run(capsys, "get", "PersistentVolume", "pv-ml-corpus")
+    assert "Bound" in out and "ceph" in out
