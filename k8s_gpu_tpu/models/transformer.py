@@ -30,6 +30,27 @@ from ..parallel.ring_attention import plain_causal_attention, ring_attention
 from ..parallel.sharding import ParamRules
 
 
+def wt(w, dt):
+    """Read a weight leaf at compute dtype.
+
+    A leaf is either a plain array or the int8 serving form
+    ``{"q": int8, "s": f32 scale}`` (serve/quant.py).  Dequant happens
+    here, inside the traced computation, so XLA fuses the scale multiply
+    into the consuming matmul and streams 1 byte/weight from HBM.
+    """
+    if isinstance(w, dict):
+        return w["q"].astype(dt) * w["s"].astype(dt)
+    return w.astype(dt)
+
+
+def emb_lookup(w, tokens, dt):
+    """Embedding gather for plain or int8-quantized tables — gather the
+    int8 rows first, then scale by the gathered per-row scales."""
+    if isinstance(w, dict):
+        return w["q"][tokens].astype(dt) * w["s"][tokens].astype(dt)
+    return w.astype(dt)[tokens]
+
+
 @dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -166,9 +187,9 @@ class TransformerLM:
     def _attention(self, x, lp, positions, mesh, seq_sharded):
         cfg = self.cfg
         dt = cfg.dtype
-        q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"].astype(dt))
-        k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"].astype(dt))
-        v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"].astype(dt))
+        q = jnp.einsum("bsd,dhk->bshk", x, wt(lp["wq"], dt))
+        k = jnp.einsum("bsd,dhk->bshk", x, wt(lp["wk"], dt))
+        v = jnp.einsum("bsd,dhk->bshk", x, wt(lp["wv"], dt))
         q = self._rope(q, positions)
         k = self._rope(k, positions)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
@@ -203,13 +224,13 @@ class TransformerLM:
         else:
             o = plain_causal_attention(q, k, v)
         o = o.transpose(0, 2, 1, 3)  # [B,S,H,Dh]
-        return jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
+        return jnp.einsum("bshk,hkd->bsd", o, wt(lp["wo"], dt))
 
     def _dense_mlp(self, x, lp):
         dt = self.cfg.dtype
-        g = jnp.einsum("bsd,df->bsf", x, lp["wi_gate"].astype(dt))
-        u = jnp.einsum("bsd,df->bsf", x, lp["wi_up"].astype(dt))
-        return jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u, lp["wo_mlp"].astype(dt))
+        g = jnp.einsum("bsd,df->bsf", x, wt(lp["wi_gate"], dt))
+        u = jnp.einsum("bsd,df->bsf", x, wt(lp["wi_up"], dt))
+        return jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u, wt(lp["wo_mlp"], dt))
 
     def _moe_mlp(self, x, lp, full_capacity=False, token_mask=None):
         """Switch-style top-1 MoE with capacity; dense dispatch einsums keep
@@ -251,9 +272,9 @@ class TransformerLM:
         )                                                        # [G,E,C]
         expert_in = jnp.einsum("gec,gd->ecd", dispatch, xt.astype(jnp.float32))
         expert_in = expert_in.astype(dt)
-        g = jnp.einsum("ecd,edf->ecf", expert_in, lp["e_wi_gate"].astype(dt))
-        u = jnp.einsum("ecd,edf->ecf", expert_in, lp["e_wi_up"].astype(dt))
-        out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, lp["e_wo"].astype(dt))
+        g = jnp.einsum("ecd,edf->ecf", expert_in, wt(lp["e_wi_gate"], dt))
+        u = jnp.einsum("ecd,edf->ecf", expert_in, wt(lp["e_wi_up"], dt))
+        out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, wt(lp["e_wo"], dt))
         combine = dispatch * gate[:, None, None]
         y = jnp.einsum("gec,ecd->gd", combine.astype(jnp.float32),
                        out.astype(jnp.float32))
@@ -282,7 +303,7 @@ class TransformerLM:
         seq_sharded = mesh is not None and mesh.shape.get("sp", 1) > 1
         B, S = tokens.shape
         positions = jnp.arange(S)
-        x = params["embed"].astype(dt)[tokens]
+        x = emb_lookup(params["embed"], tokens, dt)
 
         block = partial(
             self._scan_block, positions=positions, mesh=mesh,
@@ -292,7 +313,7 @@ class TransformerLM:
             block = jax.checkpoint(block)
         (x, aux), _ = jax.lax.scan(block, (x, jnp.float32(0)), params["blocks"])
         x = self._rmsnorm(x, params["final_norm"])
-        logits = jnp.einsum("bsd,dv->bsv", x, params["head"].astype(dt))
+        logits = jnp.einsum("bsd,dv->bsv", x, wt(params["head"], dt))
         return logits.astype(jnp.float32), aux / cfg.n_layers
 
     def _scan_block(self, carry, lp, *, positions, mesh, seq_sharded):

@@ -18,6 +18,14 @@ on the same stdlib-HTTP shape as serve/server.py and utils/obs.py:
   GET  /api/v1/schemas[/{kind}]                      → CRD schemas
   GET  /healthz
 
+With a ``kube`` handle attached, the server is also the platform's web
+console — the component the reference names GoHai-ui but never builds
+(GPU调度平台搭建.md:889, 853-865):
+
+  GET  /                                             → HTML dashboard
+  GET  /api/v1/ui/overview       → per-kind counts + status digests
+  GET  /api/v1/objects?kind=K[&namespace=ns]         → full manifests
+
 Remote fetchers build the exact public URLs but the byte transport is
 injectable (``url_fetch``) — the zero-egress test seam, same pattern as
 cloud/cloudtpu.py's Transport.  Auth: pass ``verify_token`` (the OIDC
@@ -33,10 +41,92 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from ..api.schema import all_schemas, schema_for_kind
+from ..api.serialize import known_kinds, to_manifest
 from ..utils.obs import RequestMetricsMixin
 from .assets import AssetStore
 
 MAX_UPLOAD = 2 * 1024**3  # the reference's <2 GB web-upload limit (:703-705)
+
+# The whole console is one self-contained page: no build step, no asset
+# pipeline, no external fetches (zero-egress environments included) —
+# it talks only to this server's own JSON routes and re-polls every 5 s.
+_CONSOLE_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>TPU Platform Console</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa;color:#222}
+ h1{font-size:1.3rem} h2{font-size:1rem;margin:1.2rem 0 .4rem}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:.35rem .6rem;text-align:left;font-size:.85rem}
+ th{background:#f0f0f0} .count{color:#666;font-weight:normal}
+ #err{color:#b00020}
+</style></head><body>
+<h1>TPU Platform Console</h1>
+<div>token: <input id="tok" size="30" placeholder="(none needed)"> </div>
+<div id="err"></div><div id="root">loading…</div>
+<script>
+// All untrusted strings (names, namespaces, status values, status KEYS)
+// go through DOM text nodes, never innerHTML — object metadata is
+// user-controlled and must not become markup (stored-XSS hardening).
+const tokEl = document.getElementById('tok');
+tokEl.value = sessionStorage.getItem('tok') || '';
+tokEl.addEventListener('change', () => {
+  sessionStorage.setItem('tok', tokEl.value); refresh();
+});
+function cell(tag, text){
+  const el = document.createElement(tag);
+  el.textContent = text; return el;
+}
+async function refresh(){
+  try{
+    const hdrs = tokEl.value ? {Authorization: 'Bearer '+tokEl.value} : {};
+    const r = await fetch('/api/v1/ui/overview', {headers: hdrs});
+    if(!r.ok){throw new Error('overview: HTTP '+r.status)}
+    const data = await r.json();
+    const root = document.getElementById('root'); root.innerHTML='';
+    for(const sec of data.kinds){
+      if(!sec.count) continue;
+      const h = document.createElement('h2');
+      h.appendChild(document.createTextNode(sec.kind+' '));
+      const n = cell('span', '('+sec.count+')'); n.className='count';
+      h.appendChild(n); root.appendChild(h);
+      const cols = Object.keys(Object.assign({namespace:1,name:1},...sec.objects.map(o=>o.summary)));
+      const t = document.createElement('table');
+      const head = document.createElement('tr');
+      cols.forEach(c => head.appendChild(cell('th', c)));
+      t.appendChild(head);
+      for(const o of sec.objects){
+        const row = Object.assign({namespace:o.namespace,name:o.name}, o.summary);
+        const tr = document.createElement('tr');
+        cols.forEach(c => tr.appendChild(cell('td', String(row[c]??''))));
+        t.appendChild(tr);
+      }
+      root.appendChild(t);
+    }
+    if(!root.childElementCount) root.textContent='no objects yet';
+    document.getElementById('err').textContent='';
+  }catch(e){document.getElementById('err').textContent=String(e)}
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+
+def _status_summary(man: dict) -> dict:
+    """Compact per-object digest for the console table — generic over
+    kinds: scalar status fields + desired replicas + the newest True
+    condition."""
+    out = {}
+    spec = man.get("spec") or {}
+    st = man.get("status") or {}
+    if "replicas" in spec:
+        out["desired"] = spec["replicas"]
+    for k, v in st.items():
+        if isinstance(v, (str, int, float, bool)):
+            out[k] = v
+    conds = st.get("conditions") or []
+    true_conds = [c.get("type") for c in conds if c.get("status") in (True, "True")]
+    if true_conds:
+        out["conditions"] = ",".join(true_conds)
+    return out
 
 
 def default_url_fetch(url: str) -> bytes:
@@ -67,11 +157,15 @@ class PlatformApiServer:
         url_fetch: Callable[[str], bytes] | None = None,
         verify_token: Callable[[str], object] | None = None,
         max_upload: int = MAX_UPLOAD,
+        kube=None,
     ):
+        """``kube``: a controller.kubefake.FakeKube — attaching one turns
+        on the web-console routes (dashboard + object browser)."""
         self.assets = assets
         self.url_fetch = url_fetch or default_url_fetch
         self.verify_token = verify_token
         self.max_upload = max_upload
+        self.kube = kube
         self.started_at = time.time()
         outer = self
 
@@ -81,7 +175,11 @@ class PlatformApiServer:
                 "/api/v1/assets/import",
                 "/api/v1/assets",
                 "/api/v1/schemas",
+                "/api/v1/ui/overview",
+                "/api/v1/objects",
                 "/healthz",
+                "/ui",
+                "/",
             )
 
             def _authed(self) -> bool:
@@ -106,8 +204,51 @@ class PlatformApiServer:
                     return self._json(200, {
                         "ok": True, "uptime_s": time.time() - outer.started_at,
                     })
+                if u.path in ("/", "/ui") and outer.kube is not None:
+                    body = _CONSOLE_HTML.encode()
+                    self._last_code = 200
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if not self._authed():
                     return
+                if u.path == "/api/v1/ui/overview":
+                    if outer.kube is None:
+                        return self._json(404, {"error": "no cluster attached"})
+                    kinds = []
+                    for kind in known_kinds():
+                        objs = outer.kube.list(kind)
+                        kinds.append({
+                            "kind": kind,
+                            "count": len(objs),
+                            "objects": [
+                                {
+                                    "namespace": o.metadata.namespace,
+                                    "name": o.metadata.name,
+                                    "summary": _status_summary(to_manifest(o)),
+                                }
+                                for o in objs[:50]
+                            ],
+                        })
+                    return self._json(200, {"kinds": kinds})
+                if u.path == "/api/v1/objects":
+                    if outer.kube is None:
+                        return self._json(404, {"error": "no cluster attached"})
+                    q = parse_qs(u.query)
+                    kind = (q.get("kind") or [""])[0]
+                    if kind not in known_kinds():
+                        return self._json(400, {
+                            "error": f"kind required; known: {known_kinds()}"
+                        })
+                    ns = (q.get("namespace") or [None])[0]
+                    return self._json(200, {
+                        "items": [
+                            to_manifest(o) for o in outer.kube.list(kind, ns)
+                        ],
+                    })
                 if u.path == "/api/v1/schemas":
                     return self._json(200, all_schemas())
                 if u.path.startswith("/api/v1/schemas/"):
@@ -241,7 +382,9 @@ class PlatformApiServer:
 
             def _json(self, code: int, payload) -> None:
                 self._last_code = code
-                body = json.dumps(payload).encode()
+                # default=str: manifests may carry timestamps/enums the
+                # YAML codec keeps as rich objects.
+                body = json.dumps(payload, default=str).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))

@@ -1,10 +1,14 @@
-"""Serving: KV-cache inference engine, continuous batcher, LM HTTP server."""
+"""Serving: KV-cache engine, continuous batcher, speculative decoding,
+int8 weight-only quantization, LM HTTP server."""
 
 from .batcher import ContinuousBatcher, RequestHandle
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
+from .quant import quantize_params
 from .server import LmServer
+from .speculative import SpecOutput, SpeculativeDecoder
 
 __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
-    "ContinuousBatcher", "RequestHandle",
+    "ContinuousBatcher", "RequestHandle", "SpeculativeDecoder",
+    "SpecOutput", "quantize_params",
 ]

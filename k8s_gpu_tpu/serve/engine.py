@@ -28,7 +28,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.transformer import TransformerConfig, TransformerLM
+from ..models.transformer import (
+    TransformerConfig, TransformerLM, emb_lookup, wt,
+)
 
 
 @dataclass(frozen=True)
@@ -105,20 +107,25 @@ class InferenceEngine:
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bhkd->bqhd", p, v_cache)
 
-    def _block_cached(self, x, lp, cache_k, cache_v, positions, start, mask):
+    def _block_cached(self, x, lp, cache_k, cache_v, positions, start, mask,
+                      moe_full_capacity=None):
         """One transformer block over query slice x [B,Sq,D] with the K/V for
         the slice written into the layer cache at ``start``.  Returns
         (x_out, new_cache_k, new_cache_v).
 
         ``start`` is a scalar (all rows write at the same offset — prefill
         and uniform decode) or a [B] vector (each row writes at its own
-        position — continuous batching; requires Sq == 1)."""
+        position — continuous batching; requires Sq == 1).
+
+        ``moe_full_capacity``: None = full capacity only at Sq == 1 (the
+        decode default); extend_multi passes True so a W-wide verify
+        routes experts exactly like the width-1 decode it stands in for."""
         m = self.model
         dt = self.cfg.dtype
         h = m._rmsnorm(x, lp["ln1"])
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt))
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt))
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt))
+        q = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wq"], dt))
+        k = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wk"], dt))
+        v = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wv"], dt))
         q = m._rope(q, positions)
         k = m._rope(k, positions)
         k = k.transpose(0, 2, 1, 3)  # [B,H,Sq,Dh]
@@ -126,14 +133,23 @@ class InferenceEngine:
         if jnp.ndim(start) == 0:
             cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, start, 0))
             cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, start, 0))
-        else:
+        elif x.shape[1] == 1:
             # Per-row scatter: row b writes its single new K/V at start[b].
-            assert x.shape[1] == 1, "per-row cache writes require Sq == 1"
             rows = jnp.arange(x.shape[0])
             cache_k = cache_k.at[rows, :, start].set(k[:, :, 0, :])
             cache_v = cache_v.at[rows, :, start].set(v[:, :, 0, :])
+        else:
+            # Per-row window scatter: row b writes W entries at
+            # start[b]..start[b]+W-1 (the extend_multi verify path).
+            B, W = x.shape[0], x.shape[1]
+            rows = jnp.arange(B)[:, None]                       # [B, 1]
+            cols = start[:, None] + jnp.arange(W)[None]         # [B, W]
+            # Advanced indices split by the ':' slice put the [B, W] index
+            # dims first, so the update takes [B, W, H, Dh] layout.
+            cache_k = cache_k.at[rows, :, cols].set(k.transpose(0, 2, 1, 3))
+            cache_v = cache_v.at[rows, :, cols].set(v.transpose(0, 2, 1, 3))
         o = self._attend_cached(q, cache_k, cache_v, mask)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
+        x = x + jnp.einsum("bshk,hkd->bsd", o, wt(lp["wo"], dt))
         h2 = m._rmsnorm(x, lp["ln2"])
         if self.cfg.moe:
             # Full capacity only at decode (query length 1): there G = B and
@@ -142,8 +158,10 @@ class InferenceEngine:
             # same [G, E, cap] memory footprint.  Padded query rows (their
             # attention mask is all-False) are excluded from routing so they
             # can't consume expert capacity ahead of real tokens.
+            full = (x.shape[1] == 1 if moe_full_capacity is None
+                    else moe_full_capacity)
             y, _ = m._moe_mlp(
-                h2, lp, full_capacity=x.shape[1] == 1,
+                h2, lp, full_capacity=full,
                 token_mask=mask.any(-1),
             )
             x = x + y
@@ -151,10 +169,14 @@ class InferenceEngine:
             x = x + m._dense_mlp(h2, lp)
         return x, cache_k, cache_v
 
-    def _run_blocks(self, params, x, cache, positions, start, mask):
+    def _run_blocks(self, params, x, cache, positions, start, mask,
+                    moe_full_capacity=None):
         def scan_fn(carry, layer):
             lp, ck, cv = layer
-            y, ck, cv = self._block_cached(carry, lp, ck, cv, positions, start, mask)
+            y, ck, cv = self._block_cached(
+                carry, lp, ck, cv, positions, start, mask,
+                moe_full_capacity=moe_full_capacity,
+            )
             return y, (ck, cv)
 
         x, (ck, cv) = jax.lax.scan(
@@ -162,7 +184,7 @@ class InferenceEngine:
         )
         m = self.model
         x = m._rmsnorm(x, params["final_norm"])
-        logits = jnp.einsum("bsd,dv->bsv", x, params["head"].astype(self.cfg.dtype))
+        logits = jnp.einsum("bsd,dv->bsv", x, wt(params["head"], self.cfg.dtype))
         return logits.astype(jnp.float32), {"k": ck, "v": cv}
 
     # -- public jittable pieces -------------------------------------------
@@ -178,7 +200,7 @@ class InferenceEngine:
         B, S = tokens.shape
         pad_left = jnp.asarray(pad_left, jnp.int32)
         cache = self._constrain_cache(_empty_cache(self.cfg, B, self.max_seq))
-        x = params["embed"].astype(self.cfg.dtype)[tokens]
+        x = emb_lookup(params["embed"], tokens, self.cfg.dtype)
         q_idx = jnp.arange(S)
         positions = jnp.maximum(q_idx - pad_left, 0)  # RoPE positions
         t = jnp.arange(self.max_seq)
@@ -198,7 +220,7 @@ class InferenceEngine:
         the prompt was left-padded); ``kv_start`` masks cache slots below it.
         """
         B = token.shape[0]
-        x = params["embed"].astype(self.cfg.dtype)[token][:, None]  # [B,1,D]
+        x = emb_lookup(params["embed"], token, self.cfg.dtype)[:, None]  # [B,1,D]
         pos = jnp.asarray(pos, jnp.int32).reshape(())
         rope = pos if rope_pos is None else jnp.asarray(rope_pos, jnp.int32).reshape(())
         kv_start = jnp.asarray(kv_start, jnp.int32)
@@ -220,7 +242,7 @@ class InferenceEngine:
         Returns (cache, logits [B, V]).  Idle rows are the caller's business:
         their outputs are valid numbers that simply go unused."""
         B = token.shape[0]
-        x = params["embed"].astype(self.cfg.dtype)[token][:, None]  # [B,1,D]
+        x = emb_lookup(params["embed"], token, self.cfg.dtype)[:, None]  # [B,1,D]
         pos = jnp.asarray(pos, jnp.int32)
         t = jnp.arange(self.max_seq)
         mask = (
@@ -231,6 +253,43 @@ class InferenceEngine:
             mask,
         )
         return cache, logits[:, 0]
+
+    def extend_multi(self, params, cache, tokens, start, rope_start, kv_start):
+        """Multi-token cached forward where every row writes its *own*
+        window — the speculative-decoding verify kernel.
+
+        tokens [B, W]; start/rope_start/kv_start [B] int32.  Row b writes
+        K/V for its W tokens at cache positions start[b]..start[b]+W-1 and
+        each query position start[b]+j attends to cache slots
+        [kv_start[b], start[b]+j] (causal within the window, full prefix
+        before it).  Returns (cache, logits [B, W, V]): logits[:, j]
+        predicts the token after tokens[:, j].
+
+        Rollback is free: a later round that re-writes positions ≤ p and
+        masks t ≤ p never sees the stale K/V a rejected draft left behind
+        (same property decode_step relies on across requeued slots).
+        """
+        B, W = tokens.shape
+        start = jnp.asarray(start, jnp.int32)
+        q_pos = start[:, None] + jnp.arange(W, dtype=jnp.int32)[None]  # [B, W]
+        t = jnp.arange(self.max_seq)
+        mask = (
+            (t[None, None, :] <= q_pos[:, :, None])
+            & (t[None, None, :] >= jnp.asarray(kv_start, jnp.int32)[:, None, None])
+        )  # [B, W, T]
+        x = emb_lookup(params["embed"], tokens, self.cfg.dtype)  # [B, W, D]
+        rope = (
+            jnp.asarray(rope_start, jnp.int32)[:, None]
+            + jnp.arange(W, dtype=jnp.int32)[None]
+        )
+        # moe_full_capacity=True: the verify stands in for W width-1
+        # decode steps, whose routing never capacity-drops — a capped
+        # dispatch here would make verify logits diverge from the decode
+        # path and break speculative greedy-exactness for MoE targets.
+        logits, cache = self._run_blocks(
+            params, x, cache, rope, start, mask, moe_full_capacity=True
+        )
+        return cache, logits
 
     # -- sampling ----------------------------------------------------------
     @staticmethod

@@ -1,0 +1,85 @@
+"""Int8 weight-only quantization for serving.
+
+Decode is HBM-bandwidth-bound: each step re-streams every weight matrix
+from HBM for a [B, 1, D] activation.  Storing weights as int8 with
+per-output-channel float scales halves the bytes per step versus
+bfloat16; XLA fuses the ``int8 → bf16 multiply-by-scale`` dequant into
+the matmul's operand read, so the MXU still computes in bf16 and no
+full-precision copy ever materializes (the reason quantization happens
+*inside* the traced computation, not as a preprocessing pass).
+
+A quantized leaf is the pytree ``{"q": int8[...], "s": f32[broadcastable]}``
+— ``models.transformer.wt`` transparently dequantizes it wherever a
+weight is read, so the same ``InferenceEngine`` (and the pipeline-free
+training forward, if anyone wants QAT-style eval) consumes either form.
+Scales are per-output-channel: the max-abs over each weight's
+*contraction* axes, so quantization error stays relative per channel.
+
+The reference has no quantization story (it sizes VRAM for fp16 and
+mentions TensorRT only as prose, GPU选型与优化指南.md:33-50); this is
+part of the serving stack that replaces its Ollama delegation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Contraction axes per stacked weight leaf (models/transformer.py:init):
+# the scale keeps every *other* axis, so each output channel (and each
+# layer / expert along the stacked axes) gets its own scale.
+_CONTRACT_AXES = {
+    "wq": (1,),        # [L, D, H, Dh] — contract D
+    "wk": (1,),
+    "wv": (1,),
+    "wo": (1, 2),      # [L, H, Dh, D] — contract H, Dh
+    "wi_gate": (1,),   # [L, D, F]
+    "wi_up": (1,),
+    "wo_mlp": (1,),    # [L, F, D]
+    "e_wi_gate": (2,),  # [L, E, D, F]
+    "e_wi_up": (2,),
+    "e_wo": (2,),      # [L, E, F, D]
+}
+_TOP_LEVEL = {
+    "head": (0,),      # [D, V] — contract D
+    "embed": (1,),     # [V, D] — per-row scale (gather, not matmul)
+}
+
+
+def _quantize_leaf(w, axes):
+    s = jnp.max(jnp.abs(w), axis=axes, keepdims=True) / 127.0
+    s = jnp.where(s == 0, 1.0, s).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def quantize_params(params: dict, *, quantize_embed: bool = True) -> dict:
+    """Return a serving param tree with matmul weights as int8+scale.
+
+    Norm gains (`ln1`, `ln2`, `final_norm`) and the MoE router (`gate`)
+    stay float — they are tiny and precision-sensitive.  Leaves the
+    input tree untouched.
+    """
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name, axes in _CONTRACT_AXES.items():
+        if name in blocks:
+            blocks[name] = _quantize_leaf(blocks[name], axes)
+    out["blocks"] = blocks
+    for name, axes in _TOP_LEVEL.items():
+        if name == "embed" and not quantize_embed:
+            continue
+        out[name] = _quantize_leaf(params[name], axes)
+    return out
+
+
+def quantized_bytes(params: dict) -> tuple[int, int]:
+    """(quantized_total, float_equivalent) parameter bytes — the HBM
+    traffic ratio a decode step sees."""
+    import jax
+
+    qb = fb = 0
+    for leaf in jax.tree.leaves(params):
+        qb += leaf.size * leaf.dtype.itemsize
+    for leaf in jax.tree.leaves(params):
+        fb += leaf.size * 2 if leaf.dtype == jnp.int8 else leaf.size * leaf.dtype.itemsize
+    return qb, fb

@@ -1,0 +1,270 @@
+"""Speculative decoding — draft-model lookahead, target-model verify.
+
+The reference delegates all inference to Ollama (智能风控解决方案.md:196,
+250-266) and has no speculative path; this is the TPU-native serving
+accelerator the platform hosts instead.  Design:
+
+- **One verify launch per round.**  A small draft model proposes K tokens
+  autoregressively (K cheap decode steps), then the target model scores
+  the whole window in a single ``extend_multi`` forward (query length
+  K+1 against the KV cache).  Decode latency per emitted token drops
+  from one target launch to ``1/(a+1)`` launches, where ``a`` is the
+  number of accepted drafts.
+- **Static shapes, per-row state.**  Every round is one jitted program:
+  the window width is the static ``K+1``; acceptance length, sequence
+  position, and EOS state are per-row *data* (masks and gathers), never
+  shapes — rows with different acceptance histories share the trace.
+- **Rollback is free.**  Rejected drafts leave stale K/V in the cache at
+  positions beyond the accepted prefix; the position masks in
+  ``InferenceEngine`` never attend past a row's current length, and the
+  next round's window overwrites those slots (engine.py:extend_multi).
+- **Greedy exactness.**  With temperature 0 the emitted stream is
+  *bit-identical* to ``InferenceEngine.generate`` on the target alone —
+  the draft only changes how fast tokens appear, never which tokens.
+  (tests/test_speculative.py asserts token-for-token parity.)
+  Precision caveat: this holds when matmul results don't depend on
+  program shape — true on CPU and on TPU with
+  ``jax.default_matmul_precision('highest')``.  At TPU DEFAULT
+  precision, f32 einsums take bf16 MXU passes whose rounding differs
+  between the width-(k+1) verify and the width-1 decode, so a
+  near-tie argmax (top-2 logit gap inside bf16 noise, ~1e-3 relative)
+  may resolve differently — the output is then still a valid greedy
+  stream of the target model under an equivalent-precision program,
+  the standard contract for speculative serving.
+
+Draft-cache bookkeeping: the draft stays one position behind the target
+(invariant: draft cache valid through ``P-2``), carrying the pair
+``(prev, cur)`` of the last two stream tokens.  Each round re-ingests
+``prev`` at ``P-1`` (an idempotent overwrite) before drafting from
+``cur`` — this makes the a == K "all accepted" case, where the draft
+never saw the bonus token, uniform with every other acceptance length.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .engine import InferenceEngine, SamplingConfig
+
+
+@dataclass
+class SpecOutput:
+    tokens: jnp.ndarray    # [B, max_new] generated ids (pad after EOS/budget)
+    lengths: jnp.ndarray   # [B] valid token count per row
+    rounds: int            # verify rounds run
+    accepted: jnp.ndarray  # [B] total drafts accepted (diagnostics)
+
+
+@dataclass
+class SpecStats:
+    """Running acceptance telemetry across calls (host-side)."""
+    rounds: int = 0
+    drafted: int = 0
+    accepted: int = 0
+    emitted: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+
+class SpeculativeDecoder:
+    """Greedy speculative decoding over two InferenceEngines.
+
+    ``target`` and ``draft`` must share vocab and tokenizer; the draft is
+    typically 4-10x smaller (fewer layers / narrower).  ``k`` is the
+    speculation depth — each round costs K draft steps + 1 target verify
+    and emits between 1 and K+1 tokens.
+    """
+
+    def __init__(self, target: InferenceEngine, draft: InferenceEngine,
+                 k: int = 4):
+        if target.cfg.vocab_size != draft.cfg.vocab_size:
+            raise ValueError("target and draft must share a vocabulary")
+        if k < 1:
+            raise ValueError("speculation depth k must be >= 1")
+        self.target = target
+        self.draft = draft
+        self.k = k
+        self.stats = SpecStats()
+        self._loop_jit = jax.jit(
+            self._decode_loop, static_argnames=("max_new", "eos_id", "pad_id")
+        )
+        self._prefill_t = jax.jit(self.target.prefill)
+        self._prefill_d = jax.jit(self.draft.prefill)
+
+    # -- one speculation round (jitted; all state per-row) -----------------
+    def _round(self, tparams, dparams, state, pad_left, *, max_new: int,
+               eos_id: int, pad_id: int):
+        K = self.k
+        (t_cache, d_cache, prev, cur, pos, done, emitted, out, acc_total,
+         drafted) = state
+        B = cur.shape[0]
+        kv_start = jnp.broadcast_to(jnp.asarray(pad_left, jnp.int32), (B,))
+        frozen = done | (emitted >= max_new)
+
+        # 1. Draft: re-ingest prev at pos-1, then K greedy lookahead steps.
+        #    Frozen rows park their writes at their current pos (idempotent
+        #    overwrites) so they can never run past max_seq while other
+        #    rows finish.
+        step = jnp.where(frozen, 0, 1)
+        d_cache, _ = self.draft.decode_step_multi(
+            dparams, d_cache, prev, pos - step, pos - step - pad_left, kv_start
+        )
+        tok = cur
+        drafts = []
+        for i in range(K):
+            off = jnp.where(frozen, 0, i)
+            d_cache, dlogits = self.draft.decode_step_multi(
+                dparams, d_cache, tok, pos + off, pos + off - pad_left, kv_start
+            )
+            tok = jnp.argmax(dlogits, axis=-1).astype(cur.dtype)
+            drafts.append(tok)
+        g = jnp.stack(drafts, axis=1)  # [B, K]
+
+        # 2. Verify: one target forward over [cur, g_0..g_{K-1}] (W = K+1).
+        window = jnp.concatenate([cur[:, None], g], axis=1)
+        vstart = jnp.where(frozen, pos - K - 1, pos)
+        vstart = jnp.maximum(vstart, kv_start)  # frozen rows: safe rewrite
+        t_cache, vlogits = self.target.extend_multi(
+            tparams, t_cache, window, vstart, vstart - pad_left, kv_start
+        )
+        t_pred = jnp.argmax(vlogits, axis=-1).astype(cur.dtype)  # [B, K+1]
+
+        # 3. Accept the longest matching prefix; emit drafts + correction.
+        match = (g == t_pred[:, :K]).astype(jnp.int32)            # [B, K]
+        a = jnp.cumprod(match, axis=1).sum(axis=1)                # [B] 0..K
+        idx = jnp.arange(K + 1, dtype=jnp.int32)[None]            # [1, K+1]
+        base = jnp.concatenate([g, g[:, -1:]], axis=1)
+        e = jnp.where(idx < a[:, None], base, t_pred)             # [B, K+1]
+
+        is_eos = e == eos_id
+        eos_cum = jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+        valid = (
+            (idx <= a[:, None])
+            & (eos_cum - is_eos.astype(jnp.int32) == 0) & ~is_eos
+            & ~frozen[:, None]
+            & ((emitted[:, None] + idx) < max_new)
+        )
+        hit_eos = (is_eos & (idx <= a[:, None]) & ~frozen[:, None]).any(axis=1)
+
+        # 4. Scatter emissions into the output buffer (invalid slots route
+        #    to index max_new, which JAX scatter drops as out-of-bounds).
+        wpos = jnp.where(valid, emitted[:, None] + idx, max_new)
+        rows = jnp.arange(B)[:, None]
+        out = out.at[rows, wpos].set(jnp.where(valid, e, pad_id),
+                                     mode="drop")
+
+        # 5. Advance: prev/cur slide to the accepted frontier.
+        advance = jnp.where(frozen, 0, a + 1)
+        new_prev = jnp.where(
+            frozen, prev, jnp.take_along_axis(window, a[:, None], 1)[:, 0]
+        )
+        new_cur = jnp.where(
+            frozen, cur, jnp.take_along_axis(t_pred, a[:, None], 1)[:, 0]
+        )
+        n_valid = valid.sum(axis=1, dtype=jnp.int32)
+        new_state = (
+            t_cache, d_cache, new_prev, new_cur, pos + advance,
+            done | hit_eos, emitted + n_valid, out,
+            acc_total + jnp.where(frozen, 0, a),
+            # Frozen rows draft nothing real — count only live rows, so
+            # acceptance_rate = accepted/drafted stays meaningful when
+            # batch rows finish at different times.
+            drafted + jnp.where(frozen, 0, K),
+        )
+        return new_state, jnp.where(frozen, 0, a)
+
+    def _decode_loop(self, tparams, dparams, state, pad_left, *,
+                     max_new: int, eos_id: int, pad_id: int):
+        """All speculation rounds as ONE on-device ``lax.while_loop``.
+
+        The whole generate is a single dispatch after prefill — on a
+        tunneled TPU the host↔device round trip costs tens of ms, so a
+        per-round host check (sync + relaunch) would dominate the very
+        latency speculation exists to cut.  Termination state (done,
+        emitted) lives on device; the host fetches once at the end.
+        """
+
+        def live(s):
+            done, emitted = s[5], s[6]
+            return ~(done | (emitted >= max_new)).all()
+
+        def cond(carry):
+            s, rounds = carry
+            return live(s) & (rounds < max_new)
+
+        def body(carry):
+            s, rounds = carry
+            s, _ = self._round(
+                tparams, dparams, s, pad_left,
+                max_new=max_new, eos_id=eos_id, pad_id=pad_id,
+            )
+            return s, rounds + 1
+
+        state, rounds = jax.lax.while_loop(
+            cond, body, (state, jnp.int32(0))
+        )
+        return state, rounds
+
+    # -- public API --------------------------------------------------------
+    def generate(self, tparams, dparams, prompt, *, max_new_tokens: int = 32,
+                 sampling: SamplingConfig = SamplingConfig(),
+                 pad_left: int = 0) -> SpecOutput:
+        """prompt [B, S] int32 → SpecOutput; greedy only (temperature 0).
+
+        Requires ``S + max_new_tokens + k + 1 <= target.max_seq`` (the last
+        verify window may overshoot the budget by up to k positions).
+        """
+        if sampling.temperature > 0:
+            raise NotImplementedError(
+                "speculative decoding is greedy-exact; sampled speculation "
+                "needs rejection resampling (future work)"
+            )
+        B, S = prompt.shape
+        K = self.k
+        # Both caches must hold the full stream + lookahead: a shorter
+        # draft cache would silently drop out-of-bounds K/V writes (JAX
+        # scatter semantics) and degrade acceptance to ~0 with no error.
+        limit = min(self.target.max_seq, self.draft.max_seq)
+        if S + max_new_tokens + K + 1 > limit:
+            raise ValueError(
+                f"prompt {S} + max_new {max_new_tokens} + lookahead {K + 1} "
+                f"exceeds max_seq {limit} "
+                f"(target {self.target.max_seq}, draft {self.draft.max_seq})"
+            )
+        pad = jnp.asarray(pad_left, jnp.int32)
+        t_cache, t_logits = self._prefill_t(tparams, prompt, pad)
+        d_cache, _ = self._prefill_d(dparams, prompt, pad)
+
+        cur = jnp.argmax(t_logits, axis=-1).astype(prompt.dtype)
+        done = cur == sampling.eos_id
+        out = jnp.full((B, max_new_tokens), sampling.pad_id, prompt.dtype)
+        out = out.at[:, 0].set(jnp.where(done, sampling.pad_id, cur))
+        emitted = (~done).astype(jnp.int32)
+        prev = prompt[:, -1]
+        pos = jnp.full((B,), S, jnp.int32)
+        acc = jnp.zeros((B,), jnp.int32)
+        drafted = jnp.zeros((B,), jnp.int32)
+
+        state = (t_cache, d_cache, prev, cur, pos, done, emitted, out, acc,
+                 drafted)
+        state, rounds_dev = self._loop_jit(
+            tparams, dparams, state, pad,
+            max_new=max_new_tokens, eos_id=sampling.eos_id,
+            pad_id=sampling.pad_id,
+        )
+        rounds = int(jax.device_get(rounds_dev))
+        lengths = state[6]
+        accepted = state[8]
+        self.stats.rounds += rounds
+        self.stats.drafted += int(jax.device_get(state[9]).sum())
+        self.stats.accepted += int(jax.device_get(accepted).sum())
+        self.stats.emitted += int(jax.device_get(lengths).sum())
+        return SpecOutput(
+            tokens=state[7], lengths=lengths, rounds=rounds,
+            accepted=accepted,
+        )

@@ -105,7 +105,11 @@ class RequestMetricsMixin:
     def _route(self) -> str:
         path = self.path.split("?")[0]
         for r in self.known_routes:  # declare longest prefixes first
-            if path == r or path.startswith(r.rstrip("/") + "/"):
+            if path == r:
+                return r
+            # "/" is exact-only: as a prefix it would swallow every path
+            # and defeat the "other" collapse.
+            if r != "/" and path.startswith(r.rstrip("/") + "/"):
                 return r
         return "other"
 

@@ -176,3 +176,93 @@ def test_request_metrics_recorded(server):
     assert 'route="other"' in rendered
     assert "/totally" not in rendered, "raw paths must not become labels"
     assert "http_request_seconds" in rendered
+
+
+# -- web console (the GoHai-ui analogue, GPU调度平台搭建.md:889) ----------
+
+@pytest.fixture()
+def console(tmp_path):
+    from k8s_gpu_tpu.api.tpupodslice import TpuPodSlice
+    from k8s_gpu_tpu.api.types import ObjectMeta
+    from k8s_gpu_tpu.controller.kubefake import FakeKube
+
+    kube = FakeKube()
+    ps = TpuPodSlice(metadata=ObjectMeta(name="pool-a", namespace="ml"))
+    ps.spec.accelerator_type = "v5p-8"
+    kube.create(ps)
+    got = kube.get("TpuPodSlice", "pool-a", "ml")
+    got.status.phase = "Ready"
+    got.status.ready_replicas = 1
+    kube.update_status(got)
+    srv = PlatformApiServer(AssetStore(tmp_path / "a"), kube=kube).start()
+    yield srv
+    srv.stop()
+
+
+def test_console_dashboard_page(console):
+    req = urllib.request.Request(f"http://127.0.0.1:{console.port}/")
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+        assert "text/html" in r.headers["Content-Type"]
+        page = r.read().decode()
+    assert "TPU Platform Console" in page
+    assert "/api/v1/ui/overview" in page  # the page drives the JSON API
+
+
+def test_console_overview_digest(console):
+    code, data = _req(console, "GET", "/api/v1/ui/overview")
+    assert code == 200
+    by_kind = {k["kind"]: k for k in data["kinds"]}
+    sec = by_kind["TpuPodSlice"]
+    assert sec["count"] == 1
+    obj = sec["objects"][0]
+    assert obj["name"] == "pool-a" and obj["namespace"] == "ml"
+    assert obj["summary"]["phase"] == "Ready"
+    assert obj["summary"]["readyReplicas"] == 1
+
+
+def test_console_object_browser(console):
+    code, data = _req(console, "GET", "/api/v1/objects?kind=TpuPodSlice")
+    assert code == 200 and len(data["items"]) == 1
+    man = data["items"][0]
+    assert man["spec"]["acceleratorType"] == "v5p-8"
+    code, err = _req(console, "GET", "/api/v1/objects?kind=Bogus")
+    assert code == 400
+
+
+def test_console_absent_without_kube(server):
+    req = urllib.request.Request(f"http://127.0.0.1:{server.port}/")
+    try:
+        with urllib.request.urlopen(req) as r:
+            assert False, "should 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    code, err = _req(server, "GET", "/api/v1/ui/overview")
+    assert code == 404
+
+
+def test_console_page_public_but_data_authed(tmp_path):
+    """With auth on, the static page still serves (it holds no data and
+    carries a token box), while the overview JSON requires a Bearer."""
+    from k8s_gpu_tpu.controller.kubefake import FakeKube
+
+    def verify(token):
+        if token != "good":
+            raise ValueError("bad token")
+
+    srv = PlatformApiServer(
+        AssetStore(tmp_path / "a"), kube=FakeKube(), verify_token=verify,
+    ).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/ui"
+        ) as r:
+            page = r.read().decode()
+        assert "Authorization" in page  # the page can attach a token
+        code, _ = _req(srv, "GET", "/api/v1/ui/overview")
+        assert code == 401
+        code, data = _req(srv, "GET", "/api/v1/ui/overview",
+                          headers={"Authorization": "Bearer good"})
+        assert code == 200 and "kinds" in data
+    finally:
+        srv.stop()

@@ -1,0 +1,110 @@
+"""Int8 weight-only quantization: error bounds, engine parity, bytes.
+
+The contract is *relative* fidelity, not bit-exactness: per-channel
+scales bound the round-trip error of every weight element by s/2, and
+greedy decode over a trained-scale random model should agree with bf16
+on the large majority of steps (argmax flips at near-ties are expected
+and correct behavior for a quantized model).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.models.transformer import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.serve.engine import InferenceEngine
+from k8s_gpu_tpu.serve.quant import quantize_params, quantized_bytes
+
+
+def _make(moe=False, seed=0):
+    cfg = TransformerConfig(
+        vocab_size=96, d_model=32, n_layers=2, n_heads=2, d_head=16,
+        d_ff=64, max_seq=64, dtype=jnp.float32, use_flash=False,
+        remat=False, num_experts=4 if moe else 0,
+    )
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.PRNGKey(seed))
+
+
+def test_roundtrip_error_bound():
+    """|dequant - w| <= s/2 elementwise for every quantized leaf."""
+    _, params = _make()
+    qp = quantize_params(params)
+    for name in ("wq", "wo", "wi_gate", "wo_mlp"):
+        w = params["blocks"][name]
+        leaf = qp["blocks"][name]
+        deq = leaf["q"].astype(jnp.float32) * leaf["s"]
+        err = jnp.abs(deq - w)
+        assert bool((err <= leaf["s"] / 2 + 1e-7).all()), name
+    assert qp["blocks"]["wq"]["q"].dtype == jnp.int8
+
+
+def test_moe_experts_quantized():
+    _, params = _make(moe=True)
+    qp = quantize_params(params)
+    for name in ("e_wi_gate", "e_wi_up", "e_wo"):
+        assert qp["blocks"][name]["q"].dtype == jnp.int8
+    # router stays float — top-1 dispatch is precision-sensitive
+    assert not isinstance(qp["blocks"]["gate"], dict)
+    assert not isinstance(qp["blocks"]["ln1"], dict)
+
+
+def test_logits_close_to_float():
+    """Prompt logits from quantized weights track the float model."""
+    model, params = _make()
+    eng = InferenceEngine(model)
+    qp = quantize_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 10), 1, 90)
+    _, ref = jax.jit(eng.prefill)(params, prompt)
+    _, got = jax.jit(eng.prefill)(qp, prompt)
+    denom = jnp.abs(ref).mean()
+    assert float(jnp.abs(got - ref).mean() / denom) < 0.12
+
+
+@pytest.mark.parametrize("moe", [False, True])
+def test_teacher_forced_next_token_agreement(moe):
+    """>=90% next-token argmax agreement under teacher forcing.
+
+    (Free-running streams are the wrong metric: one near-tie flip makes
+    every later position differ by construction.  Teacher forcing scores
+    each position independently against the same prefix.)"""
+    model, params = _make(moe=moe)
+    qp = quantize_params(params)
+    toks = jax.random.randint(jax.random.PRNGKey(5), (4, 40), 1, 90)
+    ref, _ = jax.jit(model.forward)(params, toks)
+    got, _ = jax.jit(model.forward)(qp, toks)
+    agree = float((ref.argmax(-1) == got.argmax(-1)).mean())
+    assert agree >= 0.9, agree
+
+
+def test_quantized_engine_decodes(moe=False):
+    """The engine's scan/cache path consumes the quantized tree end to
+    end (prefill + decode, not just teacher forcing)."""
+    model, params = _make(moe=moe)
+    eng = InferenceEngine(model)
+    qp = quantize_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 1, 90)
+    out = eng.generate(qp, prompt, max_new_tokens=12)
+    assert out.tokens.shape == (2, 12)
+    assert bool((out.lengths > 0).all())
+
+
+def test_forward_path_also_quant_aware():
+    """The training forward (used for eval/perplexity) consumes the same
+    quantized tree."""
+    model, params = _make()
+    qp = quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 12), 1, 90)
+    ref, _ = jax.jit(model.forward)(params, tokens)
+    got, _ = jax.jit(model.forward)(qp, tokens)
+    denom = jnp.abs(ref).mean()
+    assert float(jnp.abs(got - ref).mean() / denom) < 0.12
+
+
+def test_bytes_halved():
+    _, params = _make()
+    qp = quantize_params(params)
+    qb, fb = quantized_bytes(qp)
+    # int8 + scales must land well under the bf16-equivalent footprint
+    assert qb < 0.62 * fb, (qb, fb)
