@@ -87,7 +87,10 @@ async function refresh(){
       if(!sec.count) continue;
       const h = document.createElement('h2');
       h.appendChild(document.createTextNode(sec.kind+' '));
-      const n = cell('span', '('+sec.count+')'); n.className='count';
+      const label = sec.truncated
+        ? '(showing '+sec.objects.length+' of '+sec.count+')'
+        : '('+sec.count+')';
+      const n = cell('span', label); n.className='count';
       h.appendChild(n); root.appendChild(h);
       const cols = Object.keys(Object.assign({namespace:1,name:1},...sec.objects.map(o=>o.summary)));
       const t = document.createElement('table');
@@ -224,6 +227,11 @@ class PlatformApiServer:
                         kinds.append({
                             "kind": kind,
                             "count": len(objs),
+                            # truncated flags the cap so the console can
+                            # say "showing 50 of N" instead of silently
+                            # hiding objects past the cap; the full list
+                            # is one /api/v1/objects?kind= away.
+                            "truncated": len(objs) > 50,
                             "objects": [
                                 {
                                     "namespace": o.metadata.namespace,

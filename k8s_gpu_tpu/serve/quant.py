@@ -73,13 +73,21 @@ def quantize_params(params: dict, *, quantize_embed: bool = True) -> dict:
 
 
 def quantized_bytes(params: dict) -> tuple[int, int]:
-    """(quantized_total, float_equivalent) parameter bytes — the HBM
-    traffic ratio a decode step sees."""
-    import jax
+    """(quantized_total, bf16_equivalent) parameter bytes — the HBM
+    traffic ratio a decode step sees.
 
-    qb = fb = 0
-    for leaf in jax.tree.leaves(params):
-        qb += leaf.size * leaf.dtype.itemsize
-    for leaf in jax.tree.leaves(params):
-        fb += leaf.size * 2 if leaf.dtype == jnp.int8 else leaf.size * leaf.dtype.itemsize
-    return qb, fb
+    The numerator is what the quantized tree actually streams (int8
+    weights + their f32 scales + the float leaves kept at full
+    precision); the denominator is what the SAME weights cost served
+    bf16 (2 bytes each, no scale tensors — a float model has none)."""
+
+    def walk(node):
+        if isinstance(node, dict) and set(node) == {"q", "s"}:
+            actual = node["q"].size + node["s"].size * 4
+            return actual, node["q"].size * 2
+        if isinstance(node, dict):
+            pairs = [walk(v) for v in node.values()]
+            return sum(a for a, _ in pairs), sum(b for _, b in pairs)
+        return node.size * node.dtype.itemsize, node.size * 2
+
+    return walk(params)

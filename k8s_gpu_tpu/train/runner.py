@@ -38,6 +38,17 @@ class TrainConfig:
     warmup_steps: int = 100
     b1: float = 0.9
     b2: float = 0.95
+    # >1: split the batch into this many microbatches per optimizer step
+    # (scan-accumulated f32 grads — same update as one big batch, 1/N the
+    # activation memory).  Composes with gpipe; the 1f1b path microbatches
+    # through the schedule itself (set pp_microbatches instead).
+    grad_accum_steps: int = 1
+    # ZeRO-1: shard adam mu/nu over the 'dp' mesh axis (each dp replica
+    # holds 1/dp of optimizer state; GSPMD inserts the gather at update
+    # time).  Params/grads stay dp-replicated — this is the stage-1
+    # memory/comm point on the ZeRO tradeoff curve, the right one for
+    # TPU ICI where the all-gather is cheap and fully overlapped.
+    zero1: bool = False
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -48,12 +59,41 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     )
 
 
-def make_train_step(loss_fn, optimizer):
+def make_train_step(loss_fn, optimizer, accum: int = 1):
     """loss_fn(params, *batch) -> scalar.  Returns step(params, opt_state,
-    *batch) -> (params, opt_state, loss)."""
+    *batch) -> (params, opt_state, loss).
+
+    ``accum`` > 1 scans the batch as ``accum`` equal microbatches,
+    summing f32 grads, and applies ONE optimizer update from their mean —
+    numerically the same step as the full batch (equal microbatch sizes →
+    mean-of-means = global mean) at 1/accum the activation memory.  The
+    reshape keeps the per-microbatch leading dim as the dp-sharded one."""
 
     def step(params, opt_state, *batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        if accum == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        else:
+            micro = tuple(
+                b.reshape((accum, b.shape[0] // accum) + b.shape[1:])
+                for b in batch
+            )
+
+            def body(carry, mb):
+                gsum, lsum = carry
+                l, g = jax.value_and_grad(loss_fn)(params, *mb)
+                gsum = jax.tree.map(
+                    lambda a, x: a + x.astype(jnp.float32), gsum, g
+                )
+                return (gsum, lsum + l), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (gsum, lsum), _ = jax.lax.scan(
+                body, (zeros, jnp.float32(0)), micro
+            )
+            grads = jax.tree.map(lambda g: g / accum, gsum)
+            loss = lsum / accum
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -128,12 +168,36 @@ class Trainer:
             self.optimizer.init, out_shardings=opt_shardings
         )(self.params)
 
+    def _zero1_sharding(self, sharding: NamedSharding, shape) -> NamedSharding:
+        """Extend a param's sharding with 'dp' on the largest free axis.
+
+        ZeRO-1 via GSPMD: annotating mu/nu with an extra 'dp' factor is
+        the whole implementation — XLA partitions the optimizer update
+        over dp and inserts the all-gather that re-replicates the applied
+        updates.  Falls back to the param sharding when no axis divides
+        evenly (tiny leaves aren't worth a ragged partition)."""
+        dp = self.mesh.shape.get("dp", 1)
+        if dp <= 1:
+            return sharding
+        spec = tuple(sharding.spec) + (None,) * (len(shape) - len(sharding.spec))
+        best = -1
+        for i, (axis_names, dim) in enumerate(zip(spec, shape)):
+            if axis_names is None and dim % dp == 0 and dim > 0:
+                if best < 0 or dim > shape[best]:
+                    best = i
+        if best < 0:
+            return sharding
+        new_spec = list(spec)
+        new_spec[best] = "dp"
+        return NamedSharding(self.mesh, P(*new_spec))
+
     def _opt_state_shardings(self, param_shardings):
         """Optimizer state mirrors param pytrees; scalars replicated.
 
         optax states embed copies of the param tree (mu, nu): any state leaf
         whose (shape, dtype) matches a param leaf gets that param's
-        sharding, everything else (step counters etc.) is replicated."""
+        sharding — further sharded over 'dp' when zero1 is on —
+        everything else (step counters etc.) is replicated."""
         state_shape = jax.eval_shape(self.optimizer.init, self.params)
         param_leaves = jax.tree.leaves(self.params)
         sharding_leaves = jax.tree.leaves(param_shardings)
@@ -143,7 +207,10 @@ class Trainer:
         replicated = NamedSharding(self.mesh, P())
 
         def pick(leaf):
-            return by_shape.get((leaf.shape, leaf.dtype), replicated)
+            s = by_shape.get((leaf.shape, leaf.dtype), replicated)
+            if self.tc.zero1 and s is not replicated:
+                s = self._zero1_sharding(s, leaf.shape)
+            return s
 
         return jax.tree.map(pick, state_shape)
 
@@ -184,11 +251,20 @@ class Trainer:
     def step(self, *batch):
         if self._step is None:
             if self._use_1f1b():
+                if self.tc.grad_accum_steps > 1:
+                    raise ValueError(
+                        "grad_accum_steps composes with the dense/gpipe "
+                        "paths; the 1f1b schedule already microbatches — "
+                        "raise pp_microbatches instead"
+                    )
                 step_fn = make_pipeline_train_step(
                     self.model, self.optimizer, self.mesh
                 )
             else:
-                step_fn = make_train_step(self._loss, self.optimizer)
+                step_fn = make_train_step(
+                    self._loss, self.optimizer,
+                    accum=self.tc.grad_accum_steps,
+                )
             self._step = jax.jit(step_fn, donate_argnums=(0, 1))
         batch = self.shard_batch(*batch)
         t0 = time.perf_counter()

@@ -78,7 +78,8 @@ def test_teacher_forced_next_token_agreement(moe):
     assert agree >= 0.9, agree
 
 
-def test_quantized_engine_decodes(moe=False):
+@pytest.mark.parametrize("moe", [False, True])
+def test_quantized_engine_decodes(moe):
     """The engine's scan/cache path consumes the quantized tree end to
     end (prefill + decode, not just teacher forcing)."""
     model, params = _make(moe=moe)
