@@ -293,14 +293,26 @@ class InferenceEngine:
 
     # -- sampling ----------------------------------------------------------
     @staticmethod
+    def warp_logits(logits, sampling: SamplingConfig):
+        """Temperature + top-k as one logits transform.  The single source
+        of truth for the sampling distribution: ``_sample`` draws from it
+        and speculative decoding softmaxes it into the explicit p/q
+        probabilities its accept-ratio math needs — sharing the warp is
+        what makes the rejection-sampling exactness guarantee structural
+        rather than a convention two code paths must remember."""
+        l = logits.astype(jnp.float32) / sampling.temperature
+        if sampling.top_k > 0:
+            top, _ = jax.lax.top_k(l, sampling.top_k)
+            l = jnp.where(l < top[..., -1:], -jnp.inf, l)
+        return l
+
+    @staticmethod
     def _sample(logits, key, sampling: SamplingConfig):
         if sampling.temperature <= 0:
             return jnp.argmax(logits, axis=-1)
-        logits = logits / sampling.temperature
-        if sampling.top_k > 0:
-            top, _ = jax.lax.top_k(logits, sampling.top_k)
-            logits = jnp.where(logits < top[..., -1:], -1e30, logits)
-        return jax.random.categorical(key, logits, axis=-1)
+        return jax.random.categorical(
+            key, InferenceEngine.warp_logits(logits, sampling), axis=-1
+        )
 
     # -- generate ----------------------------------------------------------
     def _generate(self, params, prompt, key, pad_left, *,

@@ -83,7 +83,8 @@ def quantized_bytes(params: dict) -> tuple[int, int]:
 
     def walk(node):
         if isinstance(node, dict) and set(node) == {"q", "s"}:
-            actual = node["q"].size + node["s"].size * 4
+            actual = (node["q"].size * node["q"].dtype.itemsize
+                      + node["s"].size * node["s"].dtype.itemsize)
             return actual, node["q"].size * 2
         if isinstance(node, dict):
             pairs = [walk(v) for v in node.values()]

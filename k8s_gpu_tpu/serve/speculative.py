@@ -18,6 +18,12 @@ accelerator the platform hosts instead.  Design:
   positions beyond the accepted prefix; the position masks in
   ``InferenceEngine`` never attend past a row's current length, and the
   next round's window overwrites those slots (engine.py:extend_multi).
+- **Sampling is exact too.**  temperature > 0 runs Leviathan-style
+  rejection sampling (``rejection_sample``): accept draft i with prob
+  ``min(1, p_i(g_i)/q_i(g_i))``, emit the first rejection from the
+  normalized residual ``max(p-q, 0)`` — the output distribution equals
+  target-only sampling for ANY draft, with temperature/top-k applied as
+  distribution warps to both sides.
 - **Greedy exactness.**  With temperature 0 the emitted stream is
   *bit-identical* to ``InferenceEngine.generate`` on the target alone —
   the draft only changes how fast tokens appear, never which tokens.
@@ -48,6 +54,52 @@ import jax
 import jax.numpy as jnp
 
 from .engine import InferenceEngine, SamplingConfig
+
+
+def warped_probs(logits, sampling: SamplingConfig):
+    """The sampling distribution as explicit probabilities — the softmax
+    of the SAME warp ``InferenceEngine._sample`` draws from
+    (engine.warp_logits), so the accept-ratio/residual math and direct
+    sampling can never drift apart."""
+    return jax.nn.softmax(
+        InferenceEngine.warp_logits(logits, sampling), axis=-1
+    )
+
+
+def rejection_sample(key, p, q, g):
+    """Speculative rejection sampling (Leviathan et al., exact-match to
+    the target distribution).
+
+    p [B, K+1, V]: warped target distributions at each verify position;
+    q [B, K, V]: warped draft distributions the drafts were drawn from;
+    g [B, K]: the drafted tokens.  Returns (a [B], x [B]): the number of
+    leading drafts accepted and the correction token drawn from the
+    residual ``max(p_a - q_a, 0)`` (renormalized).  Extending q with a
+    zero row makes the all-accepted bonus case the same formula: the
+    residual against q = 0 is exactly ``p_{K+1}``.
+
+    Exactness: accept g_i with prob min(1, p_i(g_i)/q_i(g_i)), else emit
+    from the normalized residual — the emitted token is distributed
+    exactly as p_i regardless of q (tests/test_speculative.py checks the
+    empirical distribution).
+    """
+    B, K = g.shape
+    k_acc, k_corr = jax.random.split(key)
+    p_at_g = jnp.take_along_axis(p[:, :K], g[..., None], axis=2)[..., 0]
+    q_at_g = jnp.take_along_axis(q, g[..., None], axis=2)[..., 0]
+    u = jax.random.uniform(k_acc, (B, K))
+    accept = u * q_at_g < p_at_g          # u < p/q without the divide
+    a = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+    q_ext = jnp.concatenate([q, jnp.zeros_like(q[:, :1])], axis=1)
+    res = jnp.maximum(p - q_ext, 0.0)
+    res_a = jnp.take_along_axis(res, a[:, None, None], axis=1)[:, 0]
+    p_a = jnp.take_along_axis(p, a[:, None, None], axis=1)[:, 0]
+    norm = res_a.sum(-1, keepdims=True)
+    # Degenerate residual (p == q exactly at a rejected position) can't
+    # happen in exact arithmetic but can at float epsilon: fall back to p.
+    dist = jnp.where(norm > 1e-9, res_a / jnp.maximum(norm, 1e-30), p_a)
+    x = jax.random.categorical(k_corr, jnp.log(dist + 1e-30), axis=-1)
+    return a, x
 
 
 @dataclass
@@ -91,22 +143,28 @@ class SpeculativeDecoder:
         self.k = k
         self.stats = SpecStats()
         self._loop_jit = jax.jit(
-            self._decode_loop, static_argnames=("max_new", "eos_id", "pad_id")
+            self._decode_loop, static_argnames=("max_new", "sampling")
         )
         self._prefill_t = jax.jit(self.target.prefill)
         self._prefill_d = jax.jit(self.draft.prefill)
 
     # -- one speculation round (jitted; all state per-row) -----------------
     def _round(self, tparams, dparams, state, pad_left, *, max_new: int,
-               eos_id: int, pad_id: int):
+               sampling: SamplingConfig):
         K = self.k
         (t_cache, d_cache, prev, cur, pos, done, emitted, out, acc_total,
-         drafted) = state
+         drafted, key) = state
+        eos_id, pad_id = sampling.eos_id, sampling.pad_id
+        sampled = sampling.temperature > 0  # static: picks the trace
         B = cur.shape[0]
         kv_start = jnp.broadcast_to(jnp.asarray(pad_left, jnp.int32), (B,))
         frozen = done | (emitted >= max_new)
+        key, k_draft, k_rej = jax.random.split(key, 3)
+        draft_keys = jax.random.split(k_draft, K)
 
-        # 1. Draft: re-ingest prev at pos-1, then K greedy lookahead steps.
+        # 1. Draft: re-ingest prev at pos-1, then K lookahead steps
+        #    (argmax when greedy; draws from the warped draft distribution
+        #    when sampling, keeping the q vectors for the ratio test).
         #    Frozen rows park their writes at their current pos (idempotent
         #    overwrites) so they can never run past max_seq while other
         #    rows finish.
@@ -115,13 +173,20 @@ class SpeculativeDecoder:
             dparams, d_cache, prev, pos - step, pos - step - pad_left, kv_start
         )
         tok = cur
-        drafts = []
+        drafts, q_probs = [], []
         for i in range(K):
             off = jnp.where(frozen, 0, i)
             d_cache, dlogits = self.draft.decode_step_multi(
                 dparams, d_cache, tok, pos + off, pos + off - pad_left, kv_start
             )
-            tok = jnp.argmax(dlogits, axis=-1).astype(cur.dtype)
+            if sampled:
+                qp = warped_probs(dlogits, sampling)
+                tok = jax.random.categorical(
+                    draft_keys[i], jnp.log(qp + 1e-30), axis=-1
+                ).astype(cur.dtype)
+                q_probs.append(qp)
+            else:
+                tok = jnp.argmax(dlogits, axis=-1).astype(cur.dtype)
             drafts.append(tok)
         g = jnp.stack(drafts, axis=1)  # [B, K]
 
@@ -132,14 +197,25 @@ class SpeculativeDecoder:
         t_cache, vlogits = self.target.extend_multi(
             tparams, t_cache, window, vstart, vstart - pad_left, kv_start
         )
-        t_pred = jnp.argmax(vlogits, axis=-1).astype(cur.dtype)  # [B, K+1]
 
-        # 3. Accept the longest matching prefix; emit drafts + correction.
-        match = (g == t_pred[:, :K]).astype(jnp.int32)            # [B, K]
-        a = jnp.cumprod(match, axis=1).sum(axis=1)                # [B] 0..K
+        # 3. Accept + correction.  Greedy: longest exactly-matching prefix,
+        #    correction = target argmax.  Sampled: Leviathan rejection
+        #    sampling — the emitted stream is distributed exactly as
+        #    target-only sampling under the same SamplingConfig.
         idx = jnp.arange(K + 1, dtype=jnp.int32)[None]            # [1, K+1]
+        if sampled:
+            p = warped_probs(vlogits, sampling)                   # [B,K+1,V]
+            a, x = rejection_sample(k_rej, p, jnp.stack(q_probs, 1), g)
+            corr = jnp.broadcast_to(
+                x.astype(cur.dtype)[:, None], (B, K + 1)
+            )
+        else:
+            t_pred = jnp.argmax(vlogits, axis=-1).astype(cur.dtype)
+            match = (g == t_pred[:, :K]).astype(jnp.int32)        # [B, K]
+            a = jnp.cumprod(match, axis=1).sum(axis=1)            # [B] 0..K
+            corr = t_pred
         base = jnp.concatenate([g, g[:, -1:]], axis=1)
-        e = jnp.where(idx < a[:, None], base, t_pred)             # [B, K+1]
+        e = jnp.where(idx < a[:, None], base, corr)               # [B, K+1]
 
         is_eos = e == eos_id
         eos_cum = jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
@@ -164,7 +240,7 @@ class SpeculativeDecoder:
             frozen, prev, jnp.take_along_axis(window, a[:, None], 1)[:, 0]
         )
         new_cur = jnp.where(
-            frozen, cur, jnp.take_along_axis(t_pred, a[:, None], 1)[:, 0]
+            frozen, cur, jnp.take_along_axis(corr, a[:, None], 1)[:, 0]
         )
         n_valid = valid.sum(axis=1, dtype=jnp.int32)
         new_state = (
@@ -175,11 +251,12 @@ class SpeculativeDecoder:
             # acceptance_rate = accepted/drafted stays meaningful when
             # batch rows finish at different times.
             drafted + jnp.where(frozen, 0, K),
+            key,
         )
         return new_state, jnp.where(frozen, 0, a)
 
     def _decode_loop(self, tparams, dparams, state, pad_left, *,
-                     max_new: int, eos_id: int, pad_id: int):
+                     max_new: int, sampling: SamplingConfig):
         """All speculation rounds as ONE on-device ``lax.while_loop``.
 
         The whole generate is a single dispatch after prefill — on a
@@ -201,7 +278,7 @@ class SpeculativeDecoder:
             s, rounds = carry
             s, _ = self._round(
                 tparams, dparams, s, pad_left,
-                max_new=max_new, eos_id=eos_id, pad_id=pad_id,
+                max_new=max_new, sampling=sampling,
             )
             return s, rounds + 1
 
@@ -213,17 +290,17 @@ class SpeculativeDecoder:
     # -- public API --------------------------------------------------------
     def generate(self, tparams, dparams, prompt, *, max_new_tokens: int = 32,
                  sampling: SamplingConfig = SamplingConfig(),
-                 pad_left: int = 0) -> SpecOutput:
-        """prompt [B, S] int32 → SpecOutput; greedy only (temperature 0).
+                 pad_left: int = 0, key=None) -> SpecOutput:
+        """prompt [B, S] int32 → SpecOutput.
 
-        Requires ``S + max_new_tokens + k + 1 <= target.max_seq`` (the last
-        verify window may overshoot the budget by up to k positions).
+        temperature 0: greedy, bit-exact vs the plain engine (module
+        docstring).  temperature > 0: Leviathan rejection sampling — the
+        emitted stream is distributed *exactly* as target-only sampling
+        under the same SamplingConfig, for any draft (rejection_sample).
+
+        Requires ``S + max_new_tokens + k + 1 <= max_seq`` of both engines
+        (the last verify window may overshoot the budget by up to k).
         """
-        if sampling.temperature > 0:
-            raise NotImplementedError(
-                "speculative decoding is greedy-exact; sampled speculation "
-                "needs rejection resampling (future work)"
-            )
         B, S = prompt.shape
         K = self.k
         # Both caches must hold the full stream + lookahead: a shorter
@@ -240,7 +317,12 @@ class SpeculativeDecoder:
         t_cache, t_logits = self._prefill_t(tparams, prompt, pad)
         d_cache, _ = self._prefill_d(dparams, prompt, pad)
 
-        cur = jnp.argmax(t_logits, axis=-1).astype(prompt.dtype)
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        key, k0 = jax.random.split(key)
+        cur = InferenceEngine._sample(t_logits, k0, sampling).astype(
+            prompt.dtype
+        )
         done = cur == sampling.eos_id
         out = jnp.full((B, max_new_tokens), sampling.pad_id, prompt.dtype)
         out = out.at[:, 0].set(jnp.where(done, sampling.pad_id, cur))
@@ -251,11 +333,10 @@ class SpeculativeDecoder:
         drafted = jnp.zeros((B,), jnp.int32)
 
         state = (t_cache, d_cache, prev, cur, pos, done, emitted, out, acc,
-                 drafted)
+                 drafted, key)
         state, rounds_dev = self._loop_jit(
             tparams, dparams, state, pad,
-            max_new=max_new_tokens, eos_id=sampling.eos_id,
-            pad_id=sampling.pad_id,
+            max_new=max_new_tokens, sampling=sampling,
         )
         rounds = int(jax.device_get(rounds_dev))
         lengths = state[6]

@@ -66,15 +66,24 @@ def make_train_step(loss_fn, optimizer, accum: int = 1):
     ``accum`` > 1 scans the batch as ``accum`` equal microbatches,
     summing f32 grads, and applies ONE optimizer update from their mean —
     numerically the same step as the full batch (equal microbatch sizes →
-    mean-of-means = global mean) at 1/accum the activation memory.  The
-    reshape keeps the per-microbatch leading dim as the dp-sharded one."""
+    mean-of-means = global mean) at 1/accum the activation memory.
+
+    Microbatch membership is STRIDED, not contiguous: reshape to
+    (B/accum, accum) then swap.  Batch rows are dp-sharded in contiguous
+    blocks, so a contiguous split would put microbatch 0 entirely on the
+    first dp shards and force an all-to-all every scan tick; the strided
+    split takes 1/accum of each device's local block — communication-free
+    — and grad averaging is permutation-invariant, so the update is
+    unchanged."""
 
     def step(params, opt_state, *batch):
         if accum == 1:
             loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
         else:
             micro = tuple(
-                b.reshape((accum, b.shape[0] // accum) + b.shape[1:])
+                b.reshape(
+                    (b.shape[0] // accum, accum) + b.shape[1:]
+                ).swapaxes(0, 1)
                 for b in batch
             )
 

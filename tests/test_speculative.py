@@ -108,12 +108,60 @@ def test_budget_never_overshoots(target, draft):
     assert jnp.array_equal(out.tokens, ref.tokens)
 
 
-def test_sampling_rejected(target, draft):
-    spec, te, tp, dp = _engines(target, draft, 2)
-    prompt = jnp.ones((1, 4), jnp.int32)
-    with pytest.raises(NotImplementedError):
-        spec.generate(tp, dp, prompt, max_new_tokens=4,
-                      sampling=SamplingConfig(temperature=1.0))
+def test_rejection_sample_distribution_exact():
+    """The math core: for fixed p/q, the first emitted token's empirical
+    distribution must equal p (Leviathan Thm 1), for a draft that
+    disagrees with the target badly."""
+    from k8s_gpu_tpu.serve.speculative import rejection_sample
+
+    V, K, N = 4, 2, 40000
+    p1 = jnp.array([0.5, 0.25, 0.15, 0.10])
+    q1 = jnp.array([0.05, 0.05, 0.45, 0.45])  # adversarial draft
+    p = jnp.tile(p1, (1, K + 1, 1))
+    q = jnp.tile(q1, (1, K, 1))
+
+    def one(key):
+        kg, kr = jax.random.split(key)
+        # draft tokens drawn from q, as the algorithm requires
+        g = jax.random.categorical(kg, jnp.log(q[0] + 1e-30), axis=-1)[None]
+        a, x = rejection_sample(kr, p, q, g)
+        return jnp.where(a[0] > 0, g[0, 0], x[0])  # first emitted token
+
+    first = jax.vmap(one)(jax.random.split(jax.random.PRNGKey(0), N))
+    emp = jnp.bincount(first, length=V) / N
+    assert float(jnp.abs(emp - p1).max()) < 0.015, emp
+
+
+def test_sampled_self_draft_accepts_everything(target):
+    """p == q → accept ratio 1 → every draft accepted."""
+    tm, tp = target
+    te = InferenceEngine(tm)
+    spec = SpeculativeDecoder(te, InferenceEngine(tm), k=4)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 1, 60)
+    out = spec.generate(
+        tp, tp, prompt, max_new_tokens=20,
+        sampling=SamplingConfig(temperature=0.8, top_k=8),
+        key=jax.random.PRNGKey(7),
+    )
+    assert spec.stats.acceptance_rate >= 0.99, spec.stats.acceptance_rate
+    assert bool((out.lengths == 20).all())
+
+
+def test_sampled_stream_plausible(target, draft):
+    """Sampled speculation with a disagreeing draft: correct shapes,
+    in-vocab tokens, budget respected, and different keys → different
+    streams (it really samples)."""
+    spec, te, tp, dp = _engines(target, draft, 3)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 7), 1, 60)
+    samp = SamplingConfig(temperature=1.0, top_k=0)
+    o1 = spec.generate(tp, dp, prompt, max_new_tokens=16, sampling=samp,
+                       key=jax.random.PRNGKey(1))
+    o2 = spec.generate(tp, dp, prompt, max_new_tokens=16, sampling=samp,
+                       key=jax.random.PRNGKey(2))
+    assert o1.tokens.shape == (2, 16)
+    assert int(o1.tokens.max()) < 64 and int(o1.tokens.min()) >= 0
+    assert bool((o1.lengths == 16).all())
+    assert not jnp.array_equal(o1.tokens, o2.tokens)
 
 
 def test_max_seq_guard(target, draft):
