@@ -591,6 +591,51 @@ def cmd_obs(args) -> int:
     return 1
 
 
+def cmd_serve(args) -> int:
+    """Serve a model asset over HTTP — the end of the export→serve
+    journey (train → checkpoint → versioned model asset → serving
+    workload; the role the reference's platform schedules for the
+    Fin-Agent service, 智能风控解决方案.md:368-419)."""
+    ctx = _require_login(CliConfig.load())
+    p = LocalPlatform()
+    try:
+        from ..serve.bundle import load_servable
+
+        model, params, tok = load_servable(
+            p.assets, ctx.space, args.model, args.version
+        )
+    except (KeyError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    finally:
+        # Release the platform lock before serving — params are already
+        # materialized on device, and holding the exclusive lock for the
+        # serve duration would block every other CLI invocation.
+        p.close()
+    if tok is None:
+        print(
+            f"asset {args.model} bundles no tokenizer; re-export with "
+            "export_servable(..., tokenizer=...)",
+            file=sys.stderr,
+        )
+        return 1
+    from ..serve import LmServer
+
+    srv = LmServer(model, params, tok, port=args.port).start()
+    print(
+        f"serving {ctx.space}/model/{args.model} on "
+        f"http://127.0.0.1:{srv.port}/generate"
+    )
+    deadline = time.monotonic() + args.for_seconds if args.for_seconds else None
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    return 0
+
+
 # -- parser ----------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -725,6 +770,16 @@ def build_parser() -> argparse.ArgumentParser:
     p_os.add_argument("--for-seconds", type=float, default=0.0,
                       help="exit after N seconds (0 = until interrupted)")
     p_obs.set_defaults(fn=cmd_obs)
+
+    p_srv = sub.add_parser(
+        "serve", help="serve a model asset over HTTP (LM server)"
+    )
+    p_srv.add_argument("model", help="model asset id in the current space")
+    p_srv.add_argument("--version", default="", help="'' = latest")
+    p_srv.add_argument("--port", type=int, default=0)
+    p_srv.add_argument("--for-seconds", type=float, default=0.0,
+                       help="exit after N seconds (0 = until interrupted)")
+    p_srv.set_defaults(fn=cmd_serve)
 
     return ap
 

@@ -2,6 +2,7 @@
 int8 weight-only quantization, LM HTTP server."""
 
 from .batcher import ContinuousBatcher, RequestHandle
+from .bundle import export_servable, load_servable
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
 from .quant import quantize_params
 from .server import LmServer
@@ -10,5 +11,5 @@ from .speculative import SpecOutput, SpeculativeDecoder
 __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
     "ContinuousBatcher", "RequestHandle", "SpeculativeDecoder",
-    "SpecOutput", "quantize_params",
+    "SpecOutput", "quantize_params", "export_servable", "load_servable",
 ]

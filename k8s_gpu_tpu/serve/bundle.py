@@ -1,0 +1,125 @@
+"""Servable model bundles — the export→serve half of the model lifecycle.
+
+The reference's journey is train → ``/output`` → MinIO model asset →
+serving workload (GPU调度平台搭建.md:686-697; the Fin-Agent service then
+consumes a served model, 智能风控解决方案.md:368-419).  The raw Orbax
+checkpoint export (train/checkpoint.py) preserves *training state* but
+not the model's identity — nothing could reconstruct the architecture
+from it.  A servable bundle is self-describing:
+
+    payload/
+      config.json     TransformerConfig fields (+ leaf dtype/shape table)
+      params.npz      every param leaf, path-keyed ("blocks/wq", ...)
+      tokenizer.json  optional BPE merges
+
+so ``load_servable(store, space, id)`` → (model, params, tokenizer) with
+no other context — exactly what a serving pod gets scheduled with.
+Quantized trees (serve/quant.py {q,s} leaves) flatten naturally, so an
+exported int8 model serves as int8.  bfloat16 leaves ride npz as raw
+void bytes (numpy can't tag ml_dtypes) and are re-viewed on load using
+the dtype table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import tempfile
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.tokenizer import BpeTokenizer
+from ..models.transformer import TransformerConfig, TransformerLM
+from ..platform.assets import Asset, AssetStore
+
+
+def _flatten(tree: dict, prefix: str = ""):
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _flatten(v, key)
+        else:
+            yield key, v
+
+
+def _unflatten(flat: dict) -> dict:
+    out: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out
+
+
+def export_servable(
+    store: AssetStore, space: str, asset_id: str,
+    model: TransformerLM, params: dict,
+    tokenizer: BpeTokenizer | None = None,
+) -> Asset:
+    """Write a self-describing bundle into the AssetStore (kind 'model')."""
+    leaves = dict(_flatten(params))
+    with tempfile.TemporaryDirectory() as td:
+        d = Path(td)
+        cfg = dataclasses.asdict(model.cfg)
+        cfg["dtype"] = jnp.dtype(model.cfg.dtype).name
+        cfg_doc = {
+            "format": "k8s-gpu-tpu-servable-v1",
+            "model": "TransformerLM",
+            "config": cfg,
+            "leaves": {
+                k: {"dtype": np.asarray(v).dtype.name,
+                    "shape": list(np.asarray(v).shape)}
+                for k, v in leaves.items()
+            },
+            "tokenizer": tokenizer is not None,
+        }
+        (d / "config.json").write_text(json.dumps(cfg_doc))
+        np.savez(d / "params.npz",
+                 **{k: np.asarray(v) for k, v in leaves.items()})
+        if tokenizer is not None:
+            tokenizer.save(d / "tokenizer.json")
+        return store.import_path(space, "model", asset_id, d)
+
+
+def load_servable(
+    store: AssetStore, space: str, asset_id: str, version: str = "",
+):
+    """Asset → (TransformerLM, params, tokenizer | None)."""
+    import ml_dtypes
+
+    asset = store.get(space, "model", asset_id, version)
+    root = Path(asset.path)
+    if not root.is_dir() or not (root / "config.json").exists():
+        raise ValueError(
+            f"{space}/model/{asset_id}@{asset.version} is not a servable "
+            "bundle (raw checkpoint exports lack config.json — re-export "
+            "with serve.bundle.export_servable)"
+        )
+    doc = json.loads((root / "config.json").read_text())
+    if doc.get("format") != "k8s-gpu-tpu-servable-v1":
+        raise ValueError(
+            f"{space}/model/{asset_id}@{asset.version} is not a servable "
+            "bundle (raw checkpoint exports lack config.json — re-export "
+            "with serve.bundle.export_servable)"
+        )
+    cfg_fields = dict(doc["config"])
+    cfg_fields["dtype"] = jnp.dtype(cfg_fields["dtype"]).type
+    model = TransformerLM(TransformerConfig(**cfg_fields))
+    flat = {}
+    with np.load(root / "params.npz") as z:
+        for key, meta in doc["leaves"].items():
+            a = z[key]
+            want = np.dtype(getattr(ml_dtypes, meta["dtype"], None)
+                            or meta["dtype"])
+            if a.dtype != want:  # bf16 etc. came back as void bytes
+                a = a.view(want)
+            flat[key] = jnp.asarray(a.reshape(meta["shape"]))
+    params = _unflatten(flat)
+    tok = None
+    if doc.get("tokenizer"):
+        tok = BpeTokenizer.load(root / "tokenizer.json")
+    return model, params, tok

@@ -206,3 +206,41 @@ def test_apply_provisions_class_pvc(tmp_path, capsys):
     assert "Bound" in out and "pv-ml-corpus" in out
     code, out, _ = run(capsys, "get", "PersistentVolume", "pv-ml-corpus")
     assert "Bound" in out and "ceph" in out
+
+
+def test_serve_model_asset(capsys, tmp_path):
+    """The export→serve journey through the CLI: bundle a model into the
+    platform asset store, then `serve` loads and stands the LM server up
+    (briefly, via --for-seconds)."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.cli.platform_local import LocalPlatform
+    from k8s_gpu_tpu.data.tokenizer import BpeTokenizer
+    from k8s_gpu_tpu.models.transformer import (
+        TransformerConfig, TransformerLM,
+    )
+    from k8s_gpu_tpu.serve import export_servable
+
+    run(capsys, "login", "--user", "ada", "--space", "ml")
+    cfg = TransformerConfig(
+        vocab_size=300, d_model=32, n_layers=1, n_heads=2, d_head=16,
+        d_ff=64, max_seq=64, dtype=jnp.float32, use_flash=False,
+        remat=False,
+    )
+    model = TransformerLM(cfg)
+    tok = BpeTokenizer.train("tiny corpus for serving " * 30,
+                             vocab_size=280, backend="python")
+    p = LocalPlatform()
+    try:
+        export_servable(p.assets, "ml", "srv-lm", model,
+                        model.init(jax.random.PRNGKey(0)), tokenizer=tok)
+    finally:
+        p.close()
+
+    code, out, err = run(capsys, "serve", "srv-lm", "--for-seconds", "0.3")
+    assert code == 0, err
+    assert "serving ml/model/srv-lm" in out
+
+    code, _, err = run(capsys, "serve", "missing", "--for-seconds", "0.1")
+    assert code == 1 and "no asset" in err
