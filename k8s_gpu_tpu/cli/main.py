@@ -580,15 +580,20 @@ def cmd_obs(args) -> int:
         p.close()
         srv = MetricsServer(port=args.port).start()
         print(f"serving /metrics /healthz /readyz on :{srv.port}")
-        deadline = time.monotonic() + args.for_seconds if args.for_seconds else None
-        try:
-            while deadline is None or time.monotonic() < deadline:
-                time.sleep(0.2)
-        except KeyboardInterrupt:
-            pass
-        srv.stop()
-        return 0
+        return _serve_until(srv, args.for_seconds)
     return 1
+
+
+def _serve_until(srv, for_seconds: float) -> int:
+    """Block until the deadline (0 = forever) or Ctrl-C, then stop."""
+    deadline = time.monotonic() + for_seconds if for_seconds else None
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    return 0
 
 
 def cmd_serve(args) -> int:
@@ -605,7 +610,8 @@ def cmd_serve(args) -> int:
             p.assets, ctx.space, args.model, args.version
         )
     except (KeyError, ValueError) as e:
-        print(str(e), file=sys.stderr)
+        # KeyError str() wraps the message in repr quotes; args[0] is clean.
+        print(e.args[0] if e.args else str(e), file=sys.stderr)
         return 1
     finally:
         # Release the platform lock before serving — params are already
@@ -626,14 +632,7 @@ def cmd_serve(args) -> int:
         f"serving {ctx.space}/model/{args.model} on "
         f"http://127.0.0.1:{srv.port}/generate"
     )
-    deadline = time.monotonic() + args.for_seconds if args.for_seconds else None
-    try:
-        while deadline is None or time.monotonic() < deadline:
-            time.sleep(0.2)
-    except KeyboardInterrupt:
-        pass
-    srv.stop()
-    return 0
+    return _serve_until(srv, args.for_seconds)
 
 
 # -- parser ----------------------------------------------------------------

@@ -71,8 +71,7 @@ def export_servable(
             "model": "TransformerLM",
             "config": cfg,
             "leaves": {
-                k: {"dtype": np.asarray(v).dtype.name,
-                    "shape": list(np.asarray(v).shape)}
+                k: {"dtype": jnp.dtype(v.dtype).name, "shape": list(v.shape)}
                 for k, v in leaves.items()
             },
             "tokenizer": tokenizer is not None,
