@@ -53,6 +53,15 @@ from .engine import InferenceEngine, _empty_cache
 log = logging.getLogger("k8s_gpu_tpu.serve")
 
 
+def _suffix_bucket(n: int) -> int:
+    """Compile bucket for a prefix-cached prompt's suffix: smallest power
+    of two >= n (floor 8).  Right-padded, so no decode-room coupling."""
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
 def prompt_bucket(n_tokens: int, max_seq: int) -> int | None:
     """Smallest compile bucket >= n_tokens that still leaves decode room.
 
@@ -184,6 +193,23 @@ class ContinuousBatcher:
         )
         self._admit_jit = jax.jit(self._admit_dev, donate_argnums=(1,))
         self._round_jit = jax.jit(self._round_dev, donate_argnums=(1,))
+        self._admit_prefix_jit = jax.jit(
+            self._admit_prefix_dev, donate_argnums=(1,)
+        )
+        self._admit_exact_jit = jax.jit(
+            self._admit_exact_dev, donate_argnums=(1,)
+        )
+        # One wrapper → jit's own cache gives one compile per prefix
+        # length (a fresh jax.jit per call would retrace every time).
+        self._prefill_jit = jax.jit(self.engine.prefill)
+        # Prefix cache: prompt-prefix bytes → prefilled device cache row.
+        # Entries are read-only after insert; LRU-bounded (each entry owns
+        # a full [L,1,H,max_seq,Dh] K/V row — HBM, not host RAM).
+        self._prefix: "collections.OrderedDict[bytes, dict]" = (
+            collections.OrderedDict()
+        )
+        self._prefix_cap = 4
+        self._prefix_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, name="continuous-batcher", daemon=True
         )
@@ -206,18 +232,76 @@ class ContinuousBatcher:
             dev["cache"],
             row_cache,
         )
-        key, sub = jax.random.split(key)
-        greedy = jnp.argmax(last_logits[0]).astype(jnp.int32)
-        sampled = jax.random.categorical(
-            sub, last_logits[0] / jnp.maximum(temp, 1e-6)
-        ).astype(jnp.int32)
-        first = jnp.where(temp > 0, sampled, greedy)
+        first, key = self._first_token(last_logits[0], temp, key)
         return {
             "cache": cache,
             "token": dev["token"].at[slot].set(first),
             "pos": dev["pos"].at[slot].set(bucket),
             "rope": dev["rope"].at[slot].set(bucket - pad),
             "start": dev["start"].at[slot].set(pad),
+            "temps": dev["temps"].at[slot].set(temp),
+            "keys": dev["keys"].at[slot].set(key),
+        }, first
+
+    @staticmethod
+    def _first_token(logits, temp, key):
+        key, sub = jax.random.split(key)
+        greedy = jnp.argmax(logits).astype(jnp.int32)
+        sampled = jax.random.categorical(
+            sub, logits / jnp.maximum(temp, 1e-6)
+        ).astype(jnp.int32)
+        return jnp.where(temp > 0, sampled, greedy), key
+
+    def _admit_prefix_dev(self, params, dev, base, suffix, n_real, slot,
+                          temp, key, base_pos):
+        """Admit on top of a cached prefix: extend the prefix's K/V row
+        with the RIGHT-padded suffix (one extend_multi, width = suffix
+        bucket) instead of prefilling the whole prompt.
+
+        Right-padding is the safety trick: pad slots write garbage K/V at
+        positions past the live length, which the decode masks
+        (t <= pos) never attend and the decode loop overwrites in order —
+        left-padding would instead clobber the real prefix tail."""
+        row, logits = self.engine.extend_multi(
+            params, base, suffix,
+            jnp.asarray([base_pos]), jnp.asarray([base_pos]),
+            jnp.asarray([0]),
+        )
+        cache = jax.tree.map(
+            lambda p, r: jax.lax.dynamic_update_slice(
+                p, r.astype(p.dtype), (0, slot, 0, 0, 0)
+            ),
+            dev["cache"], row,
+        )
+        first, key = self._first_token(logits[0, n_real - 1], temp, key)
+        pos = base_pos + n_real
+        return {
+            "cache": cache,
+            "token": dev["token"].at[slot].set(first),
+            "pos": dev["pos"].at[slot].set(pos),
+            "rope": dev["rope"].at[slot].set(pos),
+            "start": dev["start"].at[slot].set(0),
+            "temps": dev["temps"].at[slot].set(temp),
+            "keys": dev["keys"].at[slot].set(key),
+        }, first
+
+    def _admit_exact_dev(self, params, dev, base, base_logits, base_pos,
+                         slot, temp, key):
+        """Admit a prompt that IS a cached prefix: splice + sample, no
+        model forward at all."""
+        cache = jax.tree.map(
+            lambda p, r: jax.lax.dynamic_update_slice(
+                p, r.astype(p.dtype), (0, slot, 0, 0, 0)
+            ),
+            dev["cache"], base,
+        )
+        first, key = self._first_token(base_logits[0], temp, key)
+        return {
+            "cache": cache,
+            "token": dev["token"].at[slot].set(first),
+            "pos": dev["pos"].at[slot].set(base_pos),
+            "rope": dev["rope"].at[slot].set(base_pos),
+            "start": dev["start"].at[slot].set(0),
             "temps": dev["temps"].at[slot].set(temp),
             "keys": dev["keys"].at[slot].set(key),
         }, first
@@ -298,6 +382,59 @@ class ContinuousBatcher:
         self._wake.set()
         return RequestHandle(req)
 
+    def precache_prefix(self, ids) -> None:
+        """Prefill *ids* once and keep the K/V row for reuse: any later
+        submit whose prompt starts with *ids* only computes its suffix
+        (one extend over the suffix bucket), and a prompt that IS a
+        cached prefix admits with no model forward at all.  The classic
+        use is a shared system prompt / few-shot preamble.
+
+        Exact-shape prefill: one compile per distinct prefix length —
+        prefixes are few and long-lived, so that trade is right (bucketed
+        prefixes would burn cache slots on pad garbage).  LRU-bounded at
+        4 entries; each entry owns a full K/V row in HBM."""
+        if self.engine.cfg.moe:
+            # Capacity-capped Switch dispatch couples every token in the
+            # dispatch group: a chunked (prefix + suffix) prefill computes
+            # caps over different group sizes than the one-shot prefill
+            # and silently drops different tokens — chunking cannot match
+            # the oracle, so refuse rather than serve diverging streams.
+            raise ValueError(
+                "prefix caching is unavailable for MoE models: "
+                "capacity-capped expert dispatch makes chunked prefill "
+                "diverge from the one-shot path"
+            )
+        ids = np.asarray(ids, np.int32).ravel()
+        if ids.size == 0 or ids.size > self.engine.max_seq - 8:
+            raise ValueError(f"prefix length {ids.size} unusable")
+        cache, logits = self._prefill_jit(
+            self.params, jnp.asarray(ids)[None], 0
+        )
+        with self._prefix_lock:
+            self._prefix[ids.tobytes()] = {
+                "cache": cache, "logits": logits, "n": int(ids.size),
+            }
+            self._prefix.move_to_end(ids.tobytes())
+            while len(self._prefix) > self._prefix_cap:
+                self._prefix.popitem(last=False)
+
+    def _match_prefix(self, ids: np.ndarray):
+        """Longest cached prefix of *ids* (LRU-touched), or None."""
+        best_key = None
+        best = None
+        with self._prefix_lock:
+            for key, entry in self._prefix.items():
+                n = entry["n"]
+                if (
+                    n <= ids.size
+                    and (best is None or n > best["n"])
+                    and ids[:n].tobytes() == key
+                ):
+                    best, best_key = entry, key
+            if best_key is not None:
+                self._prefix.move_to_end(best_key)
+        return best
+
     @property
     def steps_taken(self) -> int:
         return self._round_count
@@ -316,16 +453,41 @@ class ContinuousBatcher:
         return -1
 
     def _dispatch_admit(self, req: _Request, slot: int) -> tuple:
-        bucket = prompt_bucket(int(req.ids.size), self.engine.max_seq)
-        pad = bucket - int(req.ids.size)
-        padded = jnp.zeros((1, bucket), jnp.int32).at[0, pad:].set(
-            jnp.asarray(req.ids)
-        )
-        self._dev, first = self._admit_jit(
-            self.params, self._dev, padded, jnp.int32(slot),
-            jnp.float32(req.temperature),
-            jax.random.PRNGKey(req.seed), jnp.int32(pad),
-        )
+        entry = self._match_prefix(req.ids)
+        if entry is not None and entry["n"] == req.ids.size:
+            # The prompt IS a cached prefix: splice + sample, zero forward.
+            self._dev, first = self._admit_exact_jit(
+                self.params, self._dev, entry["cache"], entry["logits"],
+                jnp.int32(entry["n"]), jnp.int32(slot),
+                jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
+            )
+        elif entry is not None and (
+            entry["n"] + _suffix_bucket(req.ids.size - entry["n"])
+            <= self.engine.max_seq
+        ):
+            p = entry["n"]
+            n_real = int(req.ids.size) - p
+            w = _suffix_bucket(n_real)
+            suffix = jnp.zeros((1, w), jnp.int32).at[0, :n_real].set(
+                jnp.asarray(req.ids[p:])
+            )
+            self._dev, first = self._admit_prefix_jit(
+                self.params, self._dev, entry["cache"], suffix,
+                jnp.int32(n_real), jnp.int32(slot),
+                jnp.float32(req.temperature),
+                jax.random.PRNGKey(req.seed), jnp.int32(p),
+            )
+        else:
+            bucket = prompt_bucket(int(req.ids.size), self.engine.max_seq)
+            pad = bucket - int(req.ids.size)
+            padded = jnp.zeros((1, bucket), jnp.int32).at[0, pad:].set(
+                jnp.asarray(req.ids)
+            )
+            self._dev, first = self._admit_jit(
+                self.params, self._dev, padded, jnp.int32(slot),
+                jnp.float32(req.temperature),
+                jax.random.PRNGKey(req.seed), jnp.int32(pad),
+            )
         req.slot = slot
         self._active[slot] = req
         return ("admit", req, first)

@@ -50,7 +50,8 @@ class LmServer:
 
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
             metrics_server_label = "lm-server"
-            known_routes = ("/generate", "/tokenize", "/healthz", "/readyz")
+            known_routes = ("/generate", "/tokenize", "/precache",
+                            "/healthz", "/readyz")
 
             def _get(self):
                 if self.path == "/healthz":
@@ -78,6 +79,19 @@ class LmServer:
                     ids = outer.tokenizer.encode(text)
                     return self._json(200, {"ids": ids.tolist(),
                                             "count": int(ids.size)})
+                if self.path == "/precache":
+                    # Install a shared prompt prefix (system prompt /
+                    # few-shot preamble): later /generate prompts starting
+                    # with it prefill only their suffix.
+                    text = body.get("prompt", "")
+                    if not isinstance(text, str) or not text:
+                        return self._json(400, {"error": "prompt (string) required"})
+                    ids = outer.tokenizer.encode(text)
+                    try:
+                        outer.batcher.precache_prefix(ids)
+                    except ValueError as e:
+                        return self._json(400, {"error": str(e)})
+                    return self._json(200, {"cached_tokens": int(ids.size)})
                 return self._json(404, {"error": "not found"})
 
             def _generate(self, body):

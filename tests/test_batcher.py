@@ -208,3 +208,100 @@ def test_handle_reiteration_replays(setup):
         assert h.aborted is False
     finally:
         b.stop()
+
+
+# -- prefix caching ---------------------------------------------------------
+
+def test_prefix_hit_matches_oracle(setup):
+    """A prompt extending a cached prefix decodes exactly like the
+    uncached path (the suffix-extension admission is just a re-chunked
+    prefill)."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2).start()
+    try:
+        prefix = [7, 3, 11, 19, 2]
+        b.precache_prefix(prefix)
+        ids = prefix + [23, 29]
+        got = b.submit(ids, max_new_tokens=6).result()
+        assert got == _reference_greedy(model, params, ids, 6)
+    finally:
+        b.stop()
+
+
+def test_exact_prefix_admits_without_forward(setup):
+    """A prompt that IS a cached prefix must admit via splice+sample —
+    no prefill and no extend run on the admit path."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2).start()
+    try:
+        prefix = [5, 9, 17, 4]
+        b.precache_prefix(prefix)
+        calls = []
+        orig_prefill = b.engine.prefill
+        orig_extend = b.engine.extend_multi
+        b.engine.prefill = lambda *a, **k: (
+            calls.append("prefill") or orig_prefill(*a, **k)
+        )
+        b.engine.extend_multi = lambda *a, **k: (
+            calls.append("extend") or orig_extend(*a, **k)
+        )
+        got = b.submit(prefix, max_new_tokens=5).result()
+        assert got == _reference_greedy(model, params, prefix, 5)
+        assert calls == [], calls  # admission was splice-only
+    finally:
+        b.stop()
+
+
+def test_prefix_lru_eviction_and_miss(setup):
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2).start()
+    try:
+        b._prefix_cap = 2
+        b.precache_prefix([1, 2, 3])
+        b.precache_prefix([4, 5, 6])
+        b.precache_prefix([7, 8, 9])  # evicts [1,2,3]
+        assert len(b._prefix) == 2
+        # evicted prefix now misses → plain path still correct
+        ids = [1, 2, 3, 30]
+        got = b.submit(ids, max_new_tokens=4).result()
+        assert got == _reference_greedy(model, params, ids, 4)
+        # longest-prefix wins: precache a longer overlapping prefix
+        b.precache_prefix([7, 8])
+        ids2 = [7, 8, 9, 40]
+        got2 = b.submit(ids2, max_new_tokens=4).result()
+        assert got2 == _reference_greedy(model, params, ids2, 4)
+    finally:
+        b.stop()
+
+
+def test_prefix_and_plain_requests_interleave(setup):
+    """Mixed traffic: prefix-hit and cold requests share decode rounds
+    and each matches its oracle."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=4).start()
+    try:
+        prefix = [2, 4, 6, 8]
+        b.precache_prefix(prefix)
+        warm_ids = prefix + [10]
+        cold_ids = [9, 7, 5]
+        h1 = b.submit(warm_ids, max_new_tokens=6)
+        h2 = b.submit(cold_ids, max_new_tokens=6)
+        assert h1.result() == _reference_greedy(model, params, warm_ids, 6)
+        assert h2.result() == _reference_greedy(model, params, cold_ids, 6)
+    finally:
+        b.stop()
+
+
+def test_prefix_cache_refused_for_moe():
+    """Capacity-capped MoE dispatch couples tokens across the dispatch
+    group — chunked prefill can't match the one-shot oracle, so the
+    batcher refuses rather than serve silently diverging streams."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=48, n_layers=2, n_heads=4, d_head=12,
+        d_ff=96, max_seq=64, use_flash=False, dtype=jnp.float32,
+        num_experts=4,
+    )
+    model = TransformerLM(cfg)
+    b = ContinuousBatcher(model, model.init(jax.random.PRNGKey(0)), slots=2)
+    with pytest.raises(ValueError, match="MoE"):
+        b.precache_prefix([1, 2, 3])

@@ -171,3 +171,21 @@ def test_concurrent_http_requests_interleave(server):
     steps = {s: {st for st, sl in log if sl == s} for s in slots}
     vals = list(steps.values())
     assert vals[0] & vals[1], "requests were serialized, not interleaved"
+
+
+def test_precache_endpoint(server):
+    """/precache installs a prefix; a /generate whose prompt extends it
+    returns the same stream as before caching (parity through HTTP)."""
+    prompt = "the cat sat on the mat. the dog"
+    code, cold = _post(server, "/generate",
+                       {"prompt": prompt, "max_new_tokens": 6})
+    assert code == 200
+    code, out = _post(server, "/precache",
+                      {"prompt": "the cat sat on the mat."})
+    assert code == 200 and out["cached_tokens"] > 0
+    code, warm = _post(server, "/generate",
+                       {"prompt": prompt, "max_new_tokens": 6})
+    assert code == 200
+    assert warm["ids"] == cold["ids"]
+    code, err = _post(server, "/precache", {"prompt": ""})
+    assert code == 400
