@@ -90,6 +90,7 @@ class _Request:
     seed: int
     out: queue.Queue = field(default_factory=queue.Queue)
     slot: int = -1
+    aidx: int = 0            # adapter bank index (0 = base model)
     emitted: int = 0
     # True when the stream ended because the batcher crashed/stopped, not
     # because of EOS/budget — servers map this to a 5xx, not a 200.
@@ -147,8 +148,15 @@ class ContinuousBatcher:
         eos_id: int = -1,
         steps_per_round: int = 8,
         pipeline_depth: int = 2,
+        adapters: dict | None = None,
     ):
+        """``adapters``: name → (lora_params, LoraConfig) — serves every
+        adapter and the base model from ONE decode program; requests pick
+        an adapter by name at submit (serve/lora_bank.py)."""
+        from .lora_bank import AdapterBank
+
         self.engine = InferenceEngine(model, max_seq=max_seq, mesh=mesh)
+        self.bank = AdapterBank(adapters or {})
         self.params = params
         self.slots = slots
         self.eos_id = eos_id
@@ -170,6 +178,7 @@ class ContinuousBatcher:
             "keys": jax.vmap(jax.random.PRNGKey)(
                 jnp.zeros(slots, jnp.uint32)
             ),
+            "aidx": jnp.zeros(slots, jnp.int32),
         }
         # Host-side scheduler state.  No position mirror is needed: submit
         # clamps max_new to the decode room, so the budget always retires a
@@ -197,11 +206,17 @@ class ContinuousBatcher:
             self._admit_prefix_dev, donate_argnums=(1,)
         )
         self._admit_exact_jit = jax.jit(
-            self._admit_exact_dev, donate_argnums=(1,)
+            self._admit_exact_dev, donate_argnums=(0,)
         )
-        # One wrapper → jit's own cache gives one compile per prefix
-        # length (a fresh jax.jit per call would retrace every time).
-        self._prefill_jit = jax.jit(self.engine.prefill)
+        # One wrapper → jit's own executable cache; width comes bucketed
+        # from precache_prefix (a fresh jax.jit per call would retrace
+        # every time, and unbucketed widths would compile per length).
+        self._precache_jit = jax.jit(
+            lambda params, cache, padded: self.engine.extend_multi(
+                params, cache, padded,
+                jnp.asarray([0]), jnp.asarray([0]), jnp.asarray([0]),
+            )
+        )
         # Prefix cache: prompt-prefix bytes → prefilled device cache row.
         # Entries are read-only after insert; LRU-bounded (each entry owns
         # a full [L,1,H,max_seq,Dh] K/V row — HBM, not host RAM).
@@ -215,33 +230,23 @@ class ContinuousBatcher:
         )
 
     # -- device programs ---------------------------------------------------
-    def _admit_dev(self, params, dev, padded, slot, temp, key, pad):
+    def _admit_dev(self, params, dev, padded, slot, temp, key, pad, bank,
+                   aidx):
         """Prefill one request on a [1, bucket] shape, splice its cache row
         into the pool, seat its decode state at *slot*, and sample the
         first token — all on device (no host fetch on the admit path).
         ``pad`` is traced: prompts of every length within a bucket share
         one compiled program (the O(log max_seq) compile story)."""
         row_cache, last_logits = self.engine.prefill(
-            params, padded, pad_left=pad
+            params, padded, pad_left=pad,
+            adapters=bank, adapter_idx=aidx[None] if bank else None,
         )
         bucket = padded.shape[1]
-        cache = jax.tree.map(
-            lambda p, r: jax.lax.dynamic_update_slice(
-                p, r.astype(p.dtype), (0, slot, 0, 0, 0)
-            ),
-            dev["cache"],
-            row_cache,
-        )
         first, key = self._first_token(last_logits[0], temp, key)
-        return {
-            "cache": cache,
-            "token": dev["token"].at[slot].set(first),
-            "pos": dev["pos"].at[slot].set(bucket),
-            "rope": dev["rope"].at[slot].set(bucket - pad),
-            "start": dev["start"].at[slot].set(pad),
-            "temps": dev["temps"].at[slot].set(temp),
-            "keys": dev["keys"].at[slot].set(key),
-        }, first
+        return self._seat(
+            dev, row_cache, slot, first, bucket, bucket - pad, pad, temp,
+            key, aidx,
+        ), first
 
     @staticmethod
     def _first_token(logits, temp, key):
@@ -251,6 +256,28 @@ class ContinuousBatcher:
             sub, logits / jnp.maximum(temp, 1e-6)
         ).astype(jnp.int32)
         return jnp.where(temp > 0, sampled, greedy), key
+
+    def _seat(self, dev, row, slot, first, pos, rope, start, temp, key,
+              aidx):
+        """Splice a prefilled K/V row into the pool and seat a slot's
+        decode state — the single owner of the per-slot field list (a
+        field added here reaches all three admission paths at once)."""
+        cache = jax.tree.map(
+            lambda p, r: jax.lax.dynamic_update_slice(
+                p, r.astype(p.dtype), (0, slot, 0, 0, 0)
+            ),
+            dev["cache"], row,
+        )
+        return {
+            "cache": cache,
+            "token": dev["token"].at[slot].set(first),
+            "pos": dev["pos"].at[slot].set(pos),
+            "rope": dev["rope"].at[slot].set(rope),
+            "start": dev["start"].at[slot].set(start),
+            "temps": dev["temps"].at[slot].set(temp),
+            "keys": dev["keys"].at[slot].set(key),
+            "aidx": dev["aidx"].at[slot].set(aidx),
+        }
 
     def _admit_prefix_dev(self, params, dev, base, suffix, n_real, slot,
                           temp, key, base_pos):
@@ -267,46 +294,22 @@ class ContinuousBatcher:
             jnp.asarray([base_pos]), jnp.asarray([base_pos]),
             jnp.asarray([0]),
         )
-        cache = jax.tree.map(
-            lambda p, r: jax.lax.dynamic_update_slice(
-                p, r.astype(p.dtype), (0, slot, 0, 0, 0)
-            ),
-            dev["cache"], row,
-        )
         first, key = self._first_token(logits[0, n_real - 1], temp, key)
         pos = base_pos + n_real
-        return {
-            "cache": cache,
-            "token": dev["token"].at[slot].set(first),
-            "pos": dev["pos"].at[slot].set(pos),
-            "rope": dev["rope"].at[slot].set(pos),
-            "start": dev["start"].at[slot].set(0),
-            "temps": dev["temps"].at[slot].set(temp),
-            "keys": dev["keys"].at[slot].set(key),
-        }, first
+        return self._seat(
+            dev, row, slot, first, pos, pos, 0, temp, key, 0
+        ), first
 
-    def _admit_exact_dev(self, params, dev, base, base_logits, base_pos,
+    def _admit_exact_dev(self, dev, base, base_logits, base_pos,
                          slot, temp, key):
         """Admit a prompt that IS a cached prefix: splice + sample, no
         model forward at all."""
-        cache = jax.tree.map(
-            lambda p, r: jax.lax.dynamic_update_slice(
-                p, r.astype(p.dtype), (0, slot, 0, 0, 0)
-            ),
-            dev["cache"], base,
-        )
         first, key = self._first_token(base_logits[0], temp, key)
-        return {
-            "cache": cache,
-            "token": dev["token"].at[slot].set(first),
-            "pos": dev["pos"].at[slot].set(base_pos),
-            "rope": dev["rope"].at[slot].set(base_pos),
-            "start": dev["start"].at[slot].set(0),
-            "temps": dev["temps"].at[slot].set(temp),
-            "keys": dev["keys"].at[slot].set(key),
-        }, first
+        return self._seat(
+            dev, base, slot, first, base_pos, base_pos, 0, temp, key, 0
+        ), first
 
-    def _round_dev(self, params, dev):
+    def _round_dev(self, params, dev, bank):
         """One scheduler round: ``steps_per_round`` batched decode steps as
         a single on-device scan.  Returns (new_dev, tokens [T, B]).  Rows
         that hit EOS/budget mid-round produce garbage tails the host drops
@@ -317,7 +320,9 @@ class ContinuousBatcher:
         def one(carry, _):
             cache, token, pos, rope, keys = carry
             cache, logits = self.engine.decode_step_multi(
-                params, cache, token, pos, rope, kv_start
+                params, cache, token, pos, rope, kv_start,
+                adapters=bank,
+                adapter_idx=dev["aidx"] if bank else None,
             )
             split = jax.vmap(jax.random.split)(keys)     # [B, 2, 2]
             new_keys, subs = split[:, 0], split[:, 1]
@@ -338,6 +343,7 @@ class ContinuousBatcher:
         return {
             "cache": cache, "token": token, "pos": pos, "rope": rope,
             "start": kv_start, "temps": temps, "keys": keys,
+            "aidx": dev["aidx"],
         }, toks
 
     # -- public surface ----------------------------------------------------
@@ -356,9 +362,12 @@ class ContinuousBatcher:
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         seed: int = 0,
+        adapter: str | None = None,
     ) -> RequestHandle:
         """Queue a request; returns a handle streaming generated ids.
-        Raises ValueError when the prompt cannot fit."""
+        Raises ValueError when the prompt cannot fit, KeyError for an
+        unknown ``adapter`` name."""
+        aidx = self.bank.index(adapter)
         ids = np.asarray(ids, np.int32).ravel()
         bucket = prompt_bucket(int(ids.size), self.engine.max_seq)
         if bucket is None:
@@ -372,6 +381,7 @@ class ContinuousBatcher:
             max_new=max(1, min(int(max_new_tokens), room)),
             temperature=float(temperature),
             seed=int(seed),
+            aidx=aidx,
         )
         with self._lifecycle:
             if self._dead:
@@ -407,9 +417,20 @@ class ContinuousBatcher:
         ids = np.asarray(ids, np.int32).ravel()
         if ids.size == 0 or ids.size > self.engine.max_seq - 8:
             raise ValueError(f"prefix length {ids.size} unusable")
-        cache, logits = self._prefill_jit(
-            self.params, jnp.asarray(ids)[None], 0
+        # Bucketed width via extend_multi (RIGHT-padded, logits gathered
+        # at the last real position): one compile per power-of-2 bucket.
+        # Exact-shape prefill would hand the unauthenticated /precache
+        # endpoint an unbounded per-length XLA compile cache.  Pad K/V
+        # garbage lands at positions >= n — the suffix/decode writes
+        # overwrite it in order and position masks never attend it.
+        n = int(ids.size)
+        w = min(_suffix_bucket(n), self.engine.max_seq)
+        padded = jnp.zeros((1, w), jnp.int32).at[0, :n].set(jnp.asarray(ids))
+        cache, all_logits = self._precache_jit(
+            self.params, _empty_cache(self.engine.cfg, 1, self.engine.max_seq),
+            padded,
         )
+        logits = all_logits[:, n - 1]
         with self._prefix_lock:
             self._prefix[ids.tobytes()] = {
                 "cache": cache, "logits": logits, "n": int(ids.size),
@@ -453,11 +474,13 @@ class ContinuousBatcher:
         return -1
 
     def _dispatch_admit(self, req: _Request, slot: int) -> tuple:
-        entry = self._match_prefix(req.ids)
+        # Prefix-cache entries hold BASE-model K/V; an adapter row must
+        # cold-prefill (its prefix K/V differ) — correctness over reuse.
+        entry = self._match_prefix(req.ids) if req.aidx == 0 else None
         if entry is not None and entry["n"] == req.ids.size:
             # The prompt IS a cached prefix: splice + sample, zero forward.
             self._dev, first = self._admit_exact_jit(
-                self.params, self._dev, entry["cache"], entry["logits"],
+                self._dev, entry["cache"], entry["logits"],
                 jnp.int32(entry["n"]), jnp.int32(slot),
                 jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
             )
@@ -487,6 +510,7 @@ class ContinuousBatcher:
                 self.params, self._dev, padded, jnp.int32(slot),
                 jnp.float32(req.temperature),
                 jax.random.PRNGKey(req.seed), jnp.int32(pad),
+                self.bank.banked, jnp.int32(req.aidx),
             )
         req.slot = slot
         self._active[slot] = req
@@ -497,7 +521,9 @@ class ContinuousBatcher:
         # processed the slot may have been retired AND re-admitted to a new
         # request, whose stream must not receive this round's tokens.
         live = [(i, r) for i, r in enumerate(self._active) if r is not None]
-        self._dev, toks = self._round_jit(self.params, self._dev)
+        self._dev, toks = self._round_jit(
+            self.params, self._dev, self.bank.banked
+        )
         self._round_count += 1
         return ("round", self._round_count, live, toks)
 

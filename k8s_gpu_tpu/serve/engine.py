@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.transformer import (
     TransformerConfig, TransformerLM, emb_lookup, wt,
 )
+from .lora_bank import lora_delta
 
 
 @dataclass(frozen=True)
@@ -108,7 +109,7 @@ class InferenceEngine:
         return jnp.einsum("bhqk,bhkd->bqhd", p, v_cache)
 
     def _block_cached(self, x, lp, cache_k, cache_v, positions, start, mask,
-                      moe_full_capacity=None):
+                      moe_full_capacity=None, lp_ad=None, adapter_idx=None):
         """One transformer block over query slice x [B,Sq,D] with the K/V for
         the slice written into the layer cache at ``start``.  Returns
         (x_out, new_cache_k, new_cache_v).
@@ -126,6 +127,16 @@ class InferenceEngine:
         q = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wq"], dt))
         k = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wk"], dt))
         v = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wv"], dt))
+        if lp_ad is not None:
+            # Per-row LoRA deltas (serve/lora_bank.py): same inputs the
+            # base matmuls consume, low-rank path gathered by row index.
+            hd = (x.shape[0], x.shape[1], self.cfg.n_heads, self.cfg.d_head)
+            if "wq" in lp_ad:
+                q = q + lora_delta(h, lp_ad["wq"], adapter_idx, dt).reshape(hd)
+            if "wk" in lp_ad:
+                k = k + lora_delta(h, lp_ad["wk"], adapter_idx, dt).reshape(hd)
+            if "wv" in lp_ad:
+                v = v + lora_delta(h, lp_ad["wv"], adapter_idx, dt).reshape(hd)
         q = m._rope(q, positions)
         k = m._rope(k, positions)
         k = k.transpose(0, 2, 1, 3)  # [B,H,Sq,Dh]
@@ -149,7 +160,13 @@ class InferenceEngine:
             cache_k = cache_k.at[rows, :, cols].set(k.transpose(0, 2, 1, 3))
             cache_v = cache_v.at[rows, :, cols].set(v.transpose(0, 2, 1, 3))
         o = self._attend_cached(q, cache_k, cache_v, mask)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, wt(lp["wo"], dt))
+        attn_out = jnp.einsum("bshk,hkd->bsd", o, wt(lp["wo"], dt))
+        if lp_ad is not None and "wo" in lp_ad:
+            o_flat = o.reshape(o.shape[0], o.shape[1], -1)
+            attn_out = attn_out + lora_delta(
+                o_flat, lp_ad["wo"], adapter_idx, dt
+            )
+        x = x + attn_out
         h2 = m._rmsnorm(x, lp["ln2"])
         if self.cfg.moe:
             # Full capacity only at decode (query length 1): there G = B and
@@ -170,25 +187,37 @@ class InferenceEngine:
         return x, cache_k, cache_v
 
     def _run_blocks(self, params, x, cache, positions, start, mask,
-                    moe_full_capacity=None):
-        def scan_fn(carry, layer):
-            lp, ck, cv = layer
-            y, ck, cv = self._block_cached(
-                carry, lp, ck, cv, positions, start, mask,
-                moe_full_capacity=moe_full_capacity,
-            )
-            return y, (ck, cv)
+                    moe_full_capacity=None, adapters=None, adapter_idx=None):
+        if adapters is None:
+            def scan_fn(carry, layer):
+                lp, ck, cv = layer
+                y, ck, cv = self._block_cached(
+                    carry, lp, ck, cv, positions, start, mask,
+                    moe_full_capacity=moe_full_capacity,
+                )
+                return y, (ck, cv)
 
-        x, (ck, cv) = jax.lax.scan(
-            scan_fn, x, (params["blocks"], cache["k"], cache["v"])
-        )
+            xs = (params["blocks"], cache["k"], cache["v"])
+        else:
+            def scan_fn(carry, layer):
+                lp, ck, cv, lp_ad = layer
+                y, ck, cv = self._block_cached(
+                    carry, lp, ck, cv, positions, start, mask,
+                    moe_full_capacity=moe_full_capacity,
+                    lp_ad=lp_ad, adapter_idx=adapter_idx,
+                )
+                return y, (ck, cv)
+
+            xs = (params["blocks"], cache["k"], cache["v"], adapters)
+        x, (ck, cv) = jax.lax.scan(scan_fn, x, xs)
         m = self.model
         x = m._rmsnorm(x, params["final_norm"])
         logits = jnp.einsum("bsd,dv->bsv", x, wt(params["head"], self.cfg.dtype))
         return logits.astype(jnp.float32), {"k": ck, "v": cv}
 
     # -- public jittable pieces -------------------------------------------
-    def prefill(self, params, tokens, pad_left=0):
+    def prefill(self, params, tokens, pad_left=0, adapters=None,
+                adapter_idx=None):
         """tokens [B, S] → (cache, last_logits [B, V]).  S must be ≤ max_seq.
 
         ``pad_left`` (scalar, may be traced): number of leading positions
@@ -210,7 +239,10 @@ class InferenceEngine:
             & (t[None, :] >= pad_left)
         )
         mask = jnp.broadcast_to(mask, (B, S, self.max_seq))
-        logits, cache = self._run_blocks(params, x, cache, positions, 0, mask)
+        logits, cache = self._run_blocks(
+            params, x, cache, positions, 0, mask,
+            adapters=adapters, adapter_idx=adapter_idx,
+        )
         return cache, logits[:, -1]
 
     def decode_step(self, params, cache, pos, token, rope_pos=None,
@@ -233,7 +265,8 @@ class InferenceEngine:
         )
         return cache, logits[:, 0]
 
-    def decode_step_multi(self, params, cache, token, pos, rope_pos, kv_start):
+    def decode_step_multi(self, params, cache, token, pos, rope_pos,
+                          kv_start, adapters=None, adapter_idx=None):
         """One decode step where every batch row sits at its *own* cache
         position — the continuous-batching kernel.
 
@@ -250,11 +283,12 @@ class InferenceEngine:
         )[:, None, :]  # [B, 1, T]
         logits, cache = self._run_blocks(
             params, x, cache, jnp.asarray(rope_pos, jnp.int32)[:, None], pos,
-            mask,
+            mask, adapters=adapters, adapter_idx=adapter_idx,
         )
         return cache, logits[:, 0]
 
-    def extend_multi(self, params, cache, tokens, start, rope_start, kv_start):
+    def extend_multi(self, params, cache, tokens, start, rope_start,
+                     kv_start, adapters=None, adapter_idx=None):
         """Multi-token cached forward where every row writes its *own*
         window — the speculative-decoding verify kernel.
 
@@ -287,7 +321,8 @@ class InferenceEngine:
         # dispatch here would make verify logits diverge from the decode
         # path and break speculative greedy-exactness for MoE targets.
         logits, cache = self._run_blocks(
-            params, x, cache, rope, start, mask, moe_full_capacity=True
+            params, x, cache, rope, start, mask, moe_full_capacity=True,
+            adapters=adapters, adapter_idx=adapter_idx,
         )
         return cache, logits
 

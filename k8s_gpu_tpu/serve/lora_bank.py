@@ -1,0 +1,107 @@
+"""Multi-LoRA serving: a bank of adapters, one batched decode.
+
+The platform trains LoRA fine-tunes (train/lora.py, the reference's
+prescribed PEFT recipe, 模型微调最佳实践.md:19-33); serving them
+one-process-per-adapter would waste a chip per tenant.  The bank stacks
+every adapter into per-layer arrays so a single decode program serves
+base and all adapters at once — each batch row gathers ITS adapter by
+index (the S-LoRA/punica idea, XLA-shaped):
+
+- leaves are stacked ``[L, K+1, fin, R]`` / ``[L, K+1, R, fout]`` — the
+  layer axis leads so adapters ride the engine's existing layer scan;
+- index 0 is the base "adapter": exact zeros, so base rows compute
+  ``x@W + (x@0)@0`` — bitwise identical to the un-adapted program;
+- heterogeneous ranks zero-pad to the bank max (padding contributes
+  exactly zero to the delta);
+- each adapter's LoRA scale is folded into its B half at bank build
+  (``scale·(xA)B = (xA)(scale·B)``), so runtime needs no per-row scale.
+
+Adding/removing an adapter rebuilds the bank (K changes the array
+shapes → one recompile); banks are small — K·L·(fin+fout)·R floats.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..train.lora import LoraConfig
+
+# Engine-supported targets: the attention projections (train/lora.py's
+# default recipe).  MLP adapters would follow the same pattern.
+SERVABLE_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+class AdapterBank:
+    """names[0] is always "__base__" (the zero adapter)."""
+
+    def __init__(self, adapters: dict[str, tuple[dict, LoraConfig]]):
+        """adapters: name → (lora_params from LoraAdapter.init, its
+        LoraConfig).  Only attention-projection targets are banked;
+        an adapter carrying other targets is rejected loudly rather
+        than silently serving a different model than was trained."""
+        self.names = ["__base__"] + sorted(adapters)
+        for name, (tree, _) in adapters.items():
+            extra = [
+                t for t in tree.get("blocks", {}) if t not in SERVABLE_TARGETS
+            ] + [t for t in tree if t != "blocks"]
+            if extra:
+                raise ValueError(
+                    f"adapter {name!r} adapts {extra}; the serving bank "
+                    f"supports {SERVABLE_TARGETS} only"
+                )
+        if not adapters:
+            self.banked = None
+            return
+        ranks = {
+            name: next(iter(tree["blocks"].values()))["a"].shape[-1]
+            for name, (tree, _) in adapters.items()
+        }
+        R = max(ranks.values())
+        # Leaf shapes come from whichever adapter carries each target.
+        shapes = {}
+        for name, (tree, _) in adapters.items():
+            for t, ab in tree["blocks"].items():
+                L, fin, _ = ab["a"].shape
+                fout = ab["b"].shape[-1]
+                shapes[t] = (L, fin, fout)
+        K = len(self.names)
+        banked = {}
+        for t, (L, fin, fout) in shapes.items():
+            a = np.zeros((L, K, fin, R), np.float32)
+            b = np.zeros((L, K, R, fout), np.float32)
+            for i, name in enumerate(self.names[1:], start=1):
+                tree, cfg = adapters[name]
+                ab = tree["blocks"].get(t)
+                if ab is None:
+                    continue
+                r = ab["a"].shape[-1]
+                a[:, i, :, :r] = np.asarray(ab["a"], np.float32)
+                b[:, i, :r, :] = np.asarray(ab["b"], np.float32) * cfg.scale
+            banked[t] = {"a": jnp.asarray(a), "b": jnp.asarray(b)}
+        self.banked = banked
+
+    def index(self, name: str | None) -> int:
+        if name is None:
+            return 0
+        try:
+            return self.names.index(name)
+        except ValueError:
+            raise KeyError(
+                f"unknown adapter {name!r}; serving {self.names[1:]}"
+            ) from None
+
+
+def lora_delta(inp, ad, idx, dt):
+    """Per-row low-rank correction for one layer's target.
+
+    inp [B, S, fin] (the same activation the base matmul consumes,
+    flattened on its input dims); ad {"a": [K, fin, R], "b": [K, R,
+    fout]} (this layer's bank slice); idx [B] adapter per row.
+    Returns [B, S, fout].
+    """
+    a = ad["a"][idx].astype(dt)   # [B, fin, R]
+    b = ad["b"][idx].astype(dt)   # [B, R, fout]
+    xa = jnp.einsum("bsf,bfr->bsr", inp, a)
+    return jnp.einsum("bsr,bro->bso", xa, b)

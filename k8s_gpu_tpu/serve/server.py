@@ -39,9 +39,13 @@ class LmServer:
         max_new_tokens_cap: int = 256,
         slots: int = 4,
         mesh=None,
+        adapters: dict | None = None,
     ):
+        """``adapters``: name → (lora_params, LoraConfig); requests pick
+        one with {"adapter": "<name>"} — multi-tenant fine-tunes served
+        from one decode program (serve/lora_bank.py)."""
         self.batcher = ContinuousBatcher(
-            model, params, slots=slots, mesh=mesh
+            model, params, slots=slots, mesh=mesh, adapters=adapters
         )
         self.tokenizer = tokenizer
         self.started_at = time.time()
@@ -104,6 +108,9 @@ class LmServer:
                     seed = int(body.get("seed", 0))
                 except (TypeError, ValueError) as e:
                     return self._json(400, {"error": f"bad parameter: {e}"})
+                adapter = body.get("adapter")
+                if adapter is not None and not isinstance(adapter, str):
+                    return self._json(400, {"error": "adapter must be a string"})
                 stream = bool(body.get("stream", False))
                 ids = outer.tokenizer.encode(prompt)
                 t0 = time.perf_counter()
@@ -113,9 +120,12 @@ class LmServer:
                         max_new_tokens=max(1, min(want, outer.cap)),
                         temperature=temperature,
                         seed=seed,
+                        adapter=adapter,
                     )
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
+                except KeyError as e:  # unknown adapter name
+                    return self._json(400, {"error": e.args[0]})
                 except RuntimeError as e:  # scheduler dead: clean 503
                     return self._json(503, {"error": str(e)})
                 if stream:
