@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from ..utils.metrics import global_metrics
 from .engine import InferenceEngine, _empty_cache
 
 log = logging.getLogger("k8s_gpu_tpu.serve")
@@ -91,6 +92,12 @@ class _Request:
     out: queue.Queue = field(default_factory=queue.Queue)
     slot: int = -1
     aidx: int = 0            # adapter bank index (0 = base model)
+    # (row_cache, last_logits, pos, rope, start): K/V computed by a
+    # prefill worker (serve/disagg.py); admission splices, no forward.
+    precomputed: tuple | None = None
+    # Called once when the row is spliced into the pool (the precomputed
+    # K/V's HBM lifetime ends there) — disagg backpressure hook.
+    on_admit: object = None
     emitted: int = 0
     # True when the stream ended because the batcher crashed/stopped, not
     # because of EOS/budget — servers map this to a 5xx, not a 200.
@@ -300,13 +307,16 @@ class ContinuousBatcher:
             dev, row, slot, first, pos, pos, 0, temp, key, 0
         ), first
 
-    def _admit_exact_dev(self, dev, base, base_logits, base_pos,
-                         slot, temp, key):
-        """Admit a prompt that IS a cached prefix: splice + sample, no
-        model forward at all."""
+    def _admit_exact_dev(self, dev, base, base_logits, pos, rope, start,
+                         slot, temp, key, aidx):
+        """Seat a row whose K/V were computed elsewhere: splice + sample,
+        no model forward on THIS program.  Two callers: a prompt that IS
+        a cached prefix (pos=rope=n, start=0), and disaggregated-prefill
+        admission (serve/disagg.py — a prefill worker hands over the row
+        with its bucketing geometry intact)."""
         first, key = self._first_token(base_logits[0], temp, key)
         return self._seat(
-            dev, base, slot, first, base_pos, base_pos, 0, temp, key, 0
+            dev, base, slot, first, pos, rope, start, temp, key, aidx
         ), first
 
     def _round_dev(self, params, dev, bank):
@@ -382,6 +392,58 @@ class ContinuousBatcher:
             temperature=float(temperature),
             seed=int(seed),
             aidx=aidx,
+        )
+        with self._lifecycle:
+            if self._dead:
+                raise RuntimeError(
+                    "batcher scheduler is stopped; restart the server"
+                )
+            self._pending.put(req)
+        self._wake.set()
+        return RequestHandle(req)
+
+    def submit_precomputed(
+        self, row_cache, last_logits, n_tokens: int, pad: int,
+        max_new_tokens: int = 32, temperature: float = 0.0, seed: int = 0,
+        adapter: str | None = None, on_admit=None,
+    ) -> RequestHandle:
+        """Admit a request whose prefill ran elsewhere (serve/disagg.py):
+        ``row_cache`` is a [L, 1, H, max_seq, Dh] K/V tree computed at a
+        [1, n_tokens] bucket with ``pad`` leading pad slots;
+        ``last_logits`` [1, V] are the logits at the final prompt
+        position.  The decode side only splices and samples."""
+        aidx = self.bank.index(adapter)
+        room = self.engine.max_seq - n_tokens
+        if room < 1:
+            raise ValueError("precomputed prompt fills max_seq")
+        # Validate shapes HERE, in the caller's thread: a mis-shaped tree
+        # would otherwise explode inside the scheduler loop and take the
+        # whole batcher (and every tenant's stream) down with it.
+        cfg = self.engine.cfg
+        want = (cfg.n_layers, 1, cfg.n_heads, self.engine.max_seq,
+                cfg.d_head)
+        for leaf in jax.tree.leaves(row_cache):
+            if tuple(leaf.shape) != want:
+                raise ValueError(
+                    f"row_cache leaf shape {tuple(leaf.shape)} != {want} "
+                    "(was it prefilled by an engine with a different "
+                    "max_seq?)"
+                )
+        if tuple(last_logits.shape) != (1, cfg.vocab_size):
+            raise ValueError(
+                f"last_logits shape {tuple(last_logits.shape)} != "
+                f"(1, {cfg.vocab_size})"
+            )
+        req = _Request(
+            ids=np.zeros(0, np.int32),
+            max_new=max(1, min(int(max_new_tokens), room)),
+            temperature=float(temperature),
+            seed=int(seed),
+            aidx=aidx,
+            precomputed=(
+                row_cache, last_logits, n_tokens, n_tokens - pad, pad,
+            ),
+            on_admit=on_admit,
         )
         with self._lifecycle:
             if self._dead:
@@ -474,6 +536,20 @@ class ContinuousBatcher:
         return -1
 
     def _dispatch_admit(self, req: _Request, slot: int) -> tuple:
+        if req.precomputed is not None:
+            row, logits, pos, rope, start = req.precomputed
+            self._dev, first = self._admit_exact_jit(
+                self._dev, row, logits, jnp.int32(pos), jnp.int32(rope),
+                jnp.int32(start), jnp.int32(slot),
+                jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
+                jnp.int32(req.aidx),
+            )
+            # Drop the row reference (it lives on in the pool cache) and
+            # signal the prefill pool that its HBM is reclaimable.
+            req.precomputed = None
+            if req.on_admit is not None:
+                req.on_admit()
+            return self._seated(req, slot, first, "precomputed")
         # Prefix-cache entries hold BASE-model K/V; an adapter row must
         # cold-prefill (its prefix K/V differ) — correctness over reuse.
         entry = self._match_prefix(req.ids) if req.aidx == 0 else None
@@ -481,8 +557,10 @@ class ContinuousBatcher:
             # The prompt IS a cached prefix: splice + sample, zero forward.
             self._dev, first = self._admit_exact_jit(
                 self._dev, entry["cache"], entry["logits"],
-                jnp.int32(entry["n"]), jnp.int32(slot),
+                jnp.int32(entry["n"]), jnp.int32(entry["n"]), jnp.int32(0),
+                jnp.int32(slot),
                 jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
+                jnp.int32(0),
             )
         elif entry is not None and (
             entry["n"] + _suffix_bucket(req.ids.size - entry["n"])
@@ -512,8 +590,26 @@ class ContinuousBatcher:
                 jax.random.PRNGKey(req.seed), jnp.int32(pad),
                 self.bank.banked, jnp.int32(req.aidx),
             )
+        path = (
+            "prefix_exact" if entry is not None and entry["n"] == req.ids.size
+            else "prefix_suffix" if entry is not None
+            else "cold"
+        )
+        return self._seated(req, slot, first, path)
+
+    def _seated(self, req: _Request, slot: int, first, path: str) -> tuple:
+        """Common tail of every admission: bookkeeping + C32 counters
+        (admissions by path, live-slot gauge, pending-queue gauge)."""
         req.slot = slot
         self._active[slot] = req
+        global_metrics.inc("serve_admissions_total", path=path)
+        global_metrics.set_gauge(
+            "serve_slots_active",
+            float(sum(r is not None for r in self._active)),
+        )
+        global_metrics.set_gauge(
+            "serve_pending_requests", float(self._pending.qsize())
+        )
         return ("admit", req, first)
 
     def _dispatch_round(self) -> tuple:
@@ -536,7 +632,15 @@ class ContinuousBatcher:
         req = self._active[slot]
         if req is not None:
             req.out.put(None)  # completion sentinel
+            global_metrics.inc("serve_completions_total")
+            global_metrics.observe(
+                "serve_generated_tokens", float(req.emitted)
+            )
         self._active[slot] = None
+        global_metrics.set_gauge(
+            "serve_slots_active",
+            float(sum(r is not None for r in self._active)),
+        )
 
     def _process(self, item: tuple) -> None:
         """Consume one in-flight item — the only place the scheduler blocks
@@ -621,4 +725,9 @@ class ContinuousBatcher:
                     except queue.Empty:
                         break
                     r.aborted = True
+                    # A drained precomputed request will never be seated:
+                    # fire its admit hook so the prefill pool's inflight
+                    # semaphore doesn't leak a permit.
+                    if r.on_admit is not None:
+                        r.on_admit()
                     r.out.put(None)

@@ -1,0 +1,165 @@
+"""Disaggregated prefill/decode serving.
+
+Prefill and decode have opposite hardware appetites: prefill is one
+big compute-bound matmul burst, decode is a bandwidth-bound trickle.
+Co-locating them makes long prompts stall every in-flight decode for
+the duration of their prefill (head-of-line blocking on the device).
+Disaggregation runs prefill in its OWN worker pool (its own engine —
+same chip, another core's program slot, or a different mesh entirely)
+and hands the finished K/V row to the decode batcher, whose admission
+is then a pure splice+sample (``submit_precomputed`` →
+``_admit_exact_dev``) — the decode program never runs a prompt-width
+forward.
+
+This is the Splitwise/DistServe shape, sized for this framework: the
+KV "transfer" is a device array handed between jitted programs (same
+process; across meshes XLA reshards it), and the landing mechanism is
+the same seat-and-splice the prefix cache already uses.
+
+The pool preserves the batcher's contracts: greedy streams are
+oracle-exact (prefill is the same bucketed computation, just run
+elsewhere), adapters ride through (the pool prefills with the bank when
+a request names one), and shutdown drains cleanly.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .batcher import ContinuousBatcher, RequestHandle, prompt_bucket
+
+
+@dataclass
+class _PrefillJob:
+    ids: np.ndarray
+    max_new: int
+    temperature: float
+    seed: int
+    adapter: str | None
+    # filled by the worker: the handle of the decode-side request
+    done: "queue.Queue[object]" = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.done is None:
+            self.done = queue.Queue()
+
+
+class DisaggregatedLm:
+    """Prefill workers + a decode batcher.
+
+    ``submit`` returns the same RequestHandle the batcher gives; callers
+    cannot tell the difference — except that a long prompt no longer
+    blocks the decode rounds of everyone else.
+    """
+
+    def __init__(self, model, params, *, batcher: ContinuousBatcher,
+                 prefill_workers: int = 1, inflight_cap: int | None = None):
+        """``inflight_cap`` bounds prefilled-but-not-yet-seated rows
+        (each pins a full [L,1,H,max_seq,Dh] K/V row in HBM while it
+        waits for a decode slot).  Default: the batcher's slot count —
+        prefill never runs more than one slot-generation ahead."""
+        self.batcher = batcher
+        self.params = params
+        self._inflight = threading.Semaphore(
+            inflight_cap if inflight_cap is not None else batcher.slots
+        )
+        # The pool's own engine: same model/config as the decode side,
+        # independent program (on multi-chip deployments this is where a
+        # separate prefill mesh plugs in).
+        from .engine import InferenceEngine
+
+        self.engine = InferenceEngine(model, max_seq=batcher.engine.max_seq)
+        self._prefill_jit = jax.jit(self.engine.prefill)
+        self._jobs: "queue.Queue[_PrefillJob | None]" = queue.Queue()
+        self._dead = False
+        self._lifecycle = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"prefill-{i}",
+                             daemon=True)
+            for i in range(max(1, prefill_workers))
+        ]
+
+    def start(self) -> "DisaggregatedLm":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lifecycle:
+            self._dead = True
+        for _ in self._threads:
+            self._jobs.put(None)
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def submit(self, ids, max_new_tokens: int = 32, temperature: float = 0.0,
+               seed: int = 0, adapter: str | None = None) -> RequestHandle:
+        """Queue a request; prefill happens on the pool, decode on the
+        batcher.  Raises like ContinuousBatcher.submit."""
+        self.batcher.bank.index(adapter)  # unknown names fail fast
+        ids = np.asarray(ids, np.int32).ravel()
+        if prompt_bucket(int(ids.size), self.engine.max_seq) is None:
+            raise ValueError(
+                f"prompt too long ({ids.size} tokens, "
+                f"max {self.engine.max_seq - 8})"
+            )
+        job = _PrefillJob(ids, int(max_new_tokens), float(temperature),
+                          int(seed), adapter)
+        with self._lifecycle:
+            if self._dead:
+                raise RuntimeError("prefill pool is stopped")
+            self._jobs.put(job)
+        out = job.done.get()
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    # -- worker ------------------------------------------------------------
+    def _worker(self) -> None:
+        bank = self.batcher.bank
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            try:
+                # Backpressure BEFORE the prefill: don't burn compute on
+                # (and pin HBM for) a row no decode slot can take yet.
+                self._inflight.acquire()
+                released = False
+                try:
+                    bucket = prompt_bucket(
+                        int(job.ids.size), self.engine.max_seq
+                    )
+                    pad = bucket - int(job.ids.size)
+                    padded = jnp.zeros((1, bucket), jnp.int32).at[
+                        0, pad:
+                    ].set(jnp.asarray(job.ids))
+                    aidx = bank.index(job.adapter)
+                    row, logits = self._prefill_jit(
+                        self.params, padded, jnp.int32(pad),
+                        adapters=bank.banked,
+                        adapter_idx=(
+                            jnp.asarray([aidx]) if bank.banked else None
+                        ),
+                    )
+                    handle = self.batcher.submit_precomputed(
+                        row, logits, bucket, pad,
+                        max_new_tokens=job.max_new,
+                        temperature=job.temperature,
+                        seed=job.seed,
+                        adapter=job.adapter,
+                        on_admit=self._inflight.release,
+                    )
+                    released = True  # the on_admit hook owns the release
+                    job.done.put(handle)
+                finally:
+                    if not released:
+                        self._inflight.release()
+            except Exception as e:  # surface to the submitter, keep serving
+                job.done.put(e)

@@ -305,3 +305,24 @@ def test_prefix_cache_refused_for_moe():
     b = ContinuousBatcher(model, model.init(jax.random.PRNGKey(0)), slots=2)
     with pytest.raises(ValueError, match="MoE"):
         b.precache_prefix([1, 2, 3])
+
+
+def test_serving_metrics_recorded(setup):
+    """C32 for the serving stack: admissions by path, slot gauge, and
+    completion counters land in the shared registry."""
+    from k8s_gpu_tpu.utils.metrics import global_metrics
+
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2).start()
+    try:
+        b.precache_prefix([5, 9, 17])
+        b.submit([5, 9, 17, 4], max_new_tokens=3).result()   # prefix_suffix
+        b.submit([5, 9, 17], max_new_tokens=3).result()      # prefix_exact
+        b.submit([8, 6], max_new_tokens=3).result()          # cold
+        rendered = global_metrics.render()
+        for path in ("cold", "prefix_suffix", "prefix_exact"):
+            assert f'serve_admissions_total{{path="{path}"}}' in rendered, path
+        assert "serve_completions_total" in rendered
+        assert global_metrics.gauge("serve_slots_active") == 0.0
+    finally:
+        b.stop()
