@@ -86,11 +86,13 @@ def build_mesh(config: MeshConfig | None = None, n_devices: int | None = None) -
     return mesh_from_devices(devs, config)
 
 
-def multislice_mesh(config: MeshConfig, num_slices: int) -> Mesh:
+def multislice_mesh(config: MeshConfig, num_slices: int,
+                    devices=None) -> Mesh:
     """Multislice layout: dp MUST span slices (DCN) and every other axis must
     stay inside a slice (ICI) — the BASELINE config-4 invariant.  Validates
-    dp % num_slices == 0 and that per-slice axes fit in one slice."""
-    devs = jax.devices()
+    dp % num_slices == 0 and that per-slice axes fit in one slice.
+    ``devices``: explicit device list (defaults to all of jax.devices())."""
+    devs = list(devices) if devices is not None else jax.devices()
     sizes = config.resolve(len(devs))
     if sizes["dp"] % num_slices != 0:
         raise ValueError(

@@ -3,6 +3,7 @@ int8 weight-only quantization, LM HTTP server."""
 
 from .batcher import ContinuousBatcher, RequestHandle
 from .bundle import export_servable, load_servable
+from .constrain import RegexConstraint, compile_constraint
 from .disagg import DisaggregatedLm
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
 from .quant import quantize_params
@@ -13,5 +14,5 @@ __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
     "ContinuousBatcher", "RequestHandle", "SpeculativeDecoder",
     "SpecOutput", "quantize_params", "export_servable", "load_servable",
-    "DisaggregatedLm",
+    "DisaggregatedLm", "RegexConstraint", "compile_constraint",
 ]

@@ -389,6 +389,107 @@ class InferenceEngine:
         # dict, not DecodeOutput: jit outputs must be pytrees.
         return {"tokens": toks, "lengths": lengths, "prompt_logits": last_logits}
 
+    # -- constrained generation -------------------------------------------
+    def _generate_constrained(self, params, prompt, key, pad_left, tables,
+                              start, *, max_new_tokens: int,
+                              sampling: SamplingConfig):
+        nxt_tab, allow_tab, accepting = (
+            tables["next"], tables["allowed"], tables["accepting"],
+        )
+        B, S = prompt.shape
+        cache, last_logits = self.prefill(params, prompt, pad_left)
+        state = jnp.full((B,), start, jnp.int32)
+        done = jnp.zeros((B,), bool)
+
+        def pick(logits, st, dn, k):
+            mask = allow_tab[st] & ~dn[:, None]
+            any_ok = mask.any(-1)
+            masked = jnp.where(mask, logits, -jnp.inf)
+            tok = self._sample(masked, k, sampling)
+            # Invalid rows (all -inf) sample garbage; pad-and-freeze them.
+            tok = jnp.where(any_ok, tok, sampling.pad_id).astype(jnp.int32)
+            new_state = jnp.where(
+                any_ok & ~dn, nxt_tab[st, tok], st
+            )
+            return tok, any_ok & ~dn, new_state, dn | ~any_ok
+
+        key, k0 = jax.random.split(key)
+        tok0, valid0, state, done = pick(last_logits, state, done, k0)
+
+        def step(carry, i):
+            cache, token, st, dn, k = carry
+            k, sub = jax.random.split(k)
+            cache, logits = self.decode_step(
+                params, cache, S + i, token,
+                rope_pos=S + i - pad_left, kv_start=pad_left,
+            )
+            # pick() already pads invalid rows, so tok doubles as the
+            # feed token and the emitted value.
+            tok, valid, st, dn = pick(logits, st, dn, sub)
+            return (cache, tok, st, dn, k), (tok, valid)
+
+        if max_new_tokens > 1:
+            (cache, _, state, done, _), (rest, valids) = jax.lax.scan(
+                step, (cache, tok0, state, done, key),
+                jnp.arange(max_new_tokens - 1),
+            )
+            toks = jnp.concatenate([tok0[:, None], rest.T], axis=1)
+            lengths = valid0.astype(jnp.int32) + valids.T.sum(
+                axis=1, dtype=jnp.int32
+            )
+        else:
+            toks = tok0[:, None]
+            lengths = valid0.astype(jnp.int32)
+        return {
+            "tokens": toks, "lengths": lengths,
+            "prompt_logits": last_logits,
+            "accepted": accepting[state],
+        }
+
+    def generate_constrained(self, params, prompt, constraint, *,
+                             max_new_tokens: int = 32,
+                             sampling: SamplingConfig = SamplingConfig(),
+                             key=None, pad_left: int = 0):
+        """Generate under a RegexConstraint (serve/constrain.py).
+
+        Each row carries a DFA state; the state's ``allowed`` row masks
+        the logits (additive -inf) and the chosen token gathers its next
+        state — pure gathers, same scan as unconstrained decode.  A row
+        stops at a dead end (no token keeps the string in-language);
+        greedy decoding is maximal-munch (it continues from accepting
+        states that still have continuations).  Returns the generate
+        dict + ``accepted`` [B]: whether each row stopped in an
+        accepting state (its emitted string matches the pattern).
+        """
+        B, S = prompt.shape
+        if S + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt {S} + max_new {max_new_tokens} exceeds max_seq "
+                f"{self.max_seq}"
+            )
+        if constraint.allowed.shape[1] != self.cfg.vocab_size:
+            raise ValueError(
+                f"constraint built for vocab {constraint.allowed.shape[1]}, "
+                f"model has {self.cfg.vocab_size}"
+            )
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        tables = {
+            "next": constraint.next_state,
+            "allowed": constraint.allowed,
+            "accepting": constraint.accepting,
+        }
+        if not hasattr(self, "_constrained_jit"):
+            self._constrained_jit = jax.jit(
+                self._generate_constrained,
+                static_argnames=("max_new_tokens", "sampling"),
+            )
+        return self._constrained_jit(
+            params, prompt, key, jnp.asarray(pad_left, jnp.int32), tables,
+            jnp.int32(constraint.start),
+            max_new_tokens=max_new_tokens, sampling=sampling,
+        )
+
     def generate(self, params, prompt, *, max_new_tokens: int = 32,
                  sampling: SamplingConfig = SamplingConfig(),
                  key=None, pad_left: int = 0) -> DecodeOutput:
