@@ -92,6 +92,7 @@ class _Request:
     out: queue.Queue = field(default_factory=queue.Queue)
     slot: int = -1
     aidx: int = 0            # adapter bank index (0 = base model)
+    cidx: int = 0            # constraint bank index (0 = unconstrained)
     # (row_cache, last_logits, pos, rope, start): K/V computed by a
     # prefill worker (serve/disagg.py); admission splices, no forward.
     precomputed: tuple | None = None
@@ -156,14 +157,33 @@ class ContinuousBatcher:
         steps_per_round: int = 8,
         pipeline_depth: int = 2,
         adapters: dict | None = None,
+        constraints=None,
     ):
         """``adapters``: name → (lora_params, LoraConfig) — serves every
         adapter and the base model from ONE decode program; requests pick
-        an adapter by name at submit (serve/lora_bank.py)."""
+        an adapter by name at submit (serve/lora_bank.py).
+
+        ``constraints``: a serve.constrain.ConstraintBank — requests pick
+        a pattern by name and decode under its token-DFA mask in the
+        same shared rounds.  Constrained serving wants ``eos_id`` set:
+        a dead-ended row emits EOS to retire cleanly (otherwise it pads
+        until budget)."""
         from .lora_bank import AdapterBank
 
         self.engine = InferenceEngine(model, max_seq=max_seq, mesh=mesh)
         self.bank = AdapterBank(adapters or {})
+        self.cbank = constraints
+        if (
+            constraints is not None
+            and constraints.banked is not None
+            and int(constraints.allowed.shape[2]) != model.cfg.vocab_size
+        ):
+            raise ValueError(
+                f"ConstraintBank built over {constraints.allowed.shape[2]} "
+                f"token strings but the model's vocab is "
+                f"{model.cfg.vocab_size} — compile the bank against this "
+                "model's tokenizer"
+            )
         self.params = params
         self.slots = slots
         self.eos_id = eos_id
@@ -186,6 +206,8 @@ class ContinuousBatcher:
                 jnp.zeros(slots, jnp.uint32)
             ),
             "aidx": jnp.zeros(slots, jnp.int32),
+            "cidx": jnp.zeros(slots, jnp.int32),
+            "cstate": jnp.zeros(slots, jnp.int32),
         }
         # Host-side scheduler state.  No position mirror is needed: submit
         # clamps max_new to the decode room, so the budget always retires a
@@ -237,8 +259,22 @@ class ContinuousBatcher:
         )
 
     # -- device programs ---------------------------------------------------
+    def _constrained_first(self, logits, temp, key, ctab, cidx):
+        """First-token sampling under the constraint bank: mask at the
+        start state (0), then advance the DFA by the chosen token."""
+        if ctab is None:
+            first, key = self._first_token(logits, temp, key)
+            return first, key, jnp.int32(0)
+        mask = ctab["allowed"][cidx, 0]
+        dead = self.eos_id if self.eos_id >= 0 else 0
+        first, key = self._first_token(logits, temp, key, mask, dead)
+        cstate = jnp.where(
+            mask.any(), ctab["next"][cidx, 0, first], jnp.int32(0)
+        )
+        return first, key, cstate
+
     def _admit_dev(self, params, dev, padded, slot, temp, key, pad, bank,
-                   aidx):
+                   aidx, ctab, cidx):
         """Prefill one request on a [1, bucket] shape, splice its cache row
         into the pool, seat its decode state at *slot*, and sample the
         first token — all on device (no host fetch on the admit path).
@@ -249,23 +285,35 @@ class ContinuousBatcher:
             adapters=bank, adapter_idx=aidx[None] if bank else None,
         )
         bucket = padded.shape[1]
-        first, key = self._first_token(last_logits[0], temp, key)
+        first, key, cstate = self._constrained_first(
+            last_logits[0], temp, key, ctab, cidx
+        )
         return self._seat(
             dev, row_cache, slot, first, bucket, bucket - pad, pad, temp,
-            key, aidx,
+            key, aidx, cidx, cstate,
         ), first
 
     @staticmethod
-    def _first_token(logits, temp, key):
+    def _first_token(logits, temp, key, mask=None, dead_tok=0):
+        """``mask`` [V] bool: constrained sampling — disallowed logits go
+        to -inf; a fully-masked row emits ``dead_tok`` (EOS by
+        convention) so the scheduler retires it."""
+        any_ok = None
+        if mask is not None:
+            any_ok = mask.any()
+            logits = jnp.where(mask, logits, -jnp.inf)
         key, sub = jax.random.split(key)
         greedy = jnp.argmax(logits).astype(jnp.int32)
         sampled = jax.random.categorical(
             sub, logits / jnp.maximum(temp, 1e-6)
         ).astype(jnp.int32)
-        return jnp.where(temp > 0, sampled, greedy), key
+        first = jnp.where(temp > 0, sampled, greedy)
+        if mask is not None:
+            first = jnp.where(any_ok, first, jnp.int32(dead_tok))
+        return first, key
 
     def _seat(self, dev, row, slot, first, pos, rope, start, temp, key,
-              aidx):
+              aidx, cidx=0, cstate=0):
         """Splice a prefilled K/V row into the pool and seat a slot's
         decode state — the single owner of the per-slot field list (a
         field added here reaches all three admission paths at once)."""
@@ -284,10 +332,12 @@ class ContinuousBatcher:
             "temps": dev["temps"].at[slot].set(temp),
             "keys": dev["keys"].at[slot].set(key),
             "aidx": dev["aidx"].at[slot].set(aidx),
+            "cidx": dev["cidx"].at[slot].set(cidx),
+            "cstate": dev["cstate"].at[slot].set(cstate),
         }
 
     def _admit_prefix_dev(self, params, dev, base, suffix, n_real, slot,
-                          temp, key, base_pos):
+                          temp, key, base_pos, ctab, cidx):
         """Admit on top of a cached prefix: extend the prefix's K/V row
         with the RIGHT-padded suffix (one extend_multi, width = suffix
         bucket) instead of prefilling the whole prompt.
@@ -301,25 +351,30 @@ class ContinuousBatcher:
             jnp.asarray([base_pos]), jnp.asarray([base_pos]),
             jnp.asarray([0]),
         )
-        first, key = self._first_token(logits[0, n_real - 1], temp, key)
+        first, key, cstate = self._constrained_first(
+            logits[0, n_real - 1], temp, key, ctab, cidx
+        )
         pos = base_pos + n_real
         return self._seat(
-            dev, row, slot, first, pos, pos, 0, temp, key, 0
+            dev, row, slot, first, pos, pos, 0, temp, key, 0, cidx, cstate
         ), first
 
     def _admit_exact_dev(self, dev, base, base_logits, pos, rope, start,
-                         slot, temp, key, aidx):
+                         slot, temp, key, aidx, ctab, cidx):
         """Seat a row whose K/V were computed elsewhere: splice + sample,
         no model forward on THIS program.  Two callers: a prompt that IS
         a cached prefix (pos=rope=n, start=0), and disaggregated-prefill
         admission (serve/disagg.py — a prefill worker hands over the row
         with its bucketing geometry intact)."""
-        first, key = self._first_token(base_logits[0], temp, key)
+        first, key, cstate = self._constrained_first(
+            base_logits[0], temp, key, ctab, cidx
+        )
         return self._seat(
-            dev, base, slot, first, pos, rope, start, temp, key, aidx
+            dev, base, slot, first, pos, rope, start, temp, key, aidx,
+            cidx, cstate,
         ), first
 
-    def _round_dev(self, params, dev, bank):
+    def _round_dev(self, params, dev, bank, ctab):
         """One scheduler round: ``steps_per_round`` batched decode steps as
         a single on-device scan.  Returns (new_dev, tokens [T, B]).  Rows
         that hit EOS/budget mid-round produce garbage tails the host drops
@@ -328,12 +383,16 @@ class ContinuousBatcher:
         kv_start = dev["start"]
 
         def one(carry, _):
-            cache, token, pos, rope, keys = carry
+            cache, token, pos, rope, keys, cstate = carry
             cache, logits = self.engine.decode_step_multi(
                 params, cache, token, pos, rope, kv_start,
                 adapters=bank,
                 adapter_idx=dev["aidx"] if bank else None,
             )
+            if ctab is not None:
+                mask = ctab["allowed"][dev["cidx"], cstate]   # [B, V]
+                logits = jnp.where(mask, logits, -jnp.inf)
+                any_ok = mask.any(-1)
             split = jax.vmap(jax.random.split)(keys)     # [B, 2, 2]
             new_keys, subs = split[:, 0], split[:, 1]
             greedy = jnp.argmax(logits, axis=-1)
@@ -342,18 +401,25 @@ class ContinuousBatcher:
                 lambda k, l: jax.random.categorical(k, l)
             )(subs, scaled)
             nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-            return (cache, nxt, pos + 1, rope + 1, new_keys), nxt
+            if ctab is not None:
+                # Dead end: emit EOS so the scheduler retires the row.
+                dead = self.eos_id if self.eos_id >= 0 else 0
+                nxt = jnp.where(any_ok, nxt, jnp.int32(dead))
+                cstate = jnp.where(
+                    any_ok, ctab["next"][dev["cidx"], cstate, nxt], cstate
+                )
+            return (cache, nxt, pos + 1, rope + 1, new_keys, cstate), nxt
 
-        (cache, token, pos, rope, keys), toks = jax.lax.scan(
+        (cache, token, pos, rope, keys, cstate), toks = jax.lax.scan(
             one,
             (dev["cache"], dev["token"], dev["pos"], dev["rope"],
-             dev["keys"]),
+             dev["keys"], dev["cstate"]),
             length=self.steps_per_round,
         )
         return {
             "cache": cache, "token": token, "pos": pos, "rope": rope,
             "start": kv_start, "temps": temps, "keys": keys,
-            "aidx": dev["aidx"],
+            "aidx": dev["aidx"], "cidx": dev["cidx"], "cstate": cstate,
         }, toks
 
     # -- public surface ----------------------------------------------------
@@ -373,11 +439,13 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         seed: int = 0,
         adapter: str | None = None,
+        constraint: str | None = None,
     ) -> RequestHandle:
         """Queue a request; returns a handle streaming generated ids.
         Raises ValueError when the prompt cannot fit, KeyError for an
-        unknown ``adapter`` name."""
+        unknown ``adapter``/``constraint`` name."""
         aidx = self.bank.index(adapter)
+        cidx = self._constraint_index(constraint)
         ids = np.asarray(ids, np.int32).ravel()
         bucket = prompt_bucket(int(ids.size), self.engine.max_seq)
         if bucket is None:
@@ -392,6 +460,7 @@ class ContinuousBatcher:
             temperature=float(temperature),
             seed=int(seed),
             aidx=aidx,
+            cidx=cidx,
         )
         with self._lifecycle:
             if self._dead:
@@ -406,6 +475,7 @@ class ContinuousBatcher:
         self, row_cache, last_logits, n_tokens: int, pad: int,
         max_new_tokens: int = 32, temperature: float = 0.0, seed: int = 0,
         adapter: str | None = None, on_admit=None,
+        constraint: str | None = None,
     ) -> RequestHandle:
         """Admit a request whose prefill ran elsewhere (serve/disagg.py):
         ``row_cache`` is a [L, 1, H, max_seq, Dh] K/V tree computed at a
@@ -413,6 +483,7 @@ class ContinuousBatcher:
         ``last_logits`` [1, V] are the logits at the final prompt
         position.  The decode side only splices and samples."""
         aidx = self.bank.index(adapter)
+        cidx = self._constraint_index(constraint)
         room = self.engine.max_seq - n_tokens
         if room < 1:
             raise ValueError("precomputed prompt fills max_seq")
@@ -440,6 +511,7 @@ class ContinuousBatcher:
             temperature=float(temperature),
             seed=int(seed),
             aidx=aidx,
+            cidx=cidx,
             precomputed=(
                 row_cache, last_logits, n_tokens, n_tokens - pad, pad,
             ),
@@ -518,6 +590,15 @@ class ContinuousBatcher:
                 self._prefix.move_to_end(best_key)
         return best
 
+    def _constraint_index(self, name: str | None) -> int:
+        if name is None:
+            return 0
+        if self.cbank is None:
+            raise KeyError(
+                f"unknown constraint {name!r}; no ConstraintBank configured"
+            )
+        return self.cbank.index(name)
+
     @property
     def steps_taken(self) -> int:
         return self._round_count
@@ -536,13 +617,14 @@ class ContinuousBatcher:
         return -1
 
     def _dispatch_admit(self, req: _Request, slot: int) -> tuple:
+        ctab = self.cbank.banked if self.cbank else None
         if req.precomputed is not None:
             row, logits, pos, rope, start = req.precomputed
             self._dev, first = self._admit_exact_jit(
                 self._dev, row, logits, jnp.int32(pos), jnp.int32(rope),
                 jnp.int32(start), jnp.int32(slot),
                 jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
-                jnp.int32(req.aidx),
+                jnp.int32(req.aidx), ctab, jnp.int32(req.cidx),
             )
             # Drop the row reference (it lives on in the pool cache) and
             # signal the prefill pool that its HBM is reclaimable.
@@ -560,7 +642,7 @@ class ContinuousBatcher:
                 jnp.int32(entry["n"]), jnp.int32(entry["n"]), jnp.int32(0),
                 jnp.int32(slot),
                 jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
-                jnp.int32(0),
+                jnp.int32(0), ctab, jnp.int32(req.cidx),
             )
         elif entry is not None and (
             entry["n"] + _suffix_bucket(req.ids.size - entry["n"])
@@ -577,6 +659,7 @@ class ContinuousBatcher:
                 jnp.int32(n_real), jnp.int32(slot),
                 jnp.float32(req.temperature),
                 jax.random.PRNGKey(req.seed), jnp.int32(p),
+                ctab, jnp.int32(req.cidx),
             )
         else:
             bucket = prompt_bucket(int(req.ids.size), self.engine.max_seq)
@@ -589,6 +672,7 @@ class ContinuousBatcher:
                 jnp.float32(req.temperature),
                 jax.random.PRNGKey(req.seed), jnp.int32(pad),
                 self.bank.banked, jnp.int32(req.aidx),
+                ctab, jnp.int32(req.cidx),
             )
         path = (
             "prefix_exact" if entry is not None and entry["n"] == req.ids.size
@@ -618,7 +702,8 @@ class ContinuousBatcher:
         # request, whose stream must not receive this round's tokens.
         live = [(i, r) for i, r in enumerate(self._active) if r is not None]
         self._dev, toks = self._round_jit(
-            self.params, self._dev, self.bank.banked
+            self.params, self._dev, self.bank.banked,
+            self.cbank.banked if self.cbank else None,
         )
         self._round_count += 1
         return ("round", self._round_count, live, toks)
@@ -695,7 +780,17 @@ class ContinuousBatcher:
                         req = self._pending.get_nowait()
                     except queue.Empty:
                         break
-                    inflight.append(self._dispatch_admit(req, slot))
+                    try:
+                        inflight.append(self._dispatch_admit(req, slot))
+                    except BaseException:
+                        # The popped request is in neither _pending nor
+                        # _active yet — the crash drain below would miss
+                        # it and its caller would block forever.
+                        req.aborted = True
+                        if req.on_admit is not None:
+                            req.on_admit()
+                        req.out.put(None)
+                        raise
                 # Keep the device busy: dispatch the next round before
                 # fetching results of previous ones.
                 if any(r is not None for r in self._active):

@@ -246,6 +246,67 @@ class RegexConstraint:
         return int(self.next_state.shape[0])
 
 
+class ConstraintBank:
+    """A fixed set of named patterns, banked for continuous batching.
+
+    Per-request constraints in one decode program need uniform table
+    shapes, so — exactly like the LoRA AdapterBank — patterns are
+    compiled up-front and padded to the bank maximum:
+
+        next  [C, S_max, V] int32   allowed [C, S_max, V] bool
+
+    Index 0 is "unconstrained": a single all-permissive self-loop
+    state, so unconstrained rows run the same gathers with a mask
+    that never masks.  Each decode row carries (cidx, cstate); both
+    are data, never shapes.
+    """
+
+    def __init__(self, patterns: dict[str, str], token_strings: list[str]):
+        self.names = ["__free__"] + sorted(patterns)
+        self.compiled = {
+            name: compile_constraint(pat, token_strings)
+            for name, pat in patterns.items()
+        }
+        V = len(token_strings)
+        S = max(
+            [1] + [c.n_states for c in self.compiled.values()]
+        )
+        C = len(self.names)
+        nxt = np.full((C, S, V), -1, np.int32)
+        allow = np.zeros((C, S, V), bool)
+        # index 0: one state, everything allowed, self-loop
+        nxt[0, 0, :] = 0
+        allow[0, 0, :] = True
+        accepting = np.zeros((C, S), bool)
+        accepting[0, 0] = True
+        for i, name in enumerate(self.names[1:], start=1):
+            c = self.compiled[name]
+            s = c.n_states
+            nxt[i, :s] = np.asarray(c.next_state)
+            allow[i, :s] = np.asarray(c.allowed)
+            accepting[i, :s] = np.asarray(c.accepting)
+        self.next_state = jnp.asarray(nxt)
+        self.allowed = jnp.asarray(allow)
+        self.accepting = jnp.asarray(accepting)
+
+    @property
+    def banked(self):
+        """None when no real patterns — callers skip the gathers."""
+        if len(self.names) == 1:
+            return None
+        return {"next": self.next_state, "allowed": self.allowed}
+
+    def index(self, name: str | None) -> int:
+        if name is None:
+            return 0
+        try:
+            return self.names.index(name)
+        except ValueError:
+            raise KeyError(
+                f"unknown constraint {name!r}; serving {self.names[1:]}"
+            ) from None
+
+
 def compile_constraint(pattern: str, token_strings: list[str]) -> RegexConstraint:
     """Build the [S, V] token tables for *pattern* over a vocabulary.
 

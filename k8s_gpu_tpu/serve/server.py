@@ -40,12 +40,28 @@ class LmServer:
         slots: int = 4,
         mesh=None,
         adapters: dict | None = None,
+        constraints: dict | None = None,
+        eos_id: int = -1,
     ):
         """``adapters``: name → (lora_params, LoraConfig); requests pick
         one with {"adapter": "<name>"} — multi-tenant fine-tunes served
-        from one decode program (serve/lora_bank.py)."""
+        from one decode program (serve/lora_bank.py).
+
+        ``constraints``: name → regex pattern, compiled against this
+        tokenizer's vocabulary into a ConstraintBank; requests pick one
+        with {"constraint": "<name>"} (serve/constrain.py).  Configure
+        ``eos_id`` with constraints so dead-ended rows retire cleanly."""
+        cbank = None
+        if constraints:
+            from .constrain import ConstraintBank
+
+            token_strings = [
+                tokenizer.decode([i]) for i in range(tokenizer.vocab_size)
+            ]
+            cbank = ConstraintBank(constraints, token_strings)
         self.batcher = ContinuousBatcher(
-            model, params, slots=slots, mesh=mesh, adapters=adapters
+            model, params, slots=slots, mesh=mesh, adapters=adapters,
+            constraints=cbank, eos_id=eos_id,
         )
         self.tokenizer = tokenizer
         self.started_at = time.time()
@@ -111,6 +127,10 @@ class LmServer:
                 adapter = body.get("adapter")
                 if adapter is not None and not isinstance(adapter, str):
                     return self._json(400, {"error": "adapter must be a string"})
+                constraint = body.get("constraint")
+                if constraint is not None and not isinstance(constraint, str):
+                    return self._json(
+                        400, {"error": "constraint must be a string"})
                 stream = bool(body.get("stream", False))
                 ids = outer.tokenizer.encode(prompt)
                 t0 = time.perf_counter()
@@ -121,6 +141,7 @@ class LmServer:
                         temperature=temperature,
                         seed=seed,
                         adapter=adapter,
+                        constraint=constraint,
                     )
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})

@@ -144,3 +144,151 @@ def test_regex_errors():
         compile_constraint("[abc", TOKENS)
     with pytest.raises(RegexError):
         compile_constraint("*a", TOKENS)
+
+
+# -- banked constraints in the continuous batcher ---------------------------
+
+def _bank(patterns):
+    from k8s_gpu_tpu.serve.constrain import ConstraintBank
+
+    return ConstraintBank(patterns, TOKENS)
+
+
+def test_constraint_bank_shapes():
+    bank = _bank({"digits": "[0-9]+", "yn": "yes|no"})
+    assert bank.names == ["__free__", "digits", "yn"]
+    C, S, V = bank.allowed.shape
+    assert C == 3 and V == len(TOKENS)
+    # index 0 is the all-permissive self-loop
+    assert bool(bank.allowed[0, 0].all())
+    assert int(bank.next_state[0, 0, 3]) == 0
+    assert bank.index(None) == 0
+    with pytest.raises(KeyError, match="unknown constraint"):
+        bank.index("nope")
+
+
+def test_batcher_constrained_matches_engine(setup):
+    """The banked round loop and the engine's constrained scan are the
+    same automaton: greedy streams agree token-for-token."""
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+
+    model, params, eng = setup
+    bank = _bank({"digits": "[0-9]+"})
+    c = compile_constraint("[0-9]+", TOKENS)
+    b = ContinuousBatcher(model, params, slots=2, eos_id=0,
+                          constraints=bank).start()
+    try:
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 5), 1, 15)
+        ref = eng.generate_constrained(params, prompt, c, max_new_tokens=8)
+        got = b.submit(list(map(int, prompt[0])), max_new_tokens=8,
+                       constraint="digits").result()
+        n = int(ref["lengths"][0])
+        assert got == [int(t) for t in ref["tokens"][0][:n]], (got, ref)
+        # and the emission is digit-only
+        assert all(TOKENS[t].isdigit() for t in got)
+    finally:
+        b.stop()
+
+
+def test_batcher_mixed_constrained_and_free(setup):
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+
+    model, params, eng = setup
+    bank = _bank({"yn": "yes|no"})
+    b = ContinuousBatcher(model, params, slots=3, eos_id=0,
+                          constraints=bank).start()
+    try:
+        free_ids = [5, 9, 17]
+        h1 = b.submit(free_ids, max_new_tokens=6)
+        h2 = b.submit([7, 3], max_new_tokens=6, constraint="yn")
+        free = h1.result()
+        yn = h2.result()
+        # the free row matches the plain engine (eos_id=0 semantics)
+        ref = eng.generate(
+            params, jnp.asarray([free_ids]), max_new_tokens=6,
+            sampling=__import__(
+                "k8s_gpu_tpu.serve", fromlist=["SamplingConfig"]
+            ).SamplingConfig(eos_id=0),
+        )
+        n = int(ref.lengths[0])
+        assert free == [int(t) for t in ref.tokens[0][:n]]
+        # the constrained row produced a full yes/no
+        s = "".join(TOKENS[t] for t in yn)
+        assert re.fullmatch("yes|no", s), s
+        with pytest.raises(KeyError, match="unknown constraint"):
+            b.submit([1], constraint="nope")
+    finally:
+        b.stop()
+
+
+def test_lm_server_constraint_param(setup):
+    import json
+    import urllib.error
+    import urllib.request
+
+    from k8s_gpu_tpu.data.tokenizer import BpeTokenizer
+    from k8s_gpu_tpu.serve import LmServer
+
+    corpus = "0 1 7 9 12 ab cd e yes no " * 30
+    tok = BpeTokenizer.train(corpus, vocab_size=260, backend="python")
+    # the model's vocab must match the tokenizer's (byte-BPE floor: 256+)
+    cfg_srv = TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=32, n_layers=1, n_heads=2,
+        d_head=16, d_ff=64, max_seq=48, use_flash=False,
+        dtype=jnp.float32,
+    )
+    model_srv = TransformerLM(cfg_srv)
+    params_srv = model_srv.init(jax.random.PRNGKey(4))
+    srv = LmServer(model_srv, params_srv, tok,
+                   constraints={"digits": "[0-9 ]+"}, eos_id=0).start()
+    try:
+        def post(payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, out = post({"prompt": "ab cd", "max_new_tokens": 6,
+                          "constraint": "digits"})
+        assert code == 200
+        assert re.fullmatch("[0-9 ]*", out["text"]), out["text"]
+        code, err = post({"prompt": "x", "constraint": "nope"})
+        assert code == 400 and "unknown constraint" in err["error"]
+    finally:
+        srv.stop()
+
+
+def test_bank_vocab_mismatch_rejected_at_construction(setup):
+    """A bank compiled over a different vocabulary must fail at batcher
+    construction, not crash the scheduler mid-admit (which would strand
+    the popped request's handle forever — regression for the admit
+    crash path)."""
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+    from k8s_gpu_tpu.serve.constrain import ConstraintBank
+
+    model, params, _ = setup
+    bank = ConstraintBank({"d": "[0-9]+"}, TOKENS + ["zz", "qq"])
+    with pytest.raises(ValueError, match="vocab"):
+        ContinuousBatcher(model, params, slots=2, constraints=bank)
+
+
+def test_admit_crash_aborts_popped_request(setup):
+    """If dispatch itself raises, the popped request must be aborted —
+    not left in neither queue with a caller blocked on result()."""
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+
+    model, params, _ = setup
+    b = ContinuousBatcher(model, params, slots=2).start()
+    try:
+        b._admit_jit = None  # force a TypeError inside _dispatch_admit
+        h = b.submit([1, 2, 3], max_new_tokens=4)
+        got = h.result()  # must return promptly
+        assert h.aborted and got == []
+    finally:
+        b.stop()
