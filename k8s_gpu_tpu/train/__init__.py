@@ -1,10 +1,11 @@
+from .evaluate import evaluate_lm
 from .runner import TrainConfig, Trainer, make_train_step
 from .lora import LoraAdapter, LoraConfig, LoraModel, num_params
 
 __all__ = [
     "TrainConfig",
     "Trainer",
-    "make_train_step",
+    "make_train_step", "evaluate_lm",
     "LoraAdapter",
     "LoraConfig",
     "LoraModel",

@@ -1,0 +1,43 @@
+"""Evaluation: teacher-forced loss / perplexity over a token stream.
+
+The reference's quality story ends at training logs (the torch loop
+prints running loss, GPU调度平台搭建.md:593-602); a platform that exports
+versioned model assets needs a way to SCORE them.  One jitted
+teacher-forced forward per batch, pure next-token cross-entropy (no MoE
+aux term — that is a training regularizer, not model quality), summed
+in f64-free integer/token space so perplexity is exact over the stream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def evaluate_lm(model, params, batches, mesh=None) -> dict:
+    """``batches``: iterable of [B, S+1] int token arrays (targets are the
+    shifted inputs, the trainer's convention).  Returns token-weighted
+    mean NLL, perplexity, and the token count."""
+
+    @jax.jit
+    def batch_nll(params, tokens, targets):
+        logits, _ = model.forward(params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.sum()
+
+    total_nll = 0.0
+    total_tokens = 0
+    for toks in batches:
+        toks = jnp.asarray(toks, jnp.int32)
+        total_nll += float(batch_nll(params, toks[:, :-1], toks[:, 1:]))
+        total_tokens += int(toks.shape[0] * (toks.shape[1] - 1))
+    if total_tokens == 0:
+        raise ValueError("no evaluation tokens")
+    mean_nll = total_nll / total_tokens
+    return {
+        "nll": mean_nll,
+        "perplexity": float(np.exp(mean_nll)),
+        "tokens": total_tokens,
+    }

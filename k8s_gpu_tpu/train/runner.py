@@ -49,10 +49,30 @@ class TrainConfig:
     # memory/comm point on the ZeRO tradeoff curve, the right one for
     # TPU ICI where the all-gather is cheap and fully overlapped.
     zero1: bool = False
+    # LR schedule after warmup: "constant" (the r1-r3 default) or
+    # "cosine" (decay to lr*min_lr_frac over decay_steps).
+    schedule: str = "constant"
+    decay_steps: int = 10_000
+    min_lr_frac: float = 0.1
+    # EMA of params (Polyak averaging) — 0 disables.  The shadow tree
+    # lives at Trainer.ema with the params' shardings; evaluate/export
+    # can consume it directly.
+    ema_decay: float = 0.0
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
-    sched = optax.linear_schedule(0.0, tc.learning_rate, tc.warmup_steps)
+    warm = optax.linear_schedule(0.0, tc.learning_rate, tc.warmup_steps)
+    if tc.schedule == "cosine":
+        decay = optax.cosine_decay_schedule(
+            tc.learning_rate, tc.decay_steps, alpha=tc.min_lr_frac
+        )
+        sched = optax.join_schedules([warm, decay], [tc.warmup_steps])
+    elif tc.schedule == "constant":
+        sched = warm
+    else:
+        raise ValueError(
+            f"unknown schedule {tc.schedule!r}; expected constant|cosine"
+        )
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
         optax.adamw(sched, b1=tc.b1, b2=tc.b2, weight_decay=tc.weight_decay),
@@ -176,6 +196,15 @@ class Trainer:
         self.opt_state = jax.jit(
             self.optimizer.init, out_shardings=opt_shardings
         )(self.params)
+        if self.tc.ema_decay > 0:
+            # Polyak shadow of the params, same shardings (a copy, not an
+            # alias: the step donates params).
+            self.ema = jax.jit(
+                lambda p: jax.tree.map(jnp.array, p),
+                out_shardings=shardings,
+            )(self.params)
+        else:
+            self.ema = None
 
     def _zero1_sharding(self, sharding: NamedSharding, shape) -> NamedSharding:
         """Extend a param's sharding with 'dp' on the largest free axis.
@@ -274,12 +303,32 @@ class Trainer:
                     self._loss, self.optimizer,
                     accum=self.tc.grad_accum_steps,
                 )
-            self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+            if self.tc.ema_decay > 0:
+                base_step, d = step_fn, self.tc.ema_decay
+
+                def step_fn(params, opt_state, ema, *batch):
+                    params, opt_state, loss = base_step(
+                        params, opt_state, *batch
+                    )
+                    ema = jax.tree.map(
+                        lambda e, p: e * d + p.astype(e.dtype) * (1 - d),
+                        ema, params,
+                    )
+                    return params, opt_state, ema, loss
+
+                self._step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+            else:
+                self._step = jax.jit(step_fn, donate_argnums=(0, 1))
         batch = self.shard_batch(*batch)
         t0 = time.perf_counter()
-        self.params, self.opt_state, loss = self._step(
-            self.params, self.opt_state, *batch
-        )
+        if self.tc.ema_decay > 0:
+            self.params, self.opt_state, self.ema, loss = self._step(
+                self.params, self.opt_state, self.ema, *batch
+            )
+        else:
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, *batch
+            )
         loss = float(loss)
         global_metrics.observe("train_step_seconds", time.perf_counter() - t0)
         return loss
