@@ -33,33 +33,45 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step: int, params, opt_state) -> None:
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardSave(params),
-                opt_state=ocp.args.StandardSave(opt_state),
-            ),
-        )
+    def save(self, step: int, params, opt_state, ema=None) -> None:
+        items = {
+            "params": ocp.args.StandardSave(params),
+            "opt_state": ocp.args.StandardSave(opt_state),
+        }
+        if ema is not None:
+            items["ema"] = ocp.args.StandardSave(ema)
+        self._mgr.save(step, args=ocp.args.Composite(**items))
         self._mgr.wait_until_finished()
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore(self, params_like, opt_state_like, step: int | None = None):
+    def restore(self, params_like, opt_state_like, step: int | None = None,
+                ema_like=None):
         """Restore onto the sharding/structure of the *_like pytrees (pass
-        the trainer's freshly-initialized state to resume onto its mesh)."""
+        the trainer's freshly-initialized state to resume onto its mesh).
+        Returns (params, opt_state, step) or, with ``ema_like``,
+        (params, opt_state, ema, step) — ema is None when the checkpoint
+        predates EMA tracking (the caller should re-seed it from the
+        restored params, NOT keep a shadow of the fresh init)."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(params_like),
-                opt_state=ocp.args.StandardRestore(opt_state_like),
-            ),
-        )
+        items = {
+            "params": ocp.args.StandardRestore(params_like),
+            "opt_state": ocp.args.StandardRestore(opt_state_like),
+        }
+        want_ema = ema_like is not None and self._has_ema(step)
+        if want_ema:
+            items["ema"] = ocp.args.StandardRestore(ema_like)
+        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        if ema_like is not None:
+            ema = restored["ema"] if want_ema else None
+            return restored["params"], restored["opt_state"], ema, step
         return restored["params"], restored["opt_state"], step
+
+    def _has_ema(self, step: int) -> bool:
+        return (self.directory / str(step) / "ema").exists()
 
     def export_to_assets(
         self, store: AssetStore, space: str, asset_id: str, step: int | None = None
@@ -82,10 +94,23 @@ def attach_to_trainer(trainer, directory: str | Path, max_to_keep: int = 3):
     ckpt = CheckpointManager(directory, max_to_keep=max_to_keep)
 
     def save(step: int) -> None:
-        ckpt.save(step, trainer.params, trainer.opt_state)
+        ckpt.save(step, trainer.params, trainer.opt_state, ema=trainer.ema)
 
     def resume() -> int:
-        params, opt_state, step = ckpt.restore(trainer.params, trainer.opt_state)
+        if trainer.ema is not None:
+            params, opt_state, ema, step = ckpt.restore(
+                trainer.params, trainer.opt_state, ema_like=trainer.ema
+            )
+            # A pre-EMA checkpoint re-seeds the shadow from the RESTORED
+            # params — keeping the fresh-init shadow would blend random
+            # weights into every later average.
+            trainer.ema = ema if ema is not None else jax.tree.map(
+                lambda p: p.copy(), params
+            )
+        else:
+            params, opt_state, step = ckpt.restore(
+                trainer.params, trainer.opt_state
+            )
         trainer.params = params
         trainer.opt_state = opt_state
         return step

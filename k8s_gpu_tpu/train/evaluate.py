@@ -4,33 +4,52 @@ The reference's quality story ends at training logs (the torch loop
 prints running loss, GPU调度平台搭建.md:593-602); a platform that exports
 versioned model assets needs a way to SCORE them.  One jitted
 teacher-forced forward per batch, pure next-token cross-entropy (no MoE
-aux term — that is a training regularizer, not model quality), summed
-in f64-free integer/token space so perplexity is exact over the stream.
+aux term — that is a training regularizer, not model quality).
 """
 
 from __future__ import annotations
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# One compiled eval forward per (model, mesh): a fresh closure per call
+# would recompile the full forward on every periodic eval.
+_JIT_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _batch_nll_fn(model, mesh):
+    per_model = _JIT_CACHE.setdefault(model, {})
+    if mesh not in per_model:
+        @jax.jit
+        def batch_nll(params, tokens, targets):
+            logits, _ = model.forward(params, tokens, mesh)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, targets[..., None], axis=-1
+            )[..., 0]
+            return nll.sum()
+
+        per_model[mesh] = batch_nll
+    return per_model[mesh]
 
 
 def evaluate_lm(model, params, batches, mesh=None) -> dict:
     """``batches``: iterable of [B, S+1] int token arrays (targets are the
-    shifted inputs, the trainer's convention).  Returns token-weighted
-    mean NLL, perplexity, and the token count."""
-
-    @jax.jit
-    def batch_nll(params, tokens, targets):
-        logits, _ = model.forward(params, tokens)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return nll.sum()
-
+    shifted inputs, the trainer's convention).  ``mesh``: evaluate under
+    the training parallelism — the forward takes the same sharded/
+    pipelined path it trained with and batches are dp-sharded onto it.
+    Returns token-weighted mean NLL, perplexity, and the token count."""
+    batch_nll = _batch_nll_fn(model, mesh)
     total_nll = 0.0
     total_tokens = 0
     for toks in batches:
         toks = jnp.asarray(toks, jnp.int32)
+        if mesh is not None:
+            toks = jax.device_put(toks, NamedSharding(mesh, P("dp")))
         total_nll += float(batch_nll(params, toks[:, :-1], toks[:, 1:]))
         total_tokens += int(toks.shape[0] * (toks.shape[1] - 1))
     if total_tokens == 0:

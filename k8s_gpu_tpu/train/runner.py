@@ -60,22 +60,27 @@ class TrainConfig:
     ema_decay: float = 0.0
 
 
-def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+def make_schedule(tc: TrainConfig):
+    """The LR schedule make_optimizer wires in — exposed so tests (and
+    LR-curve dashboards) probe the real wiring, not a reconstruction."""
     warm = optax.linear_schedule(0.0, tc.learning_rate, tc.warmup_steps)
     if tc.schedule == "cosine":
         decay = optax.cosine_decay_schedule(
             tc.learning_rate, tc.decay_steps, alpha=tc.min_lr_frac
         )
-        sched = optax.join_schedules([warm, decay], [tc.warmup_steps])
-    elif tc.schedule == "constant":
-        sched = warm
-    else:
-        raise ValueError(
-            f"unknown schedule {tc.schedule!r}; expected constant|cosine"
-        )
+        return optax.join_schedules([warm, decay], [tc.warmup_steps])
+    if tc.schedule == "constant":
+        return warm
+    raise ValueError(
+        f"unknown schedule {tc.schedule!r}; expected constant|cosine"
+    )
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
-        optax.adamw(sched, b1=tc.b1, b2=tc.b2, weight_decay=tc.weight_decay),
+        optax.adamw(make_schedule(tc), b1=tc.b1, b2=tc.b2,
+                    weight_decay=tc.weight_decay),
     )
 
 
@@ -175,6 +180,7 @@ class Trainer:
         self._step = None
         self.params = None
         self.opt_state = None
+        self.ema = None
         # Does the model's loss accept a mesh kwarg?  Decided once here —
         # a try/except TypeError at call time would swallow genuine
         # TypeErrors from inside the model.

@@ -129,21 +129,19 @@ def test_accum_rejected_with_1f1b():
 # -- schedule / EMA / eval ---------------------------------------------------
 
 def test_cosine_schedule_decays():
-    from k8s_gpu_tpu.train.runner import make_optimizer
+    """Probes make_schedule — the exact object make_optimizer wires in."""
+    from k8s_gpu_tpu.train.runner import make_optimizer, make_schedule
 
-    tc = TrainConfig(warmup_steps=2, schedule="cosine", decay_steps=10,
-                     learning_rate=1e-2, min_lr_frac=0.1)
-    import optax
-
-    # reconstruct the schedule the optimizer uses and probe it
-    warm = optax.linear_schedule(0.0, tc.learning_rate, tc.warmup_steps)
-    decay = optax.cosine_decay_schedule(tc.learning_rate, tc.decay_steps,
-                                        alpha=tc.min_lr_frac)
-    sched = optax.join_schedules([warm, decay], [tc.warmup_steps])
+    sched = make_schedule(TrainConfig(
+        warmup_steps=2, schedule="cosine", decay_steps=10,
+        learning_rate=1e-2, min_lr_frac=0.1,
+    ))
     assert float(sched(0)) == 0.0
     assert abs(float(sched(2)) - 1e-2) < 1e-9           # warmup peak
     assert float(sched(12)) < float(sched(4))           # decaying
     assert abs(float(sched(200)) - 1e-3) < 1e-8         # floor at 10%
+    const = make_schedule(TrainConfig(warmup_steps=2, learning_rate=1e-2))
+    assert abs(float(const(500)) - 1e-2) < 1e-9         # constant holds
     with pytest.raises(ValueError, match="unknown schedule"):
         make_optimizer(TrainConfig(schedule="bogus"))
 
@@ -182,3 +180,67 @@ def test_evaluate_lm_perplexity():
     assert 40 < out["perplexity"] < 400
     with pytest.raises(ValueError, match="no evaluation tokens"):
         evaluate_lm(model, params, [])
+
+
+def test_ema_checkpoint_roundtrip(tmp_path):
+    """EMA survives save/resume — a resumed run must not blend a shadow
+    of the fresh init into the average (code-review r3)."""
+    from k8s_gpu_tpu.train.checkpoint import attach_to_trainer
+
+    tr, _ = _train(TrainConfig(warmup_steps=1, ema_decay=0.5), steps=3)
+    ckpt, save, _ = attach_to_trainer(tr, tmp_path / "ck")
+    save(3)
+    ema_before = [np.asarray(x) for x in jax.tree.leaves(tr.ema)]
+    ckpt.close()
+
+    # fresh trainer, same config: resume must restore the SAVED ema
+    tr2 = Trainer(
+        TransformerLM(_cfg()), mesh=_mesh(MeshConfig(dp=1)),
+        train_config=TrainConfig(warmup_steps=1, ema_decay=0.5),
+    )
+    tr2.init(jax.random.PRNGKey(123))  # different init than tr
+    ckpt2, _, resume = attach_to_trainer(tr2, tmp_path / "ck")
+    step = resume()
+    assert step == 3
+    for a, b in zip(ema_before, jax.tree.leaves(tr2.ema)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    ckpt2.close()
+
+
+def test_pre_ema_checkpoint_reseeds_shadow(tmp_path):
+    """A checkpoint written WITHOUT ema re-seeds the shadow from the
+    restored params on resume, not from the fresh init."""
+    from k8s_gpu_tpu.train.checkpoint import attach_to_trainer
+
+    tr, _ = _train(TrainConfig(warmup_steps=1), steps=2)  # no EMA
+    ckpt, save, _ = attach_to_trainer(tr, tmp_path / "ck")
+    save(2)
+    ckpt.close()
+
+    tr2 = Trainer(
+        TransformerLM(_cfg()), mesh=_mesh(MeshConfig(dp=1)),
+        train_config=TrainConfig(warmup_steps=1, ema_decay=0.9),
+    )
+    tr2.init(jax.random.PRNGKey(123))
+    ckpt2, _, resume = attach_to_trainer(tr2, tmp_path / "ck")
+    resume()
+    for p, e in zip(jax.tree.leaves(tr2.params), jax.tree.leaves(tr2.ema)):
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(e))
+    ckpt2.close()
+
+
+def test_evaluate_lm_compile_cached_and_mesh():
+    """Repeat evals reuse one compiled forward; mesh evaluates sharded."""
+    from k8s_gpu_tpu.train import evaluate_lm
+    from k8s_gpu_tpu.train.evaluate import _batch_nll_fn
+
+    model = TransformerLM(_cfg())
+    params = model.init(jax.random.PRNGKey(0))
+    f1 = _batch_nll_fn(model, None)
+    f2 = _batch_nll_fn(model, None)
+    assert f1 is f2  # same compiled fn across calls
+    mesh = _mesh(MeshConfig(dp=2, tp=2))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 128)
+    out = evaluate_lm(model, params, [toks], mesh=mesh)
+    ref = evaluate_lm(model, params, [toks])
+    assert abs(out["nll"] - ref["nll"]) < 1e-5
