@@ -58,6 +58,11 @@ class TransformerConfig:
     n_layers: int = 4
     n_heads: int = 8
     d_head: int = 64
+    # Grouped-query attention: K/V projections carry this many heads
+    # (0 = n_heads = classic MHA).  Queries stay at n_heads; each group
+    # of n_heads/n_kv_heads query heads shares one K/V head — the KV
+    # cache (the decode-memory bottleneck) shrinks by the group factor.
+    n_kv_heads: int = 0
     d_ff: int = 1376
     max_seq: int = 2048
     rope_theta: float = 10000.0
@@ -90,6 +95,16 @@ class TransformerConfig:
     def moe(self) -> bool:
         return self.num_experts > 1
 
+    @property
+    def kv_heads(self) -> int:
+        kh = self.n_kv_heads or self.n_heads
+        if self.n_heads % kh != 0:
+            raise ValueError(
+                f"n_heads {self.n_heads} must be a multiple of "
+                f"n_kv_heads {kh}"
+            )
+        return kh
+
 
 class TransformerLM:
     def __init__(self, cfg: TransformerConfig):
@@ -103,6 +118,7 @@ class TransformerLM:
             cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff,
             cfg.n_layers, cfg.vocab_size,
         )
+        KH = cfg.kv_heads
 
         def norm(shape, key, scale):
             return jax.random.normal(key, shape, jnp.float32) * scale
@@ -115,8 +131,8 @@ class TransformerLM:
                 "ln1": jnp.ones((L, D), jnp.float32),
                 "ln2": jnp.ones((L, D), jnp.float32),
                 "wq": norm((L, D, H, Dh), next(k), D**-0.5),
-                "wk": norm((L, D, H, Dh), next(k), D**-0.5),
-                "wv": norm((L, D, H, Dh), next(k), D**-0.5),
+                "wk": norm((L, D, KH, Dh), next(k), D**-0.5),
+                "wv": norm((L, D, KH, Dh), next(k), D**-0.5),
                 "wo": norm((L, H, Dh, D), next(k), (H * Dh) ** -0.5),
             },
         }
@@ -184,6 +200,14 @@ class TransformerLM:
         out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
         return out.astype(x.dtype)
 
+    def _repeat_kv(self, t):
+        """[B, KH, S, Dh] → [B, H, S, Dh] for attention kernels that
+        expect matched head counts (flash/ring/ulysses).  The KV *cache*
+        stays at KH heads — the repeat exists only inside the traced
+        attend, so GQA's memory win is real where it matters (decode)."""
+        g = self.cfg.n_heads // self.cfg.kv_heads
+        return t if g == 1 else jnp.repeat(t, g, axis=1)
+
     def _attention(self, x, lp, positions, mesh, seq_sharded):
         cfg = self.cfg
         dt = cfg.dtype
@@ -193,6 +217,7 @@ class TransformerLM:
         q = self._rope(q, positions)
         k = self._rope(k, positions)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
+        k, v = self._repeat_kv(k), self._repeat_kv(v)
         if seq_sharded:
             if cfg.sp_attention == "ulysses":
                 from ..parallel.ulysses import ulysses_attention

@@ -491,7 +491,7 @@ class ContinuousBatcher:
         # would otherwise explode inside the scheduler loop and take the
         # whole batcher (and every tenant's stream) down with it.
         cfg = self.engine.cfg
-        want = (cfg.n_layers, 1, cfg.n_heads, self.engine.max_seq,
+        want = (cfg.n_layers, 1, cfg.kv_heads, self.engine.max_seq,
                 cfg.d_head)
         for leaf in jax.tree.leaves(row_cache):
             if tuple(leaf.shape) != want:

@@ -50,7 +50,9 @@ class DecodeOutput:
 
 
 def _empty_cache(cfg: TransformerConfig, batch: int, max_seq: int):
-    shape = (cfg.n_layers, batch, cfg.n_heads, max_seq, cfg.d_head)
+    # kv_heads, not n_heads: under GQA the cache is the whole point —
+    # it shrinks by the query-group factor.
+    shape = (cfg.n_layers, batch, cfg.kv_heads, max_seq, cfg.d_head)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -81,6 +83,13 @@ class InferenceEngine:
         self.cfg = model.cfg
         self.max_seq = max_seq or self.cfg.max_seq
         self.mesh = mesh
+        if mesh is not None:
+            tp = mesh.shape.get("tp", 1)
+            if tp > 1 and self.cfg.kv_heads % tp != 0:
+                raise ValueError(
+                    f"n_kv_heads={self.cfg.kv_heads} must be a multiple of "
+                    f"tp={tp} — the KV cache's head axis shards over 'tp'"
+                )
         self._generate_jit = jax.jit(
             self._generate,
             static_argnames=("max_new_tokens", "sampling"),
@@ -100,13 +109,26 @@ class InferenceEngine:
 
     # -- cache-aware blocks ------------------------------------------------
     def _attend_cached(self, q, k_cache, v_cache, kv_len_mask):
-        """q: [B, Sq, H, Dh]; caches [B, H, T, Dh]; kv_len_mask [B, Sq, T]
-        True where attention is allowed."""
-        scale = self.cfg.d_head ** -0.5
-        s = jnp.einsum("bqhd,bhkd->bhqk", q, k_cache) * scale
-        s = jnp.where(kv_len_mask[:, None], s, -1e30)
+        """q: [B, Sq, H, Dh]; caches [B, KH, T, Dh]; kv_len_mask
+        [B, Sq, T] True where attention is allowed.  GQA (KH < H) groups
+        the query heads against their shared K/V head via a reshape —
+        no repeat of the cache ever materializes."""
+        cfg = self.cfg
+        scale = cfg.d_head ** -0.5
+        H, KH = cfg.n_heads, cfg.kv_heads
+        if H == KH:
+            s = jnp.einsum("bqhd,bhkd->bhqk", q, k_cache) * scale
+            s = jnp.where(kv_len_mask[:, None], s, -1e30)
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+            return jnp.einsum("bhqk,bhkd->bqhd", p, v_cache)
+        B, Sq = q.shape[0], q.shape[1]
+        G = H // KH
+        qg = q.reshape(B, Sq, KH, G, cfg.d_head)
+        s = jnp.einsum("bqhgd,bhtd->bhgqt", qg, k_cache) * scale
+        s = jnp.where(kv_len_mask[:, None, None], s, -1e30)
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bhkd->bqhd", p, v_cache)
+        o = jnp.einsum("bhgqt,bhtd->bqhgd", p, v_cache)
+        return o.reshape(B, Sq, H, cfg.d_head)
 
     def _block_cached(self, x, lp, cache_k, cache_v, positions, start, mask,
                       moe_full_capacity=None, lp_ad=None, adapter_idx=None):
@@ -130,13 +152,15 @@ class InferenceEngine:
         if lp_ad is not None:
             # Per-row LoRA deltas (serve/lora_bank.py): same inputs the
             # base matmuls consume, low-rank path gathered by row index.
-            hd = (x.shape[0], x.shape[1], self.cfg.n_heads, self.cfg.d_head)
+            B_, Sq_ = x.shape[0], x.shape[1]
+            hd = (B_, Sq_, self.cfg.n_heads, self.cfg.d_head)
+            kvd = (B_, Sq_, self.cfg.kv_heads, self.cfg.d_head)
             if "wq" in lp_ad:
                 q = q + lora_delta(h, lp_ad["wq"], adapter_idx, dt).reshape(hd)
             if "wk" in lp_ad:
-                k = k + lora_delta(h, lp_ad["wk"], adapter_idx, dt).reshape(hd)
+                k = k + lora_delta(h, lp_ad["wk"], adapter_idx, dt).reshape(kvd)
             if "wv" in lp_ad:
-                v = v + lora_delta(h, lp_ad["wv"], adapter_idx, dt).reshape(hd)
+                v = v + lora_delta(h, lp_ad["wv"], adapter_idx, dt).reshape(kvd)
         q = m._rope(q, positions)
         k = m._rope(k, positions)
         k = k.transpose(0, 2, 1, 3)  # [B,H,Sq,Dh]

@@ -24,9 +24,17 @@ _JIT_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 def _batch_nll_fn(model, mesh):
     per_model = _JIT_CACHE.setdefault(model, {})
     if mesh not in per_model:
+        # weakref, not a closure over `model`: a cached value that
+        # strongly referenced its own WeakKeyDictionary key would pin the
+        # entry (and its XLA executables) for process lifetime.
+        model_ref = weakref.ref(model)
+
         @jax.jit
         def batch_nll(params, tokens, targets):
-            logits, _ = model.forward(params, tokens, mesh)
+            m = model_ref()
+            if m is None:  # pragma: no cover - retrace after model GC
+                raise RuntimeError("evaluated model was garbage-collected")
+            logits, _ = m.forward(params, tokens, mesh)
             logp = jax.nn.log_softmax(logits, axis=-1)
             nll = -jnp.take_along_axis(
                 logp, targets[..., None], axis=-1

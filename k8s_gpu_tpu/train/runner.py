@@ -30,6 +30,19 @@ from ..utils.metrics import global_metrics
 log = logging.getLogger("k8s_gpu_tpu.train")
 
 
+def _check_kv_tp(cfg, mesh) -> None:
+    """GQA x tensor parallelism: the K/V head axis shards over 'tp', so
+    tp must divide kv_heads — fail with a config-level message instead
+    of an opaque device_put divisibility error mid-init."""
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    kh = getattr(cfg, "kv_heads", None)
+    if tp > 1 and kh is not None and kh % tp != 0:
+        raise ValueError(
+            f"n_kv_heads={kh} must be a multiple of tp={tp} (the K/V head "
+            "axis shards over 'tp'); lower tp or raise n_kv_heads"
+        )
+
+
 @dataclass(frozen=True)
 class TrainConfig:
     learning_rate: float = 3e-4
@@ -190,6 +203,7 @@ class Trainer:
 
     # -- setup -------------------------------------------------------------
     def init(self, key) -> None:
+        _check_kv_tp(getattr(self.model, "cfg", None), self.mesh)
         axes = self.model.logical_axes()
         shardings = jax.tree.map(
             lambda ax: self.rules.sharding(self.mesh, ax),
