@@ -17,7 +17,10 @@ external service with an on-device or in-process equivalent:
                       a fixed random projection, computed in JAX (1024-d).
 - PostgreSQL        → ``sqlstore.SqlStore``: stdlib sqlite, same two tables
                       and seed row.
-- Ollama qwen:72b   → ``llm.TpuLMClient``: the serve.InferenceEngine over a
+- Ollama qwen:72b   → ``llm.HttpLMClient`` against the platform's own
+                      LmServer (``k8sgpu serve <asset>``) — the reference's
+                      HTTP topology end to end; or ``llm.TpuLMClient``: the
+                      serve.InferenceEngine in-process over a
                       byte-level tokenizer (or ``llm.TemplateLM`` where a
                       trained checkpoint isn't loaded).
 - FastAPI           → ``server``: stdlib http.server, same routes/JSON.
@@ -26,13 +29,13 @@ external service with an on-device or in-process equivalent:
 from .agents import ChatResponse, FinAgentApp, QueryRequest
 from .embed import TextEmbedder
 from .ingest import ingest
-from .llm import TemplateLM, TpuLMClient
+from .llm import HttpLMClient, TemplateLM, TpuLMClient
 from .splitter import recursive_split
 from .sqlstore import SqlStore
 from .vectorstore import VectorStore
 
 __all__ = [
     "ChatResponse", "FinAgentApp", "QueryRequest", "TextEmbedder",
-    "ingest", "TemplateLM", "TpuLMClient", "recursive_split", "SqlStore",
+    "ingest", "TemplateLM", "TpuLMClient", "HttpLMClient", "recursive_split", "SqlStore",
     "VectorStore",
 ]

@@ -113,3 +113,62 @@ class TemplateLM:
         lines = [ln.strip() for ln in prompt.splitlines() if ln.strip()]
         gist = " / ".join(lines[-3:])[:400]
         return f"{self.prefix}{gist}"
+
+
+class HttpLMClient:
+    """The reference's service topology — agents call their LLM over HTTP
+    (Ollama's OpenAI-compatible endpoint, 智能风控解决方案.md:218-223) —
+    pointed at the platform's OWN LmServer instead: stand a model up
+    with ``k8sgpu serve <asset>`` (serve/server.py) and hand its URL to
+    the agent suite.  The platform hosts the model that powers the
+    reference's flagship application end to end.
+
+    ``adapter``/``constraint``: the LmServer's multi-LoRA and
+    regex-constraint hooks, per client.
+    """
+
+    def __init__(self, base_url: str, max_new_tokens: int = 128,
+                 temperature: float = 0.7, seed: int = 0,
+                 adapter: str | None = None,
+                 constraint: str | None = None, timeout: float = 120.0):
+        self.base_url = base_url.rstrip("/")
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.seed = seed
+        self.adapter = adapter
+        self.constraint = constraint
+        self.timeout = timeout
+
+    def chat(self, prompt: str) -> str:
+        import json
+        import urllib.error
+        import urllib.request
+
+        payload = {
+            "prompt": prompt,
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature,
+            "seed": self.seed,
+        }
+        if self.adapter:
+            payload["adapter"] = self.adapter
+        if self.constraint:
+            payload["constraint"] = self.constraint
+        req = urllib.request.Request(
+            f"{self.base_url}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read())["text"]
+        except urllib.error.HTTPError as e:
+            detail = e.read()[:200].decode(errors="replace")
+            raise RuntimeError(
+                f"LM server {self.base_url} rejected the request "
+                f"({e.code}): {detail}"
+            ) from None
+        except OSError as e:
+            raise RuntimeError(
+                f"LM server {self.base_url} unreachable: {e}"
+            ) from None

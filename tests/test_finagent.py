@@ -239,3 +239,48 @@ def test_http_acceptance_flow(app):
             assert e.code == 422
     finally:
         srv.shutdown()
+
+
+def test_http_llm_client_serves_agents(embedder, kb):
+    """The full reference topology on one box: the platform's LM server
+    hosts the model, the agent suite consumes it over HTTP — both
+    routing branches produce a reply through the real socket."""
+    import jax
+
+    from k8s_gpu_tpu.data.tokenizer import BpeTokenizer
+    from k8s_gpu_tpu.finagent import HttpLMClient
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+    from k8s_gpu_tpu.serve import LmServer
+
+    corpus = "黄金积存产品 收益 咨询 投诉 转账 " * 20 + "gold yield help " * 20
+    tok = BpeTokenizer.train(corpus, vocab_size=300, backend="python")
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=32, n_layers=1, n_heads=2,
+        d_head=16, d_ff=64, max_seq=2048, use_flash=False,
+        dtype=__import__("jax.numpy", fromlist=["float32"]).float32,
+    )
+    model = TransformerLM(cfg)
+    srv = LmServer(model, model.init(jax.random.PRNGKey(0)), tok,
+                   max_new_tokens_cap=16).start()
+    try:
+        vectors, sql = VectorStore(), SqlStore()
+        ingest(kb, vectors, sql, embedder=embedder)
+        app = FinAgentApp(
+            embedder=embedder, vectors=vectors, sql=sql,
+            llm=HttpLMClient(f"http://127.0.0.1:{srv.port}",
+                             max_new_tokens=8, temperature=0.0),
+        )
+        r1 = app.chat(QueryRequest(query="黄金积存产品怎么样", user_id="u1"))
+        assert r1.agent == "营销专员" and isinstance(r1.response, str)
+        r2 = app.chat(QueryRequest(query="我要投诉转账问题", user_id="user_123"))
+        assert r2.agent == "投诉专员" and isinstance(r2.response, str)
+    finally:
+        srv.stop()
+
+
+def test_http_llm_client_error_paths():
+    from k8s_gpu_tpu.finagent import HttpLMClient
+
+    c = HttpLMClient("http://127.0.0.1:1", timeout=2)
+    with pytest.raises(RuntimeError, match="unreachable"):
+        c.chat("hi")
