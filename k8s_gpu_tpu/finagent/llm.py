@@ -128,13 +128,17 @@ class HttpLMClient:
     """
 
     def __init__(self, base_url: str, max_new_tokens: int = 128,
-                 temperature: float = 0.7, seed: int = 0,
+                 temperature: float = 0.7, seed: int | None = None,
                  adapter: str | None = None,
                  constraint: str | None = None, timeout: float = 120.0):
+        """``seed``: None (default) = a fresh seed per request, so a
+        sampling temperature actually samples across retries (matching
+        TpuLMClient's per-call key split); pass an int to pin outputs."""
         self.base_url = base_url.rstrip("/")
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.seed = seed
+        self._counter = 0
         self.adapter = adapter
         self.constraint = constraint
         self.timeout = timeout
@@ -144,11 +148,16 @@ class HttpLMClient:
         import urllib.error
         import urllib.request
 
+        if self.seed is None:
+            self._counter += 1
+            seed = self._counter
+        else:
+            seed = self.seed
         payload = {
             "prompt": prompt,
             "max_new_tokens": self.max_new_tokens,
             "temperature": self.temperature,
-            "seed": self.seed,
+            "seed": seed,
         }
         if self.adapter:
             payload["adapter"] = self.adapter

@@ -246,6 +246,7 @@ def test_http_llm_client_serves_agents(embedder, kb):
     hosts the model, the agent suite consumes it over HTTP — both
     routing branches produce a reply through the real socket."""
     import jax
+    import jax.numpy as jnp
 
     from k8s_gpu_tpu.data.tokenizer import BpeTokenizer
     from k8s_gpu_tpu.finagent import HttpLMClient
@@ -257,7 +258,7 @@ def test_http_llm_client_serves_agents(embedder, kb):
     cfg = TransformerConfig(
         vocab_size=tok.vocab_size, d_model=32, n_layers=1, n_heads=2,
         d_head=16, d_ff=64, max_seq=2048, use_flash=False,
-        dtype=__import__("jax.numpy", fromlist=["float32"]).float32,
+        dtype=jnp.float32,
     )
     model = TransformerLM(cfg)
     srv = LmServer(model, model.init(jax.random.PRNGKey(0)), tok,
