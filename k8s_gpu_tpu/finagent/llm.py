@@ -138,7 +138,12 @@ class HttpLMClient:
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.seed = seed
-        self._counter = 0
+        # itertools.count: __next__ is atomic in CPython, so concurrent
+        # chat() calls (ThreadingHTTPServer handlers share one client)
+        # never reuse a seed.
+        import itertools
+
+        self._counter = itertools.count(1)
         self.adapter = adapter
         self.constraint = constraint
         self.timeout = timeout
@@ -148,11 +153,7 @@ class HttpLMClient:
         import urllib.error
         import urllib.request
 
-        if self.seed is None:
-            self._counter += 1
-            seed = self._counter
-        else:
-            seed = self.seed
+        seed = next(self._counter) if self.seed is None else self.seed
         payload = {
             "prompt": prompt,
             "max_new_tokens": self.max_new_tokens,

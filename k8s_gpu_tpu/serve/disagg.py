@@ -59,13 +59,34 @@ class DisaggregatedLm:
     """
 
     def __init__(self, model, params, *, batcher: ContinuousBatcher,
-                 prefill_workers: int = 1, inflight_cap: int | None = None):
+                 prefill_workers: int = 1, inflight_cap: int | None = None,
+                 chunk_tokens: int = 0):
         """``inflight_cap`` bounds prefilled-but-not-yet-seated rows
         (each pins a full [L,1,H,max_seq,Dh] K/V row in HBM while it
         waits for a decode slot).  Default: the batcher's slot count —
-        prefill never runs more than one slot-generation ahead."""
+        prefill never runs more than one slot-generation ahead.
+
+        ``chunk_tokens`` > 0: CHUNKED prefill — the prompt runs as
+        ceil(n/C) bounded extend_multi dispatches on the request's own
+        off-pool row instead of one prompt-width program, so the decode
+        batcher's rounds interleave between chunks (the device serializes
+        dispatches at CHUNK granularity — bounded stalls instead of a
+        full-prompt stall).  One compile total: every chunk is width C,
+        the last right-padded (pad garbage lands above the live length,
+        which masks never attend and decode overwrites in order).  MoE
+        models fall back to whole-prompt prefill — capacity-capped
+        dispatch couples tokens across the dispatch group, so chunking
+        would diverge from the one-shot oracle (same reason the prefix
+        cache refuses MoE)."""
         self.batcher = batcher
         self.params = params
+        self.chunk_tokens = int(chunk_tokens)
+        if self.chunk_tokens < 0 or (
+            self.chunk_tokens and self.chunk_tokens % 8 != 0
+        ):
+            raise ValueError(
+                "chunk_tokens must be a non-negative multiple of 8"
+            )
         self._inflight = threading.Semaphore(
             inflight_cap if inflight_cap is not None else batcher.slots
         )
@@ -76,6 +97,7 @@ class DisaggregatedLm:
 
         self.engine = InferenceEngine(model, max_seq=batcher.engine.max_seq)
         self._prefill_jit = jax.jit(self.engine.prefill)
+        self._extend_jit = jax.jit(self.engine.extend_multi)
         self._jobs: "queue.Queue[_PrefillJob | None]" = queue.Queue()
         self._dead = False
         self._lifecycle = threading.Lock()
@@ -104,6 +126,8 @@ class DisaggregatedLm:
         batcher.  Raises like ContinuousBatcher.submit."""
         self.batcher.bank.index(adapter)  # unknown names fail fast
         ids = np.asarray(ids, np.int32).ravel()
+        if ids.size == 0:
+            raise ValueError("empty prompt")
         if prompt_bucket(int(ids.size), self.engine.max_seq) is None:
             raise ValueError(
                 f"prompt too long ({ids.size} tokens, "
@@ -120,6 +144,30 @@ class DisaggregatedLm:
             raise out
         return out
 
+    def _prefill_chunked(self, ids, bank, aidx):
+        """ceil(n/C) width-C extend dispatches on a fresh off-pool row.
+        Returns (row_cache, last_logits [1, V]) with exact geometry
+        (pos = n, no left pad)."""
+        from .engine import _empty_cache
+
+        C = self.chunk_tokens
+        n = int(ids.size)
+        cache = _empty_cache(self.engine.cfg, 1, self.engine.max_seq)
+        logits = None
+        for i in range(0, n, C):
+            chunk = ids[i:i + C]
+            arr = jnp.zeros((1, C), jnp.int32).at[0, :chunk.size].set(
+                jnp.asarray(chunk)
+            )
+            cache, lg = self._extend_jit(
+                self.params, cache, arr,
+                jnp.asarray([i]), jnp.asarray([i]), jnp.asarray([0]),
+                adapters=bank.banked,
+                adapter_idx=jnp.asarray([aidx]) if bank.banked else None,
+            )
+            logits = lg[:, chunk.size - 1]
+        return cache, logits
+
     # -- worker ------------------------------------------------------------
     def _worker(self) -> None:
         bank = self.batcher.bank
@@ -133,23 +181,30 @@ class DisaggregatedLm:
                 self._inflight.acquire()
                 released = False
                 try:
-                    bucket = prompt_bucket(
-                        int(job.ids.size), self.engine.max_seq
-                    )
-                    pad = bucket - int(job.ids.size)
-                    padded = jnp.zeros((1, bucket), jnp.int32).at[
-                        0, pad:
-                    ].set(jnp.asarray(job.ids))
                     aidx = bank.index(job.adapter)
-                    row, logits = self._prefill_jit(
-                        self.params, padded, jnp.int32(pad),
-                        adapters=bank.banked,
-                        adapter_idx=(
-                            jnp.asarray([aidx]) if bank.banked else None
-                        ),
-                    )
+                    if self.chunk_tokens and not self.engine.cfg.moe:
+                        row, logits = self._prefill_chunked(
+                            job.ids, bank, aidx
+                        )
+                        n_tokens, pad = int(job.ids.size), 0
+                    else:
+                        bucket = prompt_bucket(
+                            int(job.ids.size), self.engine.max_seq
+                        )
+                        pad = bucket - int(job.ids.size)
+                        padded = jnp.zeros((1, bucket), jnp.int32).at[
+                            0, pad:
+                        ].set(jnp.asarray(job.ids))
+                        row, logits = self._prefill_jit(
+                            self.params, padded, jnp.int32(pad),
+                            adapters=bank.banked,
+                            adapter_idx=(
+                                jnp.asarray([aidx]) if bank.banked else None
+                            ),
+                        )
+                        n_tokens = bucket
                     handle = self.batcher.submit_precomputed(
-                        row, logits, bucket, pad,
+                        row, logits, n_tokens, pad,
                         max_new_tokens=job.max_new,
                         temperature=job.temperature,
                         seed=job.seed,

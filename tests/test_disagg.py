@@ -173,3 +173,62 @@ def test_submit_precomputed_validates_shapes(setup):
             b.submit_precomputed(good_cache, jnp.zeros((128,)), 8, 0)
     finally:
         b.stop()
+
+
+# -- chunked prefill ---------------------------------------------------------
+
+@pytest.mark.parametrize("n_prompt", [3, 8, 9, 16, 21])
+def test_chunked_prefill_matches_oracle(setup, n_prompt):
+    """Chunked prefill is the same computation re-chunked: greedy streams
+    match the teacher-forced oracle at every chunk-boundary shape
+    (n < C, n == C, n = kC, n = kC + r)."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2).start()
+    d = DisaggregatedLm(model, params, batcher=b, chunk_tokens=8).start()
+    try:
+        ids = [(i * 7) % 120 + 1 for i in range(n_prompt)]
+        got = d.submit(ids, max_new_tokens=5).result()
+        assert got == _oracle(model, params, ids, 5), n_prompt
+    finally:
+        d.stop()
+        b.stop()
+
+
+def test_chunked_prefill_with_adapter(setup):
+    model, params = setup
+    cfg = LoraConfig(rank=4, targets=("wq", "wv"))
+    tree = LoraAdapter(cfg).init(jax.random.PRNGKey(1), params)
+    keys = iter(jax.random.split(jax.random.PRNGKey(9), 8))
+    tree["blocks"] = {
+        t: {"a": ab["a"],
+            "b": jax.random.normal(next(keys), ab["b"].shape) * 0.05}
+        for t, ab in tree["blocks"].items()
+    }
+    adapters = {"t1": (tree, cfg)}
+    merged = LoraAdapter(cfg).merge(params, tree)
+    b = ContinuousBatcher(model, params, slots=2, adapters=adapters).start()
+    d = DisaggregatedLm(model, params, batcher=b, chunk_tokens=8).start()
+    try:
+        ids = [7, 3, 11, 19, 2, 4, 6, 8, 10, 12]  # crosses a chunk boundary
+        got = d.submit(ids, max_new_tokens=5, adapter="t1").result()
+        assert got == _oracle(model, merged, ids, 5)
+    finally:
+        d.stop()
+        b.stop()
+
+
+def test_chunk_tokens_validation(setup):
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        DisaggregatedLm(model, params, batcher=b, chunk_tokens=10)
+
+
+def test_chunk_and_prompt_validation(setup):
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        DisaggregatedLm(model, params, batcher=b, chunk_tokens=-8)
+    d = DisaggregatedLm(model, params, batcher=b, chunk_tokens=8)
+    with pytest.raises(ValueError, match="empty prompt"):
+        d.submit([])
