@@ -115,16 +115,19 @@ class RequestHandle:
     def __init__(self, req: _Request):
         self._req = req
         self._tokens: list[int] = []
+        self._lps: list[float] = []
         self._done = False
 
     def __iter__(self):
         yield from self._tokens  # replay what was already consumed
         while not self._done:
-            tok = self._req.out.get()
-            if tok is None:
+            item = self._req.out.get()
+            if item is None:
                 self._done = True
                 return
+            tok, lp = item
             self._tokens.append(tok)
+            self._lps.append(lp)
             yield tok
 
     def result(self) -> list[int]:
@@ -135,6 +138,18 @@ class RequestHandle:
         """True when the stream was cut by batcher shutdown/crash — the
         token list is then a truncation, not a completed generation."""
         return self._req.aborted
+
+    @property
+    def logprobs(self) -> list:
+        """Per-token log-probabilities, parallel to result().  Complete
+        only after the stream finishes (same contract as result());
+        requires the batcher's ``logprobs=True`` (zeros otherwise)."""
+        return list(self._lps)
+
+    @property
+    def last_logprob(self) -> float:
+        """Logprob of the most recently consumed token (streaming)."""
+        return self._lps[-1] if self._lps else 0.0
 
 
 class ContinuousBatcher:
@@ -158,6 +173,7 @@ class ContinuousBatcher:
         pipeline_depth: int = 2,
         adapters: dict | None = None,
         constraints=None,
+        logprobs: bool = False,
     ):
         """``adapters``: name → (lora_params, LoraConfig) — serves every
         adapter and the base model from ONE decode program; requests pick
@@ -187,6 +203,10 @@ class ContinuousBatcher:
         self.params = params
         self.slots = slots
         self.eos_id = eos_id
+        # Collect per-token logprobs: a full-vocab log_softmax per decode
+        # step plus an extra host fetch per round — off by default; the
+        # LM server turns it on (its API exposes "logprobs").
+        self.collect_logprobs = bool(logprobs)
         self.steps_per_round = max(1, int(steps_per_round))
         self.pipeline_depth = max(1, int(pipeline_depth))
         cfg = self.engine.cfg
@@ -263,15 +283,15 @@ class ContinuousBatcher:
         """First-token sampling under the constraint bank: mask at the
         start state (0), then advance the DFA by the chosen token."""
         if ctab is None:
-            first, key = self._first_token(logits, temp, key)
-            return first, key, jnp.int32(0)
+            first, key, lp = self._first_token(logits, temp, key)
+            return first, key, jnp.int32(0), lp
         mask = ctab["allowed"][cidx, 0]
         dead = self.eos_id if self.eos_id >= 0 else 0
-        first, key = self._first_token(logits, temp, key, mask, dead)
+        first, key, lp = self._first_token(logits, temp, key, mask, dead)
         cstate = jnp.where(
             mask.any(), ctab["next"][cidx, 0, first], jnp.int32(0)
         )
-        return first, key, cstate
+        return first, key, cstate, lp
 
     def _admit_dev(self, params, dev, padded, slot, temp, key, pad, bank,
                    aidx, ctab, cidx):
@@ -285,19 +305,22 @@ class ContinuousBatcher:
             adapters=bank, adapter_idx=aidx[None] if bank else None,
         )
         bucket = padded.shape[1]
-        first, key, cstate = self._constrained_first(
+        first, key, cstate, lp = self._constrained_first(
             last_logits[0], temp, key, ctab, cidx
         )
         return self._seat(
             dev, row_cache, slot, first, bucket, bucket - pad, pad, temp,
             key, aidx, cidx, cstate,
-        ), first
+        ), first, lp
 
     @staticmethod
     def _first_token(logits, temp, key, mask=None, dead_tok=0):
         """``mask`` [V] bool: constrained sampling — disallowed logits go
         to -inf; a fully-masked row emits ``dead_tok`` (EOS by
-        convention) so the scheduler retires it."""
+        convention) so the scheduler retires it.  Returns
+        (token, key, logprob) — the chosen token's log-probability under
+        the (masked, unscaled) distribution, the OpenAI-style per-token
+        logprob surface."""
         any_ok = None
         if mask is not None:
             any_ok = mask.any()
@@ -310,7 +333,13 @@ class ContinuousBatcher:
         first = jnp.where(temp > 0, sampled, greedy)
         if mask is not None:
             first = jnp.where(any_ok, first, jnp.int32(dead_tok))
-        return first, key
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))[first]
+        if mask is not None:
+            # all--inf logits → NaN log_softmax; a dead-end row's logprob
+            # must stay finite (it would otherwise serialize as invalid
+            # JSON in the /generate response).
+            lp = jnp.where(any_ok, lp, 0.0)
+        return first, key, lp
 
     def _seat(self, dev, row, slot, first, pos, rope, start, temp, key,
               aidx, cidx=0, cstate=0):
@@ -351,13 +380,13 @@ class ContinuousBatcher:
             jnp.asarray([base_pos]), jnp.asarray([base_pos]),
             jnp.asarray([0]),
         )
-        first, key, cstate = self._constrained_first(
+        first, key, cstate, lp = self._constrained_first(
             logits[0, n_real - 1], temp, key, ctab, cidx
         )
         pos = base_pos + n_real
         return self._seat(
             dev, row, slot, first, pos, pos, 0, temp, key, 0, cidx, cstate
-        ), first
+        ), first, lp
 
     def _admit_exact_dev(self, dev, base, base_logits, pos, rope, start,
                          slot, temp, key, aidx, ctab, cidx):
@@ -366,13 +395,13 @@ class ContinuousBatcher:
         a cached prefix (pos=rope=n, start=0), and disaggregated-prefill
         admission (serve/disagg.py — a prefill worker hands over the row
         with its bucketing geometry intact)."""
-        first, key, cstate = self._constrained_first(
+        first, key, cstate, lp = self._constrained_first(
             base_logits[0], temp, key, ctab, cidx
         )
         return self._seat(
             dev, base, slot, first, pos, rope, start, temp, key, aidx,
             cidx, cstate,
-        ), first
+        ), first, lp
 
     def _round_dev(self, params, dev, bank, ctab):
         """One scheduler round: ``steps_per_round`` batched decode steps as
@@ -408,9 +437,19 @@ class ContinuousBatcher:
                 cstate = jnp.where(
                     any_ok, ctab["next"][dev["cidx"], cstate, nxt], cstate
                 )
-            return (cache, nxt, pos + 1, rope + 1, new_keys, cstate), nxt
+            if self.collect_logprobs:
+                lp = jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=-1
+                )[jnp.arange(nxt.shape[0]), nxt]
+                if ctab is not None:
+                    lp = jnp.where(any_ok, lp, 0.0)  # dead end: finite
+            else:
+                lp = jnp.zeros(nxt.shape[0], jnp.float32)
+            return (cache, nxt, pos + 1, rope + 1, new_keys, cstate), (
+                nxt, lp,
+            )
 
-        (cache, token, pos, rope, keys, cstate), toks = jax.lax.scan(
+        (cache, token, pos, rope, keys, cstate), (toks, lps) = jax.lax.scan(
             one,
             (dev["cache"], dev["token"], dev["pos"], dev["rope"],
              dev["keys"], dev["cstate"]),
@@ -420,7 +459,7 @@ class ContinuousBatcher:
             "cache": cache, "token": token, "pos": pos, "rope": rope,
             "start": kv_start, "temps": temps, "keys": keys,
             "aidx": dev["aidx"], "cidx": dev["cidx"], "cstate": cstate,
-        }, toks
+        }, (toks, lps)
 
     # -- public surface ----------------------------------------------------
     def start(self) -> "ContinuousBatcher":
@@ -620,7 +659,7 @@ class ContinuousBatcher:
         ctab = self.cbank.banked if self.cbank else None
         if req.precomputed is not None:
             row, logits, pos, rope, start = req.precomputed
-            self._dev, first = self._admit_exact_jit(
+            self._dev, first, lp = self._admit_exact_jit(
                 self._dev, row, logits, jnp.int32(pos), jnp.int32(rope),
                 jnp.int32(start), jnp.int32(slot),
                 jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
@@ -631,13 +670,13 @@ class ContinuousBatcher:
             req.precomputed = None
             if req.on_admit is not None:
                 req.on_admit()
-            return self._seated(req, slot, first, "precomputed")
+            return self._seated(req, slot, first, lp, "precomputed")
         # Prefix-cache entries hold BASE-model K/V; an adapter row must
         # cold-prefill (its prefix K/V differ) — correctness over reuse.
         entry = self._match_prefix(req.ids) if req.aidx == 0 else None
         if entry is not None and entry["n"] == req.ids.size:
             # The prompt IS a cached prefix: splice + sample, zero forward.
-            self._dev, first = self._admit_exact_jit(
+            self._dev, first, lp = self._admit_exact_jit(
                 self._dev, entry["cache"], entry["logits"],
                 jnp.int32(entry["n"]), jnp.int32(entry["n"]), jnp.int32(0),
                 jnp.int32(slot),
@@ -654,7 +693,7 @@ class ContinuousBatcher:
             suffix = jnp.zeros((1, w), jnp.int32).at[0, :n_real].set(
                 jnp.asarray(req.ids[p:])
             )
-            self._dev, first = self._admit_prefix_jit(
+            self._dev, first, lp = self._admit_prefix_jit(
                 self.params, self._dev, entry["cache"], suffix,
                 jnp.int32(n_real), jnp.int32(slot),
                 jnp.float32(req.temperature),
@@ -667,7 +706,7 @@ class ContinuousBatcher:
             padded = jnp.zeros((1, bucket), jnp.int32).at[0, pad:].set(
                 jnp.asarray(req.ids)
             )
-            self._dev, first = self._admit_jit(
+            self._dev, first, lp = self._admit_jit(
                 self.params, self._dev, padded, jnp.int32(slot),
                 jnp.float32(req.temperature),
                 jax.random.PRNGKey(req.seed), jnp.int32(pad),
@@ -679,9 +718,10 @@ class ContinuousBatcher:
             else "prefix_suffix" if entry is not None
             else "cold"
         )
-        return self._seated(req, slot, first, path)
+        return self._seated(req, slot, first, lp, path)
 
-    def _seated(self, req: _Request, slot: int, first, path: str) -> tuple:
+    def _seated(self, req: _Request, slot: int, first, lp,
+                path: str) -> tuple:
         """Common tail of every admission: bookkeeping + C32 counters
         (admissions by path, live-slot gauge, pending-queue gauge)."""
         req.slot = slot
@@ -694,24 +734,27 @@ class ContinuousBatcher:
         global_metrics.set_gauge(
             "serve_pending_requests", float(self._pending.qsize())
         )
-        return ("admit", req, first)
+        return ("admit", req, first, lp)
 
     def _dispatch_round(self) -> tuple:
         # Snapshot (slot, request) identity: by the time this round is
         # processed the slot may have been retired AND re-admitted to a new
         # request, whose stream must not receive this round's tokens.
         live = [(i, r) for i, r in enumerate(self._active) if r is not None]
-        self._dev, toks = self._round_jit(
+        self._dev, (toks, lps) = self._round_jit(
             self.params, self._dev, self.bank.banked,
             self.cbank.banked if self.cbank else None,
         )
         self._round_count += 1
-        return ("round", self._round_count, live, toks)
+        return ("round", self._round_count, live, toks, lps)
 
-    def _emit(self, req: _Request, tok: int, round_id: int) -> None:
+    def _emit(self, req: _Request, tok: int, round_id: int,
+              lp: float = 0.0) -> None:
         req.emitted += 1
         self._interleave_log.append((round_id, req.slot))
-        req.out.put(int(tok))
+        # One queue item carries both — the handle collects logprobs on
+        # ITS side of the thread boundary (no per-token list snapshots).
+        req.out.put((int(tok), float(lp)))
 
     def _retire(self, slot: int) -> None:
         req = self._active[slot]
@@ -731,18 +774,21 @@ class ContinuousBatcher:
         """Consume one in-flight item — the only place the scheduler blocks
         on the device."""
         if item[0] == "admit":
-            _, req, first_dev = item
+            _, req, first_dev, lp_dev = item
             if self._active[req.slot] is not req:
                 return  # already retired
             first = int(np.asarray(first_dev))
             hit_eos = self.eos_id >= 0 and first == self.eos_id
             if not hit_eos:
-                self._emit(req, first, self._round_count)
+                self._emit(req, first, self._round_count,
+                           float(np.asarray(lp_dev)))
             if hit_eos or req.emitted >= req.max_new:
                 self._retire(req.slot)
             return
-        _, round_id, live, toks_dev = item
+        _, round_id, live, toks_dev, lps_dev = item
         toks = np.asarray(toks_dev)  # [T, B] — the blocking fetch
+        lps = (np.asarray(lps_dev) if self.collect_logprobs
+               else np.zeros_like(toks, np.float32))
         n_steps = toks.shape[0]
         for i, req in live:
             if self._active[i] is not req:
@@ -753,7 +799,7 @@ class ContinuousBatcher:
                 if self.eos_id >= 0 and tok == self.eos_id:
                     done = True
                     break
-                self._emit(req, tok, round_id)
+                self._emit(req, tok, round_id, float(lps[t, i]))
                 if req.emitted >= req.max_new:
                     done = True
                     break

@@ -61,7 +61,7 @@ class LmServer:
             cbank = ConstraintBank(constraints, token_strings)
         self.batcher = ContinuousBatcher(
             model, params, slots=slots, mesh=mesh, adapters=adapters,
-            constraints=cbank, eos_id=eos_id,
+            constraints=cbank, eos_id=eos_id, logprobs=True,
         )
         self.tokenizer = tokenizer
         self.started_at = time.time()
@@ -132,6 +132,7 @@ class LmServer:
                     return self._json(
                         400, {"error": "constraint must be a string"})
                 stream = bool(body.get("stream", False))
+                want_lp = bool(body.get("logprobs", False))
                 ids = outer.tokenizer.encode(prompt)
                 t0 = time.perf_counter()
                 try:
@@ -150,7 +151,7 @@ class LmServer:
                 except RuntimeError as e:  # scheduler dead: clean 503
                     return self._json(503, {"error": str(e)})
                 if stream:
-                    return self._stream(handle, ids, t0)
+                    return self._stream(handle, ids, t0, want_lp)
                 gen_ids = handle.result()
                 if handle.aborted:
                     return self._json(503, {
@@ -159,15 +160,18 @@ class LmServer:
                         "ids": gen_ids,
                     })
                 dt = time.perf_counter() - t0
-                return self._json(200, {
+                out = {
                     "text": outer.tokenizer.decode(gen_ids),
                     "ids": gen_ids,
                     "prompt_tokens": int(ids.size),
                     "generated_tokens": len(gen_ids),
                     "tokens_per_s": round(len(gen_ids) / dt, 2) if dt > 0 else 0.0,
-                })
+                }
+                if want_lp:
+                    out["logprobs"] = handle.logprobs
+                return self._json(200, out)
 
-            def _stream(self, handle, prompt_ids, t0):
+            def _stream(self, handle, prompt_ids, t0, want_lp=False):
                 """Newline-delimited JSON: one {"id": ...} event per token
                 as the batcher produces it, then a summary event.  No
                 Content-Length — the connection closes when done (HTTP/1.0
@@ -180,9 +184,10 @@ class LmServer:
                 gen_ids = []
                 for tok in handle:
                     gen_ids.append(tok)
-                    self.wfile.write(
-                        (json.dumps({"id": tok}) + "\n").encode()
-                    )
+                    event = {"id": tok}
+                    if want_lp:
+                        event["logprob"] = handle.last_logprob
+                    self.wfile.write((json.dumps(event) + "\n").encode())
                     self.wfile.flush()
                 dt = time.perf_counter() - t0
                 if handle.aborted:

@@ -326,3 +326,27 @@ def test_serving_metrics_recorded(setup):
         assert global_metrics.gauge("serve_slots_active") == 0.0
     finally:
         b.stop()
+
+
+def test_logprobs_parallel_and_correct(setup):
+    """handle.logprobs aligns with result() and each value equals the
+    oracle's log-softmax at the emitted token (greedy)."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2, logprobs=True).start()
+    try:
+        ids = [5, 9, 17]
+        h = b.submit(ids, max_new_tokens=5)
+        toks = h.result()
+        lps = h.logprobs
+        assert len(lps) == len(toks) == 5
+        seq = jnp.asarray(ids, jnp.int32)[None, :]
+        for tok, lp in zip(toks, lps):
+            logits, _ = model.forward(params, seq)
+            ref = float(jax.nn.log_softmax(
+                logits[0, -1].astype(jnp.float32))[tok])
+            assert abs(lp - ref) < 1e-4, (tok, lp, ref)
+            seq = jnp.concatenate(
+                [seq, jnp.asarray([[tok]], jnp.int32)], axis=1
+            )
+    finally:
+        b.stop()

@@ -292,3 +292,23 @@ def test_admit_crash_aborts_popped_request(setup):
         assert h.aborted and got == []
     finally:
         b.stop()
+
+
+def test_dead_end_logprobs_finite(setup):
+    """A constrained row that dead-ends must record finite logprobs —
+    NaN would serialize as invalid JSON (code-review r3)."""
+    import math
+
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+
+    model, params, _ = setup
+    bank = _bank({"yn": "yes|no"})
+    b = ContinuousBatcher(model, params, slots=2, eos_id=0,
+                          constraints=bank, logprobs=True).start()
+    try:
+        h = b.submit([7, 3], max_new_tokens=6, constraint="yn")
+        toks = h.result()
+        assert toks  # produced a yes/no then dead-ended
+        assert all(math.isfinite(lp) for lp in h.logprobs), h.logprobs
+    finally:
+        b.stop()

@@ -189,3 +189,15 @@ def test_precache_endpoint(server):
     assert warm["ids"] == cold["ids"]
     code, err = _post(server, "/precache", {"prompt": ""})
     assert code == 400
+
+
+def test_generate_logprobs_field(server):
+    code, out = _post(server, "/generate",
+                      {"prompt": "the cat", "max_new_tokens": 4,
+                       "logprobs": True})
+    assert code == 200
+    assert len(out["logprobs"]) == len(out["ids"])
+    assert all(lp <= 0.0 for lp in out["logprobs"])
+    code, out2 = _post(server, "/generate",
+                       {"prompt": "the cat", "max_new_tokens": 4})
+    assert "logprobs" not in out2
