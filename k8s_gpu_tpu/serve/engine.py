@@ -38,6 +38,7 @@ from .lora_bank import lora_delta
 class SamplingConfig:
     temperature: float = 0.0  # 0 = greedy
     top_k: int = 0            # 0 = full vocab
+    top_p: float = 0.0        # 0 or 1 = off; else nucleus sampling
     eos_id: int = -1          # -1 = never stop early
     pad_id: int = 0
 
@@ -363,6 +364,18 @@ class InferenceEngine:
         if sampling.top_k > 0:
             top, _ = jax.lax.top_k(l, sampling.top_k)
             l = jnp.where(l < top[..., -1:], -jnp.inf, l)
+        if 0.0 < sampling.top_p < 1.0:
+            # Nucleus: keep the smallest set of tokens whose probability
+            # mass reaches top_p.  A token is kept iff the mass of
+            # strictly-better tokens is < top_p (so the nucleus always
+            # contains at least the argmax).
+            srt = jnp.sort(l, axis=-1)[..., ::-1]          # descending
+            probs = jax.nn.softmax(srt, axis=-1)
+            before = jnp.cumsum(probs, axis=-1) - probs    # mass above
+            keep = before < sampling.top_p
+            n_keep = keep.sum(axis=-1, keepdims=True)      # >= 1
+            thresh = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
+            l = jnp.where(l < thresh, -jnp.inf, l)
         return l
 
     @staticmethod

@@ -145,3 +145,74 @@ def test_prompt_budget_enforced(setup):
     prompt = jnp.zeros((1, 10), jnp.int32)
     with pytest.raises(ValueError):
         eng.generate(params, prompt, max_new_tokens=10)
+
+
+# -- nucleus (top-p) sampling ------------------------------------------------
+
+def test_top_p_warp_keeps_exact_nucleus():
+    import numpy as np
+
+    from k8s_gpu_tpu.serve import InferenceEngine, SamplingConfig
+
+    # probs ~ [0.5, 0.25, 0.15, 0.1] after temperature 1
+    logits = jnp.log(jnp.asarray([0.5, 0.25, 0.15, 0.10]))
+    warped = InferenceEngine.warp_logits(
+        logits, SamplingConfig(temperature=1.0, top_p=0.7)
+    )
+    kept = np.asarray(jnp.isfinite(warped))
+    # mass above token0 = 0 < .7 keep; above token1 = .5 < .7 keep;
+    # above token2 = .75 >= .7 drop; token3 drop
+    assert kept.tolist() == [True, True, False, False]
+    # top_p=0.4: only the argmax survives (nucleus never empty)
+    warped = InferenceEngine.warp_logits(
+        logits, SamplingConfig(temperature=1.0, top_p=0.4)
+    )
+    assert np.asarray(jnp.isfinite(warped)).tolist() == [
+        True, False, False, False
+    ]
+    # off values are no-ops
+    for p in (0.0, 1.0):
+        w = InferenceEngine.warp_logits(
+            logits, SamplingConfig(temperature=1.0, top_p=p)
+        )
+        assert bool(jnp.isfinite(w).all())
+
+
+def test_top_p_sampling_support_is_nucleus_only():
+    import numpy as np
+
+    from k8s_gpu_tpu.serve.engine import InferenceEngine, SamplingConfig
+
+    logits = jnp.log(jnp.asarray([0.5, 0.25, 0.15, 0.10]))
+    samp = SamplingConfig(temperature=1.0, top_p=0.7)
+    draws = jax.vmap(
+        lambda k: InferenceEngine._sample(logits, k, samp)
+    )(jax.random.split(jax.random.PRNGKey(0), 2000))
+    assert set(np.asarray(draws).tolist()) == {0, 1}
+
+
+def test_top_p_speculative_consistency():
+    """warped_probs shares warp_logits, so spec decoding's accept math
+    sees the SAME nucleus — self-draft still accepts everything."""
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+    from k8s_gpu_tpu.serve import (
+        InferenceEngine, SamplingConfig, SpeculativeDecoder,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_head=16,
+        d_ff=64, max_seq=96, dtype=jnp.float32, use_flash=False,
+        remat=False,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    te = InferenceEngine(model)
+    spec = SpeculativeDecoder(te, InferenceEngine(model), k=4)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 1, 60)
+    out = spec.generate(
+        params, params, prompt, max_new_tokens=16,
+        sampling=SamplingConfig(temperature=0.9, top_p=0.8),
+        key=jax.random.PRNGKey(7),
+    )
+    assert spec.stats.acceptance_rate >= 0.99
+    assert bool((out.lengths == 16).all())
