@@ -49,7 +49,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..utils.metrics import global_metrics
-from .engine import InferenceEngine, _empty_cache
+from .engine import InferenceEngine, _empty_cache, nucleus_mask
 
 log = logging.getLogger("k8s_gpu_tpu.serve")
 
@@ -88,6 +88,7 @@ class _Request:
     ids: np.ndarray          # prompt token ids, unpadded
     max_new: int
     temperature: float
+    top_p: float
     seed: int
     out: queue.Queue = field(default_factory=queue.Queue)
     slot: int = -1
@@ -222,6 +223,7 @@ class ContinuousBatcher:
             "rope": jnp.zeros(slots, jnp.int32),
             "start": jnp.zeros(slots, jnp.int32),
             "temps": jnp.zeros(slots, jnp.float32),
+            "top_p": jnp.zeros(slots, jnp.float32),
             "keys": jax.vmap(jax.random.PRNGKey)(
                 jnp.zeros(slots, jnp.uint32)
             ),
@@ -250,7 +252,11 @@ class ContinuousBatcher:
             maxlen=4096
         )
         self._admit_jit = jax.jit(self._admit_dev, donate_argnums=(1,))
-        self._round_jit = jax.jit(self._round_dev, donate_argnums=(1,))
+        # use_top_p is static: two compiled round variants, and the
+        # common no-nucleus traffic never pays the full-vocab sort.
+        self._round_jit = jax.jit(
+            self._round_dev, donate_argnums=(1,), static_argnums=(4,)
+        )
         self._admit_prefix_jit = jax.jit(
             self._admit_prefix_dev, donate_argnums=(1,)
         )
@@ -279,22 +285,27 @@ class ContinuousBatcher:
         )
 
     # -- device programs ---------------------------------------------------
-    def _constrained_first(self, logits, temp, key, ctab, cidx):
+    def _constrained_first(self, logits, temp, key, ctab, cidx,
+                           top_p=None):
         """First-token sampling under the constraint bank: mask at the
         start state (0), then advance the DFA by the chosen token."""
         if ctab is None:
-            first, key, lp = self._first_token(logits, temp, key)
+            first, key, lp = self._first_token(
+                logits, temp, key, top_p=top_p
+            )
             return first, key, jnp.int32(0), lp
         mask = ctab["allowed"][cidx, 0]
         dead = self.eos_id if self.eos_id >= 0 else 0
-        first, key, lp = self._first_token(logits, temp, key, mask, dead)
+        first, key, lp = self._first_token(
+            logits, temp, key, mask, dead, top_p=top_p
+        )
         cstate = jnp.where(
             mask.any(), ctab["next"][cidx, 0, first], jnp.int32(0)
         )
         return first, key, cstate, lp
 
     def _admit_dev(self, params, dev, padded, slot, temp, key, pad, bank,
-                   aidx, ctab, cidx):
+                   aidx, ctab, cidx, top_p):
         """Prefill one request on a [1, bucket] shape, splice its cache row
         into the pool, seat its decode state at *slot*, and sample the
         first token — all on device (no host fetch on the admit path).
@@ -306,15 +317,16 @@ class ContinuousBatcher:
         )
         bucket = padded.shape[1]
         first, key, cstate, lp = self._constrained_first(
-            last_logits[0], temp, key, ctab, cidx
+            last_logits[0], temp, key, ctab, cidx, top_p=top_p
         )
         return self._seat(
             dev, row_cache, slot, first, bucket, bucket - pad, pad, temp,
-            key, aidx, cidx, cstate,
+            key, aidx, cidx, cstate, top_p,
         ), first, lp
 
     @staticmethod
-    def _first_token(logits, temp, key, mask=None, dead_tok=0):
+    def _first_token(logits, temp, key, mask=None, dead_tok=0,
+                     top_p=None):
         """``mask`` [V] bool: constrained sampling — disallowed logits go
         to -inf; a fully-masked row emits ``dead_tok`` (EOS by
         convention) so the scheduler retires it.  Returns
@@ -327,9 +339,10 @@ class ContinuousBatcher:
             logits = jnp.where(mask, logits, -jnp.inf)
         key, sub = jax.random.split(key)
         greedy = jnp.argmax(logits).astype(jnp.int32)
-        sampled = jax.random.categorical(
-            sub, logits / jnp.maximum(temp, 1e-6)
-        ).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temp, 1e-6)
+        if top_p is not None:
+            scaled = nucleus_mask(scaled, top_p)
+        sampled = jax.random.categorical(sub, scaled).astype(jnp.int32)
         first = jnp.where(temp > 0, sampled, greedy)
         if mask is not None:
             first = jnp.where(any_ok, first, jnp.int32(dead_tok))
@@ -342,7 +355,7 @@ class ContinuousBatcher:
         return first, key, lp
 
     def _seat(self, dev, row, slot, first, pos, rope, start, temp, key,
-              aidx, cidx=0, cstate=0):
+              aidx, cidx=0, cstate=0, top_p=0.0):
         """Splice a prefilled K/V row into the pool and seat a slot's
         decode state — the single owner of the per-slot field list (a
         field added here reaches all three admission paths at once)."""
@@ -359,6 +372,7 @@ class ContinuousBatcher:
             "rope": dev["rope"].at[slot].set(rope),
             "start": dev["start"].at[slot].set(start),
             "temps": dev["temps"].at[slot].set(temp),
+            "top_p": dev["top_p"].at[slot].set(top_p),
             "keys": dev["keys"].at[slot].set(key),
             "aidx": dev["aidx"].at[slot].set(aidx),
             "cidx": dev["cidx"].at[slot].set(cidx),
@@ -366,7 +380,7 @@ class ContinuousBatcher:
         }
 
     def _admit_prefix_dev(self, params, dev, base, suffix, n_real, slot,
-                          temp, key, base_pos, ctab, cidx):
+                          temp, key, base_pos, ctab, cidx, top_p):
         """Admit on top of a cached prefix: extend the prefix's K/V row
         with the RIGHT-padded suffix (one extend_multi, width = suffix
         bucket) instead of prefilling the whole prompt.
@@ -381,29 +395,30 @@ class ContinuousBatcher:
             jnp.asarray([0]),
         )
         first, key, cstate, lp = self._constrained_first(
-            logits[0, n_real - 1], temp, key, ctab, cidx
+            logits[0, n_real - 1], temp, key, ctab, cidx, top_p=top_p
         )
         pos = base_pos + n_real
         return self._seat(
-            dev, row, slot, first, pos, pos, 0, temp, key, 0, cidx, cstate
+            dev, row, slot, first, pos, pos, 0, temp, key, 0, cidx, cstate,
+            top_p,
         ), first, lp
 
     def _admit_exact_dev(self, dev, base, base_logits, pos, rope, start,
-                         slot, temp, key, aidx, ctab, cidx):
+                         slot, temp, key, aidx, ctab, cidx, top_p):
         """Seat a row whose K/V were computed elsewhere: splice + sample,
         no model forward on THIS program.  Two callers: a prompt that IS
         a cached prefix (pos=rope=n, start=0), and disaggregated-prefill
         admission (serve/disagg.py — a prefill worker hands over the row
         with its bucketing geometry intact)."""
         first, key, cstate, lp = self._constrained_first(
-            base_logits[0], temp, key, ctab, cidx
+            base_logits[0], temp, key, ctab, cidx, top_p=top_p
         )
         return self._seat(
             dev, base, slot, first, pos, rope, start, temp, key, aidx,
-            cidx, cstate,
+            cidx, cstate, top_p,
         ), first, lp
 
-    def _round_dev(self, params, dev, bank, ctab):
+    def _round_dev(self, params, dev, bank, ctab, use_top_p):
         """One scheduler round: ``steps_per_round`` batched decode steps as
         a single on-device scan.  Returns (new_dev, tokens [T, B]).  Rows
         that hit EOS/budget mid-round produce garbage tails the host drops
@@ -426,6 +441,8 @@ class ContinuousBatcher:
             new_keys, subs = split[:, 0], split[:, 1]
             greedy = jnp.argmax(logits, axis=-1)
             scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            if use_top_p:
+                scaled = nucleus_mask(scaled, dev["top_p"])
             sampled = jax.vmap(
                 lambda k, l: jax.random.categorical(k, l)
             )(subs, scaled)
@@ -457,7 +474,8 @@ class ContinuousBatcher:
         )
         return {
             "cache": cache, "token": token, "pos": pos, "rope": rope,
-            "start": kv_start, "temps": temps, "keys": keys,
+            "start": kv_start, "temps": temps, "top_p": dev["top_p"],
+            "keys": keys,
             "aidx": dev["aidx"], "cidx": dev["cidx"], "cstate": cstate,
         }, (toks, lps)
 
@@ -476,6 +494,7 @@ class ContinuousBatcher:
         ids,
         max_new_tokens: int = 32,
         temperature: float = 0.0,
+        top_p: float = 0.0,
         seed: int = 0,
         adapter: str | None = None,
         constraint: str | None = None,
@@ -497,6 +516,7 @@ class ContinuousBatcher:
             ids=ids,
             max_new=max(1, min(int(max_new_tokens), room)),
             temperature=float(temperature),
+            top_p=float(top_p),
             seed=int(seed),
             aidx=aidx,
             cidx=cidx,
@@ -512,7 +532,8 @@ class ContinuousBatcher:
 
     def submit_precomputed(
         self, row_cache, last_logits, n_tokens: int, pad: int,
-        max_new_tokens: int = 32, temperature: float = 0.0, seed: int = 0,
+        max_new_tokens: int = 32, temperature: float = 0.0,
+        top_p: float = 0.0, seed: int = 0,
         adapter: str | None = None, on_admit=None,
         constraint: str | None = None,
     ) -> RequestHandle:
@@ -548,6 +569,7 @@ class ContinuousBatcher:
             ids=np.zeros(0, np.int32),
             max_new=max(1, min(int(max_new_tokens), room)),
             temperature=float(temperature),
+            top_p=float(top_p),
             seed=int(seed),
             aidx=aidx,
             cidx=cidx,
@@ -664,6 +686,7 @@ class ContinuousBatcher:
                 jnp.int32(start), jnp.int32(slot),
                 jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
                 jnp.int32(req.aidx), ctab, jnp.int32(req.cidx),
+                jnp.float32(req.top_p),
             )
             # Drop the row reference (it lives on in the pool cache) and
             # signal the prefill pool that its HBM is reclaimable.
@@ -682,6 +705,7 @@ class ContinuousBatcher:
                 jnp.int32(slot),
                 jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
                 jnp.int32(0), ctab, jnp.int32(req.cidx),
+                jnp.float32(req.top_p),
             )
         elif entry is not None and (
             entry["n"] + _suffix_bucket(req.ids.size - entry["n"])
@@ -698,7 +722,7 @@ class ContinuousBatcher:
                 jnp.int32(n_real), jnp.int32(slot),
                 jnp.float32(req.temperature),
                 jax.random.PRNGKey(req.seed), jnp.int32(p),
-                ctab, jnp.int32(req.cidx),
+                ctab, jnp.int32(req.cidx), jnp.float32(req.top_p),
             )
         else:
             bucket = prompt_bucket(int(req.ids.size), self.engine.max_seq)
@@ -711,7 +735,7 @@ class ContinuousBatcher:
                 jnp.float32(req.temperature),
                 jax.random.PRNGKey(req.seed), jnp.int32(pad),
                 self.bank.banked, jnp.int32(req.aidx),
-                ctab, jnp.int32(req.cidx),
+                ctab, jnp.int32(req.cidx), jnp.float32(req.top_p),
             )
         path = (
             "prefix_exact" if entry is not None and entry["n"] == req.ids.size
@@ -741,9 +765,13 @@ class ContinuousBatcher:
         # processed the slot may have been retired AND re-admitted to a new
         # request, whose stream must not receive this round's tokens.
         live = [(i, r) for i, r in enumerate(self._active) if r is not None]
+        use_top_p = any(
+            r is not None and 0.0 < r.top_p < 1.0 for r in self._active
+        )
         self._dev, (toks, lps) = self._round_jit(
             self.params, self._dev, self.bank.banked,
             self.cbank.banked if self.cbank else None,
+            use_top_p,
         )
         self._round_count += 1
         return ("round", self._round_count, live, toks, lps)

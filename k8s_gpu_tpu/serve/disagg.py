@@ -40,6 +40,7 @@ class _PrefillJob:
     ids: np.ndarray
     max_new: int
     temperature: float
+    top_p: float
     seed: int
     adapter: str | None
     # filled by the worker: the handle of the decode-side request
@@ -121,7 +122,8 @@ class DisaggregatedLm:
             t.join(timeout=10)
 
     def submit(self, ids, max_new_tokens: int = 32, temperature: float = 0.0,
-               seed: int = 0, adapter: str | None = None) -> RequestHandle:
+               top_p: float = 0.0, seed: int = 0,
+               adapter: str | None = None) -> RequestHandle:
         """Queue a request; prefill happens on the pool, decode on the
         batcher.  Raises like ContinuousBatcher.submit."""
         self.batcher.bank.index(adapter)  # unknown names fail fast
@@ -134,7 +136,7 @@ class DisaggregatedLm:
                 f"max {self.engine.max_seq - 8})"
             )
         job = _PrefillJob(ids, int(max_new_tokens), float(temperature),
-                          int(seed), adapter)
+                          float(top_p), int(seed), adapter)
         with self._lifecycle:
             if self._dead:
                 raise RuntimeError("prefill pool is stopped")
@@ -207,6 +209,7 @@ class DisaggregatedLm:
                         row, logits, n_tokens, pad,
                         max_new_tokens=job.max_new,
                         temperature=job.temperature,
+                        top_p=job.top_p,
                         seed=job.seed,
                         adapter=job.adapter,
                         on_admit=self._inflight.release,

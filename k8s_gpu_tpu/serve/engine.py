@@ -50,6 +50,27 @@ class DecodeOutput:
     prompt_logits: jnp.ndarray  # [B, V] logits at the last prompt position
 
 
+def nucleus_mask(scaled, top_p):
+    """Nucleus (top-p) mask on temperature-scaled logits — THE single
+    implementation (warp_logits for one-shot/speculative, the batcher
+    for per-row serving; divergent copies would let the server's
+    distribution drift from the accept-ratio math).
+
+    ``top_p`` scalar or [B]; values outside (0, 1) keep everything.  A
+    token survives iff the mass of strictly-better tokens is below
+    top_p, so the nucleus always contains the argmax; -inf entries
+    (constraint masks) sort to the tail with zero mass."""
+    top_p = jnp.asarray(top_p, jnp.float32)
+    eff = jnp.where((top_p > 0.0) & (top_p < 1.0), top_p, 1.0)
+    srt = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(srt, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    keep = before < eff[..., None]
+    n_keep = keep.sum(axis=-1, keepdims=True)
+    thresh = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
+    return jnp.where(scaled < thresh, -jnp.inf, scaled)
+
+
 def _empty_cache(cfg: TransformerConfig, batch: int, max_seq: int):
     # kv_heads, not n_heads: under GQA the cache is the whole point —
     # it shrinks by the query-group factor.
@@ -365,17 +386,7 @@ class InferenceEngine:
             top, _ = jax.lax.top_k(l, sampling.top_k)
             l = jnp.where(l < top[..., -1:], -jnp.inf, l)
         if 0.0 < sampling.top_p < 1.0:
-            # Nucleus: keep the smallest set of tokens whose probability
-            # mass reaches top_p.  A token is kept iff the mass of
-            # strictly-better tokens is < top_p (so the nucleus always
-            # contains at least the argmax).
-            srt = jnp.sort(l, axis=-1)[..., ::-1]          # descending
-            probs = jax.nn.softmax(srt, axis=-1)
-            before = jnp.cumsum(probs, axis=-1) - probs    # mass above
-            keep = before < sampling.top_p
-            n_keep = keep.sum(axis=-1, keepdims=True)      # >= 1
-            thresh = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
-            l = jnp.where(l < thresh, -jnp.inf, l)
+            l = nucleus_mask(l, sampling.top_p)
         return l
 
     @staticmethod

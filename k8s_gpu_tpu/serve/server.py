@@ -121,6 +121,7 @@ class LmServer:
                 try:
                     want = int(body.get("max_new_tokens", 32))
                     temperature = float(body.get("temperature", 0.0))
+                    top_p = float(body.get("top_p", 0.0))
                     seed = int(body.get("seed", 0))
                 except (TypeError, ValueError) as e:
                     return self._json(400, {"error": f"bad parameter: {e}"})
@@ -140,6 +141,7 @@ class LmServer:
                         ids,
                         max_new_tokens=max(1, min(want, outer.cap)),
                         temperature=temperature,
+                        top_p=top_p,
                         seed=seed,
                         adapter=adapter,
                         constraint=constraint,

@@ -350,3 +350,33 @@ def test_logprobs_parallel_and_correct(setup):
             )
     finally:
         b.stop()
+
+
+def test_top_p_requests_sample_from_nucleus(setup):
+    """Per-request nucleus: a top_p row's emissions come only from the
+    top of its per-step distribution, while a greedy row in the same
+    rounds is untouched (oracle-exact)."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=3).start()
+    try:
+        ids = [5, 9, 17]
+        greedy_ref = _reference_greedy(model, params, ids, 6)
+        h_greedy = b.submit(ids, max_new_tokens=6)
+        h_p = b.submit(ids, max_new_tokens=6, temperature=1.0, top_p=0.5,
+                       seed=3)
+        assert h_greedy.result() == greedy_ref
+        toks = h_p.result()
+        # every sampled token lies in that step's 0.5-nucleus
+        seq = jnp.asarray(ids, jnp.int32)[None, :]
+        for tok in toks:
+            logits, _ = model.forward(params, seq)
+            p = np.asarray(jax.nn.softmax(logits[0, -1].astype(jnp.float32)))
+            order = np.argsort(p)[::-1]
+            before = np.cumsum(p[order]) - p[order]
+            nucleus = set(order[before < 0.5].tolist())
+            assert tok in nucleus, (tok, sorted(nucleus))
+            seq = jnp.concatenate(
+                [seq, jnp.asarray([[tok]], jnp.int32)], axis=1
+            )
+    finally:
+        b.stop()
