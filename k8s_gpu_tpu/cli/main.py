@@ -43,6 +43,20 @@ def _require_login(cfg: CliConfig) -> Context:
 
 # -- verb implementations --------------------------------------------------
 
+def _parse_kv(specs, what: str) -> dict | None:
+    """Parse repeated KEY=VALUE args into a dict; None + message on a
+    malformed spec (shared by obs selectors and serve constraints)."""
+    out = {}
+    for spec in specs or []:
+        if "=" not in spec:
+            print(f"bad {what} {spec!r}: expected key=value",
+                  file=sys.stderr)
+            return None
+        k, v = spec.split("=", 1)
+        out[k] = v
+    return out
+
+
 def cmd_login(args) -> int:
     cfg = CliConfig.load()
     name = args.context or "default"
@@ -539,13 +553,9 @@ def cmd_obs(args) -> int:
         if not logfile.exists():
             print("no logs persisted yet", file=sys.stderr)
             return 1
-        selector = {}
-        for kv in args.selector or []:
-            if "=" not in kv:
-                print(f"bad selector {kv!r}: expected key=value", file=sys.stderr)
-                return 2
-            k, v = kv.split("=", 1)
-            selector[k] = v
+        selector = _parse_kv(args.selector, "selector")
+        if selector is None:
+            return 2
         # Hydrate a LogStore so selector/contains/tail semantics are the
         # single implementation in utils/logstore.py.
         from ..utils import LogStore
@@ -627,7 +637,24 @@ def cmd_serve(args) -> int:
         return 1
     from ..serve import LmServer
 
-    srv = LmServer(model, params, tok, port=args.port).start()
+    constraints = _parse_kv(args.constraint, "--constraint")
+    if constraints is None:
+        return 2
+    if constraints and args.eos_id < 0:
+        # A dead-ended constrained row retires by emitting EOS; without
+        # one it would stream token 0 as if it were generated content.
+        print("--constraint requires --eos-id (dead-ended rows retire "
+              "by emitting EOS)", file=sys.stderr)
+        return 2
+    try:
+        srv = LmServer(
+            model, params, tok, port=args.port, slots=args.slots,
+            constraints=constraints or None,
+            eos_id=args.eos_id,
+        ).start()
+    except ValueError as e:  # bad regex / vocab mismatch: clean exit
+        print(str(e), file=sys.stderr)
+        return 1
     print(
         f"serving {ctx.space}/model/{args.model} on "
         f"http://127.0.0.1:{srv.port}/generate"
@@ -776,6 +803,13 @@ def build_parser() -> argparse.ArgumentParser:
     p_srv.add_argument("model", help="model asset id in the current space")
     p_srv.add_argument("--version", default="", help="'' = latest")
     p_srv.add_argument("--port", type=int, default=0)
+    p_srv.add_argument("--slots", type=int, default=4,
+                       help="concurrent decode slots")
+    p_srv.add_argument("--constraint", action="append", metavar="NAME=REGEX",
+                       help="named decoding constraint (repeatable); "
+                            "requests opt in with {'constraint': NAME}")
+    p_srv.add_argument("--eos-id", type=int, default=-1,
+                       help="EOS token id (set when using constraints)")
     p_srv.add_argument("--for-seconds", type=float, default=0.0,
                        help="exit after N seconds (0 = until interrupted)")
     p_srv.set_defaults(fn=cmd_serve)

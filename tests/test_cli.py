@@ -244,3 +244,48 @@ def test_serve_model_asset(capsys, tmp_path):
 
     code, _, err = run(capsys, "serve", "missing", "--for-seconds", "0.1")
     assert code == 1 and "no asset" in err
+
+
+def test_serve_with_constraints(capsys, tmp_path):
+    """--constraint name=regex stands the server up with a compiled
+    bank; malformed specs and bad patterns exit cleanly."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.cli.platform_local import LocalPlatform
+    from k8s_gpu_tpu.data.tokenizer import BpeTokenizer
+    from k8s_gpu_tpu.models.transformer import (
+        TransformerConfig, TransformerLM,
+    )
+    from k8s_gpu_tpu.serve import export_servable
+
+    run(capsys, "login", "--user", "ada", "--space", "ml")
+    tok = BpeTokenizer.train("0 1 2 answer yes no " * 30, vocab_size=270,
+                             backend="python")
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=32, n_layers=1, n_heads=2,
+        d_head=16, d_ff=64, max_seq=64, dtype=jnp.float32,
+        use_flash=False, remat=False,
+    )
+    model = TransformerLM(cfg)
+    p = LocalPlatform()
+    try:
+        export_servable(p.assets, "ml", "c-lm", model,
+                        model.init(jax.random.PRNGKey(0)), tokenizer=tok)
+    finally:
+        p.close()
+
+    code, out, err = run(
+        capsys, "serve", "c-lm", "--for-seconds", "0.3",
+        "--constraint", "digits=[0-9 ]+", "--eos-id", "0",
+    )
+    assert code == 0, err
+    code, _, err = run(capsys, "serve", "c-lm", "--for-seconds", "0.1",
+                       "--constraint", "nope", "--eos-id", "0")
+    assert code == 2 and "expected key=value" in err
+    code, _, err = run(capsys, "serve", "c-lm", "--for-seconds", "0.1",
+                       "--constraint", "d=[0-9]+")
+    assert code == 2 and "requires --eos-id" in err
+    code, _, err = run(capsys, "serve", "c-lm", "--for-seconds", "0.1",
+                       "--constraint", "bad=(unclosed", "--eos-id", "0")
+    assert code == 1 and "parenthesis" in err
