@@ -8,11 +8,12 @@ from .disagg import DisaggregatedLm
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
 from .quant import quantize_params
 from .server import LmServer
-from .speculative import SpecOutput, SpeculativeDecoder
+from .speculative import SpecOutput, SpeculativeDecoder, distill_draft
 
 __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
     "ContinuousBatcher", "RequestHandle", "SpeculativeDecoder",
     "SpecOutput", "quantize_params", "export_servable", "load_servable",
     "DisaggregatedLm", "RegexConstraint", "compile_constraint",
+    "distill_draft",
 ]

@@ -50,6 +50,7 @@ from jax.sharding import Mesh
 
 from ..utils.metrics import global_metrics
 from .engine import InferenceEngine, _empty_cache, nucleus_mask
+from .speculative import reject_row
 
 log = logging.getLogger("k8s_gpu_tpu.serve")
 
@@ -175,6 +176,9 @@ class ContinuousBatcher:
         adapters: dict | None = None,
         constraints=None,
         logprobs: bool = False,
+        draft=None,
+        spec_k: int = 4,
+        kv_quant: bool = False,
     ):
         """``adapters``: name → (lora_params, LoraConfig) — serves every
         adapter and the base model from ONE decode program; requests pick
@@ -184,10 +188,33 @@ class ContinuousBatcher:
         a pattern by name and decode under its token-DFA mask in the
         same shared rounds.  Constrained serving wants ``eos_id`` set:
         a dead-ended row emits EOS to retire cleanly (otherwise it pads
-        until budget)."""
+        until budget).
+
+        ``draft``: ``(draft_model, draft_params)`` — turns every decode
+        round into a *speculative* round: ``spec_k`` cheap draft steps
+        propose a window per slot and one target ``extend_multi`` verifies
+        all slots' windows at their own positions (engine.py:extend_multi).
+        Greedy rows stay bit-exact (accepted tokens ARE target argmaxes);
+        sampled rows run per-row Leviathan rejection sampling, exact in
+        distribution for any draft.  The draft maintains its own KV pool,
+        one position behind the target (speculative.py module docstring —
+        same prev/cur bookkeeping, per-slot).  Cold admissions prefill the
+        draft alongside the target; prefix-cache and disaggregated
+        admissions seat a zeroed draft row — the draft then re-warms from
+        the decode stream, costing acceptance, never correctness.
+        Incompatible with ``constraints`` (the DFA advance is sequential
+        in the accepted prefix, which is unknown until after the verify —
+        masking draft proposals by a state that far ahead has no
+        well-defined trace).
+
+        ``kv_quant``: int8 pool KV cache with per-(head, position) scales
+        (engine.__init__) — ~1.9× the slots at fixed HBM.  The draft's
+        (much smaller) cache stays at model dtype."""
         from .lora_bank import AdapterBank
 
-        self.engine = InferenceEngine(model, max_seq=max_seq, mesh=mesh)
+        self.engine = InferenceEngine(
+            model, max_seq=max_seq, mesh=mesh, kv_quant=kv_quant
+        )
         self.bank = AdapterBank(adapters or {})
         self.cbank = constraints
         if (
@@ -201,6 +228,36 @@ class ContinuousBatcher:
                 f"{model.cfg.vocab_size} — compile the bank against this "
                 "model's tokenizer"
             )
+        if constraints is not None and constraints.banked is not None and eos_id < 0:
+            # Without an EOS a dead-ended constrained row pads token 0 as
+            # if generated until budget; the CLI already guards this —
+            # enforce it at the constructor so every embedder does too.
+            raise ValueError(
+                "ContinuousBatcher with a ConstraintBank requires eos_id >= 0: "
+                "a dead-ended constrained row retires by emitting EOS"
+            )
+        self.draft_engine = None
+        self.draft_params = None
+        self.spec_k = max(1, int(spec_k))
+        if draft is not None:
+            draft_model, draft_params = draft
+            if constraints is not None and constraints.banked is not None:
+                raise ValueError(
+                    "speculative decoding and a ConstraintBank cannot be "
+                    "combined: the DFA advances token-by-token through the "
+                    "ACCEPTED prefix, which only exists after the verify"
+                )
+            if draft_model.cfg.vocab_size != model.cfg.vocab_size:
+                raise ValueError(
+                    "draft and target must share a vocabulary "
+                    f"({draft_model.cfg.vocab_size} != {model.cfg.vocab_size})"
+                )
+            # Same max_seq: the draft pool mirrors the target pool's
+            # geometry so positions line up row-for-row.
+            self.draft_engine = InferenceEngine(
+                draft_model, max_seq=self.engine.max_seq, mesh=mesh
+            )
+            self.draft_params = draft_params
         self.params = params
         self.slots = slots
         self.eos_id = eos_id
@@ -216,7 +273,9 @@ class ContinuousBatcher:
         # touching the host (the latency-hiding invariant).
         self._dev = {
             "cache": self.engine._constrain_cache(
-                _empty_cache(cfg, slots, self.engine.max_seq)
+                _empty_cache(
+                    cfg, slots, self.engine.max_seq, self.engine.kv_quant
+                )
             ),
             "token": jnp.zeros(slots, jnp.int32),
             "pos": jnp.zeros(slots, jnp.int32),
@@ -231,6 +290,22 @@ class ContinuousBatcher:
             "cidx": jnp.zeros(slots, jnp.int32),
             "cstate": jnp.zeros(slots, jnp.int32),
         }
+        if self.draft_engine is not None:
+            # Draft KV pool + the `prev` stream token: the draft stays one
+            # position behind the target and re-ingests prev each round
+            # (speculative.py docstring), per slot.
+            self._dev["d_cache"] = self.draft_engine._constrain_cache(
+                _empty_cache(
+                    self.draft_engine.cfg, slots, self.engine.max_seq
+                )
+            )
+            self._dev["prev"] = jnp.zeros(slots, jnp.int32)
+            # Spec rounds per dispatch: a spec round emits 1..spec_k+1
+            # tokens, so matching steps_per_round's per-dispatch token
+            # budget keeps the host-visible cadence comparable.
+            self.spec_rounds = max(
+                1, self.steps_per_round // (self.spec_k + 1)
+            )
         # Host-side scheduler state.  No position mirror is needed: submit
         # clamps max_new to the decode room, so the budget always retires a
         # slot before its writes could run past max_seq (out-of-bounds
@@ -246,6 +321,9 @@ class ContinuousBatcher:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._round_count = 0
+        # Speculative acceptance telemetry (host-side, live rows only).
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         # (round, slot) per emitted token; bounded — it's interleaving
         # observability, not an audit log.
         self._interleave_log: collections.deque = collections.deque(
@@ -256,6 +334,9 @@ class ContinuousBatcher:
         # common no-nucleus traffic never pays the full-vocab sort.
         self._round_jit = jax.jit(
             self._round_dev, donate_argnums=(1,), static_argnums=(4,)
+        )
+        self._round_spec_jit = jax.jit(
+            self._round_spec_dev, donate_argnums=(2,), static_argnums=(4,)
         )
         self._admit_prefix_jit = jax.jit(
             self._admit_prefix_dev, donate_argnums=(1,)
@@ -305,12 +386,14 @@ class ContinuousBatcher:
         return first, key, cstate, lp
 
     def _admit_dev(self, params, dev, padded, slot, temp, key, pad, bank,
-                   aidx, ctab, cidx, top_p):
+                   aidx, ctab, cidx, top_p, dparams=None):
         """Prefill one request on a [1, bucket] shape, splice its cache row
         into the pool, seat its decode state at *slot*, and sample the
         first token — all on device (no host fetch on the admit path).
         ``pad`` is traced: prompts of every length within a bucket share
-        one compiled program (the O(log max_seq) compile story)."""
+        one compiled program (the O(log max_seq) compile story).
+        Speculative mode prefills the draft on the SAME padded shape in
+        the same program — admission stays a single dispatch."""
         row_cache, last_logits = self.engine.prefill(
             params, padded, pad_left=pad,
             adapters=bank, adapter_idx=aidx[None] if bank else None,
@@ -319,9 +402,15 @@ class ContinuousBatcher:
         first, key, cstate, lp = self._constrained_first(
             last_logits[0], temp, key, ctab, cidx, top_p=top_p
         )
+        draft_row = None
+        if self.draft_engine is not None and dparams is not None:
+            draft_row, _ = self.draft_engine.prefill(
+                dparams, padded, pad_left=pad
+            )
         return self._seat(
             dev, row_cache, slot, first, bucket, bucket - pad, pad, temp,
             key, aidx, cidx, cstate, top_p,
+            draft_row=draft_row, prev=padded[0, -1],
         ), first, lp
 
     @staticmethod
@@ -355,17 +444,24 @@ class ContinuousBatcher:
         return first, key, lp
 
     def _seat(self, dev, row, slot, first, pos, rope, start, temp, key,
-              aidx, cidx=0, cstate=0, top_p=0.0):
+              aidx, cidx=0, cstate=0, top_p=0.0, draft_row=None, prev=0):
         """Splice a prefilled K/V row into the pool and seat a slot's
         decode state — the single owner of the per-slot field list (a
-        field added here reaches all three admission paths at once)."""
+        field added here reaches all three admission paths at once).
+
+        ``draft_row``/``prev`` (speculative mode): the draft's prefilled
+        K/V row, or None to seat a ZEROED row — a stale previous tenant's
+        draft K/V would otherwise poison this request's proposals.  prev
+        is the last prompt token (re-ingested at pos-1 each spec round)."""
         cache = jax.tree.map(
+            # Rank-generic splice: int8 values are rank 5, their scales
+            # rank 4 — both splice on the same (layer, slot) leading axes.
             lambda p, r: jax.lax.dynamic_update_slice(
-                p, r.astype(p.dtype), (0, slot, 0, 0, 0)
+                p, r.astype(p.dtype), (0, slot) + (0,) * (p.ndim - 2)
             ),
             dev["cache"], row,
         )
-        return {
+        out = {
             "cache": cache,
             "token": dev["token"].at[slot].set(first),
             "pos": dev["pos"].at[slot].set(pos),
@@ -378,6 +474,22 @@ class ContinuousBatcher:
             "cidx": dev["cidx"].at[slot].set(cidx),
             "cstate": dev["cstate"].at[slot].set(cstate),
         }
+        if self.draft_engine is not None:
+            if draft_row is None:
+                draft_row = jax.tree.map(
+                    lambda p: jnp.zeros(
+                        (p.shape[0], 1) + p.shape[2:], p.dtype
+                    ),
+                    dev["d_cache"],
+                )
+            out["d_cache"] = jax.tree.map(
+                lambda p, r: jax.lax.dynamic_update_slice(
+                    p, r.astype(p.dtype), (0, slot, 0, 0, 0)
+                ),
+                dev["d_cache"], draft_row,
+            )
+            out["prev"] = dev["prev"].at[slot].set(prev)
+        return out
 
     def _admit_prefix_dev(self, params, dev, base, suffix, n_real, slot,
                           temp, key, base_pos, ctab, cidx, top_p):
@@ -400,11 +512,12 @@ class ContinuousBatcher:
         pos = base_pos + n_real
         return self._seat(
             dev, row, slot, first, pos, pos, 0, temp, key, 0, cidx, cstate,
-            top_p,
+            top_p, prev=suffix[0, n_real - 1],
         ), first, lp
 
     def _admit_exact_dev(self, dev, base, base_logits, pos, rope, start,
-                         slot, temp, key, aidx, ctab, cidx, top_p):
+                         slot, temp, key, aidx, ctab, cidx, top_p,
+                         prev=0):
         """Seat a row whose K/V were computed elsewhere: splice + sample,
         no model forward on THIS program.  Two callers: a prompt that IS
         a cached prefix (pos=rope=n, start=0), and disaggregated-prefill
@@ -415,7 +528,7 @@ class ContinuousBatcher:
         )
         return self._seat(
             dev, base, slot, first, pos, rope, start, temp, key, aidx,
-            cidx, cstate, top_p,
+            cidx, cstate, top_p, prev=prev,
         ), first, lp
 
     def _round_dev(self, params, dev, bank, ctab, use_top_p):
@@ -478,6 +591,126 @@ class ContinuousBatcher:
             "keys": keys,
             "aidx": dev["aidx"], "cidx": dev["cidx"], "cstate": cstate,
         }, (toks, lps)
+
+    def _round_spec_dev(self, params, dparams, dev, bank, use_top_p):
+        """Speculative scheduler round(s): ``spec_rounds`` × (K draft
+        steps + ONE target verify over every slot's own window, via
+        engine.extend_multi's per-row window writes).  Returns
+        (new_dev, (toks [R, B, K+1], ns [R, B], lps [R, B, K+1])) —
+        row b emitted ns[r, b] = a+1 tokens in sub-round r (the accepted
+        draft prefix plus the target's correction/bonus token); the host
+        trims by EOS/budget exactly as in the plain round.
+
+        Greedy rows (temp == 0) are BIT-exact with the plain path: every
+        emitted token is a target argmax over the same cached prefix —
+        the draft only changes how many arrive per dispatch.  Sampled
+        rows run per-row rejection sampling (_reject_row) against the
+        same per-row warp the plain round samples from: exact in
+        distribution for ANY draft, though a seeded stream consumes PRNG
+        differently than the plain path (the one-shot SpeculativeDecoder
+        contract).  Retired-but-unnoticed slots advance up to K+1
+        positions per sub-round as garbage; their out-of-range window
+        writes are dropped by XLA scatter semantics and never emitted
+        (same argument as the plain round's garbage tail)."""
+        K = self.spec_k
+        kv_start = dev["start"]
+        temps = dev["temps"]
+        B = kv_start.shape[0]
+        sampled_row = temps > 0.0
+
+        def warp(logits):
+            scaled = (
+                logits.astype(jnp.float32)
+                / jnp.maximum(temps, 1e-6)[:, None]
+            )
+            if use_top_p:
+                scaled = nucleus_mask(scaled, dev["top_p"])
+            return scaled
+
+        def one(carry, _):
+            cache, d_cache, token, prev, pos, rope, keys = carry
+            # Per-row keys: 1 fresh carry + K draft draws + 1 rejection.
+            split = jax.vmap(lambda k: jax.random.split(k, K + 2))(keys)
+            new_keys = split[:, 0]
+            # 1. Draft: re-ingest prev at pos-1 (idempotent overwrite;
+            #    re-warms zero-seated rows too), then K lookahead steps.
+            d_cache, _ = self.draft_engine.decode_step_multi(
+                dparams, d_cache, prev,
+                jnp.maximum(pos - 1, kv_start), jnp.maximum(rope - 1, 0),
+                kv_start,
+            )
+            tok = token
+            drafts, qs = [], []
+            for i in range(K):
+                d_cache, dlogits = self.draft_engine.decode_step_multi(
+                    dparams, d_cache, tok, pos + i, rope + i, kv_start
+                )
+                dscaled = warp(dlogits)
+                draw = jax.vmap(jax.random.categorical)(
+                    split[:, 1 + i], dscaled
+                )
+                tok = jnp.where(
+                    sampled_row, draw, jnp.argmax(dlogits, axis=-1)
+                ).astype(jnp.int32)
+                drafts.append(tok)
+                qs.append(jax.nn.softmax(dscaled, axis=-1))
+            g = jnp.stack(drafts, axis=1)                      # [B, K]
+            # 2. Verify: one target forward over [token, g] windows.
+            window = jnp.concatenate([token[:, None], g], axis=1)
+            cache, vlogits = self.engine.extend_multi(
+                params, cache, window, pos, rope, kv_start,
+                adapters=bank, adapter_idx=dev["aidx"] if bank else None,
+            )
+            # 3a. Greedy: longest target-argmax-matching prefix.
+            t_pred = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            match = (g == t_pred[:, :K]).astype(jnp.int32)
+            a_g = jnp.cumprod(match, axis=1).sum(axis=1)
+            # 3b. Sampled: per-row rejection sampling on warped p/q.
+            p = jax.nn.softmax(
+                jax.vmap(warp, in_axes=1, out_axes=1)(vlogits), axis=-1
+            )                                                   # [B,K+1,V]
+            q = jnp.stack(qs, axis=1)                           # [B,K,V]
+            a_s, x = jax.vmap(reject_row)(split[:, K + 1], p, q, g)
+            a = jnp.where(sampled_row, a_s, a_g)
+            corr = jnp.where(
+                sampled_row[:, None],
+                jnp.broadcast_to(x[:, None], (B, K + 1)),
+                t_pred,
+            )
+            idx = jnp.arange(K + 1, dtype=jnp.int32)[None]
+            base = jnp.concatenate([g, g[:, -1:]], axis=1)
+            e = jnp.where(idx < a[:, None], base, corr)         # [B,K+1]
+            n = a + 1
+            if self.collect_logprobs:
+                lsm = jax.nn.log_softmax(
+                    vlogits.astype(jnp.float32), axis=-1
+                )
+                lp = jnp.take_along_axis(lsm, e[..., None], axis=2)[..., 0]
+            else:
+                lp = jnp.zeros((B, K + 1), jnp.float32)
+            # 4. Advance: prev/token slide to the accepted frontier —
+            #    window[a] sits at the new pos-1, e[a] is the next feed.
+            new_prev = jnp.take_along_axis(window, a[:, None], 1)[:, 0]
+            new_token = jnp.take_along_axis(e, a[:, None], 1)[:, 0]
+            return (
+                cache, d_cache, new_token, new_prev, pos + n, rope + n,
+                new_keys,
+            ), (e, n, lp)
+
+        (cache, d_cache, token, prev, pos, rope, keys), (toks, ns, lps) = (
+            jax.lax.scan(
+                one,
+                (dev["cache"], dev["d_cache"], dev["token"], dev["prev"],
+                 dev["pos"], dev["rope"], dev["keys"]),
+                length=self.spec_rounds,
+            )
+        )
+        out = dict(dev)
+        out.update(
+            cache=cache, d_cache=d_cache, token=token, prev=prev,
+            pos=pos, rope=rope, keys=keys,
+        )
+        return out, (toks, ns, lps)
 
     # -- public surface ----------------------------------------------------
     def start(self) -> "ContinuousBatcher":
@@ -551,14 +784,23 @@ class ContinuousBatcher:
         # would otherwise explode inside the scheduler loop and take the
         # whole batcher (and every tenant's stream) down with it.
         cfg = self.engine.cfg
-        want = (cfg.n_layers, 1, cfg.kv_heads, self.engine.max_seq,
-                cfg.d_head)
-        for leaf in jax.tree.leaves(row_cache):
-            if tuple(leaf.shape) != want:
+        tmpl = jax.eval_shape(
+            lambda: _empty_cache(cfg, 1, self.engine.max_seq,
+                                 self.engine.kv_quant)
+        )
+        got_keys = set(row_cache) if isinstance(row_cache, dict) else None
+        if got_keys != set(tmpl):
+            raise ValueError(
+                f"row_cache keys {got_keys} != {set(tmpl)} (was it "
+                "prefilled by an engine with a different kv_quant "
+                "setting?)"
+            )
+        for key, leaf in row_cache.items():
+            if tuple(leaf.shape) != tuple(tmpl[key].shape):
                 raise ValueError(
-                    f"row_cache leaf shape {tuple(leaf.shape)} != {want} "
-                    "(was it prefilled by an engine with a different "
-                    "max_seq?)"
+                    f"row_cache[{key!r}] shape {tuple(leaf.shape)} != "
+                    f"{tuple(tmpl[key].shape)} (was it prefilled by an "
+                    "engine with a different max_seq?)"
                 )
         if tuple(last_logits.shape) != (1, cfg.vocab_size):
             raise ValueError(
@@ -622,7 +864,9 @@ class ContinuousBatcher:
         w = min(_suffix_bucket(n), self.engine.max_seq)
         padded = jnp.zeros((1, w), jnp.int32).at[0, :n].set(jnp.asarray(ids))
         cache, all_logits = self._precache_jit(
-            self.params, _empty_cache(self.engine.cfg, 1, self.engine.max_seq),
+            self.params,
+            _empty_cache(self.engine.cfg, 1, self.engine.max_seq,
+                         self.engine.kv_quant),
             padded,
         )
         logits = all_logits[:, n - 1]
@@ -665,6 +909,18 @@ class ContinuousBatcher:
         return self._round_count
 
     @property
+    def spec_stats(self) -> dict:
+        """Measured speculative acceptance over live rows: drafted /
+        accepted counts and the rate (0.0 when spec is off or nothing
+        ran).  This is the number the bench reports — a projection is
+        not evidence."""
+        d, a = self._spec_drafted, self._spec_accepted
+        return {
+            "drafted": d, "accepted": a,
+            "acceptance": (a / d) if d else 0.0,
+        }
+
+    @property
     def interleave_log(self) -> list[tuple[int, int]]:
         """(round, slot) per emitted token — lets tests prove two requests
         shared the same decode rounds."""
@@ -686,7 +942,7 @@ class ContinuousBatcher:
                 jnp.int32(start), jnp.int32(slot),
                 jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
                 jnp.int32(req.aidx), ctab, jnp.int32(req.cidx),
-                jnp.float32(req.top_p),
+                jnp.float32(req.top_p), jnp.int32(0),
             )
             # Drop the row reference (it lives on in the pool cache) and
             # signal the prefill pool that its HBM is reclaimable.
@@ -705,7 +961,7 @@ class ContinuousBatcher:
                 jnp.int32(slot),
                 jnp.float32(req.temperature), jax.random.PRNGKey(req.seed),
                 jnp.int32(0), ctab, jnp.int32(req.cidx),
-                jnp.float32(req.top_p),
+                jnp.float32(req.top_p), jnp.int32(int(req.ids[-1])),
             )
         elif entry is not None and (
             entry["n"] + _suffix_bucket(req.ids.size - entry["n"])
@@ -736,6 +992,7 @@ class ContinuousBatcher:
                 jax.random.PRNGKey(req.seed), jnp.int32(pad),
                 self.bank.banked, jnp.int32(req.aidx),
                 ctab, jnp.int32(req.cidx), jnp.float32(req.top_p),
+                self.draft_params,
             )
         path = (
             "prefix_exact" if entry is not None and entry["n"] == req.ids.size
@@ -768,6 +1025,13 @@ class ContinuousBatcher:
         use_top_p = any(
             r is not None and 0.0 < r.top_p < 1.0 for r in self._active
         )
+        if self.draft_engine is not None:
+            self._dev, (toks, ns, lps) = self._round_spec_jit(
+                self.params, self.draft_params, self._dev,
+                self.bank.banked, use_top_p,
+            )
+            self._round_count += 1
+            return ("spec", self._round_count, live, toks, ns, lps)
         self._dev, (toks, lps) = self._round_jit(
             self.params, self._dev, self.bank.banked,
             self.cbank.banked if self.cbank else None,
@@ -812,6 +1076,34 @@ class ContinuousBatcher:
                            float(np.asarray(lp_dev)))
             if hit_eos or req.emitted >= req.max_new:
                 self._retire(req.slot)
+            return
+        if item[0] == "spec":
+            _, round_id, live, toks_dev, ns_dev, lps_dev = item
+            toks = np.asarray(toks_dev)   # [R, B, K+1] — blocking fetch
+            ns = np.asarray(ns_dev)       # [R, B] tokens per sub-round
+            lps = (np.asarray(lps_dev) if self.collect_logprobs
+                   else np.zeros(toks.shape, np.float32))
+            for i, req in live:
+                if self._active[i] is not req:
+                    continue
+                done = False
+                for r in range(toks.shape[0]):
+                    n = int(ns[r, i])
+                    self._spec_drafted += self.spec_k
+                    self._spec_accepted += n - 1
+                    for t in range(n):
+                        tok = int(toks[r, i, t])
+                        if self.eos_id >= 0 and tok == self.eos_id:
+                            done = True
+                            break
+                        self._emit(req, tok, round_id, float(lps[r, i, t]))
+                        if req.emitted >= req.max_new:
+                            done = True
+                            break
+                    if done:
+                        break
+                if done:
+                    self._retire(i)
             return
         _, round_id, live, toks_dev, lps_dev = item
         toks = np.asarray(toks_dev)  # [T, B] — the blocking fetch

@@ -40,9 +40,23 @@ _ESCAPES = {
     "s": set(" \t\n\r\f\v"),
 }
 
+# Control-character escapes resolve to the actual character; any OTHER
+# alphanumeric escape is an error rather than silently matching the
+# literal letter (standard regex engines reserve those).
+_CTRL_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", "f": "\f", "v": "\v", "0": "\0"}
+
 
 class RegexError(ValueError):
     pass
+
+
+def _escape_char(e: str) -> str:
+    """Resolve a single-character escape that is not a class shorthand."""
+    if e in _CTRL_ESCAPES:
+        return _CTRL_ESCAPES[e]
+    if e.isalnum():
+        raise RegexError(f"unknown escape \\{e}")
+    return e
 
 
 def _parse(pattern: str):
@@ -104,8 +118,9 @@ def _parse(pattern: str):
                     chars |= _ESCAPES[e]
                     prev = None
                 else:
-                    chars.add(e)
-                    prev = e
+                    resolved = _escape_char(e)
+                    chars.add(resolved)
+                    prev = resolved
             elif c == "-" and prev is not None and peek() not in (None, "]"):
                 hi = take()
                 chars |= {chr(x) for x in range(ord(prev), ord(hi) + 1)}
@@ -137,7 +152,7 @@ def _parse(pattern: str):
             e = take()
             if e in _ESCAPES:
                 return ("lit", frozenset(_ESCAPES[e]), False)
-            return ("lit", frozenset({e}), False)
+            return ("lit", frozenset({_escape_char(e)}), False)
         if c in ")|*+?]":
             raise RegexError(f"unexpected {c!r} at {pos}")
         take()

@@ -96,7 +96,12 @@ class DisaggregatedLm:
         # separate prefill mesh plugs in).
         from .engine import InferenceEngine
 
-        self.engine = InferenceEngine(model, max_seq=batcher.engine.max_seq)
+        # kv_quant follows the decode side: the handed-over row must
+        # splice into the pool cache leaf-for-leaf.
+        self.engine = InferenceEngine(
+            model, max_seq=batcher.engine.max_seq,
+            kv_quant=batcher.engine.kv_quant,
+        )
         self._prefill_jit = jax.jit(self.engine.prefill)
         self._extend_jit = jax.jit(self.engine.extend_multi)
         self._jobs: "queue.Queue[_PrefillJob | None]" = queue.Queue()
@@ -154,7 +159,8 @@ class DisaggregatedLm:
 
         C = self.chunk_tokens
         n = int(ids.size)
-        cache = _empty_cache(self.engine.cfg, 1, self.engine.max_seq)
+        cache = _empty_cache(self.engine.cfg, 1, self.engine.max_seq,
+                             self.engine.kv_quant)
         logits = None
         for i in range(0, n, C):
             chunk = ids[i:i + C]

@@ -42,6 +42,8 @@ class LmServer:
         adapters: dict | None = None,
         constraints: dict | None = None,
         eos_id: int = -1,
+        draft=None,
+        kv_quant: bool = False,
     ):
         """``adapters``: name → (lora_params, LoraConfig); requests pick
         one with {"adapter": "<name>"} — multi-tenant fine-tunes served
@@ -50,7 +52,10 @@ class LmServer:
         ``constraints``: name → regex pattern, compiled against this
         tokenizer's vocabulary into a ConstraintBank; requests pick one
         with {"constraint": "<name>"} (serve/constrain.py).  Configure
-        ``eos_id`` with constraints so dead-ended rows retire cleanly."""
+        ``eos_id`` with constraints so dead-ended rows retire cleanly.
+
+        ``draft``/``kv_quant`` pass through to ContinuousBatcher:
+        speculative rounds and the int8 pool KV cache."""
         cbank = None
         if constraints:
             from .constrain import ConstraintBank
@@ -62,6 +67,7 @@ class LmServer:
         self.batcher = ContinuousBatcher(
             model, params, slots=slots, mesh=mesh, adapters=adapters,
             constraints=cbank, eos_id=eos_id, logprobs=True,
+            draft=draft, kv_quant=kv_quant,
         )
         self.tokenizer = tokenizer
         self.started_at = time.time()

@@ -66,40 +66,52 @@ def warped_probs(logits, sampling: SamplingConfig):
     )
 
 
-def rejection_sample(key, p, q, g):
-    """Speculative rejection sampling (Leviathan et al., exact-match to
-    the target distribution).
+def reject_row(key, p, q, g):
+    """ONE row of speculative rejection sampling (Leviathan et al.) — THE
+    single implementation of the accept/residual math; the batched
+    ``rejection_sample`` and the continuous batcher's per-row path both
+    ride it (divergent copies would let the two spec surfaces drift, the
+    same hazard nucleus_mask's docstring names for sampling warps).
 
-    p [B, K+1, V]: warped target distributions at each verify position;
-    q [B, K, V]: warped draft distributions the drafts were drawn from;
-    g [B, K]: the drafted tokens.  Returns (a [B], x [B]): the number of
-    leading drafts accepted and the correction token drawn from the
-    residual ``max(p_a - q_a, 0)`` (renormalized).  Extending q with a
-    zero row makes the all-accepted bonus case the same formula: the
-    residual against q = 0 is exactly ``p_{K+1}``.
+    p [K+1, V]: warped target distributions at each verify position;
+    q [K, V]: warped draft distributions the drafts were drawn from;
+    g [K]: drafted tokens.  Returns (a, x): the number of leading drafts
+    accepted and the correction token drawn from the normalized residual
+    ``max(p_a - q_a, 0)``.  Extending q with a zero row makes the
+    all-accepted bonus case the same formula: the residual against q = 0
+    is exactly ``p_{K+1}``.
 
     Exactness: accept g_i with prob min(1, p_i(g_i)/q_i(g_i)), else emit
     from the normalized residual — the emitted token is distributed
     exactly as p_i regardless of q (tests/test_speculative.py checks the
-    empirical distribution).
-    """
-    B, K = g.shape
-    k_acc, k_corr = jax.random.split(key)
-    p_at_g = jnp.take_along_axis(p[:, :K], g[..., None], axis=2)[..., 0]
-    q_at_g = jnp.take_along_axis(q, g[..., None], axis=2)[..., 0]
-    u = jax.random.uniform(k_acc, (B, K))
+    empirical distribution)."""
+    K = g.shape[0]
+    ka, kc = jax.random.split(key)
+    p_at_g = jnp.take_along_axis(p[:K], g[:, None], axis=1)[:, 0]
+    q_at_g = jnp.take_along_axis(q, g[:, None], axis=1)[:, 0]
+    u = jax.random.uniform(ka, (K,))
     accept = u * q_at_g < p_at_g          # u < p/q without the divide
-    a = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
-    q_ext = jnp.concatenate([q, jnp.zeros_like(q[:, :1])], axis=1)
+    a = jnp.cumprod(accept.astype(jnp.int32)).sum()
+    q_ext = jnp.concatenate([q, jnp.zeros_like(q[:1])], axis=0)
     res = jnp.maximum(p - q_ext, 0.0)
-    res_a = jnp.take_along_axis(res, a[:, None, None], axis=1)[:, 0]
-    p_a = jnp.take_along_axis(p, a[:, None, None], axis=1)[:, 0]
-    norm = res_a.sum(-1, keepdims=True)
+    res_a, p_a = res[a], p[a]
+    norm = res_a.sum()
     # Degenerate residual (p == q exactly at a rejected position) can't
     # happen in exact arithmetic but can at float epsilon: fall back to p.
     dist = jnp.where(norm > 1e-9, res_a / jnp.maximum(norm, 1e-30), p_a)
-    x = jax.random.categorical(k_corr, jnp.log(dist + 1e-30), axis=-1)
-    return a, x
+    x = jax.random.categorical(kc, jnp.log(dist + 1e-30))
+    return a, x.astype(jnp.int32)
+
+
+def rejection_sample(key, p, q, g):
+    """Batched rejection sampling: split *key* per row and vmap
+    ``reject_row`` — per-row keys are a strict generalization of a
+    shared one (independent rows either way; the batcher needs per-row
+    so a seeded request's draws never depend on its co-tenants).
+
+    p [B, K+1, V], q [B, K, V], g [B, K] → (a [B], x [B])."""
+    B = g.shape[0]
+    return jax.vmap(reject_row)(jax.random.split(key, B), p, q, g)
 
 
 @dataclass
@@ -349,3 +361,77 @@ class SpeculativeDecoder:
             tokens=state[7], lengths=lengths, rounds=rounds,
             accepted=accepted,
         )
+
+
+def distill_draft(target_model, tparams, draft_cfg=None, *, steps: int = 200,
+                  batch: int = 8, seq_len: int = 64, lr: float = 3e-3,
+                  key=None):
+    """Distill a small draft LM from a target — the trained-draft path
+    that turns speculative acceptance from a projection into a measured
+    number (the random-init draft accepts ~0 of its proposals).
+
+    Training data is the TARGET'S OWN samples (temperature-1 ancestral
+    sequences from random 2-token prompts) — acceptance is measured on
+    decode-time streams, so the draft must fit the target's output
+    distribution, not some external corpus.  The loss is the standard
+    distillation KL(p_target ‖ p_draft) per position.
+
+    ``draft_cfg`` defaults to the target shrunk to 2 layers at half
+    width — a ~10× cheaper forward.  Returns (draft_model, dparams,
+    final_kl)."""
+    import dataclasses
+
+    import optax
+
+    from ..models import TransformerLM
+
+    cfg = target_model.cfg
+    if draft_cfg is None:
+        draft_cfg = dataclasses.replace(
+            cfg, n_layers=2, d_model=max(32, cfg.d_model // 2),
+            d_ff=max(64, cfg.d_ff // 2), num_experts=0,
+        )
+    if draft_cfg.vocab_size != cfg.vocab_size:
+        raise ValueError("draft_cfg must keep the target's vocab_size")
+    draft_model = TransformerLM(draft_cfg)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    k_init, k_data = jax.random.split(key)
+    dparams = draft_model.init(k_init)
+    # Sample the training stream from the target once (one engine
+    # generate per distillation — the samples are reused every step;
+    # fitting a tiny draft needs distribution coverage, not fresh data).
+    eng = InferenceEngine(target_model, max_seq=max(seq_len + 4, 16))
+    prompts = jax.random.randint(
+        k_data, (batch, 2), 1, cfg.vocab_size, jnp.int32
+    )
+    gen = eng.generate(
+        tparams, prompts, max_new_tokens=seq_len - 2,
+        sampling=SamplingConfig(temperature=1.0),
+        key=jax.random.fold_in(k_data, 1),
+    )
+    seqs = jnp.concatenate([prompts, gen.tokens], axis=1)  # [B, seq_len]
+
+    opt = optax.adamw(lr)
+    ost = opt.init(dparams)
+    # Target labels once, outside the loop: the sequences are fixed, the
+    # target is the expensive side, and no grad flows through it.
+    tlogits, _ = jax.jit(target_model.forward)(tparams, seqs)
+    pt = jax.nn.softmax(tlogits.astype(jnp.float32), axis=-1)
+    lp = jax.nn.log_softmax(tlogits.astype(jnp.float32), axis=-1)
+
+    @jax.jit
+    def step(dparams, ost):
+        def loss_fn(dp):
+            dlogits, _ = draft_model.forward(dp, seqs)
+            lq = jax.nn.log_softmax(dlogits.astype(jnp.float32), axis=-1)
+            return jnp.mean(jnp.sum(pt * (lp - lq), axis=-1))
+
+        kl, grads = jax.value_and_grad(loss_fn)(dparams)
+        updates, ost2 = opt.update(grads, ost, dparams)
+        return optax.apply_updates(dparams, updates), ost2, kl
+
+    kl = jnp.inf
+    for _ in range(steps):
+        dparams, ost, kl = step(dparams, ost)
+    return draft_model, dparams, float(kl)

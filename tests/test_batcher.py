@@ -352,6 +352,25 @@ def test_logprobs_parallel_and_correct(setup):
         b.stop()
 
 
+def test_nucleus_mask_identity_when_off():
+    """Rows with top_p off pass through nucleus_mask BIT-identical —
+    float cumsum can hit 1.0 before the tail, so `before < 1.0` alone
+    would clip it for a top_p-off row sharing a round with a top-p
+    request (co-tenant-dependent streams)."""
+    from k8s_gpu_tpu.serve.engine import nucleus_mask
+
+    # One dominant logit: softmax ≈ [1, 0, 0, ...] and the cumsum
+    # reaches 1.0 at position 1 in float32.
+    scaled = jnp.asarray([[40.0, 0.0, -1.0, -2.0],
+                          [40.0, 0.0, -1.0, -2.0]], jnp.float32)
+    out = nucleus_mask(scaled, jnp.asarray([0.0, 0.0]))
+    assert np.array_equal(np.asarray(out), np.asarray(scaled))
+    # Mixed rows: row 0 masks to its nucleus, row 1 stays identical.
+    out = nucleus_mask(scaled, jnp.asarray([0.5, 0.0]))
+    assert np.isneginf(np.asarray(out)[0, 1:]).all()
+    assert np.array_equal(np.asarray(out)[1], np.asarray(scaled)[1])
+
+
 def test_top_p_requests_sample_from_nucleus(setup):
     """Per-request nucleus: a top_p row's emissions come only from the
     top of its per-step distribution, while a greedy row in the same

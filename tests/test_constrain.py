@@ -146,6 +146,27 @@ def test_regex_errors():
         compile_constraint("*a", TOKENS)
 
 
+def test_control_escapes_resolve_to_control_chars():
+    """\\n / \\t match the control characters (standard semantics), not
+    the literal letters — and unknown alphabetic escapes are an error
+    rather than silently matching the letter."""
+    toks = ["\n", "\t", "n", "t", "a"]
+    c = compile_constraint(r"\n", toks)
+    import numpy as np
+    allowed = np.asarray(c.allowed[c.start])
+    assert allowed[toks.index("\n")] and not allowed[toks.index("n")]
+    c = compile_constraint(r"[\t]", toks)
+    allowed = np.asarray(c.allowed[c.start])
+    assert allowed[toks.index("\t")] and not allowed[toks.index("t")]
+    with pytest.raises(RegexError, match="escape"):
+        compile_constraint(r"\q", toks)
+    with pytest.raises(RegexError, match="escape"):
+        compile_constraint(r"[\q]", toks)
+    # punctuation escapes still mean the literal character
+    c = compile_constraint(r"\.", [".", "a"])
+    assert bool(np.asarray(c.allowed[c.start])[0])
+
+
 # -- banked constraints in the continuous batcher ---------------------------
 
 def _bank(patterns):
@@ -276,6 +297,43 @@ def test_bank_vocab_mismatch_rejected_at_construction(setup):
     bank = ConstraintBank({"d": "[0-9]+"}, TOKENS + ["zz", "qq"])
     with pytest.raises(ValueError, match="vocab"):
         ContinuousBatcher(model, params, slots=2, constraints=bank)
+
+
+def test_bank_without_eos_rejected(setup):
+    """A ConstraintBank with eos_id unset is a construction error (a
+    dead-ended row would pad token 0 as generated content until budget
+    — previously only the CLI guarded this)."""
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+    from k8s_gpu_tpu.serve.constrain import ConstraintBank
+
+    model, params, _ = setup
+    bank = ConstraintBank({"d": "[0-9]+"}, TOKENS)
+    with pytest.raises(ValueError, match="eos_id"):
+        ContinuousBatcher(model, params, slots=2, constraints=bank)
+
+
+def test_one_shot_constrained_stops_on_eos(setup):
+    """generate_constrained honors sampling.eos_id exactly like the
+    batcher's constrained path: a row that samples EOS freezes, the EOS
+    token is not emitted, and `accepted` reflects the pre-EOS state."""
+    from k8s_gpu_tpu.serve.engine import SamplingConfig
+
+    model, params, eng = setup
+    # Pattern that allows every token (including whatever greedy picks):
+    # then force EOS as token 0 by making it in-language too.
+    c = compile_constraint(".*", TOKENS)
+    prompt = jnp.ones((2, 3), jnp.int32)
+    out_free = eng.generate_constrained(
+        params, prompt, c, max_new_tokens=8,
+        sampling=SamplingConfig(eos_id=-1))
+    first = int(out_free["tokens"][0, 0])
+    out_eos = eng.generate_constrained(
+        params, prompt, c, max_new_tokens=8,
+        sampling=SamplingConfig(eos_id=first))
+    # Greedy deterministic: first sampled token is `first` → row 0 stops
+    # immediately with zero emissions.
+    assert int(out_eos["lengths"][0]) == 0
+    assert int(out_eos["tokens"][0, 0]) == 0  # pad, not the EOS id
 
 
 def test_admit_crash_aborts_popped_request(setup):

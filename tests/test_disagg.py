@@ -165,7 +165,7 @@ def test_submit_precomputed_validates_shapes(setup):
     try:
         bad_cache = {"k": jnp.zeros((2, 1, 4, 32, 12)),  # wrong max_seq
                      "v": jnp.zeros((2, 1, 4, 32, 12))}
-        with pytest.raises(ValueError, match="row_cache leaf shape"):
+        with pytest.raises(ValueError, match="row_cache\\['k'\\] shape"):
             b.submit_precomputed(bad_cache, jnp.zeros((1, 128)), 8, 0)
         good_cache = {"k": jnp.zeros((2, 1, 4, 64, 12)),
                       "v": jnp.zeros((2, 1, 4, 64, 12))}

@@ -1,0 +1,165 @@
+"""Int8 KV cache (VERDICT r3 ask #3): per-(head, position) absmax
+quantization of the pool cache — ~1.9× slot capacity at fixed HBM —
+with decode-quality parity against the bf16/f32 cache."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.serve import ContinuousBatcher, InferenceEngine
+from k8s_gpu_tpu.serve.engine import _empty_cache, _quantize_kv
+
+TINY = TransformerConfig(
+    vocab_size=128, d_model=48, n_layers=2, n_heads=4, d_head=12,
+    d_ff=96, max_seq=64, use_flash=False, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = TransformerLM(TINY)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def test_quantize_roundtrip_error_bounded():
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 4, 16, 32))
+    q, s = _quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (3, 4, 16)
+    back = q.astype(jnp.float32) * s[..., None]
+    # absmax int8: error per element <= scale/2 = amax/254
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    assert float(jnp.max(jnp.abs(back - x) / amax)) <= (1 / 254) + 1e-6
+
+
+def test_cache_bytes_roughly_halved():
+    import dataclasses
+
+    bf = dataclasses.replace(TINY, dtype=jnp.bfloat16)
+    dense = _empty_cache(bf, 8, 64)
+    quant = _empty_cache(bf, 8, 64, kv_quant=True)
+    dense_b = sum(x.nbytes for x in jax.tree.leaves(dense))
+    quant_b = sum(x.nbytes for x in jax.tree.leaves(quant))
+    # int8 + f32/d_head scales vs bf16: 0.5 + 2/d_head of the bytes —
+    # exactly 1.5× capacity at this toy d_head=12; ~1.9× at d_head 128.
+    assert quant_b < 0.7 * dense_b
+    assert dense_b / quant_b >= 1.5
+
+
+def _agreement(a, b):
+    n = min(len(a), len(b))
+    return sum(x == y for x, y in zip(a[:n], b[:n])) / max(n, 1)
+
+
+def test_engine_generate_parity(setup):
+    """Greedy decode with the int8 cache must track the f32-cache stream
+    closely — quantization noise may flip a near-tie argmax, but the
+    streams cannot diverge wholesale."""
+    model, params = setup
+    prompt = jnp.asarray([[5, 9, 17, 3]], jnp.int32)
+    base = InferenceEngine(model).generate(
+        params, prompt, max_new_tokens=16
+    )
+    quant = InferenceEngine(model, kv_quant=True).generate(
+        params, prompt, max_new_tokens=16
+    )
+    a = [int(t) for t in base.tokens[0][: int(base.lengths[0])]]
+    b = [int(t) for t in quant.tokens[0][: int(quant.lengths[0])]]
+    assert _agreement(a, b) >= 0.8, (a, b)
+    # prompt logits carry most of the signal un-quantized (only the
+    # prefix K/V round-trips): they must be close
+    np.testing.assert_allclose(
+        np.asarray(base.prompt_logits), np.asarray(quant.prompt_logits),
+        atol=0.15, rtol=0.1,
+    )
+
+
+def test_batcher_kv_quant_matches_engine_kv_quant(setup):
+    """The int8-cache BATCHER stream equals the int8-cache one-shot
+    engine's (same quantized numerics through a different write path:
+    bucketed prefill + per-row scatter vs scalar geometry).  Exactness
+    here mirrors the bf16 batcher-vs-engine parity contract."""
+    model, params = setup
+    ids = [5, 9, 17]
+    eng = InferenceEngine(model, kv_quant=True)
+    # left-pad to the batcher's bucket of 8 so prefill geometry matches
+    pad = 8 - len(ids)
+    padded = jnp.zeros((1, 8), jnp.int32).at[0, pad:].set(
+        jnp.asarray(ids)
+    )
+    ref = eng.generate(params, padded, max_new_tokens=8, pad_left=pad)
+    want = [int(t) for t in ref.tokens[0][: int(ref.lengths[0])]]
+    b = ContinuousBatcher(model, params, slots=2, kv_quant=True).start()
+    try:
+        got = b.submit(ids, max_new_tokens=8).result()
+        assert got == want, (got, want)
+    finally:
+        b.stop()
+
+
+def test_batcher_kv_quant_interleaved_consistency(setup):
+    """Two co-tenant int8-cache requests must not contaminate each
+    other: each matches its own solo-run stream."""
+    model, params = setup
+
+    def solo(ids):
+        b = ContinuousBatcher(model, params, slots=2, kv_quant=True).start()
+        try:
+            return b.submit(ids, max_new_tokens=8).result()
+        finally:
+            b.stop()
+
+    ids_a, ids_b = [5, 9, 17], [2, 4, 8, 16]
+    ref_a, ref_b = solo(ids_a), solo(ids_b)
+    b = ContinuousBatcher(model, params, slots=2, kv_quant=True).start()
+    try:
+        ha = b.submit(ids_a, max_new_tokens=8)
+        hb = b.submit(ids_b, max_new_tokens=8)
+        assert ha.result() == ref_a
+        assert hb.result() == ref_b
+    finally:
+        b.stop()
+
+
+def test_kv_quant_composes_with_spec_and_gqa(setup):
+    """int8 KV + speculative rounds + GQA in one batcher: the verify
+    path's window writes quantize too, and greedy stays agreement-close
+    to the quantized plain batcher (bit-exact: both run the SAME int8
+    numerics)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, n_kv_heads=2)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(3))
+    ids = [5, 9, 17]
+    plain = ContinuousBatcher(model, params, slots=2, kv_quant=True).start()
+    try:
+        want = plain.submit(ids, max_new_tokens=8).result()
+    finally:
+        plain.stop()
+    spec = ContinuousBatcher(
+        model, params, slots=2, kv_quant=True, draft=(model, params),
+        spec_k=2,
+    ).start()
+    try:
+        got = spec.submit(ids, max_new_tokens=8).result()
+        assert got == want, (got, want)
+    finally:
+        spec.stop()
+
+
+def test_precomputed_row_quant_mismatch_rejected(setup):
+    """A disagg row prefilled without kv_quant must be rejected at
+    submit (leaf mismatch), not crash the scheduler."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2, kv_quant=True).start()
+    try:
+        eng = InferenceEngine(model)  # dense rows
+        cache, logits = eng.prefill(
+            params, jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        )
+        with pytest.raises(ValueError, match="kv_quant"):
+            b.submit_precomputed(cache, logits, 4, 0)
+    finally:
+        b.stop()
