@@ -90,6 +90,13 @@ class TransformerConfig:
     # always uses the gpipe forward schedule — without a backward there is
     # nothing for 1F1B to interleave.
     pp_schedule: str = "1f1b"
+    # Virtual pipeline stages per device (interleaved 1F1B,
+    # parallel/pipeline.py:interleaved_1f1b): 1 = classic contiguous
+    # stages; v > 1 splits each device's layers into v non-contiguous
+    # chunks, cutting the fill/drain bubble toward half of classic under
+    # lockstep SPMD (win needs pp >= 4).  Only meaningful with
+    # pp_schedule="1f1b".
+    pp_virtual_stages: int = 1
 
     @property
     def moe(self) -> bool:
@@ -433,7 +440,7 @@ class TransformerLM:
         """
         from jax.sharding import PartitionSpec as PSpec
 
-        from ..parallel.pipeline import one_f_one_b
+        from ..parallel.pipeline import interleaved_1f1b, one_f_one_b
 
         cfg = self.cfg
         self._check_pp_composition(mesh)
@@ -448,17 +455,33 @@ class TransformerLM:
             nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
             return nll.mean()
 
-        loss, dblocks, (dnorm, dhead), dx = one_f_one_b(
-            self._pp_stage_fn(mesh),
-            params["blocks"],
-            (params["final_norm"], params["head"]),
-            tail_loss_fn,
-            x,
-            targets,
-            mesh,
-            num_microbatches=cfg.pp_microbatches or None,
-            x_spec=PSpec("dp"),
-        )
+        if cfg.pp_virtual_stages > 1:
+            # Interleaved (virtual-stage) schedule: same stage/tail
+            # contracts, v non-contiguous chunks per device.
+            loss, dblocks, (dnorm, dhead), dx = interleaved_1f1b(
+                self._pp_stage_fn(mesh),
+                params["blocks"],
+                (params["final_norm"], params["head"]),
+                tail_loss_fn,
+                x,
+                targets,
+                mesh,
+                v=cfg.pp_virtual_stages,
+                num_microbatches=cfg.pp_microbatches or None,
+                x_spec=PSpec("dp"),
+            )
+        else:
+            loss, dblocks, (dnorm, dhead), dx = one_f_one_b(
+                self._pp_stage_fn(mesh),
+                params["blocks"],
+                (params["final_norm"], params["head"]),
+                tail_loss_fn,
+                x,
+                targets,
+                mesh,
+                num_microbatches=cfg.pp_microbatches or None,
+                x_spec=PSpec("dp"),
+            )
         # Embedding grad: scatter-add the input cotangent over token ids
         # (the transpose of the gather the pipeline never saw).
         dembed = (

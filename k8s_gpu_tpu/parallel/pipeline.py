@@ -326,3 +326,278 @@ def one_f_one_b(
         axis_names=manual,
         check_vma=False,
     )(stage_params, tail_params, x, targets)
+
+
+# -- interleaved (virtual-stage) 1F1B ---------------------------------------
+
+def interleaved_ticks(M: int, pp: int, v: int) -> int:
+    """Fine-tick count of the interleaved schedule: M·v busy fine ticks
+    per device + the fill/drain bubble Pv + P - 2.  A fine tick is 1/v
+    of a classic tick (one chunk of L/(P·v) layers, fwd+bwd)."""
+    return M * v + pp * v + pp - 2
+
+
+def classic_ticks_fine(M: int, pp: int) -> int:
+    """Classic 1F1B's M + 2P - 2 coarse ticks expressed in the same
+    fine-tick unit (×v = ×1 here since a classic tick IS v fine ticks
+    at v=1): multiply by v when comparing against interleaved_ticks."""
+    return M + 2 * pp - 2
+
+
+def interleaved_1f1b(
+    stage_fn,
+    stage_params,
+    tail_params,
+    tail_loss_fn,
+    x,
+    targets,
+    mesh: Mesh,
+    v: int,
+    num_microbatches: int | None = None,
+    axis_name: str = "pp",
+    x_spec: P | None = None,
+):
+    """Interleaved 1F1B: each device holds ``v`` NON-contiguous layer
+    chunks (virtual stages), Megatron's interleaved schedule re-derived
+    for lockstep SPMD.
+
+    Layers [L] split into S = P·v virtual stages; virtual stage
+    s = c·P + d is chunk c on device d, so a microbatch visits every
+    device v times, wrapping P-1 → 0 between chunks (the ppermute ring
+    gains its wraparound edge).  Forward of (chunk c, microbatch j) runs
+    on device d at fine tick
+
+        t_f = d + (j mod P) + P·c + P·v·(j div P)
+
+    — a mixed-radix bijection per device, so each device's forward work
+    occupies Mv CONSECUTIVE fine ticks (no intra-schedule stalls), and
+    consecutive virtual stages differ by one tick (the activation hop).
+    Backward mirrors it at t_b = t_f(0, j) + 2S - 2 - s, the last virtual
+    stage fusing F and B of a microbatch in one tick exactly like
+    one_f_one_b.  A fine tick costs 1/v of a classic tick (one chunk of
+    L/(P·v) layers), so the bubble drops from classic 1F1B's 2(P-1)
+    coarse ticks to (Pv + P - 2)/v = (P-1)(1 + 1/v)/1·… coarse —
+    approaching HALF of classic as v grows (interleaved_ticks /
+    classic_ticks_fine·v).  Megatron's (P-1)/v bubble needs per-device
+    asynchrony (a device drains only its own chunk queue); under
+    lockstep SPMD every device ticks together, and at P = 2 the win
+    vanishes entirely — use pp >= 4 with v >= 2.  Trade: the live
+    stage-input ring holds 2S - 1 = 2Pv - 1 chunk inputs (vs 2P - 1
+    classic) — chunk inputs are full-width activations, so activation
+    memory grows with v; the schedule buys bubble with memory, the
+    inverse of one_f_one_b's trade vs GPipe.
+
+    ``stage_params`` leaves are [L, ...]; L must divide by P·v.  The
+    leading axis is reshaped to [v, P, L/(P·v)] and the P axis sharded
+    over 'pp' — note this is a DIFFERENT layout than one_f_one_b's
+    contiguous split, so switching schedules re-shards the blocks once
+    at entry.  stage_fn/tail_loss_fn contracts match one_f_one_b.
+    Returns (loss, d_stage_params [L-leading, like stage_params],
+    d_tail_params, dx).
+    """
+    pp = mesh.shape[axis_name]
+    if pp == 1:
+        raise ValueError("interleaved_1f1b needs pp > 1")
+    if v < 2:
+        raise ValueError("v < 2 is classic 1F1B; call one_f_one_b")
+    S = pp * v
+    in_x_spec = x_spec or P()
+
+    L = jax.tree.leaves(stage_params)[0].shape[0]
+    if L % S != 0:
+        raise ValueError(f"{L} layers not divisible by {pp}·{v} chunks")
+    Lc = L // S
+    # [L, ...] → [v, P, Lc, ...]: virtual stage c·P + d = chunk c, device d.
+    chunked = jax.tree.map(
+        lambda p: p.reshape((v, pp, Lc) + p.shape[1:]), stage_params
+    )
+    p_spec = P(None, axis_name)
+
+    batch_axes = []
+    for ax in in_x_spec:
+        if ax is not None:
+            batch_axes.extend(ax if isinstance(ax, tuple) else (ax,))
+
+    def body(params, tail, xfull, tgt):
+        idx = jax.lax.axis_index(axis_name)
+        is_first_dev = idx == 0
+        is_last_dev = idx == pp - 1
+        local_b = xfull.shape[0]
+        M = num_microbatches or (2 * pp if local_b % (2 * pp) == 0 else pp)
+        if local_b % M != 0:
+            raise ValueError(
+                f"local batch {local_b} not divisible by {M} microbatches"
+            )
+        mb = local_b // M
+        xm = xfull.reshape((M, mb) + xfull.shape[1:])
+        tm = tgt.reshape((M, mb) + tgt.shape[1:])
+        n_rep = 1
+        if batch_axes:
+            n_rep = jax.lax.psum(1, tuple(batch_axes))
+        seed = jnp.float32(1.0) / (M * n_rep)
+
+        K = 2 * S - 1  # live chunk-input bound (first chunk, device 0)
+        zeros_mb = jnp.zeros_like(xm[0])
+        store0 = jnp.zeros((v, K) + tuple(xm.shape[1:]), xfull.dtype)
+        dxm0 = jnp.zeros_like(xm)
+        # params_local: [v, 1, Lc, ...] → drop the sharded-P axis.
+        plocal = jax.tree.map(lambda p: p[:, 0], params)
+        zero_dp = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), plocal
+        )
+        zero_dt = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), tail
+        )
+        # Wraparound rings: chunk boundaries hop P-1 → 0 (forward) and
+        # 0 → P-1 (backward); the (c, j) decode below decides whether a
+        # received activation is a chunk handoff or pipe fill garbage.
+        fwd_perm = [(d, (d + 1) % pp) for d in range(pp)]
+        bwd_perm = [(d, (d - 1) % pp) for d in range(pp)]
+
+        def decode_fwd(i):
+            """tick, device → (chunk c, microbatch j, valid)."""
+            y = i - idx
+            jr = jnp.mod(y, pp)           # j mod P
+            z = (y - jr) // pp            # c + v·(j div P)
+            c = jnp.mod(z, v)
+            q = (z - c) // v
+            j = q * pp + jr
+            valid = (y >= 0) & (q >= 0) & (j < M) & (c >= 0)
+            return c, jnp.clip(j, 0, M - 1), valid
+
+        def decode_bwd(i):
+            y = i - (2 * S - 2 - idx)     # = (j%P) + Pv(j//P) - P·c
+            jr = jnp.mod(y, pp)
+            z = (y - jr) // pp            # v·(j div P) - c
+            c = jnp.mod(-z, v)
+            q = (z + c) // v
+            j = q * pp + jr
+            valid = (q >= 0) & (j < M) & (j >= 0)
+            return c, jnp.clip(j, 0, M - 1), valid
+
+        def chunk_params(c):
+            return jax.tree.map(
+                lambda p: jax.lax.dynamic_index_in_dim(
+                    p, c, 0, keepdims=False
+                ),
+                plocal,
+            )
+
+        def tick(carry, i):
+            (fwd_recv, bwd_recv, store, dxm, dparams, dtail,
+             loss_acc) = carry
+
+            # ---- forward: one chunk ---------------------------------------
+            cf, jf, f_valid = decode_fwd(i)
+            s_f = cf * pp + idx
+            inp = jnp.where(
+                is_first_dev & (cf == 0),
+                jax.lax.dynamic_index_in_dim(xm, jf, 0, keepdims=False),
+                fwd_recv,
+            )
+            store = jax.lax.cond(
+                f_valid,
+                lambda s: s.at[cf, jnp.mod(jf, K)].set(inp.astype(s.dtype)),
+                lambda s: s,
+                store,
+            )
+            is_last_virtual = s_f == S - 1
+            out = jax.lax.cond(
+                f_valid & jnp.logical_not(is_last_virtual),
+                lambda: stage_fn(chunk_params(cf), inp),
+                lambda: zeros_mb,
+            )
+            fwd_recv = jax.lax.ppermute(out, axis_name, fwd_perm)
+
+            # ---- backward: one chunk --------------------------------------
+            cb, jb, b_valid = decode_bwd(i)
+            s_b = cb * pp + idx
+            saved = store[cb, jnp.mod(jb, K)]
+            tgt_mb = jax.lax.dynamic_index_in_dim(tm, jb, 0, keepdims=False)
+
+            def last_bwd(operands):
+                saved, tgt_mb, _ = operands
+
+                def f(p, tl, a):
+                    return tail_loss_fn(tl, stage_fn(p, a), tgt_mb)
+
+                loss_j, vjp = jax.vjp(f, chunk_params(cb), tail, saved)
+                dp_, dt_, dinp = vjp(seed)
+                return dp_, dt_, dinp, loss_j / M
+
+            def mid_bwd(operands):
+                saved, _, cot = operands
+                _, vjp = jax.vjp(
+                    lambda p, a: stage_fn(p, a), chunk_params(cb), saved
+                )
+                dp_, dinp = vjp(cot)
+                return dp_, zero_dt, dinp, jnp.float32(0)
+
+            def no_bwd(operands):
+                return (
+                    jax.tree.map(lambda p: jnp.zeros(
+                        p.shape[1:], jnp.float32), plocal),
+                    zero_dt, zeros_mb, jnp.float32(0),
+                )
+
+            dp_, dt_, dinp, loss_j = jax.lax.cond(
+                b_valid,
+                lambda ops: jax.lax.cond(
+                    s_b == S - 1, last_bwd, mid_bwd, ops
+                ),
+                no_bwd,
+                (saved, tgt_mb, bwd_recv),
+            )
+            dparams = jax.tree.map(
+                lambda acc, g: acc.at[cb].add(
+                    jnp.where(b_valid, g, jnp.zeros_like(g))
+                ),
+                dparams, dp_,
+            )
+            dtail = jax.tree.map(jnp.add, dtail, dt_)
+            loss_acc = loss_acc + loss_j
+            dxm = jax.lax.cond(
+                b_valid & is_first_dev & (cb == 0),
+                lambda d: jax.lax.dynamic_update_index_in_dim(
+                    d, dinp.astype(d.dtype), jb, 0
+                ),
+                lambda d: d,
+                dxm,
+            )
+            bwd_recv = jax.lax.ppermute(dinp, axis_name, bwd_perm)
+            return (fwd_recv, bwd_recv, store, dxm, dparams, dtail,
+                    loss_acc), None
+
+        T = interleaved_ticks(M, pp, v)
+        carry0 = (zeros_mb, zeros_mb, store0, dxm0, zero_dp, zero_dt,
+                  jnp.float32(0))
+        (_, _, _, dxm, dparams, dtail, loss_acc), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
+
+        all_axes = tuple([axis_name] + batch_axes)
+        loss = jax.lax.psum(loss_acc, all_axes) / n_rep
+        dtail = jax.lax.psum(dtail, all_axes)
+        if batch_axes:
+            dparams = jax.lax.psum(dparams, tuple(batch_axes))
+        dx = jax.lax.psum(
+            dxm.reshape(xfull.shape).astype(jnp.float32), axis_name
+        ).astype(xfull.dtype)
+        # Re-insert the sharded-P axis so out_specs can shard it.
+        dparams = jax.tree.map(lambda p: p[:, None], dparams)
+        return loss, dparams, dtail, dx
+
+    manual = {axis_name, *batch_axes}
+    loss, dchunked, dtail, dx = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(p_spec, P(), in_x_spec, in_x_spec),
+        out_specs=(P(), p_spec, P(), in_x_spec),
+        axis_names=manual,
+        check_vma=False,
+    )(chunked, tail_params, x, targets)
+    # [v, P, Lc, ...] → [L, ...] to mirror stage_params' layout.
+    dparams = jax.tree.map(
+        lambda g, p: g.reshape((L,) + p.shape[1:]), dchunked, stage_params
+    )
+    return loss, dparams, dtail, dx
