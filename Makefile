@@ -1,0 +1,41 @@
+# Build/push/deploy targets — the reference operator's `make docker-build
+# docker-push deploy` flow (README.md:298-302), retargeted at this
+# platform's three images and Helm-role release.
+#
+#   make docker-build               # build all images
+#   make docker-push                # push to $(REGISTRY)
+#   make deploy                     # install/upgrade the platform chart
+#   make undeploy
+#
+# Overridables: REGISTRY, TAG, NAMESPACE.
+
+REGISTRY ?= registry.example.com/k8sgpu
+TAG      ?= 0.1.0
+NAMESPACE ?= gohai-system
+
+IMAGES = operator trainer devenv
+
+.PHONY: docker-build docker-push deploy undeploy test
+
+docker-build:
+	@for img in $(IMAGES); do \
+	  docker build -t $(REGISTRY)/$$img:$(TAG) -f images/$$img/Dockerfile .; \
+	done
+
+docker-push:
+	@for img in $(IMAGES); do \
+	  docker push $(REGISTRY)/$$img:$(TAG); \
+	done
+
+# The in-repo release path: the CLI's helm-role verbs render
+# platform/release.py:gohai_platform_chart onto the cluster state the
+# controllers reconcile (docs/platform/deploy.md for the full flow).
+deploy:
+	python -m k8s_gpu_tpu.cli ci install gohai \
+	  --image $(REGISTRY)/operator:$(TAG) --namespace $(NAMESPACE)
+
+undeploy:
+	python -m k8s_gpu_tpu.cli ci uninstall gohai --namespace $(NAMESPACE)
+
+test:
+	python -m pytest tests/ -x -q

@@ -346,9 +346,84 @@ def cmd_devenv(args) -> int:
             p.settle()
             print(f"{args.name} deleted (workspace PVC retained)")
             return 0
+        if args.devenv_cmd == "gateway":
+            # Serve the SSH gateway off the live platform state — the
+            # ingress the reference exposes on :2022 (GPU调度平台搭建.md:418).
+            from ..platform.sshgate import SshGateway
+
+            gw = SshGateway(
+                p.kube, port=args.port, namespace=ctx.space or "default",
+                assets=p.assets,
+            ).start()
+            print(f"gateway listening on 127.0.0.1:{gw.port} "
+                  f"(namespace {ctx.space or 'default'})", flush=True)
+            try:
+                import time as _time
+
+                if args.for_seconds > 0:
+                    _time.sleep(args.for_seconds)
+                else:
+                    while True:
+                        _time.sleep(3600)
+            except KeyboardInterrupt:
+                pass
+            finally:
+                gw.stop()
+            return 0
         return 1
     finally:
         p.close()
+
+
+def cmd_devenv_client(args) -> int:
+    """`devenv ssh` / `devenv put`: the gateway CLIENT — pure socket,
+    no platform lock, so it runs against a live `devenv gateway` (same
+    or another process) exactly like ssh/sftp against the reference's
+    ingress (GPU调度平台搭建.md:408-419, :707-734)."""
+    from ..platform.sshgate import GatewayClient, GatewayError
+
+    ctx = _require_login(CliConfig.load())
+    try:
+        host, port_s = args.gateway.rsplit(":", 1)
+        port = int(port_s)
+    except ValueError:
+        print(f"bad --gateway {args.gateway!r}: expected host:port",
+              file=sys.stderr)
+        return 2
+    try:
+        pubkey = Path(args.pubkey).read_text().strip()
+    except OSError as e:
+        print(f"error: cannot read pubkey: {e}", file=sys.stderr)
+        return 1
+    user = args.user or ctx.user
+    try:
+        with GatewayClient(host, port, user, pubkey) as c:
+            if args.devenv_cmd == "put":
+                print(c.put(args.space or ctx.space or "default",
+                            args.kind, args.id, args.file))
+                return 0
+            if args.command:
+                print(c.banner)
+                for cmd in args.command:
+                    print(c.exec(cmd))
+                return 0
+            # Interactive: one command per stdin line (scripted ssh).
+            print(c.banner)
+            for line in sys.stdin:
+                line = line.strip()
+                if not line or line == "exit":
+                    break
+                try:
+                    print(c.exec(line), flush=True)
+                except GatewayError as e:
+                    print(f"error: {e}", file=sys.stderr)
+            return 0
+    except GatewayError as e:
+        print(f"denied: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"error: cannot reach gateway: {e}", file=sys.stderr)
+        return 1
 
 
 def cmd_apply(args) -> int:
@@ -525,6 +600,46 @@ def cmd_ci(args) -> int:
             if run.status == "success":
                 p.settle()
             return 0 if run.status == "success" else 1
+        if args.ci_cmd == "install":
+            # helm upgrade --install semantics (the Makefile's `make
+            # deploy` analogue of reference README.md:298-302): render
+            # the platform chart onto the cluster and let the
+            # Deployment controller materialize pods.
+            from ..platform.release import gohai_platform_chart
+
+            flat = _parse_kv(args.set or [], "--set")
+            if flat is None:
+                return 2
+            # helm --set semantics: dotted keys nest (api.replicas=3),
+            # digit values coerce to int (replicas is an int field).
+            values: dict = {}
+            for k, v in flat.items():
+                cur = values
+                *path, leaf = k.split(".")
+                for part in path:
+                    cur = cur.setdefault(part, {})
+                cur[leaf] = int(v) if v.isdigit() else v
+            if args.image:
+                values["image"] = args.image
+            rel = p.releases.upgrade(
+                gohai_platform_chart(), args.name,
+                args.namespace or ctx.space or "default", values,
+            )
+            p.settle()
+            print(f"release {args.name} revision {rel.revision} deployed")
+            return 0
+        if args.ci_cmd == "uninstall":
+            from ..platform.release import ReleaseError
+
+            try:
+                p.releases.uninstall(
+                    args.name, args.namespace or ctx.space or "default"
+                )
+            except ReleaseError as e:
+                print(str(e), file=sys.stderr)
+                return 1
+            print(f"release {args.name} uninstalled")
+            return 0
         if args.ci_cmd == "releases":
             hist = p.releases.history(args.name, ctx.space or "default")
             if not hist:
@@ -699,6 +814,31 @@ def build_parser() -> argparse.ArgumentParser:
                            "(0 releases an existing grant)")
     env_sub.add_parser("list")
     env_sub.add_parser("delete").add_argument("name")
+    p_gw = env_sub.add_parser(
+        "gateway", help="serve the devenv SSH gateway (port 2022 role)"
+    )
+    p_gw.add_argument("--port", type=int, default=0)
+    p_gw.add_argument("--for-seconds", type=float, default=0.0,
+                      help="exit after N seconds (0 = until interrupted)")
+    p_ssh = env_sub.add_parser(
+        "ssh", help="open a session through the gateway (EXEC channel)"
+    )
+    p_put = env_sub.add_parser(
+        "put", help="bulk-upload a file through the gateway (SFTP role)"
+    )
+    for sp in (p_ssh, p_put):
+        sp.add_argument("--gateway", required=True, help="host:port")
+        sp.add_argument("--pubkey", required=True,
+                        help="path to the SSH public key the devenv holds")
+        sp.add_argument("--user", default="")
+    p_ssh.add_argument("-c", "--command", action="append",
+                       help="run command(s) and exit (else read stdin)")
+    p_ssh.set_defaults(fn=cmd_devenv_client)
+    p_put.add_argument("--space", default="")
+    p_put.add_argument("kind")
+    p_put.add_argument("id")
+    p_put.add_argument("file")
+    p_put.set_defaults(fn=cmd_devenv_client)
     p_env.set_defaults(fn=cmd_devenv)
 
     p_repo = sub.add_parser("repo", help="code repositories")
@@ -781,6 +921,18 @@ def build_parser() -> argparse.ArgumentParser:
     ref_group.add_argument("--tag", default="")
     p_rel = ci_sub.add_parser("releases")
     p_rel.add_argument("name")
+    p_inst = ci_sub.add_parser(
+        "install", help="install/upgrade the platform chart (make deploy)"
+    )
+    p_inst.add_argument("name")
+    p_inst.add_argument("--set", action="append",
+                        help="chart value key=value (repeatable)")
+    p_inst.add_argument("--image", default="",
+                        help="operator image ref override")
+    p_inst.add_argument("--namespace", default="")
+    p_uninst = ci_sub.add_parser("uninstall")
+    p_uninst.add_argument("name")
+    p_uninst.add_argument("--namespace", default="")
     p_ci.set_defaults(fn=cmd_ci)
 
     p_obs = sub.add_parser("obs", help="platform logs and metrics")

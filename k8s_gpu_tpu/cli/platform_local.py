@@ -17,15 +17,8 @@ import time
 from pathlib import Path
 
 from ..api.trainjob import TrainJob
-from ..cloud.fake_cloudtpu import FakeCloudTpu, cloudtpu_client_factory
+from ..cloud.fake_cloudtpu import FakeCloudTpu
 from ..controller.kubefake import FakeKube
-from ..controller.manager import Manager
-from ..operators import (
-    DevEnvReconciler,
-    SliceAutoscaler,
-    TpuPodSliceReconciler,
-    TrainJobReconciler,
-)
 from ..platform.assets import AssetStore
 
 
@@ -107,42 +100,26 @@ class LocalPlatform:
         self.cloud = self._load_cloud()
         self.assets = AssetStore(self.root / "assets")
         self.registry = self._load_registry()
-        from ..platform.release import DeploymentReconciler, ReleaseManager
+        from ..platform.entrypoint import controller_manager
+        from ..platform.release import ReleaseManager
 
         self.releases = ReleaseManager(self.kube)
-        self.mgr = Manager(self.kube)
-        self.mgr.register("Deployment", DeploymentReconciler(self.kube))
-        self.mgr.register(
-            "TpuPodSlice",
-            TpuPodSliceReconciler(
-                self.kube, cloudtpu_client_factory(self.cloud), provision_poll=0.05
-            ),
+        # THE controller wiring, shared with the in-cluster operator
+        # image (platform/entrypoint.py) — one place, no drift.
+        self.mgr, storage = controller_manager(
+            self.kube, self.cloud, provision_poll=0.05, devenv=True
         )
-        self.mgr.register("TrainJob", TrainJobReconciler(self.kube), name="trainjob")
-        self.mgr.register("TrainJob", SliceAutoscaler(self.kube), name="autoscaler")
-        self.mgr.register("DevEnv", DevEnvReconciler(self.kube))
-        from ..scheduling.queueing import QueueReconciler
-
-        self.mgr.register("SchedulingQueue", QueueReconciler(self.kube))
         # Dynamic storage (C13): dev-box pools sized generously — capacity
         # enforcement matters, exact numbers don't.  Usage is re-derived
         # from live PVs (the pickled cluster state), not persisted.
-        from ..platform.bulkstore import StoragePool, StorageProvisioner
+        from ..platform.bulkstore import StoragePool
 
-        storage = StorageProvisioner(self.kube)
         ceph = storage.pools.setdefault("ceph", StoragePool("ceph"))
         nfs = storage.pools.setdefault("nfs", StoragePool("nfs"))
         for i in range(3):
             ceph.add_device(f"osd-{i}", "500Gi")
         nfs.add_device("nfs-server", "1Ti")
         storage.resync_pools()
-        self.mgr.register("PersistentVolumeClaim", storage)
-        from ..operators import ResourceGC
-
-        # GC watches '*': any kind's churn (slices and VM pools emit Events
-        # too) triggers a sweep, and the in-reconciler debounce collapses
-        # the startup replay storm to one sweep.
-        self.mgr.register("*", ResourceGC(self.kube, keep_finished=20), name="gc")
         self.mgr.start()
 
     # -- persistence -------------------------------------------------------

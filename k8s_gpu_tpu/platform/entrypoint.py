@@ -1,0 +1,197 @@
+"""In-cluster operator entrypoint — what the operator image runs.
+
+The reference deploys its operator with ``make docker-build docker-push
+deploy`` (README.md:298-302) and its platform as three Deployments —
+GoHai-api, GoHai-controller, devenv-controller (GPU调度平台搭建.md:853-865).
+One image serves all three roles (the controller-runtime idiom): the
+Helm chart sets ``GOHAI_ROLE`` per Deployment and this module assembles
+the matching process:
+
+  api               → PlatformApiServer (assets/schemas/console REST,
+                      ``GOHAI_PORT``)
+  controller        → Manager{TpuPodSlice, TrainJob, autoscaler, queue,
+                      Deployment, PVC-provisioner, GC}
+  devenv-controller → Manager{DevEnv} + the devenv SSH gateway on
+                      ``GOHAI_GATEWAY_PORT`` (default 2022, the
+                      reference's ingress port)
+
+``build_operator(role)`` constructs and returns the components without
+blocking (the test surface); ``main()`` runs them until SIGTERM,
+binding ``GOHAI_HOST`` (default 0.0.0.0 — a pod must accept Service
+traffic; tests bind loopback explicitly).
+
+State: roles share cluster state through the ``kube`` seam.  When
+``GOHAI_STATE_DIR`` is set the FakeKube state is pickled there on stop
+and reloaded on start (the platform_local persistence shape), so a pod
+restart resumes instead of starting empty.  The three-Deployments
+layout assumes a SHARED state backend at that seam — the in-memory
+FakeKube is per-process, so a real multi-pod install plugs a real
+API-server-backed client in here; running all roles in one pod (or one
+pod per role with its own state dir for demo purposes) works as-is.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import signal
+import threading
+from pathlib import Path
+
+
+def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
+                       keep_finished: int = 20, devenv: bool = False):
+    """The platform's controller set on *kube* — THE single wiring,
+    shared by the in-cluster controller role and the CLI's local
+    platform (cli/platform_local.py) so the two cannot drift.
+
+    Returns (manager, storage_provisioner); the caller may add device
+    capacity to ``storage.pools`` before ``mgr.start()``."""
+    from ..cloud.fake_cloudtpu import FakeCloudTpu, cloudtpu_client_factory
+    from ..controller.manager import Manager
+    from ..operators import (
+        DevEnvReconciler,
+        ResourceGC,
+        SliceAutoscaler,
+        TpuPodSliceReconciler,
+        TrainJobReconciler,
+    )
+    from ..platform.bulkstore import StoragePool, StorageProvisioner
+    from ..platform.release import DeploymentReconciler
+    from ..scheduling.queueing import QueueReconciler
+
+    cloud = cloud if cloud is not None else FakeCloudTpu()
+    mgr = Manager(kube)
+    mgr.register("Deployment", DeploymentReconciler(kube))
+    mgr.register(
+        "TpuPodSlice",
+        TpuPodSliceReconciler(
+            kube, cloudtpu_client_factory(cloud),
+            provision_poll=provision_poll,
+        ),
+    )
+    mgr.register("TrainJob", TrainJobReconciler(kube), name="trainjob")
+    mgr.register("TrainJob", SliceAutoscaler(kube), name="autoscaler")
+    mgr.register("SchedulingQueue", QueueReconciler(kube))
+    storage = StorageProvisioner(kube)
+    storage.pools.setdefault("ceph", StoragePool("ceph"))
+    mgr.register("PersistentVolumeClaim", storage)
+    if devenv:
+        mgr.register("DevEnv", DevEnvReconciler(kube))
+    # GC watches '*': any kind's churn triggers a sweep; the in-reconciler
+    # debounce collapses the startup replay storm to one sweep.
+    mgr.register(
+        "*", ResourceGC(kube, keep_finished=keep_finished), name="gc"
+    )
+    return mgr, storage
+
+
+def _load_kube(state_dir: str | None):
+    """FakeKube, hydrated from ``<state_dir>/kube.pkl`` when present —
+    the platform_local persistence shape, so a pod restart resumes."""
+    from ..controller.kubefake import FakeKube
+
+    kube = FakeKube()
+    if state_dir:
+        f = Path(state_dir) / "kube.pkl"
+        if f.exists():
+            kube.load(pickle.loads(f.read_bytes()))
+    return kube
+
+
+def _save_kube(kube, state_dir: str | None) -> None:
+    if state_dir:
+        root = Path(state_dir)
+        root.mkdir(parents=True, exist_ok=True)
+        (root / "kube.pkl").write_bytes(pickle.dumps(kube.dump()))
+
+
+def _asset_store():
+    from ..platform.assets import AssetStore
+
+    return AssetStore(
+        os.environ.get("GOHAI_ASSET_DIR", "/var/lib/gohai/assets")
+    )
+
+
+def build_operator(role: str, kube=None, port: int = 0,
+                   host: str = "127.0.0.1", state_dir: str | None = None):
+    """Assemble the components for *role* without starting anything.
+
+    Returns a dict with ``start()``/``stop()`` callables plus the
+    constructed pieces (``mgr``/``server``/``gateway``) so tests can
+    drive them directly.  Unknown roles raise ValueError — a typo in the
+    Deployment env must fail the pod, not silently run nothing."""
+    kube = kube if kube is not None else _load_kube(state_dir)
+    parts: dict = {"role": role, "kube": kube}
+    if role == "api":
+        from ..platform.apiserver import PlatformApiServer
+
+        server = PlatformApiServer(
+            _asset_store(), host=host, port=port, kube=kube
+        )
+        parts.update(
+            server=server,
+            start=lambda: server.start(),
+            stop=lambda: (server.stop(), _save_kube(kube, state_dir)),
+        )
+    elif role == "controller":
+        mgr, _ = controller_manager(kube)
+        parts.update(
+            mgr=mgr,
+            start=lambda: mgr.start(),
+            stop=lambda: (mgr.stop(), _save_kube(kube, state_dir)),
+        )
+    elif role == "devenv-controller":
+        from ..controller.manager import Manager
+        from ..operators import DevEnvReconciler
+        from ..platform.sshgate import SshGateway
+
+        mgr = Manager(kube)
+        mgr.register("DevEnv", DevEnvReconciler(kube))
+        # assets on: the gateway PUT verb is the SFTP bulk-upload role.
+        gateway = SshGateway(kube, host=host, port=port,
+                             assets=_asset_store())
+
+        def start():
+            mgr.start()
+            gateway.start()
+
+        def stop():
+            gateway.stop()
+            mgr.stop()
+            _save_kube(kube, state_dir)
+
+        parts.update(mgr=mgr, gateway=gateway, start=start, stop=stop)
+    else:
+        raise ValueError(
+            f"unknown GOHAI_ROLE {role!r}: expected api | controller | "
+            "devenv-controller"
+        )
+    return parts
+
+
+def main() -> None:
+    from ..platform.sshgate import SSH_GATEWAY_PORT
+
+    role = os.environ.get("GOHAI_ROLE", "controller")
+    host = os.environ.get("GOHAI_HOST", "0.0.0.0")
+    port = (
+        int(os.environ.get("GOHAI_GATEWAY_PORT", str(SSH_GATEWAY_PORT)))
+        if role == "devenv-controller"
+        else int(os.environ.get("GOHAI_PORT", "8080"))
+    )
+    parts = build_operator(
+        role, port=port, host=host,
+        state_dir=os.environ.get("GOHAI_STATE_DIR"),
+    )
+    parts["start"]()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    parts["stop"]()
+
+
+if __name__ == "__main__":
+    main()

@@ -230,9 +230,14 @@ class ReleaseManager:
 
 # -- the platform's own chart (the charts/GoHai layout, :853-865) ----------
 
+# The single operator image all three Deployments run; role selection
+# rides GOHAI_ROLE (platform/entrypoint.py, images/operator/Dockerfile).
+OPERATOR_IMAGE = "registry.example.com/k8sgpu/operator:0.1.0"
+
+
 def gohai_platform_chart() -> Chart:
     defaults = {
-        "image": "platform/gohai:latest",
+        "image": OPERATOR_IMAGE,
         "api": {"replicas": 2},
         "controller": {"replicas": 1},
         "devenvController": {"replicas": 1},
@@ -250,6 +255,7 @@ def gohai_platform_chart() -> Chart:
             d.metadata.name = f"{name}-{comp}"
             d.spec.image = v["image"]
             d.spec.replicas = int(v[key]["replicas"])
+            d.spec.env = {"GOHAI_ROLE": comp}
             out.append(d)
         pvc = PersistentVolumeClaim()
         pvc.metadata.name = f"{name}-workspace"

@@ -69,6 +69,11 @@ def test_release_install_upgrade_prune_and_history(kube: FakeKube):
     }
     assert all(d.spec.image == "ml/train:v1" for d in deps)
     assert kube.get("Deployment", "gohai-api").spec.replicas == 2
+    # Role selection: one image, GOHAI_ROLE per Deployment (the operator
+    # image contract — images/operator/Dockerfile + platform/entrypoint).
+    assert {d.spec.env["GOHAI_ROLE"] for d in deps} == {
+        "api", "controller", "devenv-controller"
+    }
 
     rel2 = rm.upgrade(chart, "gohai", "default",
                       {"image": "ml/train:v2", "api": {"replicas": 3}})

@@ -1,0 +1,86 @@
+"""Operator image entrypoint (platform/entrypoint.py): the process the
+gohai-api / gohai-controller / devenv-controller Deployments run
+(reference README.md:298-302 deploy flow, GPU调度平台搭建.md:853-865)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s_gpu_tpu.controller.kubefake import FakeKube
+from k8s_gpu_tpu.platform.entrypoint import build_operator
+
+
+def test_api_role_serves_healthz(tmp_path, monkeypatch):
+    monkeypatch.setenv("GOHAI_ASSET_DIR", str(tmp_path / "assets"))
+    parts = build_operator("api", kube=FakeKube(), port=0)
+    parts["start"]()
+    try:
+        port = parts["server"].port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ) as r:
+            assert json.loads(r.read())["ok"] is True
+    finally:
+        parts["stop"]()
+
+
+def test_controller_role_reconciles(tmp_path):
+    """The controller role must run the same reconciler set the CLI's
+    local platform does — a TpuPodSlice applied to its kube goes Ready."""
+    from k8s_gpu_tpu.api import TpuPodSlice
+
+    kube = FakeKube()
+    parts = build_operator("controller", kube=kube)
+    assert parts["mgr"] is not None
+    parts["start"]()
+    try:
+        ps = TpuPodSlice()
+        ps.metadata.name = "demo"
+        ps.spec.accelerator_type = "v4-8"
+        kube.create(ps)
+        import time
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            cur = kube.get("TpuPodSlice", "demo")
+            if cur.status.phase == "Ready":
+                break
+            time.sleep(0.2)
+        assert kube.get("TpuPodSlice", "demo").status.phase == "Ready"
+    finally:
+        parts["stop"]()
+
+
+def test_devenv_role_has_gateway(tmp_path, monkeypatch):
+    monkeypatch.setenv("GOHAI_ASSET_DIR", str(tmp_path / "assets"))
+    parts = build_operator("devenv-controller", kube=FakeKube(), port=0)
+    parts["start"]()
+    try:
+        assert parts["gateway"].port > 0
+        # The gateway carries an asset store: `devenv put` works in-cluster.
+        assert parts["gateway"].assets is not None
+    finally:
+        parts["stop"]()
+
+
+def test_state_dir_persists_across_restart(tmp_path, monkeypatch):
+    """GOHAI_STATE_DIR: a controller pod restart resumes from pickled
+    state instead of starting empty."""
+    from k8s_gpu_tpu.api.core import Secret
+
+    sd = str(tmp_path / "state")
+    parts = build_operator("controller", state_dir=sd)
+    parts["start"]()
+    sec = Secret()
+    sec.metadata.name = "team-a-token"
+    sec.data["k"] = "v"
+    parts["kube"].create(sec)
+    parts["stop"]()
+    parts2 = build_operator("controller", state_dir=sd)
+    assert parts2["kube"].try_get("Secret", "team-a-token") is not None
+
+
+def test_unknown_role_rejected():
+    with pytest.raises(ValueError, match="GOHAI_ROLE"):
+        build_operator("apiserver")

@@ -140,6 +140,7 @@ def test_sftp_style_bulk_upload(tmp_path, cluster):
         c.line()  # welcome
         payload = b"model-bytes " * 500_000  # ~6 MB, one shot
         c.send(f"PUT ml model big-model {len(payload)}")
+        assert c.line() == "GO"  # header accepted before any body byte
         c.f.write(payload)
         c.f.flush()
         reply = c.line()
@@ -150,6 +151,7 @@ def test_sftp_style_bulk_upload(tmp_path, cluster):
             assert f.read() == payload
         # Second upload versions.
         c.send("PUT ml model big-model 3")
+        assert c.line() == "GO"
         c.f.write(b"xyz")
         c.f.flush()
         assert "v2" in c.line()
@@ -183,7 +185,8 @@ def test_put_traversal_rejected(tmp_path, cluster):
         assert c.line().startswith("OK")
         c.line()
         c.send("PUT ../../evil model x 4")
-        c.f.write(b"boom"); c.f.flush()
+        # Refused at the HEADER — no GO, so the body is never sent and
+        # a rejected transfer costs one round trip.
         assert c.line().startswith("ERR unsafe path component")
         assert not (tmp_path / "evil").exists()
     finally:
