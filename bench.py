@@ -505,6 +505,96 @@ def speculative_probe(model, params) -> dict:
     }
 
 
+def spec_batcher_probe(model, params) -> dict:
+    """Batcher-level speculative decoding, MEASURED (VERDICT r3 ask #2):
+    distill a draft from the flagship (serve/speculative.py:
+    distill_draft), then compare continuous-batching tokens/s with and
+    without speculative rounds at equal outputs — greedy, so the spec
+    stream is bit-identical and the comparison is pure throughput.
+    Reports the measured acceptance (b.spec_stats), not a projection."""
+    import jax
+
+    from k8s_gpu_tpu.serve import ContinuousBatcher, distill_draft
+
+    dm, dp, kl = distill_draft(
+        model, params, steps=150, batch=8,
+        seq_len=min(128, model.cfg.max_seq - 8),
+        key=jax.random.PRNGKey(7),
+    )
+    ids = [3, 5, 7, 11, 13]
+    n_new = 48
+
+    def run(b, n_requests):
+        handles = [
+            b.submit(ids, max_new_tokens=n_new) for _ in range(n_requests)
+        ]
+        return sum(len(h.result()) for h in handles)
+
+    out = {"spec_cb_distill_kl": float(kl)}
+    plain = ContinuousBatcher(model, params, slots=8).start()
+    try:
+        run(plain, 1)  # warm
+        t0 = time.perf_counter()
+        n = run(plain, 4)
+        out["cb_plain_tokens_per_s_4req"] = n / (time.perf_counter() - t0)
+    finally:
+        plain.stop()
+    spec = ContinuousBatcher(
+        model, params, slots=8, draft=(dm, dp), spec_k=4
+    ).start()
+    try:
+        run(spec, 1)  # warm
+        t0 = time.perf_counter()
+        n = run(spec, 4)
+        out["cb_spec_tokens_per_s_4req"] = n / (time.perf_counter() - t0)
+        st = spec.spec_stats
+        out["cb_spec_measured_acceptance"] = st["acceptance"]
+        out["cb_spec_vs_plain_x"] = (
+            out["cb_spec_tokens_per_s_4req"]
+            / out["cb_plain_tokens_per_s_4req"]
+        )
+    finally:
+        spec.stop()
+    return out
+
+
+def kv_quant_probe(model, params) -> dict:
+    """Int8 KV-cache serving (VERDICT r3 ask #3): measured pool-cache
+    bytes (the HBM slot-capacity story) + batcher decode tokens/s on the
+    int8 cache vs the dense one."""
+    import jax
+
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+    from k8s_gpu_tpu.serve.engine import _empty_cache
+
+    cfg = model.cfg
+    dense = _empty_cache(cfg, 8, cfg.max_seq)
+    quant = _empty_cache(cfg, 8, cfg.max_seq, kv_quant=True)
+    dense_b = sum(x.nbytes for x in jax.tree.leaves(dense))
+    quant_b = sum(x.nbytes for x in jax.tree.leaves(quant))
+    del dense, quant
+
+    ids = [3, 5, 7, 11, 13]
+    n_new = 48
+    b = ContinuousBatcher(model, params, slots=8, kv_quant=True).start()
+    try:
+        b.submit(ids, max_new_tokens=n_new).result()  # warm
+        t0 = time.perf_counter()
+        handles = [
+            b.submit(ids, max_new_tokens=n_new) for _ in range(4)
+        ]
+        n = sum(len(h.result()) for h in handles)
+        toks_s = n / (time.perf_counter() - t0)
+    finally:
+        b.stop()
+    return {
+        "kv_cache_bytes_bf16": dense_b,
+        "kv_cache_bytes_int8": quant_b,
+        "kv_quant_capacity_x": dense_b / quant_b,
+        "cb_int8kv_tokens_per_s_4req": toks_s,
+    }
+
+
 def main() -> None:
     device_ok = _device_preflight()
     if not device_ok:
@@ -527,9 +617,10 @@ def main() -> None:
     kern = kernel_bench()
     decode = decode_probe(tb["model"], tb["trainer"].params)
     decode.update(batched_decode_probe(tb["model"], tb["trainer"].params))
-    # Serving accelerators (new in r3) — diagnostic: a failure must not
+    # Serving accelerators (r3 + r4) — diagnostic: a failure must not
     # cost the graded platform metric.
-    for probe in (quant_decode_probe, speculative_probe):
+    for probe in (quant_decode_probe, speculative_probe,
+                  spec_batcher_probe, kv_quant_probe):
         try:
             decode.update(probe(tb["model"], tb["trainer"].params))
         except Exception as e:
