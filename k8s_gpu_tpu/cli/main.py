@@ -287,6 +287,37 @@ def cmd_devenv(args) -> int:
     from ..api.devenv import DevEnv
     from ..controller.kubefake import NotFound
 
+    if args.devenv_cmd == "keygen":
+        # Pure local key generation — no platform state, no lock (a
+        # keygen must work while a gateway holds the platform open),
+        # and no login either: a fresh machine keygens FIRST.
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            NoEncryption,
+            PrivateFormat,
+        )
+
+        from ..platform.sshwire import authorized_key_line
+
+        key = Ed25519PrivateKey.generate()
+        out = Path(args.out or ".")
+        out.mkdir(parents=True, exist_ok=True)
+        priv = out / "id_ed25519"
+        priv.write_bytes(key.private_bytes(
+            Encoding.PEM, PrivateFormat.OpenSSH, NoEncryption()
+        ))
+        priv.chmod(0o600)
+        cfg = CliConfig.load()
+        cur = cfg.current()
+        user = args.user or (cur.user if cur else "") or "dev"
+        (out / "id_ed25519.pub").write_text(
+            authorized_key_line(key, f"{user}@k8sgpu") + "\n"
+        )
+        print(f"wrote {priv} and {priv}.pub")
+        return 0
     ctx = _require_login(CliConfig.load())
     p = LocalPlatform()
     try:
@@ -390,12 +421,59 @@ def cmd_devenv_client(args) -> int:
         print(f"bad --gateway {args.gateway!r}: expected host:port",
               file=sys.stderr)
         return 2
+    user = args.user or ctx.user
+    if getattr(args, "ssh2", False):
+        # Real SSH-2 transport (platform/sshwire.py): curve25519-sha256
+        # kex, ssh-ed25519 keys, aes128-ctr + hmac-sha2-256.
+        from cryptography.hazmat.primitives.serialization import (
+            load_ssh_private_key,
+        )
+
+        from ..platform.sshwire import Ssh2Client, SshError
+
+        if not args.key:
+            print("--key <private key> is required with --ssh2",
+                  file=sys.stderr)
+            return 2
+        try:
+            key = load_ssh_private_key(
+                Path(args.key).read_bytes(), password=None
+            )
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load key: {e}", file=sys.stderr)
+            return 1
+        try:
+            with Ssh2Client(host, port, user, key) as c:
+                rc = 0
+                for cmd in (args.command or []):
+                    out, status = c.exec(cmd)
+                    print(out, end="" if out.endswith("\n") else "\n")
+                    rc = rc or status
+                if not args.command:
+                    for line in sys.stdin:
+                        line = line.strip()
+                        if not line or line == "exit":
+                            break
+                        out, status = c.exec(line)
+                        print(out, end="" if out.endswith("\n") else "\n",
+                              flush=True)
+                return rc
+        except SshError as e:
+            print(f"denied: {e}", file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"error: cannot reach gateway: {e}", file=sys.stderr)
+            return 1
+    if not args.pubkey:
+        print("--pubkey is required for the line-protocol client "
+              "(or pass --ssh2 --key for the SSH-2 transport)",
+              file=sys.stderr)
+        return 2
     try:
         pubkey = Path(args.pubkey).read_text().strip()
     except OSError as e:
         print(f"error: cannot read pubkey: {e}", file=sys.stderr)
         return 1
-    user = args.user or ctx.user
     try:
         with GatewayClient(host, port, user, pubkey) as c:
             if args.devenv_cmd == "put":
@@ -820,6 +898,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_gw.add_argument("--port", type=int, default=0)
     p_gw.add_argument("--for-seconds", type=float, default=0.0,
                       help="exit after N seconds (0 = until interrupted)")
+    p_kg = env_sub.add_parser(
+        "keygen", help="generate an Ed25519 keypair (ssh-keygen analogue)"
+    )
+    p_kg.add_argument("--out", default="", help="output dir (default .)")
+    p_kg.add_argument("--user", default="", help="key comment user")
     p_ssh = env_sub.add_parser(
         "ssh", help="open a session through the gateway (EXEC channel)"
     )
@@ -828,11 +911,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     for sp in (p_ssh, p_put):
         sp.add_argument("--gateway", required=True, help="host:port")
-        sp.add_argument("--pubkey", required=True,
+        sp.add_argument("--pubkey", default="",
                         help="path to the SSH public key the devenv holds")
         sp.add_argument("--user", default="")
     p_ssh.add_argument("-c", "--command", action="append",
                        help="run command(s) and exit (else read stdin)")
+    p_ssh.add_argument("--ssh2", action="store_true",
+                       help="real SSH-2 transport (curve25519/ed25519/"
+                            "aes128-ctr; platform/sshwire.py)")
+    p_ssh.add_argument("--key", default="",
+                       help="OpenSSH Ed25519 private key (with --ssh2)")
     p_ssh.set_defaults(fn=cmd_devenv_client)
     p_put.add_argument("--space", default="")
     p_put.add_argument("kind")

@@ -8,9 +8,17 @@ ever accepted a connection (VERDICT r2 missing #4) — this listener makes
 the flow real the same way the LM server made serving real: a socket you
 can actually connect to, driving auth off live cluster state.
 
-Protocol: SSH-*shaped* stub, one line each way (documented boundary — the
-full RFC 4253 key exchange belongs to the in-pod sshd this gateway fronts;
-the gateway's job is the reference's ingress routing + key check):
+TWO protocols share the port, routed by the first byte after the version
+exchange:
+
+1. **Real SSH-2** (RFC 4253/4252/4254 via platform/sshwire.py):
+   curve25519-sha256 kex, ssh-ed25519 host + user keys, aes128-ctr +
+   hmac-sha2-256, publickey auth against the user-ssh Secret, session
+   channels with exec — what ``k8sgpu devenv ssh --ssh2`` (and any
+   client speaking that suite) uses.  The host key persists as Secret
+   ``ssh-gateway-hostkey`` (the known_hosts contract).
+2. **Legacy line protocol**, one line each way (kept for the PUT bulk
+   path and scripted tooling):
 
     S: SSH-2.0-k8sgpu-devenv-gateway\r\n        (version banner, like sshd)
     C: SSH-2.0-<client>\r\n
@@ -68,6 +76,24 @@ class SshGateway:
                 if not client_version.startswith(b"SSH-"):
                     self.wfile.write(b"DENIED protocol mismatch\n")
                     return
+                # Dual protocol on one port: after the version exchange an
+                # SSH-2 client sends a binary KEXINIT packet (first byte
+                # is the high byte of a small length, 0x00); the legacy
+                # line client sends "AUTH ...".  Peek, don't consume.
+                head = self.rfile.peek(1)[:1]
+                if head and head != b"\x00":
+                    self._legacy(client_version)
+                    return
+                self.client_version_stripped = client_version
+                try:
+                    outer._ssh2_session(self)
+                except Exception as e:  # noqa: BLE001 — any wire error ends it
+                    log = __import__("logging").getLogger(
+                        "k8s_gpu_tpu.sshgate"
+                    )
+                    log.debug("ssh2 session ended: %s", e)
+
+            def _legacy(self, client_version: bytes) -> None:
                 line = self.rfile.readline(64 * 1024).decode(
                     "utf-8", "replace"
                 ).strip()
@@ -172,20 +198,220 @@ class SshGateway:
             target=self._server.serve_forever, name="ssh-gateway", daemon=True
         )
 
-    # -- auth + session backends (live cluster state) -----------------------
-    def _authenticate(self, username: str, offered_key: str):
-        """Returns (True, pod) or (False, reason)."""
+    # -- SSH-2 transport (sshwire.py; RFC 4253/4252/4254) -------------------
+    def host_key(self):
+        """Gateway Ed25519 host key, persisted as a Secret so the host
+        identity survives restarts (the known_hosts contract)."""
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        if getattr(self, "_host_key", None) is not None:
+            return self._host_key
+        sec = self.kube.try_get(
+            "Secret", "ssh-gateway-hostkey", self.namespace
+        )
+        if sec is not None and sec.data.get("ed25519"):
+            self._host_key = Ed25519PrivateKey.from_private_bytes(
+                bytes.fromhex(sec.data["ed25519"])
+            )
+            return self._host_key
+        key = Ed25519PrivateKey.generate()
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            NoEncryption,
+            PrivateFormat,
+        )
+
+        raw = key.private_bytes(
+            Encoding.Raw, PrivateFormat.Raw, NoEncryption()
+        )
+        from ..api.core import Secret
+
+        sec = Secret()
+        sec.metadata.name = "ssh-gateway-hostkey"
+        sec.metadata.namespace = self.namespace
+        sec.data["ed25519"] = raw.hex()
+        try:
+            self.kube.create(sec)
+        except Exception:
+            # Raced another gateway: adopt the WINNER's key — caching our
+            # own would present two host identities for one endpoint.
+            sec = self.kube.try_get(
+                "Secret", "ssh-gateway-hostkey", self.namespace
+            )
+            if sec is not None and sec.data.get("ed25519"):
+                key = Ed25519PrivateKey.from_private_bytes(
+                    bytes.fromhex(sec.data["ed25519"])
+                )
+        self._host_key = key
+        return key
+
+    def _lookup_devenv(self, username: str):
+        """THE auth-policy lookup both protocols share: running devenv
+        pod + the user's authorized_keys line.  Returns
+        (pod, authorized_line, None) or (None, None, reason) — only the
+        key COMPARISON differs per protocol (string equality for the
+        line client, blob + signature for SSH-2)."""
         pod = self.kube.try_get(
             "Pod", f"devenv-{username}", self.namespace
         )
         if pod is None or pod.phase != "Running":
-            return False, f"no running devenv for {username!r}"
+            return None, None, f"no running devenv for {username!r}"
         secret = self.kube.try_get(
             "Secret", f"user-ssh-{username}", self.namespace
         )
         if secret is None:
-            return False, f"no ssh key registered for {username!r}"
-        authorized = secret.data.get("authorized_keys", "")
+            return None, None, f"no ssh key registered for {username!r}"
+        return pod, secret.data.get("authorized_keys", ""), None
+
+    def _authenticate_ssh2(self, username: str, offered_blob: bytes):
+        """publickey auth against live cluster state: the offered
+        ssh-ed25519 blob must equal the authorized_keys entry.  (The
+        signature check is the caller's — this is the lookup half.)"""
+        from .sshwire import parse_authorized_key
+
+        pod, line, reason = self._lookup_devenv(username)
+        if pod is None:
+            return False, reason
+        want = parse_authorized_key(line)
+        if want is None or want != offered_blob:
+            return False, "public key rejected"
+        return True, pod
+
+    def _ssh2_session(self, handler) -> None:
+        from cryptography.exceptions import InvalidSignature
+
+        from . import sshwire as w
+
+        conn = w.PacketConn(handler.rfile, handler.wfile, server=True)
+        # Version strings (no CRLF) for the exchange hash.
+        client_version = handler.client_version_stripped
+        session_id = w.server_handshake(
+            conn, client_version, BANNER.strip(), self.host_key()
+        )
+        # service: ssh-userauth
+        pkt = conn.recv()
+        if pkt[0] != w.MSG_SERVICE_REQUEST:
+            raise w.SshError("expected SERVICE_REQUEST")
+        conn.send(bytes([w.MSG_SERVICE_ACCEPT]) + w.sb(b"ssh-userauth"))
+        pod = username = None
+        for _ in range(8):  # bounded auth attempts
+            pkt = conn.recv()
+            if pkt[0] != w.MSG_USERAUTH_REQUEST:
+                raise w.SshError("expected USERAUTH_REQUEST")
+            r = w.Reader(pkt[1:])
+            user = r.string().decode()
+            r.string()  # service
+            method = r.string()
+            if method != b"publickey":
+                conn.send(
+                    bytes([w.MSG_USERAUTH_FAILURE])
+                    + w.sb(b"publickey") + b"\x00"
+                )
+                continue
+            has_sig = r.boolean()
+            r.string()  # algo
+            blob = r.string()
+            ok, detail = self._authenticate_ssh2(user, blob)
+            if ok and not has_sig:
+                # The RFC 4252 §7 probe: a valid key without a signature
+                # gets PK_OK, telling the client to sign (what OpenSSH
+                # sends first).
+                conn.send(
+                    bytes([w.MSG_USERAUTH_PK_OK])
+                    + w.sb(w.HOSTKEY_ALGO) + w.sb(blob)
+                )
+                continue
+            if not ok:
+                conn.send(
+                    bytes([w.MSG_USERAUTH_FAILURE])
+                    + w.sb(b"publickey") + b"\x00"
+                )
+                continue
+            sig_r = w.Reader(r.string())
+            sig_r.string()  # algo
+            try:
+                w.ed25519_pub_from_blob(blob).verify(
+                    sig_r.string(),
+                    w.userauth_sign_blob(session_id, user, blob),
+                )
+            except InvalidSignature:
+                conn.send(
+                    bytes([w.MSG_USERAUTH_FAILURE])
+                    + w.sb(b"publickey") + b"\x00"
+                )
+                continue
+            pod, username = detail, user
+            conn.send(bytes([w.MSG_USERAUTH_SUCCESS]))
+            break
+        if pod is None:
+            return
+        # connection layer: session channels, exec requests.
+        while True:
+            try:
+                pkt = conn.recv()
+            except w.SshError:
+                return
+            t = pkt[0]
+            if t == w.MSG_DISCONNECT:
+                return
+            if t == w.MSG_CHANNEL_OPEN:
+                r = w.Reader(pkt[1:])
+                ctype = r.string()
+                peer_chan = r.u32()
+                if ctype != b"session":
+                    conn.send(
+                        bytes([w.MSG_CHANNEL_OPEN_FAILURE])
+                        + w.su32(peer_chan) + w.su32(3)
+                        + w.sb(b"only session channels") + w.sb(b"")
+                    )
+                    continue
+                conn.send(
+                    bytes([w.MSG_CHANNEL_OPEN_CONFIRMATION])
+                    + w.su32(peer_chan) + w.su32(peer_chan)
+                    + w.su32(1 << 20) + w.su32(1 << 15)
+                )
+            elif t == w.MSG_CHANNEL_REQUEST:
+                r = w.Reader(pkt[1:])
+                chan = r.u32()
+                rtype = r.string()
+                want_reply = r.boolean()
+                if rtype != b"exec":
+                    if want_reply:
+                        conn.send(
+                            bytes([w.MSG_CHANNEL_FAILURE]) + w.su32(chan)
+                        )
+                    continue
+                cmd = r.string().decode("utf-8", "replace")
+                if want_reply:
+                    conn.send(bytes([w.MSG_CHANNEL_SUCCESS]) + w.su32(chan))
+                out = self._exec(username, pod, cmd)
+                status = 1 if out.startswith("ERR ") else 0
+                conn.send(
+                    bytes([w.MSG_CHANNEL_DATA]) + w.su32(chan)
+                    + w.sb((out + "\n").encode())
+                )
+                conn.send(
+                    bytes([w.MSG_CHANNEL_REQUEST]) + w.su32(chan)
+                    + w.sb(b"exit-status") + b"\x00" + w.su32(status)
+                )
+                conn.send(bytes([w.MSG_CHANNEL_EOF]) + w.su32(chan))
+                conn.send(bytes([w.MSG_CHANNEL_CLOSE]) + w.su32(chan))
+            elif t == w.MSG_CHANNEL_CLOSE:
+                continue
+            elif t == w.MSG_CHANNEL_EOF:
+                continue
+            else:
+                raise w.SshError(f"unexpected message {t}")
+
+    # -- auth + session backends (live cluster state) -----------------------
+    def _authenticate(self, username: str, offered_key: str):
+        """Line-protocol auth: Returns (True, pod) or (False, reason) —
+        same _lookup_devenv policy as SSH-2, string-equality comparison."""
+        pod, authorized, reason = self._lookup_devenv(username)
+        if pod is None:
+            return False, reason
         if not offered_key or offered_key != authorized.strip():
             return False, "public key rejected"
         return True, pod

@@ -1,0 +1,508 @@
+"""SSH-2 wire protocol (RFC 4253/4252/4254) — the restricted cipher
+suite that upgrades the devenv gateway from an SSH-*shaped* line
+protocol to the real transport (C24, GPU调度平台搭建.md:408-419: the
+reference fronts devenvs with actual sshd on :2022).
+
+One algorithm per slot — negotiation still happens, the lists are just
+length one (RFC 4253 allows exactly this):
+
+    kex        curve25519-sha256        (RFC 8731)
+    host key   ssh-ed25519              (RFC 8709)
+    cipher     aes128-ctr               (RFC 4344)
+    mac        hmac-sha2-256            (RFC 6668)
+    compression none
+
+Channel layer: session channels with ``exec`` requests only — the
+gateway's job is the reference's ingress routing + key check; a full
+shell/PTY belongs to the in-pod sshd it fronts.
+
+Everything here is transport mechanics shared by the server
+(sshgate.SshGateway) and the client (Ssh2Client below, what
+``k8sgpu devenv ssh --ssh2`` and the tests speak).  Crypto primitives
+come from the ``cryptography`` package (X25519/Ed25519/AES-CTR/HMAC);
+the protocol state machine is all here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+import struct
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+VERSION = b"SSH-2.0-k8sgpu_gateway"
+
+# Message numbers (RFC 4253 §12, 4252, 4254).
+MSG_DISCONNECT = 1
+MSG_SERVICE_REQUEST = 5
+MSG_SERVICE_ACCEPT = 6
+MSG_KEXINIT = 20
+MSG_NEWKEYS = 21
+MSG_KEX_ECDH_INIT = 30
+MSG_KEX_ECDH_REPLY = 31
+MSG_USERAUTH_REQUEST = 50
+MSG_USERAUTH_FAILURE = 51
+MSG_USERAUTH_SUCCESS = 52
+MSG_USERAUTH_PK_OK = 60
+MSG_CHANNEL_OPEN = 90
+MSG_CHANNEL_OPEN_CONFIRMATION = 91
+MSG_CHANNEL_OPEN_FAILURE = 92
+MSG_CHANNEL_DATA = 94
+MSG_CHANNEL_EOF = 96
+MSG_CHANNEL_CLOSE = 97
+MSG_CHANNEL_REQUEST = 98
+MSG_CHANNEL_SUCCESS = 99
+MSG_CHANNEL_FAILURE = 100
+
+KEX_ALGO = b"curve25519-sha256"
+HOSTKEY_ALGO = b"ssh-ed25519"
+CIPHER_ALGO = b"aes128-ctr"
+MAC_ALGO = b"hmac-sha2-256"
+COMP_ALGO = b"none"
+
+
+class SshError(RuntimeError):
+    pass
+
+
+# -- SSH primitive encodings (RFC 4251 §5) ----------------------------------
+
+def sb(b: bytes) -> bytes:  # string
+    return struct.pack(">I", len(b)) + b
+
+
+def su32(n: int) -> bytes:
+    return struct.pack(">I", n)
+
+
+def smpint(n: int) -> bytes:
+    if n == 0:
+        return sb(b"")
+    raw = n.to_bytes((n.bit_length() + 8) // 8, "big")
+    return sb(raw)
+
+
+class Reader:
+    """Bounds-checked parse cursor: truncated or malformed packets raise
+    SshError (the handled path) — never bare IndexError/struct.error
+    tracebacks out of the CLI."""
+
+    def __init__(self, data: bytes):
+        self.d = data
+        self.o = 0
+
+    def byte(self) -> int:
+        if self.o >= len(self.d):
+            raise SshError("truncated packet")
+        self.o += 1
+        return self.d[self.o - 1]
+
+    def u32(self) -> int:
+        if self.o + 4 > len(self.d):
+            raise SshError("truncated packet")
+        v = struct.unpack(">I", self.d[self.o:self.o + 4])[0]
+        self.o += 4
+        return v
+
+    def string(self) -> bytes:
+        n = self.u32()
+        v = self.d[self.o:self.o + n]
+        if len(v) != n:
+            raise SshError("truncated string")
+        self.o += n
+        return v
+
+    def boolean(self) -> bool:
+        return self.byte() != 0
+
+
+def ed25519_blob(pub: Ed25519PublicKey) -> bytes:
+    """The ssh-ed25519 public-key wire blob (RFC 8709 §4)."""
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    raw = pub.public_bytes(Encoding.Raw, PublicFormat.Raw)
+    return sb(HOSTKEY_ALGO) + sb(raw)
+
+
+def ed25519_pub_from_blob(blob: bytes) -> Ed25519PublicKey:
+    r = Reader(blob)
+    if r.string() != HOSTKEY_ALGO:
+        raise SshError("not an ssh-ed25519 key blob")
+    return Ed25519PublicKey.from_public_bytes(r.string())
+
+
+def authorized_key_line(priv: Ed25519PrivateKey, comment: str = "") -> str:
+    """`ssh-ed25519 <b64 blob> comment` — what lands in the user-ssh
+    Secret's authorized_keys (and what ssh-keygen would emit)."""
+    import base64
+
+    b64 = base64.b64encode(ed25519_blob(priv.public_key())).decode()
+    return f"ssh-ed25519 {b64}" + (f" {comment}" if comment else "")
+
+
+def parse_authorized_key(line: str) -> bytes | None:
+    """authorized_keys line → wire blob (None if not ssh-ed25519)."""
+    import base64
+
+    parts = line.strip().split()
+    if len(parts) < 2 or parts[0] != "ssh-ed25519":
+        return None
+    try:
+        return base64.b64decode(parts[1])
+    except Exception:
+        return None
+
+
+# -- binary packet protocol (RFC 4253 §6) -----------------------------------
+
+class PacketConn:
+    """Framed, optionally encrypted packet stream over a socket file
+    pair.  Starts plaintext; ``enable_crypto`` switches on aes128-ctr +
+    hmac-sha2-256 with independent c2s/s2c keys after NEWKEYS."""
+
+    def __init__(self, rfile, wfile, server: bool):
+        self.r, self.w = rfile, wfile
+        self.server = server
+        self.seq_in = 0
+        self.seq_out = 0
+        self._enc = self._dec = None
+        self._mac_out = self._mac_in = None
+
+    def enable_crypto(self, keys: dict) -> None:
+        side_out = "s2c" if self.server else "c2s"
+        side_in = "c2s" if self.server else "s2c"
+        self._enc = Cipher(
+            algorithms.AES(keys[f"key_{side_out}"]),
+            modes.CTR(keys[f"iv_{side_out}"]),
+        ).encryptor()
+        self._dec = Cipher(
+            algorithms.AES(keys[f"key_{side_in}"]),
+            modes.CTR(keys[f"iv_{side_in}"]),
+        ).decryptor()
+        self._mac_out = keys[f"mac_{side_out}"]
+        self._mac_in = keys[f"mac_{side_in}"]
+
+    def send(self, payload: bytes) -> None:
+        block = 16
+        # padding: total (len+padlen+payload+pad) multiple of block, >= 4.
+        pad = block - ((5 + len(payload)) % block)
+        if pad < 4:
+            pad += block
+        pkt = struct.pack(">IB", 1 + len(payload) + pad, pad)
+        pkt += payload + os.urandom(pad)
+        if self._enc is not None:
+            mac = hmac_mod.new(
+                self._mac_out, su32(self.seq_out) + pkt, hashlib.sha256
+            ).digest()
+            self.w.write(self._enc.update(pkt) + mac)
+        else:
+            self.w.write(pkt)
+        self.w.flush()
+        self.seq_out += 1
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.r.read(n - len(buf))
+            if not chunk:
+                raise SshError("connection closed")
+            buf += chunk
+        return buf
+
+    def recv(self) -> bytes:
+        if self._dec is not None:
+            first = self._dec.update(self._read_exact(16))
+            (plen,) = struct.unpack(">I", first[:4])
+            if plen > 1 << 20:
+                raise SshError("packet too large")
+            rest = self._dec.update(self._read_exact(plen + 4 - 16))
+            pkt = first + rest
+            mac = self._read_exact(32)
+            want = hmac_mod.new(
+                self._mac_in, su32(self.seq_in) + pkt, hashlib.sha256
+            ).digest()
+            if not hmac_mod.compare_digest(mac, want):
+                raise SshError("MAC verification failed")
+        else:
+            head = self._read_exact(5)
+            (plen,) = struct.unpack(">I", head[:4])
+            if plen > 1 << 20:
+                raise SshError("packet too large")
+            pkt = head + self._read_exact(plen - 1)
+        (plen,) = struct.unpack(">I", pkt[:4])
+        pad = pkt[4]
+        payload = pkt[5:5 + plen - 1 - pad]
+        self.seq_in += 1
+        return payload
+
+
+def kexinit_payload(cookie: bytes) -> bytes:
+    lists = [
+        KEX_ALGO, HOSTKEY_ALGO, CIPHER_ALGO, CIPHER_ALGO,
+        MAC_ALGO, MAC_ALGO, COMP_ALGO, COMP_ALGO, b"", b"",
+    ]
+    out = bytes([MSG_KEXINIT]) + cookie
+    for item in lists:
+        out += sb(item)
+    out += b"\x00" + su32(0)  # first_kex_packet_follows, reserved
+    return out
+
+
+def check_kexinit(payload: bytes) -> None:
+    """Peer's KEXINIT must contain our one algorithm per slot."""
+    r = Reader(payload)
+    r.byte()
+    r.d, r.o = payload, 1 + 16  # skip cookie
+    names = [r.string() for _ in range(10)]
+    want = [KEX_ALGO, HOSTKEY_ALGO, CIPHER_ALGO, CIPHER_ALGO,
+            MAC_ALGO, MAC_ALGO, COMP_ALGO, COMP_ALGO]
+    for have, algo in zip(names[:8], want):
+        if algo not in have.split(b","):
+            raise SshError(
+                f"no common algorithm: need {algo.decode()}, "
+                f"peer offers {have.decode()!r}"
+            )
+
+
+def derive_keys(K: int, H: bytes, session_id: bytes) -> dict:
+    """RFC 4253 §7.2 key derivation (sha256)."""
+
+    def kdf(letter: bytes, size: int) -> bytes:
+        out = hashlib.sha256(smpint(K) + H + letter + session_id).digest()
+        while len(out) < size:
+            out += hashlib.sha256(smpint(K) + H + out).digest()
+        return out[:size]
+
+    return {
+        "iv_c2s": kdf(b"A", 16),
+        "iv_s2c": kdf(b"B", 16),
+        "key_c2s": kdf(b"C", 16),
+        "key_s2c": kdf(b"D", 16),
+        "mac_c2s": kdf(b"E", 32),
+        "mac_s2c": kdf(b"F", 32),
+    }
+
+
+def exchange_hash(v_c: bytes, v_s: bytes, i_c: bytes, i_s: bytes,
+                  k_s: bytes, q_c: bytes, q_s: bytes, K: int) -> bytes:
+    """RFC 8731 §3: H = hash of the concatenated exchange values."""
+    blob = (
+        sb(v_c) + sb(v_s) + sb(i_c) + sb(i_s) + sb(k_s)
+        + sb(q_c) + sb(q_s) + smpint(K)
+    )
+    return hashlib.sha256(blob).digest()
+
+
+def _x25519_shared(priv: X25519PrivateKey, peer_raw: bytes) -> int:
+    shared = priv.exchange(X25519PublicKey.from_public_bytes(peer_raw))
+    return int.from_bytes(shared, "big")
+
+
+# -- server-side handshake ---------------------------------------------------
+
+def server_handshake(conn: PacketConn, v_c: bytes, v_s: bytes,
+                     host_key: Ed25519PrivateKey) -> bytes:
+    """KEXINIT → ECDH → NEWKEYS on the server side.  Returns the session
+    id (= the first exchange hash)."""
+    cookie = os.urandom(16)
+    i_s = kexinit_payload(cookie)
+    conn.send(i_s)
+    i_c = conn.recv()
+    if i_c[0] != MSG_KEXINIT:
+        raise SshError(f"expected KEXINIT, got {i_c[0]}")
+    check_kexinit(i_c)
+
+    pkt = conn.recv()
+    if pkt[0] != MSG_KEX_ECDH_INIT:
+        raise SshError(f"expected KEX_ECDH_INIT, got {pkt[0]}")
+    q_c = Reader(pkt[1:]).string()
+    eph = X25519PrivateKey.generate()
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    q_s = eph.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+    K = _x25519_shared(eph, q_c)
+    k_s = ed25519_blob(host_key.public_key())
+    H = exchange_hash(v_c, v_s, i_c, i_s, k_s, q_c, q_s, K)
+    sig = sb(HOSTKEY_ALGO) + sb(host_key.sign(H))
+    conn.send(
+        bytes([MSG_KEX_ECDH_REPLY]) + sb(k_s) + sb(q_s) + sb(sig)
+    )
+    conn.send(bytes([MSG_NEWKEYS]))
+    if conn.recv()[0] != MSG_NEWKEYS:
+        raise SshError("expected NEWKEYS")
+    conn.enable_crypto(derive_keys(K, H, H))
+    return H
+
+
+def client_handshake(conn: PacketConn, v_c: bytes, v_s: bytes) -> tuple:
+    """Client side of the same.  Returns (session_id, host_key_blob) —
+    the caller decides host-key trust (known_hosts is its business)."""
+    i_c = kexinit_payload(os.urandom(16))
+    conn.send(i_c)
+    i_s = conn.recv()
+    if i_s[0] != MSG_KEXINIT:
+        raise SshError(f"expected KEXINIT, got {i_s[0]}")
+    check_kexinit(i_s)
+    eph = X25519PrivateKey.generate()
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    q_c = eph.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+    conn.send(bytes([MSG_KEX_ECDH_INIT]) + sb(q_c))
+    pkt = conn.recv()
+    if pkt[0] != MSG_KEX_ECDH_REPLY:
+        raise SshError(f"expected KEX_ECDH_REPLY, got {pkt[0]}")
+    r = Reader(pkt[1:])
+    k_s, q_s, sig_blob = r.string(), r.string(), r.string()
+    K = _x25519_shared(eph, q_s)
+    H = exchange_hash(v_c, v_s, i_c, i_s, k_s, q_c, q_s, K)
+    sr = Reader(sig_blob)
+    if sr.string() != HOSTKEY_ALGO:
+        raise SshError("host key signature algorithm mismatch")
+    ed25519_pub_from_blob(k_s).verify(sr.string(), H)  # raises on forgery
+    conn.send(bytes([MSG_NEWKEYS]))
+    if conn.recv()[0] != MSG_NEWKEYS:
+        raise SshError("expected NEWKEYS")
+    conn.enable_crypto(derive_keys(K, H, H))
+    return H, k_s
+
+
+def userauth_sign_blob(session_id: bytes, username: str,
+                       key_blob: bytes) -> bytes:
+    """The exact bytes a publickey USERAUTH_REQUEST signature covers
+    (RFC 4252 §7) — shared so server verify and client sign cannot
+    diverge."""
+    return (
+        sb(session_id) + bytes([MSG_USERAUTH_REQUEST])
+        + sb(username.encode()) + sb(b"ssh-connection")
+        + sb(b"publickey") + b"\x01" + sb(HOSTKEY_ALGO) + sb(key_blob)
+    )
+
+
+class Ssh2Client:
+    """Minimal SSH-2 client: connect, publickey-auth, exec one or more
+    commands over session channels.  This is the platform's own client
+    for the SSH-2 gateway — structurally what `ssh -p 2022` does with
+    the same algorithm suite."""
+
+    def __init__(self, host: str, port: int, username: str,
+                 key: Ed25519PrivateKey, timeout: float = 10.0):
+        import socket
+
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self.r = self._sock.makefile("rb")
+        self.w = self._sock.makefile("wb")
+        banner = self.r.readline(256).strip()
+        if not banner.startswith(b"SSH-2.0"):
+            raise SshError(f"not an SSH-2 server: {banner!r}")
+        self.w.write(VERSION + b"-client\r\n")
+        self.w.flush()
+        self.conn = PacketConn(self.r, self.w, server=False)
+        self.session_id, self.host_key_blob = client_handshake(
+            self.conn, VERSION + b"-client", banner
+        )
+        # service + publickey auth
+        self.conn.send(bytes([MSG_SERVICE_REQUEST]) + sb(b"ssh-userauth"))
+        if self.conn.recv()[0] != MSG_SERVICE_ACCEPT:
+            raise SshError("service ssh-userauth refused")
+        blob = ed25519_blob(key.public_key())
+        # Probe first (signature flag FALSE) like OpenSSH does — the
+        # server must answer PK_OK before we spend the signature
+        # (RFC 4252 §7); this also keeps the server's PK_OK path
+        # exercised by every client connection.
+        self.conn.send(
+            bytes([MSG_USERAUTH_REQUEST]) + sb(username.encode())
+            + sb(b"ssh-connection") + sb(b"publickey") + b"\x00"
+            + sb(HOSTKEY_ALGO) + sb(blob)
+        )
+        probe = self.conn.recv()
+        if probe[0] != MSG_USERAUTH_PK_OK:
+            raise SshError("authentication failed")
+        sig = key.sign(userauth_sign_blob(self.session_id, username, blob))
+        self.conn.send(
+            bytes([MSG_USERAUTH_REQUEST]) + sb(username.encode())
+            + sb(b"ssh-connection") + sb(b"publickey") + b"\x01"
+            + sb(HOSTKEY_ALGO) + sb(blob)
+            + sb(sb(HOSTKEY_ALGO) + sb(sig))
+        )
+        resp = self.conn.recv()
+        if resp[0] != MSG_USERAUTH_SUCCESS:
+            raise SshError("authentication failed")
+        self._next_chan = 0
+
+    def exec(self, command: str) -> tuple[str, int]:
+        """Run one command in a session channel → (output, exit_status)."""
+        cid = self._next_chan
+        self._next_chan += 1
+        self.conn.send(
+            bytes([MSG_CHANNEL_OPEN]) + sb(b"session") + su32(cid)
+            + su32(1 << 20) + su32(1 << 15)
+        )
+        pkt = self.conn.recv()
+        if pkt[0] != MSG_CHANNEL_OPEN_CONFIRMATION:
+            raise SshError("channel open refused")
+        r = Reader(pkt[1:])
+        r.u32()  # recipient (our id)
+        server_chan = r.u32()
+        self.conn.send(
+            bytes([MSG_CHANNEL_REQUEST]) + su32(server_chan)
+            + sb(b"exec") + b"\x01" + sb(command.encode())
+        )
+        out = b""
+        status = -1
+        while True:
+            pkt = self.conn.recv()
+            t = pkt[0]
+            if t == MSG_CHANNEL_SUCCESS:
+                continue
+            if t == MSG_CHANNEL_FAILURE:
+                raise SshError(f"exec refused: {command!r}")
+            if t == MSG_CHANNEL_DATA:
+                r = Reader(pkt[1:])
+                r.u32()
+                out += r.string()
+            elif t == MSG_CHANNEL_REQUEST:
+                r = Reader(pkt[1:])
+                r.u32()
+                if r.string() == b"exit-status":
+                    r.boolean()
+                    status = r.u32()
+            elif t == MSG_CHANNEL_EOF:
+                continue
+            elif t == MSG_CHANNEL_CLOSE:
+                self.conn.send(bytes([MSG_CHANNEL_CLOSE]) + su32(cid))
+                break
+            else:
+                raise SshError(f"unexpected channel message {t}")
+        return out.decode("utf-8", "replace"), status
+
+    def close(self) -> None:
+        for h in (self.r, self.w, self._sock):
+            try:
+                h.close()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -205,6 +205,45 @@ def test_devenv_ssh_and_put_cli_client(tmp_path, capsys):
         p.close()
 
 
+def test_devenv_ssh2_cli_end_to_end(tmp_path, capsys):
+    """The SSH-2 stretch (VERDICT r3 #7): `devenv keygen` makes a real
+    Ed25519 pair, `devenv create` registers the .pub, and `devenv ssh
+    --ssh2 --key` runs the full RFC-4253 transport against the live
+    gateway socket."""
+    run(capsys, "login", "--user", "ada")
+    code, out, _ = run(capsys, "devenv", "keygen", "--out", str(tmp_path))
+    assert code == 0
+    assert (tmp_path / "id_ed25519").exists()
+    code, out, _ = run(capsys, "devenv", "create", "--pubkey",
+                       str(tmp_path / "id_ed25519.pub"))
+    assert code == 0, out
+    from k8s_gpu_tpu.cli.platform_local import LocalPlatform
+    from k8s_gpu_tpu.platform.sshgate import SshGateway
+
+    p = LocalPlatform()
+    gw = SshGateway(p.kube, port=0, namespace="default").start()
+    try:
+        ep = f"127.0.0.1:{gw.port}"
+        code, out, err = run(
+            capsys, "devenv", "ssh", "--gateway", ep, "--ssh2",
+            "--key", str(tmp_path / "id_ed25519"),
+            "-c", "hostname", "-c", "whoami",
+        )
+        assert code == 0, err
+        assert "devenv-ada" in out and "ada" in out
+        # wrong private key: transport-level auth failure
+        run(capsys, "devenv", "keygen", "--out", str(tmp_path / "other"))
+        code, _, err = run(
+            capsys, "devenv", "ssh", "--gateway", ep, "--ssh2",
+            "--key", str(tmp_path / "other" / "id_ed25519"),
+            "-c", "hostname",
+        )
+        assert code == 1 and "denied" in err
+    finally:
+        gw.stop()
+        p.close()
+
+
 def test_ci_install_uninstall(capsys):
     """`make deploy`'s CLI analogue (reference README.md:298-302): the
     platform chart installs with the operator image ref, upgrades

@@ -25,11 +25,12 @@ def test_api_role_serves_healthz(tmp_path, monkeypatch):
         parts["stop"]()
 
 
-def test_controller_role_reconciles(tmp_path):
+def test_controller_role_reconciles(tmp_path, monkeypatch):
     """The controller role must run the same reconciler set the CLI's
     local platform does — a TpuPodSlice applied to its kube goes Ready."""
     from k8s_gpu_tpu.api import TpuPodSlice
 
+    monkeypatch.setenv("GOHAI_ASSET_DIR", str(tmp_path / "assets"))
     kube = FakeKube()
     parts = build_operator("controller", kube=kube)
     assert parts["mgr"] is not None
@@ -69,6 +70,7 @@ def test_state_dir_persists_across_restart(tmp_path, monkeypatch):
     state instead of starting empty."""
     from k8s_gpu_tpu.api.core import Secret
 
+    monkeypatch.setenv("GOHAI_ASSET_DIR", str(tmp_path / "assets"))
     sd = str(tmp_path / "state")
     parts = build_operator("controller", state_dir=sd)
     parts["start"]()

@@ -1,0 +1,131 @@
+"""SSH-2 transport on the devenv gateway (platform/sshwire.py — RFC
+4253/4252/4254 with the restricted suite curve25519-sha256 /
+ssh-ed25519 / aes128-ctr / hmac-sha2-256): real key exchange, encrypted
+packets, publickey auth and exec channels against live cluster state —
+C24's standard-protocol half (GPU调度平台搭建.md:408-419)."""
+
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+)
+
+from k8s_gpu_tpu.api.core import Pod, Secret
+from k8s_gpu_tpu.controller.kubefake import FakeKube
+from k8s_gpu_tpu.platform.sshgate import SshGateway
+from k8s_gpu_tpu.platform.sshwire import (
+    Ssh2Client,
+    SshError,
+    authorized_key_line,
+    parse_authorized_key,
+)
+
+KEY = Ed25519PrivateKey.generate()
+
+
+@pytest.fixture()
+def cluster():
+    kube = FakeKube()
+    pod = Pod()
+    pod.metadata.name = "devenv-ada"
+    pod.phase = "Running"
+    pod.env["TPU_VISIBLE_CHIPS"] = "0,1"
+    kube.create(pod)
+    sec = Secret()
+    sec.metadata.name = "user-ssh-ada"
+    sec.data["authorized_keys"] = authorized_key_line(KEY, "ada@laptop")
+    kube.create(sec)
+    gw = SshGateway(kube).start()
+    yield kube, gw
+    gw.stop()
+
+
+def test_handshake_auth_exec(cluster):
+    kube, gw = cluster
+    with Ssh2Client("127.0.0.1", gw.port, "ada", KEY) as c:
+        out, status = c.exec("hostname")
+        assert out.strip() == "devenv-ada" and status == 0
+        out, status = c.exec("whoami")
+        assert out.strip() == "ada" and status == 0
+        out, status = c.exec("chips")
+        assert out.strip() == "0,1"
+        # unsupported command maps to a nonzero exit status
+        out, status = c.exec("rm -rf /")
+        assert status == 1 and "unsupported" in out
+
+
+def test_wrong_key_rejected(cluster):
+    kube, gw = cluster
+    with pytest.raises(SshError, match="authentication failed"):
+        Ssh2Client("127.0.0.1", gw.port, "ada",
+                   Ed25519PrivateKey.generate())
+
+
+def test_no_devenv_rejected(cluster):
+    kube, gw = cluster
+    with pytest.raises(SshError, match="authentication failed"):
+        Ssh2Client("127.0.0.1", gw.port, "mallory", KEY)
+
+
+def test_key_rotation_takes_effect_immediately(cluster):
+    """Auth reads live cluster state per connection: rotating the
+    Secret's key flips which private key gets in, no restart."""
+    kube, gw = cluster
+    new_key = Ed25519PrivateKey.generate()
+    sec = kube.get("Secret", "user-ssh-ada")
+    sec.data["authorized_keys"] = authorized_key_line(new_key)
+    kube.update(sec)
+    with pytest.raises(SshError):
+        Ssh2Client("127.0.0.1", gw.port, "ada", KEY)
+    with Ssh2Client("127.0.0.1", gw.port, "ada", new_key) as c:
+        assert c.exec("whoami")[0].strip() == "ada"
+
+
+def test_host_key_is_stable_across_connections(cluster):
+    """The host key persists in a Secret — the known_hosts contract:
+    two connections see the same identity."""
+    kube, gw = cluster
+    with Ssh2Client("127.0.0.1", gw.port, "ada", KEY) as a:
+        blob_a = a.host_key_blob
+    with Ssh2Client("127.0.0.1", gw.port, "ada", KEY) as b:
+        assert b.host_key_blob == blob_a
+    assert kube.try_get("Secret", "ssh-gateway-hostkey") is not None
+
+
+def test_packet_tampering_detected(cluster):
+    """Flipping one ciphertext byte must fail the HMAC, not decode."""
+    kube, gw = cluster
+    c = Ssh2Client("127.0.0.1", gw.port, "ada", KEY)
+    try:
+        # Corrupt the next outgoing packet's MAC key so the server's
+        # verification fails: emulate by sending a valid-length packet
+        # with a garbage MAC directly.
+        import os
+
+        c.conn.w.write(os.urandom(16 + 32))
+        c.conn.w.flush()
+        with pytest.raises(SshError):
+            # server drops the connection; our next exec dies on read
+            c.exec("hostname")
+    finally:
+        c.close()
+
+
+def test_legacy_line_protocol_still_served_on_same_port(cluster):
+    """Dual protocol: the line client (GatewayClient) and SSH-2 share
+    one port — the first post-version byte routes."""
+    from k8s_gpu_tpu.platform.sshgate import GatewayClient, GatewayError
+
+    kube, gw = cluster
+    line = kube.get("Secret", "user-ssh-ada").data["authorized_keys"]
+    with GatewayClient("127.0.0.1", gw.port, "ada", line) as c:
+        assert c.exec("whoami") == "ada"
+    with Ssh2Client("127.0.0.1", gw.port, "ada", KEY) as c:
+        assert c.exec("whoami")[0].strip() == "ada"
+
+
+def test_authorized_key_roundtrip():
+    line = authorized_key_line(KEY, "comment here")
+    blob = parse_authorized_key(line)
+    assert blob is not None
+    assert parse_authorized_key("ssh-rsa AAAA nope") is None
+    assert parse_authorized_key("garbage") is None
