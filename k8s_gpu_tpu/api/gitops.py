@@ -1,0 +1,59 @@
+"""GitOps Application kind — the ArgoCD-style pull-based deployment
+option the reference lists as the alternative to its push-mode GitLab-CI
+flow (GPU调度平台搭建.md:792-794: "可选：改造成 ArgoCD 拉取式同步").
+
+An Application points at a repository asset (the platform's git-ish
+store, the same one the CI pipeline builds from) and a manifest
+directory inside it; the GitOps reconciler (operators/gitops.py) keeps
+the cluster converged to those manifests — apply on drift, prune on
+removal — and records the synced revision in status.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .types import Condition, CustomResource, ValidationError
+
+
+@dataclass
+class ApplicationSpec:
+    space: str = "default"          # asset space holding the repo
+    repo: str = ""                  # repository asset id
+    path: str = "manifests"         # manifest dir inside the repo
+    target_namespace: str = "default"
+    # auto_sync False = detect drift only (status OutOfSync), never
+    # write — ArgoCD's manual-sync mode; sync happens via
+    # GitOpsReconciler.sync_now or by flipping the flag.
+    auto_sync: bool = True
+    prune: bool = True              # delete managed objects not in git
+
+
+@dataclass
+class ApplicationStatus:
+    phase: str = ""                 # Synced | OutOfSync | Error
+    revision: str = ""              # repo asset version last examined
+    synced_revision: str = ""       # revision last APPLIED
+    applied: int = 0
+    pruned: int = 0
+    drifted: list = field(default_factory=list)  # ["Kind/name", ...]
+    message: str = ""
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class Application(CustomResource):
+    kind: str = "Application"
+    api_version: str = "gitops.k8sgpu.dev/v1alpha1"
+    spec: ApplicationSpec = field(default_factory=ApplicationSpec)
+    status: ApplicationStatus = field(default_factory=ApplicationStatus)
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.spec.repo:
+            raise ValidationError("spec.repo is required")
+        if ".." in self.spec.path or self.spec.path.startswith("/"):
+            raise ValidationError(
+                f"spec.path {self.spec.path!r} must be a relative path "
+                "inside the repo"
+            )

@@ -37,9 +37,19 @@ def known_kinds() -> list[str]:
 def _ensure_registry() -> None:
     if _KIND_REGISTRY:
         return
-    from . import core, azurevmpool, devenv, queue, tenancy, tpupodslice, trainjob
+    from . import (
+        core,
+        azurevmpool,
+        devenv,
+        gitops,
+        queue,
+        tenancy,
+        tpupodslice,
+        trainjob,
+    )
 
-    for mod in (core, azurevmpool, devenv, queue, tenancy, tpupodslice, trainjob):
+    for mod in (core, azurevmpool, devenv, gitops, queue, tenancy,
+                tpupodslice, trainjob):
         for name in dir(mod):
             obj = getattr(mod, name)
             if (

@@ -107,7 +107,8 @@ class LocalPlatform:
         # THE controller wiring, shared with the in-cluster operator
         # image (platform/entrypoint.py) — one place, no drift.
         self.mgr, storage = controller_manager(
-            self.kube, self.cloud, provision_poll=0.05, devenv=True
+            self.kube, self.cloud, provision_poll=0.05, devenv=True,
+            assets=self.assets,
         )
         # Dynamic storage (C13): dev-box pools sized generously — capacity
         # enforcement matters, exact numbers don't.  Usage is re-derived

@@ -4,6 +4,7 @@ from .trainjob import TrainJobReconciler
 from .autoscaler import SliceAutoscaler
 from .devenv import DevEnvReconciler
 from .gc import ResourceGC
+from .gitops import GitOpsReconciler
 
 __all__ = [
     "AzureVmPoolReconciler",
@@ -12,4 +13,5 @@ __all__ = [
     "SliceAutoscaler",
     "DevEnvReconciler",
     "ResourceGC",
+    "GitOpsReconciler",
 ]

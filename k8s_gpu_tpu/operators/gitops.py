@@ -1,0 +1,208 @@
+"""GitOps reconciler — the ArgoCD pull-based sync option
+(GPU调度平台搭建.md:792-794; the reference's push-mode GitLab-CI deploy is
+platform/cicd.py; this is the pull alternative it names).
+
+An Application (api/gitops.py) points at a repository asset and a
+manifest directory.  Each reconcile:
+
+1. reads every ``*.yaml`` under ``<repo asset>/<spec.path>`` through the
+   schema codec (api/serialize.load_manifests — the same parser
+   ``k8sgpu apply`` uses, so git IS the apply surface);
+2. stamps each desired object with the app label and target namespace;
+3. diffs desired vs live on the manifest dicts with metadata/status
+   stripped — drift in ANY spec field (or a hand-edited object) makes
+   the app OutOfSync;
+4. with ``auto_sync``: creates/updates drifted objects and — with
+   ``prune`` — deletes app-labeled objects whose manifest left git (the
+   label set is the ownership record, ArgoCD's tracking-label idiom);
+   without it: reports only (manual-sync mode).
+
+Polling: the repo asset has no push hook, so the reconciler requeues
+every ``POLL_S`` (the argoCD default-ish 15 s scaled down for tests) —
+level-triggered convergence against both git changes and cluster drift.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+from ..api.gitops import Application
+from ..api.serialize import known_kinds, load_manifests, to_manifest
+from ..api.types import set_condition
+from ..controller.kubefake import Conflict, FakeKube, NotFound
+from ..controller.manager import Reconciler, Request, Result
+
+log = logging.getLogger("k8s_gpu_tpu.operators.gitops")
+
+APP_LABEL = "gitops.k8sgpu.dev/app"
+POLL_S = 15.0
+
+
+def _desired_manifest(obj) -> dict:
+    """The comparable core of a manifest: everything except metadata
+    (server-managed fields) and status (controller-owned)."""
+    m = to_manifest(obj)
+    m.pop("metadata", None)
+    m.pop("status", None)
+    return m
+
+
+class GitOpsReconciler(Reconciler):
+    def __init__(self, kube: FakeKube, assets, poll_s: float = POLL_S):
+        self.kube = kube
+        self.assets = assets
+        self.poll_s = poll_s
+
+    # -- manifest source ----------------------------------------------------
+    def _load_desired(self, app: Application):
+        asset = self.assets.get(app.spec.space, "repository", app.spec.repo)
+        root = Path(asset.path) / app.spec.path
+        if not root.is_dir():
+            raise FileNotFoundError(
+                f"manifest dir {app.spec.path!r} not in repo "
+                f"{app.spec.space}/{app.spec.repo} {asset.version}"
+            )
+        desired = []
+        for f in sorted(root.rglob("*.yaml")):
+            desired.extend(load_manifests(f.read_text()))
+        from ..api.types import ValidationError
+
+        for obj in desired:
+            # target_namespace is the DESTINATION default (the argocd
+            # destination.namespace idea): manifests that name their own
+            # namespace keep it; cluster-scoped kinds (their validate()
+            # rejects any namespace) drop to "".
+            if obj.metadata.namespace == "default":
+                obj.metadata.namespace = app.spec.target_namespace
+            try:
+                obj.validate()
+            except ValidationError as e:
+                if "cluster-scoped" in str(e):
+                    obj.metadata.namespace = ""
+                else:
+                    raise
+            obj.metadata.labels[APP_LABEL] = app.metadata.name
+        return desired, asset.version
+
+    # -- reconcile ----------------------------------------------------------
+    def reconcile(self, req: Request) -> Result:
+        app = self.kube.try_get("Application", req.name, req.namespace)
+        if app is None:
+            return Result()
+        try:
+            outcome = self._sync(app)
+        except Exception as e:
+            app.status.phase = "Error"
+            app.status.message = str(e)[:500]
+            set_condition(app.status.conditions, "Synced", "False",
+                          "SyncError", str(e)[:200])
+            self._put_status(app)
+            return Result(requeue_after=self.poll_s)
+        (app.status.phase, app.status.revision, app.status.applied,
+         app.status.pruned, app.status.drifted) = outcome
+        if app.status.phase == "Synced":
+            app.status.synced_revision = app.status.revision
+            app.status.message = ""
+            set_condition(app.status.conditions, "Synced", "True",
+                          "InSync", f"revision {app.status.revision}")
+        else:
+            set_condition(
+                app.status.conditions, "Synced", "False", "OutOfSync",
+                f"{len(app.status.drifted)} object(s) drifted "
+                "(auto_sync off)",
+            )
+        self._put_status(app)
+        return Result(requeue_after=self.poll_s)
+
+    def _sync(self, app: Application):
+        desired, revision = self._load_desired(app)
+        sel = {APP_LABEL: app.metadata.name}
+        desired_keys = set()
+        drifted: list[str] = []
+        applied = 0
+        for obj in desired:
+            key = (obj.kind, obj.metadata.name, obj.metadata.namespace)
+            desired_keys.add(key)
+            live = self.kube.try_get(
+                obj.kind, obj.metadata.name, obj.metadata.namespace
+            )
+            if live is None:
+                drifted.append(f"{obj.kind}/{obj.metadata.name}")
+                if app.spec.auto_sync:
+                    self.kube.create(obj)
+                    applied += 1
+            elif (
+                _desired_manifest(live) != _desired_manifest(obj)
+                or live.metadata.labels.get(APP_LABEL)
+                != app.metadata.name
+            ):
+                drifted.append(f"{obj.kind}/{obj.metadata.name}")
+                if app.spec.auto_sync:
+                    obj.metadata.resource_version = (
+                        live.metadata.resource_version
+                    )
+                    obj.metadata.creation_timestamp = (
+                        live.metadata.creation_timestamp
+                    )
+                    # Preserve foreign labels; ours wins on conflict.
+                    merged = dict(live.metadata.labels)
+                    merged.update(obj.metadata.labels)
+                    obj.metadata.labels = merged
+                    try:
+                        self.kube.update(obj)
+                    except Conflict:
+                        # Raced a writer: next poll re-diffs.
+                        continue
+                    applied += 1
+        pruned = 0
+        # Ownership is the tracking label, not the namespace: prune scans
+        # every namespace (and keys on namespace too) so a
+        # target_namespace change retires the OLD namespace's copies.
+        for kind in known_kinds():
+            if kind == "Application":
+                continue
+            for live in self.kube.list(kind, label_selector=sel):
+                key = (kind, live.metadata.name, live.metadata.namespace)
+                if key not in desired_keys:
+                    drifted.append(f"{kind}/{live.metadata.name} (pruned)")
+                    if app.spec.auto_sync and app.spec.prune:
+                        try:
+                            self.kube.delete(
+                                kind, live.metadata.name,
+                                live.metadata.namespace,
+                            )
+                        except NotFound:
+                            continue  # raced another deleter: not ours
+                        pruned += 1
+        synced = app.spec.auto_sync or not drifted
+        return (
+            "Synced" if synced else "OutOfSync",
+            revision, applied, pruned, drifted,
+        )
+
+    def sync_now(self, name: str, namespace: str = "default") -> dict:
+        """Manual sync (the argocd `app sync` verb): run one sync with
+        auto_sync forced on, return what changed."""
+        app = self.kube.get("Application", name, namespace)
+        spec_auto = app.spec.auto_sync
+        app.spec.auto_sync = True
+        try:
+            phase, revision, applied, pruned, drifted = self._sync(app)
+        finally:
+            app.spec.auto_sync = spec_auto
+        app.status.phase = "Synced"
+        app.status.revision = revision
+        app.status.synced_revision = revision
+        app.status.applied = applied
+        app.status.pruned = pruned
+        app.status.drifted = []
+        self._put_status(app)
+        return {"revision": revision, "applied": applied, "pruned": pruned,
+                "drifted": drifted}
+
+    def _put_status(self, app: Application) -> None:
+        try:
+            self.kube.update_status(app)
+        except (Conflict, NotFound):
+            pass  # next poll writes a fresh diff

@@ -40,17 +40,21 @@ from pathlib import Path
 
 
 def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
-                       keep_finished: int = 20, devenv: bool = False):
+                       keep_finished: int = 20, devenv: bool = False,
+                       assets=None):
     """The platform's controller set on *kube* — THE single wiring,
     shared by the in-cluster controller role and the CLI's local
     platform (cli/platform_local.py) so the two cannot drift.
 
+    ``assets``: an AssetStore — enables the GitOps reconciler
+    (pull-based Application sync needs the repository assets).
     Returns (manager, storage_provisioner); the caller may add device
     capacity to ``storage.pools`` before ``mgr.start()``."""
     from ..cloud.fake_cloudtpu import FakeCloudTpu, cloudtpu_client_factory
     from ..controller.manager import Manager
     from ..operators import (
         DevEnvReconciler,
+        GitOpsReconciler,
         ResourceGC,
         SliceAutoscaler,
         TpuPodSliceReconciler,
@@ -78,6 +82,8 @@ def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
     mgr.register("PersistentVolumeClaim", storage)
     if devenv:
         mgr.register("DevEnv", DevEnvReconciler(kube))
+    if assets is not None:
+        mgr.register("Application", GitOpsReconciler(kube, assets))
     # GC watches '*': any kind's churn triggers a sweep; the in-reconciler
     # debounce collapses the startup replay storm to one sweep.
     mgr.register(
@@ -136,7 +142,7 @@ def build_operator(role: str, kube=None, port: int = 0,
             stop=lambda: (server.stop(), _save_kube(kube, state_dir)),
         )
     elif role == "controller":
-        mgr, _ = controller_manager(kube)
+        mgr, _ = controller_manager(kube, assets=_asset_store())
         parts.update(
             mgr=mgr,
             start=lambda: mgr.start(),

@@ -1,0 +1,181 @@
+"""ArgoCD-style GitOps (operators/gitops.py): pull-based sync of an
+Application's repo manifests — apply on drift, prune on removal, manual
+mode — the reference's optional pull alternative to its push-mode CI
+deploy (GPU调度平台搭建.md:792-794)."""
+
+import time
+
+import pytest
+
+from k8s_gpu_tpu.api.gitops import Application
+from k8s_gpu_tpu.api.types import ValidationError
+from k8s_gpu_tpu.controller.kubefake import FakeKube
+from k8s_gpu_tpu.controller.manager import Manager
+from k8s_gpu_tpu.operators.gitops import APP_LABEL, GitOpsReconciler
+from k8s_gpu_tpu.platform.assets import AssetStore
+
+SECRET = """\
+apiVersion: v1
+kind: Secret
+metadata:
+  name: app-config
+data:
+  mode: fast
+"""
+
+# Cluster-scoped kind: proves the validation-driven namespace fallback.
+QUEUE = """\
+apiVersion: scheduling.tpu.k8sgpu.dev/v1alpha1
+kind: SchedulingQueue
+metadata:
+  name: team-queue
+spec:
+  capTpu: 8
+"""
+
+
+def _repo(tmp_path, files: dict) -> str:
+    src = tmp_path / f"src-{time.monotonic_ns()}"
+    (src / "manifests").mkdir(parents=True)
+    for name, text in files.items():
+        (src / "manifests" / name).write_text(text)
+    return str(src)
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    kube = FakeKube()
+    store = AssetStore(tmp_path / "assets")
+    rec = GitOpsReconciler(kube, store, poll_s=0.05)
+    mgr = Manager(kube)
+    mgr.register("Application", rec)
+    mgr.start()
+    yield kube, store, rec, tmp_path
+    mgr.stop()
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _app(name="demo", **spec_kw) -> Application:
+    app = Application()
+    app.metadata.name = name
+    app.spec.repo = spec_kw.pop("repo", "cfg")
+    for k, v in spec_kw.items():
+        setattr(app.spec, k, v)
+    return app
+
+
+def test_sync_applies_and_tracks_revision(rig):
+    kube, store, rec, tmp = rig
+    store.import_path("default", "repository", "cfg",
+                      _repo(tmp, {"a.yaml": SECRET, "b.yaml": QUEUE}))
+    kube.create(_app())
+    assert _wait(lambda: kube.try_get("Secret", "app-config") is not None)
+    assert _wait(lambda: kube.try_get("SchedulingQueue", "team-queue", "")
+                 is not None)
+    sec = kube.get("Secret", "app-config")
+    assert sec.metadata.labels[APP_LABEL] == "demo"
+    assert sec.data["mode"] == "fast"
+    assert _wait(
+        lambda: kube.get("Application", "demo").status.phase == "Synced"
+    )
+    assert kube.get("Application", "demo").status.revision == "v1"
+
+
+def test_drift_is_reverted(rig):
+    """A hand-edited managed object converges back to git (the GitOps
+    self-heal contract)."""
+    kube, store, rec, tmp = rig
+    store.import_path("default", "repository", "cfg",
+                      _repo(tmp, {"a.yaml": SECRET}))
+    kube.create(_app())
+    assert _wait(lambda: kube.try_get("Secret", "app-config") is not None)
+    sec = kube.get("Secret", "app-config")
+    sec.data["mode"] = "slow"  # kubectl edit
+    kube.update(sec)
+    assert _wait(
+        lambda: kube.get("Secret", "app-config").data["mode"] == "fast"
+    )
+
+
+def test_git_update_rolls_forward_and_prunes(rig):
+    """A new repo revision changes one object and drops another: the
+    change applies, the orphan prunes (ownership = tracking label)."""
+    kube, store, rec, tmp = rig
+    store.import_path("default", "repository", "cfg",
+                      _repo(tmp, {"a.yaml": SECRET, "b.yaml": QUEUE}))
+    kube.create(_app())
+    assert _wait(lambda: kube.try_get("SchedulingQueue", "team-queue", "")
+                 is not None)
+    store.import_path(
+        "default", "repository", "cfg",
+        _repo(tmp, {"a.yaml": SECRET.replace("fast", "careful")}),
+    )
+    assert _wait(
+        lambda: kube.get("Secret", "app-config").data["mode"] == "careful"
+    )
+    assert _wait(
+        lambda: kube.try_get("SchedulingQueue", "team-queue", "") is None
+    )
+    app = kube.get("Application", "demo")
+    assert app.status.synced_revision == "v2"
+
+
+def test_unmanaged_objects_never_pruned(rig):
+    """Prune only touches app-labeled objects — a foreign Secret in the
+    same namespace is invisible to the app."""
+    from k8s_gpu_tpu.api.core import Secret
+
+    kube, store, rec, tmp = rig
+    foreign = Secret()
+    foreign.metadata.name = "unrelated"
+    kube.create(foreign)
+    store.import_path("default", "repository", "cfg",
+                      _repo(tmp, {"a.yaml": SECRET}))
+    kube.create(_app())
+    assert _wait(
+        lambda: kube.get("Application", "demo").status.phase == "Synced"
+    )
+    assert kube.try_get("Secret", "unrelated") is not None
+
+
+def test_manual_mode_reports_then_sync_now_applies(rig):
+    kube, store, rec, tmp = rig
+    store.import_path("default", "repository", "cfg",
+                      _repo(tmp, {"a.yaml": SECRET}))
+    kube.create(_app(auto_sync=False))
+    assert _wait(
+        lambda: kube.get("Application", "demo").status.phase == "OutOfSync"
+    )
+    assert kube.try_get("Secret", "app-config") is None
+    assert "Secret/app-config" in kube.get(
+        "Application", "demo"
+    ).status.drifted
+    out = rec.sync_now("demo")
+    assert out["applied"] == 1 and out["revision"] == "v1"
+    assert kube.try_get("Secret", "app-config") is not None
+    assert _wait(
+        lambda: kube.get("Application", "demo").status.phase == "Synced"
+    )
+
+
+def test_missing_repo_reports_error(rig):
+    kube, store, rec, tmp = rig
+    kube.create(_app(repo="nope"))
+    assert _wait(
+        lambda: kube.get("Application", "demo").status.phase == "Error"
+    )
+
+
+def test_application_validation():
+    with pytest.raises(ValidationError, match="spec.repo"):
+        FakeKube().create(_app(repo=""))
+    with pytest.raises(ValidationError, match="relative"):
+        FakeKube().create(_app(path="../escape"))
