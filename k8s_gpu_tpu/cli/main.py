@@ -805,13 +805,30 @@ def cmd_serve(args) -> int:
     workload; the role the reference's platform schedules for the
     Fin-Agent service, 智能风控解决方案.md:368-419)."""
     ctx = _require_login(CliConfig.load())
+    if args.constraint and args.draft:
+        # Knowable from flags alone — fail as a usage error BEFORE
+        # loading two bundles and compiling a vocab-wide DFA bank
+        # (batcher.__init__ documents why the combination can't exist).
+        print("--constraint and --draft cannot be combined: the DFA "
+              "advances through the accepted prefix, which only exists "
+              "after the speculative verify", file=sys.stderr)
+        return 2
     p = LocalPlatform()
+    draft = None
     try:
         from ..serve.bundle import load_servable
 
         model, params, tok = load_servable(
             p.assets, ctx.space, args.model, args.version
         )
+        if args.draft:
+            # Speculative serving: the draft is its own servable bundle
+            # (typically distill_draft's output exported beside the
+            # target); vocab compatibility is checked by the batcher.
+            dmodel, dparams, _ = load_servable(
+                p.assets, ctx.space, args.draft, ""
+            )
+            draft = (dmodel, dparams)
     except (KeyError, ValueError) as e:
         # KeyError str() wraps the message in repr quotes; args[0] is clean.
         print(e.args[0] if e.args else str(e), file=sys.stderr)
@@ -844,6 +861,7 @@ def cmd_serve(args) -> int:
             model, params, tok, port=args.port, slots=args.slots,
             constraints=constraints or None,
             eos_id=args.eos_id,
+            draft=draft, kv_quant=args.kv_quant,
         ).start()
     except ValueError as e:  # bad regex / vocab mismatch: clean exit
         print(str(e), file=sys.stderr)
@@ -1050,6 +1068,11 @@ def build_parser() -> argparse.ArgumentParser:
                             "requests opt in with {'constraint': NAME}")
     p_srv.add_argument("--eos-id", type=int, default=-1,
                        help="EOS token id (set when using constraints)")
+    p_srv.add_argument("--draft", default="",
+                       help="draft model asset id: speculative decoding "
+                            "in the batcher's shared rounds")
+    p_srv.add_argument("--kv-quant", action="store_true",
+                       help="int8 KV cache (~1.9x slot capacity)")
     p_srv.add_argument("--for-seconds", type=float, default=0.0,
                        help="exit after N seconds (0 = until interrupted)")
     p_srv.set_defaults(fn=cmd_serve)

@@ -349,6 +349,53 @@ def test_serve_model_asset(capsys, tmp_path):
     assert code == 1 and "no asset" in err
 
 
+def test_serve_with_draft_and_kv_quant(capsys, tmp_path):
+    """`serve --draft <asset> --kv-quant`: speculative rounds + int8 KV
+    from the CLI — both bundles load from the asset store."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.cli.platform_local import LocalPlatform
+    from k8s_gpu_tpu.data.tokenizer import BpeTokenizer
+    from k8s_gpu_tpu.models.transformer import (
+        TransformerConfig, TransformerLM,
+    )
+    from k8s_gpu_tpu.serve import export_servable
+
+    run(capsys, "login", "--user", "ada", "--space", "ml")
+    cfg = TransformerConfig(
+        vocab_size=300, d_model=32, n_layers=2, n_heads=2, d_head=16,
+        d_ff=64, max_seq=64, dtype=jnp.float32, use_flash=False,
+        remat=False,
+    )
+    model = TransformerLM(cfg)
+    draft = TransformerLM(dataclasses.replace(cfg, n_layers=1))
+    tok = BpeTokenizer.train("tiny corpus for serving " * 30,
+                             vocab_size=280, backend="python")
+    p = LocalPlatform()
+    try:
+        export_servable(p.assets, "ml", "spec-lm", model,
+                        model.init(jax.random.PRNGKey(0)), tokenizer=tok)
+        export_servable(p.assets, "ml", "spec-draft", draft,
+                        draft.init(jax.random.PRNGKey(1)), tokenizer=tok)
+    finally:
+        p.close()
+
+    code, out, err = run(
+        capsys, "serve", "spec-lm", "--draft", "spec-draft", "--kv-quant",
+        "--for-seconds", "0.3",
+    )
+    assert code == 0, err
+    assert "serving ml/model/spec-lm" in out
+    code, _, err = run(
+        capsys, "serve", "spec-lm", "--draft", "missing-draft",
+        "--for-seconds", "0.1",
+    )
+    assert code == 1 and "no asset" in err
+
+
 def test_serve_with_constraints(capsys, tmp_path):
     """--constraint name=regex stands the server up with a compiled
     bank; malformed specs and bad patterns exit cleanly."""
