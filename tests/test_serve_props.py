@@ -102,6 +102,57 @@ def test_random_mixes_match_oracles(batcher, reqs):
         assert got == _oracle(ids, n, adapter), (ids, n, adapter)
 
 
+_DRAFT_MODEL = TransformerLM(
+    TransformerConfig(
+        vocab_size=64, d_model=24, n_layers=1, n_heads=2, d_head=12,
+        d_ff=48, max_seq=48, use_flash=False, dtype=jnp.float32,
+    )
+)
+_DRAFT_PARAMS = _DRAFT_MODEL.init(jax.random.PRNGKey(9))
+
+
+@pytest.fixture(scope="module")
+def spec_batcher():
+    # Random-init draft: worst-case acceptance, so every accepted token
+    # REALLY had to match the target argmax (VERDICT r3 ask #2's
+    # "greedy bit-exactness preserved under interleaving").
+    b = ContinuousBatcher(
+        _MODEL, _PARAMS, slots=3, draft=(_DRAFT_MODEL, _DRAFT_PARAMS),
+        spec_k=2,
+    ).start()
+    b.precache_prefix([7, 3, 11])
+    yield b
+    b.stop()
+
+
+spec_req_strategy = st.fixed_dictionaries({
+    "prefix_hit": st.booleans(),
+    "extra": st.lists(st.integers(1, 60), min_size=1, max_size=6),
+    "max_new": st.integers(1, 6),
+})
+
+
+@settings(max_examples=15, deadline=None,
+          suppress_health_check=[HealthCheck.function_scoped_fixture])
+@given(reqs=st.lists(spec_req_strategy, min_size=2, max_size=6))
+def test_spec_random_mixes_stay_greedy_exact(spec_batcher, reqs):
+    """Speculative rounds under random interleavings (mixed prefix-hit /
+    cold admissions, random budgets): every stream must equal the plain
+    greedy oracle bit-for-bit — acceptance variance across co-tenants
+    changes round shapes, never tokens."""
+    handles = []
+    for r in reqs:
+        ids = ([7, 3, 11] + r["extra"]) if r["prefix_hit"] else r["extra"]
+        handles.append((
+            ids, r["max_new"],
+            spec_batcher.submit(ids, max_new_tokens=r["max_new"]),
+        ))
+    for ids, n, h in handles:
+        got = h.result()
+        assert not h.aborted
+        assert got == _oracle(ids, n, None), (ids, n)
+
+
 @settings(max_examples=8, deadline=None)
 @given(n_reqs=st.integers(1, 4), stop_after=st.integers(0, 3))
 def test_stop_race_never_hangs(n_reqs, stop_after):
