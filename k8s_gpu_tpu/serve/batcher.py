@@ -339,7 +339,8 @@ class ContinuousBatcher:
         # amortize dispatch overhead (see _round_dev docstring).
         self.solo_steps = 4 * self.steps_per_round
         self._round_spec_jit = jax.jit(
-            self._round_spec_dev, donate_argnums=(2,), static_argnums=(4,)
+            self._round_spec_dev, donate_argnums=(2,),
+            static_argnums=(4, 5),
         )
         self._admit_prefix_jit = jax.jit(
             self._admit_prefix_dev, donate_argnums=(1,)
@@ -606,7 +607,8 @@ class ContinuousBatcher:
             "aidx": dev["aidx"], "cidx": dev["cidx"], "cstate": cstate,
         }, (toks, lps)
 
-    def _round_spec_dev(self, params, dparams, dev, bank, use_top_p):
+    def _round_spec_dev(self, params, dparams, dev, bank, use_top_p,
+                        n_rounds):
         """Speculative scheduler round(s): ``spec_rounds`` × (K draft
         steps + ONE target verify over every slot's own window, via
         engine.extend_multi's per-row window writes).  Returns
@@ -716,7 +718,7 @@ class ContinuousBatcher:
                 one,
                 (dev["cache"], dev["d_cache"], dev["token"], dev["prev"],
                  dev["pos"], dev["rope"], dev["keys"]),
-                length=self.spec_rounds,
+                length=n_rounds,
             )
         )
         out = dict(dev)
@@ -1039,14 +1041,17 @@ class ContinuousBatcher:
         use_top_p = any(
             r is not None and 0.0 < r.top_p < 1.0 for r in self._active
         )
+        solo = len(live) == 1 and self._pending.empty()
         if self.draft_engine is not None:
+            # Same solo amortization as the plain path: a lone stream's
+            # verify rounds batch 4x per dispatch.
             self._dev, (toks, ns, lps) = self._round_spec_jit(
                 self.params, self.draft_params, self._dev,
                 self.bank.banked, use_top_p,
+                4 * self.spec_rounds if solo else self.spec_rounds,
             )
             self._round_count += 1
             return ("spec", self._round_count, live, toks, ns, lps)
-        solo = len(live) == 1 and self._pending.empty()
         self._dev, (toks, lps) = self._round_jit(
             self.params, self._dev, self.bank.banked,
             self.cbank.banked if self.cbank else None,
