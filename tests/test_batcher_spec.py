@@ -199,6 +199,30 @@ def test_distilled_draft_beats_random(setup):
     assert acc_dist > 0.0
 
 
+def test_spec_with_moe_target(setup):
+    """MoE target: the verify's full-capacity expert routing must match
+    width-1 decode routing exactly (extend_multi's moe_full_capacity),
+    or greedy exactness would break — this is the test that pins it
+    inside the BATCHER's spec rounds."""
+    cfg = dataclasses.replace(TINY, num_experts=4, d_ff=64)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(5))
+    plain = ContinuousBatcher(model, params, slots=2).start()
+    try:
+        want = plain.submit([5, 9, 17], max_new_tokens=8).result()
+    finally:
+        plain.stop()
+    spec = ContinuousBatcher(
+        model, params, slots=2, draft=(model, params), spec_k=3,
+    ).start()
+    try:
+        got = spec.submit([5, 9, 17], max_new_tokens=8).result()
+        assert got == want, (got, want)
+        assert spec.spec_stats["acceptance"] > 0.5
+    finally:
+        spec.stop()
+
+
 def test_constraints_plus_draft_rejected(setup):
     model, params, draft_model, draft_params = setup
     from k8s_gpu_tpu.serve.constrain import ConstraintBank
