@@ -365,20 +365,39 @@ class SpeculativeDecoder:
 
 def distill_draft(target_model, tparams, draft_cfg=None, *, steps: int = 200,
                   batch: int = 8, seq_len: int = 64, lr: float = 3e-3,
-                  key=None):
+                  key=None, data_temperature: float = 1.0,
+                  hard_labels: bool = False, prompts=None):
     """Distill a small draft LM from a target — the trained-draft path
     that turns speculative acceptance from a projection into a measured
     number (the random-init draft accepts ~0 of its proposals).
 
-    Training data is the TARGET'S OWN samples (temperature-1 ancestral
-    sequences from random 2-token prompts) — acceptance is measured on
-    decode-time streams, so the draft must fit the target's output
-    distribution, not some external corpus.  The loss is the standard
-    distillation KL(p_target ‖ p_draft) per position.
+    Training data is the TARGET'S OWN samples (ancestral sequences at
+    ``data_temperature`` from random 2-token prompts) — acceptance is
+    measured on decode-time streams, so the draft must fit the target's
+    output behavior, not some external corpus.  Two losses for the two
+    serving modes:
+
+    - ``hard_labels=False`` (default): KL(p_target ‖ p_draft) — fits
+      the full distribution, which is what SAMPLED spec's rejection
+      ratio min(1, p/q) rewards (acceptance ≈ exp(-KL) per token).
+    - ``hard_labels=True`` + ``data_temperature=0.0``: cross-entropy
+      against the target's ARGMAX on its own greedy trajectories —
+      GREEDY spec accepts iff the argmaxes agree, and a diffuse target
+      (early training) can have tiny KL yet near-zero argmax agreement,
+      so greedy serving distills against the argmax function itself,
+      on-policy.
+
+    ``prompts`` [B, P] int32: distill on THESE prompts' trajectories
+    instead of random ones (overrides ``batch`` — the row count is
+    prompts.shape[0]) — on-policy distillation on the serving prompt
+    distribution, the deployment-realistic setup (production spec
+    drafts are distilled on production traffic).  Matters most for
+    barely-trained targets, whose argmax function doesn't generalize
+    across prefixes for ANY draft.
 
     ``draft_cfg`` defaults to the target shrunk to 2 layers at half
     width — a ~10× cheaper forward.  Returns (draft_model, dparams,
-    final_kl)."""
+    final_loss)."""
     import dataclasses
 
     import optax
@@ -401,13 +420,19 @@ def distill_draft(target_model, tparams, draft_cfg=None, *, steps: int = 200,
     # Sample the training stream from the target once (one engine
     # generate per distillation — the samples are reused every step;
     # fitting a tiny draft needs distribution coverage, not fresh data).
+    if prompts is None:
+        prompts = jax.random.randint(
+            k_data, (batch, 2), 1, cfg.vocab_size, jnp.int32
+        )
+    prompts = jnp.asarray(prompts, jnp.int32)
+    P = prompts.shape[1]
+    if P >= seq_len:
+        raise ValueError(f"prompts ({P}) must be shorter than seq_len "
+                         f"({seq_len})")
     eng = InferenceEngine(target_model, max_seq=max(seq_len + 4, 16))
-    prompts = jax.random.randint(
-        k_data, (batch, 2), 1, cfg.vocab_size, jnp.int32
-    )
     gen = eng.generate(
-        tparams, prompts, max_new_tokens=seq_len - 2,
-        sampling=SamplingConfig(temperature=1.0),
+        tparams, prompts, max_new_tokens=seq_len - P,
+        sampling=SamplingConfig(temperature=data_temperature),
         key=jax.random.fold_in(k_data, 1),
     )
     seqs = jnp.concatenate([prompts, gen.tokens], axis=1)  # [B, seq_len]
@@ -415,16 +440,26 @@ def distill_draft(target_model, tparams, draft_cfg=None, *, steps: int = 200,
     opt = optax.adamw(lr)
     ost = opt.init(dparams)
     # Target labels once, outside the loop: the sequences are fixed, the
-    # target is the expensive side, and no grad flows through it.
+    # target is the expensive side, and no grad flows through it.  Only
+    # the branch in use materializes — the other would hold full [B,S,V]
+    # f32 arrays alive in the jitted closure for nothing.
     tlogits, _ = jax.jit(target_model.forward)(tparams, seqs)
-    pt = jax.nn.softmax(tlogits.astype(jnp.float32), axis=-1)
-    lp = jax.nn.log_softmax(tlogits.astype(jnp.float32), axis=-1)
+    if hard_labels:
+        labels = jnp.argmax(tlogits, axis=-1)
+    else:
+        pt = jax.nn.softmax(tlogits.astype(jnp.float32), axis=-1)
+        lp = jax.nn.log_softmax(tlogits.astype(jnp.float32), axis=-1)
+    del tlogits
 
     @jax.jit
     def step(dparams, ost):
         def loss_fn(dp):
             dlogits, _ = draft_model.forward(dp, seqs)
             lq = jax.nn.log_softmax(dlogits.astype(jnp.float32), axis=-1)
+            if hard_labels:
+                return -jnp.mean(
+                    jnp.take_along_axis(lq, labels[..., None], -1)
+                )
             return jnp.mean(jnp.sum(pt * (lp - lq), axis=-1))
 
         kl, grads = jax.value_and_grad(loss_fn)(dparams)

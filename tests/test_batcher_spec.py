@@ -199,6 +199,33 @@ def test_distilled_draft_beats_random(setup):
     assert acc_dist > 0.0
 
 
+def test_onpolicy_hard_label_distill_high_acceptance(setup):
+    """Hard-label distillation on the serving prompts' own greedy
+    trajectories (the production-traffic setup): measured acceptance on
+    that workload must be high even for a weak target whose argmax
+    function doesn't generalize — greedy spec accepts iff argmaxes
+    agree, and on-policy hard labels train exactly that."""
+    import jax.numpy as jnp
+
+    model, params, _, _ = setup
+    ids = [5, 9, 17]
+    prompts = jnp.asarray(ids, jnp.int32)[None]  # greedy: 1 row suffices
+    dm, dp, loss = distill_draft(
+        model, params, steps=200, seq_len=32,
+        key=jax.random.PRNGKey(2), data_temperature=0.0,
+        hard_labels=True, prompts=prompts,
+    )
+    b = ContinuousBatcher(
+        model, params, slots=2, draft=(dm, dp), spec_k=3,
+    ).start()
+    try:
+        got = b.submit(ids, max_new_tokens=12).result()
+        assert got == _reference_greedy(model, params, ids, 12)
+        assert b.spec_stats["acceptance"] > 0.5, (b.spec_stats, loss)
+    finally:
+        b.stop()
+
+
 def test_spec_with_moe_target(setup):
     """MoE target: the verify's full-capacity expert routing must match
     width-1 decode routing exactly (extend_multi's moe_full_capacity),
