@@ -410,7 +410,13 @@ def flash_attention_lse(
             # 1-wide kernel.
             return reference_attention_lse(q, k, v, causal)
     bq, bk = min(block_q, s), min(block_k, s)
-    if s % bq != 0 or s % bk != 0:
+    # Blocks must also respect the TPU vector tiling (sublane 16 for
+    # bf16, 8 for f32) — clamping a pinned block to an odd S (e.g. 512
+    # clamped to 65) divides evenly yet makes Mosaic reject the kernel
+    # ("index in dimension 1 is not a multiple of 8").
+    tile = 16 if q.dtype == jnp.bfloat16 else 8
+    if (s % bq != 0 or s % bk != 0
+            or bq % tile != 0 or bk % tile != 0):
         return reference_attention_lse(q, k, v, causal)
     if interpret is None:
         interpret = _auto_interpret()

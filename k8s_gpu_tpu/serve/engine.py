@@ -206,7 +206,7 @@ class InferenceEngine:
         return o.reshape(B, Sq, H, cfg.d_head)
 
     @staticmethod
-    def _cache_store(arr, val, start, sq):
+    def _cache_store(arr, val, start, sq, layer=None):
         """Write ``val`` [B, KH, Sq, *rest] into ``arr`` [B, KH, T, *rest]
         at ``start`` — the single owner of the three write geometries
         (rank-generic so int8 values and their rank-3 scales share it):
@@ -214,24 +214,54 @@ class InferenceEngine:
         - scalar start: all rows at one offset (prefill, uniform decode);
         - [B] start, Sq == 1: per-row scatter (continuous batching);
         - [B] start, Sq == W: per-row window (the extend_multi verify;
-          out-of-range garbage-row writes drop by scatter semantics)."""
+          out-of-range garbage-row writes drop by scatter semantics).
+
+        ``layer`` (static int): ``arr`` is the full stacked
+        [L, B, KH, T, *rest] cache and the write lands at arr[layer] —
+        the unrolled-decode path scatters straight into the big buffer so
+        XLA updates it in place.  The layer-scan path would instead copy
+        the whole cache through the scan's stacked-output buffer every
+        decode step (~1 GB/step on the flagship pool — measured 10 ms vs
+        2 ms per step on v5e)."""
+        if layer is None:
+            if jnp.ndim(start) == 0:
+                idx = (0, 0, start) + (0,) * (arr.ndim - 3)
+                return jax.lax.dynamic_update_slice(
+                    arr, val.astype(arr.dtype), idx
+                )
+            if sq == 1:
+                rows = jnp.arange(arr.shape[0])
+                return arr.at[rows, :, start].set(
+                    val[:, :, 0].astype(arr.dtype)
+                )
+            B, W = val.shape[0], sq
+            rows = jnp.arange(B)[:, None]                   # [B, 1]
+            cols = start[:, None] + jnp.arange(W)[None]     # [B, W]
+            # Advanced indices split by the ':' slice put the [B, W] index
+            # dims first, so the update takes [B, W, KH, ...] layout.
+            return arr.at[rows, :, cols].set(
+                jnp.moveaxis(val, 2, 1).astype(arr.dtype)
+            )
         if jnp.ndim(start) == 0:
-            idx = (0, 0, start) + (0,) * (arr.ndim - 3)
-            return jax.lax.dynamic_update_slice(arr, val.astype(arr.dtype), idx)
+            idx = (layer, 0, 0, start) + (0,) * (arr.ndim - 4)
+            return jax.lax.dynamic_update_slice(
+                arr, val[None].astype(arr.dtype), idx
+            )
         if sq == 1:
-            rows = jnp.arange(arr.shape[0])
-            return arr.at[rows, :, start].set(val[:, :, 0].astype(arr.dtype))
+            rows = jnp.arange(arr.shape[1])
+            return arr.at[layer, rows, :, start].set(
+                val[:, :, 0].astype(arr.dtype)
+            )
         B, W = val.shape[0], sq
         rows = jnp.arange(B)[:, None]                       # [B, 1]
         cols = start[:, None] + jnp.arange(W)[None]         # [B, W]
-        # Advanced indices split by the ':' slice put the [B, W] index
-        # dims first, so the update takes [B, W, KH, ...] layout.
-        return arr.at[rows, :, cols].set(
+        return arr.at[layer, rows, :, cols].set(
             jnp.moveaxis(val, 2, 1).astype(arr.dtype)
         )
 
     def _block_cached(self, x, lp, lc, positions, start, mask,
-                      moe_full_capacity=None, lp_ad=None, adapter_idx=None):
+                      moe_full_capacity=None, lp_ad=None, adapter_idx=None,
+                      layer=None):
         """One transformer block over query slice x [B,Sq,D] with the K/V for
         the slice written into the layer cache ``lc`` (k/v [+ k_s/v_s
         when kv_quant]) at ``start``.  Returns (x_out, new_lc).
@@ -242,7 +272,12 @@ class InferenceEngine:
 
         ``moe_full_capacity``: None = full capacity only at Sq == 1 (the
         decode default); extend_multi passes True so a W-wide verify
-        routes experts exactly like the width-1 decode it stands in for."""
+        routes experts exactly like the width-1 decode it stands in for.
+
+        ``layer`` (static int, unrolled-decode path): ``lc`` holds the
+        FULL stacked [L, ...] cache arrays; writes scatter into
+        lc[...][layer] in place and attention reads the [layer] slice —
+        see _cache_store for why this beats the layer scan at decode."""
         m = self.model
         dt = self.cfg.dtype
         h = m._rmsnorm(x, lp["ln1"])
@@ -270,16 +305,30 @@ class InferenceEngine:
         if self.kv_quant:
             kq, ks = _quantize_kv(k)
             vq, vs = _quantize_kv(v)
-            lc["k"] = self._cache_store(lc["k"], kq, start, sq)
-            lc["v"] = self._cache_store(lc["v"], vq, start, sq)
-            lc["k_s"] = self._cache_store(lc["k_s"], ks, start, sq)
-            lc["v_s"] = self._cache_store(lc["v_s"], vs, start, sq)
+            lc["k"] = self._cache_store(lc["k"], kq, start, sq, layer)
+            lc["v"] = self._cache_store(lc["v"], vq, start, sq, layer)
+            lc["k_s"] = self._cache_store(lc["k_s"], ks, start, sq, layer)
+            lc["v_s"] = self._cache_store(lc["v_s"], vs, start, sq, layer)
         else:
-            lc["k"] = self._cache_store(lc["k"], k, start, sq)
-            lc["v"] = self._cache_store(lc["v"], v, start, sq)
+            lc["k"] = self._cache_store(lc["k"], k, start, sq, layer)
+            lc["v"] = self._cache_store(lc["v"], v, start, sq, layer)
+        # The mask's trailing dim is the attention-read bound (t_hi): the
+        # cache READ shrinks to it while writes target the full buffer —
+        # a decode step at position ~50 streams 256 slots, not max_seq.
+        T_eff = mask.shape[-1]
+        if layer is None:
+            k_read = lc["k"][:, :, :T_eff]
+            v_read = lc["v"][:, :, :T_eff]
+            ks_read = lc["k_s"][:, :, :T_eff] if "k_s" in lc else None
+            vs_read = lc["v_s"][:, :, :T_eff] if "v_s" in lc else None
+        else:
+            k_read = lc["k"][layer, :, :, :T_eff]
+            v_read = lc["v"][layer, :, :, :T_eff]
+            ks_read = lc["k_s"][layer, :, :, :T_eff] if "k_s" in lc else None
+            vs_read = lc["v_s"][layer, :, :, :T_eff] if "v_s" in lc else None
         o = self._attend_cached(
-            q, lc["k"], lc["v"], mask,
-            k_scale=lc.get("k_s"), v_scale=lc.get("v_s"),
+            q, k_read, v_read, mask,
+            k_scale=ks_read, v_scale=vs_read,
         )
         attn_out = jnp.einsum("bshk,hkd->bsd", o, wt(lp["wo"], dt))
         if lp_ad is not None and "wo" in lp_ad:
@@ -308,7 +357,30 @@ class InferenceEngine:
         return x, lc
 
     def _run_blocks(self, params, x, cache, positions, start, mask,
-                    moe_full_capacity=None, adapters=None, adapter_idx=None):
+                    moe_full_capacity=None, adapters=None, adapter_idx=None,
+                    unroll_layers=False):
+        """``unroll_layers``: decode paths set True — a Python loop over
+        layers scatters each K/V write straight into the stacked cache
+        (in-place under XLA aliasing), where the layer scan would round-
+        trip the whole pool cache through the scan's stacked-output
+        buffer every step.  Prefill keeps the scan: its program is large
+        (full-sequence attention per block) and one traced block keeps
+        compile time O(1) in depth, while its per-call cache copy is
+        amortized over the whole prompt."""
+        if unroll_layers:
+            new_cache = cache
+            for l in range(self.cfg.n_layers):
+                lp = jax.tree.map(lambda a: a[l], params["blocks"])
+                lp_ad = (
+                    jax.tree.map(lambda a: a[l], adapters)
+                    if adapters is not None else None
+                )
+                x, new_cache = self._block_cached(
+                    x, lp, new_cache, positions, start, mask,
+                    moe_full_capacity=moe_full_capacity,
+                    lp_ad=lp_ad, adapter_idx=adapter_idx, layer=l,
+                )
+            return self._head(params, x), new_cache
         if adapters is None:
             def scan_fn(carry, layer):
                 lp, lc = layer
@@ -331,10 +403,16 @@ class InferenceEngine:
 
             xs = (params["blocks"], cache, adapters)
         x, new_cache = jax.lax.scan(scan_fn, x, xs)
-        m = self.model
-        x = m._rmsnorm(x, params["final_norm"])
-        logits = jnp.einsum("bsd,dv->bsv", x, wt(params["head"], self.cfg.dtype))
-        return logits.astype(jnp.float32), new_cache
+        return self._head(params, x), new_cache
+
+    def _head(self, params, x):
+        """Shared epilogue for both _run_blocks paths: final RMSNorm +
+        vocabulary projection in f32."""
+        x = self.model._rmsnorm(x, params["final_norm"])
+        logits = jnp.einsum(
+            "bsd,dv->bsv", x, wt(params["head"], self.cfg.dtype)
+        )
+        return logits.astype(jnp.float32)
 
     # -- public jittable pieces -------------------------------------------
     def prefill(self, params, tokens, pad_left=0, adapters=None,
@@ -355,13 +433,15 @@ class InferenceEngine:
         x = emb_lookup(params["embed"], tokens, self.cfg.dtype)
         q_idx = jnp.arange(S)
         positions = jnp.maximum(q_idx - pad_left, 0)  # RoPE positions
-        t = jnp.arange(self.max_seq)
+        # Attention reads only the first S cache slots (the mask width is
+        # the read bound — _block_cached): prompt K/V land at [0, S) and
+        # the rest of the max_seq cache is untouched zeros.
+        t = jnp.arange(S)
         mask = (
             (t[None, :] <= q_idx[:, None])
-            & (t[None, :] < S)
             & (t[None, :] >= pad_left)
         )
-        mask = jnp.broadcast_to(mask, (B, S, self.max_seq))
+        mask = jnp.broadcast_to(mask, (B, S, S))
         logits, cache = self._run_blocks(
             params, x, cache, positions, 0, mask,
             adapters=adapters, adapter_idx=adapter_idx,
@@ -369,49 +449,61 @@ class InferenceEngine:
         return cache, logits[:, -1]
 
     def decode_step(self, params, cache, pos, token, rope_pos=None,
-                    kv_start=0):
+                    kv_start=0, t_hi=None):
         """token [B] at cache position pos (scalar) → (cache, logits [B,V]).
         ``rope_pos`` is the rotary position (defaults to pos; differs when
         the prompt was left-padded); ``kv_start`` masks cache slots below it.
+        ``t_hi`` (static): attention-read bound — generate passes
+        S + max_new_tokens so a short generation never streams the full
+        max_seq cache per step.
         """
         B = token.shape[0]
         x = emb_lookup(params["embed"], token, self.cfg.dtype)[:, None]  # [B,1,D]
         pos = jnp.asarray(pos, jnp.int32).reshape(())
         rope = pos if rope_pos is None else jnp.asarray(rope_pos, jnp.int32).reshape(())
         kv_start = jnp.asarray(kv_start, jnp.int32)
-        t = jnp.arange(self.max_seq)
+        T = t_hi if t_hi is not None else self.max_seq
+        t = jnp.arange(T)
         mask = jnp.broadcast_to(
-            ((t <= pos) & (t >= kv_start))[None, None], (B, 1, self.max_seq)
+            ((t <= pos) & (t >= kv_start))[None, None], (B, 1, T)
         )
         logits, cache = self._run_blocks(
-            params, x, cache, rope[None], pos, mask
+            params, x, cache, rope[None], pos, mask, unroll_layers=True
         )
         return cache, logits[:, 0]
 
     def decode_step_multi(self, params, cache, token, pos, rope_pos,
-                          kv_start, adapters=None, adapter_idx=None):
+                          kv_start, adapters=None, adapter_idx=None,
+                          t_hi=None):
         """One decode step where every batch row sits at its *own* cache
         position — the continuous-batching kernel.
 
         token [B]; pos/rope_pos/kv_start [B] int32.  Row b attends to cache
         slots [kv_start[b], pos[b]] and writes its new K/V at pos[b].
         Returns (cache, logits [B, V]).  Idle rows are the caller's business:
-        their outputs are valid numbers that simply go unused."""
+        their outputs are valid numbers that simply go unused.
+
+        ``t_hi`` (static): upper bound on every LIVE row's pos — the
+        attention read covers cache[..., :t_hi] only (the scheduler
+        buckets it pow2 from its host position mirror), cutting decode's
+        bandwidth-bound cache traffic by max_seq/t_hi at short contexts."""
         B = token.shape[0]
         x = emb_lookup(params["embed"], token, self.cfg.dtype)[:, None]  # [B,1,D]
         pos = jnp.asarray(pos, jnp.int32)
-        t = jnp.arange(self.max_seq)
+        t = jnp.arange(t_hi if t_hi is not None else self.max_seq)
         mask = (
             (t[None, :] <= pos[:, None]) & (t[None, :] >= kv_start[:, None])
         )[:, None, :]  # [B, 1, T]
         logits, cache = self._run_blocks(
             params, x, cache, jnp.asarray(rope_pos, jnp.int32)[:, None], pos,
             mask, adapters=adapters, adapter_idx=adapter_idx,
+            unroll_layers=True,
         )
         return cache, logits[:, 0]
 
     def extend_multi(self, params, cache, tokens, start, rope_start,
-                     kv_start, adapters=None, adapter_idx=None):
+                     kv_start, adapters=None, adapter_idx=None,
+                     t_hi=None):
         """Multi-token cached forward where every row writes its *own*
         window — the speculative-decoding verify kernel.
 
@@ -429,7 +521,7 @@ class InferenceEngine:
         B, W = tokens.shape
         start = jnp.asarray(start, jnp.int32)
         q_pos = start[:, None] + jnp.arange(W, dtype=jnp.int32)[None]  # [B, W]
-        t = jnp.arange(self.max_seq)
+        t = jnp.arange(t_hi if t_hi is not None else self.max_seq)
         mask = (
             (t[None, None, :] <= q_pos[:, :, None])
             & (t[None, None, :] >= jnp.asarray(kv_start, jnp.int32)[:, None, None])
@@ -446,6 +538,7 @@ class InferenceEngine:
         logits, cache = self._run_blocks(
             params, x, cache, rope, start, mask, moe_full_capacity=True,
             adapters=adapters, adapter_idx=adapter_idx,
+            unroll_layers=True,
         )
         return cache, logits
 
@@ -484,12 +577,14 @@ class InferenceEngine:
         valid0 = first != sampling.eos_id
         done0 = ~valid0
 
+        t_hi = min(S + max_new_tokens, self.max_seq)
+
         def step(carry, i):
             cache, token, done, k = carry
             k, sub = jax.random.split(k)
             cache, logits = self.decode_step(
                 params, cache, S + i, token,
-                rope_pos=S + i - pad_left, kv_start=pad_left,
+                rope_pos=S + i - pad_left, kv_start=pad_left, t_hi=t_hi,
             )
             nxt = self._sample(logits, sub, sampling)
             valid = ~done & (nxt != sampling.eos_id)
@@ -549,12 +644,14 @@ class InferenceEngine:
         key, k0 = jax.random.split(key)
         tok0, valid0, state, done = pick(last_logits, state, done, k0)
 
+        t_hi = min(S + max_new_tokens, self.max_seq)
+
         def step(carry, i):
             cache, token, st, dn, k = carry
             k, sub = jax.random.split(k)
             cache, logits = self.decode_step(
                 params, cache, S + i, token,
-                rope_pos=S + i - pad_left, kv_start=pad_left,
+                rope_pos=S + i - pad_left, kv_start=pad_left, t_hi=t_hi,
             )
             # pick() already pads invalid rows, so tok doubles as the
             # feed token and the emitted value.

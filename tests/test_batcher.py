@@ -87,8 +87,14 @@ def test_late_admission_interleaves(setup):
     first request's last step."""
     model, params = setup
     # Small rounds → many scheduler rounds for A, so B demonstrably joins
-    # while A is still decoding even with the pipelined dispatcher.
-    b = ContinuousBatcher(model, params, slots=4, steps_per_round=2).start()
+    # while A is still decoding even with the pipelined dispatcher.  Solo
+    # amortization is pinned off (bucket == steps_per_round): with it on,
+    # a 40-token A's whole budget is legitimately in flight before B
+    # arrives (budget-aware tail-sizing) and the rounds can't be shared —
+    # the solo path has its own test below.
+    b = ContinuousBatcher(model, params, slots=4, steps_per_round=2)
+    b.solo_buckets = [2]
+    b.start()
     try:
         ha = b.submit([1, 2, 3], max_new_tokens=40)
         # Wait until A is demonstrably mid-decode.
@@ -353,9 +359,10 @@ def test_logprobs_parallel_and_correct(setup):
 
 
 def test_solo_rounds_amortize_dispatches(setup):
-    """A single live request runs the LONG round variant (solo_steps =
-    4x steps_per_round): same oracle-exact stream, ~4x fewer dispatches
-    — the single-stream-overhead fix (VERDICT r3 weak #2/ask #4)."""
+    """A single live request runs LONG round variants (solo_buckets,
+    up to 8x steps_per_round): same oracle-exact stream, far fewer
+    dispatches — the single-stream-overhead fix (VERDICT r3 weak
+    #2/ask #4)."""
     model, params = setup
     b = ContinuousBatcher(model, params, slots=2, steps_per_round=2).start()
     try:
@@ -374,6 +381,38 @@ def test_solo_rounds_amortize_dispatches(setup):
         hb = b.submit([2, 4, 8], max_new_tokens=8)
         assert ha.result() == _reference_greedy(model, params, [5, 9, 17], 8)
         assert hb.result() == _reference_greedy(model, params, [2, 4, 8], 8)
+    finally:
+        b.stop()
+
+
+def test_budget_gate_no_garbage_rounds(setup):
+    """The scheduler never dispatches a round past every live row's
+    remaining budget: a 5-token solo request is one admit + ONE tail-
+    sized round (bucket 4 covers rem=4), not a pipeline of full-width
+    garbage rounds that no stream can consume."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=4, steps_per_round=4).start()
+    try:
+        got = b.submit([5, 9, 17], max_new_tokens=5).result()
+        assert got == _reference_greedy(model, params, [5, 9, 17], 5)
+        # Give the scheduler a beat to (wrongly) dispatch extra rounds.
+        time.sleep(0.2)
+        assert b.steps_taken == 1, b.steps_taken
+    finally:
+        b.stop()
+
+
+def test_solo_tail_round_sized_to_budget(setup):
+    """Tail-sizing picks the smallest solo bucket covering the remaining
+    budget: 13 post-admit tokens at steps_per_round=2 → one 16-step
+    round, not 8+8 or 4x bigger."""
+    model, params = setup
+    b = ContinuousBatcher(model, params, slots=2, steps_per_round=2).start()
+    try:
+        got = b.submit([5, 9, 17], max_new_tokens=14).result()
+        assert got == _reference_greedy(model, params, [5, 9, 17], 14)
+        time.sleep(0.2)
+        assert b.steps_taken == 1, b.steps_taken
     finally:
         b.stop()
 

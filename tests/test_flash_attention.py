@@ -174,3 +174,21 @@ def test_flash_lse_value_and_gradient_match_reference():
                    argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_f, g_r):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_pinned_block_odd_seq_falls_back():
+    """A pinned block clamped to an odd S (512→65) divides S evenly yet
+    violates the TPU sublane tiling — flash must fall back to the
+    reference path instead of handing Mosaic an uncompilable kernel
+    (seen live: model.forward at S=65 with flash_block_q=512)."""
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (1, 2, 65, 16), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, 2, 65, 16), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, 2, 65, 16), jnp.bfloat16)
+    o = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, block_q=512, block_k=512)
+    )(q, k, v)
+    o_r = reference_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), np.asarray(o_r, np.float32), atol=2e-2
+    )
