@@ -568,6 +568,23 @@ def spec_batcher_probe(model, params) -> dict:
         )
     finally:
         spec.stop()
+    # Machinery ceiling: the target AS its own draft.  On a trained
+    # model this reads ~1.0; on the barely-trained bench flagship it
+    # reads the fraction of decode positions whose argmax margin
+    # survives bf16 fusion differences between the draft chain and the
+    # W-wide verify — the distilled number above can't beat it, so
+    # report both (acceptance below the ceiling is draft quality;
+    # ceiling below 1.0 is argmax-margin noise, not a spec bug).
+    ceil_b = ContinuousBatcher(
+        model, params, slots=8, draft=(model, params), spec_k=4
+    ).start()
+    try:
+        run(ceil_b, 1)
+        out["cb_spec_ceiling_acceptance"] = (
+            ceil_b.spec_stats["acceptance"]
+        )
+    finally:
+        ceil_b.stop()
     return out
 
 

@@ -10,6 +10,11 @@ from .azurevmpool import AzureVmPool, AzureVmPoolSpec, AzureVmPoolStatus, ImageR
 from .tpupodslice import TpuPodSlice, TpuPodSliceSpec, TpuPodSliceStatus, SliceStatus
 from .core import Secret, Node, Event, Pod, PersistentVolume, PersistentVolumeClaim, Deployment
 from .devenv import DevEnv, DevEnvSpec, DevEnvStatus
+from .inferenceservice import (
+    InferenceService,
+    InferenceServiceSpec,
+    InferenceServiceStatus,
+)
 from .trainjob import TrainJob, TrainJobSpec, TrainJobStatus, AssetRef, EnvVar
 from .tenancy import LimitRange, Namespace, ResourceQuota, RoleBinding
 from .queue import DEFAULT_QUEUE, SchedulingQueue, SchedulingQueueSpec
@@ -51,4 +56,7 @@ __all__ = [
     "DevEnv",
     "DevEnvSpec",
     "DevEnvStatus",
+    "InferenceService",
+    "InferenceServiceSpec",
+    "InferenceServiceStatus",
 ]

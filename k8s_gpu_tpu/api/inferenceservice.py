@@ -1,0 +1,103 @@
+"""InferenceService CRD — platform-managed LM serving.
+
+The reference hosts its serving workload as a hand-run Ollama deployment
+the Fin-Agent service points at (智能风控解决方案.md:368-419, 440-520:
+docker-compose with a fixed `ollama` service) — serving is config, not a
+reconciled object.  Here serving joins the workload matrix next to
+TrainJob and DevEnv: an InferenceService declares a servable model
+bundle from the asset store (serve/bundle.py — the train→export→serve
+journey of GPU调度平台搭建.md:686-697) plus replica/engine knobs, and the
+reconciler (operators/inferenceservice.py) keeps that many live serving
+replicas placed on TPU chip carve-outs, self-healing and optionally
+autoscaling on queue depth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .trainjob import AssetRef
+from .types import Condition, CustomResource, ValidationError
+
+
+@dataclass
+class InferenceServiceSpec:
+    # Servable model bundle (kind "model" in the AssetStore; must be the
+    # serve.bundle format — raw checkpoint exports are rejected at load).
+    model: AssetRef = field(default_factory=AssetRef)
+    # Optional speculative-decoding draft bundle (serve/speculative.py);
+    # empty id = plain decoding.
+    draft: AssetRef = field(default_factory=AssetRef)
+    replicas: int = 1
+    # Chips carved out of one TPU host per replica (the HAMi-sharing
+    # path, scheduling/sharing.py) — serving replicas are single-host;
+    # scale throughput by replicas, not slice size.
+    chips: int = 1
+    # Engine knobs, passed through to serve.LmServer/ContinuousBatcher.
+    slots: int = 8
+    spec_k: int = 4
+    kv_quant: bool = False
+    eos_id: int = -1
+    max_new_tokens_cap: int = 256
+    # Queue-depth autoscaling: when max_replicas > 0 the reconciler sizes
+    # the replica set to clamp(ceil(pending / target_pending_per_replica),
+    # min_replicas, max_replicas) from the live batchers' pending-request
+    # depth; spec.replicas is then only the initial size.
+    min_replicas: int = 0
+    max_replicas: int = 0
+    target_pending_per_replica: int = 4
+
+
+@dataclass
+class InferenceServiceStatus:
+    phase: str = "Pending"  # Pending|Ready|Degraded|Failed
+    message: str = ""
+    # Desired size after autoscaling (== spec.replicas when off).
+    replicas: int = 0
+    ready_replicas: int = 0
+    # "host:port" per live replica, index-aligned with pods.
+    endpoints: list[str] = field(default_factory=list)
+    # pod name → node name.
+    placements: dict[str, str] = field(default_factory=dict)
+    # Last observed total pending-request depth (the autoscale signal).
+    pending_requests: int = 0
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class InferenceService(CustomResource):
+    kind: str = "InferenceService"
+    api_version: str = "tpu.k8sgpu.dev/v1alpha1"
+    spec: InferenceServiceSpec = field(default_factory=InferenceServiceSpec)
+    status: InferenceServiceStatus = field(
+        default_factory=InferenceServiceStatus
+    )
+
+    def validate(self) -> None:
+        super().validate()
+        s = self.spec
+        if not s.model.id:
+            raise ValidationError("spec.model.id is required")
+        if s.replicas < 1:
+            raise ValidationError("spec.replicas must be >= 1")
+        if s.chips < 1:
+            raise ValidationError("spec.chips must be >= 1")
+        if s.slots < 1:
+            raise ValidationError("spec.slots must be >= 1")
+        if s.max_replicas:
+            if s.min_replicas < 1:
+                raise ValidationError(
+                    "autoscaling needs spec.minReplicas >= 1"
+                )
+            if s.max_replicas < s.min_replicas:
+                raise ValidationError(
+                    "spec.maxReplicas must be >= spec.minReplicas"
+                )
+            if s.target_pending_per_replica < 1:
+                raise ValidationError(
+                    "spec.targetPendingPerReplica must be >= 1"
+                )
+        if s.draft.id and s.spec_k < 1:
+            raise ValidationError(
+                "speculative serving (spec.draft) needs spec.specK >= 1"
+            )

@@ -42,14 +42,15 @@ def _ensure_registry() -> None:
         azurevmpool,
         devenv,
         gitops,
+        inferenceservice,
         queue,
         tenancy,
         tpupodslice,
         trainjob,
     )
 
-    for mod in (core, azurevmpool, devenv, gitops, queue, tenancy,
-                tpupodslice, trainjob):
+    for mod in (core, azurevmpool, devenv, gitops, inferenceservice,
+                queue, tenancy, tpupodslice, trainjob):
         for name in dir(mod):
             obj = getattr(mod, name)
             if (

@@ -5,6 +5,7 @@ from .autoscaler import SliceAutoscaler
 from .devenv import DevEnvReconciler
 from .gc import ResourceGC
 from .gitops import GitOpsReconciler
+from .inferenceservice import InferenceServiceReconciler
 
 __all__ = [
     "AzureVmPoolReconciler",
@@ -14,4 +15,5 @@ __all__ = [
     "DevEnvReconciler",
     "ResourceGC",
     "GitOpsReconciler",
+    "InferenceServiceReconciler",
 ]

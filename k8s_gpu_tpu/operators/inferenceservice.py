@@ -1,0 +1,329 @@
+"""InferenceService reconciler — serving joins the workload matrix.
+
+The reference's serving story is a hand-managed Ollama container the
+platform never reconciles (智能风控解决方案.md:368-419; docker-compose
+440-520).  This operator gives serving the same treatment TrainJob gives
+training: desired state is *N live replicas of a servable bundle*, and
+reconcile makes it so —
+
+- each replica is a Pod on a TPU chip carve-out
+  (scheduling/sharing.grant_chips_from_cluster — the HAMi role), placed
+  best-fit and self-healed when the pod dies;
+- with ``run_servers=True`` (the in-process-workload idiom TrainJob
+  established) each replica IS a live ``serve.LmServer`` — a real HTTP
+  endpoint, loaded from the AssetStore via serve.bundle.load_servable
+  (the train→export→serve journey, GPU调度平台搭建.md:686-697) — so
+  status.endpoints are connectable, not decorative;
+- queue-depth autoscaling: with spec.maxReplicas set, the replica set is
+  resized to clamp(ceil(pending / targetPendingPerReplica), min, max)
+  from the live batchers' pending-request depth — the serving analogue
+  of the TrainJob autoscaler's scale-from-zero.
+
+Deletion stops every server, frees every carve-out, then drops the
+finalizer.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+from ..api.core import Pod
+from ..api.inferenceservice import InferenceService
+from ..api.types import set_condition
+from ..controller.events import EventRecorder
+from ..controller.kubefake import Conflict, FakeKube, NotFound
+from ..controller.manager import Reconciler, Request, Result
+from ..scheduling.labels import TPU_RESOURCE
+from ..scheduling.placement import PlacementError
+from ..scheduling.sharing import grant_chips_from_cluster, resync_node_chips
+from ..utils.metrics import MetricsRegistry, global_metrics
+
+log = logging.getLogger("k8s_gpu_tpu.operators.inferenceservice")
+
+FINALIZER = "tpu.k8sgpu.dev/inferenceservice-cleanup"
+
+AUTOSCALE_POLL = 5.0  # re-evaluate queue depth while autoscaling
+
+
+def pod_name(svc: InferenceService, i: int) -> str:
+    return f"{svc.metadata.name}-r-{i}"
+
+
+def dns_endpoint(svc: InferenceService, i: int) -> str:
+    """Synthetic service DNS used when servers don't run in-process
+    (run_servers=False — placement-only tests and dry runs)."""
+    return (
+        f"{svc.metadata.name}-{i}.serve.tpu-platform.example.com:8000"
+    )
+
+
+class InferenceServiceReconciler(Reconciler):
+    def __init__(
+        self,
+        kube: FakeKube,
+        store=None,
+        run_servers: bool = True,
+        metrics: MetricsRegistry | None = None,
+    ):
+        """``store``: the AssetStore servable bundles load from (required
+        when run_servers).  ``run_servers=False`` reconciles placement
+        and status only — no JAX, no HTTP — for control-plane tests."""
+        self.kube = kube
+        self.store = store
+        self.run_servers = run_servers
+        self.metrics = metrics or global_metrics
+        self.recorder = EventRecorder(kube, "inferenceservice-controller")
+        # (namespace, service, pod) → live LmServer.
+        self._servers: dict[tuple, object] = {}
+        # (space, id, version) → loaded (model, params, tokenizer):
+        # replicas of one service — and services sharing a bundle —
+        # share the host-side weights (each server still owns its own
+        # device state).
+        self._bundles: dict[tuple, tuple] = {}
+
+    # -- bundle loading ----------------------------------------------------
+    def _load(self, ref):
+        key = (ref.space or "default", ref.id, ref.version)
+        if key not in self._bundles:
+            from ..serve.bundle import load_servable
+
+            if self.store is None:
+                raise ValueError(
+                    "run_servers requires an AssetStore (store=...)"
+                )
+            self._bundles[key] = load_servable(
+                self.store, key[0], ref.id, ref.version
+            )
+        return self._bundles[key]
+
+    # -- reconcile ---------------------------------------------------------
+    def reconcile(self, req: Request) -> Result:
+        svc = self.kube.try_get("InferenceService", req.name, req.namespace)
+        if svc is None:
+            return Result()
+        if svc.metadata.deletion_timestamp is not None:
+            return self._teardown(svc)
+        if FINALIZER not in svc.metadata.finalizers:
+            svc.metadata.finalizers.append(FINALIZER)
+            try:
+                svc = self.kube.update(svc)
+            except Conflict:
+                return Result(requeue=True)
+
+        desired = self._desired_replicas(svc)
+
+        # Scale down: retire surplus replicas (highest index first).
+        existing = self._owned_pods(svc)
+        for p in existing:
+            idx = self._index_of(svc, p.metadata.name)
+            if idx is None or idx >= desired:
+                self._retire_pod(svc, p)
+
+        # Scale up / self-heal: ensure pods 0..desired-1.
+        short = None
+        for i in range(desired):
+            try:
+                self._ensure_replica(svc, i)
+            except PlacementError as e:
+                short = str(e)
+                break  # lower indices first; retry fills the rest
+
+        return self._update_status(svc, desired, short)
+
+    # -- replica lifecycle -------------------------------------------------
+    def _owned_pods(self, svc: InferenceService) -> list[Pod]:
+        return [
+            p for p in self.kube.list("Pod", namespace=svc.metadata.namespace)
+            if p.metadata.labels.get("inferenceservice")
+            == svc.metadata.name
+        ]
+
+    @staticmethod
+    def _index_of(svc: InferenceService, name: str) -> int | None:
+        prefix = f"{svc.metadata.name}-r-"
+        if not name.startswith(prefix):
+            return None
+        try:
+            return int(name[len(prefix):])
+        except ValueError:
+            return None
+
+    def _ensure_replica(self, svc: InferenceService, i: int) -> None:
+        name = pod_name(svc, i)
+        ns = svc.metadata.namespace
+        pod = self.kube.try_get("Pod", name, ns)
+        if pod is None:
+            # A dead replica's server (pod deleted out from under us)
+            # must not survive its pod.
+            self._stop_server(svc, name)
+            pod = Pod()
+            pod.metadata.name = name
+            pod.metadata.namespace = ns
+            pod.metadata.labels = {
+                "inferenceservice": svc.metadata.name,
+                "replica": str(i),
+            }
+            pod.image = "k8s-gpu-tpu/lm-server:latest"
+            pod.command = "python -m k8s_gpu_tpu.serve"
+            pod.requests[TPU_RESOURCE] = svc.spec.chips
+            alloc = grant_chips_from_cluster(self.kube, name, svc.spec.chips)
+            pod.node_name = alloc.node
+            pod.env.update(alloc.env)
+            pod.phase = "Running"
+            try:
+                self.kube.create(pod)
+            except Conflict:
+                resync_node_chips(self.kube, alloc.node)
+                return
+            self.recorder.event(
+                svc, "Normal", "ReplicaPlaced",
+                f"{name} on {alloc.node} "
+                f"(chips {alloc.env.get('TPU_VISIBLE_CHIPS', '')})",
+            )
+        if self.run_servers:
+            self._ensure_server(svc, name)
+
+    def _ensure_server(self, svc: InferenceService, pod: str) -> None:
+        key = (svc.metadata.namespace, svc.metadata.name, pod)
+        if key in self._servers:
+            return
+        from ..serve.server import LmServer
+
+        model, params, tok = self._load(svc.spec.model)
+        draft = None
+        if svc.spec.draft.id:
+            dm, dp, _ = self._load(svc.spec.draft)
+            draft = (dm, dp)
+        server = LmServer(
+            model, params, tok,
+            slots=svc.spec.slots,
+            eos_id=svc.spec.eos_id,
+            max_new_tokens_cap=svc.spec.max_new_tokens_cap,
+            draft=draft,
+            kv_quant=svc.spec.kv_quant,
+        ).start()
+        self._servers[key] = server
+        self.recorder.event(
+            svc, "Normal", "ReplicaServing",
+            f"{pod} listening on 127.0.0.1:{server.port}",
+        )
+
+    def _stop_server(self, svc: InferenceService, pod: str) -> None:
+        key = (svc.metadata.namespace, svc.metadata.name, pod)
+        server = self._servers.pop(key, None)
+        if server is not None:
+            try:
+                server.stop()
+            except Exception:
+                log.exception("stopping server for %s", pod)
+
+    def _retire_pod(self, svc: InferenceService, pod: Pod) -> None:
+        self._stop_server(svc, pod.metadata.name)
+        node = pod.node_name
+        try:
+            self.kube.delete(
+                "Pod", pod.metadata.name, pod.metadata.namespace
+            )
+        except NotFound:
+            pass
+        if node:
+            resync_node_chips(self.kube, node)
+
+    # -- autoscale ---------------------------------------------------------
+    def _pending(self, svc: InferenceService) -> int:
+        """Total queued (unadmitted) requests across this service's live
+        in-process servers — the scale signal.  Measured from the
+        batchers directly: level-triggered like everything else here."""
+        ns, name = svc.metadata.namespace, svc.metadata.name
+        total = 0
+        for (kns, kname, _), server in self._servers.items():
+            if (kns, kname) == (ns, name):
+                total += server.batcher._pending.qsize()
+        return total
+
+    def _desired_replicas(self, svc: InferenceService) -> int:
+        s = svc.spec
+        if not s.max_replicas:
+            return s.replicas
+        pending = self._pending(svc)
+        svc.status.pending_requests = pending
+        want = math.ceil(pending / s.target_pending_per_replica)
+        # Never scale below what serves current traffic boundlessly —
+        # min_replicas is the floor even at zero pending.
+        return max(s.min_replicas, min(s.max_replicas, want))
+
+    # -- status ------------------------------------------------------------
+    def _update_status(
+        self, svc: InferenceService, desired: int, short: str | None
+    ) -> Result:
+        pods = {
+            self._index_of(svc, p.metadata.name): p
+            for p in self._owned_pods(svc)
+        }
+        endpoints, placements, ready = [], {}, 0
+        for i in range(desired):
+            p = pods.get(i)
+            if p is None:
+                continue
+            placements[p.metadata.name] = p.node_name
+            key = (svc.metadata.namespace, svc.metadata.name,
+                   p.metadata.name)
+            server = self._servers.get(key)
+            if server is not None:
+                endpoints.append(f"127.0.0.1:{server.port}")
+                ready += 1
+            elif not self.run_servers:
+                endpoints.append(dns_endpoint(svc, i))
+                ready += 1
+        svc.status.replicas = desired
+        svc.status.ready_replicas = ready
+        svc.status.endpoints = endpoints
+        svc.status.placements = placements
+        if ready == desired and desired > 0:
+            svc.status.phase = "Ready"
+            svc.status.message = ""
+            cond = ("True", "AllReplicasServing",
+                    f"{ready}/{desired} replicas ready")
+        elif ready > 0:
+            svc.status.phase = "Degraded"
+            svc.status.message = short or f"{ready}/{desired} ready"
+            cond = ("False", "PartiallyReady", svc.status.message)
+        else:
+            svc.status.phase = "Pending"
+            svc.status.message = short or "awaiting placement"
+            cond = ("False", "NoCapacity" if short else "Starting",
+                    svc.status.message)
+        set_condition(
+            svc.status.conditions, "Ready", cond[0], cond[1], cond[2],
+            observed_generation=svc.metadata.generation,
+        )
+        self.metrics.set_gauge(
+            "inferenceservice_ready_replicas", float(ready),
+            service=svc.metadata.name,
+        )
+        try:
+            self.kube.update_status(svc)
+        except (Conflict, NotFound):
+            return Result(requeue=True)
+        if short is not None:
+            return Result(requeue_after=10.0)
+        if svc.spec.max_replicas:
+            return Result(requeue_after=AUTOSCALE_POLL)
+        return Result()
+
+    # -- teardown ----------------------------------------------------------
+    def _teardown(self, svc: InferenceService) -> Result:
+        for p in self._owned_pods(svc):
+            self._retire_pod(svc, p)
+        if FINALIZER in svc.metadata.finalizers:
+            svc.metadata.finalizers.remove(FINALIZER)
+            try:
+                self.kube.update(svc)
+            except (Conflict, NotFound):
+                return Result(requeue=True)
+        self.recorder.event(
+            svc, "Normal", "Deleted",
+            f"all replicas of {svc.metadata.name} stopped and freed",
+        )
+        return Result()

@@ -55,6 +55,7 @@ def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
     from ..operators import (
         DevEnvReconciler,
         GitOpsReconciler,
+        InferenceServiceReconciler,
         ResourceGC,
         SliceAutoscaler,
         TpuPodSliceReconciler,
@@ -84,6 +85,14 @@ def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
         mgr.register("DevEnv", DevEnvReconciler(kube))
     if assets is not None:
         mgr.register("Application", GitOpsReconciler(kube, assets))
+    # Serving workloads: real in-process LmServers when the asset store
+    # (servable bundles) is available, placement-only otherwise.
+    mgr.register(
+        "InferenceService",
+        InferenceServiceReconciler(
+            kube, store=assets, run_servers=assets is not None,
+        ),
+    )
     # GC watches '*': any kind's churn triggers a sweep; the in-reconciler
     # debounce collapses the startup replay storm to one sweep.
     mgr.register(

@@ -1,0 +1,289 @@
+"""InferenceService: serving as a reconciled workload (the reference
+runs its LM as a hand-managed Ollama container, 智能风控解决方案.md:368-419
+— here serving gets the TrainJob treatment: placement, self-heal,
+autoscale, real endpoints)."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_gpu_tpu.api import InferenceService, Node, ValidationError
+from k8s_gpu_tpu.api.trainjob import AssetRef
+from k8s_gpu_tpu.controller import FakeKube, Manager
+from k8s_gpu_tpu.controller.kubefake import NotFound
+from k8s_gpu_tpu.controller.manager import Request
+from k8s_gpu_tpu.data.tokenizer import BpeTokenizer
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.operators import InferenceServiceReconciler
+from k8s_gpu_tpu.platform.assets import AssetStore
+from k8s_gpu_tpu.scheduling.labels import TPU_RESOURCE
+from k8s_gpu_tpu.serve.bundle import export_servable
+
+TINY = TransformerConfig(
+    vocab_size=256, d_model=32, n_layers=2, n_heads=2, d_head=16,
+    d_ff=64, max_seq=64, use_flash=False, dtype=jnp.float32,
+)
+
+
+def _tpu_node(name: str, chips: int = 8) -> Node:
+    n = Node()
+    n.metadata.name = name
+    n.capacity = {TPU_RESOURCE: chips}
+    n.allocatable = {TPU_RESOURCE: chips}
+    n.ready = True
+    return n
+
+
+@pytest.fixture(scope="module")
+def bundle_store(tmp_path_factory):
+    """AssetStore with one servable TINY bundle (and a tokenizer)."""
+    root = tmp_path_factory.mktemp("assets")
+    store = AssetStore(root)
+    model = TransformerLM(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    tok = BpeTokenizer.train(
+        "the quick brown fox jumps over the lazy dog " * 4,
+        vocab_size=TINY.vocab_size,
+    )
+    export_servable(store, "default", "tiny-lm", model, params, tok)
+    return store
+
+
+def _cluster(run_servers: bool, store=None, nodes: int = 2):
+    kube = FakeKube()
+    for i in range(nodes):
+        kube.create(_tpu_node(f"tpu-{i}"))
+    rec = InferenceServiceReconciler(
+        kube, store=store, run_servers=run_servers
+    )
+    return kube, rec
+
+
+def _svc(name="chat", replicas=1, chips=2, **spec) -> InferenceService:
+    svc = InferenceService()
+    svc.metadata.name = name
+    svc.spec.model = AssetRef(space="default", id="tiny-lm")
+    svc.spec.replicas = replicas
+    svc.spec.chips = chips
+    for k, v in spec.items():
+        setattr(svc.spec, k, v)
+    return svc
+
+
+def _reconcile(kube, rec, name="chat"):
+    return rec.reconcile(Request(namespace="default", name=name))
+
+
+def test_validation():
+    svc = InferenceService()
+    svc.metadata.name = "x"
+    with pytest.raises(ValidationError, match="model.id"):
+        svc.validate()
+    svc.spec.model.id = "m"
+    svc.spec.replicas = 0
+    with pytest.raises(ValidationError, match="replicas"):
+        svc.validate()
+    svc.spec.replicas = 1
+    svc.spec.max_replicas = 2
+    with pytest.raises(ValidationError, match="minReplicas"):
+        svc.validate()
+
+
+def test_placement_only_reconcile_to_ready():
+    """run_servers=False: pods placed on chip carve-outs, endpoints are
+    service DNS, status Ready — pure control-plane semantics."""
+    kube, rec = _cluster(run_servers=False)
+    kube.create(_svc(replicas=3, chips=2))
+    _reconcile(kube, rec)
+    svc = kube.get("InferenceService", "chat")
+    assert svc.status.phase == "Ready", svc.status
+    assert svc.status.ready_replicas == 3
+    assert len(svc.status.endpoints) == 3
+    pods = [p for p in kube.list("Pod")
+            if p.metadata.labels.get("inferenceservice") == "chat"]
+    assert len(pods) == 3
+    for p in pods:
+        assert p.requests[TPU_RESOURCE] == 2
+        assert p.env.get("TPU_VISIBLE_CHIPS"), "no chip grant"
+    # carve-outs visible in allocatable: 3 replicas x 2 chips from 16
+    free = sum(n.allocatable.get(TPU_RESOURCE, 0)
+               for n in kube.list("Node"))
+    assert free == 16 - 6, free
+
+
+def test_self_heal_replaces_dead_pod():
+    kube, rec = _cluster(run_servers=False)
+    kube.create(_svc(replicas=2))
+    _reconcile(kube, rec)
+    kube.delete("Pod", "chat-r-0")
+    _reconcile(kube, rec)
+    assert kube.get("Pod", "chat-r-0") is not None
+    svc = kube.get("InferenceService", "chat")
+    assert svc.status.ready_replicas == 2
+
+
+def test_scale_down_frees_chips():
+    kube, rec = _cluster(run_servers=False)
+    kube.create(_svc(replicas=3, chips=2))
+    _reconcile(kube, rec)
+    svc = kube.get("InferenceService", "chat")
+    svc.spec.replicas = 1
+    kube.update(svc)
+    _reconcile(kube, rec)
+    pods = [p for p in kube.list("Pod")
+            if p.metadata.labels.get("inferenceservice") == "chat"]
+    assert len(pods) == 1
+    free = sum(n.allocatable.get(TPU_RESOURCE, 0)
+               for n in kube.list("Node"))
+    assert free == 16 - 2, free
+
+
+def test_no_capacity_pending_then_ready():
+    """More chips than the cluster has → Pending with NoCapacity; a new
+    node unblocks the next reconcile (level-triggered)."""
+    kube, rec = _cluster(run_servers=False, nodes=1)
+    kube.create(_svc(replicas=3, chips=8))  # 24 chips vs 8 available
+    res = _reconcile(kube, rec)
+    svc = kube.get("InferenceService", "chat")
+    assert svc.status.phase in ("Pending", "Degraded")
+    assert res.requeue_after is not None
+    kube.create(_tpu_node("tpu-9", 16))
+    _reconcile(kube, rec)
+    assert kube.get("InferenceService", "chat").status.phase == "Ready"
+
+
+def test_finalizer_teardown_frees_everything():
+    kube, rec = _cluster(run_servers=False)
+    kube.create(_svc(replicas=2, chips=4))
+    _reconcile(kube, rec)
+    kube.delete("InferenceService", "chat")
+    _reconcile(kube, rec)
+    with pytest.raises(NotFound):
+        kube.get("InferenceService", "chat")
+    assert not [p for p in kube.list("Pod")
+                if p.metadata.labels.get("inferenceservice")]
+    free = sum(n.allocatable.get(TPU_RESOURCE, 0)
+               for n in kube.list("Node"))
+    assert free == 16, free
+
+
+def test_real_servers_serve_http(bundle_store):
+    """run_servers=True: endpoints are LIVE LmServers loaded from the
+    asset store — /generate round-trips through the continuous batcher."""
+    kube, rec = _cluster(run_servers=True, store=bundle_store)
+    kube.create(_svc(replicas=2, slots=2))
+    try:
+        _reconcile(kube, rec)
+        svc = kube.get("InferenceService", "chat")
+        assert svc.status.phase == "Ready", svc.status
+        assert len(svc.status.endpoints) == 2
+        for ep in svc.status.endpoints:
+            body = json.dumps(
+                {"prompt": "the quick", "max_new_tokens": 4}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://{ep}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            assert "text" in out or "ids" in out, out
+    finally:
+        svc = kube.get("InferenceService", "chat")
+        kube.delete("InferenceService", "chat")
+        _reconcile(kube, rec)
+    assert not rec._servers, "servers leaked after teardown"
+
+
+def test_autoscale_grows_and_shrinks_on_queue_depth(bundle_store,
+                                                    monkeypatch):
+    kube, rec = _cluster(run_servers=True, store=bundle_store)
+    kube.create(_svc(replicas=1, slots=2, min_replicas=1, max_replicas=3,
+                     target_pending_per_replica=2))
+    try:
+        res = _reconcile(kube, rec)
+        assert res.requeue_after is not None  # keeps watching the queue
+        assert kube.get("InferenceService", "chat").status.replicas == 1
+        # Pretend 5 requests are queued → ceil(5/2) = 3 replicas.
+        monkeypatch.setattr(rec, "_pending", lambda svc: 5)
+        _reconcile(kube, rec)
+        svc = kube.get("InferenceService", "chat")
+        assert svc.status.replicas == 3, svc.status
+        assert svc.status.ready_replicas == 3
+        # Queue drains → back to the min floor.
+        monkeypatch.setattr(rec, "_pending", lambda svc: 0)
+        _reconcile(kube, rec)
+        assert kube.get("InferenceService", "chat").status.replicas == 1
+    finally:
+        kube.delete("InferenceService", "chat")
+        _reconcile(kube, rec)
+
+
+def test_manager_integration_real_clock(bundle_store):
+    """The production path: Manager + watch, CR applied → Ready, spec
+    change → scaled, delete → gone (the verify-skill drive shape)."""
+    import time
+
+    kube = FakeKube()
+    kube.create(_tpu_node("tpu-0"))
+    rec = InferenceServiceReconciler(kube, store=bundle_store,
+                                     run_servers=False)
+    mgr = Manager(kube)
+    mgr.register("InferenceService", rec)
+    mgr.start()
+    try:
+        kube.create(_svc(replicas=2))
+        t0 = time.time()
+        while time.time() - t0 < 8:
+            svc = kube.get("InferenceService", "chat")
+            if svc.status.phase == "Ready":
+                break
+            time.sleep(0.1)
+        assert svc.status.phase == "Ready", svc.status
+        kube.delete("InferenceService", "chat")
+        t0 = time.time()
+        while time.time() - t0 < 8:
+            try:
+                kube.get("InferenceService", "chat")
+            except NotFound:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("finalizer never released the CR")
+    finally:
+        mgr.stop()
+
+
+def test_schema_and_apply_validate():
+    from k8s_gpu_tpu.api.schema import schema_for_kind, validate_manifest
+
+    s = schema_for_kind("InferenceService")
+    assert "spec" in s["properties"]
+    doc = {
+        "apiVersion": "tpu.k8sgpu.dev/v1alpha1",
+        "kind": "InferenceService",
+        "metadata": {"name": "chat"},
+        "spec": {"model": {"space": "default", "id": "tiny-lm"},
+                 "replicas": 2},
+    }
+    assert validate_manifest(doc) == []
+    doc["spec"]["replicas"] = "two"
+    assert validate_manifest(doc), "type error not caught"
+
+
+def test_sample_manifest_validates():
+    import yaml
+
+    from k8s_gpu_tpu.api.schema import validate_manifest
+    from k8s_gpu_tpu.api.serialize import from_manifest
+
+    doc = yaml.safe_load(
+        open("config/samples/inferenceservice.yaml")
+    )
+    assert validate_manifest(doc) == []
+    svc = from_manifest(doc)
+    assert svc.spec.max_replicas == 4
+    svc.validate()
