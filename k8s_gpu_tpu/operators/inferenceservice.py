@@ -76,26 +76,42 @@ class InferenceServiceReconciler(Reconciler):
         self.recorder = EventRecorder(kube, "inferenceservice-controller")
         # (namespace, service, pod) → live LmServer.
         self._servers: dict[tuple, object] = {}
-        # (space, id, version) → loaded (model, params, tokenizer):
-        # replicas of one service — and services sharing a bundle —
-        # share the host-side weights (each server still owns its own
-        # device state).
+        # Resolved (space, id, version) → loaded (model, params,
+        # tokenizer): replicas of one service — and services sharing a
+        # bundle — share the host-side weights (each server still owns
+        # its own device state).  Refcounted by live servers and evicted
+        # at zero so a long-lived controller doesn't pin every model it
+        # ever served; keyed by the RESOLVED version so a "" (latest)
+        # ref picks up newly exported versions for new replicas.
         self._bundles: dict[tuple, tuple] = {}
+        self._bundle_refs: dict[tuple, int] = {}
+        self._server_bundles: dict[tuple, list[tuple]] = {}
 
     # -- bundle loading ----------------------------------------------------
     def _load(self, ref):
-        key = (ref.space or "default", ref.id, ref.version)
-        if key not in self._bundles:
-            from ..serve.bundle import load_servable
+        from ..serve.bundle import load_servable
 
-            if self.store is None:
-                raise ValueError(
-                    "run_servers requires an AssetStore (store=...)"
-                )
-            self._bundles[key] = load_servable(
-                self.store, key[0], ref.id, ref.version
+        if self.store is None:
+            raise ValueError(
+                "run_servers requires an AssetStore (store=...)"
             )
-        return self._bundles[key]
+        space = ref.space or "default"
+        asset = self.store.get(space, "model", ref.id, ref.version)
+        key = (space, ref.id, asset.version)
+        if key not in self._bundles:
+            self._bundles[key] = load_servable(
+                self.store, space, ref.id, asset.version
+            )
+        return key, self._bundles[key]
+
+    def _release_bundles(self, keys: list[tuple]) -> None:
+        for key in keys:
+            n = self._bundle_refs.get(key, 0) - 1
+            if n <= 0:
+                self._bundle_refs.pop(key, None)
+                self._bundles.pop(key, None)
+            else:
+                self._bundle_refs[key] = n
 
     # -- reconcile ---------------------------------------------------------
     def reconcile(self, req: Request) -> Result:
@@ -128,8 +144,34 @@ class InferenceServiceReconciler(Reconciler):
             except PlacementError as e:
                 short = str(e)
                 break  # lower indices first; retry fills the rest
+            except (KeyError, ValueError) as e:
+                # Bad bundle ref (missing asset, raw non-servable
+                # checkpoint): a spec problem — surface it as Failed
+                # instead of retrying forever with chips held.
+                return self._fail(svc, f"model bundle unusable: {e}")
 
         return self._update_status(svc, desired, short)
+
+    def _fail(self, svc: InferenceService, msg: str) -> Result:
+        for p in self._owned_pods(svc):
+            self._retire_pod(svc, p)
+        svc.status.phase = "Failed"
+        svc.status.message = msg
+        svc.status.ready_replicas = 0
+        svc.status.endpoints = []
+        svc.status.placements = {}
+        set_condition(
+            svc.status.conditions, "Ready", "False", "BadBundle", msg,
+            observed_generation=svc.metadata.generation,
+        )
+        self.recorder.event(svc, "Warning", "BadBundle", msg)
+        try:
+            self.kube.update_status(svc)
+        except (Conflict, NotFound):
+            return Result(requeue=True)
+        # No requeue: a spec/asset fix bumps generation or a re-export
+        # changes the store; the user retriggers by touching the CR.
+        return Result()
 
     # -- replica lifecycle -------------------------------------------------
     def _owned_pods(self, svc: InferenceService) -> list[Pod]:
@@ -190,10 +232,13 @@ class InferenceServiceReconciler(Reconciler):
             return
         from ..serve.server import LmServer
 
-        model, params, tok = self._load(svc.spec.model)
+        used = []
+        bkey, (model, params, tok) = self._load(svc.spec.model)
+        used.append(bkey)
         draft = None
         if svc.spec.draft.id:
-            dm, dp, _ = self._load(svc.spec.draft)
+            dkey, (dm, dp, _) = self._load(svc.spec.draft)
+            used.append(dkey)
             draft = (dm, dp)
         server = LmServer(
             model, params, tok,
@@ -201,9 +246,13 @@ class InferenceServiceReconciler(Reconciler):
             eos_id=svc.spec.eos_id,
             max_new_tokens_cap=svc.spec.max_new_tokens_cap,
             draft=draft,
+            spec_k=svc.spec.spec_k,
             kv_quant=svc.spec.kv_quant,
         ).start()
         self._servers[key] = server
+        self._server_bundles[key] = used
+        for k in used:
+            self._bundle_refs[k] = self._bundle_refs.get(k, 0) + 1
         self.recorder.event(
             svc, "Normal", "ReplicaServing",
             f"{pod} listening on 127.0.0.1:{server.port}",
@@ -217,6 +266,7 @@ class InferenceServiceReconciler(Reconciler):
                 server.stop()
             except Exception:
                 log.exception("stopping server for %s", pod)
+        self._release_bundles(self._server_bundles.pop(key, []))
 
     def _retire_pod(self, svc: InferenceService, pod: Pod) -> None:
         self._stop_server(svc, pod.metadata.name)
@@ -239,17 +289,21 @@ class InferenceServiceReconciler(Reconciler):
         total = 0
         for (kns, kname, _), server in self._servers.items():
             if (kns, kname) == (ns, name):
-                total += server.batcher._pending.qsize()
+                total += server.batcher.pending_requests
         return total
 
     def _desired_replicas(self, svc: InferenceService) -> int:
         s = svc.spec
         if not s.max_replicas:
             return s.replicas
+        if svc.status.replicas == 0:
+            # First reconcile: spec.replicas is the declared initial
+            # size; autoscaling takes over once the set exists (a fresh
+            # service has no queue to measure yet).
+            return max(s.min_replicas, min(s.max_replicas, s.replicas))
         pending = self._pending(svc)
         svc.status.pending_requests = pending
         want = math.ceil(pending / s.target_pending_per_replica)
-        # Never scale below what serves current traffic boundlessly —
         # min_replicas is the floor even at zero pending.
         return max(s.min_replicas, min(s.max_replicas, want))
 

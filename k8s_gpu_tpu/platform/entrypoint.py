@@ -85,14 +85,15 @@ def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
         mgr.register("DevEnv", DevEnvReconciler(kube))
     if assets is not None:
         mgr.register("Application", GitOpsReconciler(kube, assets))
-    # Serving workloads: real in-process LmServers when the asset store
-    # (servable bundles) is available, placement-only otherwise.
-    mgr.register(
-        "InferenceService",
-        InferenceServiceReconciler(
-            kube, store=assets, run_servers=assets is not None,
-        ),
-    )
+        # Serving workloads need the asset store (servable bundles) —
+        # like GitOps, the reconciler is only wired when it can do the
+        # real thing.  Placement-only mode (run_servers=False) is a
+        # test seam, not a production configuration: it would report
+        # Ready with endpoints that connect to nothing.
+        mgr.register(
+            "InferenceService",
+            InferenceServiceReconciler(kube, store=assets),
+        )
     # GC watches '*': any kind's churn triggers a sweep; the in-reconciler
     # debounce collapses the startup replay storm to one sweep.
     mgr.register(

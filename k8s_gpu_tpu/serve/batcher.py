@@ -944,6 +944,13 @@ class ContinuousBatcher:
         return self._round_count
 
     @property
+    def pending_requests(self) -> int:
+        """Queued-but-unadmitted request count — the autoscale signal
+        (operators/inferenceservice.py) and the same quantity the
+        'serve_pending_requests' gauge reports."""
+        return self._pending.qsize()
+
+    @property
     def spec_stats(self) -> dict:
         """Measured speculative acceptance over live rows: drafted /
         accepted counts and the rate (0.0 when spec is off or nothing

@@ -43,6 +43,7 @@ class LmServer:
         constraints: dict | None = None,
         eos_id: int = -1,
         draft=None,
+        spec_k: int = 4,
         kv_quant: bool = False,
     ):
         """``adapters``: name → (lora_params, LoraConfig); requests pick
@@ -67,7 +68,7 @@ class LmServer:
         self.batcher = ContinuousBatcher(
             model, params, slots=slots, mesh=mesh, adapters=adapters,
             constraints=cbank, eos_id=eos_id, logprobs=True,
-            draft=draft, kv_quant=kv_quant,
+            draft=draft, spec_k=spec_k, kv_quant=kv_quant,
         )
         self.tokenizer = tokenizer
         self.started_at = time.time()

@@ -287,3 +287,36 @@ def test_sample_manifest_validates():
     svc = from_manifest(doc)
     assert svc.spec.max_replicas == 4
     svc.validate()
+
+
+def test_bad_bundle_fails_cleanly(bundle_store):
+    """A missing/unusable bundle is a spec problem: Failed phase with a
+    message, no chips held, no endless retry."""
+    kube, rec = _cluster(run_servers=True, store=bundle_store)
+    svc = _svc(replicas=2)
+    svc.spec.model.id = "no-such-model"
+    kube.create(svc)
+    res = _reconcile(kube, rec)
+    svc = kube.get("InferenceService", "chat")
+    assert svc.status.phase == "Failed"
+    assert "no-such-model" in svc.status.message or "bundle" in svc.status.message
+    assert res.requeue_after is None and not res.requeue
+    free = sum(n.allocatable.get(TPU_RESOURCE, 0)
+               for n in kube.list("Node"))
+    assert free == 16, "chips leaked on Failed service"
+
+
+def test_autoscale_first_reconcile_uses_spec_replicas(bundle_store):
+    """With autoscaling on, the FIRST reconcile sizes to spec.replicas
+    (the declared initial size) — not to min_replicas."""
+    kube, rec = _cluster(run_servers=True, store=bundle_store)
+    kube.create(_svc(replicas=2, slots=2, min_replicas=1, max_replicas=4))
+    try:
+        _reconcile(kube, rec)
+        svc = kube.get("InferenceService", "chat")
+        assert svc.status.replicas == 2, svc.status
+        assert svc.status.ready_replicas == 2
+    finally:
+        kube.delete("InferenceService", "chat")
+        _reconcile(kube, rec)
+    assert not rec._bundles, "bundle cache not evicted at zero refs"
