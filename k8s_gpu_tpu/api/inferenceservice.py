@@ -28,6 +28,9 @@ class InferenceServiceSpec:
     # Optional speculative-decoding draft bundle (serve/speculative.py);
     # empty id = plain decoding.
     draft: AssetRef = field(default_factory=AssetRef)
+    # "ngram" = prompt-lookup drafting (proposals from each row's own
+    # token history, batcher.ngram_propose) — no draft bundle involved.
+    draft_mode: str = ""
     replicas: int = 1
     # Chips carved out of one TPU host per replica (the HAMi-sharing
     # path, scheduling/sharing.py) — serving replicas are single-host;
@@ -97,7 +100,17 @@ class InferenceService(CustomResource):
                 raise ValidationError(
                     "spec.targetPendingPerReplica must be >= 1"
                 )
-        if s.draft.id and s.spec_k < 1:
+        if (s.draft.id or s.draft_mode) and s.spec_k < 1:
             raise ValidationError(
-                "speculative serving (spec.draft) needs spec.specK >= 1"
+                "speculative serving (spec.draft / spec.draftMode) needs "
+                "spec.specK >= 1"
+            )
+        if s.draft_mode not in ("", "ngram"):
+            raise ValidationError(
+                "spec.draftMode must be '' or 'ngram'"
+            )
+        if s.draft_mode and s.draft.id:
+            raise ValidationError(
+                "spec.draftMode and spec.draft are mutually exclusive "
+                "(ngram drafting uses no draft bundle)"
             )

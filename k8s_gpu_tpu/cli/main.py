@@ -821,7 +821,12 @@ def cmd_serve(args) -> int:
         model, params, tok = load_servable(
             p.assets, ctx.space, args.model, args.version
         )
-        if args.draft:
+        if args.draft == "ngram":
+            # Prompt-lookup drafting: proposals from each row's own
+            # token history — no draft bundle to load, no draft
+            # forward at serve time (batcher.ngram_propose).
+            draft = "ngram"
+        elif args.draft:
             # Speculative serving: the draft is its own servable bundle
             # (typically distill_draft's output exported beside the
             # target); vocab compatibility is checked by the batcher.
@@ -1069,8 +1074,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_srv.add_argument("--eos-id", type=int, default=-1,
                        help="EOS token id (set when using constraints)")
     p_srv.add_argument("--draft", default="",
-                       help="draft model asset id: speculative decoding "
-                            "in the batcher's shared rounds")
+                       help="speculative decoding in the batcher's shared "
+                            "rounds: a draft model asset id, or 'ngram' "
+                            "for prompt-lookup drafting (no draft model)")
     p_srv.add_argument("--kv-quant", action="store_true",
                        help="int8 KV cache (~1.9x slot capacity)")
     p_srv.add_argument("--for-seconds", type=float, default=0.0,

@@ -236,7 +236,9 @@ class InferenceServiceReconciler(Reconciler):
         bkey, (model, params, tok) = self._load(svc.spec.model)
         used.append(bkey)
         draft = None
-        if svc.spec.draft.id:
+        if svc.spec.draft_mode == "ngram":
+            draft = "ngram"
+        elif svc.spec.draft.id:
             dkey, (dm, dp, _) = self._load(svc.spec.draft)
             used.append(dkey)
             draft = (dm, dp)

@@ -344,7 +344,10 @@ def test_admit_crash_aborts_popped_request(setup):
     model, params, _ = setup
     b = ContinuousBatcher(model, params, slots=2).start()
     try:
-        b._admit_jit = None  # force a TypeError inside _dispatch_admit
+        # Force a TypeError inside dispatch, whichever admission program
+        # the scheduler picks (fused cold-solo or the plain admit).
+        b._admit_jit = None
+        b._admit_round_jit = None
         h = b.submit([1, 2, 3], max_new_tokens=4)
         got = h.result()  # must return promptly
         assert h.aborted and got == []

@@ -320,3 +320,44 @@ def test_autoscale_first_reconcile_uses_spec_replicas(bundle_store):
         kube.delete("InferenceService", "chat")
         _reconcile(kube, rec)
     assert not rec._bundles, "bundle cache not evicted at zero refs"
+
+
+def test_draft_mode_validation():
+    svc = _svc()
+    svc.spec.draft_mode = "lookahead"
+    with pytest.raises(ValidationError, match="draftMode"):
+        svc.validate()
+    svc.spec.draft_mode = "ngram"
+    svc.spec.draft = AssetRef(space="default", id="tiny-draft")
+    with pytest.raises(ValidationError, match="mutually exclusive"):
+        svc.validate()
+    svc.spec.draft = AssetRef()
+    svc.validate()  # ngram alone is fine
+
+
+def test_ngram_draft_mode_serves(bundle_store):
+    """spec.draftMode='ngram' reaches the batcher (prompt-lookup
+    speculative rounds) and the endpoint still serves correctly."""
+    kube, rec = _cluster(run_servers=True, store=bundle_store)
+    kube.create(_svc(replicas=1, slots=2, draft_mode="ngram"))
+    try:
+        _reconcile(kube, rec)
+        svc = kube.get("InferenceService", "chat")
+        assert svc.status.phase == "Ready", svc.status
+        (key,) = list(rec._servers)
+        assert rec._servers[key].batcher.spec_mode == "ngram"
+        ep = svc.status.endpoints[0]
+        body = json.dumps(
+            {"prompt": "the quick", "max_new_tokens": 4}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://{ep}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert "text" in out or "ids" in out, out
+    finally:
+        kube.delete("InferenceService", "chat")
+        _reconcile(kube, rec)
+    assert not rec._servers
