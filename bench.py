@@ -394,12 +394,21 @@ def batched_decode_probe(model, params) -> dict:
         # scheduler (r04 first-cut artifact: cb_8req looked 7x slow).
         run(1)
         run(8)
-        t0 = time.perf_counter()
-        n1 = run(1)
-        dt1 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        n8 = run(8)
-        dt8 = time.perf_counter() - t0
+
+        def best(n_req, trials=3):
+            # Best-of-N: a single sample can eat a stray t_hi-variant
+            # compile (bucket choice races with emission draining) and
+            # read 10x slow; the min is the steady state.
+            best_dt, n = None, 0
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                n = run(n_req)
+                dt = time.perf_counter() - t0
+                best_dt = dt if best_dt is None else min(best_dt, dt)
+            return n, best_dt
+
+        n1, dt1 = best(1)
+        n8, dt8 = best(8)
         return {
             "cb_decode_tokens_per_s_1req": n1 / dt1,
             "cb_decode_tokens_per_s_8req": n8 / dt8,

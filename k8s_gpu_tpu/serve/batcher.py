@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from ..utils.compat import serialize_xla_compiles
 from ..utils.metrics import global_metrics
 from .engine import InferenceEngine, _empty_cache, nucleus_mask
 from .speculative import reject_row
@@ -92,6 +93,13 @@ def ngram_propose(hist, token, pos, k: int, m: int = 3):
     ext = jnp.concatenate([hist, jnp.full((k,), -1, jnp.int32)])
     g = jax.lax.dynamic_slice(ext, (j,), (k,))
     return jnp.where((score[j] > 0) & (g >= 0), g, token)
+
+
+def _param_count(tree) -> int:
+    """Total array elements in a param tree — the relative-decode-cost
+    proxy speculative round sizing uses (decode streams every weight
+    byte once per step, so cost scales with parameter count)."""
+    return sum(int(x.size) for x in jax.tree.leaves(tree))
 
 
 def _suffix_bucket(n: int) -> int:
@@ -380,14 +388,24 @@ class ContinuousBatcher:
             # of paying a whole dispatch for 1..K+1 tokens (measured:
             # token-parity sizing put ngram at 0.24x plain on v5e purely
             # on dispatch overhead).  A neural draft adds K draft
-            # forwards per sub-round; charging each at ~half a target
-            # step (drafts are smaller but not free) gives sub-round
-            # cost ~ 1 + K/2 target-steps, so the count shrinks with K
-            # and a dispatch's wall-clock stays near a plain round's.
-            self.spec_rounds = (
-                self.steps_per_round if self.spec_mode == "ngram"
-                else max(1, self.steps_per_round * 2 // (2 + self.spec_k))
-            )
+            # forwards per sub-round, each costing ~(draft params /
+            # target params) of a target step (decode is HBM-bound on
+            # the weights), so a sub-round costs ~ 1 + K*r target-steps
+            # and the count scales by the MEASURABLE ratio instead of a
+            # guess — a 10%-size draft barely shrinks it, a same-size
+            # draft divides it by K+1.
+            if self.spec_mode == "ngram":
+                self.spec_rounds = self.steps_per_round
+            else:
+                r = _param_count(self.draft_params) / max(
+                    1, _param_count(params)
+                )
+                self.spec_rounds = max(
+                    1,
+                    int(round(
+                        self.steps_per_round / (1.0 + self.spec_k * r)
+                    )),
+                )
         # Host-side scheduler state.  No position mirror is needed: submit
         # clamps max_new to the decode room, so the budget always retires a
         # slot before its writes could run past max_seq (out-of-bounds
@@ -460,6 +478,11 @@ class ContinuousBatcher:
         )
         self._prefix_cap = 4
         self._prefix_lock = threading.Lock()
+        # The scheduler loop compiles round variants from its own thread
+        # while the embedding process may compile elsewhere; this
+        # jaxlib's compiler races under concurrent compiles (utils/
+        # compat.py) — serialize them before the thread exists.
+        serialize_xla_compiles()
         self._thread = threading.Thread(
             target=self._loop, name="continuous-batcher", daemon=True
         )
@@ -740,6 +763,58 @@ class ContinuousBatcher:
             "aidx": dev["aidx"], "cidx": dev["cidx"], "cstate": cstate,
         }, (toks, lps)
 
+    def _spec_accept(self, vlogits, g, q, rkeys, temps, top_p, use_top_p):
+        """THE verify/accept/advance math both speculative surfaces ride
+        (neural-draft `_round_spec_dev` and ngram `_round_spec_ngram_dev`)
+        — one implementation so the two cannot drift (the same hazard
+        reject_row's docstring names).
+
+        ``vlogits`` [B, K+1, V] target verify logits over each row's
+        [token, g] window; ``g`` [B, K] proposals; ``q`` [B, K, V] the
+        warped distributions the proposals were drawn from (a one-hot
+        delta for deterministic drafts); ``rkeys`` [B] rejection keys.
+        Returns (e [B, K+1] emitted tokens, n [B] = accepted+1, lp,
+        a [B] accepted counts, new_token [B] the next feed)."""
+        K = g.shape[1]
+        B = g.shape[0]
+        sampled_row = temps > 0.0
+
+        def warp(logits):
+            scaled = (
+                logits.astype(jnp.float32)
+                / jnp.maximum(temps, 1e-6)[:, None]
+            )
+            if use_top_p:
+                scaled = nucleus_mask(scaled, top_p)
+            return scaled
+
+        # Greedy: longest target-argmax-matching prefix.
+        t_pred = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+        match = (g == t_pred[:, :K]).astype(jnp.int32)
+        a_g = jnp.cumprod(match, axis=1).sum(axis=1)
+        # Sampled: per-row rejection sampling on warped p/q.
+        p = jax.nn.softmax(
+            jax.vmap(warp, in_axes=1, out_axes=1)(vlogits), axis=-1
+        )                                                   # [B,K+1,V]
+        a_s, x = jax.vmap(reject_row)(rkeys, p, q, g)
+        a = jnp.where(sampled_row, a_s, a_g)
+        corr = jnp.where(
+            sampled_row[:, None],
+            jnp.broadcast_to(x[:, None], (B, K + 1)),
+            t_pred,
+        )
+        idx = jnp.arange(K + 1, dtype=jnp.int32)[None]
+        base = jnp.concatenate([g, g[:, -1:]], axis=1)
+        e = jnp.where(idx < a[:, None], base, corr)         # [B,K+1]
+        n = a + 1
+        if self.collect_logprobs:
+            lsm = jax.nn.log_softmax(vlogits.astype(jnp.float32), axis=-1)
+            lp = jnp.take_along_axis(lsm, e[..., None], axis=2)[..., 0]
+        else:
+            lp = jnp.zeros((B, K + 1), jnp.float32)
+        new_token = jnp.take_along_axis(e, a[:, None], 1)[:, 0]
+        return e, n, lp, a, new_token
+
     def _round_spec_dev(self, params, dparams, dev, bank, use_top_p,
                         n_rounds, t_hi=None):
         """Speculative scheduler round(s): ``spec_rounds`` × (K draft
@@ -812,37 +887,15 @@ class ContinuousBatcher:
                 adapters=bank, adapter_idx=dev["aidx"] if bank else None,
                 t_hi=t_hi,
             )
-            # 3a. Greedy: longest target-argmax-matching prefix.
-            t_pred = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
-            match = (g == t_pred[:, :K]).astype(jnp.int32)
-            a_g = jnp.cumprod(match, axis=1).sum(axis=1)
-            # 3b. Sampled: per-row rejection sampling on warped p/q.
-            p = jax.nn.softmax(
-                jax.vmap(warp, in_axes=1, out_axes=1)(vlogits), axis=-1
-            )                                                   # [B,K+1,V]
+            # 3. Accept/correct via the shared math (_spec_accept).
             q = jnp.stack(qs, axis=1)                           # [B,K,V]
-            a_s, x = jax.vmap(reject_row)(split[:, K + 1], p, q, g)
-            a = jnp.where(sampled_row, a_s, a_g)
-            corr = jnp.where(
-                sampled_row[:, None],
-                jnp.broadcast_to(x[:, None], (B, K + 1)),
-                t_pred,
+            e, n, lp, a, new_token = self._spec_accept(
+                vlogits, g, q, split[:, K + 1], temps, dev["top_p"],
+                use_top_p,
             )
-            idx = jnp.arange(K + 1, dtype=jnp.int32)[None]
-            base = jnp.concatenate([g, g[:, -1:]], axis=1)
-            e = jnp.where(idx < a[:, None], base, corr)         # [B,K+1]
-            n = a + 1
-            if self.collect_logprobs:
-                lsm = jax.nn.log_softmax(
-                    vlogits.astype(jnp.float32), axis=-1
-                )
-                lp = jnp.take_along_axis(lsm, e[..., None], axis=2)[..., 0]
-            else:
-                lp = jnp.zeros((B, K + 1), jnp.float32)
             # 4. Advance: prev/token slide to the accepted frontier —
             #    window[a] sits at the new pos-1, e[a] is the next feed.
             new_prev = jnp.take_along_axis(window, a[:, None], 1)[:, 0]
-            new_token = jnp.take_along_axis(e, a[:, None], 1)[:, 0]
             return (
                 cache, d_cache, new_token, new_prev, pos + n, rope + n,
                 new_keys,
@@ -886,18 +939,7 @@ class ContinuousBatcher:
         K = self.spec_k
         kv_start = dev["start"]
         temps = dev["temps"]
-        B = kv_start.shape[0]
         V = self.engine.cfg.vocab_size
-        sampled_row = temps > 0.0
-
-        def warp(logits):
-            scaled = (
-                logits.astype(jnp.float32)
-                / jnp.maximum(temps, 1e-6)[:, None]
-            )
-            if use_top_p:
-                scaled = nucleus_mask(scaled, dev["top_p"])
-            return scaled
 
         def one(carry, _):
             cache, hist, token, pos, rope, keys = carry
@@ -912,37 +954,15 @@ class ContinuousBatcher:
                 adapters=bank, adapter_idx=dev["aidx"] if bank else None,
                 t_hi=t_hi,
             )
-            t_pred = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
-            match = (g == t_pred[:, :K]).astype(jnp.int32)
-            a_g = jnp.cumprod(match, axis=1).sum(axis=1)
-            p = jax.nn.softmax(
-                jax.vmap(warp, in_axes=1, out_axes=1)(vlogits), axis=-1
-            )
             q = jax.nn.one_hot(g, V, dtype=jnp.float32)         # [B,K,V]
-            a_s, x = jax.vmap(reject_row)(rkeys, p, q, g)
-            a = jnp.where(sampled_row, a_s, a_g)
-            corr = jnp.where(
-                sampled_row[:, None],
-                jnp.broadcast_to(x[:, None], (B, K + 1)),
-                t_pred,
+            e, n, lp, a, new_token = self._spec_accept(
+                vlogits, g, q, rkeys, temps, dev["top_p"], use_top_p,
             )
-            idx = jnp.arange(K + 1, dtype=jnp.int32)[None]
-            base = jnp.concatenate([g, g[:, -1:]], axis=1)
-            e = jnp.where(idx < a[:, None], base, corr)         # [B,K+1]
-            n = a + 1
-            if self.collect_logprobs:
-                lsm = jax.nn.log_softmax(
-                    vlogits.astype(jnp.float32), axis=-1
-                )
-                lp = jnp.take_along_axis(lsm, e[..., None], axis=2)[..., 0]
-            else:
-                lp = jnp.zeros((B, K + 1), jnp.float32)
             hist = jax.vmap(
                 lambda h, ee, p_: jax.lax.dynamic_update_slice(
                     h, ee, (p_ + 1,)
                 )
             )(hist, e, pos)
-            new_token = jnp.take_along_axis(e, a[:, None], 1)[:, 0]
             return (
                 cache, hist, new_token, pos + n, rope + n, new_keys,
             ), (e, n, lp)
@@ -1200,7 +1220,13 @@ class ContinuousBatcher:
         h[pos0 - ids.size: pos0] = ids
         return jnp.asarray(h)
 
-    def _dispatch_admit(self, req: _Request, slot: int) -> tuple:
+    _ENTRY_UNRESOLVED = object()
+
+    def _dispatch_admit(self, req: _Request, slot: int,
+                        entry=_ENTRY_UNRESOLVED) -> tuple:
+        """``entry``: the prefix-cache match for ``req.ids`` when the
+        caller already looked it up (the _loop fused gate does); left
+        unset, it is resolved here."""
         ctab = self.cbank.banked if self.cbank else None
         if req.precomputed is not None:
             row, logits, pos, rope, start = req.precomputed
@@ -1227,7 +1253,8 @@ class ContinuousBatcher:
             return self._seated(req, slot, first, lp, "precomputed")
         # Prefix-cache entries hold BASE-model K/V; an adapter row must
         # cold-prefill (its prefix K/V differ) — correctness over reuse.
-        entry = self._match_prefix(req.ids) if req.aidx == 0 else None
+        if entry is ContinuousBatcher._ENTRY_UNRESOLVED:
+            entry = self._match_prefix(req.ids) if req.aidx == 0 else None
         if entry is not None and entry["n"] == req.ids.size:
             # The prompt IS a cached prefix: splice + sample, zero forward.
             req.pos_hint = int(entry["n"])
@@ -1584,7 +1611,14 @@ class ContinuousBatcher:
                         # Idle cold solo start → fuse admission with the
                         # first tail-sized round in one dispatch (plain
                         # mode; prefix/disagg admissions keep their own
-                        # cheaper programs).
+                        # cheaper programs).  The prefix lookup runs once
+                        # here and feeds both the gate and the unfused
+                        # admit path.
+                        entry = (
+                            self._match_prefix(req.ids)
+                            if req.aidx == 0 and req.precomputed is None
+                            else None
+                        )
                         fused = (
                             self.spec_mode is None
                             and not inflight
@@ -1594,15 +1628,16 @@ class ContinuousBatcher:
                             and not any(
                                 r is not None for r in self._active
                             )
-                            and (req.aidx != 0
-                                 or self._match_prefix(req.ids) is None)
+                            and entry is None
                         )
                         if fused:
                             inflight.append(
                                 self._dispatch_admit_round(req, slot)
                             )
                         else:
-                            inflight.append(self._dispatch_admit(req, slot))
+                            inflight.append(
+                                self._dispatch_admit(req, slot, entry)
+                            )
                     except BaseException:
                         # The popped request is in neither _pending nor
                         # _active yet — the crash drain below would miss

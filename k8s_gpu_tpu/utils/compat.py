@@ -1,0 +1,51 @@
+"""Workarounds for upstream bugs in pinned dependencies.
+
+The reference has no analogue (it pins no accelerator runtime at all);
+this module exists because the framework drives JAX from background
+threads (serve/batcher.py's scheduler loop) and the environment's jaxlib
+has a thread-safety bug in its CPU compiler.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_compile_lock = threading.Lock()
+_install_lock = threading.Lock()
+_installed = False
+
+
+def serialize_xla_compiles() -> None:
+    """Serialize all XLA backend compiles behind one process-wide lock.
+
+    This jaxlib's CPU compiler segfaults when two threads compile
+    concurrently — observed repeatedly in full-suite runs as a hard
+    ``Fatal Python error: Segmentation fault`` inside
+    ``jax._src.compiler.backend_compile_and_load`` with a second thread
+    (the continuous batcher's scheduler loop) also inside a compile.
+    Compilation is a tiny fraction of steady-state serving time, so the
+    lock costs nothing once programs are warm.
+
+    Idempotent; call early (before the racing threads start).  Wraps a
+    private jax API on purpose: the environment pins jax/jaxlib, and the
+    patch degrades to a no-op wrapper on any version that has fixed the
+    underlying race."""
+    global _installed
+    with _install_lock:  # two threads racing here must not double-wrap
+        if _installed:
+            return
+        from jax._src import compiler as _compiler
+
+        orig = getattr(_compiler, "backend_compile_and_load", None)
+        if orig is None:
+            # A jax that renamed the private symbol presumably also
+            # fixed the race — degrade to a no-op as documented.
+            _installed = True
+            return
+
+        def locked(*args, **kwargs):
+            with _compile_lock:
+                return orig(*args, **kwargs)
+
+        _compiler.backend_compile_and_load = locked
+        _installed = True

@@ -26,6 +26,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# This jaxlib's CPU compiler is not thread-safe: a main-thread compile
+# racing a batcher-thread compile segfaults the process (seen thrice in
+# full-suite runs, always inside backend_compile_and_load).  Serialize
+# compiles for the whole test process.
+from k8s_gpu_tpu.utils.compat import serialize_xla_compiles  # noqa: E402
+
+serialize_xla_compiles()
+
 import pytest  # noqa: E402
 
 from k8s_gpu_tpu.controller import FakeKube, Manager  # noqa: E402
