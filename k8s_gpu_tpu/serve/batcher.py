@@ -41,6 +41,7 @@ import collections
 import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -48,7 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from ..utils.compat import serialize_xla_compiles
+from ..utils.compat import large_thread_stack, serialize_xla_compiles
 from ..utils.metrics import global_metrics
 from .engine import InferenceEngine, _empty_cache, nucleus_mask
 from .speculative import reject_row
@@ -160,6 +161,13 @@ class _Request:
     # True when the stream ended because the batcher crashed/stopped, not
     # because of EOS/budget — servers map this to a 5xx, not a 200.
     aborted: bool = False
+    # Latency telemetry (host wall-clock, seconds): submit time, admit
+    # dispatch time, first/last emission time.  Feed the C32 serving
+    # histograms at retirement (queue wait, TTFT, inter-token gap).
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first: float = 0.0
+    t_last: float = 0.0
 
 
 class RequestHandle:
@@ -984,7 +992,11 @@ class ContinuousBatcher:
 
     # -- public surface ----------------------------------------------------
     def start(self) -> "ContinuousBatcher":
-        self._thread.start()
+        # Enlarged stack for the scheduler thread: it compiles round
+        # variants, and XLA codegen recursion can blow a default worker
+        # stack (utils/compat.py:large_thread_stack has the account).
+        with large_thread_stack():
+            self._thread.start()
         return self
 
     def stop(self) -> None:
@@ -1023,6 +1035,7 @@ class ContinuousBatcher:
             seed=int(seed),
             aidx=aidx,
             cidx=cidx,
+            t_submit=time.monotonic(),
         )
         with self._lifecycle:
             if self._dead:
@@ -1089,6 +1102,7 @@ class ContinuousBatcher:
                 row_cache, last_logits, n_tokens, n_tokens - pad, pad,
             ),
             on_admit=on_admit,
+            t_submit=time.monotonic(),
         )
         with self._lifecycle:
             if self._dead:
@@ -1350,6 +1364,10 @@ class ContinuousBatcher:
         (admissions by path, live-slot gauge, pending-queue gauge)."""
         req.slot = slot
         self._active[slot] = req
+        req.t_admit = time.monotonic()
+        global_metrics.observe(
+            "serve_queue_wait_seconds", req.t_admit - req.t_submit
+        )
         # The admit's first token is already in flight: the budget gate
         # must see it, or a freshly admitted max_new=1 request triggers a
         # round that is 100% garbage (and every tail round sizes one
@@ -1447,6 +1465,9 @@ class ContinuousBatcher:
     def _emit(self, req: _Request, tok: int, round_id: int,
               lp: float = 0.0) -> None:
         req.emitted += 1
+        req.t_last = time.monotonic()
+        if req.emitted == 1:
+            req.t_first = req.t_last
         self._interleave_log.append((round_id, req.slot))
         # One queue item carries both — the handle collects logprobs on
         # ITS side of the thread boundary (no per-token list snapshots).
@@ -1460,6 +1481,19 @@ class ContinuousBatcher:
             global_metrics.observe(
                 "serve_generated_tokens", float(req.emitted)
             )
+            # C32 latency budget surface: time-to-first-token and mean
+            # inter-token gap per request (emission-side wall-clock —
+            # tokens reach the host in round batches, so the gap is the
+            # per-request STREAMING rate, dispatch cadence included).
+            if req.emitted >= 1 and req.t_first > 0.0:
+                global_metrics.observe(
+                    "serve_ttft_seconds", req.t_first - req.t_submit
+                )
+            if req.emitted >= 2 and req.t_first > 0.0:
+                global_metrics.observe(
+                    "serve_inter_token_seconds",
+                    (req.t_last - req.t_first) / (req.emitted - 1),
+                )
         self._active[slot] = None
         global_metrics.set_gauge(
             "serve_slots_active",

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compat import large_thread_stack
 from .batcher import ContinuousBatcher, RequestHandle, prompt_bucket
 
 
@@ -114,8 +115,11 @@ class DisaggregatedLm:
         ]
 
     def start(self) -> "DisaggregatedLm":
-        for t in self._threads:
-            t.start()
+        # Prefill workers compile bucketed variants on their own threads
+        # — enlarged stack, same account as the batcher's scheduler.
+        with large_thread_stack():
+            for t in self._threads:
+                t.start()
         return self
 
     def stop(self) -> None:

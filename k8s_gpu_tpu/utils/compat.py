@@ -8,11 +8,34 @@ has a thread-safety bug in its CPU compiler.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 _compile_lock = threading.Lock()
 _install_lock = threading.Lock()
 _installed = False
+
+
+@contextlib.contextmanager
+def large_thread_stack(nbytes: int = 64 << 20):
+    """Start threads under an enlarged fixed stack.
+
+    ``threading.stack_size`` is consumed at OS-thread creation inside
+    ``Thread.start()`` — NOT at ``Thread()`` construction — so this must
+    wrap the ``.start()`` call.  XLA's CPU codegen recurses deeply
+    enough to blow a worker thread's default stack (segfault inside
+    ``backend_compile_and_load`` with no concurrent compile); the
+    growable main-thread stack never hits this, so only spawned
+    compile-capable threads need it."""
+    try:
+        prev = threading.stack_size(nbytes)
+    except (ValueError, RuntimeError):
+        prev = None
+    try:
+        yield
+    finally:
+        if prev is not None:
+            threading.stack_size(prev)
 
 
 def serialize_xla_compiles() -> None:

@@ -322,14 +322,30 @@ def test_serving_metrics_recorded(setup):
     b = ContinuousBatcher(model, params, slots=2).start()
     try:
         b.precache_prefix([5, 9, 17])
+        cold0 = sum(
+            global_metrics.counter("serve_admissions_total", path=p)
+            for p in ("cold", "cold_fused")
+        )
         b.submit([5, 9, 17, 4], max_new_tokens=3).result()   # prefix_suffix
         b.submit([5, 9, 17], max_new_tokens=3).result()      # prefix_exact
-        b.submit([8, 6], max_new_tokens=3).result()          # cold
+        b.submit([8, 6], max_new_tokens=3).result()          # cold (fused
+        # when the batcher happens to be idle at admit — either path)
         rendered = global_metrics.render()
-        for path in ("cold", "prefix_suffix", "prefix_exact"):
+        for path in ("prefix_suffix", "prefix_exact"):
             assert f'serve_admissions_total{{path="{path}"}}' in rendered, path
+        cold1 = sum(
+            global_metrics.counter("serve_admissions_total", path=p)
+            for p in ("cold", "cold_fused")
+        )
+        assert cold1 == cold0 + 1, (cold0, cold1)
         assert "serve_completions_total" in rendered
         assert global_metrics.gauge("serve_slots_active") == 0.0
+        # Latency budget surface: queue wait, TTFT, inter-token gap.
+        for h in ("serve_queue_wait_seconds", "serve_ttft_seconds",
+                  "serve_inter_token_seconds"):
+            hist = global_metrics.histogram(h)
+            assert hist is not None and hist.n >= 1, h
+            assert hist.mean >= 0.0, h
     finally:
         b.stop()
 

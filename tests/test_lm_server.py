@@ -160,17 +160,23 @@ def test_concurrent_http_requests_interleave(server):
         results[name] = _post(server, "/generate",
                               {"prompt": prompt, "max_new_tokens": n})
 
-    before = server.batcher.steps_taken
-    ta = threading.Thread(target=go, args=("a", "the cat sat", 24))
-    tb = threading.Thread(target=go, args=("b", "the dog", 24))
-    ta.start(); tb.start(); ta.join(); tb.join()
-    assert results["a"][0] == 200 and results["b"][0] == 200
-    log = [e for e in server.batcher.interleave_log if e[0] >= before]
-    slots = {s for _, s in log}
-    assert len(slots) >= 2
-    steps = {s: {st for st, sl in log if sl == s} for s in slots}
-    vals = list(steps.values())
-    assert vals[0] & vals[1], "requests were serialized, not interleaved"
+    # Timing race under load: if thread B's HTTP post lags until A has
+    # already drained, no shared round EXISTS to observe — retry a few
+    # times and fail only if no attempt ever interleaves.
+    for attempt in range(3):
+        before = server.batcher.steps_taken
+        ta = threading.Thread(target=go, args=("a", "the cat sat", 24))
+        tb = threading.Thread(target=go, args=("b", "the dog", 24))
+        ta.start(); tb.start(); ta.join(); tb.join()
+        assert results["a"][0] == 200 and results["b"][0] == 200
+        log = [e for e in server.batcher.interleave_log if e[0] >= before]
+        slots = {s for _, s in log}
+        if len(slots) >= 2:
+            steps = {s: {st for st, sl in log if sl == s} for s in slots}
+            vals = list(steps.values())
+            if vals[0] & vals[1]:
+                return
+    raise AssertionError("requests were serialized in all 3 attempts")
 
 
 def test_precache_endpoint(server):

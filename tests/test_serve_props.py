@@ -49,19 +49,32 @@ _ADAPTERS, _MERGED = _adapter()
 _ORACLE_CACHE: dict = {}
 
 
+@jax.jit
+def _oracle_logits(params, padded):
+    return _MODEL.forward(params, padded)[0]
+
+
 def _oracle(ids, n, adapter):
+    """Greedy reference via the FULL forward (independent of the engine's
+    cached decode).  One fixed [1, max_seq] shape for every call — the
+    causal mask makes right-pad garbage invisible to position len-1, and
+    the growing-shape variant compiled a fresh XLA program per emitted
+    token, which at full-suite scale (hundreds of eager compiles) tips
+    this jaxlib's CPU compiler into a segfault (utils/compat.py)."""
     key = (tuple(ids), n, adapter)
     if key not in _ORACLE_CACHE:
         params = _MERGED if adapter else _PARAMS
-        seq = jnp.asarray(ids, jnp.int32)[None, :]
+        S = CFG.max_seq
+        seq = list(int(t) for t in ids)
         out = []
         for _ in range(n):
-            logits, _ = _MODEL.forward(params, seq)
-            nxt = int(jnp.argmax(logits[:, -1], axis=-1)[0])
-            out.append(nxt)
-            seq = jnp.concatenate(
-                [seq, jnp.asarray([[nxt]], jnp.int32)], axis=1
+            padded = jnp.zeros((1, S), jnp.int32).at[0, : len(seq)].set(
+                jnp.asarray(seq, jnp.int32)
             )
+            logits = _oracle_logits(params, padded)
+            nxt = int(jnp.argmax(logits[0, len(seq) - 1]))
+            out.append(nxt)
+            seq.append(nxt)
         _ORACLE_CACHE[key] = out
     return _ORACLE_CACHE[key]
 
