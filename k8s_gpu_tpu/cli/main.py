@@ -10,6 +10,7 @@ Run as ``python -m k8s_gpu_tpu.cli ...``.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 from pathlib import Path
@@ -855,6 +856,24 @@ def cmd_serve(args) -> int:
     constraints = _parse_kv(args.constraint, "--constraint")
     if constraints is None:
         return 2
+    schemas = _parse_kv(args.json_constraint, "--json-constraint")
+    if schemas is None:
+        return 2
+    if schemas:
+        # NAME=schema.json → regex over canonical JSON; requests opt in
+        # with {"constraint": NAME} exactly like plain-regex patterns.
+        from ..serve.jsonschema import SchemaError, schema_to_regex
+    for name, path in (schemas or {}).items():
+        if name in constraints:
+            print(f"--json-constraint {name} collides with --constraint "
+                  f"{name}: pick distinct names", file=sys.stderr)
+            return 2
+        try:
+            with open(path) as f:
+                constraints[name] = schema_to_regex(json.load(f))
+        except (OSError, json.JSONDecodeError, SchemaError) as e:
+            print(f"--json-constraint {name}: {e}", file=sys.stderr)
+            return 2
     if constraints and args.eos_id < 0:
         # A dead-ended constrained row retires by emitting EOS; without
         # one it would stream token 0 as if it were generated content.
@@ -1071,6 +1090,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_srv.add_argument("--constraint", action="append", metavar="NAME=REGEX",
                        help="named decoding constraint (repeatable); "
                             "requests opt in with {'constraint': NAME}")
+    p_srv.add_argument("--json-constraint", action="append",
+                       metavar="NAME=SCHEMA.json",
+                       help="named JSON-schema constraint (repeatable): "
+                            "the schema file compiles to a canonical-JSON "
+                            "regex; requests opt in with "
+                            "{'constraint': NAME}")
     p_srv.add_argument("--eos-id", type=int, default=-1,
                        help="EOS token id (set when using constraints)")
     p_srv.add_argument("--draft", default="",

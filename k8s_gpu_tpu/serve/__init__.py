@@ -6,6 +6,7 @@ from .bundle import export_servable, load_servable
 from .constrain import RegexConstraint, compile_constraint
 from .disagg import DisaggregatedLm
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
+from .jsonschema import SchemaError, schema_to_regex
 from .quant import quantize_params
 from .server import LmServer
 from .speculative import SpecOutput, SpeculativeDecoder, distill_draft
@@ -15,5 +16,5 @@ __all__ = [
     "ContinuousBatcher", "RequestHandle", "SpeculativeDecoder",
     "SpecOutput", "quantize_params", "export_servable", "load_servable",
     "DisaggregatedLm", "RegexConstraint", "compile_constraint",
-    "distill_draft",
+    "distill_draft", "schema_to_regex", "SchemaError",
 ]

@@ -1,0 +1,141 @@
+"""JSON-schema constrained decoding: schema → regex → token DFA.
+
+The reference's serving story delegates structure to prompt engineering
+(智能风控解决方案.md:250-266 asks the LLM nicely); modern serving stacks
+offer schema-constrained output (OpenAI ``response_format``, vLLM
+guided decoding).  Here the schema compiles to a regex over the
+CANONICAL JSON serialization, and the existing regex→DFA pipeline
+(serve/constrain.py) does the rest — one code path enforces both plain
+regex and JSON-schema constraints, banked per request in shared decode
+rounds.
+
+Canonical form (what the DFA admits — also what ``json.dumps(...,
+separators=(",", ":"))`` emits):
+
+- no whitespace outside strings;
+- object properties in DECLARATION order, all present (constrained
+  generation must decide the next token greedily — optional/reordered
+  keys would make the automaton ambiguous about which key comes next;
+  callers mark truly-optional fields as nullable instead);
+- strings admit any character except ``"``, ``\\`` and control chars,
+  plus ``\\"`` ``\\\\`` ``\\/`` ``\\b`` ``\\f`` ``\\n`` ``\\r`` ``\\t``
+  and ``\\uXXXX`` escapes.
+
+Supported schema subset: ``type`` ∈ {string, integer, number, boolean,
+null, array, object}, ``enum`` (JSON scalars), ``properties`` (fixed
+order), ``items``, ``minItems`` ∈ {0, 1}, string ``pattern`` (embedded
+verbatim — the author's regex replaces the default string body).
+``maxItems``/``additionalProperties``/``$ref`` are rejected loudly:
+a constraint that silently under-constrains is worse than none.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["schema_to_regex", "SchemaError"]
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def _lit(text: str) -> str:
+    """Regex matching *text* literally (escape every non-alphanumeric —
+    constrain.py's parser treats ``\\X`` as literal X for non-alnum)."""
+    return "".join(c if c.isalnum() else "\\" + c for c in text)
+
+
+# One JSON string character: anything but quote/backslash/the full
+# control range 0x00-0x1F (json.loads rejects raw controls), or a
+# sanctioned escape.  The control characters are embedded RAW in the
+# class — constrain.py's class parser takes any character literally.
+_CTRL = "".join(chr(i) for i in range(0x20))
+_STRING_CHAR = (
+    '([^"\\\\' + _CTRL + ']'
+    '|\\\\(["\\\\/bfnrt]|u[0-9a-fA-F][0-9a-fA-F][0-9a-fA-F][0-9a-fA-F]))'
+)
+_STRING = '"' + _STRING_CHAR + '*"'
+_INTEGER = "\\-?(0|[1-9][0-9]*)"
+_NUMBER = _INTEGER + "(\\.[0-9]+)?([eE][\\-\\+]?[0-9]+)?"
+
+
+def schema_to_regex(schema: dict) -> str:
+    """Compile a JSON-schema subset to a regex over canonical JSON."""
+    if not isinstance(schema, dict):
+        raise SchemaError(f"schema must be an object, got {type(schema).__name__}")
+    for unsupported in ("$ref", "maxItems", "additionalProperties",
+                        "anyOf", "oneOf", "allOf"):
+        if unsupported in schema:
+            raise SchemaError(
+                f"unsupported schema keyword {unsupported!r} — the DFA "
+                "would silently under-constrain"
+            )
+    if "enum" in schema:
+        opts = []
+        for v in schema["enum"]:
+            if isinstance(v, (dict, list)):
+                raise SchemaError("enum values must be JSON scalars")
+            opts.append(_lit(json.dumps(v, separators=(",", ":"))))
+        if not opts:
+            raise SchemaError("empty enum")
+        return "(" + "|".join(opts) + ")"
+    t = schema.get("type")
+    if t == "string":
+        if "pattern" in schema:
+            pat = schema["pattern"]
+            # The constrain.py dialect has no bounded reps or anchors:
+            # an unescaped { } ^ $ would silently match LITERALLY (e.g.
+            # [0-9]{3} admits '5{3}') — reject loudly instead.
+            esc = False
+            for c in pat:
+                if esc:
+                    esc = False
+                elif c == "\\":
+                    esc = True
+                elif c in "{}^$":
+                    raise SchemaError(
+                        f"string pattern uses {c!r}: the DFA regex "
+                        "dialect has no bounded repetition or anchors "
+                        "(it would match the character literally)"
+                    )
+            # Wrapping group: a top-level alternation must not escape
+            # the surrounding quotes ('"yes|no"' parses as '"yes'|'no"').
+            return '"(' + pat + ')"'
+        return _STRING
+    if t == "integer":
+        return _INTEGER
+    if t == "number":
+        return _NUMBER
+    if t == "boolean":
+        return "(true|false)"
+    if t == "null":
+        return "null"
+    if t == "array":
+        items = schema.get("items")
+        if items is None:
+            raise SchemaError("array schema needs 'items'")
+        item = schema_to_regex(items)
+        min_items = int(schema.get("minItems", 0))
+        if min_items not in (0, 1):
+            raise SchemaError(
+                "minItems > 1 needs bounded repetition the DFA regex "
+                "dialect does not have; nest required items explicitly"
+            )
+        non_empty = f"\\[{item}(,{item})*\\]"
+        if min_items == 1:
+            return non_empty
+        return f"(\\[\\]|{non_empty})"
+    if t == "object":
+        props = schema.get("properties")
+        if not props:
+            raise SchemaError("object schema needs non-empty 'properties'")
+        parts = []
+        for name, sub in props.items():
+            nullable = isinstance(sub, dict) and sub.get("nullable")
+            body = schema_to_regex(sub)
+            if nullable:
+                body = f"({body}|null)"
+            parts.append(_lit(json.dumps(name)) + ":" + body)
+        return "\\{" + ",".join(parts) + "\\}"
+    raise SchemaError(f"unsupported schema type {t!r}")

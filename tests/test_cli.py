@@ -439,3 +439,57 @@ def test_serve_with_constraints(capsys, tmp_path):
     code, _, err = run(capsys, "serve", "c-lm", "--for-seconds", "0.1",
                        "--constraint", "bad=(unclosed", "--eos-id", "0")
     assert code == 1 and "parenthesis" in err
+
+
+def test_serve_with_json_constraint(capsys, tmp_path):
+    """--json-constraint name=schema.json compiles the schema through
+    schema_to_regex into the same constraint bank; unsupported schemas
+    and unreadable files exit cleanly."""
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.cli.platform_local import LocalPlatform
+    from k8s_gpu_tpu.data.tokenizer import BpeTokenizer
+    from k8s_gpu_tpu.models.transformer import (
+        TransformerConfig, TransformerLM,
+    )
+    from k8s_gpu_tpu.serve import export_servable
+
+    run(capsys, "login", "--user", "ada", "--space", "ml")
+    tok = BpeTokenizer.train('{"status": "ok"} 0 1 2 ' * 30, vocab_size=270,
+                             backend="python")
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=32, n_layers=1, n_heads=2,
+        d_head=16, d_ff=64, max_seq=64, dtype=jnp.float32,
+        use_flash=False, remat=False,
+    )
+    model = TransformerLM(cfg)
+    p = LocalPlatform()
+    try:
+        export_servable(p.assets, "ml", "j-lm", model,
+                        model.init(jax.random.PRNGKey(0)), tokenizer=tok)
+    finally:
+        p.close()
+
+    schema = tmp_path / "resp.json"
+    schema.write_text(_json.dumps({
+        "type": "object",
+        "properties": {"status": {"enum": ["ok", "fail"]}},
+    }))
+    code, out, err = run(
+        capsys, "serve", "j-lm", "--for-seconds", "0.3",
+        "--json-constraint", f"resp={schema}", "--eos-id", "0",
+    )
+    assert code == 0, err
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(_json.dumps({"$ref": "#/x"}))
+    code, _, err = run(capsys, "serve", "j-lm", "--for-seconds", "0.1",
+                       "--json-constraint", f"b={bad}", "--eos-id", "0")
+    assert code == 2 and "unsupported schema keyword" in err
+    code, _, err = run(capsys, "serve", "j-lm", "--for-seconds", "0.1",
+                       "--json-constraint", "b=/nope/missing.json",
+                       "--eos-id", "0")
+    assert code == 2

@@ -1,0 +1,220 @@
+"""JSON-schema constrained decoding: schema → regex → token DFA.
+
+Contract layers:
+1. the generated regex accepts exactly the canonical JSON serializations
+   the schema admits (cross-checked against Python's `re` — the dialect
+   overlaps for everything schema_to_regex emits);
+2. the regex compiles through the existing DFA pipeline and a token walk
+   accepts canonical instances;
+3. end-to-end: a constrained decode over a JSON-ish vocabulary emits a
+   parseable instance of the schema.
+"""
+
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.serve import compile_constraint
+from k8s_gpu_tpu.serve.jsonschema import SchemaError, schema_to_regex
+
+
+def canon(v) -> str:
+    return json.dumps(v, separators=(",", ":"))
+
+
+def accepts(schema, value) -> bool:
+    return re.fullmatch(schema_to_regex(schema), canon(value)) is not None
+
+
+# -- layer 1: regex semantics vs Python re ----------------------------------
+
+def test_scalars():
+    assert accepts({"type": "integer"}, 0)
+    assert accepts({"type": "integer"}, -17)
+    assert not accepts({"type": "integer"}, 1.5)
+    assert re.fullmatch(schema_to_regex({"type": "integer"}), "007") is None
+    assert accepts({"type": "number"}, -3.25)
+    assert accepts({"type": "number"}, 2e10)
+    assert accepts({"type": "boolean"}, True)
+    assert not accepts({"type": "boolean"}, "true")
+    assert accepts({"type": "null"}, None)
+
+
+def test_strings_and_escapes():
+    s = {"type": "string"}
+    assert accepts(s, "hello world")
+    assert accepts(s, 'say "hi"')       # json.dumps escapes the quotes
+    assert accepts(s, "tab\there")      # \t escape
+    assert not re.fullmatch(schema_to_regex(s), '"raw " quote"')
+
+
+def test_string_pattern_override():
+    s = {"type": "string", "pattern": "[a-z]+@[a-z]+\\.com"}
+    r = schema_to_regex(s)
+    assert re.fullmatch(r, '"ann@corp.com"')
+    assert not re.fullmatch(r, '"not an email"')
+
+
+def test_string_pattern_alternation_stays_quoted():
+    # Without the wrapping group, '"yes|no"' would parse as
+    # ('"yes' | 'no"') and the DFA could emit unterminated strings.
+    r = schema_to_regex({"type": "string", "pattern": "yes|no"})
+    assert re.fullmatch(r, '"yes"') and re.fullmatch(r, '"no"')
+    assert not re.fullmatch(r, '"yes')
+    assert not re.fullmatch(r, 'no"')
+
+
+def test_string_pattern_dialect_guard():
+    # constrain.py has no bounded reps or anchors — {n}/^/$ would match
+    # LITERALLY, silently under-constraining.  Rejected loudly instead.
+    for pat in ("[0-9]{3}", "^ok$", "a{1,2}"):
+        with pytest.raises(SchemaError):
+            schema_to_regex({"type": "string", "pattern": pat})
+    # escaped braces are literal on purpose and stay allowed
+    r = schema_to_regex({"type": "string", "pattern": "a\\{b\\}"})
+    assert re.fullmatch(r, '"a{b}"')
+
+
+def test_raw_control_chars_rejected_in_strings():
+    r = schema_to_regex({"type": "string"})
+    assert not re.fullmatch(r, '"\x0c"'), "form feed must need escaping"
+    assert not re.fullmatch(r, '"\x00"')
+    assert re.fullmatch(r, '"\\f"')  # the escape form is fine
+
+
+def test_enum():
+    s = {"enum": ["low", "high", 3, None]}
+    for v in ["low", "high", 3, None]:
+        assert accepts(s, v), v
+    assert not accepts(s, "medium")
+
+
+def test_array():
+    s = {"type": "array", "items": {"type": "integer"}}
+    for v in ([], [1], [1, -2, 30]):
+        assert accepts(s, v), v
+    assert not accepts(s, ["x"])
+    s1 = {"type": "array", "items": {"type": "boolean"}, "minItems": 1}
+    assert not accepts(s1, [])
+    assert accepts(s1, [True, False])
+
+
+def test_object_fixed_order_and_nullable():
+    s = {"type": "object", "properties": {
+        "name": {"type": "string"},
+        "age": {"type": "integer", "nullable": True},
+        "tags": {"type": "array", "items": {"type": "string"}},
+    }}
+    assert accepts(s, {"name": "ann", "age": 7, "tags": ["a", "b"]})
+    assert accepts(s, {"name": "ann", "age": None, "tags": []})
+    # wrong order / missing key → rejected (canonical form)
+    r = schema_to_regex(s)
+    assert not re.fullmatch(r, '{"age":7,"name":"ann","tags":[]}')
+    assert not re.fullmatch(r, '{"name":"ann","tags":[]}')
+
+
+def test_nested():
+    s = {"type": "object", "properties": {
+        "user": {"type": "object", "properties": {
+            "id": {"type": "integer"},
+            "role": {"enum": ["admin", "viewer"]},
+        }},
+        "scores": {"type": "array", "items": {"type": "number"},
+                   "minItems": 1},
+    }}
+    assert accepts(s, {"user": {"id": 3, "role": "admin"},
+                       "scores": [1.5, -2e3]})
+    assert not accepts(s, {"user": {"id": 3, "role": "root"},
+                           "scores": [1.0]})
+
+
+def test_loud_rejections():
+    for bad in (
+        {"$ref": "#/defs/x"},
+        {"type": "array", "items": {"type": "integer"}, "maxItems": 3},
+        {"type": "object", "properties": {"a": {"type": "string"}},
+         "additionalProperties": False},
+        {"anyOf": [{"type": "integer"}]},
+        {"type": "array"},
+        {"type": "object"},
+        {"type": "array", "items": {"type": "integer"}, "minItems": 2},
+        {"enum": []},
+        {"enum": [[1, 2]]},
+        {"type": "frobnicate"},
+    ):
+        with pytest.raises(SchemaError):
+            schema_to_regex(bad)
+
+
+# -- layer 2: through the DFA pipeline --------------------------------------
+
+TOKENS = ["", "{", "}", "[", "]", '"', ":", ",", "-", "ok", "fail",
+          "0", "1", "7", "12", "true", "false", "null", "a", "b", "e",
+          '"status"', '"n"', '{"status":']
+
+
+def _walk(c, text_tokens):
+    """Token-walk the compiled tables; returns final state or -1."""
+    import numpy as np
+    nxt = np.asarray(c.next_state)
+    state = 0
+    for tok in text_tokens:
+        v = TOKENS.index(tok)
+        state = int(nxt[state, v])
+        if state < 0:
+            return -1
+    return state
+
+
+def test_dfa_accepts_canonical_instance():
+    s = {"type": "object", "properties": {
+        "status": {"enum": ["ok", "fail"]},
+        "n": {"type": "integer"},
+    }}
+    c = compile_constraint(schema_to_regex(s), TOKENS)
+    import numpy as np
+    acc = np.asarray(c.accepting)
+    # '{"status":' (one BPE-ish token) '"ok"' ',' '"n"' ':' '7' '}'
+    end = _walk(c, ['{"status":', '"', "ok", '"', ",", '"n"', ":", "7",
+                    "}"])
+    assert end >= 0 and acc[end]
+    assert _walk(c, ['{"status":', '"', "b", '"']) == -1  # not in enum
+
+
+# -- layer 3: end-to-end constrained decode ---------------------------------
+
+CFG = TransformerConfig(
+    vocab_size=len(TOKENS), d_model=32, n_layers=2, n_heads=2, d_head=16,
+    d_ff=64, max_seq=48, use_flash=False, dtype=jnp.float32,
+)
+
+
+def test_constrained_decode_emits_schema_instance():
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+    from k8s_gpu_tpu.serve.constrain import ConstraintBank
+
+    schema = {"type": "object", "properties": {
+        "status": {"enum": ["ok", "fail"]},
+        "n": {"type": "integer"},
+    }}
+    bank = ConstraintBank({"resp": schema_to_regex(schema)}, TOKENS)
+    model = TransformerLM(CFG)
+    params = model.init(jax.random.PRNGKey(3))
+    b = ContinuousBatcher(model, params, slots=2, eos_id=0,
+                          constraints=bank).start()
+    try:
+        toks = b.submit([18, 19], max_new_tokens=30,
+                        constraint="resp").result()
+        text = "".join(TOKENS[t] for t in toks)
+        # The automaton guarantees prefix-validity; with this vocabulary
+        # every prefix can complete, and budget 30 > the longest
+        # canonical instance, so the emitted text parses.
+        obj = json.loads(text)
+        assert obj["status"] in ("ok", "fail")
+        assert isinstance(obj["n"], int)
+    finally:
+        b.stop()
