@@ -222,3 +222,27 @@ def test_constraints_plus_ngram_rejected(setup):
     with pytest.raises(ValueError, match="speculative"):
         ContinuousBatcher(model, params, slots=2, eos_id=0,
                           constraints=bank, draft="ngram")
+
+
+def test_tp_sharded_ngram_matches_unsharded(setup):
+    """ngram speculative rounds compose with tp sharding: the verify's
+    extend_multi runs tp-parallel while hist/proposals stay replicated
+    row state — the stream must equal the unsharded greedy oracle."""
+    import jax as _jax
+
+    from k8s_gpu_tpu.parallel.mesh import MeshConfig, build_mesh
+    from k8s_gpu_tpu.parallel.sharding import shard_params
+
+    model, params = setup
+    if _jax.device_count() < 4:
+        pytest.skip("needs the 8-device CPU mesh (conftest sets it)")
+    mesh = build_mesh(MeshConfig(dp=1, tp=4), n_devices=4)
+    sharded = shard_params(params, model.logical_axes(), mesh)
+    b = ContinuousBatcher(model, sharded, slots=2, mesh=mesh,
+                          draft="ngram", spec_k=3).start()
+    try:
+        ids = [13, 26, 39]
+        got = b.submit(ids, max_new_tokens=12).result()
+        assert got == _reference_greedy(model, params, ids, 12)
+    finally:
+        b.stop()
