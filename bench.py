@@ -160,6 +160,21 @@ def reconcile_to_ready(accel: str, slice_count: int = 1) -> tuple[float, int]:
 
 # -- compute half -----------------------------------------------------------
 
+def _best_rate(run_once, trials: int = 3) -> float:
+    """Best-of-N tokens/s for a timed window: ``run_once`` performs the
+    work and returns its token count.  Single samples through the
+    dispatch tunnel swing ±40% (a stray t_hi-variant compile, host
+    jitter); the min-time trial is the steady state every serving claim
+    should be built on."""
+    best = n = None
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        n = run_once()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return n / best
+
+
 def _flagship_config(on_tpu: bool):
     """302M-param decoder LM on TPU (compute-bound: fills the MXU at
     d_model=1024, d_head=128, seq 2048); a ~4M toy on CPU so the bench
@@ -361,6 +376,13 @@ def kernel_bench() -> dict:
         long_flops = 2 * 2 * 1 * 8 * S2 * S2 * D / 2
         res["fwd_long_8192_ms"] = ms
         res["fwd_long_8192_tflops_per_s"] = long_flops / (ms / 1e3) / 1e12
+        if "pallas_ref_error" not in res:  # bf is bound iff import worked
+            try:
+                res["fwd_long_8192_pallas_ref_ms"] = (
+                    time_fwd(bf, ops=ops2) * 1e3
+                )
+            except Exception as e:
+                res["fwd_long_8192_pallas_ref_error"] = str(e)[:200]
     except Exception as e:
         res["fwd_long_8192_error"] = str(e)[:200]
     return res
@@ -379,13 +401,15 @@ def decode_probe(model, params) -> dict:
     # Warmup with the SAME static args as the timed call (max_new_tokens is
     # a static jit arg — a different value would recompile in the window).
     np.asarray(engine.generate(params, prompt, max_new_tokens=n_new).tokens)
-    t0 = time.perf_counter()
-    out = engine.generate(params, prompt, max_new_tokens=n_new)
-    # The host fetch is the sync point (block_until_ready is unreliable
-    # through the tunnel).
-    np.asarray(out.tokens)
-    dt = time.perf_counter() - t0
-    return {"decode_tokens_per_s": n_new / dt}
+
+    def once():
+        out = engine.generate(params, prompt, max_new_tokens=n_new)
+        # The host fetch is the sync point (block_until_ready is
+        # unreliable through the tunnel).
+        np.asarray(out.tokens)
+        return n_new
+
+    return {"decode_tokens_per_s": _best_rate(once)}
 
 
 def batched_decode_probe(model, params) -> dict:
@@ -452,13 +476,14 @@ def quant_decode_probe(model, params) -> dict:
     prompt = jnp.zeros((1, 33), jnp.int32)
     n_new = 64
     np.asarray(engine.generate(qp, prompt, max_new_tokens=n_new).tokens)
-    t0 = time.perf_counter()
-    out = engine.generate(qp, prompt, max_new_tokens=n_new)
-    np.asarray(out.tokens)
-    dt = time.perf_counter() - t0
+
+    def once():
+        np.asarray(engine.generate(qp, prompt, max_new_tokens=n_new).tokens)
+        return n_new
+
     qb, fb = quantized_bytes(qp)
     return {
-        "decode_tokens_per_s_int8": n_new / dt,
+        "decode_tokens_per_s_int8": _best_rate(once),
         "int8_param_bytes_ratio": qb / fb,
     }
 
@@ -583,9 +608,7 @@ def spec_batcher_probe(model, params) -> dict:
     try:
         run(plain, 1)  # warm solo variant
         run(plain, 4)  # warm shared-round variant (trace+compile)
-        t0 = time.perf_counter()
-        n = run(plain, 4)
-        out["cb_plain_tokens_per_s_4req"] = n / (time.perf_counter() - t0)
+        out["cb_plain_tokens_per_s_4req"] = _best_rate(lambda: run(plain, 4))
     finally:
         plain.stop()
     spec = ContinuousBatcher(
@@ -594,9 +617,7 @@ def spec_batcher_probe(model, params) -> dict:
     try:
         run(spec, 1)  # warm solo variant
         run(spec, 4)  # warm shared-round variant
-        t0 = time.perf_counter()
-        n = run(spec, 4)
-        out["cb_spec_tokens_per_s_4req"] = n / (time.perf_counter() - t0)
+        out["cb_spec_tokens_per_s_4req"] = _best_rate(lambda: run(spec, 4))
         st = spec.spec_stats
         out["cb_spec_measured_acceptance"] = st["acceptance"]
         out["cb_spec_vs_plain_x"] = (
@@ -635,12 +656,8 @@ def spec_batcher_probe(model, params) -> dict:
     try:
         run(ng, 1)  # warm solo variant
         run(ng, 4)  # warm shared-round variant
-        t0 = time.perf_counter()
-        n = run(ng, 4)
-        out["cb_ngram_tokens_per_s_4req"] = n / (time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        n = run(ng, 1)
-        out["cb_ngram_tokens_per_s_1req"] = n / (time.perf_counter() - t0)
+        out["cb_ngram_tokens_per_s_4req"] = _best_rate(lambda: run(ng, 4))
+        out["cb_ngram_tokens_per_s_1req"] = _best_rate(lambda: run(ng, 1))
         out["cb_ngram_measured_acceptance"] = ng.spec_stats["acceptance"]
         out["cb_ngram_vs_plain_x"] = (
             out["cb_ngram_tokens_per_s_4req"]
@@ -674,12 +691,11 @@ def kv_quant_probe(model, params) -> dict:
         b.submit(ids, max_new_tokens=n_new).result()  # warm solo
         for h in [b.submit(ids, max_new_tokens=n_new) for _ in range(4)]:
             h.result()  # warm the 4-wide shared round
-        t0 = time.perf_counter()
-        handles = [
-            b.submit(ids, max_new_tokens=n_new) for _ in range(4)
-        ]
-        n = sum(len(h.result()) for h in handles)
-        toks_s = n / (time.perf_counter() - t0)
+        toks_s = _best_rate(lambda: sum(
+            len(h.result())
+            for h in [b.submit(ids, max_new_tokens=n_new)
+                      for _ in range(4)]
+        ))
     finally:
         b.stop()
     return {
