@@ -1,0 +1,132 @@
+"""Full-suite test runner that completes reliably in one command.
+
+The environment's jaxlib CPU compiler has a cumulative failure mode: after
+several hundred compiles in one process it can segfault inside
+``backend_compile_and_load`` even with compiles serialized and on the
+growable main-thread stack (the two modes ``utils/compat.py`` already
+mitigates).  Every test passes when the suite is run in bounded chunks, so
+this runner treats the jaxlib bug as the environment fact it is:
+
+- partition the test files into chunks small enough that no chunk
+  approaches the accumulation threshold (~430 tests; chunks here carry
+  <=8 files each),
+- run each chunk as its own pytest subprocess,
+- if a chunk dies on a signal (segfault) rather than a test failure,
+  bisect it file-by-file so a genuine failure is never masked by the
+  compiler crash,
+- merge the pass/fail/skip counts and exit non-zero iff any test failed.
+
+``make test`` invokes this.  The reference's test story is ``go test``
+over envtest packages — naturally one-process-per-package — so per-chunk
+processes are also the closer analogue of the reference harness
+(SURVEY.md §4), not just a workaround.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Summary tail of ``pytest -q``:  "12 passed, 1 skipped in 3.45s" etc.
+_COUNTS = re.compile(r"(\d+) (passed|failed|skipped|errors?|error|xfailed|xpassed|deselected|warnings?)")
+
+
+def parse_counts(out: str) -> dict:
+    counts: dict[str, int] = {}
+    for line in reversed(out.strip().splitlines()):
+        found = _COUNTS.findall(line)
+        if found and ("passed" in line or "failed" in line or "error" in line or "no tests ran" in line):
+            for n, kind in found:
+                kind = {"error": "errors", "warning": "warnings"}.get(kind, kind)
+                counts[kind] = counts.get(kind, 0) + int(n)
+            break
+    return counts
+
+
+def run_pytest(files: list[str], extra: list[str]) -> tuple[int, dict, str]:
+    cmd = [sys.executable, "-m", "pytest", "-q", "--no-header", *extra, *files]
+    proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+    out = proc.stdout + proc.stderr
+    return proc.returncode, parse_counts(out), out
+
+
+def chunked(files: list[str], size: int) -> list[list[str]]:
+    return [files[i : i + size] for i in range(0, len(files), size)]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--chunk-size", type=int, default=8, metavar="N",
+                    help="test files per subprocess (default 8)")
+    ap.add_argument("--verbose", action="store_true", help="stream each chunk's tail")
+    ap.add_argument("pytest_args", nargs="*", help="extra args forwarded to pytest")
+    args = ap.parse_args()
+
+    files = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
+    if not files:
+        print("no test files found", file=sys.stderr)
+        return 2
+    rel = [os.path.relpath(f, REPO) for f in files]
+
+    total: dict[str, int] = {}
+    failures: list[str] = []
+    crashes: list[str] = []
+    t0 = time.time()
+    chunks = chunked(rel, args.chunk_size)
+    for i, chunk in enumerate(chunks):
+        rc, counts, out = run_pytest(chunk, args.pytest_args)
+        crashed = rc < 0 or rc == 139  # killed by signal → compiler crash, not a test failure
+        if crashed:
+            # Bisect file-by-file so a real failure inside the chunk is
+            # never hidden behind the jaxlib crash.
+            print(f"[chunk {i + 1}/{len(chunks)}] crashed (rc={rc}); re-running file-by-file",
+                  flush=True)
+            counts = {}
+            for f in chunk:
+                rc1, c1, out1 = run_pytest([f], args.pytest_args)
+                if rc1 < 0 or rc1 == 139:
+                    crashes.append(f)
+                    print(f"  {f}: crashed twice (rc={rc1}) — compiler, see tail below", flush=True)
+                    print("\n".join(out1.strip().splitlines()[-15:]), flush=True)
+                elif rc1 != 0:
+                    failures.append(f)
+                    print("\n".join(out1.strip().splitlines()[-40:]), flush=True)
+                for k, v in c1.items():
+                    counts[k] = counts.get(k, 0) + v
+        elif rc != 0:
+            failures.extend(chunk)
+            print(f"[chunk {i + 1}/{len(chunks)}] FAILED", flush=True)
+            print("\n".join(out.strip().splitlines()[-60:]), flush=True)
+        for k, v in counts.items():
+            total[k] = total.get(k, 0) + v
+        status = "ok" if rc == 0 else ("crash" if crashed else "FAIL")
+        line = (f"[chunk {i + 1}/{len(chunks)}] {status}: "
+                + ", ".join(f"{v} {k}" for k, v in sorted(counts.items()) if k != "warnings"))
+        print(line, flush=True)
+        if args.verbose and rc == 0:
+            print("\n".join(out.strip().splitlines()[-3:]), flush=True)
+
+    dt = time.time() - t0
+    summary = ", ".join(f"{v} {k}" for k, v in sorted(total.items()) if k != "warnings")
+    print(f"== total: {summary} in {dt:.0f}s over {len(chunks)} chunks ==", flush=True)
+    bad = total.get("failed", 0) + total.get("errors", 0)
+    if crashes:
+        print(f"== {len(crashes)} file(s) crashed even in isolation: {crashes} ==", flush=True)
+    # `failures` catches chunks whose nonzero exit produced no parseable
+    # summary (pytest INTERNALERROR / usage error): counts alone would
+    # read as green.
+    if bad or crashes or failures:
+        return 1
+    print("== ALL GREEN ==", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
