@@ -445,19 +445,33 @@ def cmd_devenv_client(args) -> int:
             return 1
         try:
             with Ssh2Client(host, port, user, key) as c:
+                if args.devenv_cmd == "put":
+                    # Standard-protocol bulk upload: the SFTP subsystem
+                    # commits a new asset version on close (platform/
+                    # sftp.py) — the lftp-mirror path, no invented verbs.
+                    space = args.space or ctx.space or "default"
+                    msg = c.sftp().put(
+                        args.file, f"/{space}/{args.kind}/{args.id}"
+                    )
+                    print(msg or "OK")
+                    return 0
                 rc = 0
                 for cmd in (args.command or []):
                     out, status = c.exec(cmd)
                     print(out, end="" if out.endswith("\n") else "\n")
                     rc = rc or status
                 if not args.command:
-                    for line in sys.stdin:
-                        line = line.strip()
-                        if not line or line == "exit":
-                            break
-                        out, status = c.exec(line)
-                        print(out, end="" if out.endswith("\n") else "\n",
-                              flush=True)
+                    # Interactive: a real pty-req+shell session, one
+                    # command per stdin line (scripted ssh).
+                    with c.shell() as sh:
+                        print(sh.banner, end="", flush=True)
+                        for line in sys.stdin:
+                            line = line.strip()
+                            if not line:
+                                continue
+                            if line in ("exit", "logout"):
+                                break
+                            print(sh.run(line), end="", flush=True)
                 return rc
         except SshError as e:
             print(f"denied: {e}", file=sys.stderr)
@@ -465,6 +479,10 @@ def cmd_devenv_client(args) -> int:
         except OSError as e:
             print(f"error: cannot reach gateway: {e}", file=sys.stderr)
             return 1
+    if args.devenv_cmd == "put":
+        print("note: the line-protocol PUT is deprecated; prefer "
+              "--ssh2 --key <private-key> (standard SFTP subsystem)",
+              file=sys.stderr)
     if not args.pubkey:
         print("--pubkey is required for the line-protocol client "
               "(or pass --ssh2 --key for the SSH-2 transport)",
@@ -806,7 +824,15 @@ def cmd_serve(args) -> int:
     workload; the role the reference's platform schedules for the
     Fin-Agent service, 智能风控解决方案.md:368-419)."""
     ctx = _require_login(CliConfig.load())
-    if args.constraint and args.draft:
+    if args.draft and args.draft_mode:
+        # Mirrors api/inferenceservice.py: spec.draft and spec.draftMode
+        # are mutually exclusive — and keeping them separate flags means
+        # an asset literally named 'ngram' stays loadable via --draft.
+        print("--draft and --draft-mode are mutually exclusive: a draft "
+              "is either a model asset or a model-free mode",
+              file=sys.stderr)
+        return 2
+    if args.constraint and (args.draft or args.draft_mode):
         # Knowable from flags alone — fail as a usage error BEFORE
         # loading two bundles and compiling a vocab-wide DFA bank
         # (batcher.__init__ documents why the combination can't exist).
@@ -822,7 +848,7 @@ def cmd_serve(args) -> int:
         model, params, tok = load_servable(
             p.assets, ctx.space, args.model, args.version
         )
-        if args.draft == "ngram":
+        if args.draft_mode == "ngram":
             # Prompt-lookup drafting: proposals from each row's own
             # token history — no draft bundle to load, no draft
             # forward at serve time (batcher.ngram_propose).
@@ -954,15 +980,17 @@ def build_parser() -> argparse.ArgumentParser:
     for sp in (p_ssh, p_put):
         sp.add_argument("--gateway", required=True, help="host:port")
         sp.add_argument("--pubkey", default="",
-                        help="path to the SSH public key the devenv holds")
+                        help="path to the SSH public key the devenv holds "
+                             "(legacy line protocol only)")
         sp.add_argument("--user", default="")
+        sp.add_argument("--ssh2", action="store_true",
+                        help="real SSH-2 transport (curve25519/ed25519/"
+                             "aes128-ctr; platform/sshwire.py); for put, "
+                             "uploads ride the standard SFTP subsystem")
+        sp.add_argument("--key", default="",
+                        help="OpenSSH Ed25519 private key (with --ssh2)")
     p_ssh.add_argument("-c", "--command", action="append",
                        help="run command(s) and exit (else read stdin)")
-    p_ssh.add_argument("--ssh2", action="store_true",
-                       help="real SSH-2 transport (curve25519/ed25519/"
-                            "aes128-ctr; platform/sshwire.py)")
-    p_ssh.add_argument("--key", default="",
-                       help="OpenSSH Ed25519 private key (with --ssh2)")
     p_ssh.set_defaults(fn=cmd_devenv_client)
     p_put.add_argument("--space", default="")
     p_put.add_argument("kind")
@@ -1100,8 +1128,14 @@ def build_parser() -> argparse.ArgumentParser:
                        help="EOS token id (set when using constraints)")
     p_srv.add_argument("--draft", default="",
                        help="speculative decoding in the batcher's shared "
-                            "rounds: a draft model asset id, or 'ngram' "
-                            "for prompt-lookup drafting (no draft model)")
+                            "rounds: a draft model asset id (always treated "
+                            "as an asset id — use --draft-mode for "
+                            "model-free drafting)")
+    p_srv.add_argument("--draft-mode", default="", choices=["", "ngram"],
+                       help="model-free drafting mode, mirroring "
+                            "spec.draftMode: 'ngram' = prompt-lookup "
+                            "proposals from each row's own history; "
+                            "mutually exclusive with --draft")
     p_srv.add_argument("--kv-quant", action="store_true",
                        help="int8 KV cache (~1.9x slot capacity)")
     p_srv.add_argument("--for-seconds", type=float, default=0.0,

@@ -96,11 +96,15 @@ def ngram_propose(hist, token, pos, k: int, m: int = 3):
     return jnp.where((score[j] > 0) & (g >= 0), g, token)
 
 
-def _param_count(tree) -> int:
-    """Total array elements in a param tree — the relative-decode-cost
-    proxy speculative round sizing uses (decode streams every weight
-    byte once per step, so cost scales with parameter count)."""
-    return sum(int(x.size) for x in jax.tree.leaves(tree))
+def _param_bytes(tree) -> int:
+    """Total param-tree BYTES — the relative-decode-cost proxy
+    speculative round sizing uses.  Decode is HBM-bound: every weight
+    byte streams once per step, so cost scales with bytes, not element
+    count — an int8-quantized draft against a bf16 target really does
+    cost half per element, and sizing by elements would overstate the
+    draft/target ratio 2x and undersize spec_rounds."""
+    return sum(int(x.size) * np.dtype(getattr(x, "dtype", np.float32)).itemsize
+               for x in jax.tree.leaves(tree))
 
 
 def _suffix_bucket(n: int) -> int:
@@ -405,8 +409,8 @@ class ContinuousBatcher:
             if self.spec_mode == "ngram":
                 self.spec_rounds = self.steps_per_round
             else:
-                r = _param_count(self.draft_params) / max(
-                    1, _param_count(params)
+                r = _param_bytes(self.draft_params) / max(
+                    1, _param_bytes(params)
                 )
                 self.spec_rounds = max(
                     1,

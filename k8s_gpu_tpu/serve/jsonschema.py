@@ -23,10 +23,12 @@ separators=(",", ":"))`` emits):
 
 Supported schema subset: ``type`` ∈ {string, integer, number, boolean,
 null, array, object}, ``enum`` (JSON scalars), ``properties`` (fixed
-order), ``items``, ``minItems`` ∈ {0, 1}, string ``pattern`` (embedded
-verbatim — the author's regex replaces the default string body).
-``maxItems``/``additionalProperties``/``$ref`` are rejected loudly:
-a constraint that silently under-constrains is worse than none.
+order), ``items``, ``minItems`` ∈ {0, 1}, string ``pattern`` (the
+author's regex replaces the default string body, INTERSECTED with the
+legal JSON-string alphabet so it can never emit a raw quote/backslash/
+control character).  Keyword support is an allowlist: anything else
+(``maxItems``, ``required``, ``minimum``, ``$ref``, ...) is rejected
+loudly — a constraint that silently under-constrains is worse than none.
 """
 
 from __future__ import annotations
@@ -60,17 +62,164 @@ _INTEGER = "\\-?(0|[1-9][0-9]*)"
 _NUMBER = _INTEGER + "(\\.[0-9]+)?([eE][\\-\\+]?[0-9]+)?"
 
 
+# The full supported keyword surface.  An ALLOWLIST, not a denylist: any
+# keyword outside it (minimum, maxLength, required, $ref, ...) would be
+# silently ignored by this compiler, i.e. the DFA would under-constrain
+# relative to the declared schema — the exact failure mode the module
+# docstring calls worse than none.  Annotation-only keys that constrain
+# nothing (title, description, ...) are tolerated.
+_SUPPORTED_KEYS = frozenset(
+    {"type", "enum", "properties", "items", "minItems", "pattern", "nullable"}
+)
+_ANNOTATION_KEYS = frozenset({"title", "description", "default", "examples", "$schema"})
+
+
+# Characters no JSON string body may contain raw: the framing quote, the
+# escape introducer, and the full control range.  A pattern atom that can
+# match one of them would let the DFA emit output that is not valid JSON
+# (a raw quote inside the string body), so every atom is INTERSECTED with
+# the legal body alphabet rather than embedded verbatim:
+#
+#   .          → [^"\<ctrl>]        dot, narrowed to the legal alphabet
+#   [^...]     → [^..."\<ctrl>]     widening the negation = intersection
+#   [a-z"]     → SchemaError        a member outside the legal alphabet
+#   \s \n \t…  → SchemaError        would emit raw control characters
+#
+# The { } $ rejections (no bounded reps/anchors in the DFA dialect) and
+# the top-level ^ rejection stay; ^ right after an unescaped [ is class
+# negation and is supported by constrain.py, so it passes through.
+_ILLEGAL_ORDS = frozenset({0x22, 0x5C} | set(range(0x20)))
+_NEG_EXTRA = '"\\\\' + _CTRL  # regex text: quote, escaped backslash, raw ctrls
+_LEGAL_DOT = "[^" + _NEG_EXTRA + "]"
+
+
+def _pattern_to_string_body(pat: str) -> str:
+    """Rewrite an author regex so it can only emit legal JSON string bodies."""
+
+    def fail(msg: str):
+        raise SchemaError(f"string pattern {pat!r}: {msg}")
+
+    out: list[str] = []
+    i, n = 0, len(pat)
+    in_class = False          # inside [...]
+    class_negated = False
+    at_class_start = False    # immediately after [ (where ^ negates)
+    prev_ord: int | None = None  # last concrete class member (range lo)
+    range_open = False        # saw 'lo-' and await the range hi
+
+    def member(o: int, text: str):
+        """Append one concrete class member, enforcing legality/ranges."""
+        nonlocal prev_ord, range_open
+        if range_open:
+            lo = prev_ord
+            if lo is None or lo > o:
+                fail(f"bad class range ending at {text!r}")
+            if not class_negated and any(lo <= x <= o for x in _ILLEGAL_ORDS):
+                fail(f"class range {chr(lo)!r}-{text!r} covers characters "
+                     "illegal in a JSON string body")
+            range_open = False
+            prev_ord = None
+        else:
+            if not class_negated and o in _ILLEGAL_ORDS:
+                fail(f"class member {text!r} is illegal in a JSON string body")
+            prev_ord = o
+        out.append(text)
+
+    while i < n:
+        c = pat[i]
+        if c == "\\":
+            if i + 1 >= n:
+                fail("trailing backslash")
+            e = pat[i + 1]
+            if e in "sntrfv0":
+                fail(f"'\\{e}' can emit a raw control character, which is "
+                     "illegal inside a JSON string body")
+            if e in '"\\':
+                fail(f"a literal {e!r} cannot appear raw inside a JSON "
+                     "string body (it would break the framing)")
+            if in_class:
+                if e in "dw":  # shorthand sets; both fully body-legal
+                    if range_open:
+                        fail(f"class range cannot end in '\\{e}'")
+                    prev_ord = None
+                    out.append("\\" + e)
+                else:
+                    member(ord(e), "\\" + e)
+            else:
+                out.append("\\" + e)
+            i += 2
+            at_class_start = False
+            continue
+        if in_class:
+            if c == "]":
+                if range_open:
+                    member(ord("-"), "-")  # trailing '-' is a literal member
+                if class_negated:
+                    out.append(_NEG_EXTRA)
+                out.append("]")
+                in_class = False
+            elif c == '"':
+                fail("'\"' in a character class would break the JSON framing")
+            elif c == "^" and at_class_start:
+                class_negated = True
+                out.append("^")
+            elif c == "-" and prev_ord is not None and i + 1 < n and pat[i + 1] != "]":
+                range_open = True
+                out.append("-")
+            elif ord(c) < 0x20:
+                if class_negated:
+                    out.append(c)  # excluding a control char is fine
+                else:
+                    fail("raw control character in class")
+            else:
+                member(ord(c), c)
+        else:
+            if c == "[":
+                in_class, class_negated = True, False
+                at_class_start = True
+                prev_ord, range_open = None, False
+                out.append("[")
+                i += 1
+                continue
+            if c == ".":
+                out.append(_LEGAL_DOT)
+            elif c == '"':
+                fail("a literal '\"' cannot appear raw inside a JSON "
+                     "string body (it would break the framing)")
+            elif c in "{}$" or c == "^":
+                fail(f"uses {c!r}: the DFA regex dialect has no bounded "
+                     "repetition or anchors (it would match the character "
+                     "literally)")
+            elif ord(c) < 0x20:
+                fail("raw control character")
+            else:
+                out.append(c)
+        i += 1
+        at_class_start = False
+    if in_class:
+        fail("unterminated character class")
+    return "".join(out)
+
+
 def schema_to_regex(schema: dict) -> str:
     """Compile a JSON-schema subset to a regex over canonical JSON."""
     if not isinstance(schema, dict):
         raise SchemaError(f"schema must be an object, got {type(schema).__name__}")
-    for unsupported in ("$ref", "maxItems", "additionalProperties",
-                        "anyOf", "oneOf", "allOf"):
-        if unsupported in schema:
-            raise SchemaError(
-                f"unsupported schema keyword {unsupported!r} — the DFA "
-                "would silently under-constrain"
-            )
+    unsupported = set(schema) - _SUPPORTED_KEYS - _ANNOTATION_KEYS
+    if unsupported:
+        raise SchemaError(
+            f"unsupported schema keyword(s) {sorted(unsupported)!r} — the "
+            "DFA would silently under-constrain (supported: "
+            f"{sorted(_SUPPORTED_KEYS)})"
+        )
+    if schema.get("nullable"):
+        # Honored at EVERY level (top-level, array items, object
+        # properties): an allowlisted keyword that only worked in one
+        # position would silently under-constrain elsewhere.
+        inner = schema_to_regex(
+            {k: v for k, v in schema.items() if k != "nullable"}
+        )
+        return f"({inner}|null)"
     if "enum" in schema:
         opts = []
         for v in schema["enum"]:
@@ -83,25 +232,9 @@ def schema_to_regex(schema: dict) -> str:
     t = schema.get("type")
     if t == "string":
         if "pattern" in schema:
-            pat = schema["pattern"]
-            # The constrain.py dialect has no bounded reps or anchors:
-            # an unescaped { } ^ $ would silently match LITERALLY (e.g.
-            # [0-9]{3} admits '5{3}') — reject loudly instead.
-            esc = False
-            for c in pat:
-                if esc:
-                    esc = False
-                elif c == "\\":
-                    esc = True
-                elif c in "{}^$":
-                    raise SchemaError(
-                        f"string pattern uses {c!r}: the DFA regex "
-                        "dialect has no bounded repetition or anchors "
-                        "(it would match the character literally)"
-                    )
             # Wrapping group: a top-level alternation must not escape
             # the surrounding quotes ('"yes|no"' parses as '"yes'|'no"').
-            return '"(' + pat + ')"'
+            return '"(' + _pattern_to_string_body(schema["pattern"]) + ')"'
         return _STRING
     if t == "integer":
         return _INTEGER
@@ -132,10 +265,7 @@ def schema_to_regex(schema: dict) -> str:
             raise SchemaError("object schema needs non-empty 'properties'")
         parts = []
         for name, sub in props.items():
-            nullable = isinstance(sub, dict) and sub.get("nullable")
-            body = schema_to_regex(sub)
-            if nullable:
-                body = f"({body}|null)"
-            parts.append(_lit(json.dumps(name)) + ":" + body)
+            # nullable is handled by the recursive call (every level).
+            parts.append(_lit(json.dumps(name)) + ":" + schema_to_regex(sub))
         return "\\{" + ",".join(parts) + "\\}"
     raise SchemaError(f"unsupported schema type {t!r}")

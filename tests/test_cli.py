@@ -193,6 +193,9 @@ def test_devenv_ssh_and_put_cli_client(tmp_path, capsys):
         )
         assert code == 0, err
         assert "OK imported model/m1" in out and "4096 bytes" in out
+        # the line-protocol put warns that it is deprecated (SFTP is
+        # the standard-protocol path now)
+        assert "deprecated" in err
         bad = tmp_path / "bad.pub"
         bad.write_text("ssh-ed25519 WRONGKEY\n")
         code, out, err = run(
@@ -239,6 +242,42 @@ def test_devenv_ssh2_cli_end_to_end(tmp_path, capsys):
             "-c", "hostname",
         )
         assert code == 1 and "denied" in err
+    finally:
+        gw.stop()
+
+
+def test_devenv_put_over_sftp_cli(tmp_path, capsys):
+    """`devenv put --ssh2`: bulk upload rides the standard SFTP
+    subsystem end-to-end (CLI → SSH-2 transport → sftp channel →
+    versioned asset store) — the lftp-mirror role with no invented
+    verbs (VERDICT r4 #6)."""
+    run(capsys, "login", "--user", "ada")
+    code, out, _ = run(capsys, "devenv", "keygen", "--out", str(tmp_path))
+    assert code == 0
+    code, out, _ = run(capsys, "devenv", "create", "--pubkey",
+                       str(tmp_path / "id_ed25519.pub"))
+    assert code == 0, out
+    from k8s_gpu_tpu.cli.platform_local import LocalPlatform
+    from k8s_gpu_tpu.platform.sshgate import SshGateway
+
+    p = LocalPlatform()
+    gw = SshGateway(p.kube, port=0, namespace="default",
+                    assets=p.assets).start()
+    try:
+        ep = f"127.0.0.1:{gw.port}"
+        data = tmp_path / "weights.bin"
+        data.write_bytes(b"w" * 100_000)
+        code, out, err = run(
+            capsys, "devenv", "put", "--gateway", ep, "--ssh2",
+            "--key", str(tmp_path / "id_ed25519"), "--space", "ml",
+            "model", "m-sftp", str(data),
+        )
+        assert code == 0, err
+        assert "imported model/m-sftp v1" in out
+        assert "100000 bytes" in out
+        assert "deprecated" not in err  # this IS the standard path
+        a = p.assets.get("ml", "model", "m-sftp")
+        assert a.size == 100_000
     finally:
         gw.stop()
         p.close()
@@ -394,6 +433,24 @@ def test_serve_with_draft_and_kv_quant(capsys, tmp_path):
         "--for-seconds", "0.1",
     )
     assert code == 1 and "no asset" in err
+
+    # Model-free drafting is its own flag (mirroring spec.draftMode), so
+    # '--draft ngram' is an ASSET lookup — an asset named 'ngram' is not
+    # shadowed by the mode name.
+    code, out, err = run(
+        capsys, "serve", "spec-lm", "--draft-mode", "ngram",
+        "--for-seconds", "0.3",
+    )
+    assert code == 0, err
+    code, _, err = run(
+        capsys, "serve", "spec-lm", "--draft", "ngram", "--for-seconds", "0.1",
+    )
+    assert code == 1 and "no asset" in err
+    code, _, err = run(
+        capsys, "serve", "spec-lm", "--draft", "spec-draft",
+        "--draft-mode", "ngram", "--for-seconds", "0.1",
+    )
+    assert code == 2 and "mutually exclusive" in err
 
 
 def test_serve_with_constraints(capsys, tmp_path):

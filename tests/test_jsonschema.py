@@ -132,6 +132,63 @@ def test_nested():
                            "scores": [1.0]})
 
 
+def test_pattern_intersected_with_json_string_alphabet():
+    # '.' and negated classes are narrowed so the DFA can never emit a
+    # raw quote/backslash/control char — output is always valid JSON.
+    r = schema_to_regex({"type": "string", "pattern": ".+"})
+    assert re.fullmatch(r, '"abc"')
+    assert not re.fullmatch(r, '"a"b"'), "dot must not admit a raw quote"
+    assert not re.fullmatch(r, '"a\\b"'), "dot must not admit a raw backslash"
+    r = schema_to_regex({"type": "string", "pattern": "[^0-9]+"})
+    assert re.fullmatch(r, '"xy"')
+    assert not re.fullmatch(r, '"x"y"')
+    # but patterns that can ONLY emit illegal bodies are rejected loudly
+    for pat in ('a"b', '\\"', "\\\\", "\\n", "\\s+", '[a-z"]', "[ -~]+"):
+        with pytest.raises(SchemaError):
+            schema_to_regex({"type": "string", "pattern": pat})
+
+
+def test_negated_class_negation_caret_allowed():
+    # '^' right after '[' is class negation (supported by constrain.py),
+    # not an anchor — only anchor uses are rejected.
+    r = schema_to_regex({"type": "string", "pattern": "[^abc]"})
+    assert re.fullmatch(r, '"z"') and not re.fullmatch(r, '"a"')
+    with pytest.raises(SchemaError):
+        schema_to_regex({"type": "string", "pattern": "a^b"})
+
+
+def test_nullable_honored_at_every_level():
+    # nullable is allowlisted everywhere, so it must WORK everywhere —
+    # array items and top level, not just object properties.
+    s = {"type": "array", "items": {"type": "integer", "nullable": True}}
+    assert accepts(s, [1, None, 3])
+    assert not accepts(s, ["x"])
+    assert accepts({"type": "string", "nullable": True}, None)
+    assert accepts({"enum": ["a", "b"], "nullable": True}, None)
+
+
+def test_allowlist_rejects_unknown_keywords():
+    # Allowlist, not denylist: ANY constraining keyword outside the
+    # supported set must fail loudly instead of silently under-constraining.
+    for bad in (
+        {"type": "integer", "minimum": 0},
+        {"type": "string", "maxLength": 8},
+        {"type": "string", "minLength": 1},
+        {"type": "number", "multipleOf": 2},
+        {"type": "object", "properties": {"a": {"type": "integer"}},
+         "required": ["a"]},
+        {"type": "array", "items": {"type": "integer"}, "uniqueItems": True},
+        {"type": "integer", "not": {"enum": [3]}},
+        {"type": "integer", "if": {"enum": [3]}},
+    ):
+        with pytest.raises(SchemaError):
+            schema_to_regex(bad)
+    # annotation-only keys constrain nothing and stay tolerated
+    r = schema_to_regex({"type": "integer", "title": "count",
+                         "description": "a count", "default": 0})
+    assert re.fullmatch(r, "12")
+
+
 def test_loud_rejections():
     for bad in (
         {"$ref": "#/defs/x"},
