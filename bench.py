@@ -767,7 +767,42 @@ def main() -> None:
             "flash_kernel_4x16x2048x128": {k: rnd(v) for k, v in kern.items()},
         },
     }
-    print(json.dumps(out))
+    # The driver records only a ~2,000-char tail of stdout; the round-4
+    # artifact exceeded it and was captured as a truncated string
+    # (BENCH_r04.json "parsed": null).  So: write the FULL result to a
+    # file (the in-repo pin copies it), and print one COMPACT
+    # headline-first line that fits the window whole.
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_full.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+    detail = out["detail"]
+    keep = (
+        "platform", "device_preflight_ok", "mfu", "train_step_s",
+        "train_tokens_per_s", "decode_tokens_per_s",
+        "decode_tokens_per_s_int8", "cb_decode_tokens_per_s_1req",
+        "cb_decode_tokens_per_s_8req", "cb_batch_scaling_x",
+        "cb_spec_vs_plain_x", "cb_spec_measured_acceptance",
+        "cb_ngram_vs_plain_x", "cb_ngram_vs_plain_x_repetitive",
+        "kv_quant_capacity_x", "paged_kv_capacity_x",
+    )
+    compact = {
+        "metric": out["metric"],
+        "value": out["value"],
+        "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"],
+        "detail": {
+            **{k: detail[k] for k in keep if k in detail},
+            "full_json": "bench_full.json",
+        },
+    }
+    line = json.dumps(compact)
+    if len(line) > 1900:  # never regress into the truncation failure mode
+        line = json.dumps({"metric": out["metric"], "value": out["value"],
+                           "unit": out["unit"],
+                           "vs_baseline": out["vs_baseline"],
+                           "detail": {"full_json": "bench_full.json"}})
+    print(line)
 
 
 if __name__ == "__main__":
