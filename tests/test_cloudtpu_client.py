@@ -358,6 +358,140 @@ def test_reconciler_runs_unmodified_against_real_client():
         mgr.stop()
 
 
+# -- multislice queued resources (BASELINE config 4's provisioning half) ----
+
+class MsSpec:
+    accelerator_type = "v5p-32"
+    slice_count = 2
+    runtime_version = "tpu-ubuntu2204-base"
+    network = "default"
+    spot = False
+    reserved = False
+
+
+MS_TAGS = {"managed-by": "tpupodslice-operator", "owner": "default-msdemo"}
+
+
+def test_multislice_create_emits_one_nodespec_per_slice():
+    """slice_count=2 → the explicit multislice create form: TWO nodeSpec
+    entries under one queued resource, ids suffixed -slice-{i} (recorded
+    wire shape qr_ms_accepted.json)."""
+    client, api, _ = make_client([
+        ("POST", r"/queuedResources\?", 200, b"{}"),
+        ("GET", r"/queuedResources/ms-demo-qr$", 200,
+         fx_bytes("qr_ms_accepted.json")),
+    ])
+    qr = client.create_resource("ms-demo-qr", MsSpec(), MS_TAGS)
+    specs = api.calls[0]["body"]["tpu"]["nodeSpec"]
+    assert [ns["nodeId"] for ns in specs] == [
+        "ms-demo-qr-slice-0", "ms-demo-qr-slice-1"
+    ]
+    assert all(ns["node"]["acceleratorType"] == "v5p-32" for ns in specs)
+    # the POSTed body parses back to the same shape the fixture records
+    assert api.calls[0]["body"]["tpu"] == fx("qr_ms_accepted.json")["tpu"]
+    assert qr.state == "ACCEPTED" and qr.slice_count == 2
+
+
+def test_multislice_active_attaches_per_slice_inventory():
+    """An ACTIVE 2-slice QR does one nodes.get PER SLICE and carries two
+    disjoint host inventories — the DCN-connected slice pair the mesh
+    layer's multislice_mesh consumes."""
+    client, api, _ = make_client([
+        ("GET", r"/queuedResources/ms-demo-qr$", 200,
+         fx_bytes("qr_ms_active.json")),
+        ("GET", r"/nodes/ms-demo-qr-slice-0$", 200,
+         fx_bytes("node_ms_slice0.json")),
+        ("GET", r"/nodes/ms-demo-qr-slice-1$", 200,
+         fx_bytes("node_ms_slice1.json")),
+    ])
+    qr = client._get("ms-demo-qr")
+    assert qr.state == "ACTIVE" and qr.slice_count == 2
+    assert len(qr.slices) == 2
+    for i, inv in enumerate(qr.slices):
+        assert inv.name == f"ms-demo-qr-slice-{i}"
+        assert inv.topology == "2x4x4" and len(inv.hosts) == 8
+        assert sum(h.chips for h in inv.hosts) == 32
+    ips0 = {h.internal_ip for h in qr.slices[0].hosts}
+    ips1 = {h.internal_ip for h in qr.slices[1].hosts}
+    assert not ips0 & ips1, "slices must be distinct host sets"
+
+
+def test_multislice_partial_failure_is_atomic_and_names_the_node():
+    """One slice hitting capacity exhaustion fails the WHOLE queued
+    resource (atomicity is the point of QRs vs N independent creates);
+    the error names the failing node so operators can see which half
+    died.  The reconciler's self-heal ladder keys off state=FAILED."""
+    client, _, _ = make_client([
+        ("GET", r"/queuedResources/ms-demo-qr$", 200,
+         fx_bytes("qr_ms_partial_failed.json")),
+    ])
+    qr = client._get("ms-demo-qr")
+    assert qr.state == "FAILED"
+    assert "ms-demo-qr-slice-1" in qr.error
+    assert "rolled back" in qr.error
+    assert not client.is_ready(qr)
+    # no nodes.get calls for a FAILED QR — inventory only attaches to ACTIVE
+
+
+def test_multislice_spot_preemption_mid_provision():
+    """Spot reclamation while provisioning drops the QR to SUSPENDED with
+    the spot tier marker intact; SUSPENDED is in the reconciler's broken
+    set (delete + recreate), so parse must surface it as-is."""
+    client, _, _ = make_client([
+        ("GET", r"/queuedResources/ms-demo-qr$", 200,
+         fx_bytes("qr_ms_preempted.json")),
+    ])
+    qr = client._get("ms-demo-qr")
+    assert qr.state == "SUSPENDED" and qr.spot
+    assert not client.is_ready(qr)
+    from k8s_gpu_tpu.operators.tpupodslice import TpuPodSliceReconciler  # noqa: F401
+    # the state the self-heal branch keys on (operators/tpupodslice.py:121)
+    assert qr.state in ("FAILED", "SUSPENDED")
+
+
+def test_multislice_reconciler_end_to_end_against_real_client():
+    """A slice_count=2 TpuPodSlice reaches Ready through the REAL client
+    over the HTTP-level fake: 2 slices × 8 hosts of nodes, 64 chips."""
+    import time
+
+    from k8s_gpu_tpu.controller import FakeKube, Manager
+    from k8s_gpu_tpu.operators import TpuPodSliceReconciler
+
+    rest = RestFakeCloudTpu()
+    tt = ReplayTransport(
+        [("GET", "metadata.google.internal", 200, fx_bytes("token.json"))] * 50
+    )
+    factory = real_cloudtpu_client_factory(
+        "proj-1", "us-east5-a", transport=rest, token_transport=tt
+    )
+    kube = FakeKube()
+    mgr = Manager(kube)
+    mgr.register(
+        "TpuPodSlice",
+        TpuPodSliceReconciler(kube, factory, provision_poll=0.02),
+    )
+    mgr.start()
+    try:
+        ps = TpuPodSlice()
+        ps.metadata.name = "msdemo"
+        ps.spec.accelerator_type = "v5p-32"
+        ps.spec.slice_count = 2
+        kube.create(ps)
+        deadline = time.time() + 20
+        cur = None
+        while time.time() < deadline:
+            cur = kube.get("TpuPodSlice", "msdemo")
+            if cur.status.phase == "Ready":
+                break
+            time.sleep(0.01)
+        assert cur.status.phase == "Ready"
+        nodes = kube.list("Node")
+        assert len(nodes) == 16  # 2 slices x 8 hosts
+        assert sum(int(n.capacity["google.com/tpu"]) for n in nodes) == 64
+    finally:
+        mgr.stop()
+
+
 def test_spot_and_reserved_mutually_exclusive():
     """Silently dropping one tier would round-trip as drift and make the
     reconciler delete/recreate forever — both layers must reject it."""
