@@ -14,11 +14,16 @@ exchange:
 1. **Real SSH-2** (RFC 4253/4252/4254 via platform/sshwire.py):
    curve25519-sha256 kex, ssh-ed25519 host + user keys, aes128-ctr +
    hmac-sha2-256, publickey auth against the user-ssh Secret, session
-   channels with exec — what ``k8sgpu devenv ssh --ssh2`` (and any
-   client speaking that suite) uses.  The host key persists as Secret
-   ``ssh-gateway-hostkey`` (the known_hosts contract).
-2. **Legacy line protocol**, one line each way (kept for the PUT bulk
-   path and scripted tooling):
+   channels with ``exec``, ``pty-req``+``shell`` (line-discipline
+   interactive sessions) and the ``sftp`` subsystem (platform/sftp.py
+   — open/read/write/stat/readdir against the versioned asset store,
+   the lftp-mirror bulk path, :707-734) — what ``k8sgpu devenv ssh
+   --ssh2`` / ``devenv put --ssh2`` (and any client speaking that
+   suite) use.  The host key persists as Secret ``ssh-gateway-hostkey``
+   (the known_hosts contract).
+2. **Legacy line protocol**, one line each way — DEPRECATED: kept one
+   round for scripted tooling migration; the PUT verb's role moved to
+   the SFTP subsystem:
 
     S: SSH-2.0-k8sgpu-devenv-gateway\r\n        (version banner, like sshd)
     C: SSH-2.0-<client>\r\n
@@ -347,63 +352,148 @@ class SshGateway:
             break
         if pod is None:
             return
-        # connection layer: session channels, exec requests.
-        while True:
-            try:
-                pkt = conn.recv()
-            except w.SshError:
-                return
-            t = pkt[0]
-            if t == w.MSG_DISCONNECT:
-                return
-            if t == w.MSG_CHANNEL_OPEN:
-                r = w.Reader(pkt[1:])
-                ctype = r.string()
-                peer_chan = r.u32()
-                if ctype != b"session":
-                    conn.send(
-                        bytes([w.MSG_CHANNEL_OPEN_FAILURE])
-                        + w.su32(peer_chan) + w.su32(3)
-                        + w.sb(b"only session channels") + w.sb(b"")
-                    )
-                    continue
-                conn.send(
-                    bytes([w.MSG_CHANNEL_OPEN_CONFIRMATION])
-                    + w.su32(peer_chan) + w.su32(peer_chan)
-                    + w.su32(1 << 20) + w.su32(1 << 15)
-                )
-            elif t == w.MSG_CHANNEL_REQUEST:
-                r = w.Reader(pkt[1:])
-                chan = r.u32()
-                rtype = r.string()
-                want_reply = r.boolean()
-                if rtype != b"exec":
-                    if want_reply:
-                        conn.send(
-                            bytes([w.MSG_CHANNEL_FAILURE]) + w.su32(chan)
-                        )
-                    continue
-                cmd = r.string().decode("utf-8", "replace")
-                if want_reply:
-                    conn.send(bytes([w.MSG_CHANNEL_SUCCESS]) + w.su32(chan))
-                out = self._exec(username, pod, cmd)
-                status = 1 if out.startswith("ERR ") else 0
-                conn.send(
-                    bytes([w.MSG_CHANNEL_DATA]) + w.su32(chan)
-                    + w.sb((out + "\n").encode())
-                )
+        # connection layer: session channels with exec / pty-req+shell /
+        # the sftp subsystem.  Per-channel state lives in `chans` —
+        # a shell keeps a line buffer, an sftp channel keeps its
+        # SftpServer (which owns handles and staged uploads).
+        chans: dict[int, dict] = {}
+
+        def data(chan: int, payload: bytes) -> None:
+            conn.send(
+                bytes([w.MSG_CHANNEL_DATA]) + w.su32(chan) + w.sb(payload)
+            )
+
+        def close_chan(chan: int, status: int | None = None) -> None:
+            if status is not None:
                 conn.send(
                     bytes([w.MSG_CHANNEL_REQUEST]) + w.su32(chan)
                     + w.sb(b"exit-status") + b"\x00" + w.su32(status)
                 )
-                conn.send(bytes([w.MSG_CHANNEL_EOF]) + w.su32(chan))
-                conn.send(bytes([w.MSG_CHANNEL_CLOSE]) + w.su32(chan))
-            elif t == w.MSG_CHANNEL_CLOSE:
-                continue
-            elif t == w.MSG_CHANNEL_EOF:
-                continue
-            else:
-                raise w.SshError(f"unexpected message {t}")
+            conn.send(bytes([w.MSG_CHANNEL_EOF]) + w.su32(chan))
+            conn.send(bytes([w.MSG_CHANNEL_CLOSE]) + w.su32(chan))
+            st = chans.pop(chan, None)
+            if st and st.get("sftp") is not None:
+                st["sftp"].close()
+
+        prompt = f"{username}@{pod.metadata.name}:~$ ".encode()
+        try:
+            while True:
+                try:
+                    pkt = conn.recv()
+                except w.SshError:
+                    return
+                t = pkt[0]
+                if t == w.MSG_DISCONNECT:
+                    return
+                if t == w.MSG_CHANNEL_OPEN:
+                    r = w.Reader(pkt[1:])
+                    ctype = r.string()
+                    peer_chan = r.u32()
+                    if ctype != b"session":
+                        conn.send(
+                            bytes([w.MSG_CHANNEL_OPEN_FAILURE])
+                            + w.su32(peer_chan) + w.su32(3)
+                            + w.sb(b"only session channels") + w.sb(b"")
+                        )
+                        continue
+                    chans[peer_chan] = {
+                        "mode": None, "pty": False,
+                        "buf": bytearray(), "sftp": None,
+                    }
+                    conn.send(
+                        bytes([w.MSG_CHANNEL_OPEN_CONFIRMATION])
+                        + w.su32(peer_chan) + w.su32(peer_chan)
+                        + w.su32(1 << 20) + w.su32(1 << 15)
+                    )
+                elif t == w.MSG_CHANNEL_REQUEST:
+                    r = w.Reader(pkt[1:])
+                    chan = r.u32()
+                    rtype = r.string()
+                    want_reply = r.boolean()
+                    st = chans.get(chan)
+
+                    def reply(ok: bool) -> None:
+                        if want_reply:
+                            conn.send(bytes([
+                                w.MSG_CHANNEL_SUCCESS if ok
+                                else w.MSG_CHANNEL_FAILURE
+                            ]) + w.su32(chan))
+
+                    if st is None:
+                        reply(False)
+                        continue
+                    if rtype == b"pty-req":
+                        # Terminal geometry is acknowledged, not emulated:
+                        # the line discipline below needs no cursor state.
+                        st["pty"] = True
+                        reply(True)
+                    elif rtype == b"shell":
+                        st["mode"] = "shell"
+                        reply(True)
+                        data(chan, (
+                            f"Welcome to the TPU devenv "
+                            f"({pod.requests.get('google.com/tpu', 0)} "
+                            f"chip(s), workspace at /workspace)\n"
+                        ).encode() + prompt)
+                    elif rtype == b"subsystem":
+                        name = r.string()
+                        if name != b"sftp" or self.assets is None:
+                            reply(False)
+                            continue
+                        from .sftp import SftpServer
+
+                        st["mode"] = "sftp"
+                        st["sftp"] = SftpServer(self.assets, username)
+                        reply(True)
+                    elif rtype == b"exec":
+                        cmd = r.string().decode("utf-8", "replace")
+                        reply(True)
+                        out = self._exec(username, pod, cmd)
+                        status = 1 if out.startswith("ERR ") else 0
+                        data(chan, (out + "\n").encode())
+                        close_chan(chan, status)
+                    else:
+                        reply(False)
+                elif t == w.MSG_CHANNEL_DATA:
+                    r = w.Reader(pkt[1:])
+                    chan = r.u32()
+                    payload = r.string()
+                    st = chans.get(chan)
+                    if st is None:
+                        continue
+                    if st["mode"] == "sftp":
+                        resp = st["sftp"].feed(payload)
+                        if resp:
+                            data(chan, resp)
+                    elif st["mode"] == "shell":
+                        st["buf"].extend(payload)
+                        while b"\n" in st["buf"]:
+                            nl = st["buf"].index(b"\n")
+                            line = bytes(st["buf"][:nl]).decode(
+                                "utf-8", "replace"
+                            ).strip()
+                            del st["buf"][:nl + 1]
+                            if line in ("exit", "logout"):
+                                data(chan, b"logout\n")
+                                close_chan(chan, 0)
+                                break
+                            if line:
+                                out = self._exec(username, pod, line)
+                                data(chan, (out + "\n").encode() + prompt)
+                            else:
+                                data(chan, prompt)
+                elif t in (w.MSG_CHANNEL_WINDOW_ADJUST, w.MSG_CHANNEL_EOF):
+                    continue
+                elif t == w.MSG_CHANNEL_CLOSE:
+                    st = chans.pop(w.Reader(pkt[1:]).u32(), None)
+                    if st and st.get("sftp") is not None:
+                        st["sftp"].close()
+                else:
+                    raise w.SshError(f"unexpected message {t}")
+        finally:
+            for st in chans.values():
+                if st.get("sftp") is not None:
+                    st["sftp"].close()
 
     # -- auth + session backends (live cluster state) -----------------------
     def _authenticate(self, username: str, offered_key: str):

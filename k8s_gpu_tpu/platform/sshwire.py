@@ -12,9 +12,11 @@ length one (RFC 4253 allows exactly this):
     mac        hmac-sha2-256            (RFC 6668)
     compression none
 
-Channel layer: session channels with ``exec`` requests only — the
-gateway's job is the reference's ingress routing + key check; a full
-shell/PTY belongs to the in-pod sshd it fronts.
+Channel layer: session channels with ``exec``, ``pty-req``/``shell``
+(a line-discipline interactive session — what VSCode Remote-SSH's
+bootstrap and scripted ssh need), and the ``sftp`` subsystem
+(platform/sftp.py — the standard bulk-transfer path replacing the
+legacy PUT line verb).
 
 Everything here is transport mechanics shared by the server
 (sshgate.SshGateway) and the client (Ssh2Client below, what
@@ -57,7 +59,9 @@ MSG_USERAUTH_PK_OK = 60
 MSG_CHANNEL_OPEN = 90
 MSG_CHANNEL_OPEN_CONFIRMATION = 91
 MSG_CHANNEL_OPEN_FAILURE = 92
+MSG_CHANNEL_WINDOW_ADJUST = 93
 MSG_CHANNEL_DATA = 94
+MSG_CHANNEL_EXTENDED_DATA = 95
 MSG_CHANNEL_EOF = 96
 MSG_CHANNEL_CLOSE = 97
 MSG_CHANNEL_REQUEST = 98
@@ -448,8 +452,8 @@ class Ssh2Client:
             raise SshError("authentication failed")
         self._next_chan = 0
 
-    def exec(self, command: str) -> tuple[str, int]:
-        """Run one command in a session channel → (output, exit_status)."""
+    def _open_session(self) -> int:
+        """CHANNEL_OPEN "session" → the server's channel id."""
         cid = self._next_chan
         self._next_chan += 1
         self.conn.send(
@@ -461,7 +465,67 @@ class Ssh2Client:
             raise SshError("channel open refused")
         r = Reader(pkt[1:])
         r.u32()  # recipient (our id)
-        server_chan = r.u32()
+        return r.u32()
+
+    def _recv_channel_data(self) -> bytes:
+        """Next CHANNEL_DATA payload; flow-control and reply chatter is
+        skipped (this client never exhausts the gateway's window)."""
+        while True:
+            pkt = self.conn.recv()
+            t = pkt[0]
+            if t == MSG_CHANNEL_DATA:
+                r = Reader(pkt[1:])
+                r.u32()
+                return r.string()
+            if t in (MSG_CHANNEL_WINDOW_ADJUST, MSG_CHANNEL_SUCCESS,
+                     MSG_CHANNEL_EXTENDED_DATA):
+                continue
+            if t == MSG_CHANNEL_FAILURE:
+                raise SshError("channel request refused")
+            if t in (MSG_CHANNEL_EOF, MSG_CHANNEL_CLOSE):
+                raise SshError("channel closed")
+            raise SshError(f"unexpected channel message {t}")
+
+    def _send_channel_data(self, server_chan: int, data: bytes) -> None:
+        self.conn.send(
+            bytes([MSG_CHANNEL_DATA]) + su32(server_chan) + sb(data)
+        )
+
+    def sftp(self) -> "object":
+        """Open the sftp subsystem on a fresh session channel → SftpClient
+        (platform/sftp.py): put/get/stat/listdir against the asset store
+        over standard SFTP v3 — the `lftp sftp://` role."""
+        from .sftp import SftpClient
+
+        server_chan = self._open_session()
+        self.conn.send(
+            bytes([MSG_CHANNEL_REQUEST]) + su32(server_chan)
+            + sb(b"subsystem") + b"\x01" + sb(b"sftp")
+        )
+        return SftpClient(
+            lambda data: self._send_channel_data(server_chan, data),
+            self._recv_channel_data,
+        )
+
+    def shell(self, term: str = "xterm", cols: int = 80,
+              rows: int = 24) -> "Ssh2Shell":
+        """pty-req + shell on a fresh session channel → an interactive
+        line-discipline session (Ssh2Shell.run / .close)."""
+        server_chan = self._open_session()
+        self.conn.send(
+            bytes([MSG_CHANNEL_REQUEST]) + su32(server_chan)
+            + sb(b"pty-req") + b"\x01" + sb(term.encode())
+            + su32(cols) + su32(rows) + su32(0) + su32(0) + sb(b"")
+        )
+        self.conn.send(
+            bytes([MSG_CHANNEL_REQUEST]) + su32(server_chan)
+            + sb(b"shell") + b"\x01"
+        )
+        return Ssh2Shell(self, server_chan)
+
+    def exec(self, command: str) -> tuple[str, int]:
+        """Run one command in a session channel → (output, exit_status)."""
+        server_chan = self._open_session()
         self.conn.send(
             bytes([MSG_CHANNEL_REQUEST]) + su32(server_chan)
             + sb(b"exec") + b"\x01" + sb(command.encode())
@@ -488,8 +552,12 @@ class Ssh2Client:
             elif t == MSG_CHANNEL_EOF:
                 continue
             elif t == MSG_CHANNEL_CLOSE:
-                self.conn.send(bytes([MSG_CHANNEL_CLOSE]) + su32(cid))
+                self.conn.send(
+                    bytes([MSG_CHANNEL_CLOSE]) + su32(server_chan)
+                )
                 break
+            elif t == MSG_CHANNEL_WINDOW_ADJUST:
+                continue
             else:
                 raise SshError(f"unexpected channel message {t}")
         return out.decode("utf-8", "replace"), status
@@ -506,3 +574,56 @@ class Ssh2Client:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class Ssh2Shell:
+    """A line-discipline interactive session over a pty-req+shell
+    channel: ``run()`` sends one line and collects output until the
+    next prompt — the scripted form of what a human (or VSCode
+    Remote-SSH's bootstrap probe) does at the prompt."""
+
+    PROMPT_TAIL = b"$ "
+
+    def __init__(self, client: Ssh2Client, server_chan: int):
+        self._c = client
+        self._chan = server_chan
+        self.banner = self._read_to_prompt()
+
+    def _read_to_prompt(self) -> str:
+        buf = b""
+        while not buf.endswith(self.PROMPT_TAIL):
+            buf += self._c._recv_channel_data()
+        # strip the trailing prompt line itself
+        body = buf[: buf.rfind(b"\n") + 1] if b"\n" in buf else b""
+        return body.decode("utf-8", "replace")
+
+    def run(self, command: str) -> str:
+        """One command → its output (everything up to the next prompt)."""
+        if "\n" in command.strip():
+            raise ValueError("one line per run() call")
+        self._c._send_channel_data(self._chan, command.encode() + b"\n")
+        return self._read_to_prompt()
+
+    def close(self) -> None:
+        """`exit` the shell; drains until the server closes the channel."""
+        self._c._send_channel_data(self._chan, b"exit\n")
+        while True:
+            pkt = self._c.conn.recv()
+            if pkt[0] == MSG_CHANNEL_CLOSE:
+                self._c.conn.send(
+                    bytes([MSG_CHANNEL_CLOSE]) + su32(self._chan)
+                )
+                return
+            if pkt[0] in (MSG_CHANNEL_DATA, MSG_CHANNEL_EOF,
+                          MSG_CHANNEL_REQUEST, MSG_CHANNEL_WINDOW_ADJUST):
+                continue
+            raise SshError(f"unexpected message {pkt[0]} at shell exit")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            self.close()
+        except SshError:
+            pass
