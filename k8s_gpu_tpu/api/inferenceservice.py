@@ -40,6 +40,11 @@ class InferenceServiceSpec:
     slots: int = 8
     spec_k: int = 4
     kv_quant: bool = False
+    # Paged (block-table) KV pool: > 0 = number of physical blocks of
+    # pagedPageSize positions; cache bytes then scale with USED tokens
+    # (serve/batcher.py paged mode).  0 = dense slots×max_seq pool.
+    paged_blocks: int = 0
+    paged_page_size: int = 64
     eos_id: int = -1
     max_new_tokens_cap: int = 256
     # Queue-depth autoscaling: when max_replicas > 0 the reconciler sizes
@@ -113,4 +118,12 @@ class InferenceService(CustomResource):
             raise ValidationError(
                 "spec.draftMode and spec.draft are mutually exclusive "
                 "(ngram drafting uses no draft bundle)"
+            )
+        if s.paged_blocks < 0:
+            raise ValidationError("spec.pagedBlocks must be >= 0")
+        if s.paged_blocks and (s.draft.id or s.draft_mode):
+            raise ValidationError(
+                "spec.pagedBlocks and speculative drafting are not yet "
+                "combinable (the draft pool splices dense rows) — pick "
+                "one per service"
             )

@@ -912,6 +912,7 @@ def cmd_serve(args) -> int:
             constraints=constraints or None,
             eos_id=args.eos_id,
             draft=draft, kv_quant=args.kv_quant,
+            paged_blocks=args.paged_blocks,
         ).start()
     except ValueError as e:  # bad regex / vocab mismatch: clean exit
         print(str(e), file=sys.stderr)
@@ -1138,6 +1139,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "mutually exclusive with --draft")
     p_srv.add_argument("--kv-quant", action="store_true",
                        help="int8 KV cache (~1.9x slot capacity)")
+    p_srv.add_argument("--paged-blocks", type=int, default=0,
+                       help="paged KV pool: N physical blocks of 64 "
+                            "positions shared by all slots (cache bytes "
+                            "scale with used tokens); 0 = dense pool")
     p_srv.add_argument("--for-seconds", type=float, default=0.0,
                        help="exit after N seconds (0 = until interrupted)")
     p_srv.set_defaults(fn=cmd_serve)

@@ -250,6 +250,8 @@ class InferenceServiceReconciler(Reconciler):
             draft=draft,
             spec_k=svc.spec.spec_k,
             kv_quant=svc.spec.kv_quant,
+            paged_blocks=svc.spec.paged_blocks,
+            page_size=svc.spec.paged_page_size,
         ).start()
         self._servers[key] = server
         self._server_bundles[key] = used

@@ -9,12 +9,12 @@ from .engine import DecodeOutput, InferenceEngine, SamplingConfig
 from .jsonschema import SchemaError, schema_to_regex
 from .quant import quantize_params
 from .server import LmServer
-from .speculative import SpecOutput, SpeculativeDecoder, distill_draft
+from .speculative import distill_draft, rejection_sample
 
 __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
-    "ContinuousBatcher", "RequestHandle", "SpeculativeDecoder",
-    "SpecOutput", "quantize_params", "export_servable", "load_servable",
+    "ContinuousBatcher", "RequestHandle",
+    "quantize_params", "export_servable", "load_servable",
     "DisaggregatedLm", "RegexConstraint", "compile_constraint",
-    "distill_draft", "schema_to_regex", "SchemaError",
+    "distill_draft", "rejection_sample", "schema_to_regex", "SchemaError",
 ]

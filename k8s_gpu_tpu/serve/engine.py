@@ -99,6 +99,30 @@ def _empty_cache(cfg: TransformerConfig, batch: int, max_seq: int,
     }
 
 
+def _empty_cache_paged(cfg: TransformerConfig, n_blocks: int, page: int,
+                       kv_quant: bool = False):
+    """Paged KV pool: physical blocks of ``page`` positions shared by all
+    slots through per-slot page tables (the vLLM PagedAttention memory
+    model, XLA-shaped).  A slot's cache bytes scale with the tokens it
+    USES — ceil(len/page) blocks — instead of reserving max_seq
+    (VERDICT r4 weak #6: the dense pool wastes proportionally on
+    mixed-length traffic).  Block 0 is the TRASH block: page-table rows
+    of retired slots point at it, so garbage in-flight writes land
+    somewhere harmless instead of corrupting a reused block."""
+    shape = (cfg.n_layers, n_blocks, cfg.kv_heads, page, cfg.d_head)
+    if kv_quant:
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_s": jnp.zeros(shape[:-1], jnp.float32),
+            "v_s": jnp.zeros(shape[:-1], jnp.float32),
+        }
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
 def _quantize_kv(x):
     """x [..., Dh] → (int8 values, f32 scale [...]): symmetric per-vector
     absmax quantization — the head-dim vector at one (row, head,
@@ -259,9 +283,42 @@ class InferenceEngine:
             jnp.moveaxis(val, 2, 1).astype(arr.dtype)
         )
 
+    @staticmethod
+    def _paged_store(arr, val, pages, pos, page: int, layer: int):
+        """Scatter ``val`` [B, KH, Sq, *rest] into the paged pool
+        ``arr`` [L, NB, KH, page, *rest] through per-row page tables
+        ``pages`` [B, MP] at positions ``pos`` ([B] when Sq == 1, else
+        the window starts).  Logical position p of row b lives at
+        physical (pages[b, p // page], p % page)."""
+        B, _, sq = val.shape[0], val.shape[1], val.shape[2]
+        rows = jnp.arange(B)
+        if sq == 1:
+            blk = pages[rows, pos // page]          # [B]
+            off = pos % page                        # [B]
+            return arr.at[layer, blk, :, off].set(
+                val[:, :, 0].astype(arr.dtype)
+            )
+        q_pos = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None]  # [B,W]
+        blk = pages[rows[:, None], q_pos // page]   # [B, W]
+        off = q_pos % page                          # [B, W]
+        return arr.at[layer, blk, :, off].set(
+            jnp.moveaxis(val, 2, 1).astype(arr.dtype)
+        )
+
+    @staticmethod
+    def _paged_read(arr, pages, p_hi: int, layer: int):
+        """Gather a row-contiguous view [B, KH, p_hi*page, *rest] of the
+        first ``p_hi`` logical pages of every row."""
+        sel = arr[layer][pages[:, :p_hi]]           # [B, P, KH, page, *rest]
+        sel = jnp.moveaxis(sel, 2, 1)               # [B, KH, P, page, *rest]
+        return sel.reshape(
+            sel.shape[0], sel.shape[1], sel.shape[2] * sel.shape[3],
+            *sel.shape[4:]
+        )
+
     def _block_cached(self, x, lp, lc, positions, start, mask,
                       moe_full_capacity=None, lp_ad=None, adapter_idx=None,
-                      layer=None):
+                      layer=None, pages=None, page: int = 0):
         """One transformer block over query slice x [B,Sq,D] with the K/V for
         the slice written into the layer cache ``lc`` (k/v [+ k_s/v_s
         when kv_quant]) at ``start``.  Returns (x_out, new_lc).
@@ -302,6 +359,31 @@ class InferenceEngine:
         v = v.transpose(0, 2, 1, 3)
         sq = x.shape[1]
         lc = dict(lc)
+        if pages is not None:
+            if self.kv_quant:
+                kq, ks = _quantize_kv(k)
+                vq, vs = _quantize_kv(v)
+                lc["k"] = self._paged_store(lc["k"], kq, pages, start, page, layer)
+                lc["v"] = self._paged_store(lc["v"], vq, pages, start, page, layer)
+                lc["k_s"] = self._paged_store(lc["k_s"], ks, pages, start, page, layer)
+                lc["v_s"] = self._paged_store(lc["v_s"], vs, pages, start, page, layer)
+            else:
+                lc["k"] = self._paged_store(lc["k"], k, pages, start, page, layer)
+                lc["v"] = self._paged_store(lc["v"], v, pages, start, page, layer)
+            p_hi = mask.shape[-1] // page
+            k_read = self._paged_read(lc["k"], pages, p_hi, layer)
+            v_read = self._paged_read(lc["v"], pages, p_hi, layer)
+            ks_read = (self._paged_read(lc["k_s"], pages, p_hi, layer)
+                       if "k_s" in lc else None)
+            vs_read = (self._paged_read(lc["v_s"], pages, p_hi, layer)
+                       if "v_s" in lc else None)
+            o = self._attend_cached(
+                q, k_read, v_read, mask,
+                k_scale=ks_read, v_scale=vs_read,
+            )
+            return self._block_epilogue(
+                x, o, lp, lp_ad, adapter_idx, mask, moe_full_capacity
+            ), lc
         if self.kv_quant:
             kq, ks = _quantize_kv(k)
             vq, vs = _quantize_kv(v)
@@ -330,6 +412,16 @@ class InferenceEngine:
             q, k_read, v_read, mask,
             k_scale=ks_read, v_scale=vs_read,
         )
+        return self._block_epilogue(
+            x, o, lp, lp_ad, adapter_idx, mask, moe_full_capacity
+        ), lc
+
+    def _block_epilogue(self, x, o, lp, lp_ad, adapter_idx, mask,
+                        moe_full_capacity):
+        """Attention output projection + MLP — shared by the dense and
+        paged cache branches of _block_cached."""
+        m = self.model
+        dt = self.cfg.dtype
         attn_out = jnp.einsum("bshk,hkd->bsd", o, wt(lp["wo"], dt))
         if lp_ad is not None and "wo" in lp_ad:
             o_flat = o.reshape(o.shape[0], o.shape[1], -1)
@@ -354,11 +446,11 @@ class InferenceEngine:
             x = x + y
         else:
             x = x + m._dense_mlp(h2, lp)
-        return x, lc
+        return x
 
     def _run_blocks(self, params, x, cache, positions, start, mask,
                     moe_full_capacity=None, adapters=None, adapter_idx=None,
-                    unroll_layers=False):
+                    unroll_layers=False, pages=None, page: int = 0):
         """``unroll_layers``: decode paths set True — a Python loop over
         layers scatters each K/V write straight into the stacked cache
         (in-place under XLA aliasing), where the layer scan would round-
@@ -379,8 +471,10 @@ class InferenceEngine:
                     x, lp, new_cache, positions, start, mask,
                     moe_full_capacity=moe_full_capacity,
                     lp_ad=lp_ad, adapter_idx=adapter_idx, layer=l,
+                    pages=pages, page=page,
                 )
             return self._head(params, x), new_cache
+        assert pages is None, "paged KV requires the unrolled decode path"
         if adapters is None:
             def scan_fn(carry, layer):
                 lp, lc = layer
@@ -474,7 +568,7 @@ class InferenceEngine:
 
     def decode_step_multi(self, params, cache, token, pos, rope_pos,
                           kv_start, adapters=None, adapter_idx=None,
-                          t_hi=None):
+                          t_hi=None, pages=None, page: int = 0):
         """One decode step where every batch row sits at its *own* cache
         position — the continuous-batching kernel.
 
@@ -486,18 +580,27 @@ class InferenceEngine:
         ``t_hi`` (static): upper bound on every LIVE row's pos — the
         attention read covers cache[..., :t_hi] only (the scheduler
         buckets it pow2 from its host position mirror), cutting decode's
-        bandwidth-bound cache traffic by max_seq/t_hi at short contexts."""
+        bandwidth-bound cache traffic by max_seq/t_hi at short contexts.
+
+        ``pages`` [B, MP] int32 + ``page`` (static): paged-KV mode —
+        ``cache`` leaves are the [L, NB, KH, page, ...] physical pool
+        and row b's logical position p lives at block pages[b, p//page].
+        t_hi rounds up to a page multiple (the read gathers whole
+        pages)."""
         B = token.shape[0]
         x = emb_lookup(params["embed"], token, self.cfg.dtype)[:, None]  # [B,1,D]
         pos = jnp.asarray(pos, jnp.int32)
-        t = jnp.arange(t_hi if t_hi is not None else self.max_seq)
+        T = t_hi if t_hi is not None else self.max_seq
+        if pages is not None:
+            T = -(-T // page) * page  # whole pages only
+        t = jnp.arange(T)
         mask = (
             (t[None, :] <= pos[:, None]) & (t[None, :] >= kv_start[:, None])
         )[:, None, :]  # [B, 1, T]
         logits, cache = self._run_blocks(
             params, x, cache, jnp.asarray(rope_pos, jnp.int32)[:, None], pos,
             mask, adapters=adapters, adapter_idx=adapter_idx,
-            unroll_layers=True,
+            unroll_layers=True, pages=pages, page=page,
         )
         return cache, logits[:, 0]
 

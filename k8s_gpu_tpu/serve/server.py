@@ -45,6 +45,8 @@ class LmServer:
         draft=None,
         spec_k: int = 4,
         kv_quant: bool = False,
+        paged_blocks: int = 0,
+        page_size: int = 64,
     ):
         """``adapters``: name → (lora_params, LoraConfig); requests pick
         one with {"adapter": "<name>"} — multi-tenant fine-tunes served
@@ -55,8 +57,9 @@ class LmServer:
         with {"constraint": "<name>"} (serve/constrain.py).  Configure
         ``eos_id`` with constraints so dead-ended rows retire cleanly.
 
-        ``draft``/``kv_quant`` pass through to ContinuousBatcher:
-        speculative rounds and the int8 pool KV cache."""
+        ``draft``/``kv_quant``/``paged_blocks``/``page_size`` pass
+        through to ContinuousBatcher: speculative rounds, the int8 pool
+        KV cache, and the paged (block-table) KV pool."""
         cbank = None
         if constraints:
             from .constrain import ConstraintBank
@@ -69,6 +72,7 @@ class LmServer:
             model, params, slots=slots, mesh=mesh, adapters=adapters,
             constraints=cbank, eos_id=eos_id, logprobs=True,
             draft=draft, spec_k=spec_k, kv_quant=kv_quant,
+            paged_blocks=paged_blocks, page_size=page_size,
         )
         self.tokenizer = tokenizer
         self.started_at = time.time()

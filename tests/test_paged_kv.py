@@ -1,0 +1,205 @@
+"""Paged KV cache: block-table pool parity + allocator behavior.
+
+VERDICT r4 ask #3: the dense slots×max_seq pool reserves the full
+window per slot whether a request uses 40 tokens or 4,000; the paged
+pool (engine._empty_cache_paged + the batcher's block allocator) scales
+a slot's cache bytes with ceil(used/page).  Contract:
+
+1. decode PARITY: paged streams are token-for-token identical to the
+   dense batcher and the one-shot oracle (greedy and sampled);
+2. composes with int8 KV (paged int8 blocks, same parity bar);
+3. the allocator backpressures (defers under block exhaustion, resumes
+   on retirement, frees everything at the end) instead of corrupting.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.serve import ContinuousBatcher
+
+CFG = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_head=16,
+    d_ff=64, max_seq=128, use_flash=False, dtype=jnp.float32,
+)
+MODEL = TransformerLM(CFG)
+PARAMS = MODEL.init(jax.random.PRNGKey(0))
+
+PROMPTS = [
+    [3, 5, 7],                           # short
+    list(range(2, 24)),                  # crosses a 16-token page
+    [11, 13],                            # tiny
+    list(range(40, 75)),                 # multi-page
+]
+
+
+def _run(batcher_kwargs, reqs):
+    b = ContinuousBatcher(MODEL, PARAMS, slots=4, **batcher_kwargs).start()
+    try:
+        handles = [b.submit(ids, **kw) for ids, kw in reqs]
+        return [h.result() for h in handles]
+    finally:
+        b.stop()
+
+
+def test_paged_matches_dense_greedy():
+    reqs = [(p, dict(max_new_tokens=12)) for p in PROMPTS]
+    dense = _run({}, reqs)
+    paged = _run({"paged_blocks": 64, "page_size": 16}, reqs)
+    assert paged == dense
+
+
+def test_paged_matches_dense_sampled():
+    reqs = [
+        (p, dict(max_new_tokens=10, temperature=0.8, seed=41 + i))
+        for i, p in enumerate(PROMPTS)
+    ]
+    dense = _run({}, reqs)
+    paged = _run({"paged_blocks": 64, "page_size": 16}, reqs)
+    assert paged == dense
+
+
+def test_paged_composes_with_int8_kv():
+    reqs = [(p, dict(max_new_tokens=12)) for p in PROMPTS]
+    dense_q = _run({"kv_quant": True}, reqs)
+    paged_q = _run(
+        {"kv_quant": True, "paged_blocks": 64, "page_size": 16}, reqs
+    )
+    assert paged_q == dense_q
+
+
+def test_paged_matches_oracle():
+    from k8s_gpu_tpu.serve.engine import InferenceEngine
+
+    eng = InferenceEngine(MODEL)
+
+    def oracle(ids, n):
+        out = eng.generate(
+            PARAMS, jnp.asarray(ids, jnp.int32)[None], max_new_tokens=n
+        )
+        return [int(t) for t in out.tokens[0][: int(out.lengths[0])]]
+
+    got = _run(
+        {"paged_blocks": 64, "page_size": 16},
+        [(p, dict(max_new_tokens=12)) for p in PROMPTS],
+    )
+    for p, toks in zip(PROMPTS, got):
+        assert toks == oracle(p, 12), p
+
+
+def test_allocator_backpressure_and_reclaim():
+    """More requests than blocks: later admissions defer until earlier
+    retirements free blocks; every stream still completes exactly; all
+    blocks return to the free list."""
+    # 12 blocks of 16 = 192 positions; each request needs
+    # ceil((8 + 24)/16) = 2 blocks, so at most 5 concurrent (plus
+    # trash); submit 8 with 4 slots.
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=4, paged_blocks=12, page_size=16
+    ).start()
+    try:
+        handles = [
+            b.submit([3, 5, 7, 11 + i], max_new_tokens=24)
+            for i in range(8)
+        ]
+        outs = [h.result() for h in handles]
+        assert all(len(o) == 24 for o in outs)
+        # prompts differ only in the last token → streams may differ;
+        # equal prompts must produce equal streams through the paging
+        same = [
+            b.submit([3, 5, 7, 11], max_new_tokens=24) for _ in range(2)
+        ]
+        s0, s1 = same[0].result(), same[1].result()
+        assert s0 == s1 == outs[0]
+    finally:
+        b.stop()
+    assert sorted(b._free_blocks) == list(range(1, 12))
+    assert (b._pages == 0).all()
+
+
+def test_pool_floor_guarantees_progress():
+    """paged_blocks must cover trash + one max-length request — below
+    that, a long request could deadlock the allocator, so the
+    constructor refuses."""
+    with pytest.raises(ValueError, match="trash"):
+        ContinuousBatcher(
+            MODEL, PARAMS, slots=2, paged_blocks=8, page_size=16
+        )
+    # exactly at the floor: a worst-case request still serves
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=2, paged_blocks=9, page_size=16
+    ).start()
+    try:
+        ok = b.submit(list(range(2, 60)), max_new_tokens=56).result()
+        assert len(ok) == 56
+    finally:
+        b.stop()
+
+
+def test_lm_server_paged_passthrough():
+    """The HTTP server serves off a paged pool end-to-end and frees
+    every block at retirement."""
+    import json
+    import urllib.request
+
+    from k8s_gpu_tpu.data.tokenizer import BpeTokenizer
+    from k8s_gpu_tpu.serve import LmServer
+
+    tok = BpeTokenizer.train("tiny corpus for serving " * 40,
+                             vocab_size=120, backend="python")
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, vocab_size=tok.vocab_size)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    srv = LmServer(model, params, tok, port=0, slots=4,
+                   paged_blocks=64, page_size=16).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"prompt": "tiny corpus",
+                             "max_new_tokens": 12}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=90).read())
+        assert out["generated_tokens"] == 12
+    finally:
+        srv.stop()
+    assert sorted(srv.batcher._free_blocks) == list(range(1, 64))
+
+
+def test_inferenceservice_paged_spec_validation():
+    from k8s_gpu_tpu.api.inferenceservice import InferenceService
+    from k8s_gpu_tpu.api.types import ValidationError
+
+    svc = InferenceService()
+    svc.metadata.name = "paged-svc"
+    svc.spec.model.id = "m"
+    svc.spec.paged_blocks = 128
+    svc.validate()  # paged alone is fine
+    svc.spec.draft_mode = "ngram"
+    with pytest.raises(ValidationError, match="pagedBlocks"):
+        svc.validate()
+    svc.spec.draft_mode = ""
+    svc.spec.paged_blocks = -1
+    with pytest.raises(ValidationError, match=">= 0"):
+        svc.validate()
+
+
+def test_paged_rejects_incompatible_modes():
+    with pytest.raises(ValueError, match="speculative"):
+        ContinuousBatcher(
+            MODEL, PARAMS, slots=2, draft="ngram",
+            paged_blocks=32, page_size=16,
+        )
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=2, paged_blocks=32, page_size=16
+    )
+    with pytest.raises(ValueError, match="prefix"):
+        b.precache_prefix([3, 5, 7])
+    with pytest.raises(ValueError, match="max_seq"):
+        ContinuousBatcher(
+            MODEL, PARAMS, slots=2, paged_blocks=32, page_size=48
+        )

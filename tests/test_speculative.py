@@ -1,9 +1,12 @@
-"""Speculative decoding: greedy exactness, acceptance accounting, EOS.
+"""Speculative decoding math core + batcher-surface properties.
 
-The load-bearing property is *bit-exactness*: for any draft model — even
-one with random weights that disagrees with the target almost always —
-the emitted stream must equal ``InferenceEngine.generate`` on the target
-alone.  Speculation may only change latency, never output.
+The one spec code path is the continuous batcher's shared rounds
+(tests/test_batcher_spec.py holds its exactness/interleaving suite);
+this file pins the MATH those rounds ride on — Leviathan rejection
+sampling exactness for any draft — and the distribution-level
+properties that used to be asserted through the (retired) one-shot
+SpeculativeDecoder: self-draft full acceptance under sampling and under
+the shared top-p warp.
 """
 
 import jax
@@ -11,109 +14,18 @@ import jax.numpy as jnp
 import pytest
 
 from k8s_gpu_tpu.models.transformer import TransformerConfig, TransformerLM
-from k8s_gpu_tpu.serve.engine import InferenceEngine, SamplingConfig
-from k8s_gpu_tpu.serve.speculative import SpeculativeDecoder
-
-
-def _make(vocab=64, d_model=32, n_layers=2, n_heads=2, seed=0, max_seq=96):
-    cfg = TransformerConfig(
-        vocab_size=vocab, d_model=d_model, n_layers=n_layers,
-        n_heads=n_heads, d_head=d_model // n_heads, d_ff=64,
-        max_seq=max_seq, dtype=jnp.float32, use_flash=False, remat=False,
-    )
-    model = TransformerLM(cfg)
-    params = model.init(jax.random.PRNGKey(seed))
-    return model, params
-
-
-@pytest.fixture(scope="module")
-def target():
-    return _make(n_layers=3, seed=0)
-
-
-@pytest.fixture(scope="module")
-def draft():
-    return _make(n_layers=1, seed=7)
-
-
-def _engines(target, draft, k):
-    tm, tp = target
-    dm, dp = draft
-    te = InferenceEngine(tm)
-    de = InferenceEngine(dm)
-    return SpeculativeDecoder(te, de, k=k), te, tp, dp
-
-
-@pytest.mark.parametrize("k", [1, 3, 5])
-def test_greedy_exactness_random_draft(target, draft, k):
-    """A disagreeing draft must still yield the target's exact stream."""
-    spec, te, tp, dp = _engines(target, draft, k)
-    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 7), 1, 60)
-    ref = te.generate(tp, prompt, max_new_tokens=24)
-    out = spec.generate(tp, dp, prompt, max_new_tokens=24)
-    assert jnp.array_equal(out.tokens, ref.tokens), (
-        out.tokens, ref.tokens)
-    assert jnp.array_equal(out.lengths, ref.lengths)
-
-
-def test_self_draft_accepts_everything(target):
-    """Draft == target → every round accepts all k drafts, so the round
-    count collapses to ceil(max_new / (k+1))."""
-    tm, tp = target
-    te = InferenceEngine(tm)
-    spec = SpeculativeDecoder(te, InferenceEngine(tm), k=4)
-    prompt = jax.random.randint(jax.random.PRNGKey(5), (1, 6), 1, 60)
-    out = spec.generate(tp, tp, prompt, max_new_tokens=25)
-    ref = te.generate(tp, prompt, max_new_tokens=25)
-    assert jnp.array_equal(out.tokens, ref.tokens)
-    # first token comes from prefill; remaining 24 arrive 5 per round
-    assert out.rounds == 5
-    assert spec.stats.acceptance_rate == 1.0
-
-
-def test_eos_parity(target, draft):
-    """Pick the EOS id from the reference stream's interior so the spec
-    path must cut emission at the same position."""
-    spec, te, tp, dp = _engines(target, draft, 3)
-    prompt = jax.random.randint(jax.random.PRNGKey(11), (2, 5), 1, 60)
-    base = te.generate(tp, prompt, max_new_tokens=20)
-    eos = int(base.tokens[0, 8])  # a token the greedy stream really emits
-    samp = SamplingConfig(eos_id=eos)
-    ref = te.generate(tp, prompt, max_new_tokens=20, sampling=samp)
-    out = spec.generate(tp, dp, prompt, max_new_tokens=20, sampling=samp)
-    assert jnp.array_equal(out.tokens, ref.tokens)
-    assert jnp.array_equal(out.lengths, ref.lengths)
-
-
-def test_pad_left_bucketed_prompts(target, draft):
-    """Left-padded (bucketed) prompts decode identically to unpadded."""
-    spec, te, tp, dp = _engines(target, draft, 3)
-    prompt = jax.random.randint(jax.random.PRNGKey(13), (2, 6), 1, 60)
-    padded = jnp.concatenate(
-        [jnp.zeros((2, 4), prompt.dtype), prompt], axis=1
-    )
-    ref = te.generate(tp, prompt, max_new_tokens=16)
-    out = spec.generate(tp, dp, padded, max_new_tokens=16, pad_left=4)
-    assert jnp.array_equal(out.tokens, ref.tokens)
-
-
-def test_budget_never_overshoots(target, draft):
-    """Emission stops exactly at max_new even when a round could emit
-    past it (k+1 > remaining budget)."""
-    spec, te, tp, dp = _engines(target, draft, 5)
-    prompt = jax.random.randint(jax.random.PRNGKey(17), (1, 4), 1, 60)
-    ref = te.generate(tp, prompt, max_new_tokens=7)
-    out = spec.generate(tp, dp, prompt, max_new_tokens=7)
-    assert out.tokens.shape == (1, 7)
-    assert jnp.array_equal(out.tokens, ref.tokens)
+from k8s_gpu_tpu.serve import ContinuousBatcher
+from k8s_gpu_tpu.serve.speculative import (
+    reject_row,
+    rejection_sample,
+    warped_probs,
+)
 
 
 def test_rejection_sample_distribution_exact():
     """The math core: for fixed p/q, the first emitted token's empirical
     distribution must equal p (Leviathan Thm 1), for a draft that
     disagrees with the target badly."""
-    from k8s_gpu_tpu.serve.speculative import rejection_sample
-
     V, K, N = 4, 2, 40000
     p1 = jnp.array([0.5, 0.25, 0.15, 0.10])
     q1 = jnp.array([0.05, 0.05, 0.45, 0.45])  # adversarial draft
@@ -132,77 +44,124 @@ def test_rejection_sample_distribution_exact():
     assert float(jnp.abs(emp - p1).max()) < 0.015, emp
 
 
-def test_sampled_self_draft_accepts_everything(target):
-    """p == q → accept ratio 1 → every draft accepted."""
-    tm, tp = target
-    te = InferenceEngine(tm)
-    spec = SpeculativeDecoder(te, InferenceEngine(tm), k=4)
-    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 1, 60)
-    out = spec.generate(
-        tp, tp, prompt, max_new_tokens=20,
-        sampling=SamplingConfig(temperature=0.8, top_k=8),
-        key=jax.random.PRNGKey(7),
-    )
-    assert spec.stats.acceptance_rate >= 0.99, spec.stats.acceptance_rate
-    assert bool((out.lengths == 20).all())
+def test_reject_row_identical_pq_accepts_all():
+    """p == q → the accept ratio is 1 everywhere: every draft accepted."""
+    V, K = 8, 4
+    key = jax.random.PRNGKey(3)
+    probs = jax.nn.softmax(jax.random.normal(key, (K + 1, V)))
+    g = jnp.arange(K, dtype=jnp.int32) % V
+    a, _ = reject_row(jax.random.PRNGKey(1), probs, probs[:K], g)
+    assert int(a) == K
 
 
-def test_sampled_stream_plausible(target, draft):
-    """Sampled speculation with a disagreeing draft: correct shapes,
-    in-vocab tokens, budget respected, and different keys → different
-    streams (it really samples)."""
-    spec, te, tp, dp = _engines(target, draft, 3)
-    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 7), 1, 60)
-    samp = SamplingConfig(temperature=1.0, top_k=0)
-    o1 = spec.generate(tp, dp, prompt, max_new_tokens=16, sampling=samp,
-                       key=jax.random.PRNGKey(1))
-    o2 = spec.generate(tp, dp, prompt, max_new_tokens=16, sampling=samp,
-                       key=jax.random.PRNGKey(2))
-    assert o1.tokens.shape == (2, 16)
-    assert int(o1.tokens.max()) < 64 and int(o1.tokens.min()) >= 0
-    assert bool((o1.lengths == 16).all())
-    assert not jnp.array_equal(o1.tokens, o2.tokens)
+def test_reject_row_disjoint_support_rejects_first():
+    """q puts all mass where p has none → ratio 0 → reject at 0 and the
+    correction comes from p's support."""
+    V, K = 4, 3
+    p = jnp.tile(jnp.array([[0.5, 0.5, 0.0, 0.0]]), (K + 1, 1))
+    q = jnp.tile(jnp.array([[0.0, 0.0, 0.5, 0.5]]), (K, 1))
+    g = jnp.full((K,), 2, jnp.int32)  # drafts from q's support
+    a, x = reject_row(jax.random.PRNGKey(5), p, q, g)
+    assert int(a) == 0 and int(x) in (0, 1)
 
 
-def test_max_seq_guard(target, draft):
-    spec, te, tp, dp = _engines(target, draft, 4)
-    prompt = jnp.ones((1, 90), jnp.int32)
-    with pytest.raises(ValueError):
-        spec.generate(tp, dp, prompt, max_new_tokens=8)
-
-
-def test_moe_target_exactness():
-    """MoE targets: the W-wide verify must route experts with full
-    capacity (like the width-1 decode it stands in for) — a capped
-    dispatch would drop tokens and break exactness (code-review r3)."""
+def _tiny():
     cfg = TransformerConfig(
         vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_head=16,
         d_ff=64, max_seq=96, dtype=jnp.float32, use_flash=False,
-        remat=False, num_experts=4,
+        remat=False,
     )
     model = TransformerLM(cfg)
-    params = model.init(jax.random.PRNGKey(1))
-    te = InferenceEngine(model)
-    spec = SpeculativeDecoder(te, InferenceEngine(model), k=4)  # self-draft
-    prompt = jax.random.randint(jax.random.PRNGKey(21), (2, 6), 1, 60)
-    ref = te.generate(params, prompt, max_new_tokens=20)
-    out = spec.generate(params, params, prompt, max_new_tokens=20)
-    assert jnp.array_equal(out.tokens, ref.tokens)
-    # Not 1.0: the Switch gate's argmax routing amplifies shape-dependent
-    # GEMM rounding (the draft's width-1 steps vs the width-(k+1) verify),
-    # so a ~1e-7 gate-logit difference occasionally flips an expert and
-    # rejects a draft.  The correction token keeps the OUTPUT exact (the
-    # assert above); near-1 acceptance is the MoE self-draft contract.
-    assert spec.stats.acceptance_rate >= 0.9, spec.stats.acceptance_rate
+    return model, model.init(jax.random.PRNGKey(0))
 
 
-def test_short_draft_max_seq_rejected(target):
-    """A draft whose cache can't hold the stream must error loudly, not
-    silently reject every proposal (code-review r3)."""
-    tm, tp = target
-    short, _ = _make(n_layers=1, seed=7, max_seq=32)
-    spec = SpeculativeDecoder(InferenceEngine(tm), InferenceEngine(short),
-                              k=4)
-    prompt = jnp.ones((1, 20), jnp.int32)
-    with pytest.raises(ValueError, match="draft 32"):
-        spec.generate(tp, tp, prompt, max_new_tokens=20)
+def _acceptance(b, reqs):
+    for h in reqs:
+        h.result()
+    return b.spec_stats["acceptance"]
+
+
+def test_batcher_sampled_self_draft_accepts_everything():
+    """p == q per position (draft IS the target) → rejection sampling
+    accepts ~every proposal even at temperature > 0."""
+    model, params = _tiny()
+    b = ContinuousBatcher(
+        model, params, slots=2, draft=(model, params), spec_k=4
+    ).start()
+    try:
+        hs = [
+            b.submit([3, 5, 7], max_new_tokens=20, temperature=0.8,
+                     seed=i)
+            for i in range(2)
+        ]
+        acc = _acceptance(b, hs)
+    finally:
+        b.stop()
+    assert acc >= 0.99, acc
+
+
+def test_batcher_top_p_spec_self_draft():
+    """warped_probs shares warp_logits, so the spec accept math sees the
+    SAME nucleus the plain sampler draws from — self-draft still
+    accepts everything under top-p."""
+    model, params = _tiny()
+    b = ContinuousBatcher(
+        model, params, slots=2, draft=(model, params), spec_k=4
+    ).start()
+    try:
+        hs = [
+            b.submit([3, 5, 7], max_new_tokens=16, temperature=0.9,
+                     top_p=0.8, seed=i)
+            for i in range(2)
+        ]
+        acc = _acceptance(b, hs)
+    finally:
+        b.stop()
+    assert acc >= 0.99, acc
+
+
+def test_warped_probs_matches_sample_distribution():
+    """warped_probs must be the softmax of exactly the logits transform
+    _sample draws from (temperature + top_k)."""
+    from k8s_gpu_tpu.serve.engine import InferenceEngine, SamplingConfig
+
+    logits = jax.random.normal(jax.random.PRNGKey(2), (3, 16))
+    s = SamplingConfig(temperature=0.7, top_k=5)
+    p = warped_probs(logits, s)
+    w = jax.nn.softmax(InferenceEngine.warp_logits(logits, s), axis=-1)
+    assert jnp.allclose(p, w)
+    # top_k really zeroes the tail
+    assert int((p > 0).sum(axis=-1).max()) <= 5
+
+
+def test_adaptive_k_moves_with_acceptance():
+    """The adaptive-K policy: high measured acceptance earns a deeper
+    draft window for a CHEAP draft, low acceptance shrinks it, and an
+    expensive draft caps the depth even at high acceptance (pure host
+    logic — drive the rolling window directly)."""
+    model, params = _tiny()
+
+    def batcher(ratio):
+        b = ContinuousBatcher(
+            model, params, slots=2, draft=(model, params), spec_k=4
+        )
+        b._draft_ratio = ratio  # model a draft of this relative cost
+        return b
+
+    # cheap draft (5% of target) + high acceptance → deeper window pays
+    b = batcher(0.05)
+    b._spec_recent.extend([(64, 60)] * 8)
+    assert b._adaptive_k() == 8
+    # cheap draft + low acceptance → shallow window
+    b = batcher(0.05)
+    b._spec_recent.extend([(64, 2)] * 8)
+    assert b._adaptive_k() == 2
+    # SELF-draft (ratio 1.0): every draft step costs a full target step,
+    # so even near-perfect acceptance caps the window shallow
+    b = batcher(1.0)
+    b._spec_recent.extend([(64, 60)] * 8)
+    assert b._adaptive_k() == 2
+    # too little evidence → keep the configured K
+    b = batcher(0.05)
+    b._spec_recent.append((32, 30))
+    assert b._adaptive_k() == 4
