@@ -55,6 +55,37 @@ def _train(tc, steps=3, mesh_cfg=None):
     return tr, losses
 
 
+def test_step_many_matches_step_loop():
+    """N steps fused into one lax.scan program (step_many — the zero-
+    dispatch-overhead window bench.py measures) must walk params through
+    the SAME trajectory as N step() calls, and sync=False steps must
+    chain identically to synced ones."""
+    tc = TrainConfig(warmup_steps=1)
+    batches = [_batch(jax.random.PRNGKey(10 + i)) for i in range(3)]
+
+    def fresh():
+        model = TransformerLM(_cfg())
+        tr = Trainer(model, mesh=_mesh(MeshConfig(dp=1)), train_config=tc)
+        tr.init(jax.random.PRNGKey(0))
+        return tr
+
+    tr_loop = fresh()
+    for x, y in batches[:-1]:
+        tr_loop.step(x, y, sync=False)  # pipelined regime
+    last_loop = tr_loop.step(*batches[-1])
+
+    tr_many = fresh()
+    xs = jnp.stack([x for x, _ in batches])
+    ys = jnp.stack([y for _, y in batches])
+    last_many = tr_many.step_many(xs, ys)
+
+    assert last_many == pytest.approx(last_loop, rel=1e-5)
+    for a, b in zip(jax.tree.leaves(tr_loop.params),
+                    jax.tree.leaves(tr_many.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
 def test_grad_accum_parity():
     tr1, l1 = _train(TrainConfig(warmup_steps=1))
     tr4, l4 = _train(TrainConfig(warmup_steps=1, grad_accum_steps=4))

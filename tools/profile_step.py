@@ -1,0 +1,216 @@
+"""Per-phase profile of the flagship 302M train step (VERDICT r4 ask #4).
+
+MFU plateaued at 0.469-0.473 through round 4 with no attribution of the
+other ~53%; this script decomposes the step ON THE CHIP into
+
+    forward-loss | backward (incl. remat recompute) | optimizer apply
+
+by timing nested jitted programs (each window ends in a device->host
+fetch — the tunnel discipline), plus XLA's own cost analysis
+(flops / bytes accessed) for the full step, and the flash-attention
+kernel at the exact train shape.  Output: one JSON blob on stdout,
+copied into docs/perf/mfu_breakdown.md with the conclusions.
+
+Run (bench host):  PYTHONPATH=/root/repo:/root/.axon_site python tools/profile_step.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from bench import (
+        PEAK_BF16_FLOPS, _flagship_config, model_flops_per_step,
+    )
+    from k8s_gpu_tpu.models import TransformerLM
+    from k8s_gpu_tpu.parallel.mesh import MeshConfig, mesh_from_devices
+    from k8s_gpu_tpu.train import TrainConfig, Trainer
+
+    devs = jax.devices()
+    on_tpu = devs[0].platform == "tpu"
+    cfg, batch = _flagship_config(on_tpu)
+    import dataclasses
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--no-remat":
+        cfg = dataclasses.replace(cfg, remat=False)
+    if len(sys.argv) > 1 and sys.argv[1].startswith("--remat-policy="):
+        cfg = dataclasses.replace(
+            cfg, remat_policy=sys.argv[1].split("=", 1)[1]
+        )
+    model = TransformerLM(cfg)
+    mesh = mesh_from_devices(devs[:1], MeshConfig(dp=1))
+    trainer = Trainer(model, mesh=mesh,
+                      train_config=TrainConfig(warmup_steps=1))
+    trainer.init(jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(trainer.params))
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, cfg.max_seq + 1), 0, cfg.vocab_size
+    )
+    x, y = toks[:, :-1], toks[:, 1:]
+
+    out: dict = {
+        "device": devs[0].device_kind,
+        "params_m": round(n_params / 1e6, 1),
+        "batch": batch,
+        "seq": cfg.max_seq,
+        "remat": cfg.remat,
+        "remat_policy": getattr(cfg, "remat_policy", "full"),
+    }
+
+    R = 6  # inner repetitions per dispatch
+
+    def timed(label, fn, *args, n=R):
+        """Time ``fn`` amortized over ``n`` calls dispatched back-to-back,
+        ending in a scalar fetch (the tunnel discipline).  Each dispatch
+        through the tunnel costs ~60-100 ms, so single-call timings of
+        sub-200ms phases measure the tunnel, not the chip — the caller
+        should pass a LOOPED program (see ``looped``) for small phases."""
+        fn(*args)  # compile + warm
+        jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = fn(*args)
+        jnp.asarray(jax.tree.leaves(r)[0]).reshape(-1)[0].item()
+        dt = (time.perf_counter() - t0) / n
+        out[label + "_s"] = dt
+        return dt
+
+    def looped(phase_fn, feed):
+        """R iterations of ``phase_fn`` inside ONE jitted program — the
+        only dispatch-noise-proof way to time a phase through the
+        tunnel.  ``feed(args, acc)`` must thread the carried scalar into
+        the next iteration's inputs so XLA cannot hoist the loop body
+        (identical pure iterations would be CSE'd to one)."""
+
+        def run(*args):
+            def body(i, acc):
+                return acc + phase_fn(*feed(args, acc))
+
+            return jax.lax.fori_loop(0, R, body, jnp.float32(0.0))
+
+        return jax.jit(run)
+
+    # Phase programs — THE Trainer's own loss and optimizer, so the
+    # decomposition sums to the step it explains.
+    loss_fn = trainer._loss
+    opt = trainer.optimizer
+    opt_state = jax.jit(opt.init)(trainer.params)
+
+    import optax
+
+    # Thread the carried scalar into the TOKENS so iterations cannot be
+    # CSE'd/hoisted (adding 0·acc to int tokens keeps values identical).
+    def feed_tok(args, acc):
+        params, xx, yy = args
+        bump = (acc * 0.0).astype(jnp.int32)
+        return params, xx + bump, yy
+
+    fwd_loop = looped(lambda p, xx, yy: loss_fn(p, xx, yy), feed_tok)
+    grad_loop = looped(
+        lambda p, xx, yy: (
+            lambda lv, gv: lv + jax.tree.leaves(gv)[0].reshape(-1)[0] * 0.0
+        )(*jax.value_and_grad(loss_fn)(p, xx, yy)),
+        feed_tok,
+    )
+
+    def opt_phase(params, opt_state, grads, bump):
+        gb = jax.tree.map(lambda g: g + bump, grads)
+        updates, _ = opt.update(gb, opt_state, params)
+        new = optax.apply_updates(params, updates)
+        return jax.tree.leaves(new)[0].reshape(-1)[0].astype(jnp.float32)
+
+    opt_loop = looped(
+        lambda p, o, g, b: opt_phase(p, o, g, b),
+        lambda args, acc: (args[0], args[1], args[2], acc * 0.0),
+    )
+
+    full = timed("full_step", lambda: trainer.step(x, y), n=R)
+    _, grads = jax.jit(jax.value_and_grad(loss_fn))(trainer.params, x, y)
+    t_fwd = timed("forward_loss", fwd_loop, trainer.params, x, y, n=1) / R
+    out["forward_loss_s"] = t_fwd
+    t_grad = timed("value_and_grad", grad_loop, trainer.params, x, y,
+                   n=1) / R
+    out["value_and_grad_s"] = t_grad
+    t_opt = timed("optimizer_apply", opt_loop, trainer.params, opt_state,
+                  grads, jnp.float32(0.0), n=1) / R
+    out["optimizer_apply_s"] = t_opt
+    out["backward_incl_remat_s"] = t_grad - t_fwd
+    out["step_minus_parts_s"] = full - (t_grad + t_opt)
+
+    # Flash attention at the exact train shape AND the train cfg's block
+    # sizes, summed over layers — looped in one dispatch like the rest.
+    try:
+        from k8s_gpu_tpu.ops.attention import flash_attention
+
+        q = jax.random.normal(
+            jax.random.PRNGKey(2),
+            (batch, cfg.n_heads, cfg.max_seq, cfg.d_head), jnp.bfloat16,
+        )
+        bq, bk = cfg.flash_block_q or None, cfg.flash_block_k or None
+
+        def fa_one(qq):
+            return flash_attention(
+                qq, qq, qq, causal=True, block_q=bq, block_k=bk
+            ).reshape(-1)[0].astype(jnp.float32)
+
+        fa_loop = looped(
+            fa_one, lambda args, acc: (args[0] + acc.astype(q.dtype) * 0,)
+        )
+        t_fa = timed("flash_fwd_one_layer", fa_loop, q, n=1) / R
+        out["flash_fwd_one_layer_s"] = t_fa
+        out["flash_fwd_all_layers_s"] = t_fa * cfg.n_layers
+
+        def fab_one(qq):
+            g = jax.grad(
+                lambda z: flash_attention(
+                    z, z, z, causal=True, block_q=bq, block_k=bk
+                ).astype(jnp.float32).sum()
+            )(qq)
+            return g.reshape(-1)[0].astype(jnp.float32)
+
+        fab_loop = looped(
+            fab_one, lambda args, acc: (args[0] + acc.astype(q.dtype) * 0,)
+        )
+        t_fab = timed("flash_fwdbwd_one_layer", fab_loop, q, n=1) / R
+        out["flash_fwdbwd_one_layer_s"] = t_fab
+        out["flash_fwdbwd_all_layers_s"] = t_fab * cfg.n_layers
+    except Exception as e:  # CPU / kernel unavailable
+        out["flash_error"] = str(e)[:200]
+
+    # XLA's own view of the full step (hardware flops INCLUDING remat
+    # recompute, and total HBM bytes touched).
+    try:
+        ca = trainer._step.lower(
+            trainer.params, trainer.opt_state, x, y
+        ).compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        out["xla_flops"] = float(ca.get("flops", 0.0))
+        out["xla_bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+        if peak := PEAK_BF16_FLOPS.get(devs[0].device_kind, 0.0):
+            out["xla_hw_util_full_step"] = out["xla_flops"] / full / peak
+    except Exception as e:
+        out["cost_analysis_error"] = str(e)[:200]
+
+    flops = model_flops_per_step(cfg, n_params, batch)
+    peak = PEAK_BF16_FLOPS.get(devs[0].device_kind, 0.0)
+    out["model_flops_per_step"] = flops
+    out["mfu"] = (flops / full / peak) if peak else 0.0
+    if peak:
+        out["fwd_hw_util"] = (flops / 3.0) / t_fwd / peak
+        out["bwd_hw_util_counting_remat"] = (
+            (flops * (2.0 / 3.0) + (flops / 3.0 if cfg.remat else 0.0))
+            / max(1e-9, t_grad - t_fwd) / peak
+        )
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
