@@ -269,6 +269,23 @@ def train_bench() -> dict:
     fused_step_s = fused_window_s / n_steps
 
     step_s = steady_s / n_steps
+
+    # Converge the flagship before the serving probes (fused windows —
+    # ~5 min on-chip).  Rounds 1-4 served a 6-step-trained model whose
+    # argmax margins sat inside bf16 rounding noise: the greedy
+    # trajectory then DIVERGES between program shapes (width-1 decode
+    # vs W-wide verify), which made speculative acceptance a lottery
+    # (r4: 0.34, r5 first capture: 0.10 — with IDENTICAL machinery;
+    # three different distill recipes all measured 0.1019 because the
+    # number was trajectory luck, not draft quality).  A converged
+    # target has decisive margins, like any real served model.
+    serve_loss = loss
+    if on_tpu:
+        # Reuse the already-compiled [n_steps, ...] fused window — a new
+        # window width would recompile the whole train scan.
+        for _ in range(50):
+            serve_loss = trainer.step_many(xs_many, ys_many)
+
     flops = model_flops_per_step(cfg, n_params, batch)
     flops_per_s = flops / step_s
     peak = PEAK_BF16_FLOPS.get(devs[0].device_kind, 0.0)
@@ -295,6 +312,9 @@ def train_bench() -> dict:
             "train_steady_window_s": steady_s,
             "first_loss": float(first_loss),
             "last_loss": float(loss),
+            # Loss after the post-window convergence phase — the model
+            # the serving probes actually serve.
+            "serve_target_loss": float(serve_loss),
         },
     }
 
@@ -630,12 +650,17 @@ def spec_batcher_probe(model, params) -> dict:
     # schedule and an agreement-based early stop (steps is a budget).
     dm, dp, distill_loss = distill_draft(
         model, params, steps=1500,
-        seq_len=min(128, model.cfg.max_seq - 8),
+        seq_len=min(256, model.cfg.max_seq - 8),
         key=jax.random.PRNGKey(7),
         data_temperature=0.0, hard_labels=True, prompts=prompts,
         train_dtype=jnp.float32, target_agreement=0.99,
     )
-    n_new = 48
+    # 160-token generations: short 48-token requests complete in ~2
+    # dispatches either way, so dispatch overhead masks the compute
+    # asymmetry the spec path exists for (a verify round costs
+    # ~1 + K·r target-steps for K+1 tokens vs K+1 plain steps); a
+    # serving-realistic budget lets the compute term dominate.
+    n_new = min(160, model.cfg.max_seq // 2)
 
     def run(b, n_requests):
         handles = [
@@ -656,10 +681,15 @@ def spec_batcher_probe(model, params) -> dict:
     ).start()
     try:
         run(spec, 1)  # warm solo variant
-        run(spec, 4)  # warm shared-round variant
+        # Warm until adaptive K settles (acceptance evidence accrues
+        # over ~256 proposals + a 512-proposal freeze), so the timed
+        # window measures the steady-state K, not a mid-switch compile.
+        for _ in range(3):
+            run(spec, 4)
         out["cb_spec_tokens_per_s_4req"] = _best_rate(lambda: run(spec, 4))
         st = spec.spec_stats
         out["cb_spec_measured_acceptance"] = st["acceptance"]
+        out["cb_spec_adapted_k"] = spec._spec_k_active
         out["cb_spec_vs_plain_x"] = (
             out["cb_spec_tokens_per_s_4req"]
             / out["cb_plain_tokens_per_s_4req"]
