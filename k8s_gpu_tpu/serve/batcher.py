@@ -1639,11 +1639,26 @@ class ContinuousBatcher:
                     self.params, self.draft_params, self._dev,
                     self.bank.banked, use_top_p, n_rounds, t_hi, K,
                 )
+            # Budget-gate charge: EXPECTED tokens from rolling acceptance,
+            # not the all-accepted worst case — a worst-case charge at
+            # acceptance a<1 makes the gate think the budget is covered
+            # and stall the device between dispatches (measured: spec at
+            # acceptance 0.77 barely beat plain purely on this stall).
+            # pos_hint stays worst-case: it sizes the t_hi attention-read
+            # bound, where an underestimate would truncate reads.
+            drafted = sum(d for d, _ in self._spec_recent)
+            a_hat = (
+                sum(a for _, a in self._spec_recent) / drafted
+                if drafted >= 64 else 0.5
+            )
+            expected = max(n_rounds, int(n_rounds * (1.0 + a_hat * K)))
             for _, r in live:
-                r.inflight_steps += advance
+                r.inflight_steps += expected
                 r.pos_hint += advance
             self._round_count += 1
-            return ("spec", self._round_count, live, toks, ns, lps)
+            return (
+                "spec", self._round_count, live, toks, ns, lps, expected,
+            )
         n_steps = self.steps_per_round
         if solo:
             # Smallest solo bucket covering the remaining budget — the
@@ -1798,7 +1813,7 @@ class ContinuousBatcher:
                 self._retire(req.slot)
             return
         if item[0] == "spec":
-            _, round_id, live, toks_dev, ns_dev, lps_dev = item
+            _, round_id, live, toks_dev, ns_dev, lps_dev, charged = item
             # [R, B, K+1] / [R, B] — ONE blocking fetch for the batch.
             if self.collect_logprobs:
                 toks, ns, lps = jax.device_get((toks_dev, ns_dev, lps_dev))
@@ -1812,10 +1827,13 @@ class ContinuousBatcher:
             k_used = toks.shape[2] - 1  # the dispatch's (possibly
             # adapted) K — derive from the fetched shape, never from
             # self.spec_k, which may have changed since dispatch.
-            assumed = toks.shape[0] * (k_used + 1)
+            worst = toks.shape[0] * (k_used + 1)
             for i, req in live:
-                req.inflight_steps = max(0, req.inflight_steps - assumed)
-                req.pos_hint -= assumed - int(ns[:, i].sum())
+                # Release exactly what dispatch charged (the expected-
+                # value budget charge); pos_hint walks back from its
+                # worst-case advance to the device's real position.
+                req.inflight_steps = max(0, req.inflight_steps - charged)
+                req.pos_hint -= worst - int(ns[:, i].sum())
             # The rolling window for _adaptive_k accumulates below, in
             # the SAME guarded per-row loop as the telemetry counters —
             # garbage sub-rounds of retired/EOS'd rows must not count
