@@ -27,6 +27,27 @@ def _get(port, path):
 
 # -- metrics endpoint -------------------------------------------------------
 
+def test_histogram_exact_percentiles():
+    """percentile() is exact over the raw reservoir (latency evidence
+    must not read as bucket upper bounds), and the reservoir rolls over
+    instead of growing unbounded."""
+    from k8s_gpu_tpu.utils.metrics import Histogram
+
+    h = Histogram()
+    for i in range(100):
+        h.observe(i / 100.0)
+    assert h.percentile(0.5) == pytest.approx(0.50)
+    assert h.percentile(0.95) == pytest.approx(0.95)
+    # rollover keeps the most recent window, bounded
+    from collections import deque
+
+    h2 = Histogram(raw=deque(maxlen=8))
+    for v in range(100):
+        h2.observe(float(v))
+    assert len(h2.raw) == 8
+    assert h2.percentile(0.0) >= 92.0  # only recent samples remain
+
+
 def test_metrics_server_endpoints():
     reg = MetricsRegistry()
     reg.inc("reconcile_total", kind="TpuPodSlice", result="ok")
