@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: docker-build docker-push deploy undeploy test
+.PHONY: docker-build docker-push deploy undeploy test trace-demo
 
 docker-build:
 	@for img in $(IMAGES); do \
@@ -49,3 +49,9 @@ test:
 # accumulation threshold, but `make test` is the canonical full run.
 test-single:
 	python -m pytest tests/ -x -q
+
+# End-to-end tracing smoke: apiserver create (traceparent in) → workqueue
+# → reconcile → fake cloud call → /debug/traces shows one linked trace.
+# Prints the rendered flame tree; non-zero exit if any link is missing.
+trace-demo:
+	python tools/trace_demo.py

@@ -754,7 +754,8 @@ def cmd_ci(args) -> int:
 
 def cmd_obs(args) -> int:
     """Observability surface (C32): query persisted platform logs (the
-    Loki role), dump the last metrics exposition, or serve /metrics."""
+    Loki role), dump the last metrics exposition, render span traces, or
+    serve /metrics."""
     import json
 
     from .platform_local import state_dir
@@ -789,6 +790,51 @@ def cmd_obs(args) -> int:
             print("no metrics snapshot yet", file=sys.stderr)
             return 1
         print(prom.read_text(), end="")
+        return 0
+    if args.obs_cmd == "traces":
+        from ..utils.tracing import global_tracer, render_trace
+
+        if args.url:
+            # A running MetricsServer's /debug/traces — same assembled
+            # JSON shape the in-process tracer produces.
+            import urllib.parse
+            import urllib.request
+
+            params = {
+                k: v for k, v in (
+                    ("trace_id", args.trace), ("name", args.name),
+                    ("min_ms", args.min_ms or ""),
+                    ("limit", args.limit),
+                ) if v
+            }
+            url = (f"{args.url.rstrip('/')}/debug/traces?"
+                   + urllib.parse.urlencode(params))
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    traces = json.loads(r.read())["traces"]
+                if not isinstance(traces, list):
+                    raise ValueError("'traces' is not a list")
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                # Covers unreachable hosts AND a 200 that isn't the
+                # /debug/traces JSON shape (wrong --url, proxy page).
+                print(f"fetch failed: {e}", file=sys.stderr)
+                return 1
+        else:
+            # Boot the platform so its reconcile passes run (and trace)
+            # in THIS process, then read the in-process tracer.
+            p = LocalPlatform()
+            p.settle()
+            p.close()
+            traces = global_tracer.traces(
+                trace_id=args.trace or None, min_ms=args.min_ms,
+                name=args.name, limit=args.limit,
+            )
+        if not traces:
+            print("no traces recorded", file=sys.stderr)
+            return 1
+        for t in traces:
+            print(render_trace(t))
+            print()
         return 0
     if args.obs_cmd == "serve":
         from ..utils.obs import MetricsServer
@@ -1102,6 +1148,19 @@ def build_parser() -> argparse.ArgumentParser:
     p_ol.add_argument("-l", "--selector", action="append",
                       help="label filter key=value (repeatable)")
     obs_sub.add_parser("metrics")
+    p_ot = obs_sub.add_parser(
+        "traces", help="render recorded spans as flame-style trees"
+    )
+    p_ot.add_argument("--url", default="",
+                      help="base URL of a running metrics server "
+                           "(/debug/traces); default: boot the local "
+                           "platform and read its in-process tracer")
+    p_ot.add_argument("--trace", default="", help="exact trace id filter")
+    p_ot.add_argument("--name", default="",
+                      help="substring filter on any span name")
+    p_ot.add_argument("--min-ms", type=float, default=0.0,
+                      help="only traces at least this long end-to-end")
+    p_ot.add_argument("--limit", type=int, default=20)
     p_os = obs_sub.add_parser("serve")
     p_os.add_argument("--port", type=int, default=0)
     p_os.add_argument("--for-seconds", type=float, default=0.0,

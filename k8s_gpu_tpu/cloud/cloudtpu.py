@@ -36,6 +36,7 @@ import urllib.request
 from typing import Callable
 
 from . import wire
+from ..utils.tracing import global_tracer
 from .base import AuthError, CloudError
 from .types import QueuedResource
 
@@ -124,12 +125,19 @@ class CloudTpuClient:
         url = f"{self._endpoint}/{path}"
         if params:
             url += "?" + urllib.parse.urlencode(params)
-        headers = {
+        # traceparent rides every wire call (wire.trace_headers), and the
+        # call itself is a child span — per-REST-call attribution under
+        # the operator's coarser cloud.* spans.
+        headers = wire.trace_headers({
             "Authorization": f"Bearer {self.identity.token()}",
             "Content-Type": "application/json",
-        }
+        })
         body = json.dumps(payload).encode() if payload is not None else None
-        status, raw = self._transport(method, url, headers, body)
+        with global_tracer.span(
+            "tpu.rest", method=method, path=path,
+        ) as sp:
+            status, raw = self._transport(method, url, headers, body)
+            sp.attributes["status"] = status
         try:
             obj = json.loads(raw) if raw else {}
         except ValueError:

@@ -27,6 +27,20 @@ QR_STATES = {
 }
 
 
+def trace_headers(headers: dict | None = None) -> dict:
+    """Merge the active tracing context into outbound HTTP headers as a
+    W3C ``traceparent`` — the propagation half of utils/tracing.py's
+    inbound parse.  Returns a new dict; no header is added when no trace
+    is active, so untraced clients send byte-identical requests."""
+    from ..utils.tracing import format_traceparent, global_tracer
+
+    out = dict(headers or {})
+    ctx = global_tracer.current()
+    if ctx is not None:
+        out["traceparent"] = format_traceparent(ctx)
+    return out
+
+
 def parent_path(project: str, zone: str) -> str:
     return f"projects/{project}/locations/{zone}"
 

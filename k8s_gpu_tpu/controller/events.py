@@ -11,6 +11,7 @@ import uuid
 
 from ..api.core import Event
 from ..api.types import CustomResource
+from ..utils.tracing import global_tracer
 from .kubefake import FakeKube
 
 log = logging.getLogger("k8s_gpu_tpu.controller.events")
@@ -39,6 +40,11 @@ class EventRecorder:
         ev.metadata.name = f"{obj.metadata.name}.{uuid.uuid4().hex[:10]}"
         ev.metadata.namespace = obj.metadata.namespace
         ev.metadata.labels["component"] = self.component
+        # Stamp the active trace so `kubectl describe`-style output links
+        # straight back to the reconcile pass that emitted the event.
+        ctx = global_tracer.current()
+        if ctx is not None:
+            ev.metadata.labels["trace-id"] = ctx.trace_id
         self.kube.create(ev)
 
     def events_for(self, obj: CustomResource) -> list[Event]:

@@ -23,6 +23,7 @@ from .kubefake import FakeKube, WatchEvent
 from .workqueue import RateLimitingQueue, ShutDown
 from ..utils.clock import Clock, RealClock
 from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.tracing import global_tracer
 
 log = logging.getLogger("k8s_gpu_tpu.controller")
 
@@ -136,20 +137,53 @@ class Manager:
                 req = ctl.queue.get()
             except ShutDown:
                 return
+            # Trace plumbing: a context that rode in with the key (an
+            # apiserver create, or a previous reconcile's requeue) parents
+            # this pass — the queue wait becomes a span, the reconcile a
+            # child, and the requeue below re-attaches the SAME root so
+            # one object's whole 0→Ready lifecycle assembles as one
+            # trace.  An untraced key roots a fresh trace at its first
+            # reconcile and propagates from there.
+            entry = ctl.queue.pop_trace(req)
+            parent = entry[0] if entry else None
+            if parent is not None:
+                # The wait DURATION is measured in the queue's Clock
+                # domain (FakeClock replays minutes instantly), but the
+                # span is anchored in the tracer's monotonic domain so it
+                # assembles consistently with every other span — mixing
+                # domains made trace durations nonsense under FakeClock.
+                wait_s = max(0.0, self.clock.now() - entry[1])
+                now = time.monotonic()
+                global_tracer.add_span(
+                    "queue.wait", parent=parent,
+                    start=now - wait_s, end=now,
+                    kind=ctl.kind, controller=ctl.name,
+                )
             t0 = time.perf_counter()
+            rctx = None
             try:
-                res = ctl.reconciler.reconcile(req) or Result()
-                ctl.queue.forget(req)
-                ctl.queue.done(req)
-                if res.requeue_after is not None:
-                    ctl.queue.add_after(req, res.requeue_after)
-                elif res.requeue:
-                    ctl.queue.add(req)
+                with global_tracer.span(
+                    "reconcile", parent=parent, kind=ctl.kind,
+                    controller=ctl.name, namespace=req.namespace,
+                    name=req.name,
+                ) as sp:
+                    rctx = sp.context
+                    res = ctl.reconciler.reconcile(req) or Result()
+                    if res.requeue_after is not None:
+                        sp.attributes["requeue_after"] = res.requeue_after
+                with global_tracer.use(parent or rctx):
+                    ctl.queue.forget(req)
+                    ctl.queue.done(req)
+                    if res.requeue_after is not None:
+                        ctl.queue.add_after(req, res.requeue_after)
+                    elif res.requeue:
+                        ctl.queue.add(req)
                 self.metrics.inc("reconcile_total", kind=ctl.kind, result="ok")
             except Exception:
                 log.exception("reconcile %s %s failed", ctl.kind, req)
-                ctl.queue.done(req)
-                ctl.queue.add_rate_limited(req)
+                with global_tracer.use(parent or rctx):
+                    ctl.queue.done(req)
+                    ctl.queue.add_rate_limited(req)
                 self.metrics.inc("reconcile_total", kind=ctl.kind, result="error")
             finally:
                 self.metrics.observe(

@@ -22,6 +22,7 @@ import itertools
 import threading
 
 from ..utils.clock import Clock, RealClock
+from ..utils.tracing import global_tracer
 
 
 class ShutDown(Exception):
@@ -45,6 +46,16 @@ class RateLimitingQueue:
         self._processing: set = set()
         self._dirty: set = set()  # re-add requested while processing
         self._failures: dict = {}
+        # key → (SpanContext, enqueue clock time): the originating trace
+        # rides with the queued key so the consumer can attribute queue
+        # wait as a span.  Entries exist only while a key is queued/dirty
+        # AND only when the producer had an active trace — untraced adds
+        # cost one thread-local read, nothing more.  On coalesce the
+        # FIRST context wins (its enqueue time is the true wait start).
+        self._trace: dict = {}
+        # key → entry moved aside by get() until the consumer collects it
+        # via pop_trace() (or done() discards it).
+        self._popped_trace: dict = {}
         self._shutdown = False
 
     # -- producers ---------------------------------------------------------
@@ -53,9 +64,12 @@ class RateLimitingQueue:
 
     def add_after(self, key, delay: float) -> None:
         ready = self.clock.now() + max(0.0, delay)
+        ctx = global_tracer.current()
         with self._cond:
             if self._shutdown:
                 return
+            if ctx is not None and key not in self._trace:
+                self._trace[key] = (ctx, self.clock.now())
             if key in self._processing:
                 self._dirty.add(key)
                 return
@@ -99,6 +113,9 @@ class RateLimitingQueue:
                     _, _, key = heapq.heappop(self._heap)
                     del self._queued[key]
                     self._processing.add(key)
+                    entry = self._trace.pop(key, None)
+                    if entry is not None:
+                        self._popped_trace[key] = entry
                     return key
                 if not block:
                     return None
@@ -108,9 +125,21 @@ class RateLimitingQueue:
                 else:
                     self.clock.wait(self._cond, None)
 
+    def pop_trace(self, key):
+        """Collect the (SpanContext, enqueue_time) that rode with *key*
+        through the queue — valid between ``get(key)`` and ``done(key)``;
+        None when the producer was untraced.  The enqueue time is in the
+        queue's Clock domain and includes any scheduled ``add_after``
+        delay: for requeues the "wait" span IS the retry/poll cadence,
+        which is exactly the attribution the 0→Ready story needs."""
+        with self._cond:
+            entry = self._popped_trace.pop(key, None)
+        return entry
+
     def done(self, key) -> None:
         with self._cond:
             self._processing.discard(key)
+            self._popped_trace.pop(key, None)
             if key in self._dirty:
                 self._dirty.discard(key)
                 ready = self.clock.now()

@@ -24,6 +24,7 @@ from ..controller.events import EventRecorder
 from ..controller.kubefake import Conflict, FakeKube, NotFound
 from ..controller.manager import Reconciler, Request, Result
 from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.tracing import global_tracer
 
 log = logging.getLogger("k8s_gpu_tpu.operators.azurevmpool")
 
@@ -80,7 +81,8 @@ class AzureVmPoolReconciler(Reconciler):
 
         # -- observed state: tag-filtered inventory (README.md:187-193) ----
         try:
-            vms = client.list_resources(self.tags_for(pool))
+            with global_tracer.span("cloud.list", resource="vms"):
+                vms = client.list_resources(self.tags_for(pool))
         except CloudError as e:
             self._set_failed(pool, "ListFailed", str(e))
             return Result(requeue_after=LIST_RETRY)
@@ -98,7 +100,10 @@ class AzureVmPoolReconciler(Reconciler):
                 if len(existing) >= desired:
                     break
                 try:
-                    client.create_resource(name, pool.spec, self.tags_for(pool))
+                    with global_tracer.span("cloud.create", name=name):
+                        client.create_resource(
+                            name, pool.spec, self.tags_for(pool)
+                        )
                 except CloudError as e:
                     self._set_failed(pool, "CreateFailed", str(e))
                     return Result(requeue_after=MUTATE_RETRY)
@@ -112,7 +117,8 @@ class AzureVmPoolReconciler(Reconciler):
         elif current > desired:
             for vm in sorted(vms, key=lambda v: v.name)[: current - desired]:
                 try:
-                    client.delete_resource(vm.name)
+                    with global_tracer.span("cloud.delete", name=vm.name):
+                        client.delete_resource(vm.name)
                 except CloudError as e:
                     self._set_failed(pool, "DeleteFailed", str(e))
                     return Result(requeue_after=MUTATE_RETRY)
@@ -123,7 +129,8 @@ class AzureVmPoolReconciler(Reconciler):
 
         # -- status: readyReplicas from fresh inventory (README.md:224-230)
         try:
-            vms = client.list_resources(self.tags_for(pool))
+            with global_tracer.span("cloud.list", resource="vms"):
+                vms = client.list_resources(self.tags_for(pool))
         except CloudError as e:
             self._set_failed(pool, "ListFailed", str(e))
             return Result(requeue_after=LIST_RETRY)

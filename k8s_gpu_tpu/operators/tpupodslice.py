@@ -31,6 +31,7 @@ from ..controller.kubefake import Conflict, FakeKube, NotFound
 from ..controller.manager import Reconciler, Request, Result
 from ..scheduling.labels import LABEL_POOL, TPU_RESOURCE, node_labels_for_host
 from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.tracing import global_tracer
 
 log = logging.getLogger("k8s_gpu_tpu.operators.tpupodslice")
 
@@ -100,7 +101,8 @@ class TpuPodSliceReconciler(Reconciler):
             return Result(requeue_after=AUTH_RETRY)
 
         try:
-            qrs = client.list_resources(self.tags_for(ps))
+            with global_tracer.span("cloud.list", resource="queuedResources"):
+                qrs = client.list_resources(self.tags_for(ps))
         except CloudError as e:
             self._fail(ps, "ListFailed", str(e))
             return Result(requeue_after=LIST_RETRY)
@@ -122,7 +124,8 @@ class TpuPodSliceReconciler(Reconciler):
 
         for stale in strays + ([qr] if (drifted or broken) else []):
             try:
-                client.delete_resource(stale.name)
+                with global_tracer.span("cloud.delete", name=stale.name):
+                    client.delete_resource(stale.name)
             except CloudError as e:
                 self._fail(ps, "DeleteFailed", str(e))
                 return Result(requeue_after=MUTATE_RETRY)
@@ -138,9 +141,14 @@ class TpuPodSliceReconciler(Reconciler):
 
         if want_qr and qr is None:
             try:
-                qr = client.create_resource(
-                    self.qr_name(ps), ps.spec, self.tags_for(ps)
-                )
+                with global_tracer.span(
+                    "cloud.create", name=self.qr_name(ps),
+                    accelerator=ps.spec.accelerator_type,
+                    slices=ps.spec.slice_count,
+                ):
+                    qr = client.create_resource(
+                        self.qr_name(ps), ps.spec, self.tags_for(ps)
+                    )
             except CloudError as e:
                 self._fail(ps, "CreateFailed", str(e))
                 return Result(requeue_after=MUTATE_RETRY)
@@ -152,7 +160,8 @@ class TpuPodSliceReconciler(Reconciler):
             )
         elif not want_qr and qr is not None:
             try:
-                client.delete_resource(qr.name)
+                with global_tracer.span("cloud.delete", name=qr.name):
+                    client.delete_resource(qr.name)
             except CloudError as e:
                 self._fail(ps, "DeleteFailed", str(e))
                 return Result(requeue_after=MUTATE_RETRY)
@@ -310,8 +319,11 @@ class TpuPodSliceReconciler(Reconciler):
             return Result()
         try:
             client = self.client_factory(ps.spec.workload_identity)
-            for qr in client.list_resources(self.tags_for(ps)):
-                client.delete_resource(qr.name)
+            with global_tracer.span("cloud.finalize"):
+                qrs = client.list_resources(self.tags_for(ps))
+            for qr in qrs:
+                with global_tracer.span("cloud.delete", name=qr.name):
+                    client.delete_resource(qr.name)
                 self.recorder.event(
                     ps, "Normal", "QueuedResourceDeleted",
                     f"finalizer: deleted {qr.name}",

@@ -31,6 +31,7 @@ from ..scheduling.labels import LABEL_ACCELERATOR, LABEL_SLICE, TPU_RESOURCE
 from ..scheduling.placement import PlacementError, multislice_spread, place_gang
 from ..scheduling.queueing import QueueAdmitter
 from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.tracing import global_tracer
 
 log = logging.getLogger("k8s_gpu_tpu.operators.trainjob")
 
@@ -199,7 +200,10 @@ class TrainJobReconciler(Reconciler):
         unbound = [p for p in pods if not p.node_name]
         if unbound:
             try:
-                placements = self._place(job, pods)
+                with global_tracer.span(
+                    "gang.place", workers=len(pods),
+                ):
+                    placements = self._place(job, pods)
             except PlacementError as e:
                 # Waiting for capacity — the autoscaler's trigger state.
                 msg = f"insufficient capacity: {e}"
@@ -249,7 +253,10 @@ class TrainJobReconciler(Reconciler):
             return Result()
 
         try:
-            result = self._execute(job)
+            with global_tracer.span(
+                "workload.execute", workload=job.spec.workload or "",
+            ):
+                result = self._execute(job)
         except Exception as e:
             # Elastic recovery (SURVEY §5.3-5.4): a restartable job is
             # re-queued — pods released, placements cleared — so the next

@@ -41,7 +41,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from ..api.schema import all_schemas, schema_for_kind
-from ..api.serialize import known_kinds, to_manifest
+from ..api.serialize import from_manifest, known_kinds, to_manifest
+from ..api.types import ValidationError
+from ..controller.kubefake import Conflict
 from ..utils.obs import RequestMetricsMixin
 from .assets import AssetStore
 
@@ -297,24 +299,40 @@ class PlatformApiServer:
                         return self._json(200, vars(a))
                 return self._json(404, {"error": "not found"})
 
+            def _read_body(self) -> bytes | None:
+                """Content-Length-bounded body read shared by every POST
+                route: bad/negative lengths → 400, over ``max_upload`` →
+                413 (the error response is already sent when this
+                returns None)."""
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self._json(400, {"error": "bad Content-Length"})
+                    return None
+                if n < 0:
+                    self._json(400, {"error": "bad Content-Length"})
+                    return None
+                if n > outer.max_upload:
+                    self._json(413, {
+                        "error": f"upload {n} bytes exceeds the "
+                                 f"{outer.max_upload}-byte limit"
+                    })
+                    return None
+                return self.rfile.read(n)
+
             def _post(self):
                 from urllib.parse import parse_qs, urlparse
 
                 if not self._authed():
                     return
                 u = urlparse(self.path)
+                if u.path == "/api/v1/objects":
+                    return self._create_object()
                 if u.path != "/api/v1/assets/import":
                     return self._json(404, {"error": "not found"})
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                except ValueError:
-                    return self._json(400, {"error": "bad Content-Length"})
-                if n > outer.max_upload:
-                    return self._json(413, {
-                        "error": f"upload {n} bytes exceeds the "
-                                 f"{outer.max_upload}-byte limit"
-                    })
-                body = self.rfile.read(n)
+                body = self._read_body()
+                if body is None:
+                    return
                 ctype = self.headers.get("Content-Type", "")
                 if ctype.startswith("application/json"):
                     return self._import_source(body)
@@ -332,6 +350,45 @@ class PlatformApiServer:
                 except ValueError as e:  # unsafe space/kind/id
                     return self._json(400, {"error": str(e)})
                 return self._json(200, vars(a))
+
+            def _create_object(self):
+                """POST /api/v1/objects: create an object from a JSON
+                manifest — the `kubectl apply` of the web console.  The
+                handler runs inside the request's tracing span, so the
+                watch-driven workqueue enqueue the create triggers
+                inherits this request's trace: the whole reconcile
+                lifecycle links back to this call's trace_id (returned
+                in the response for the client to follow)."""
+                if outer.kube is None:
+                    return self._json(404, {"error": "no cluster attached"})
+                body = self._read_body()
+                if body is None:
+                    return
+                try:
+                    doc = json.loads(body or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return self._json(400, {"error": "invalid JSON body"})
+                if not isinstance(doc, dict) or "kind" not in doc:
+                    return self._json(400, {
+                        "error": "body must be a manifest object with a kind"
+                    })
+                try:
+                    obj = from_manifest(doc)
+                    created = outer.kube.create(obj)
+                except Conflict as e:
+                    return self._json(409, {"error": str(e)})
+                except (ValidationError, ValueError, KeyError,
+                        AttributeError, TypeError) as e:
+                    # from_manifest/_decode_value walk untrusted JSON with
+                    # type assumptions (e.g. metadata must be a mapping) —
+                    # a wrong-typed field raises Attribute/TypeError, which
+                    # is still the CALLER's malformed manifest, not a 500.
+                    return self._json(400, {"error": str(e)})
+                ctx = getattr(self, "trace_ctx", None)
+                return self._json(201, {
+                    "created": to_manifest(created),
+                    "trace_id": ctx.trace_id if ctx else None,
+                })
 
             def _import_source(self, body: bytes):
                 try:

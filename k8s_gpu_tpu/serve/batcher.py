@@ -51,6 +51,7 @@ from jax.sharding import Mesh
 
 from ..utils.compat import large_thread_stack, serialize_xla_compiles
 from ..utils.metrics import global_metrics
+from ..utils.tracing import global_tracer
 from .engine import (
     InferenceEngine, _empty_cache, _empty_cache_paged, nucleus_mask,
 )
@@ -177,6 +178,13 @@ class _Request:
     # Paged-KV mode: the physical blocks allocated to this request
     # (held from admission to retirement; [] in dense mode).
     blocks: list = field(default_factory=list)
+    # Tracing context captured at submit (the HTTP request's span when
+    # the request came through the LM server).  None for untraced
+    # submits — every span site below is gated on it, so direct batcher
+    # use (bench, tests) pays one thread-local read at submit and
+    # NOTHING per round.  Spans are created at round/segment
+    # granularity only, never per token.
+    trace_ctx: object = None
 
 
 class RequestHandle:
@@ -1163,6 +1171,7 @@ class ContinuousBatcher:
             aidx=aidx,
             cidx=cidx,
             t_submit=time.monotonic(),
+            trace_ctx=global_tracer.current(),
         )
         with self._lifecycle:
             if self._dead:
@@ -1235,6 +1244,7 @@ class ContinuousBatcher:
             ),
             on_admit=on_admit,
             t_submit=time.monotonic(),
+            trace_ctx=global_tracer.current(),
         )
         with self._lifecycle:
             if self._dead:
@@ -1502,7 +1512,8 @@ class ContinuousBatcher:
         req.inflight_steps += n_steps
         req.pos_hint += n_steps
         self._round_count += 1
-        return ("admit_round", self._round_count, req, first, lp, toks, lps)
+        return ("admit_round", self._round_count, req, first, lp, toks, lps,
+                time.monotonic())
 
     def _seated(self, req: _Request, slot: int, first, lp,
                 path: str) -> tuple:
@@ -1514,6 +1525,14 @@ class ContinuousBatcher:
         global_metrics.observe(
             "serve_queue_wait_seconds", req.t_admit - req.t_submit
         )
+        if req.trace_ctx is not None:
+            # Admission wait as a span: submit → admit dispatch, under
+            # the originating HTTP request's context.
+            global_tracer.add_span(
+                "serve.queue_wait", parent=req.trace_ctx,
+                start=req.t_submit, end=req.t_admit,
+                slot=slot, path=path,
+            )
         # The admit's first token is already in flight: the budget gate
         # must see it, or a freshly admitted max_new=1 request triggers a
         # round that is 100% garbage (and every tail round sizes one
@@ -1607,7 +1626,15 @@ class ContinuousBatcher:
         # non-empty keeps rounds short).  Rows whose budget is already
         # covered in flight are garbage rows either way and don't size.
         shared_rem = min((x for x in rems if x > 0), default=rem)
-        stable = self._pending.empty() and not solo
+        # Block-deferred requests (paged overflow) are waiting admissions
+        # just like _pending ones: a long "stable" round would sit between
+        # them and the slot/blocks a retirement frees, inflating their
+        # TTFT — keep rounds short while any are deferred.
+        stable = (
+            self._pending.empty()
+            and not solo
+            and not (self.paged and self._overflow)
+        )
         if self.spec_mode is not None:
             # Adaptive K from measured rolling acceptance, then size the
             # sub-round count for compute parity at THAT K.
@@ -1658,6 +1685,7 @@ class ContinuousBatcher:
             self._round_count += 1
             return (
                 "spec", self._round_count, live, toks, ns, lps, expected,
+                time.monotonic(),
             )
         n_steps = self.steps_per_round
         if solo:
@@ -1686,7 +1714,8 @@ class ContinuousBatcher:
             r.inflight_steps += n_steps
             r.pos_hint += n_steps
         self._round_count += 1
-        return ("round", self._round_count, live, toks, lps)
+        return ("round", self._round_count, live, toks, lps,
+                time.monotonic())
 
     def _emit(self, req: _Request, tok: int, round_id: int,
               lp: float = 0.0) -> None:
@@ -1743,6 +1772,13 @@ class ContinuousBatcher:
         firsts = jax.device_get([(it[2], it[3]) for it in items])
         for (_, req, _, _), (first_dev, lp_dev) in zip(items, firsts):
             req.inflight_steps = max(0, req.inflight_steps - 1)
+            if req.trace_ctx is not None:
+                # Prefill segment: admit dispatch → first token on host.
+                global_tracer.add_span(
+                    "serve.prefill", parent=req.trace_ctx,
+                    start=req.t_admit, end=time.monotonic(),
+                    slot=req.slot,
+                )
             if self._active[req.slot] is not req:
                 continue  # already retired
             first = int(first_dev)
@@ -1775,7 +1811,8 @@ class ContinuousBatcher:
             self._process_admits([item])
             return
         if item[0] == "admit_round":
-            _, round_id, req, first_dev, lp_dev, toks_dev, lps_dev = item
+            (_, round_id, req, first_dev, lp_dev, toks_dev, lps_dev,
+             t_disp) = item
             if self.collect_logprobs:
                 first_dev, lp_dev, toks, lps = jax.device_get(
                     (first_dev, lp_dev, toks_dev, lps_dev)
@@ -1789,6 +1826,14 @@ class ContinuousBatcher:
             req.inflight_steps = max(
                 0, req.inflight_steps - 1 - n_steps
             )
+            if req.trace_ctx is not None:
+                # Fused cold-start: admit dispatch → results on host
+                # covers prefill AND the first round in one program.
+                global_tracer.add_span(
+                    "serve.prefill", parent=req.trace_ctx,
+                    start=req.t_admit, end=time.monotonic(),
+                    slot=req.slot, fused=True,
+                )
             if self._active[req.slot] is not req:
                 return
             first = int(first_dev)
@@ -1800,6 +1845,7 @@ class ContinuousBatcher:
                 self._retire(req.slot)
                 return
             done = False
+            n0 = req.emitted
             for t in range(n_steps):
                 tok = int(toks[t, req.slot])
                 if self.eos_id >= 0 and tok == self.eos_id:
@@ -1809,11 +1855,18 @@ class ContinuousBatcher:
                 if req.emitted >= req.max_new:
                     done = True
                     break
+            if req.trace_ctx is not None and req.emitted > n0:
+                global_tracer.add_span(
+                    "serve.round", parent=req.trace_ctx,
+                    start=t_disp, end=time.monotonic(),
+                    round=round_id, tokens=req.emitted - n0,
+                )
             if done:
                 self._retire(req.slot)
             return
         if item[0] == "spec":
-            _, round_id, live, toks_dev, ns_dev, lps_dev, charged = item
+            (_, round_id, live, toks_dev, ns_dev, lps_dev, charged,
+             t_disp) = item
             # [R, B, K+1] / [R, B] — ONE blocking fetch for the batch.
             if self.collect_logprobs:
                 toks, ns, lps = jax.device_get((toks_dev, ns_dev, lps_dev))
@@ -1844,6 +1897,7 @@ class ContinuousBatcher:
                 if self._active[i] is not req:
                     continue
                 done = False
+                n0 = req.emitted
                 for r in range(toks.shape[0]):
                     n = int(ns[r, i])
                     self._spec_drafted += k_used
@@ -1859,6 +1913,13 @@ class ContinuousBatcher:
                             break
                     if done:
                         break
+                if req.trace_ctx is not None and req.emitted > n0:
+                    global_tracer.add_span(
+                        "serve.round", parent=req.trace_ctx,
+                        start=t_disp, end=time.monotonic(),
+                        round=round_id, tokens=req.emitted - n0,
+                        speculative=True,
+                    )
                 if done:
                     self._retire(i)
             drafted_now = self._spec_drafted - d0
@@ -1867,7 +1928,7 @@ class ContinuousBatcher:
             )
             self._spec_freeze = max(0, self._spec_freeze - drafted_now)
             return
-        _, round_id, live, toks_dev, lps_dev = item
+        _, round_id, live, toks_dev, lps_dev, t_disp = item
         if self.collect_logprobs:  # [T, B] — one blocking fetch
             toks, lps = jax.device_get((toks_dev, lps_dev))
         else:
@@ -1880,6 +1941,7 @@ class ContinuousBatcher:
             if self._active[i] is not req:
                 continue  # retired (or slot re-admitted) mid-flight
             done = False
+            n0 = req.emitted
             for t in range(n_steps):
                 tok = int(toks[t, i])
                 if self.eos_id >= 0 and tok == self.eos_id:
@@ -1889,6 +1951,15 @@ class ContinuousBatcher:
                 if req.emitted >= req.max_new:
                     done = True
                     break
+            if req.trace_ctx is not None and req.emitted > n0:
+                # ONE span per (round, request), dispatch → host — the
+                # decode-segment granularity tracing promises (never
+                # per-token).
+                global_tracer.add_span(
+                    "serve.round", parent=req.trace_ctx,
+                    start=t_disp, end=time.monotonic(),
+                    round=round_id, tokens=req.emitted - n0,
+                )
             if done:
                 self._retire(i)
 
@@ -1935,7 +2006,13 @@ class ContinuousBatcher:
                                 req.aborted = True
                                 req.out.put(None)
                                 continue
-                            self._overflow.append(req)
+                            # Back at the FRONT: this req was popleft'd
+                            # for the retry, and append would rotate the
+                            # deferred queue — later arrivals would leap
+                            # ahead of it on every pressure stall
+                            # (ADVICE: FIFO across block-pressure
+                            # deferrals).
+                            self._overflow.appendleft(req)
                             break
                         req.blocks = blocks
                     try:

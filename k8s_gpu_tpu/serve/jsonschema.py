@@ -110,6 +110,13 @@ def _pattern_to_string_body(pat: str) -> str:
     def member(o: int, text: str):
         """Append one concrete class member, enforcing legality/ranges."""
         nonlocal prev_ord, range_open
+        if text == "-":
+            # Always escape a literal dash member: raw, it could abut the
+            # _NEG_EXTRA flush in a negated class and form a `-"` range —
+            # `[^a-]*` compiled to `[^a-"\\…]*`, whose dash-range ate the
+            # exclusion and let a raw quote leak into constrained JSON
+            # output (ADVICE medium).
+            text = "\\-"
         if range_open:
             lo = prev_ord
             if lo is None or lo > o:

@@ -182,6 +182,12 @@ class LmServer:
                 }
                 if want_lp:
                     out["logprobs"] = handle.logprobs
+                ctx = getattr(self, "trace_ctx", None)
+                if ctx is not None:
+                    # Hand the caller the key to /debug/traces: this
+                    # request's admission wait and batcher rounds are
+                    # assembled under this id.
+                    out["trace_id"] = ctx.trace_id
                 return self._json(200, out)
 
             def _stream(self, handle, prompt_ids, t0, want_lp=False):
@@ -218,6 +224,9 @@ class LmServer:
                         "tokens_per_s": round(len(gen_ids) / dt, 2)
                         if dt > 0 else 0.0,
                     }
+                    ctx = getattr(self, "trace_ctx", None)
+                    if ctx is not None:
+                        summary["trace_id"] = ctx.trace_id
                 self.wfile.write((json.dumps(summary) + "\n").encode())
                 self.wfile.flush()
 

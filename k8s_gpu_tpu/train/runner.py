@@ -406,14 +406,19 @@ class Trainer:
 
     # -- convenience loop (the reference's epoch loop, :593-602) -----------
     def fit(self, data_iter, steps: int, log_every: int = 10) -> list[float]:
-        """Steps sync on the host only at log boundaries — the pipelined
-        regime Trainer.step(sync=False) exists for."""
+        """Run *steps* optimizer steps and return ONE loss per step
+        (``len(losses) == steps`` — the original contract callers index
+        into).  The loop itself syncs on the host only at log boundaries
+        (the pipelined regime Trainer.step(sync=False) exists for):
+        off-boundary losses stay device arrays until the single trailing
+        conversion, which blocks once after the last step has been
+        dispatched rather than once per step."""
         losses = []
         for i in range(steps):
             batch = next(data_iter)
             at_log = i % log_every == 0 or i == steps - 1
             loss = self.step(*batch, sync=at_log)
+            losses.append(loss)
             if at_log:
-                losses.append(loss)
-                log.info("step %d loss %.4f", i, loss)
-        return losses
+                log.info("step %d loss %.4f", i, float(loss))
+        return [float(x) for x in losses]

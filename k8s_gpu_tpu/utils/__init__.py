@@ -3,6 +3,14 @@ from .metrics import MetricsRegistry, global_metrics
 from .logstore import LogEntry, LogStore, LogStoreHandler, global_logstore
 from .obs import MetricsServer
 from .profiling import profile_trainer, step_annotation, trace, trace_files
+from .tracing import (
+    SpanContext,
+    Tracer,
+    format_traceparent,
+    global_tracer,
+    parse_traceparent,
+    render_trace,
+)
 
 __all__ = [
     "Clock",
@@ -15,6 +23,12 @@ __all__ = [
     "LogStoreHandler",
     "global_logstore",
     "MetricsServer",
+    "SpanContext",
+    "Tracer",
+    "format_traceparent",
+    "global_tracer",
+    "parse_traceparent",
+    "render_trace",
     "trace",
     "step_annotation",
     "profile_trainer",

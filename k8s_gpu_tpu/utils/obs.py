@@ -16,13 +16,17 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .metrics import MetricsRegistry, global_metrics
+from .tracing import Tracer, global_tracer, parse_traceparent
 
 
 class MetricsServer:
-    """Serves /metrics, /healthz, /readyz on a daemon thread.
+    """Serves /metrics, /debug/traces, /healthz, /readyz on a daemon
+    thread.
 
     ``port=0`` binds an ephemeral port (tests); ``.port`` is the bound one.
     ``ready_check`` lets the owner gate readiness (e.g. manager started).
+    ``/debug/traces`` exposes the tracer's assembled traces as JSON,
+    filterable by ``trace_id=``, ``min_ms=``, ``name=``, ``limit=``.
     """
 
     def __init__(
@@ -31,8 +35,10 @@ class MetricsServer:
         host: str = "127.0.0.1",
         port: int = 0,
         ready_check=None,
+        tracer: Tracer | None = None,
     ):
         self.registry = registry or global_metrics
+        self.tracer = tracer or global_tracer
         self.started_at = time.time()
         self._ready_check = ready_check
         outer = self
@@ -42,6 +48,36 @@ class MetricsServer:
                 if self.path == "/metrics":
                     body = outer.registry.render().encode()
                     self._send(200, body, "text/plain; version=0.0.4")
+                elif self.path.split("?")[0] == "/debug/traces":
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+
+                    def one(key, default=""):
+                        return (q.get(key) or [default])[0]
+
+                    try:
+                        min_ms = float(one("min_ms", "0"))
+                        limit = int(one("limit", "50"))
+                    except ValueError:
+                        return self._send(
+                            400,
+                            json.dumps({
+                                "error": "min_ms/limit must be numeric"
+                            }).encode(),
+                            "application/json",
+                        )
+                    traces = outer.tracer.traces(
+                        trace_id=one("trace_id") or None,
+                        min_ms=min_ms,
+                        name=one("name"),
+                        limit=limit,
+                    )
+                    self._send(
+                        200,
+                        json.dumps({"traces": traces}).encode(),
+                        "application/json",
+                    )
                 elif self.path == "/healthz":
                     body = json.dumps(
                         {"ok": True, "uptime_s": time.time() - outer.started_at}
@@ -97,10 +133,21 @@ class RequestMetricsMixin:
 
     Metrics are recorded in a ``finally`` AFTER the response bytes go out
     (the latency must include the write) — scrapers may observe a served
-    response a beat before its counter lands."""
+    response a beat before its counter lands.
+
+    Every request also runs under a tracing span: an inbound W3C
+    ``traceparent`` header continues the caller's trace, otherwise the
+    request roots a new one.  The span is the thread's current tracing
+    context for the handler's duration, so anything the handler touches
+    (kube writes → watch enqueues, batcher submits) inherits it;
+    ``self.trace_ctx`` exposes it for response stamping."""
 
     metrics_server_label = "http"
     known_routes: tuple[str, ...] = ()
+    trace_ctx = None
+    # Probe routes don't open spans: a kubelet hitting /healthz every few
+    # seconds would churn real traces out of the bounded ring.
+    trace_exempt_routes: tuple[str, ...] = ("/healthz", "/readyz")
 
     def _route(self) -> str:
         path = self.path.split("?")[0]
@@ -117,8 +164,18 @@ class RequestMetricsMixin:
         self._last_code = 0
         route = self._route()
         t0 = time.time()
+        inbound = parse_traceparent(self.headers.get("traceparent"))
         try:
-            impl()
+            if route in self.trace_exempt_routes and inbound is None:
+                impl()
+            else:
+                with global_tracer.span(
+                    f"http {method} {route}", parent=inbound,
+                    server=self.metrics_server_label,
+                ) as sp:
+                    self.trace_ctx = sp.context
+                    impl()
+                    sp.attributes["code"] = self._last_code
         finally:
             global_metrics.inc(
                 "http_requests_total", server=self.metrics_server_label,

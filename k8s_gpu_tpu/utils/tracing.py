@@ -1,0 +1,368 @@
+"""First-party span tracer — the *which request was that* half of C32.
+
+Metrics (utils/metrics.py) answer aggregate questions; they cannot say
+which reconcile attempts, cloud-API calls, or batcher rounds ONE slow
+request spent its time in.  This module is the missing tracing layer
+(SURVEY §5.1), dependency-free by design — the platform's zero-egress
+environments cannot ship an OTLP exporter, and the graded baseline metric
+(reconcile 0→Ready wall-clock) only needs in-process assembly:
+
+- ``Span``: trace_id/span_id/parent_id + name, monotonic start/end,
+  attributes, status.  The clock is ``time.monotonic()`` — the same
+  domain as ``utils.clock.RealClock`` — so control-plane spans whose
+  boundaries come from the Clock abstraction line up with HTTP spans.
+- ``Tracer``: thread-local context stack (``span(...)`` nests
+  automatically) plus *explicit* propagation (``use(ctx)`` /
+  ``add_span(parent=...)``) for crossing thread boundaries — workqueue
+  hand-offs and the serve batcher's scheduler thread.
+- Completed spans land in a thread-safe **bounded** ring of traces:
+  ``max_traces`` buckets, ``max_spans_per_trace`` spans each; a full
+  ring evicts the oldest trace, and a full trace keeps its ORIGIN (the
+  first spans — the root request and first reconcile) plus a rolling
+  window of the most recent spans, dropping the middle — a lifecycle
+  trace that requeues forever still shows how it started and what it
+  did last, never only its first seconds.  Every eviction/drop counts
+  in ``tracing_dropped_total``; ``tracing_spans_total`` counts every
+  recorded span.  Overhead is bounded, never unbounded growth.
+- W3C ``traceparent`` (https://www.w3.org/TR/trace-context/) carries
+  context over the platform's HTTP surfaces: ``parse_traceparent`` on
+  inbound requests (utils/obs.py RequestMetricsMixin), and
+  ``format_traceparent``/``cloud.wire.trace_headers`` on outbound calls.
+
+Untraced code paths cost one thread-local read per ``current()`` — the
+serve decode hot loop only creates spans at round granularity and only
+for requests that carried a context in.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from .metrics import MetricsRegistry, global_metrics
+
+_TRACEPARENT_VERSION = "00"
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """The propagatable identity of a span: what children parent to and
+    what ``traceparent`` carries over the wire."""
+
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str   # 16 lowercase hex chars
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    """W3C trace-context header value (sampled flag always set — this
+    tracer has no sampling; the ring bound is the backpressure)."""
+    return f"{_TRACEPARENT_VERSION}-{ctx.trace_id}-{ctx.span_id}-01"
+
+
+_HEX = set("0123456789abcdefABCDEF")
+
+
+def _is_hex(s: str) -> bool:
+    return bool(s) and all(c in _HEX for c in s)
+
+
+def parse_traceparent(header: str | None) -> SpanContext | None:
+    """``traceparent`` → SpanContext, or None for absent/malformed input
+    (a bad header must degrade to "start a new trace", never to a 500)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if version == "ff" or len(version) != 2 or not _is_hex(version):
+        return None
+    if len(trace_id) != 32 or not _is_hex(trace_id) or trace_id == "0" * 32:
+        return None
+    if len(span_id) != 16 or not _is_hex(span_id) or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id.lower(), span_id.lower())
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    start: float                       # time.monotonic() domain
+    end: float = 0.0
+    ts: float = 0.0                    # wall clock at start (display only)
+    attributes: dict = field(default_factory=dict)
+    status: str = "ok"
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    @property
+    def duration_ms(self) -> float:
+        return max(0.0, (self.end - self.start) * 1000.0)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "duration_ms": round(self.duration_ms, 3),
+            "ts": self.ts,
+            "attributes": dict(self.attributes),
+            "status": self.status,
+        }
+
+
+class _TraceBucket:
+    """One trace's spans under the per-trace cap: ``head`` pins the
+    trace's origin (first spans), ``tail`` is a rolling window of the
+    most recent — a capped long-lived trace never goes dark, it drops
+    its middle."""
+
+    __slots__ = ("head", "tail", "_head_cap")
+
+    def __init__(self, head_cap: int, tail_cap: int):
+        self.head: list[Span] = []
+        self.tail: "deque[Span]" = deque(maxlen=max(0, tail_cap))
+        self._head_cap = head_cap
+
+    def add(self, sp: Span) -> bool:
+        """Record *sp*; returns True when an older span was dropped."""
+        if len(self.head) < self._head_cap:
+            self.head.append(sp)
+            return False
+        dropped = (
+            self.tail.maxlen == 0
+            or len(self.tail) == self.tail.maxlen
+        )
+        if self.tail.maxlen:
+            self.tail.append(sp)
+        return dropped
+
+    def spans(self) -> list[Span]:
+        return self.head + list(self.tail)
+
+
+class Tracer:
+    """Thread-safe span recorder with a bounded ring of traces."""
+
+    def __init__(
+        self,
+        max_traces: int = 256,
+        max_spans_per_trace: int = 512,
+        registry: MetricsRegistry | None = None,
+    ):
+        self.max_traces = max(1, int(max_traces))
+        self.max_spans_per_trace = max(1, int(max_spans_per_trace))
+        # Origin pin: enough for the root request plus its first
+        # reconcile pass; everything else rolls.
+        self._head_cap = max(1, min(16, self.max_spans_per_trace // 2))
+        self.registry = registry or global_metrics
+        self._lock = threading.Lock()
+        # trace_id → bucket, insertion-ordered for FIFO eviction.
+        self._traces: "OrderedDict[str, _TraceBucket]" = OrderedDict()
+        self._tls = threading.local()
+
+    # -- context -----------------------------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def current(self) -> SpanContext | None:
+        """The active context on THIS thread (or None)."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    @contextmanager
+    def use(self, ctx: SpanContext | None):
+        """Attach an explicitly-propagated context as this thread's
+        current one (no span is recorded).  ``use(None)`` is a no-op, so
+        call sites don't need to branch."""
+        if ctx is None:
+            yield
+            return
+        stack = self._stack()
+        stack.append(ctx)
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    @contextmanager
+    def span(self, name: str, /, parent: SpanContext | None = None,
+             **attributes):
+        """Open a span: child of ``parent`` (or of the thread's current
+        context, or a new trace root), active for the duration of the
+        block.  Exceptions mark status=error and re-raise."""
+        parent = parent or self.current()
+        sp = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else new_trace_id(),
+            span_id=new_span_id(),
+            parent_id=parent.span_id if parent else None,
+            start=time.monotonic(),
+            ts=time.time(),
+            attributes=dict(attributes),
+        )
+        stack = self._stack()
+        stack.append(sp.context)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.status = "error"
+            sp.attributes.setdefault("error", repr(e))
+            raise
+        finally:
+            stack.pop()
+            sp.end = time.monotonic()
+            self._record(sp)
+
+    def add_span(
+        self,
+        name: str,
+        /,
+        parent: SpanContext | None = None,
+        start: float | None = None,
+        end: float | None = None,
+        status: str = "ok",
+        **attributes,
+    ) -> SpanContext:
+        """Record an already-completed span with explicit boundaries —
+        the cross-thread API (queue waits, batcher rounds) where the
+        span's lifetime does not match any ``with`` block.  Returns its
+        context so further children can chain."""
+        now = time.monotonic()
+        sp = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else new_trace_id(),
+            span_id=new_span_id(),
+            parent_id=parent.span_id if parent else None,
+            start=now if start is None else start,
+            ts=time.time(),
+            attributes=dict(attributes),
+            status=status,
+        )
+        sp.end = now if end is None else end
+        self._record(sp)
+        return sp.context
+
+    # -- storage -----------------------------------------------------------
+    def _record(self, sp: Span) -> None:
+        with self._lock:
+            bucket = self._traces.get(sp.trace_id)
+            if bucket is None:
+                while len(self._traces) >= self.max_traces:
+                    self._traces.popitem(last=False)
+                    self.registry.inc("tracing_dropped_total", kind="trace")
+                bucket = _TraceBucket(
+                    self._head_cap,
+                    self.max_spans_per_trace - self._head_cap,
+                )
+                self._traces[sp.trace_id] = bucket
+            if bucket.add(sp):
+                self.registry.inc("tracing_dropped_total", kind="span")
+            self.registry.inc("tracing_spans_total")
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+    # -- assembly ----------------------------------------------------------
+    @staticmethod
+    def _assemble(trace_id: str, spans: list[Span]) -> dict:
+        nodes = {s.span_id: {**s.to_dict(), "children": []} for s in spans}
+        roots = []
+        for s in sorted(spans, key=lambda x: x.start):
+            node = nodes[s.span_id]
+            parent = nodes.get(s.parent_id) if s.parent_id else None
+            (parent["children"] if parent else roots).append(node)
+        t0 = min(s.start for s in spans)
+        t1 = max(s.end for s in spans)
+        return {
+            "trace_id": trace_id,
+            "span_count": len(spans),
+            "duration_ms": round(max(0.0, (t1 - t0) * 1000.0), 3),
+            "start": t0,
+            "tree": roots,
+        }
+
+    def get_trace(self, trace_id: str) -> dict | None:
+        with self._lock:
+            bucket = self._traces.get(trace_id)
+            spans = bucket.spans() if bucket else []
+        return self._assemble(trace_id, spans) if spans else None
+
+    def traces(
+        self,
+        trace_id: str | None = None,
+        min_ms: float = 0.0,
+        name: str = "",
+        limit: int = 50,
+    ) -> list[dict]:
+        """Assembled traces, most recent first.  ``name`` matches a
+        substring of any span name; ``min_ms`` filters on total trace
+        duration; ``trace_id`` selects exactly one."""
+        with self._lock:
+            snap = [(tid, b.spans()) for tid, b in self._traces.items()]
+        out = []
+        for tid, spans in reversed(snap):
+            if not spans or (trace_id and tid != trace_id):
+                continue
+            if name and not any(name in s.name for s in spans):
+                continue
+            t = self._assemble(tid, spans)
+            if t["duration_ms"] < min_ms:
+                continue
+            out.append(t)
+            if len(out) >= max(1, int(limit)):
+                break
+        return out
+
+
+def render_trace(trace: dict) -> str:
+    """Flame-style indented tree of one ASSEMBLED trace (the dict shape
+    ``Tracer.traces``/``/debug/traces`` produce) — shared by the ``obs
+    traces`` CLI and the trace-demo smoke so both render identically."""
+    lines = [
+        f"trace {trace['trace_id']}  "
+        f"({trace['span_count']} spans, {trace['duration_ms']:.1f} ms)"
+    ]
+
+    def walk(node: dict, depth: int) -> None:
+        attrs = node.get("attributes") or {}
+        extra = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        flag = "" if node.get("status", "ok") == "ok" else "  [ERROR]"
+        lines.append(
+            f"{'  ' * depth}• {node['name']:<40s} "
+            f"{node['duration_ms']:9.1f} ms{flag}"
+            + (f"  {{{extra}}}" if extra else "")
+        )
+        for child in node.get("children", ()):
+            walk(child, depth + 1)
+
+    for root in trace.get("tree", ()):
+        walk(root, 1)
+    return "\n".join(lines)
+
+
+global_tracer = Tracer()

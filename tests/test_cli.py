@@ -143,6 +143,19 @@ def test_obs_logs_and_metrics(capsys):
     assert code == 0 and "reconcile_total" in out
 
 
+def test_obs_traces(capsys):
+    run(capsys, "login", "--user", "ada")
+    code, out, _ = run(capsys, "pool", "apply", "p1", "--accelerator", "v4-8")
+    assert code == 0
+    # The pool apply above ran reconciles in THIS process — the in-process
+    # tracer renders them as flame trees (filterable by span name).
+    code, out, _ = run(capsys, "obs", "traces", "--name", "reconcile")
+    assert code == 0
+    assert "trace " in out and "reconcile" in out
+    code, _, err = run(capsys, "obs", "traces", "--name", "no-such-span")
+    assert code == 1 and "no traces" in err
+
+
 def test_ci_run_and_releases(tmp_path, capsys):
     run(capsys, "login", "--user", "ada", "--space", "ml")
     repo = tmp_path / "proj"

@@ -1,0 +1,457 @@
+"""End-to-end span tracing: tracer mechanics (nesting, thread isolation,
+ring bounds), W3C traceparent round-trips, the control-plane journey
+(apiserver create → workqueue wait → reconcile → fake cloud call → Event),
+the serving-plane journey (request → admission wait → batcher rounds), and
+/debug/traces filtering.
+
+(Named test_distributed_tracing, not test_tracing: the single-process
+tier-1 run truncates alphabetically at its time budget, and this file
+must sort inside the executed window to keep the tracing path exercised
+there.)"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_gpu_tpu.api import TpuPodSlice
+from k8s_gpu_tpu.cloud import FakeCloudTpu, cloudtpu_client_factory
+from k8s_gpu_tpu.controller import FakeKube, Manager
+from k8s_gpu_tpu.controller.manager import Request
+from k8s_gpu_tpu.controller.workqueue import RateLimitingQueue
+from k8s_gpu_tpu.operators import TpuPodSliceReconciler
+from k8s_gpu_tpu.utils import MetricsRegistry, MetricsServer
+from k8s_gpu_tpu.utils.tracing import (
+    SpanContext,
+    Tracer,
+    format_traceparent,
+    global_tracer,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    render_trace,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_tracer():
+    global_tracer.clear()
+    yield
+    global_tracer.clear()
+
+
+def _ctx() -> SpanContext:
+    return SpanContext(new_trace_id(), new_span_id())
+
+
+def _names(node, out=None):
+    out = [] if out is None else out
+    out.append(node["name"])
+    for c in node.get("children", ()):
+        _names(c, out)
+    return out
+
+
+def _all_names(trace):
+    out = []
+    for root in trace["tree"]:
+        _names(root, out)
+    return out
+
+
+# -- tracer mechanics -------------------------------------------------------
+
+def test_span_nesting_and_assembly():
+    tr = Tracer(registry=MetricsRegistry())
+    with tr.span("root", who="test") as root:
+        with tr.span("child-a"):
+            with tr.span("leaf"):
+                pass
+        with tr.span("child-b"):
+            pass
+    t = tr.get_trace(root.trace_id)
+    assert t["span_count"] == 4
+    assert len(t["tree"]) == 1
+    top = t["tree"][0]
+    assert top["name"] == "root" and top["attributes"]["who"] == "test"
+    assert [c["name"] for c in top["children"]] == ["child-a", "child-b"]
+    assert top["children"][0]["children"][0]["name"] == "leaf"
+    # durations nest: the parent covers its children
+    assert top["duration_ms"] >= top["children"][0]["duration_ms"]
+
+
+def test_span_error_status_propagates_and_reraises():
+    tr = Tracer(registry=MetricsRegistry())
+    with pytest.raises(ValueError):
+        with tr.span("outer") as sp:
+            raise ValueError("boom")
+    t = tr.get_trace(sp.trace_id)
+    assert t["tree"][0]["status"] == "error"
+    assert "boom" in t["tree"][0]["attributes"]["error"]
+
+
+def test_thread_local_isolation():
+    """Concurrent threads must never cross-parent each other's spans."""
+    tr = Tracer(registry=MetricsRegistry())
+    ids = {}
+    barrier = threading.Barrier(2)
+
+    def work(tag):
+        with tr.span(f"root-{tag}") as root:
+            barrier.wait()  # both roots open simultaneously
+            with tr.span(f"inner-{tag}"):
+                time.sleep(0.01)
+            ids[tag] = root.trace_id
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ids["a"] != ids["b"]
+    for tag in ("a", "b"):
+        trace = tr.get_trace(ids[tag])
+        assert trace["span_count"] == 2
+        assert _all_names(trace) == [f"root-{tag}", f"inner-{tag}"]
+
+
+def test_explicit_propagation_use_and_add_span():
+    tr = Tracer(registry=MetricsRegistry())
+    ctx = _ctx()
+    with tr.use(ctx):
+        assert tr.current() == ctx
+        with tr.span("child"):
+            pass
+    assert tr.current() is None
+    tr.add_span("late", parent=ctx, start=1.0, end=2.5)
+    t = tr.get_trace(ctx.trace_id)
+    names = _all_names(t)
+    assert "child" in names and "late" in names
+    late = next(n for r in t["tree"] for n in [r] if n["name"] == "late")
+    assert late["duration_ms"] == pytest.approx(1500.0)
+
+
+def test_ring_buffer_eviction_and_span_cap_under_churn():
+    reg = MetricsRegistry()
+    tr = Tracer(max_traces=4, max_spans_per_trace=3, registry=reg)
+    for _ in range(10):
+        with tr.span("churn"):
+            pass
+    assert len(tr.traces(limit=100)) == 4
+    assert reg.counter("tracing_dropped_total", kind="trace") == 6
+    # Per-trace span cap: bounded, but a capped trace keeps its ORIGIN
+    # plus the most RECENT spans (drops the middle) — a lifecycle trace
+    # that requeues forever must not go dark after its first seconds.
+    ctx = _ctx()
+    for i in range(5):
+        tr.add_span(f"s{i}", parent=ctx)
+    t = tr.get_trace(ctx.trace_id)
+    assert t["span_count"] == 3
+    kept = {n["name"] for n in t["tree"]}
+    assert kept == {"s0", "s3", "s4"}  # origin + rolling tail
+    assert reg.counter("tracing_dropped_total", kind="span") == 2
+    assert reg.counter("tracing_spans_total") == 10 + 5
+
+
+# -- traceparent ------------------------------------------------------------
+
+def test_traceparent_round_trip():
+    ctx = _ctx()
+    assert parse_traceparent(format_traceparent(ctx)) == ctx
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-abc-def-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",   # forbidden version
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",   # non-hex
+])
+def test_traceparent_rejects_malformed(bad):
+    assert parse_traceparent(bad) is None
+
+
+# -- workqueue carry --------------------------------------------------------
+
+def test_workqueue_carries_trace_context():
+    q = RateLimitingQueue()
+    ctx = _ctx()
+    with global_tracer.use(ctx):
+        q.add(Request("default", "x"))
+    key = q.get(block=False)
+    assert key == Request("default", "x")
+    carried, t_enq = q.pop_trace(key)
+    assert carried == ctx and t_enq > 0
+    # collected once; done() leaves nothing stale behind
+    assert q.pop_trace(key) is None
+    q.done(key)
+    q.add(Request("default", "x"))  # untraced re-add
+    key = q.get(block=False)
+    assert q.pop_trace(key) is None
+
+
+# -- control plane end-to-end ----------------------------------------------
+
+@pytest.fixture
+def control_plane(tmp_path):
+    from k8s_gpu_tpu.platform.apiserver import PlatformApiServer
+    from k8s_gpu_tpu.platform.assets import AssetStore
+
+    kube = FakeKube()
+    cloud = FakeCloudTpu()
+    mgr = Manager(kube)
+    mgr.register(
+        "TpuPodSlice",
+        TpuPodSliceReconciler(kube, cloudtpu_client_factory(cloud)),
+    )
+    mgr.start()
+    api = PlatformApiServer(AssetStore(tmp_path), kube=kube).start()
+    obs = MetricsServer().start()
+    yield kube, mgr, api, obs
+    obs.stop()
+    api.stop()
+    mgr.stop()
+
+
+def _debug_traces(obs, **params):
+    from urllib.parse import urlencode
+
+    url = f"http://127.0.0.1:{obs.port}/debug/traces"
+    if params:
+        url += "?" + urlencode(params)
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())["traces"]
+
+
+def test_create_request_links_queue_reconcile_cloud_and_event(control_plane):
+    """The acceptance journey: ONE trace_id observably links the apiserver
+    create to its workqueue wait, reconcile passes, cloud-call child
+    spans, and the recorded Events — queried through /debug/traces."""
+    kube, mgr, api, obs = control_plane
+    ctx = _ctx()
+    manifest = {
+        "kind": "TpuPodSlice",
+        "metadata": {"name": "traced", "namespace": "default"},
+        "spec": {"acceleratorType": "v4-8", "sliceCount": 1},
+    }
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{api.port}/api/v1/objects",
+        data=json.dumps(manifest).encode(),
+        headers={"Content-Type": "application/json",
+                 "traceparent": format_traceparent(ctx)},
+    )
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 201
+        created = json.loads(r.read())
+    # the server continued OUR trace rather than minting its own
+    assert created["trace_id"] == ctx.trace_id
+
+    assert mgr.wait_idle(
+        timeout=30.0,
+        predicate=lambda: (
+            (ps := kube.try_get("TpuPodSlice", "traced")) is not None
+            and ps.status.phase == "Ready"
+        ),
+    )
+    # The http span closes AFTER the response bytes go out (same beat as
+    # the RequestMetricsMixin counter note) and the zero-delay fake can
+    # reach Ready first — poll briefly for the root to land.
+    deadline = time.monotonic() + 5.0
+    names, traces = [], []
+    while time.monotonic() < deadline:
+        traces = _debug_traces(obs, trace_id=ctx.trace_id)
+        names = _all_names(traces[0]) if traces else []
+        if any("http POST /api/v1/objects" in n for n in names):
+            break
+        time.sleep(0.02)
+    assert len(traces) == 1
+    assert any("http POST /api/v1/objects" in n for n in names), names
+    assert "queue.wait" in names
+    assert names.count("reconcile") >= 1
+    assert "cloud.create" in names
+
+    # cloud spans are CHILDREN of a reconcile span (tree, not a flat bag)
+    def find(node, name):
+        if node["name"] == name:
+            return node
+        for c in node.get("children", ()):
+            got = find(c, name)
+            if got:
+                return got
+        return None
+
+    rec = next(
+        (n for r in traces[0]["tree"] for n in [find(r, "reconcile")] if n),
+        None,
+    )
+    assert rec is not None and rec["attributes"]["kind"] == "TpuPodSlice"
+    assert any(
+        find(r, "cloud.create") for r in traces[0]["tree"]
+    )
+
+    # the recorded Events carry the same trace id
+    stamped = [
+        e for e in kube.list("Event")
+        if e.metadata.labels.get("trace-id") == ctx.trace_id
+    ]
+    assert stamped, "no Event stamped with the originating trace id"
+
+    # and the whole thing renders without blowing up
+    art = render_trace(traces[0])
+    assert "reconcile" in art and ctx.trace_id in art
+
+
+def test_untraced_create_roots_trace_at_first_reconcile(control_plane):
+    kube, mgr, api, obs = control_plane
+    ps = TpuPodSlice()
+    ps.metadata.name = "plain"
+    ps.spec.accelerator_type = "v4-8"
+    ps.spec.slice_count = 1
+    kube.create(ps)
+    assert mgr.wait_idle(
+        timeout=30.0,
+        predicate=lambda: (
+            (cur := kube.try_get("TpuPodSlice", "plain")) is not None
+            and cur.status.phase == "Ready"
+        ),
+    )
+    traces = _debug_traces(obs, name="cloud.create")
+    assert traces, "reconcile lifecycle did not assemble into a trace"
+    names = _all_names(traces[0])
+    assert "reconcile" in names and "cloud.create" in names
+
+
+def test_tracing_counters_registered(control_plane):
+    kube, mgr, api, obs = control_plane
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{api.port}/healthz"
+    ) as r:
+        assert r.status == 200
+    from k8s_gpu_tpu.utils.metrics import global_metrics
+
+    with global_tracer.span("probe"):
+        pass
+    assert global_metrics.counter("tracing_spans_total") >= 1
+    body = global_metrics.render()
+    assert "tracing_spans_total" in body
+
+
+# -- /debug/traces filtering ------------------------------------------------
+
+def test_debug_traces_filtering():
+    obs = MetricsServer().start()
+    try:
+        slow = _ctx()
+        global_tracer.add_span("slow.op", parent=slow, start=0.0, end=1.0)
+        fast = _ctx()
+        global_tracer.add_span("fast.op", parent=fast, start=0.0, end=0.001)
+
+        assert len(_debug_traces(obs)) == 2
+        only_slow = _debug_traces(obs, min_ms=500)
+        assert [t["trace_id"] for t in only_slow] == [slow.trace_id]
+        by_name = _debug_traces(obs, name="fast")
+        assert [t["trace_id"] for t in by_name] == [fast.trace_id]
+        by_id = _debug_traces(obs, trace_id=slow.trace_id)
+        assert len(by_id) == 1 and by_id[0]["span_count"] == 1
+        assert _debug_traces(obs, name="nomatch") == []
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{obs.port}/debug/traces?min_ms=banana"
+            )
+    finally:
+        obs.stop()
+
+
+# -- serving plane ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm_server():
+    import jax
+
+    from k8s_gpu_tpu.data import BpeTokenizer
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+    from k8s_gpu_tpu.serve import LmServer
+
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 40
+    tok = BpeTokenizer.train(corpus, vocab_size=300)
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=32, n_layers=1, n_heads=2,
+        d_head=16, d_ff=64, max_seq=64, use_flash=False,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    srv = LmServer(model, params, tok).start()
+    yield srv
+    srv.stop()
+
+
+def test_serve_request_trace_has_admission_wait_and_rounds(lm_server):
+    """Acceptance: one serve request's trace shows admission wait plus
+    ≥1 batcher-round span, queried via /debug/traces."""
+    obs = MetricsServer().start()
+    try:
+        ctx = _ctx()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{lm_server.port}/generate",
+            data=json.dumps(
+                {"prompt": "the cat", "max_new_tokens": 24}
+            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": format_traceparent(ctx)},
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["generated_tokens"] >= 1
+        assert out["trace_id"] == ctx.trace_id
+
+        # Round spans land when the scheduler processes results — give
+        # the pipeline a beat to drain after the response returned.
+        deadline = time.monotonic() + 5.0
+        names = []
+        while time.monotonic() < deadline:
+            traces = _debug_traces(obs, trace_id=ctx.trace_id)
+            names = _all_names(traces[0]) if traces else []
+            if "serve.round" in names and "serve.queue_wait" in names:
+                break
+            time.sleep(0.05)
+        assert "serve.queue_wait" in names, names
+        assert "serve.prefill" in names, names
+        assert names.count("serve.round") >= 1, names
+        # round spans carry token counts; their sum covers the stream
+        # minus the first (prefill-emitted) token
+        traces = _debug_traces(obs, trace_id=ctx.trace_id)
+        rounds = [
+            n for r in traces[0]["tree"] for n in _flatten(r)
+            if n["name"] == "serve.round"
+        ]
+        assert sum(n["attributes"]["tokens"] for n in rounds) >= (
+            out["generated_tokens"] - 1
+        )
+    finally:
+        obs.stop()
+
+
+def _flatten(node):
+    yield node
+    for c in node.get("children", ()):
+        yield from _flatten(c)
+
+
+def test_untraced_serve_request_records_no_request_spans(lm_server):
+    """No traceparent, no server span context leak: direct batcher
+    submits stay span-free (the bench/hot-path zero-overhead contract)."""
+    import numpy as np
+
+    global_tracer.clear()
+    h = lm_server.batcher.submit(
+        np.asarray([1, 2, 3], np.int32), max_new_tokens=4
+    )
+    h.result()
+    assert all(
+        "serve." not in n
+        for t in global_tracer.traces(limit=100)
+        for n in _all_names(t)
+    )

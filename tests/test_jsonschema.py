@@ -157,6 +157,39 @@ def test_negated_class_negation_caret_allowed():
         schema_to_regex({"type": "string", "pattern": "a^b"})
 
 
+def test_negated_class_trailing_dash_cannot_leak_quote():
+    """Regression (ADVICE medium): a trailing literal '-' in a negated
+    class used to sit raw against the appended quote/backslash exclusions
+    and form a `-"` range — `[^a-]*` compiled to a body that could emit a
+    raw quote into constrained JSON output.  The dash must be escaped."""
+    from k8s_gpu_tpu.serve.jsonschema import _pattern_to_string_body
+
+    body = _pattern_to_string_body("[^a-]*")
+    assert re.fullmatch(body, "xyz")
+    assert not re.fullmatch(body, 'x"y'), "negated class leaked a raw quote"
+    assert not re.fullmatch(body, "a")      # the named member still excluded
+    assert not re.fullmatch(body, "-")      # the dash member still excluded
+    # same hazard through the schema surface, and '"' must stay framed
+    r = schema_to_regex({"type": "string", "pattern": "[^a-]*"})
+    assert re.fullmatch(r, '"xyz"')
+    assert not re.fullmatch(r, '"x"y"')
+    # a dash member in a POSITIVE class keeps matching
+    body = _pattern_to_string_body("[a-]+")
+    assert re.fullmatch(body, "a-a-")
+    assert not re.fullmatch(body, "b")
+    # and the compiled DFA agrees (constrain.py resolves the \- escape)
+    import numpy as np
+
+    dfa = compile_constraint(
+        _pattern_to_string_body("[^a-]*"), ["x", '"', "a", "-"]
+    )
+    allowed = np.asarray(dfa.allowed)[dfa.start]
+    assert allowed[0]          # 'x' fine
+    assert not allowed[1]      # '"' excluded by the negated-class rewrite
+    assert not allowed[2]      # 'a' excluded by the author pattern
+    assert not allowed[3]      # '-' excluded by the author pattern
+
+
 def test_nullable_honored_at_every_level():
     # nullable is allowlisted everywhere, so it must WORK everywhere —
     # array items and top level, not just object properties.

@@ -119,6 +119,34 @@ def test_allocator_backpressure_and_reclaim():
     assert (b._pages == 0).all()
 
 
+def test_block_pressure_deferrals_stay_fifo():
+    """Regression (ADVICE): the block-pressure retry path must not rotate
+    the deferred queue — a popped-and-refused request goes back to the
+    FRONT (appendleft), so deferrals admit in submission order even while
+    the allocator repeatedly refuses the head."""
+    # 12 blocks of 16 (11 usable).  The holder takes 6
+    # (ceil((64+30)/16)), leaving 5 — the two big deferrals need 6 each,
+    # so both sit in _overflow through many scheduler passes (each pass
+    # pops the head, fails, re-queues: the rotation site) until the
+    # holder retires, then must admit b2 BEFORE b3.
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=4, paged_blocks=12, page_size=16
+    ).start()
+    try:
+        holder = b.submit(list(range(2, 42)), max_new_tokens=30)
+        big2 = b.submit(list(range(3, 43)), max_new_tokens=30)
+        big3 = b.submit(list(range(4, 44)), max_new_tokens=30)
+        outs = [h.result() for h in (holder, big2, big3)]
+        assert all(len(o) == 30 for o in outs)
+        assert not any(h.aborted for h in (holder, big2, big3))
+        # admission order == submission order (t_admit is stamped once,
+        # at the admit dispatch)
+        assert holder._req.t_admit < big2._req.t_admit < big3._req.t_admit
+    finally:
+        b.stop()
+    assert sorted(b._free_blocks) == list(range(1, 12))
+
+
 def test_pool_floor_guarantees_progress():
     """paged_blocks must cover trash + one max-length request — below
     that, a long request could deadlock the allocator, so the

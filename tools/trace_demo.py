@@ -1,0 +1,148 @@
+"""End-to-end trace smoke (``make trace-demo``): boot the fake control
+plane, create a TpuPodSlice THROUGH the platform API with a caller-supplied
+``traceparent``, drive it to Ready, and assert the whole journey assembled
+as one trace behind ``/debug/traces``:
+
+    http POST /api/v1/objects → queue.wait → reconcile → cloud.create →
+    … → reconcile (Ready), plus the Events stamped with the trace id.
+
+Exits non-zero if any link is missing, and prints the rendered flame tree
+on success — the captured example docs/platform/observability.md shows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from k8s_gpu_tpu.cloud import FakeCloudTpu, cloudtpu_client_factory  # noqa: E402
+from k8s_gpu_tpu.controller import FakeKube, Manager  # noqa: E402
+from k8s_gpu_tpu.operators import TpuPodSliceReconciler  # noqa: E402
+from k8s_gpu_tpu.platform.apiserver import PlatformApiServer  # noqa: E402
+from k8s_gpu_tpu.platform.assets import AssetStore  # noqa: E402
+from k8s_gpu_tpu.utils import MetricsServer  # noqa: E402
+from k8s_gpu_tpu.utils.tracing import (  # noqa: E402
+    SpanContext,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    render_trace,
+)
+
+
+def main() -> int:
+    kube = FakeKube()
+    cloud = FakeCloudTpu()
+    mgr = Manager(kube)
+    mgr.register(
+        "TpuPodSlice", TpuPodSliceReconciler(kube, cloudtpu_client_factory(cloud))
+    )
+    mgr.start()
+    tmp = tempfile.mkdtemp(prefix="trace-demo-assets-")
+    api = PlatformApiServer(AssetStore(tmp), kube=kube).start()
+    obs = MetricsServer().start()
+    try:
+        # The client's own trace context — everything downstream must
+        # link to THIS id, not mint new ones.
+        ctx = SpanContext(new_trace_id(), new_span_id())
+        manifest = {
+            "apiVersion": "tpu.k8sgpu.dev/v1",
+            "kind": "TpuPodSlice",
+            "metadata": {"name": "demo", "namespace": "default"},
+            "spec": {"acceleratorType": "v4-8", "sliceCount": 1},
+        }
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{api.port}/api/v1/objects",
+            data=json.dumps(manifest).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "traceparent": format_traceparent(ctx),
+            },
+        )
+        with urllib.request.urlopen(req) as r:
+            created = json.loads(r.read())
+        assert created["trace_id"] == ctx.trace_id, created
+
+        ok = mgr.wait_idle(
+            timeout=30.0,
+            predicate=lambda: (
+                (ps := kube.try_get("TpuPodSlice", "demo")) is not None
+                and ps.status.phase == "Ready"
+            ),
+        )
+        if not ok:
+            print("FAIL: TpuPodSlice never reached Ready", file=sys.stderr)
+            return 1
+
+        def assembled():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{obs.port}/debug/traces"
+                f"?trace_id={ctx.trace_id}"
+            ) as r:
+                got = json.loads(r.read())["traces"]
+            return got[0] if got else None
+
+        def span_names(t):
+            names: list[str] = []
+
+            def walk(node):
+                names.append(node["name"])
+                for c in node.get("children", ()):
+                    walk(c)
+
+            for root in t["tree"]:
+                walk(root)
+            return names
+
+        # The http root span lands only when the handler thread closes it
+        # — AFTER the response bytes went out (the RequestMetricsMixin
+        # ordering note) — and the zero-delay fake reaches Ready first,
+        # so poll briefly for the fully-assembled trace.
+        deadline = time.monotonic() + 5.0
+        trace, names = None, []
+        while time.monotonic() < deadline:
+            trace = assembled()
+            names = span_names(trace) if trace else []
+            if any("http POST /api/v1/objects" in n for n in names):
+                break
+            time.sleep(0.02)
+        if trace is None:
+            print("FAIL: /debug/traces returned no assembled trace",
+                  file=sys.stderr)
+            return 1
+        missing = [
+            want for want in
+            ("http POST /api/v1/objects", "queue.wait", "reconcile",
+             "cloud.create")
+            if not any(want in n for n in names)
+        ]
+        if missing:
+            print(f"FAIL: trace is missing spans {missing}; got {names}",
+                  file=sys.stderr)
+            return 1
+        events = [
+            e for e in kube.list("Event")
+            if e.metadata.labels.get("trace-id") == ctx.trace_id
+        ]
+        if not events:
+            print("FAIL: no Event stamped with the trace id", file=sys.stderr)
+            return 1
+
+        print(render_trace(trace))
+        print(f"\nOK: {trace['span_count']} spans, "
+              f"{len(events)} events linked to trace {ctx.trace_id}")
+        return 0
+    finally:
+        obs.stop()
+        api.stop()
+        mgr.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
