@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: docker-build docker-push deploy undeploy test trace-demo
+.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo
 
 docker-build:
 	@for img in $(IMAGES); do \
@@ -55,3 +55,10 @@ test-single:
 # Prints the rendered flame tree; non-zero exit if any link is missing.
 trace-demo:
 	python tools/trace_demo.py
+
+# Chaos smoke: seeded 30% fault schedule against the fake Cloud TPU API,
+# reconcile-to-convergence behind retries + circuit breakers, then print
+# the retry/breaker/shed counters.  Non-zero exit if convergence or any
+# invariant (zero leaked resources, faults actually fired) fails.
+chaos-demo:
+	python tools/chaos_demo.py

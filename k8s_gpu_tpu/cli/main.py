@@ -791,6 +791,29 @@ def cmd_obs(args) -> int:
             return 1
         print(prom.read_text(), end="")
         return 0
+    if args.obs_cmd == "resilience":
+        # The resilience slice of the exposition: retries, breaker
+        # state/transitions, load sheds, injected faults — the counters
+        # docs/platform/resilience.md defines.
+        prom = state_dir() / "metrics.prom"
+        if not prom.exists():
+            print("no metrics snapshot yet", file=sys.stderr)
+            return 1
+        families = (
+            "faults_injected_total", "circuit_breaker_",
+            "cloud_retry_attempts_total",
+            "cloud_breaker_short_circuits_total", "serve_shed_total",
+        )
+        lines = [
+            ln for ln in prom.read_text().splitlines()
+            if ln.startswith(families)
+        ]
+        if not lines:
+            print("no resilience metrics recorded (no retries, sheds, "
+                  "or injected faults in the last run)")
+            return 0
+        print("\n".join(lines))
+        return 0
     if args.obs_cmd == "traces":
         from ..utils.tracing import global_tracer, render_trace
 
@@ -1148,6 +1171,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_ol.add_argument("-l", "--selector", action="append",
                       help="label filter key=value (repeatable)")
     obs_sub.add_parser("metrics")
+    obs_sub.add_parser(
+        "resilience",
+        help="retry/breaker/shed/fault-injection counters from the last "
+             "metrics snapshot",
+    )
     p_ot = obs_sub.add_parser(
         "traces", help="render recorded spans as flame-style trees"
     )

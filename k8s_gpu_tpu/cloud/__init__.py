@@ -1,7 +1,15 @@
 from .base import (
     CloudError,
     AuthError,
+    CircuitOpenError,
     CloudPoolBackend,
+)
+from .resilience import (
+    BreakerBank,
+    CircuitBreaker,
+    ResilientBackend,
+    RetryPolicy,
+    resilient_factory,
 )
 from .topology import TpuTopology, parse_accelerator_type, default_topology
 from .types import QueuedResource, SliceInventory, TpuHost
@@ -10,13 +18,21 @@ from .fake_cloudtpu import FakeCloudTpu, cloudtpu_client_factory
 from .cloudtpu import (
     CloudTpuClient,
     MetadataIdentity,
+    make_urllib_transport,
     real_cloudtpu_client_factory,
 )
 
 __all__ = [
     "CloudError",
     "AuthError",
+    "CircuitOpenError",
     "CloudPoolBackend",
+    "BreakerBank",
+    "CircuitBreaker",
+    "ResilientBackend",
+    "RetryPolicy",
+    "resilient_factory",
+    "make_urllib_transport",
     "TpuTopology",
     "parse_accelerator_type",
     "default_topology",

@@ -21,6 +21,14 @@ class AuthError(CloudError):
     """Credential exchange failed (bad/missing secret or identity)."""
 
 
+class CircuitOpenError(CloudError):
+    """An open circuit breaker short-circuited the call before it left the
+    process (cloud/resilience.py).  Still a CloudError — the reconcile
+    ladder's RequeueAfter handling applies unchanged — but reconcilers
+    that distinguish it requeue FAST (the breaker's half-open probe, not
+    the full error rung, decides when the endpoint is worth trying)."""
+
+
 @runtime_checkable
 class CloudPoolBackend(Protocol):
     """list-by-tag / create / delete / readiness — the four verbs the

@@ -36,12 +36,19 @@ import urllib.request
 from typing import Callable
 
 from . import wire
+from ..utils.clock import Clock, RealClock
+from ..utils.faults import global_faults
+from ..utils.metrics import global_metrics
 from ..utils.tracing import global_tracer
 from .base import AuthError, CloudError
+from .resilience import RetryPolicy
 from .types import QueuedResource
 
-# (method, url, headers, body) -> (status_code, response_bytes)
-Transport = Callable[[str, str, dict, bytes | None], tuple[int, bytes]]
+# (method, url, headers, body) -> (status_code, response_bytes) or
+# (status_code, response_bytes, response_headers) — the 3-tuple form lets
+# the retry layer honor Retry-After; 2-tuple transports (older tests and
+# fakes) keep working through _tx_result's normalization.
+Transport = Callable[[str, str, dict, bytes | None], tuple]
 
 TPU_ENDPOINT = "https://tpu.googleapis.com/v2"
 METADATA_TOKEN_URL = (
@@ -49,19 +56,73 @@ METADATA_TOKEN_URL = (
     "service-accounts/default/token"
 )
 
+CONNECT_TIMEOUT = 10.0
+READ_TIMEOUT = 30.0
+# Ceiling on an honored Retry-After: the server's hint is advice, not a
+# license to wedge a reconcile worker — a hostile/buggy "Retry-After:
+# 86400" must not outsleep the requeue ladder.
+RETRY_AFTER_CAP = 30.0
 
-def urllib_transport(method: str, url: str, headers: dict,
-                     body: bytes | None) -> tuple[int, bytes]:
-    """Production transport; HTTPError is a response, URLError is not."""
-    req = urllib.request.Request(url, data=body, headers=headers,
-                                 method=method)
-    try:
-        with urllib.request.urlopen(req, timeout=30) as r:
-            return r.status, r.read()
-    except urllib.error.HTTPError as e:
-        return e.code, e.read()
-    except urllib.error.URLError as e:
-        raise CloudError(f"transport error for {method} {url}: {e}") from e
+
+def _tx_result(res) -> tuple[int, bytes, dict]:
+    """Normalize a transport's return: (status, body) or
+    (status, body, headers) → (status, body, lowercase-keyed headers)."""
+    if len(res) == 2:
+        status, raw = res
+        return int(status), raw, {}
+    status, raw, hdrs = res
+    return int(status), raw, {
+        str(k).lower(): v for k, v in dict(hdrs).items()
+    }
+
+
+def make_urllib_transport(
+    connect_timeout: float = CONNECT_TIMEOUT,
+    read_timeout: float = READ_TIMEOUT,
+) -> Transport:
+    """Production transport with a socket timeout — urllib applies ONE
+    timeout to every blocking socket op (the connect and each read), so
+    the effective per-op bound is max(connect, read); the two knobs exist
+    so call sites can state intent.  A hung transport now surfaces as a
+    CloudError within the bound instead of blocking a reconcile worker
+    forever (the pre-timeout failure mode: one dead API conversation
+    wedged a whole controller).  HTTPError is a response, URLError and
+    timeouts are not."""
+    timeout = max(connect_timeout, read_timeout)
+
+    def transport(method: str, url: str, headers: dict,
+                  body: bytes | None) -> tuple[int, bytes, dict]:
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, r.read(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers or {})
+        except TimeoutError as e:
+            raise CloudError(
+                f"transport timeout after {timeout:g}s for {method} {url}"
+            ) from e
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None), TimeoutError):
+                raise CloudError(
+                    f"transport timeout after {timeout:g}s for "
+                    f"{method} {url}"
+                ) from e
+            raise CloudError(
+                f"transport error for {method} {url}: {e}"
+            ) from e
+        except OSError as e:
+            # Residual socket errors (reset mid-read, DNS): transport,
+            # not response — the reconciler's RequeueAfter rung.
+            raise CloudError(
+                f"transport error for {method} {url}: {e}"
+            ) from e
+
+    return transport
+
+
+urllib_transport = make_urllib_transport()
 
 
 class MetadataIdentity:
@@ -84,9 +145,9 @@ class MetadataIdentity:
         with self._lock:
             if self._token and time.time() < self._expiry - 60:
                 return self._token
-            status, body = self._transport(
+            status, body, _ = _tx_result(self._transport(
                 "GET", self._token_url, {"Metadata-Flavor": "Google"}, None
-            )
+            ))
             if status != 200:
                 raise AuthError(
                     f"workload-identity token exchange failed: HTTP {status}"
@@ -110,7 +171,14 @@ class CloudTpuClient:
         identity: MetadataIdentity,
         transport: Transport | None = None,
         endpoint: str = TPU_ENDPOINT,
+        retry: RetryPolicy | None = None,
+        clock: Clock | None = None,
     ):
+        """``retry`` arms HTTP-level retries in ``_call``: 429/5xx and
+        transport CloudErrors are retryable (with a Retry-After response
+        header honored as a delay floor); 401/403 → AuthError and other
+        4xx are permanent.  ``None`` (the default) keeps the single-shot
+        behavior — ``real_cloudtpu_client_factory`` opts production in."""
         if not project or not zone:
             raise CloudError("project and zone are required")
         self.project = project
@@ -118,6 +186,8 @@ class CloudTpuClient:
         self.identity = identity
         self._transport = transport or urllib_transport
         self._endpoint = endpoint.rstrip("/")
+        self._retry = retry
+        self._clock = clock or RealClock()
 
     # -- REST plumbing -----------------------------------------------------
     def _call(self, method: str, path: str, params: dict | None = None,
@@ -133,11 +203,43 @@ class CloudTpuClient:
             "Content-Type": "application/json",
         })
         body = json.dumps(payload).encode() if payload is not None else None
-        with global_tracer.span(
-            "tpu.rest", method=method, path=path,
-        ) as sp:
-            status, raw = self._transport(method, url, headers, body)
-            sp.attributes["status"] = status
+        attempt = 1
+        while True:
+            try:
+                # The injection site sits where a real transport fault
+                # would: inside the retry loop, so flaky-N-then-succeed
+                # plans heal across attempts.
+                global_faults.fire(
+                    "cloudtpu.rest", error_type=CloudError,
+                    clock=self._clock,
+                )
+                with global_tracer.span(
+                    "tpu.rest", method=method, path=path, attempt=attempt,
+                ) as sp:
+                    status, raw, rhdrs = _tx_result(
+                        self._transport(method, url, headers, body)
+                    )
+                    sp.attributes["status"] = status
+            except AuthError:
+                raise
+            except CloudError:
+                if (
+                    self._retry is None
+                    or attempt >= self._retry.max_attempts
+                ):
+                    raise
+                self._sleep_before_retry(attempt, path, {})
+                attempt += 1
+                continue
+            if (
+                (status == 429 or status >= 500)
+                and self._retry is not None
+                and attempt < self._retry.max_attempts
+            ):
+                self._sleep_before_retry(attempt, path, rhdrs)
+                attempt += 1
+                continue
+            break
         try:
             obj = json.loads(raw) if raw else {}
         except ValueError:
@@ -145,6 +247,21 @@ class CloudTpuClient:
         if status in (401, 403):
             raise AuthError(wire.parse_error(status, obj))
         return status, obj
+
+    def _sleep_before_retry(self, attempt: int, path: str,
+                            rhdrs: dict) -> None:
+        """Backoff between ``_call`` attempts; a server-sent Retry-After
+        (seconds) is honored as a floor over the policy's delay, capped
+        at RETRY_AFTER_CAP."""
+        delay = self._retry.delay(attempt, key=path)
+        ra = rhdrs.get("retry-after")
+        if ra is not None:
+            try:
+                delay = max(delay, min(float(ra), RETRY_AFTER_CAP))
+            except (TypeError, ValueError):
+                pass
+        global_metrics.inc("cloud_retry_attempts_total", endpoint="tpu.rest")
+        self._clock.sleep(delay)
 
     def _raise_for(self, status: int, obj: dict, what: str) -> None:
         raise CloudError(f"{what}: {wire.parse_error(status, obj)}")
@@ -241,10 +358,15 @@ def real_cloudtpu_client_factory(
     zone: str,
     transport: Transport | None = None,
     token_transport: Transport | None = None,
+    retry: RetryPolicy | None = RetryPolicy(),
+    clock: Clock | None = None,
 ):
     """The reconciler-facing factory seam, mirroring
     ``cloudtpu_client_factory(fake)``: factory(identity) → client.  Swap
-    one line in the operator wiring to move fake → real."""
+    one line in the operator wiring to move fake → real.  Production
+    clients retry 429/5xx/transport faults by default (pass
+    ``retry=None`` for single-shot); compose with
+    ``resilience.resilient_factory`` for breakers on top."""
 
     def factory(identity: str) -> CloudTpuClient:
         return CloudTpuClient(
@@ -252,6 +374,8 @@ def real_cloudtpu_client_factory(
             zone,
             MetadataIdentity(identity, transport=token_transport),
             transport=transport,
+            retry=retry,
+            clock=clock,
         )
 
     return factory

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 
 from .base import AuthError, CloudError
 from ..utils.clock import Clock, RealClock
+from ..utils.faults import FaultInjector, global_faults
 
 VALID_CRED_KEYS = (
     "AZURE_CLIENT_ID",
@@ -36,8 +37,10 @@ class FakeVm:
 
 
 @dataclass
-class FaultPlan:
-    """Scripted failures: consume-on-use counters per verb."""
+class ScriptedFaultPlan:
+    """Scripted failures: consume-on-use counters per verb.  (Named like
+    fake_cloudtpu's TpuFaultPlan; the seeded-schedule harness is
+    utils.faults.FaultPlan — a different, orthogonal layer.)"""
 
     fail_creates: int = 0
     fail_deletes: int = 0
@@ -48,13 +51,21 @@ class FaultPlan:
 class FakeAzureCloud:
     """The cloud side: shared inventory of VMs/NICs/disks."""
 
-    def __init__(self, clock: Clock | None = None, provisioning_delay: float = 0.0):
+    def __init__(
+        self,
+        clock: Clock | None = None,
+        provisioning_delay: float = 0.0,
+        injector: FaultInjector | None = None,
+    ):
         self.clock = clock or RealClock()
         self.provisioning_delay = provisioning_delay
         self.vms: dict[str, FakeVm] = {}
         self.nics: dict[str, str] = {}
         self.disks: dict[str, str] = {}
-        self.faults = FaultPlan()
+        self.faults = ScriptedFaultPlan()
+        # Seeded chaos sites (utils/faults.py), orthogonal to the scripted
+        # ScriptedFaultPlan counters above.
+        self.injector = injector or global_faults
         self.api_calls: list[str] = []
         self._lock = threading.RLock()
 
@@ -74,6 +85,9 @@ class FakeAzureCloud:
             if self.faults.fail_lists > 0:
                 self.faults.fail_lists -= 1
                 raise CloudError("injected: list VMs failed")
+            self.injector.fire(
+                "azure.list", error_type=CloudError, clock=self.clock
+            )
             self._settle()
             return [
                 FakeVm(**vars(vm))
@@ -87,6 +101,9 @@ class FakeAzureCloud:
             if self.faults.fail_creates > 0:
                 self.faults.fail_creates -= 1
                 raise CloudError("injected: create VM failed")
+            self.injector.fire(
+                "azure.create", error_type=CloudError, clock=self.clock
+            )
             if name in self.vms:  # idempotency (reference README.md:240)
                 return self.vms[name]
             vm = FakeVm(
@@ -111,6 +128,9 @@ class FakeAzureCloud:
             if self.faults.fail_deletes > 0:
                 self.faults.fail_deletes -= 1
                 raise CloudError("injected: delete VM failed")
+            self.injector.fire(
+                "azure.delete", error_type=CloudError, clock=self.clock
+            )
             vm = self.vms.pop(name, None)
             if vm is None:
                 return  # idempotent

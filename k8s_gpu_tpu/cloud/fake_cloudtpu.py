@@ -25,6 +25,7 @@ from .base import AuthError, CloudError
 from .topology import TpuTopology, parse_accelerator_type
 from .types import QueuedResource, SliceInventory, TpuHost
 from ..utils.clock import Clock, RealClock
+from ..utils.faults import FaultInjector, global_faults
 
 # State-machine ordering (index = progress).
 _LADDER = ["ACCEPTED", "WAITING_FOR_RESOURCES", "PROVISIONING", "ACTIVE"]
@@ -55,12 +56,17 @@ class FakeCloudTpu:
         clock: Clock | None = None,
         accepted_delay: float = 0.0,
         provisioning_delay: float = 0.0,
+        injector: FaultInjector | None = None,
     ):
         self.clock = clock or RealClock()
         self.accepted_delay = accepted_delay
         self.provisioning_delay = provisioning_delay
         self.queued_resources: dict[str, QueuedResource] = {}
         self.faults = TpuFaultPlan()
+        # Seeded fault-plan sites (utils/faults.py) — orthogonal to the
+        # scripted TpuFaultPlan counters above: counters say "the Nth
+        # call fails", armed sites replay a whole seeded chaos schedule.
+        self.injector = injector or global_faults
         self.api_calls: list[str] = []
         self._lock = threading.RLock()
 
@@ -127,6 +133,9 @@ class FakeCloudTpu:
             if self.faults.fail_creates > 0:
                 self.faults.fail_creates -= 1
                 raise CloudError("injected: queuedResources.create failed")
+            self.injector.fire(
+                "cloudtpu.create", error_type=CloudError, clock=self.clock
+            )
             if name in self.queued_resources:  # idempotent
                 return self.queued_resources[name]
             # Round-trip through the REAL wire schema (cloud/wire.py): the
@@ -163,6 +172,9 @@ class FakeCloudTpu:
             if self.faults.fail_lists > 0:
                 self.faults.fail_lists -= 1
                 raise CloudError("injected: queuedResources.list failed")
+            self.injector.fire(
+                "cloudtpu.list", error_type=CloudError, clock=self.clock
+            )
             self._settle()
             import copy
 
@@ -178,6 +190,9 @@ class FakeCloudTpu:
             if self.faults.fail_deletes > 0:
                 self.faults.fail_deletes -= 1
                 raise CloudError("injected: queuedResources.delete failed")
+            self.injector.fire(
+                "cloudtpu.delete", error_type=CloudError, clock=self.clock
+            )
             self.queued_resources.pop(name, None)  # idempotent
 
     # -- fault injection helpers ------------------------------------------

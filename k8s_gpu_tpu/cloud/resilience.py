@@ -1,0 +1,330 @@
+"""Resilience layer for the cloud plane: retry policy + circuit breakers.
+
+The reference's retry ladder (README.md:184-240) lives in the
+*reconcilers* — a failed pass maps to a RequeueAfter rung.  That is the
+outer loop; this module adds the two inner layers the contract assumes
+but the reference leaves implicit:
+
+- **RetryPolicy** — capped exponential backoff with *deterministic*
+  jitter (a seeded hash of (endpoint, attempt), so a chaos replay sleeps
+  the same schedule every run) and a retry *budget*: the total retries
+  one backend instance may spend across all its calls.  Reconcilers
+  construct a client per pass through the factory seam, so a fresh
+  ``ResilientBackend`` per ``factory()`` call makes the budget naturally
+  per-reconcile-pass — a flaky pass retries a few times then yields the
+  worker back to the queue instead of monopolizing it.
+- **CircuitBreaker** — per-endpoint closed/open/half-open, driven by the
+  Clock abstraction.  While open, calls short-circuit to
+  ``CircuitOpenError`` (a CloudError: the reconciler requeues instead of
+  hammering a dead API); after ``reset_timeout`` one half-open probe is
+  admitted, and its outcome re-closes or re-opens.  State is exported as
+  the ``circuit_breaker_state`` gauge (0 closed / 1 half-open / 2 open)
+  and stamped on every ``cloud.attempt`` span.
+- **ResilientBackend** — a CloudPoolBackend decorator composing both
+  around ANY backend (FakeAzure, FakeCloudTpu, the real CloudTpuClient),
+  so the chaos suite proves the policy on the fakes and production gets
+  the identical code.  ``AuthError`` is permanent (never retried, never
+  breaker-counted — it is a credential problem, not endpoint health);
+  every other CloudError is retryable.
+
+``resilient_factory`` wraps an existing client factory in one line —
+the same seam swap that moves fake → real cloud.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from dataclasses import dataclass
+
+from .base import AuthError, CircuitOpenError, CloudError
+from ..utils.clock import Clock, RealClock
+from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.tracing import global_tracer
+
+_STATE_VALUE = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+# An open breaker never reached the API — reconcilers requeue fast and
+# let the half-open probe decide, instead of waiting out a full error
+# rung (the reference's 20-40 s cadences assume the API was actually hit).
+BREAKER_RETRY = 5.0
+
+
+def requeue_delay(e: CloudError, default: float) -> float:
+    """The reconcilers' retry-ladder hook: the error rung for real cloud
+    failures, ``BREAKER_RETRY`` for short-circuited ones."""
+    return BREAKER_RETRY if isinstance(e, CircuitOpenError) else default
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """``max_attempts`` bounds attempts per call; ``budget`` bounds total
+    retries per backend instance (= per reconcile pass through the
+    factory seam).  Delays are ``base_delay * 2^attempt`` capped at
+    ``max_delay``, scaled down by up to ``jitter`` via a PRNG seeded from
+    (key, attempt) — full-jitter's thundering-herd spread, bit-for-bit
+    reproducible."""
+
+    max_attempts: int = 3
+    budget: int = 8
+    base_delay: float = 0.1
+    max_delay: float = 5.0
+    jitter: float = 0.5
+
+    def delay(self, attempt: int, key: str = "") -> float:
+        d = min(self.max_delay, self.base_delay * (2 ** max(0, attempt - 1)))
+        if self.jitter <= 0.0:
+            return d
+        u = random.Random(f"{key}:{attempt}").random()
+        return d * (1.0 - self.jitter * u)
+
+
+class CircuitBreaker:
+    """closed → (``failure_threshold`` consecutive failures) → open →
+    (``reset_timeout`` clock-seconds) → half-open probe → closed on
+    success, straight back to open on failure."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        clock: Clock | None = None,
+        failure_threshold: int = 5,
+        reset_timeout: float = 30.0,
+        registry: MetricsRegistry | None = None,
+    ):
+        self.endpoint = endpoint
+        self.clock = clock or RealClock()
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_timeout = reset_timeout
+        self.registry = registry or global_metrics
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self.registry.set_gauge(
+            "circuit_breaker_state", 0.0, endpoint=endpoint
+        )
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _set(self, state: str) -> None:
+        # lock held by caller
+        if state == self._state:
+            return
+        self._state = state
+        self.registry.set_gauge(
+            "circuit_breaker_state", _STATE_VALUE[state],
+            endpoint=self.endpoint,
+        )
+        self.registry.inc(
+            "circuit_breaker_transitions_total",
+            endpoint=self.endpoint, to=state,
+        )
+
+    def allow(self) -> bool:
+        """May a call go out now?  Open → False until ``reset_timeout``
+        elapses, then half-open admits exactly ONE in-flight probe."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if self.clock.now() - self._opened_at < self.reset_timeout:
+                    return False
+                self._set("half_open")
+                self._probing = True
+                return True
+            # half-open: one probe at a time
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def release(self) -> None:
+        """Release a probe claim WITHOUT judging the endpoint — for
+        outcomes that say nothing about its health (auth failures,
+        unexpected exceptions).  Half-open goes back to waiting for a
+        probe instead of wedging with a claim nobody will return."""
+        with self._lock:
+            self._probing = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            self._set("closed")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probing = False
+            if self._state == "half_open":
+                self._opened_at = self.clock.now()
+                self._set("open")
+                return
+            self._failures += 1
+            if (
+                self._state == "closed"
+                and self._failures >= self.failure_threshold
+            ):
+                self._opened_at = self.clock.now()
+                self._set("open")
+
+
+class BreakerBank:
+    """Per-endpoint breakers, SHARED across backend instances — the
+    factory creates a fresh ResilientBackend per reconcile pass, but the
+    breaker memory must persist across passes or it could never open."""
+
+    def __init__(
+        self,
+        clock: Clock | None = None,
+        failure_threshold: int = 5,
+        reset_timeout: float = 30.0,
+        name: str = "cloud",
+        registry: MetricsRegistry | None = None,
+    ):
+        self.clock = clock or RealClock()
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.name = name
+        self.registry = registry or global_metrics
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def get(self, endpoint: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(endpoint)
+            if br is None:
+                br = CircuitBreaker(
+                    f"{self.name}.{endpoint}",
+                    clock=self.clock,
+                    failure_threshold=self.failure_threshold,
+                    reset_timeout=self.reset_timeout,
+                    registry=self.registry,
+                )
+                self._breakers[endpoint] = br
+            return br
+
+    def states(self) -> dict:
+        with self._lock:
+            return {ep: br.state for ep, br in self._breakers.items()}
+
+
+class ResilientBackend:
+    """CloudPoolBackend decorator: breaker gate + bounded retry around
+    every verb of *inner*.  ``is_ready`` passes through (pure local
+    predicate, no cloud call)."""
+
+    def __init__(
+        self,
+        inner,
+        breakers: BreakerBank,
+        policy: RetryPolicy | None = None,
+        clock: Clock | None = None,
+        registry: MetricsRegistry | None = None,
+    ):
+        self.inner = inner
+        self.breakers = breakers
+        self.policy = policy or RetryPolicy()
+        self.clock = clock or breakers.clock
+        self.registry = registry or global_metrics
+        self._budget = self.policy.budget
+
+    # -- CloudPoolBackend verbs -------------------------------------------
+    def list_resources(self, tags: dict) -> list:
+        return self._guard("list", lambda c: c.list_resources(tags))
+
+    def create_resource(self, name: str, spec, tags: dict):
+        return self._guard(
+            "create", lambda c: c.create_resource(name, spec, tags)
+        )
+
+    def delete_resource(self, name: str) -> None:
+        return self._guard("delete", lambda c: c.delete_resource(name))
+
+    def is_ready(self, resource) -> bool:
+        return self.inner.is_ready(resource)
+
+    # -- the guard ---------------------------------------------------------
+    def _guard(self, endpoint: str, fn):
+        br = self.breakers.get(endpoint)
+        attempt = 0
+        # EXACTLY one allow() per attempt: allow() is side-effecting (it
+        # claims the half-open probe), so every claim must be consumed by
+        # one attempt whose outcome (record_success / record_failure /
+        # release) returns it — a second allow() for the same attempt
+        # would strand the claim and wedge the breaker half-open forever.
+        allowed = br.allow()
+        while True:
+            if not allowed:
+                self.registry.inc(
+                    "cloud_breaker_short_circuits_total",
+                    endpoint=br.endpoint,
+                )
+                raise CircuitOpenError(
+                    f"circuit open for {br.endpoint}; not calling out"
+                )
+            try:
+                with global_tracer.span(
+                    "cloud.attempt", endpoint=br.endpoint,
+                    attempt=attempt, breaker=br.state,
+                ):
+                    out = fn(self.inner)
+                br.record_success()
+                return out
+            except AuthError:
+                # Permanent: a bad credential is not endpoint health and
+                # retrying cannot fix it (reference README.md:184).
+                br.release()
+                raise
+            except CloudError:
+                br.record_failure()
+                attempt += 1
+                if attempt >= self.policy.max_attempts or self._budget <= 0:
+                    raise
+                allowed = br.allow()  # the next attempt's single claim
+                if not allowed:
+                    raise
+                self._budget -= 1
+                self.registry.inc(
+                    "cloud_retry_attempts_total", endpoint=br.endpoint
+                )
+                self.clock.sleep(self.policy.delay(attempt, key=endpoint))
+            except BaseException:
+                # Not a cloud outcome (bug in a fake, KeyboardInterrupt):
+                # say nothing about endpoint health, but hand back any
+                # probe claim before propagating.
+                br.release()
+                raise
+
+
+def resilient_factory(
+    factory,
+    policy: RetryPolicy | None = None,
+    clock: Clock | None = None,
+    breakers: BreakerBank | None = None,
+    name: str = "cloud",
+    failure_threshold: int = 5,
+    reset_timeout: float = 30.0,
+):
+    """Wrap a ``factory(credentials) -> backend`` seam so every client it
+    mints is a ResilientBackend sharing ONE BreakerBank.  The returned
+    factory exposes the bank as ``.breakers`` for introspection
+    (chaos-demo, tests)."""
+    bank = breakers or BreakerBank(
+        clock=clock, name=name,
+        failure_threshold=failure_threshold, reset_timeout=reset_timeout,
+    )
+    policy = policy or RetryPolicy()
+
+    def wrapped(credentials):
+        return ResilientBackend(
+            factory(credentials), bank, policy=policy, clock=clock or bank.clock
+        )
+
+    wrapped.breakers = bank
+    return wrapped

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from .kubefake import FakeKube, WatchEvent
 from .workqueue import RateLimitingQueue, ShutDown
 from ..utils.clock import Clock, RealClock
+from ..utils.faults import global_faults
 from ..utils.metrics import MetricsRegistry, global_metrics
 from ..utils.tracing import global_tracer
 
@@ -162,6 +163,12 @@ class Manager:
             t0 = time.perf_counter()
             rctx = None
             try:
+                # Chaos site: an injected error here is an unhandled
+                # reconcile exception — the per-key rate-limited backoff
+                # path, exactly what a panicking reconciler produces.
+                # The clock makes "slow" plans real (a stalled pass),
+                # deterministic under FakeClock.
+                global_faults.fire(f"reconcile.{ctl.kind}", clock=self.clock)
                 with global_tracer.span(
                     "reconcile", parent=parent, kind=ctl.kind,
                     controller=ctl.name, namespace=req.namespace,

@@ -22,6 +22,7 @@ import itertools
 import threading
 
 from ..utils.clock import Clock, RealClock
+from ..utils.faults import global_faults
 from ..utils.tracing import global_tracer
 
 
@@ -63,7 +64,16 @@ class RateLimitingQueue:
         self.add_after(key, 0.0)
 
     def add_after(self, key, delay: float) -> None:
-        ready = self.clock.now() + max(0.0, delay)
+        # Chaos site: a "slow" plan models delayed watch delivery / a
+        # congested informer.  The returned delay folds into the entry's
+        # deadline (never a sleep — producers are watch handlers), and
+        # only slow is honored: an injected *error* here would lose an
+        # event, which no real fault mode does (at-least-once delivery is
+        # the queue's contract).
+        delay = max(0.0, delay) + global_faults.fire(
+            "workqueue.add", only=("slow",)
+        )
+        ready = self.clock.now() + delay
         ctx = global_tracer.current()
         with self._cond:
             if self._shutdown:

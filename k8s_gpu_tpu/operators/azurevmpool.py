@@ -20,6 +20,7 @@ import logging
 from ..api.azurevmpool import AzureVmPool, VmInfo
 from ..api.types import set_condition
 from ..cloud.base import AuthError, CloudError
+from ..cloud.resilience import requeue_delay as _requeue_delay
 from ..controller.events import EventRecorder
 from ..controller.kubefake import Conflict, FakeKube, NotFound
 from ..controller.manager import Reconciler, Request, Result
@@ -34,6 +35,8 @@ AUTH_RETRY = 30.0   # reference README.md:184
 LIST_RETRY = 20.0   # reference README.md:192
 MUTATE_RETRY = 40.0 # reference README.md:207,219
 RESYNC = 60.0       # reference README.md:233-234
+# CloudError requeues go through cloud.resilience.requeue_delay: the rung
+# above for real failures, the fast BREAKER_RETRY for short-circuits.
 
 
 class AzureVmPoolReconciler(Reconciler):
@@ -85,7 +88,7 @@ class AzureVmPoolReconciler(Reconciler):
                 vms = client.list_resources(self.tags_for(pool))
         except CloudError as e:
             self._set_failed(pool, "ListFailed", str(e))
-            return Result(requeue_after=LIST_RETRY)
+            return Result(requeue_after=_requeue_delay(e, LIST_RETRY))
 
         desired = pool.spec.replicas
         current = len(vms)
@@ -106,7 +109,7 @@ class AzureVmPoolReconciler(Reconciler):
                         )
                 except CloudError as e:
                     self._set_failed(pool, "CreateFailed", str(e))
-                    return Result(requeue_after=MUTATE_RETRY)
+                    return Result(requeue_after=_requeue_delay(e, MUTATE_RETRY))
                 existing.add(name)
                 self.metrics.inc("cloud_resources_created_total", kind="AzureVm")
                 self.recorder.event(
@@ -121,7 +124,7 @@ class AzureVmPoolReconciler(Reconciler):
                         client.delete_resource(vm.name)
                 except CloudError as e:
                     self._set_failed(pool, "DeleteFailed", str(e))
-                    return Result(requeue_after=MUTATE_RETRY)
+                    return Result(requeue_after=_requeue_delay(e, MUTATE_RETRY))
                 self.metrics.inc("cloud_resources_deleted_total", kind="AzureVm")
                 self.recorder.event(
                     pool, "Normal", "VmDeleted", f"deleted VM {vm.name}"
@@ -133,7 +136,7 @@ class AzureVmPoolReconciler(Reconciler):
                 vms = client.list_resources(self.tags_for(pool))
         except CloudError as e:
             self._set_failed(pool, "ListFailed", str(e))
-            return Result(requeue_after=LIST_RETRY)
+            return Result(requeue_after=_requeue_delay(e, LIST_RETRY))
 
         ready = sum(1 for vm in vms if client.is_ready(vm))
         pool.status.ready_replicas = ready
@@ -208,7 +211,7 @@ class AzureVmPoolReconciler(Reconciler):
             return Result(requeue_after=AUTH_RETRY)
         except CloudError as e:
             self._set_failed(pool, "FinalizeFailed", str(e))
-            return Result(requeue_after=MUTATE_RETRY)
+            return Result(requeue_after=_requeue_delay(e, MUTATE_RETRY))
         pool.metadata.finalizers.remove(FINALIZER)
         try:
             self.kube.update(pool)

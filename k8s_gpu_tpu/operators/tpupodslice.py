@@ -25,6 +25,7 @@ from ..api.tpupodslice import SliceStatus, TpuPodSlice
 from ..api.types import set_condition
 from ..cloud.base import AuthError, CloudError
 from ..cloud.fake_cloudtpu import QueuedResource
+from ..cloud.resilience import requeue_delay as _requeue_delay
 from ..cloud.topology import parse_accelerator_type
 from ..controller.events import EventRecorder
 from ..controller.kubefake import Conflict, FakeKube, NotFound
@@ -42,6 +43,8 @@ LIST_RETRY = 20.0
 MUTATE_RETRY = 40.0
 PROVISION_POLL = 5.0  # fast poll while a QR is in-flight
 RESYNC = 60.0
+# CloudError requeues go through cloud.resilience.requeue_delay: the rung
+# above for real failures, the fast BREAKER_RETRY for short-circuits.
 
 
 class TpuPodSliceReconciler(Reconciler):
@@ -105,7 +108,7 @@ class TpuPodSliceReconciler(Reconciler):
                 qrs = client.list_resources(self.tags_for(ps))
         except CloudError as e:
             self._fail(ps, "ListFailed", str(e))
-            return Result(requeue_after=LIST_RETRY)
+            return Result(requeue_after=_requeue_delay(e, LIST_RETRY))
 
         want_qr = ps.spec.slice_count > 0
         qr = next((q for q in qrs if q.name == self.qr_name(ps)), None)
@@ -128,7 +131,7 @@ class TpuPodSliceReconciler(Reconciler):
                     client.delete_resource(stale.name)
             except CloudError as e:
                 self._fail(ps, "DeleteFailed", str(e))
-                return Result(requeue_after=MUTATE_RETRY)
+                return Result(requeue_after=_requeue_delay(e, MUTATE_RETRY))
             self.recorder.event(
                 ps, "Warning" if broken else "Normal", "QueuedResourceDeleted",
                 f"deleted queued resource {stale.name} (state={stale.state})",
@@ -151,7 +154,7 @@ class TpuPodSliceReconciler(Reconciler):
                     )
             except CloudError as e:
                 self._fail(ps, "CreateFailed", str(e))
-                return Result(requeue_after=MUTATE_RETRY)
+                return Result(requeue_after=_requeue_delay(e, MUTATE_RETRY))
             self.metrics.inc("cloud_resources_created_total", kind="QueuedResource")
             self.recorder.event(
                 ps, "Normal", "QueuedResourceCreated",
@@ -164,7 +167,7 @@ class TpuPodSliceReconciler(Reconciler):
                     client.delete_resource(qr.name)
             except CloudError as e:
                 self._fail(ps, "DeleteFailed", str(e))
-                return Result(requeue_after=MUTATE_RETRY)
+                return Result(requeue_after=_requeue_delay(e, MUTATE_RETRY))
             self.recorder.event(
                 ps, "Normal", "QueuedResourceDeleted",
                 f"scaled to zero: deleted {qr.name}",
@@ -333,7 +336,7 @@ class TpuPodSliceReconciler(Reconciler):
             return Result(requeue_after=AUTH_RETRY)
         except CloudError as e:
             self._fail(ps, "FinalizeFailed", str(e))
-            return Result(requeue_after=MUTATE_RETRY)
+            return Result(requeue_after=_requeue_delay(e, MUTATE_RETRY))
         self._prune_nodes(ps, keep_hostnames=set())
         ps.metadata.finalizers.remove(FINALIZER)
         try:

@@ -1,7 +1,7 @@
 """Serving: KV-cache engine, continuous batcher, speculative decoding,
 int8 weight-only quantization, LM HTTP server."""
 
-from .batcher import ContinuousBatcher, RequestHandle
+from .batcher import ContinuousBatcher, Overloaded, RequestHandle
 from .bundle import export_servable, load_servable
 from .constrain import RegexConstraint, compile_constraint
 from .disagg import DisaggregatedLm
@@ -13,7 +13,7 @@ from .speculative import distill_draft, rejection_sample
 
 __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
-    "ContinuousBatcher", "RequestHandle",
+    "ContinuousBatcher", "Overloaded", "RequestHandle",
     "quantize_params", "export_servable", "load_servable",
     "DisaggregatedLm", "RegexConstraint", "compile_constraint",
     "distill_draft", "rejection_sample", "schema_to_regex", "SchemaError",

@@ -50,6 +50,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..utils.compat import large_thread_stack, serialize_xla_compiles
+from ..utils.faults import global_faults
 from ..utils.metrics import global_metrics
 from ..utils.tracing import global_tracer
 from .engine import (
@@ -58,6 +59,13 @@ from .engine import (
 from .speculative import reject_row
 
 log = logging.getLogger("k8s_gpu_tpu.serve")
+
+
+class Overloaded(RuntimeError):
+    """Admission refused: the pending queue is at ``max_pending``.  The
+    load-shedding signal — servers map it to 429 + Retry-After so clients
+    back off, instead of letting the queue (and every queued request's
+    latency) grow without bound."""
 
 
 def ngram_propose(hist, token, pos, k: int, m: int = 3):
@@ -168,6 +176,14 @@ class _Request:
     # True when the stream ended because the batcher crashed/stopped, not
     # because of EOS/budget — servers map this to a 5xx, not a 200.
     aborted: bool = False
+    # Absolute host-monotonic deadline (None = no deadline), propagated
+    # from the caller (the LM server's x-request-deadline-ms header).
+    # Expired work is DROPPED — at admission before any device program,
+    # and between rounds mid-stream — never computed to completion.
+    deadline: float | None = None
+    # True when the stream ended because ``deadline`` passed — servers
+    # map this to 504, distinct from the crash-abort 503.
+    deadline_expired: bool = False
     # Latency telemetry (host wall-clock, seconds): submit time, admit
     # dispatch time, first/last emission time.  Feed the C32 serving
     # histograms at retirement (queue wait, TTFT, inter-token gap).
@@ -222,6 +238,12 @@ class RequestHandle:
         return self._req.aborted
 
     @property
+    def deadline_expired(self) -> bool:
+        """True when the stream ended because the request's deadline
+        passed (shed at admission, or cut between rounds)."""
+        return self._req.deadline_expired
+
+    @property
     def logprobs(self) -> list:
         """Per-token log-probabilities, parallel to result().  Complete
         only after the stream finishes (same contract as result());
@@ -261,8 +283,14 @@ class ContinuousBatcher:
         kv_quant: bool = False,
         paged_blocks: int = 0,
         page_size: int = 64,
+        max_pending: int = 0,
     ):
-        """``adapters``: name → (lora_params, LoraConfig) — serves every
+        """``max_pending`` > 0 bounds the unadmitted-request queue:
+        ``submit`` raises ``Overloaded`` at the bound (admission control —
+        the server's 429 path) instead of queueing unboundedly.  0 keeps
+        the historical unbounded behavior for direct embedders.
+
+        ``adapters``: name → (lora_params, LoraConfig) — serves every
         adapter and the base model from ONE decode program; requests pick
         an adapter by name at submit (serve/lora_bank.py).
 
@@ -479,7 +507,14 @@ class ContinuousBatcher:
         # scatter writes in a final round's garbage tail are dropped by
         # XLA's scatter semantics and never emitted).
         self._active: list[_Request | None] = [None] * slots
-        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        # maxsize IS the admission bound: put_nowait's queue.Full is the
+        # atomic load-shedding signal (a qsize() pre-check would race
+        # concurrent HTTP handler threads and overshoot the bound).
+        # maxsize=0 means unbounded, matching the max_pending=0 contract.
+        self.max_pending = max(0, int(max_pending))
+        self._pending: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=self.max_pending
+        )
         self._dead = False
         # Serializes submit() against the end-of-life drain: either a
         # request lands in _pending before the drain empties it, or submit
@@ -1148,10 +1183,22 @@ class ContinuousBatcher:
         seed: int = 0,
         adapter: str | None = None,
         constraint: str | None = None,
+        deadline: float | None = None,
     ) -> RequestHandle:
         """Queue a request; returns a handle streaming generated ids.
         Raises ValueError when the prompt cannot fit, KeyError for an
-        unknown ``adapter``/``constraint`` name."""
+        unknown ``adapter``/``constraint`` name, ``Overloaded`` when
+        ``max_pending`` is configured and the queue is full.
+        ``deadline`` is an absolute ``time.monotonic()`` instant: work
+        still queued (or still decoding) past it is dropped, not
+        computed."""
+        # error/timeout only: this site has no clock to realize a
+        # "slow" decision, and a silently-skipped delay must not be
+        # counted as an injection.
+        global_faults.fire(
+            "serve.submit", error_type=RuntimeError,
+            only=("error", "timeout"),
+        )
         aidx = self.bank.index(adapter)
         cidx = self._constraint_index(constraint)
         ids = np.asarray(ids, np.int32).ravel()
@@ -1170,6 +1217,7 @@ class ContinuousBatcher:
             seed=int(seed),
             aidx=aidx,
             cidx=cidx,
+            deadline=deadline,
             t_submit=time.monotonic(),
             trace_ctx=global_tracer.current(),
         )
@@ -1178,7 +1226,14 @@ class ContinuousBatcher:
                 raise RuntimeError(
                     "batcher scheduler is stopped; restart the server"
                 )
-            self._pending.put(req)
+            try:
+                self._pending.put_nowait(req)
+            except queue.Full:
+                global_metrics.inc("serve_shed_total", reason="queue_full")
+                raise Overloaded(
+                    f"pending queue full ({self.max_pending} requests); "
+                    "retry later"
+                ) from None
         self._wake.set()
         return RequestHandle(req)
 
@@ -1194,6 +1249,13 @@ class ContinuousBatcher:
         [1, n_tokens] bucket with ``pad`` leading pad slots;
         ``last_logits`` [1, V] are the logits at the final prompt
         position.  The decode side only splices and samples."""
+        # error/timeout only: this site has no clock to realize a
+        # "slow" decision, and a silently-skipped delay must not be
+        # counted as an injection.
+        global_faults.fire(
+            "serve.submit", error_type=RuntimeError,
+            only=("error", "timeout"),
+        )
         if self.paged:
             raise ValueError(
                 "disaggregated admission is not yet available in paged-KV "
@@ -1251,7 +1313,14 @@ class ContinuousBatcher:
                 raise RuntimeError(
                     "batcher scheduler is stopped; restart the server"
                 )
-            self._pending.put(req)
+            try:
+                self._pending.put_nowait(req)
+            except queue.Full:
+                global_metrics.inc("serve_shed_total", reason="queue_full")
+                raise Overloaded(
+                    f"pending queue full ({self.max_pending} requests); "
+                    "retry later"
+                ) from None
         self._wake.set()
         return RequestHandle(req)
 
@@ -1732,23 +1801,26 @@ class ContinuousBatcher:
         req = self._active[slot]
         if req is not None:
             req.out.put(None)  # completion sentinel
-            global_metrics.inc("serve_completions_total")
-            global_metrics.observe(
-                "serve_generated_tokens", float(req.emitted)
-            )
-            # C32 latency budget surface: time-to-first-token and mean
-            # inter-token gap per request (emission-side wall-clock —
-            # tokens reach the host in round batches, so the gap is the
-            # per-request STREAMING rate, dispatch cadence included).
-            if req.emitted >= 1 and req.t_first > 0.0:
+            if not req.deadline_expired:
+                # An expired row is a shed, not a completion — it must
+                # not pollute the completion/latency series.
+                global_metrics.inc("serve_completions_total")
                 global_metrics.observe(
-                    "serve_ttft_seconds", req.t_first - req.t_submit
+                    "serve_generated_tokens", float(req.emitted)
                 )
-            if req.emitted >= 2 and req.t_first > 0.0:
-                global_metrics.observe(
-                    "serve_inter_token_seconds",
-                    (req.t_last - req.t_first) / (req.emitted - 1),
-                )
+                # C32 latency budget surface: time-to-first-token and mean
+                # inter-token gap per request (emission-side wall-clock —
+                # tokens reach the host in round batches, so the gap is the
+                # per-request STREAMING rate, dispatch cadence included).
+                if req.emitted >= 1 and req.t_first > 0.0:
+                    global_metrics.observe(
+                        "serve_ttft_seconds", req.t_first - req.t_submit
+                    )
+                if req.emitted >= 2 and req.t_first > 0.0:
+                    global_metrics.observe(
+                        "serve_inter_token_seconds",
+                        (req.t_last - req.t_first) / (req.emitted - 1),
+                    )
         if self.paged and req is not None and req.blocks:
             # Point the slot at the trash block and return its blocks.
             # Rounds already in flight carry their dispatch-time table
@@ -1762,6 +1834,29 @@ class ContinuousBatcher:
             "serve_slots_active",
             float(sum(r is not None for r in self._active)),
         )
+
+    def _shed_expired(self, req: _Request) -> None:
+        """Drop an expired request AT ADMISSION: no prefill or decode
+        round ever runs for it — the "dropped, not computed" half of the
+        deadline contract."""
+        req.deadline_expired = True
+        req.aborted = True
+        global_metrics.inc("serve_shed_total", reason="deadline")
+        req.out.put(None)
+
+    def _expire_live(self, slot: int, req: _Request) -> bool:
+        """Mid-stream deadline check at round granularity: an expired row
+        retires before its fetched tokens are emitted, freeing the slot
+        instead of decoding to budget for a caller that stopped waiting.
+        Rounds already in flight were dispatched before the expiry was
+        observable; their output for this row is dropped here."""
+        if req.deadline is None or time.monotonic() <= req.deadline:
+            return False
+        req.deadline_expired = True
+        req.aborted = True
+        global_metrics.inc("serve_shed_total", reason="deadline")
+        self._retire(slot)
+        return True
 
     def _process_admits(self, items: list) -> None:
         """Consume a RUN of consecutive admit items with ONE device_get
@@ -1781,6 +1876,8 @@ class ContinuousBatcher:
                 )
             if self._active[req.slot] is not req:
                 continue  # already retired
+            if self._expire_live(req.slot, req):
+                continue
             first = int(first_dev)
             hit_eos = self.eos_id >= 0 and first == self.eos_id
             if not hit_eos:
@@ -1835,6 +1932,8 @@ class ContinuousBatcher:
                     slot=req.slot, fused=True,
                 )
             if self._active[req.slot] is not req:
+                return
+            if self._expire_live(req.slot, req):
                 return
             first = int(first_dev)
             if self.eos_id >= 0 and first == self.eos_id:
@@ -1896,6 +1995,8 @@ class ContinuousBatcher:
             for i, req in live:
                 if self._active[i] is not req:
                     continue
+                if self._expire_live(i, req):
+                    continue
                 done = False
                 n0 = req.emitted
                 for r in range(toks.shape[0]):
@@ -1940,6 +2041,8 @@ class ContinuousBatcher:
         for i, req in live:
             if self._active[i] is not req:
                 continue  # retired (or slot re-admitted) mid-flight
+            if self._expire_live(i, req):
+                continue
             done = False
             n0 = req.emitted
             for t in range(n_steps):
@@ -1990,6 +2093,15 @@ class ContinuousBatcher:
                             req = self._pending.get_nowait()
                         except queue.Empty:
                             break
+                    # Deadline gate BEFORE any allocation or device
+                    # program: work that expired while queued is shed,
+                    # never prefilled.
+                    if (
+                        req.deadline is not None
+                        and time.monotonic() > req.deadline
+                    ):
+                        self._shed_expired(req)
+                        continue
                     if self.paged:
                         bucket = prompt_bucket(
                             int(req.ids.size), self.engine.max_seq

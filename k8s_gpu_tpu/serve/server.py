@@ -17,13 +17,19 @@ returns tokens as they are produced.  Pass ``mesh`` for tp-sharded serving.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..data.tokenizer import BpeTokenizer
+from ..utils.metrics import global_metrics
 from ..utils.obs import RequestMetricsMixin
-from .batcher import ContinuousBatcher
+from .batcher import ContinuousBatcher, Overloaded
+
+# Advisory client backoff on 429/503: long enough to drain a round or
+# two, short enough that a recovered server re-fills quickly.
+RETRY_AFTER_S = 1
 
 
 class LmServer:
@@ -47,8 +53,16 @@ class LmServer:
         kv_quant: bool = False,
         paged_blocks: int = 0,
         page_size: int = 64,
+        max_pending: int = 64,
     ):
-        """``adapters``: name → (lora_params, LoraConfig); requests pick
+        """``max_pending`` bounds the batcher's unadmitted-request queue:
+        at the bound, /generate sheds with 429 + Retry-After instead of
+        queueing unboundedly (0 disables admission control).  Requests
+        may carry an ``x-request-deadline-ms`` header — a per-request
+        latency budget propagated into the batcher; work still queued or
+        decoding past it is dropped and answered 504.
+
+        ``adapters``: name → (lora_params, LoraConfig); requests pick
         one with {"adapter": "<name>"} — multi-tenant fine-tunes served
         from one decode program (serve/lora_bank.py).
 
@@ -73,6 +87,7 @@ class LmServer:
             constraints=cbank, eos_id=eos_id, logprobs=True,
             draft=draft, spec_k=spec_k, kv_quant=kv_quant,
             paged_blocks=paged_blocks, page_size=page_size,
+            max_pending=max_pending,
         )
         self.tokenizer = tokenizer
         self.started_at = time.time()
@@ -145,6 +160,32 @@ class LmServer:
                         400, {"error": "constraint must be a string"})
                 stream = bool(body.get("stream", False))
                 want_lp = bool(body.get("logprobs", False))
+                # Per-request latency budget: x-request-deadline-ms is a
+                # RELATIVE budget (clients cannot share our monotonic
+                # clock); it becomes an absolute deadline the batcher
+                # enforces at admission and between rounds.
+                deadline = None
+                budget_ms = self.headers.get("x-request-deadline-ms")
+                if budget_ms is not None:
+                    try:
+                        budget_ms = float(budget_ms)
+                    except (TypeError, ValueError):
+                        budget_ms = None
+                    if budget_ms is None or not math.isfinite(budget_ms):
+                        return self._json(400, {
+                            "error": "x-request-deadline-ms must be a "
+                                     "finite number"
+                        })
+                    if budget_ms <= 0:
+                        # A shed like any other deadline drop — the 504
+                        # rate must move the same observable the batcher's
+                        # admission/round gates do.
+                        global_metrics.inc(
+                            "serve_shed_total", reason="deadline"
+                        )
+                        return self._json(
+                            504, {"error": "deadline exceeded"})
+                    deadline = time.monotonic() + budget_ms / 1000.0
                 ids = outer.tokenizer.encode(prompt)
                 t0 = time.perf_counter()
                 try:
@@ -156,22 +197,36 @@ class LmServer:
                         seed=seed,
                         adapter=adapter,
                         constraint=constraint,
+                        deadline=deadline,
                     )
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
                 except KeyError as e:  # unknown adapter name
                     return self._json(400, {"error": e.args[0]})
+                except Overloaded as e:  # queue full: shed with backoff
+                    return self._json(
+                        429, {"error": str(e)},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
                 except RuntimeError as e:  # scheduler dead: clean 503
-                    return self._json(503, {"error": str(e)})
+                    return self._json(
+                        503, {"error": str(e)},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
                 if stream:
                     return self._stream(handle, ids, t0, want_lp)
                 gen_ids = handle.result()
+                if handle.deadline_expired:
+                    return self._json(504, {
+                        "error": "deadline exceeded",
+                        "ids": gen_ids,
+                    })
                 if handle.aborted:
                     return self._json(503, {
                         "error": "generation aborted: server shutting down "
                                  "or batcher crashed",
                         "ids": gen_ids,
-                    })
+                    }, headers={"Retry-After": str(RETRY_AFTER_S)})
                 dt = time.perf_counter() - t0
                 out = {
                     "text": outer.tokenizer.decode(gen_ids),
@@ -209,7 +264,9 @@ class LmServer:
                     self.wfile.write((json.dumps(event) + "\n").encode())
                     self.wfile.flush()
                 dt = time.perf_counter() - t0
-                if handle.aborted:
+                if handle.deadline_expired:
+                    summary = {"done": False, "error": "deadline exceeded"}
+                elif handle.aborted:
                     # The stream already carries tokens; the terminal event
                     # must say they are a truncation, not a completion.
                     summary = {"done": False,
@@ -230,12 +287,15 @@ class LmServer:
                 self.wfile.write((json.dumps(summary) + "\n").encode())
                 self.wfile.flush()
 
-            def _json(self, code: int, payload: dict) -> None:
+            def _json(self, code: int, payload: dict,
+                      headers: dict | None = None) -> None:
                 self._last_code = code
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 

@@ -1,4 +1,5 @@
 from .clock import Clock, RealClock, FakeClock
+from .faults import FaultInjector, FaultPlan, InjectedFault, global_faults
 from .metrics import MetricsRegistry, global_metrics
 from .logstore import LogEntry, LogStore, LogStoreHandler, global_logstore
 from .obs import MetricsServer
@@ -16,6 +17,10 @@ __all__ = [
     "Clock",
     "RealClock",
     "FakeClock",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "global_faults",
     "MetricsRegistry",
     "global_metrics",
     "LogEntry",

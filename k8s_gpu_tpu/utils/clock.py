@@ -24,6 +24,21 @@ class Clock:
         """Wait on *cond* (already held) up to *timeout* clock-seconds."""
         raise NotImplementedError
 
+    def sleep(self, seconds: float) -> None:
+        """Block for *seconds* CLOCK-seconds — the retry-backoff primitive
+        (cloud/resilience.py).  Under RealClock this is a plain sleep;
+        under FakeClock the caller parks on the cheap poll until a test
+        advances fake time past the deadline, so chaos suites replay
+        whole backoff ladders instantly."""
+        deadline = self.now() + max(0.0, seconds)
+        cond = threading.Condition()
+        with cond:
+            while True:
+                remaining = deadline - self.now()
+                if remaining <= 0:
+                    return
+                self.wait(cond, remaining)
+
 
 class RealClock(Clock):
     def now(self) -> float:

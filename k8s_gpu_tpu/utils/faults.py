@@ -1,0 +1,182 @@
+"""Deterministic fault-injection harness — named sites, seeded plans.
+
+The fakes already script per-verb failure counters (ScriptedFaultPlan in
+cloud/fake_azure.py, TpuFaultPlan in cloud/fake_cloudtpu.py); that covers
+"the Nth create fails" but not the chaos question the ROADMAP north-star
+poses: does the whole control plane *converge* when 30% of everything
+fails, and does the serving plane degrade instead of hanging?  This module
+is the second, orthogonal layer: **injection sites** are named choke
+points compiled into production code paths (cloud transport, fake cloud
+verbs, workqueue enqueue, reconcile dispatch, serve admission), and a
+test/demo *arms* a site with a seeded ``FaultPlan``.  Disarmed sites cost
+one dict lookup — the default state everywhere outside a chaos run.
+
+Determinism is the design constraint (the chaos suite must replay
+identically under the tier-1 budget): every plan decision comes from a
+``random.Random(seed)`` private to the armed site, so a given
+(seed, call-sequence) pair always injects the same schedule.  Fault kinds:
+
+- ``error``   — raise the site's error type (CloudError at cloud sites);
+- ``timeout`` — raise the same type with a timeout-flavored message (the
+  shape a hung-then-expired transport produces);
+- ``slow``    — delay: sites with a Clock sleep in *clock* domain, sites
+  that schedule (workqueue) fold the returned delay into their deadline;
+- flaky-N-then-succeed — ``FaultPlan(flaky=N)``: the first N calls fail,
+  then the site heals (the retry-policy acceptance shape).
+
+Every injection counts in ``faults_injected_total{site,kind}`` so a chaos
+run can prove faults actually fired (a green run with zero injections is
+a broken harness, not a robust system).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+
+from .metrics import MetricsRegistry, global_metrics
+
+
+class InjectedFault(Exception):
+    """Default error raised at a site armed with an ``error``/``timeout``
+    plan; sites with a domain failure type (CloudError, RuntimeError)
+    pass it via ``fire(error_type=...)`` so injected faults travel the
+    exact handling path a real one would."""
+
+
+@dataclass
+class FaultPlan:
+    """One site's seeded schedule.
+
+    ``rate``/``kinds``/``seed`` drive the PRNG schedule: each call draws
+    once; under ``rate`` it injects a kind drawn from ``kinds``.
+    ``flaky=N`` overrides the PRNG: the first N calls inject
+    ``kinds[0]``, every later call passes (deterministic heal).
+    ``limit`` caps total injections regardless of mode; ``slow_s`` is the
+    delay a ``slow`` decision carries.
+    """
+
+    seed: int = 0
+    rate: float = 1.0
+    kinds: tuple = ("error",)
+    slow_s: float = 0.05
+    flaky: int = 0
+    limit: int | None = None
+
+
+class _ArmedSite:
+    __slots__ = ("plan", "rng", "calls", "injected")
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.rng = random.Random(plan.seed)
+        self.calls = 0
+        self.injected = 0
+
+    def decide(self) -> str | None:
+        self.calls += 1
+        p = self.plan
+        if p.limit is not None and self.injected >= p.limit:
+            return None
+        if p.flaky > 0:
+            kind = p.kinds[0] if self.calls <= p.flaky else None
+        else:
+            # One draw per call whatever the outcome, so the schedule is a
+            # pure function of (seed, call index) — a passing call never
+            # shifts a later call's decision.
+            u = self.rng.random()
+            kind = (
+                p.kinds[self.rng.randrange(len(p.kinds))]
+                if u < p.rate else None
+            )
+        if kind is not None:
+            self.injected += 1
+        return kind
+
+
+class FaultInjector:
+    """Named injection sites; ``global_faults`` is the default wired into
+    production code, and chaos harnesses may construct private instances
+    (the fakes take ``injector=``) for isolation."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry or global_metrics
+        self._lock = threading.Lock()
+        self._sites: dict[str, _ArmedSite] = {}
+
+    # -- arming ------------------------------------------------------------
+    def arm(self, site: str, plan: FaultPlan) -> None:
+        with self._lock:
+            self._sites[site] = _ArmedSite(plan)
+
+    def disarm(self, site: str | None = None) -> None:
+        """Disarm one site, or every site when ``site`` is None."""
+        with self._lock:
+            if site is None:
+                self._sites.clear()
+            else:
+                self._sites.pop(site, None)
+
+    # -- the choke point ---------------------------------------------------
+    def fire(
+        self,
+        site: str,
+        error_type: type = InjectedFault,
+        clock=None,
+        only: tuple | None = None,
+    ) -> float:
+        """Called by production code at injection site *site*.
+
+        Disarmed → returns 0.0 (the fast path).  An armed decision either
+        raises ``error_type`` (kinds ``error``/``timeout``) or handles
+        ``slow``: with a ``clock`` the delay is slept here (clock
+        domain); without one it is RETURNED for the caller to fold into
+        its own scheduling.  ``only`` restricts which kinds this site
+        honors — the workqueue site passes ``("slow",)`` because an
+        injected error there would *lose an event*, which no real fault
+        mode produces.
+        """
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None:
+                return 0.0
+            kind = st.decide()
+            if kind is not None and only is not None and kind not in only:
+                st.injected -= 1
+                kind = None
+            if kind is None:
+                return 0.0
+            slow_s = st.plan.slow_s
+            n = st.injected
+        self.registry.inc("faults_injected_total", site=site, kind=kind)
+        if kind == "slow":
+            if clock is not None:
+                clock.sleep(slow_s)
+                return 0.0
+            return slow_s
+        flavor = "timeout" if kind == "timeout" else "fault"
+        raise error_type(f"injected {flavor} at {site} (#{n})")
+
+    # -- introspection -----------------------------------------------------
+    def injected(self, site: str) -> int:
+        with self._lock:
+            st = self._sites.get(site)
+            return st.injected if st else 0
+
+    def calls(self, site: str) -> int:
+        with self._lock:
+            st = self._sites.get(site)
+            return st.calls if st else 0
+
+    def sites(self) -> dict:
+        """site → {calls, injected} for every armed site (chaos-demo
+        reporting surface)."""
+        with self._lock:
+            return {
+                name: {"calls": st.calls, "injected": st.injected}
+                for name, st in self._sites.items()
+            }
+
+
+global_faults = FaultInjector()

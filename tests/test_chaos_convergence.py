@@ -1,0 +1,617 @@
+"""Chaos suite: the control and serving planes must CONVERGE under
+seeded fault schedules, not merely pass when the fake cloud is polite.
+
+Named to sort early in the alphabetically-truncated tier-1 window.
+Everything is driven by FakeClock + seeded FaultPlans (utils/faults.py),
+so minutes of retry/requeue/breaker cadence replay in milliseconds and
+every run injects the identical schedule.  Invariants pinned here:
+
+- AzureVmPool and TpuPodSlice converge to spec under a 30% injected
+  cloud-fault rate within a bounded number of reconcile passes, with
+  zero leaked cloud resources (strays / orphaned NICs+disks), and tear
+  down cleanly while the faults keep firing;
+- an open circuit breaker caps outbound call attempts while the endpoint
+  is down (short-circuits never reach the cloud) and heals through the
+  half-open probe;
+- the workqueue failure ladder resets: a successful reconcile forgets
+  the key, so a later transient error starts at base_delay again;
+- a hung transport surfaces as CloudError within the timeout bound
+  instead of blocking a reconcile worker forever;
+- the serve plane sheds (429/Overloaded, expired deadlines) instead of
+  queueing or computing work nobody is waiting for.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_gpu_tpu.api import AzureVmPool, Secret, TpuPodSlice
+from k8s_gpu_tpu.cloud import (
+    AuthError,
+    CircuitOpenError,
+    CloudError,
+    CloudTpuClient,
+    FakeAzureCloud,
+    FakeCloudTpu,
+    MetadataIdentity,
+    RetryPolicy,
+    azure_client_factory,
+    cloudtpu_client_factory,
+    make_urllib_transport,
+    resilient_factory,
+)
+from k8s_gpu_tpu.cloud.resilience import CircuitBreaker
+from k8s_gpu_tpu.controller import FakeKube, Manager
+from k8s_gpu_tpu.controller.manager import Reconciler, Result
+from k8s_gpu_tpu.controller.workqueue import RateLimitingQueue
+from k8s_gpu_tpu.operators import AzureVmPoolReconciler, TpuPodSliceReconciler
+from k8s_gpu_tpu.utils.clock import FakeClock
+from k8s_gpu_tpu.utils.faults import FaultInjector, FaultPlan, global_faults
+
+# Zero-delay retries: under FakeClock a non-zero backoff would park the
+# worker until the test advances time — determinism is already covered by
+# the dedicated jitter test below.
+FAST_RETRY = RetryPolicy(max_attempts=3, budget=6, base_delay=0.0)
+
+FAULT_RATE = 0.30
+
+
+@pytest.fixture
+def faults():
+    """The global injector, disarmed before and after — sites in the
+    workqueue/manager/serve planes read global_faults directly."""
+    global_faults.disarm()
+    yield global_faults
+    global_faults.disarm()
+
+
+def drive(mgr, clock, predicate, passes=30, step=41.0):
+    """Advance fake time one error-ladder rung at a time (41 s clears the
+    worst rung, MUTATE_RETRY=40) until *predicate* holds.  Returns the
+    number of advances spent — the suite's 'bounded reconcile passes'
+    measure."""
+    for i in range(passes):
+        if predicate():
+            return i
+        clock.advance(step)
+        mgr.wait_idle(timeout=0.5)
+    assert predicate(), "did not converge within the pass bound"
+    return passes
+
+
+# -- pool convergence under a 30% fault rate --------------------------------
+
+def test_tpu_pool_converges_under_30pct_faults(kube, clock, faults):
+    for site, seed in (
+        ("cloudtpu.create", 11), ("cloudtpu.list", 12),
+        ("cloudtpu.delete", 13),
+    ):
+        faults.arm(site, FaultPlan(seed=seed, rate=FAULT_RATE))
+    # Control-plane sites too: delayed watch delivery and reconciler
+    # panics must also be survivable (events delayed, never lost).
+    faults.arm(
+        "workqueue.add",
+        FaultPlan(seed=14, rate=0.2, kinds=("slow",), slow_s=2.0),
+    )
+    faults.arm("reconcile.TpuPodSlice", FaultPlan(seed=15, rate=0.1))
+
+    cloud = FakeCloudTpu(clock=clock)
+    mgr = Manager(kube, clock=clock)
+    factory = resilient_factory(
+        cloudtpu_client_factory(cloud), policy=FAST_RETRY, clock=clock,
+        name="cloudtpu",
+    )
+    mgr.register("TpuPodSlice", TpuPodSliceReconciler(kube, factory))
+    mgr.start()
+    try:
+        ps = TpuPodSlice()
+        ps.metadata.name = "chaos"
+        ps.spec.accelerator_type = "v4-8"
+        kube.create(ps)
+
+        def ready():
+            cur = kube.try_get("TpuPodSlice", "chaos")
+            return cur is not None and cur.status.phase == "Ready"
+
+        drive(mgr, clock, ready)
+        # Faults really fired — a green run with zero injections would be
+        # a broken harness, not a robust system.
+        assert sum(
+            s["injected"] for s in faults.sites().values()
+        ) > 0
+        # Zero leaked cloud resources: exactly the one owned QR, ACTIVE.
+        assert list(cloud.queued_resources) == ["default-chaos-qr"]
+        assert cloud.queued_resources["default-chaos-qr"].state == "ACTIVE"
+        assert len(kube.list("Node")) == 2  # v4-8 = 2 hosts
+
+        # Teardown must also converge while the faults keep firing.
+        kube.delete("TpuPodSlice", "chaos")
+        drive(
+            mgr, clock,
+            lambda: not cloud.queued_resources
+            and kube.try_get("TpuPodSlice", "chaos") is None,
+        )
+        assert kube.list("Node") == []
+    finally:
+        mgr.stop()
+
+
+def test_azure_pool_converges_and_scales_without_leaks(kube, clock, faults):
+    for site, seed in (
+        ("azure.create", 21), ("azure.list", 22), ("azure.delete", 23),
+    ):
+        faults.arm(site, FaultPlan(seed=seed, rate=FAULT_RATE))
+
+    cloud = FakeAzureCloud(clock=clock)
+    mgr = Manager(kube, clock=clock)
+    factory = resilient_factory(
+        azure_client_factory(cloud), policy=FAST_RETRY, clock=clock,
+        name="azure",
+    )
+    mgr.register("AzureVmPool", AzureVmPoolReconciler(kube, factory))
+    mgr.start()
+    secret = Secret(data={
+        "AZURE_CLIENT_ID": "cid", "AZURE_CLIENT_SECRET": "sec",
+        "AZURE_TENANT_ID": "tid", "AZURE_SUBSCRIPTION_ID": "sub",
+    })
+    secret.metadata.name = "azure-creds"
+    kube.create(secret)
+    try:
+        pool = AzureVmPool()
+        pool.metadata.name = "chaos-pool"
+        pool.spec.replicas = 3
+        pool.spec.vm_size = "Standard_NC4as_T4_v3"
+        pool.spec.azure_credential_secret = "azure-creds"
+        kube.create(pool)
+
+        def ready(n):
+            def check():
+                p = kube.try_get("AzureVmPool", "chaos-pool")
+                return (
+                    p is not None and p.status.ready_replicas == n
+                    and len(cloud.vms) == n
+                )
+            return check
+
+        drive(mgr, clock, ready(3))
+        assert cloud.leaked_attachments == 0
+
+        # Scale down under the same fault schedule: the cost-leak rule
+        # (NIC + disk go with the VM) must hold on every retried delete.
+        p = kube.get("AzureVmPool", "chaos-pool")
+        p.spec.replicas = 1
+        kube.update(p)
+        drive(mgr, clock, ready(1))
+        assert cloud.leaked_attachments == 0
+        assert faults.injected("azure.delete") + faults.injected(
+            "azure.create") + faults.injected("azure.list") > 0
+    finally:
+        mgr.stop()
+
+
+# -- circuit breaker --------------------------------------------------------
+
+def test_breaker_caps_attempts_while_endpoint_down(clock, faults):
+    inj = FaultInjector()
+    cloud = FakeCloudTpu(clock=clock, injector=inj)
+    inj.arm("cloudtpu.list", FaultPlan(rate=1.0))  # endpoint hard-down
+    factory = resilient_factory(
+        cloudtpu_client_factory(cloud), policy=FAST_RETRY, clock=clock,
+        failure_threshold=3, reset_timeout=30.0, name="tpu",
+    )
+    # Each factory() call = one reconcile pass's client (fresh retry
+    # budget, shared breakers).
+    opens = 0
+    for _ in range(10):
+        try:
+            factory("wi").list_resources({})
+        except CircuitOpenError:
+            opens += 1
+        except CloudError:
+            pass
+    calls_while_down = len(cloud.api_calls)
+    assert factory.breakers.states() == {"list": "open"}
+    assert opens > 0
+    # The cap: 10 passes x up to 3 attempts = 30 potential calls; the
+    # breaker must have stopped all outbound traffic at its threshold.
+    assert calls_while_down == 3
+    # More passes while open: ZERO additional outbound calls.
+    for _ in range(5):
+        with pytest.raises(CircuitOpenError):
+            factory("wi").list_resources({})
+    assert len(cloud.api_calls) == calls_while_down
+
+    # Half-open probe after the reset window: endpoint still down → one
+    # probe call, straight back to open.
+    clock.advance(30.1)
+    with pytest.raises(CloudError):
+        factory("wi").list_resources({})
+    assert len(cloud.api_calls) == calls_while_down + 1
+    assert factory.breakers.states() == {"list": "open"}
+
+    # Endpoint heals → next probe closes the breaker and traffic flows.
+    inj.disarm()
+    clock.advance(30.1)
+    assert factory("wi").list_resources({}) == []
+    assert factory.breakers.states() == {"list": "closed"}
+
+
+def test_breaker_state_transitions_deterministic(clock):
+    br = CircuitBreaker(
+        "ep", clock=clock, failure_threshold=2, reset_timeout=10.0
+    )
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    clock.advance(9.9)
+    assert not br.allow()  # still inside the reset window
+    clock.advance(0.2)
+    assert br.allow() and br.state == "half_open"
+    assert not br.allow()  # a single probe at a time
+    br.record_failure()    # probe failed → re-open, timer restarts
+    assert br.state == "open" and not br.allow()
+    clock.advance(10.1)
+    assert br.allow() and br.state == "half_open"
+    br.record_success()    # probe succeeded → closed, failures reset
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed"  # the count restarted from zero
+
+
+def test_half_open_probe_claim_released_on_non_cloud_outcomes(clock):
+    """An AuthError (or a bug in the backend) during the half-open probe
+    must hand the claim back — a stranded claim would wedge the breaker
+    half-open forever, short-circuiting every future call."""
+    from k8s_gpu_tpu.cloud.resilience import BreakerBank, ResilientBackend
+
+    class Backend:
+        def __init__(self):
+            self.mode = CloudError
+
+        def list_resources(self, tags):
+            if self.mode is None:
+                return []
+            raise self.mode("scripted")
+
+        def is_ready(self, r):
+            return True
+
+    bank = BreakerBank(clock=clock, failure_threshold=1, reset_timeout=5.0)
+    inner = Backend()
+    rb = ResilientBackend(
+        inner, bank, policy=RetryPolicy(max_attempts=1), clock=clock
+    )
+    with pytest.raises(CloudError):
+        rb.list_resources({})          # threshold 1 → open
+    assert bank.states() == {"list": "open"}
+    clock.advance(5.1)
+    inner.mode = AuthError             # probe hits a credential problem
+    with pytest.raises(AuthError):
+        rb.list_resources({})
+    # The claim came back: the breaker still admits a (real) probe...
+    inner.mode = TypeError             # ...which explodes non-cloudly...
+    with pytest.raises(TypeError):
+        rb.list_resources({})
+    inner.mode = None                  # ...and the NEXT probe still runs.
+    assert rb.list_resources({}) == []
+    assert bank.states() == {"list": "closed"}
+
+
+def test_retry_backoff_deterministic_and_capped():
+    p = RetryPolicy(base_delay=0.1, max_delay=2.0, jitter=0.5)
+    for attempt in range(1, 8):
+        a = p.delay(attempt, key="queuedResources")
+        b = p.delay(attempt, key="queuedResources")
+        assert a == b  # same (key, attempt) → same jitter, every run
+        assert 0.0 < a <= 2.0
+    # Different keys de-synchronize (full-jitter's herd spread).
+    assert p.delay(3, key="list") != p.delay(3, key="create")
+    # The exponential rises until the cap.
+    assert p.delay(1, key="k") < p.delay(4, key="k") <= 2.0
+
+
+# -- workqueue failure ladder ----------------------------------------------
+
+def test_workqueue_forget_resets_backoff_ladder(clock):
+    q = RateLimitingQueue(clock=clock, base_delay=1.0, max_delay=100.0)
+    # Two failures climb the ladder to a 2 s delay...
+    q.add_rate_limited("k")
+    clock.advance(1.1)
+    assert q.get(block=False) == "k"
+    q.done("k")
+    q.add_rate_limited("k")
+    clock.advance(1.1)
+    assert q.get(block=False) is None  # second rung: 2 s, not 1 s
+    clock.advance(1.0)
+    assert q.get(block=False) == "k"
+    q.done("k")
+    # ... a successful reconcile forgets the key ...
+    q.forget("k")
+    # ... so the NEXT transient error starts back at base_delay.
+    q.add_rate_limited("k")
+    clock.advance(1.1)
+    assert q.get(block=False) == "k"
+    q.done("k")
+
+
+def test_manager_forgets_backoff_after_successful_reconcile(kube, clock):
+    """The contract the workqueue test pins, proven at the manager level:
+    reconcile failures climb the per-key ladder, ONE success resets it."""
+
+    class Flaky(Reconciler):
+        def __init__(self):
+            self.calls = 0
+
+        def reconcile(self, req):
+            self.calls += 1
+            if self.calls <= 2:
+                raise RuntimeError("transient")
+            return Result()
+
+    rec = Flaky()
+    mgr = Manager(kube, clock=clock)
+    mgr.register("TpuPodSlice", rec)
+    mgr.start()
+    try:
+        ps = TpuPodSlice()
+        ps.metadata.name = "flaky"
+        ps.spec.accelerator_type = "v4-8"
+        kube.create(ps)
+        q = mgr._controllers["TpuPodSlice"].queue
+        deadline = time.monotonic() + 10.0
+        while rec.calls < 3 and time.monotonic() < deadline:
+            clock.advance(0.05)  # clears any backoff rung (base 5 ms)
+            time.sleep(0.002)
+        assert rec.calls >= 3
+        mgr.wait_idle(timeout=5.0)
+        from k8s_gpu_tpu.controller.manager import Request
+
+        # forget() ran on success: the failure memory is gone and a
+        # future transient error restarts at base_delay.
+        assert q.num_requeues(Request("default", "flaky")) == 0
+    finally:
+        mgr.stop()
+
+
+def test_workqueue_slow_site_delays_but_never_loses_events(clock, faults):
+    faults.arm(
+        "workqueue.add",
+        FaultPlan(rate=1.0, kinds=("slow",), slow_s=5.0),
+    )
+    q = RateLimitingQueue(clock=clock)
+    q.add("k")
+    assert q.get(block=False) is None  # delivery delayed, not dropped
+    clock.advance(5.1)
+    assert q.get(block=False) == "k"
+    # An error-kind plan at this site is IGNORED: losing an event would
+    # violate at-least-once delivery, which no real fault mode does.
+    faults.arm("workqueue.add", FaultPlan(rate=1.0, kinds=("error",)))
+    q.done("k")
+    q.add("k2")
+    assert q.get(block=False) == "k2"
+    assert faults.injected("workqueue.add") == 0
+
+
+# -- transport timeouts -----------------------------------------------------
+
+def test_hung_transport_surfaces_as_clouderror_not_a_hang():
+    """Regression for the un-timed urllib call: a server that accepts and
+    never responds must fail the call within the timeout bound instead of
+    wedging a reconcile worker forever."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    accepted = []
+    t = threading.Thread(
+        target=lambda: accepted.append(srv.accept()), daemon=True
+    )
+    t.start()
+    transport = make_urllib_transport(
+        connect_timeout=0.3, read_timeout=0.3
+    )
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(CloudError, match="timeout"):
+            transport("GET", f"http://127.0.0.1:{port}/v2/x", {}, None)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        srv.close()
+        for conn, _ in accepted:
+            conn.close()
+
+
+class ScriptedTransport:
+    """(status, body, headers) responses in order; records calls."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def __call__(self, method, url, headers, body):
+        self.calls += 1
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+def _client(script, retry):
+    ident = MetadataIdentity(
+        "sa",
+        transport=ScriptedTransport(
+            [(200, json.dumps(
+                {"access_token": "tok", "expires_in": 3600}).encode(), {})]
+        ),
+    )
+    api = ScriptedTransport(script)
+    return CloudTpuClient(
+        "p", "z", ident, transport=api, retry=retry, clock=FakeClock()
+    ), api
+
+
+def test_cloudtpu_client_retries_5xx_and_honors_retry_after():
+    ok = json.dumps({"queuedResources": []}).encode()
+    client, api = _client(
+        [
+            (503, b"{}", {"Retry-After": "0"}),
+            (429, b"{}", {}),
+            (200, ok, {}),
+        ],
+        retry=RetryPolicy(max_attempts=3, base_delay=0.0),
+    )
+    assert client.list_resources({}) == []
+    assert api.calls == 3  # 503 and 429 retried, 200 ended the ladder
+
+
+def test_retry_after_is_capped_not_a_wedge():
+    """A hostile 'Retry-After: 86400' must not outsleep the requeue
+    ladder: the honored floor clamps at RETRY_AFTER_CAP (30 s)."""
+    client, _ = _client([], retry=RetryPolicy(max_attempts=3, base_delay=0.0))
+    done = threading.Event()
+
+    def run():
+        client._sleep_before_retry(1, "p", {"retry-after": "86400"})
+        done.set()
+
+    threading.Thread(target=run, daemon=True).start()
+    client._clock.advance(30.1)  # > the cap, << the hostile hint
+    assert done.wait(2.0), "sleep exceeded RETRY_AFTER_CAP"
+
+
+def test_cloudtpu_client_4xx_is_permanent_and_auth_maps():
+    client, api = _client(
+        [(403, json.dumps({"error": {"status": "PERMISSION_DENIED"}}
+                          ).encode(), {})],
+        retry=RetryPolicy(max_attempts=3, base_delay=0.0),
+    )
+    with pytest.raises(AuthError):
+        client.list_resources({})
+    assert api.calls == 1  # permanent: never retried
+
+    client, api = _client(
+        [(404, b"{}", {})],
+        retry=RetryPolicy(max_attempts=3, base_delay=0.0),
+    )
+    client.delete_resource("gone")  # idempotent 404, single attempt
+    assert api.calls == 1
+
+
+def test_cloudtpu_rest_fault_site_heals_through_retry(faults):
+    """flaky-2-then-succeed at the transport site: the client's retry
+    ladder absorbs both injected faults inside ONE _call."""
+    faults.arm("cloudtpu.rest", FaultPlan(flaky=2))
+    ok = json.dumps({"queuedResources": []}).encode()
+    client, api = _client(
+        [(200, ok, {})], retry=RetryPolicy(max_attempts=3, base_delay=0.0),
+    )
+    assert client.list_resources({}) == []
+    assert faults.injected("cloudtpu.rest") == 2
+    assert api.calls == 1  # the two faults fired before the transport
+
+
+# -- serve-plane admission control ------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=16,
+        d_ff=64, max_seq=64, use_flash=False, dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def test_batcher_sheds_at_max_pending(tiny_lm):
+    from k8s_gpu_tpu.serve import ContinuousBatcher, Overloaded
+
+    model, params = tiny_lm
+    b = ContinuousBatcher(model, params, slots=2, max_pending=1)
+    # Scheduler not started: the first submit parks in _pending, the
+    # second must be refused at the door (no unbounded queue).
+    b.submit([1, 2, 3], max_new_tokens=4)
+    with pytest.raises(Overloaded, match="queue full"):
+        b.submit([4, 5, 6], max_new_tokens=4)
+
+
+def test_batcher_drops_expired_work_without_computing(tiny_lm):
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+
+    model, params = tiny_lm
+    b = ContinuousBatcher(model, params, slots=2).start()
+    try:
+        h = b.submit(
+            [1, 2, 3], max_new_tokens=8,
+            deadline=time.monotonic() - 0.001,  # already expired
+        )
+        assert h.result() == []
+        assert h.deadline_expired and h.aborted
+        # Dropped, not computed: no admit or decode round was dispatched.
+        assert b.steps_taken == 0
+    finally:
+        b.stop()
+
+
+def test_server_maps_sheds_to_429_503_504_with_retry_after(tiny_lm):
+    from k8s_gpu_tpu.data import BpeTokenizer
+    from k8s_gpu_tpu.serve import LmServer, Overloaded
+
+    model, params = tiny_lm
+    tok = BpeTokenizer.train("aa bb cc dd " * 30, vocab_size=80)
+    srv = LmServer(model, params, tok, max_pending=4)
+    # HTTP surface only — the batcher scheduler never starts, so no
+    # device program compiles in this test.
+    srv._thread.start()
+    try:
+        def post(payload, headers=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json",
+                         **(headers or {})},
+            )
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, dict(r.headers), json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, dict(e.headers), json.loads(e.read())
+
+        # Queue full → 429 + Retry-After.
+        real_submit = srv.batcher.submit
+        srv.batcher.submit = lambda *a, **k: (_ for _ in ()).throw(
+            Overloaded("pending queue full (4 requests); retry later")
+        )
+        code, hdrs, body = post({"prompt": "aa", "max_new_tokens": 2})
+        assert code == 429 and hdrs.get("Retry-After") == "1"
+        assert "queue full" in body["error"]
+        srv.batcher.submit = real_submit
+
+        # Expired-before-submit budget → 504.
+        code, _, body = post(
+            {"prompt": "aa"}, headers={"x-request-deadline-ms": "0"}
+        )
+        assert code == 504 and body["error"] == "deadline exceeded"
+        code, _, _ = post(
+            {"prompt": "aa"}, headers={"x-request-deadline-ms": "nan?"}
+        )
+        assert code == 400
+
+        # Dead scheduler → 503 + Retry-After (clients back off instead
+        # of tight-looping on a server that cannot recover by itself).
+        srv.batcher._dead = True
+        code, hdrs, _ = post({"prompt": "aa", "max_new_tokens": 2})
+        assert code == 503 and hdrs.get("Retry-After") == "1"
+    finally:
+        srv._httpd.shutdown()
+        srv._httpd.server_close()
