@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo
+.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo alerts-demo
 
 docker-build:
 	@for img in $(IMAGES); do \
@@ -62,3 +62,11 @@ trace-demo:
 # invariant (zero leaked resources, faults actually fired) fails.
 chaos-demo:
 	python tools/chaos_demo.py
+
+# Alerts smoke: chaos-driven breaker/pool alerts traverse
+# pending→firing→resolved deterministically under FakeClock (two runs,
+# identical timelines), Warning Events land on the affected objects, and
+# `obs top` renders the fleet-utilization snapshot from one /metrics
+# scrape.  Non-zero exit if any invariant fails.
+alerts-demo:
+	python tools/alerts_demo.py

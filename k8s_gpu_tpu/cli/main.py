@@ -752,6 +752,35 @@ def cmd_ci(args) -> int:
         p.close()
 
 
+def _obs_fetch(url: str, path: str) -> str | None:
+    """GET ``url+path`` from a running metrics server; None (with the
+    error printed) on failure.  OSError covers unreachable hosts;
+    ValueError covers a scheme-less --url (urlopen's "unknown url type")
+    and a non-UTF-8 body (UnicodeDecodeError)."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"{url.rstrip('/')}{path}", timeout=10
+        ) as r:
+            return r.read().decode()
+    except (OSError, ValueError) as e:
+        print(f"fetch failed: {e}", file=sys.stderr)
+        return None
+
+
+def _obs_snapshot() -> str | None:
+    """The last platform invocation's persisted exposition, or None
+    (with the hint printed) when no run has happened yet."""
+    from .platform_local import state_dir
+
+    prom = state_dir() / "metrics.prom"
+    if not prom.exists():
+        print("no metrics snapshot yet", file=sys.stderr)
+        return None
+    return prom.read_text()
+
+
 def cmd_obs(args) -> int:
     """Observability surface (C32): query persisted platform logs (the
     Loki role), dump the last metrics exposition, render span traces, or
@@ -785,19 +814,17 @@ def cmd_obs(args) -> int:
                   f"[{lvl}] {e.line}")
         return 0
     if args.obs_cmd == "metrics":
-        prom = state_dir() / "metrics.prom"
-        if not prom.exists():
-            print("no metrics snapshot yet", file=sys.stderr)
+        text = _obs_snapshot()
+        if text is None:
             return 1
-        print(prom.read_text(), end="")
+        print(text, end="")
         return 0
     if args.obs_cmd == "resilience":
         # The resilience slice of the exposition: retries, breaker
         # state/transitions, load sheds, injected faults — the counters
         # docs/platform/resilience.md defines.
-        prom = state_dir() / "metrics.prom"
-        if not prom.exists():
-            print("no metrics snapshot yet", file=sys.stderr)
+        text = _obs_snapshot()
+        if text is None:
             return 1
         families = (
             "faults_injected_total", "circuit_breaker_",
@@ -805,14 +832,95 @@ def cmd_obs(args) -> int:
             "cloud_breaker_short_circuits_total", "serve_shed_total",
         )
         lines = [
-            ln for ln in prom.read_text().splitlines()
-            if ln.startswith(families)
+            ln for ln in text.splitlines() if ln.startswith(families)
         ]
         if not lines:
             print("no resilience metrics recorded (no retries, sheds, "
                   "or injected faults in the last run)")
             return 0
         print("\n".join(lines))
+        return 0
+    if args.obs_cmd == "top":
+        # Fleet-utilization snapshot from ONE /metrics exposition: a live
+        # scrape with --url, or the persisted metrics.prom of the last
+        # platform invocation.
+        from ..utils.obs import render_top
+
+        text = (
+            _obs_fetch(args.url, "/metrics") if args.url
+            else _obs_snapshot()
+        )
+        if text is None:
+            return 1
+        print(render_top(text))
+        return 0
+    if args.obs_cmd == "alerts":
+        if args.url:
+            # A running MetricsServer's /alerts — the rules engine's live
+            # pending/firing set and transition timeline.
+            body = _obs_fetch(args.url, f"/alerts?limit={args.limit}")
+            if body is None:
+                return 1
+            try:
+                snap = json.loads(body)
+                alerts = snap["alerts"]
+                transitions = snap.get("transitions", [])
+            except (ValueError, KeyError, TypeError) as e:
+                # A 200 that isn't the /alerts JSON shape (wrong --url).
+                print(f"fetch failed: {e}", file=sys.stderr)
+                return 1
+        else:
+            # Instant evaluation over the last snapshot: rebuild a
+            # registry from metrics.prom and run the default pack with
+            # hold durations collapsed (one snapshot carries no history,
+            # so `for:` windows and counter rates cannot apply).
+            from ..utils.alerts import (
+                AlertingRule, RuleEvaluator, default_rule_pack,
+            )
+            from ..utils.metrics import MetricsRegistry, parse_exposition
+
+            text = _obs_snapshot()
+            if text is None:
+                return 1
+            reg = MetricsRegistry()
+            for name, series in parse_exposition(text).items():
+                if name.endswith(("_bucket", "_sum", "_count")):
+                    continue
+                for lbls, v in series.items():
+                    reg.set_gauge_series(name, v, dict(lbls))
+            rules = default_rule_pack()
+            for r in rules:
+                if isinstance(r, AlertingRule):
+                    r.for_s = 0.0
+            ev = RuleEvaluator(rules, registry=reg)
+            ev.evaluate_once()
+            alerts = ev.active_alerts()
+            transitions = []
+            print("(instant evaluation of the last snapshot; hold "
+                  "durations and rate windows not applied — use --url "
+                  "against a live server for the real state)\n")
+        if not alerts:
+            print("no alerts pending or firing")
+        else:
+            print(f"{'ALERT':<22} {'STATE':<8} {'ACTIVE(S)':>9} "
+                  f"{'VALUE':>9}  LABELS")
+            for a in alerts:
+                lbls = ",".join(
+                    f"{k}={v}" for k, v in sorted(a["labels"].items())
+                )
+                print(f"{a['alertname']:<22} {a['state']:<8} "
+                      f"{a['active_s']:>9.1f} {a['value']:>9.3g}  {lbls}")
+                if a.get("annotation"):
+                    print(f"  ↳ {a['annotation']}")
+        if transitions and args.limit > 0:
+            # limit<=0 means none — a bare [-0:] slice would show ALL.
+            print("\nrecent transitions:")
+            for t in transitions[-args.limit:]:
+                lbls = ",".join(
+                    f"{k}={v}" for k, v in sorted(t["labels"].items())
+                )
+                print(f"  t={t['t']:<10.1f} {t['alert']:<22} "
+                      f"{t['from']:>8} → {t['to']:<8} {lbls}")
         return 0
     if args.obs_cmd == "traces":
         from ..utils.tracing import global_tracer, render_trace
@@ -869,8 +977,12 @@ def cmd_obs(args) -> int:
         p = LocalPlatform()
         p.settle()
         p.close()
-        srv = MetricsServer(port=args.port).start()
-        print(f"serving /metrics /healthz /readyz on :{srv.port}")
+        # The manager's rules engine rides along so /alerts serves the
+        # session's final pending/firing set and timeline.
+        srv = MetricsServer(
+            port=args.port, alerts=getattr(p.mgr, "alerts", None)
+        ).start()
+        print(f"serving /metrics /alerts /healthz /readyz on :{srv.port}")
         return _serve_until(srv, args.for_seconds)
     return 1
 
@@ -1176,6 +1288,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="retry/breaker/shed/fault-injection counters from the last "
              "metrics snapshot",
     )
+    p_oa = obs_sub.add_parser(
+        "alerts",
+        help="pending/firing alerts + transition timeline from the "
+             "rules engine (--url) or an instant view of the last "
+             "metrics snapshot",
+    )
+    p_oa.add_argument("--url", default="",
+                      help="base URL of a running metrics server "
+                           "(/alerts); default: instant evaluation of "
+                           "the persisted metrics.prom")
+    p_oa.add_argument("--limit", type=int, default=20,
+                      help="max transitions to show")
+    p_otop = obs_sub.add_parser(
+        "top",
+        help="fleet-utilization snapshot (KV occupancy, batch fill, "
+             "queue depths, pool ready-ratios) from one /metrics scrape",
+    )
+    p_otop.add_argument("--url", default="",
+                        help="base URL of a running metrics server "
+                             "(/metrics); default: the persisted "
+                             "metrics.prom")
     p_ot = obs_sub.add_parser(
         "traces", help="render recorded spans as flame-style trees"
     )

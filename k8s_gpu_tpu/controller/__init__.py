@@ -2,6 +2,7 @@ from .kubefake import FakeKube, WatchEvent, Conflict, NotFound
 from .workqueue import RateLimitingQueue
 from .manager import Manager, Reconciler, Request, Result
 from .events import EventRecorder
+from .alerting import AlertEventNotifier
 
 __all__ = [
     "FakeKube",
@@ -14,4 +15,5 @@ __all__ = [
     "Request",
     "Result",
     "EventRecorder",
+    "AlertEventNotifier",
 ]

@@ -1,0 +1,63 @@
+"""Alert transitions → K8s Warning Events on the affected objects.
+
+The rules engine (utils/alerts.py) is deliberately kube-free; this is the
+controller-plane adapter that gives alerts the `kubectl describe`
+surface: when a rule whose labels name an object (``kind`` plus
+``pool``/``name``) transitions to firing, the object gets a Warning
+Event with the alert name as reason — resolved transitions get a Normal
+Event.  Alerts without an object reference (QueueBacklog, burn rate) are
+logged only; the ``/alerts`` endpoint and timeline remain their surface.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .events import EventRecorder
+from .kubefake import FakeKube
+
+log = logging.getLogger("k8s_gpu_tpu.controller.alerting")
+
+
+class AlertEventNotifier:
+    """``notify`` hook for ``RuleEvaluator``: maps alert labels to a
+    cluster object and records an Event on it."""
+
+    def __init__(self, kube: FakeKube, component: str = "alerts-engine"):
+        self.kube = kube
+        self.recorder = EventRecorder(kube, component)
+
+    def __call__(self, rule, labels: dict, transition: str,
+                 value: float) -> None:
+        obj = self._resolve(labels)
+        message = (
+            f"{transition}: {rule.annotate(tuple(sorted(labels.items())), value) or rule.name} "
+            f"(value={value:.4g}, severity={rule.severity})"
+        )
+        if obj is None:
+            log.warning("alert %s %s %s: no object to attach (labels=%s)",
+                        rule.name, transition, message, labels)
+            return
+        self.recorder.event(
+            obj,
+            "Warning" if transition == "firing" else "Normal",
+            rule.name,
+            message,
+        )
+
+    def _resolve(self, labels: dict):
+        kind = labels.get("kind")
+        name = labels.get("pool") or labels.get("name")
+        if not kind or not name:
+            return None
+        try:
+            # Namespace-scoped when the series carries one: two
+            # same-named pools in different namespaces must not receive
+            # each other's alert Events.
+            objs = self.kube.list(kind, namespace=labels.get("namespace"))
+        except Exception:
+            return None
+        for o in objs:
+            if o.metadata.name == name:
+                return o
+        return None

@@ -64,13 +64,28 @@ class Manager:
         kube: FakeKube,
         clock: Clock | None = None,
         metrics: MetricsRegistry | None = None,
+        alerts=None,
     ):
+        """``alerts``: a ``utils.alerts.RuleEvaluator`` the manager owns —
+        its tick loop starts/stops with the manager, and a collector is
+        registered that refreshes every controller queue's depth/age
+        gauges before each evaluation (oldest-item age grows with the
+        clock, so event-driven updates alone would go stale).  Construct
+        it with the SAME clock as the manager so alert hold durations and
+        requeue cadence live in one time domain."""
         self.kube = kube
         self.clock = clock or RealClock()
         self.metrics = metrics or global_metrics
+        self.alerts = alerts
+        if alerts is not None:
+            alerts.collectors.append(self._collect_queue_gauges)
         self._controllers: dict[str, _Controller] = {}
         self._started = False
         self._stop = threading.Event()
+
+    def _collect_queue_gauges(self) -> None:
+        for ctl in self._controllers.values():
+            ctl.queue.export_gauges()
 
     def register(
         self,
@@ -87,7 +102,9 @@ class Manager:
         name = name or kind
         if name in self._controllers:
             raise ValueError(f"controller {name!r} already registered")
-        q = RateLimitingQueue(clock=self.clock)
+        q = RateLimitingQueue(
+            clock=self.clock, name=name, registry=self.metrics
+        )
         self._controllers[name] = _Controller(name, kind, reconciler, q, workers)
 
     def start(self) -> None:
@@ -131,6 +148,8 @@ class Manager:
                 )
                 ctl.threads.append(t)
                 t.start()
+        if self.alerts is not None:
+            self.alerts.start()
 
     def _worker(self, ctl: _Controller) -> None:
         while not self._stop.is_set():
@@ -201,6 +220,12 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.alerts is not None:
+            self.alerts.stop()
+        # Final gauge refresh so a metrics snapshot persisted after stop
+        # (platform_local) carries current queue depths — live freshness
+        # comes from the evaluator's collector, not the queue hot path.
+        self._collect_queue_gauges()
         for ctl in self._controllers.values():
             ctl.queue.shutdown()
         for ctl in self._controllers.values():

@@ -36,10 +36,20 @@ class RateLimitingQueue:
         clock: Clock | None = None,
         base_delay: float = 0.005,
         max_delay: float = 1000.0,
+        name: str | None = None,
+        registry=None,
     ):
         self.clock = clock or RealClock()
         self.base_delay = base_delay
         self.max_delay = max_delay
+        # Fleet telemetry (ISSUE 4): a NAMED queue exports
+        # workqueue_depth{name} and workqueue_oldest_age_seconds{name} —
+        # the per-queue backlog gauges the QueueBacklog alert evaluates.
+        # Unnamed queues (direct embedders, tests) export nothing.
+        self.name = name
+        if registry is None and name is not None:
+            from ..utils.metrics import global_metrics as registry
+        self.registry = registry
         self._cond = threading.Condition()
         self._heap: list = []  # (ready_time, seq, key)
         self._seq = itertools.count()
@@ -158,6 +168,33 @@ class RateLimitingQueue:
                 self._cond.notify_all()
 
     # -- introspection -----------------------------------------------------
+    def export_gauges(self) -> None:
+        """Refresh the depth/age gauges for a named queue NOW — called
+        by the rule evaluator's collector before each tick and by the
+        manager on shutdown (before the metrics snapshot persists), NOT
+        on the add/get/done hot path: the due-now scan is O(queued) and
+        would make a watch-burst drain quadratic under the condition
+        lock.  Only keys DUE NOW count: items parked on a future
+        ``add_after`` deadline (steady-state resyncs, retry rungs) are
+        scheduled work, not backlog — counting them would make the
+        QueueBacklog alert fire forever on a healthy idle fleet.  Age is
+        the oldest due key's wait SINCE its deadline (now - ready_time);
+        for immediate adds that IS time-since-enqueue.  Lock order is
+        queue-cond → registry-lock, and the registry never calls back
+        into the queue, so this cannot deadlock."""
+        if self.registry is None or self.name is None:
+            return
+        with self._cond:
+            now = self.clock.now()
+            due = [t for t in self._queued.values() if t <= now]
+            self.registry.set_gauge(
+                "workqueue_depth", float(len(due)), queue=self.name
+            )
+            age = (now - min(due)) if due else 0.0
+            self.registry.set_gauge(
+                "workqueue_oldest_age_seconds", age, queue=self.name
+            )
+
     def empty(self) -> bool:
         with self._cond:
             return not self._queued and not self._processing and not self._dirty

@@ -24,6 +24,7 @@ from ..cloud.resilience import requeue_delay as _requeue_delay
 from ..controller.events import EventRecorder
 from ..controller.kubefake import Conflict, FakeKube, NotFound
 from ..controller.manager import Reconciler, Request, Result
+from .pool_gauges import clear_pool_gauges, export_pool_gauges
 from ..utils.metrics import MetricsRegistry, global_metrics
 from ..utils.tracing import global_tracer
 
@@ -62,7 +63,12 @@ class AzureVmPoolReconciler(Reconciler):
     def reconcile(self, req: Request) -> Result:
         pool = self.kube.try_get("AzureVmPool", req.name, req.namespace)
         if pool is None:
-            return Result()  # deleted; nothing to do (README.md:175-177)
+            # Deleted (README.md:175-177) — retire the pool gauges so a
+            # stale ratio can't keep PoolDegraded firing against nothing.
+            clear_pool_gauges(
+                self.metrics, "AzureVmPool", req.namespace, req.name
+            )
+            return Result()
 
         # -- graceful deletion via finalizer (README.md:309) ---------------
         if pool.metadata.deletion_timestamp is not None:
@@ -169,9 +175,9 @@ class AzureVmPoolReconciler(Reconciler):
             observed_generation=gen,
         )
         self._update_status(pool)
-        self.metrics.set_gauge(
-            "pool_ready_replicas", ready,
-            kind="AzureVmPool", pool=pool.metadata.name,
+        export_pool_gauges(
+            self.metrics, "AzureVmPool", pool.metadata.namespace,
+            pool.metadata.name, ready, desired,
         )
 
         # Converge faster while VMs are still provisioning.

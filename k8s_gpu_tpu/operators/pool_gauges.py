@@ -1,0 +1,41 @@
+"""Per-pool readiness gauges — the ONE implementation both pool
+reconcilers export through (ISSUE 4 fleet telemetry; review finding:
+the two operators had drifted-copy versions of the same three calls).
+
+Series are keyed ``{kind, namespace, pool}``: pool names are only
+unique per namespace (the same rule TpuPodSliceReconciler.pool_id
+applies to Node selectors), so an un-namespaced series would let
+``ns-a/demo`` and ``ns-b/demo`` overwrite each other's ratio — and a
+delete of one would clear the other's gauges.
+
+``export``: ready/desired/ratio on every status projection, so a
+provisioning pool reads degraded rather than stale.  desired=0 (paused)
+is ratio 1.0 — a pool scaled to zero is exactly as ready as asked.
+
+``clear``: drop the series when the object is deleted.  The registry
+never evicts on its own, so without this a pool deleted mid-degradation
+would keep ``PoolDegraded`` firing forever against an object that no
+longer exists (and haunt ``obs top``)."""
+
+from __future__ import annotations
+
+from ..utils.metrics import MetricsRegistry
+
+_GAUGES = ("pool_ready_replicas", "pool_desired_replicas",
+           "pool_ready_ratio")
+
+
+def export_pool_gauges(metrics: MetricsRegistry, kind: str,
+                       namespace: str, pool: str,
+                       ready: int, desired: int) -> None:
+    labels = {"kind": kind, "namespace": namespace, "pool": pool}
+    metrics.set_gauge("pool_ready_replicas", float(ready), **labels)
+    metrics.set_gauge("pool_desired_replicas", float(desired), **labels)
+    metrics.set_gauge("pool_ready_ratio",
+                      (ready / desired) if desired else 1.0, **labels)
+
+
+def clear_pool_gauges(metrics: MetricsRegistry, kind: str,
+                      namespace: str, pool: str) -> None:
+    for g in _GAUGES:
+        metrics.remove_gauge(g, kind=kind, namespace=namespace, pool=pool)

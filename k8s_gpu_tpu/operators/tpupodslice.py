@@ -30,6 +30,7 @@ from ..cloud.topology import parse_accelerator_type
 from ..controller.events import EventRecorder
 from ..controller.kubefake import Conflict, FakeKube, NotFound
 from ..controller.manager import Reconciler, Request, Result
+from .pool_gauges import clear_pool_gauges, export_pool_gauges
 from ..scheduling.labels import LABEL_POOL, TPU_RESOURCE, node_labels_for_host
 from ..utils.metrics import MetricsRegistry, global_metrics
 from ..utils.tracing import global_tracer
@@ -83,8 +84,13 @@ class TpuPodSliceReconciler(Reconciler):
         ps = self.kube.try_get("TpuPodSlice", req.name, req.namespace)
         if ps is None:
             # Drop phase-transition memory so a recreated slice with the
-            # same name logs its transitions from scratch.
+            # same name logs its transitions from scratch, and retire the
+            # pool gauges — a stale ratio would keep PoolDegraded firing
+            # against an object that no longer exists.
             self._last_phase.pop((req.namespace, req.name), None)
+            clear_pool_gauges(
+                self.metrics, "TpuPodSlice", req.namespace, req.name
+            )
             return Result()
 
         if ps.metadata.deletion_timestamp is not None:
@@ -177,6 +183,12 @@ class TpuPodSliceReconciler(Reconciler):
         # -- project QR state into cluster state + status ------------------
         return self._observe(ps, qr)
 
+    def _pool_gauges(self, ps: TpuPodSlice, ready: int) -> None:
+        export_pool_gauges(
+            self.metrics, "TpuPodSlice", ps.metadata.namespace,
+            ps.metadata.name, ready, ps.spec.slice_count,
+        )
+
     def _observe(self, ps: TpuPodSlice, qr: QueuedResource | None) -> Result:
         gen = ps.metadata.generation
         if qr is None:
@@ -195,6 +207,7 @@ class TpuPodSliceReconciler(Reconciler):
                 observed_generation=gen,
             )
             self._update_status(ps)
+            self._pool_gauges(ps, 0)
             return Result(
                 requeue_after=RESYNC if ps.spec.slice_count == 0 else self.provision_poll
             )
@@ -228,6 +241,7 @@ class TpuPodSliceReconciler(Reconciler):
                 observed_generation=gen,
             )
             self._update_status(ps)
+            self._pool_gauges(ps, 0)
             return Result(requeue_after=self.provision_poll)
 
         # ACTIVE: join each slice's hosts as Nodes with topology labels.
@@ -275,10 +289,7 @@ class TpuPodSliceReconciler(Reconciler):
             observed_generation=gen,
         )
         self._update_status(ps)
-        self.metrics.set_gauge(
-            "pool_ready_replicas", ready_slices,
-            kind="TpuPodSlice", pool=ps.metadata.name,
-        )
+        self._pool_gauges(ps, ready_slices)
         return Result(requeue_after=RESYNC if all_ready else self.provision_poll)
 
     # -- node lifecycle ----------------------------------------------------

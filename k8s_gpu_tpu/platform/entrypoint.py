@@ -51,7 +51,9 @@ def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
     Returns (manager, storage_provisioner); the caller may add device
     capacity to ``storage.pools`` before ``mgr.start()``."""
     from ..cloud.fake_cloudtpu import FakeCloudTpu, cloudtpu_client_factory
+    from ..controller.alerting import AlertEventNotifier
     from ..controller.manager import Manager
+    from ..utils.alerts import RuleEvaluator, default_rule_pack
     from ..operators import (
         DevEnvReconciler,
         GitOpsReconciler,
@@ -66,7 +68,14 @@ def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
     from ..scheduling.queueing import QueueReconciler
 
     cloud = cloud if cloud is not None else FakeCloudTpu()
-    mgr = Manager(kube)
+    # The evaluation half of the observability plane: the default rule
+    # pack ticking on the manager's lifecycle, firing alerts as Warning
+    # Events on the affected objects (ISSUE 4).  The manager registers
+    # the queue-gauge collector on it.
+    evaluator = RuleEvaluator(
+        default_rule_pack(), notify=AlertEventNotifier(kube)
+    )
+    mgr = Manager(kube, alerts=evaluator)
     mgr.register("Deployment", DeploymentReconciler(kube))
     mgr.register(
         "TpuPodSlice",

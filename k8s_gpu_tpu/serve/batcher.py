@@ -549,6 +549,13 @@ class ContinuousBatcher:
         self._interleave_log: collections.deque = collections.deque(
             maxlen=4096
         )
+        # Fleet-utilization telemetry (ISSUE 4): cumulative emissions and
+        # a rolling (time, total) window feed the decode-throughput
+        # gauge; occupancy/fill gauges are recomputed in
+        # _update_util_gauges at admission/round/retire boundaries —
+        # scheduler-thread only, a handful of host ops per round.
+        self._emit_total = 0
+        self._tput_samples: collections.deque = collections.deque(maxlen=64)
         self._admit_jit = jax.jit(self._admit_dev, donate_argnums=(1,))
         # use_top_p is static: two compiled round variants, and the
         # common no-nucleus traffic never pays the full-vocab sort.
@@ -1609,13 +1616,52 @@ class ContinuousBatcher:
         req.inflight_steps = 1
         global_metrics.inc("serve_admissions_total", path=path)
         global_metrics.set_gauge(
-            "serve_slots_active",
-            float(sum(r is not None for r in self._active)),
-        )
-        global_metrics.set_gauge(
             "serve_pending_requests", float(self._pending.qsize())
         )
+        self._update_util_gauges()
         return ("admit", req, first, lp)
+
+    def _update_util_gauges(self) -> None:
+        """Serve-plane utilization gauges — the inputs pooled-accelerator
+        scheduling decisions (and the KVCacheSaturation alert) read:
+
+        - ``serve_slots_active`` / ``serve_slot_fill_ratio``: decode batch
+          occupancy out of the static ``slots`` width;
+        - ``serve_kv_occupancy_ratio``: paged mode reports allocated
+          physical blocks over the usable pool (the trash block is
+          overhead, not capacity); dense mode reports live rows' cache
+          positions over slots×max_seq — reserved-but-unwritten tail
+          counts as free, which is the actionable number (it is what
+          admission can still use);
+        - ``serve_decode_tokens_per_second``: emitted tokens over a
+          rolling host-wall-clock window (dispatch cadence included — the
+          streaming rate callers actually see)."""
+        live = [r for r in self._active if r is not None]
+        global_metrics.set_gauge("serve_slots_active", float(len(live)))
+        global_metrics.set_gauge(
+            "serve_slot_fill_ratio",
+            len(live) / self.slots if self.slots else 0.0,
+        )
+        if self.paged:
+            usable = self.paged_blocks - 1
+            used = usable - len(self._free_blocks)
+            global_metrics.set_gauge("serve_kv_blocks_used", float(used))
+            occ = used / usable if usable else 0.0
+        else:
+            cap = float(self.slots * self.engine.max_seq)
+            occ = (
+                sum(min(r.pos_hint, self.engine.max_seq) for r in live) / cap
+                if cap else 0.0
+            )
+        global_metrics.set_gauge("serve_kv_occupancy_ratio", occ)
+        now = time.monotonic()
+        self._tput_samples.append((now, self._emit_total))
+        t0, n0 = self._tput_samples[0]
+        if now - t0 > 0.0:
+            global_metrics.set_gauge(
+                "serve_decode_tokens_per_second",
+                (self._emit_total - n0) / (now - t0),
+            )
 
     def _adaptive_k(self) -> int:
         """Draft-window size from measured rolling acceptance.
@@ -1789,6 +1835,7 @@ class ContinuousBatcher:
     def _emit(self, req: _Request, tok: int, round_id: int,
               lp: float = 0.0) -> None:
         req.emitted += 1
+        self._emit_total += 1
         req.t_last = time.monotonic()
         if req.emitted == 1:
             req.t_first = req.t_last
@@ -1830,10 +1877,7 @@ class ContinuousBatcher:
             self._free_blocks.extend(req.blocks)
             req.blocks = []
         self._active[slot] = None
-        global_metrics.set_gauge(
-            "serve_slots_active",
-            float(sum(r is not None for r in self._active)),
-        )
+        self._update_util_gauges()
 
     def _shed_expired(self, req: _Request) -> None:
         """Drop an expired request AT ADMISSION: no prefill or decode
@@ -1894,8 +1938,9 @@ class ContinuousBatcher:
             while inflight and inflight[0][0] == "admit":
                 batch.append(inflight.popleft())
             self._process_admits(batch)
-            return
-        self._process(item)
+        else:
+            self._process(item)
+        self._update_util_gauges()
 
     def _process(self, item: tuple) -> None:
         """Consume one in-flight item — the only place the scheduler blocks
@@ -2074,6 +2119,10 @@ class ContinuousBatcher:
                 if (not any_active and self._pending.empty()
                         and not inflight
                         and not (self.paged and self._overflow)):
+                    # Keep sampling while idle so the decode-throughput
+                    # gauge decays to 0 as the window ages out, instead
+                    # of freezing at the last burst's rate forever.
+                    self._update_util_gauges()
                     self._wake.wait(timeout=0.1)
                     self._wake.clear()
                     continue

@@ -358,7 +358,18 @@ class Trainer:
             )
         if sync:
             loss = float(loss)
-        global_metrics.observe("train_step_seconds", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        global_metrics.observe("train_step_seconds", dt)
+        # Fleet telemetry (ISSUE 4): instantaneous step cadence and token
+        # throughput as gauges — the `obs top` train row.  With
+        # sync=False this measures dispatch, not device completion; the
+        # pipelined regime's steady-state rate converges to the true one
+        # (each dispatch blocks once the device queue fills).
+        global_metrics.set_gauge("train_last_step_seconds", dt)
+        if dt > 0.0 and batch:
+            global_metrics.set_gauge(
+                "train_tokens_per_second", float(batch[0].size) / dt
+            )
         return loss
 
     def step_many(self, xs, ys) -> float:

@@ -1,8 +1,14 @@
+from .alerts import (
+    AlertingRule,
+    RecordingRule,
+    RuleEvaluator,
+    default_rule_pack,
+)
 from .clock import Clock, RealClock, FakeClock
 from .faults import FaultInjector, FaultPlan, InjectedFault, global_faults
-from .metrics import MetricsRegistry, global_metrics
+from .metrics import MetricsRegistry, global_metrics, parse_exposition
 from .logstore import LogEntry, LogStore, LogStoreHandler, global_logstore
-from .obs import MetricsServer
+from .obs import MetricsServer, render_top
 from .profiling import profile_trainer, step_annotation, trace, trace_files
 from .tracing import (
     SpanContext,
@@ -14,9 +20,15 @@ from .tracing import (
 )
 
 __all__ = [
+    "AlertingRule",
+    "RecordingRule",
+    "RuleEvaluator",
+    "default_rule_pack",
     "Clock",
     "RealClock",
     "FakeClock",
+    "parse_exposition",
+    "render_top",
     "FaultInjector",
     "FaultPlan",
     "InjectedFault",

@@ -1,0 +1,487 @@
+"""In-process Prometheus-style rules engine: recording + alerting rules.
+
+The reference asks for Prometheus monitoring of GPU utilization, queue
+length and storage plus quota alerting (GPU调度平台搭建.md:798-807), but the
+stack so far stops at raw signal collection — counters and gauges nobody
+evaluates.  This module is the evaluation half, dependency-free (no
+Prometheus server in zero-egress environments):
+
+- **RecordingRule** — a named derived series (error ratio, p95, SLO burn
+  rate) computed from ``MetricsRegistry`` counters/histograms each tick
+  and written back as a gauge, so ``/metrics`` exposes it and later rules
+  can reference it.  Rules evaluate in pack order: a recording rule's
+  output is visible to every rule after it in the same tick.
+- **AlertingRule** — threshold (``above``/``below``) plus a ``for_s``
+  hold duration, per label-set:
+
+      inactive → pending (condition holds, held < for_s)
+               → firing  (held ≥ for_s)
+               → resolved (condition clears after firing; one transition,
+                           then the series is inactive again)
+
+  Every transition bumps ``alert_transitions_total{alertname,to}`` and
+  lands in a bounded timeline; ``alerts_firing{alertname}`` gauges the
+  number of firing label-sets.  A ``notify`` hook fires on
+  firing/resolved — the controller plane wires it to Warning Events on
+  the affected objects (controller/alerting.py).
+- **RuleEvaluator** — owns the rules, a Clock, and counter-rate history.
+  ``evaluate_once()`` is pure function of (registry state, clock time):
+  two runs over the same scripted mutations produce identical transition
+  timelines under ``FakeClock`` — the determinism the chaos/alerts demos
+  assert.  ``start()`` runs the tick loop on a daemon thread (the
+  controller manager owns one in production).
+
+Rate/burn-rate math: the evaluator snapshots each *watched* counter
+family per tick (watching is self-registering — the first ``ctx.rate``
+call on a name starts its history), and ``rate(name, window)`` is the
+per-second increase of the summed matching series between the oldest and
+newest samples inside the window.  ``burn_rate`` divides the bad/total
+ratio by the SLO's error budget — the standard SRE burn-rate signal.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from dataclasses import dataclass, field
+
+from .clock import Clock, RealClock
+from .metrics import MetricsRegistry, global_metrics
+
+log = logging.getLogger("k8s_gpu_tpu.alerts")
+
+# A label-set is the registry's canonical tuple(sorted((k, v), ...)).
+LabelSet = tuple
+
+
+def _match(lbls: LabelSet, where: dict) -> bool:
+    """Label filter: values are exact strings or predicates on the value."""
+    d = dict(lbls)
+    for k, want in where.items():
+        have = d.get(k)
+        if callable(want):
+            if have is None or not want(have):
+                return False
+        elif have != want:
+            return False
+    return True
+
+
+def _normalize(result) -> dict[LabelSet, float]:
+    """Rule expressions may return a scalar (one unlabeled series) or a
+    ``{label_tuple: value}`` dict (one FSM per label-set)."""
+    if result is None:
+        return {}
+    if isinstance(result, dict):
+        return {k: float(v) for k, v in result.items()}
+    return {(): float(result)}
+
+
+class Ctx:
+    """What a rule expression sees for one evaluation tick: registry
+    reads, windowed counter rates, and the tick's clock time."""
+
+    def __init__(self, evaluator: "RuleEvaluator", now: float):
+        self._ev = evaluator
+        self.registry = evaluator.registry
+        self.now = now
+
+    def gauge(self, name: str, default: float = 0.0, **labels) -> float:
+        v = self.registry.gauge(name, **labels)
+        return default if v is None else v
+
+    def series(self, name: str, **where) -> dict[LabelSet, float]:
+        return {
+            lbls: v
+            for lbls, v in self.registry.series(name).items()
+            if _match(lbls, where)
+        }
+
+    def sum(self, name: str, **where) -> float:
+        return float(sum(self.series(name, **where).values()))
+
+    def rate(self, name: str, window: float, **where) -> float:
+        """Per-second increase of the summed matching counter series over
+        the trailing *window* clock-seconds; 0.0 until two samples exist."""
+        return self._ev._rate(name, window, where, self.now)
+
+    def percentile(self, name: str, q: float, **labels) -> float:
+        return self.registry.percentile(name, q, **labels)
+
+    def percentiles(self, name: str, q: float) -> dict[LabelSet, float]:
+        return self.registry.hist_percentiles(name, q)
+
+    @staticmethod
+    def ratio(num: float, den: float) -> float:
+        return num / den if den else 0.0
+
+    def burn_rate(self, name: str, window: float, slo: float,
+                  bad: dict, total: dict | None = None) -> float:
+        """SLO burn rate: (bad-rate / total-rate) / (1 - slo).  1.0 means
+        the error budget burns exactly at the sustainable pace; N means N
+        times too fast."""
+        t = self.rate(name, window, **(total or {}))
+        if t <= 0.0:
+            return 0.0
+        b = self.rate(name, window, **bad)
+        return (b / t) / max(1e-9, 1.0 - slo)
+
+
+@dataclass
+class RecordingRule:
+    """Evaluate ``expr(ctx)`` and write the result back as gauge
+    ``record`` (per label-set when the expr returns a dict)."""
+
+    record: str
+    expr: object
+    labels: dict = field(default_factory=dict)
+
+
+@dataclass
+class AlertingRule:
+    """Threshold alert with a hold duration, one FSM per label-set."""
+
+    name: str
+    expr: object
+    above: float | None = None
+    below: float | None = None
+    for_s: float = 0.0
+    severity: str = "warning"
+    annotation: str = ""
+
+    def breached(self, v: float) -> bool:
+        if self.above is not None and v > self.above:
+            return True
+        if self.below is not None and v < self.below:
+            return True
+        return False
+
+    def annotate(self, lbls: LabelSet, v: float) -> str:
+        if not self.annotation:
+            return ""
+        try:
+            return self.annotation.format(value=v, **dict(lbls))
+        except (KeyError, IndexError, ValueError):
+            return self.annotation
+
+
+class RuleEvaluator:
+    """Evaluates a rule pack against one registry on a Clock cadence.
+
+    ``collectors`` run before every tick — hooks for gauges that need
+    polling rather than event-driven updates (workqueue oldest-item age;
+    the manager registers one).  ``notify(rule, labels, transition,
+    value)`` fires on transitions to ``firing``/``resolved``."""
+
+    def __init__(
+        self,
+        rules,
+        clock: Clock | None = None,
+        registry: MetricsRegistry | None = None,
+        interval: float = 10.0,
+        notify=None,
+        max_timeline: int = 512,
+        history_samples: int = 240,
+    ):
+        self.rules = list(rules)
+        self.clock = clock or RealClock()
+        self.registry = registry or global_metrics
+        self.interval = float(interval)
+        self.notify = notify
+        self.collectors: list = []
+        self.timeline: collections.deque = collections.deque(
+            maxlen=max_timeline
+        )
+        self._history_samples = history_samples
+        self._lock = threading.Lock()
+        self._watched: dict[str, collections.deque] = {}
+        # alertname -> label-set -> {"state", "since", "value"}
+        self._state: dict[str, dict[LabelSet, dict]] = {}
+        self._last_eval = float("-inf")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        for r in self.rules:
+            if isinstance(r, AlertingRule):
+                # Visible from tick 0 so dashboards can tell "no rule" from
+                # "rule evaluated, nothing firing".
+                self.registry.set_gauge(
+                    "alerts_firing", 0.0, alertname=r.name
+                )
+
+    # -- counter-rate history ---------------------------------------------
+    def _rate(self, name: str, window: float, where: dict,
+              now: float) -> float:
+        hist = self._watched.get(name)
+        if hist is None:
+            # Self-registering watch: seed the history with this tick's
+            # snapshot; a rate needs two samples, so this tick reads 0.0.
+            hist = collections.deque(maxlen=self._history_samples)
+            hist.append((now, self.registry.series(name)))
+            self._watched[name] = hist
+            return 0.0
+        inside = [(t, snap) for t, snap in hist if t >= now - window]
+        if len(inside) < 2:
+            return 0.0
+        t0, s0 = inside[0]
+        t1, s1 = inside[-1]
+        if t1 <= t0:
+            return 0.0
+
+        def total(snap):
+            return sum(v for lbls, v in snap.items() if _match(lbls, where))
+
+        return max(0.0, (total(s1) - total(s0)) / (t1 - t0))
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate_once(self) -> None:
+        now = self.clock.now()
+        for c in list(self.collectors):
+            try:
+                c()
+            except Exception:
+                log.exception("alert collector failed")
+        with self._lock:
+            self._last_eval = now
+            # One snapshot per watched family per tick (skip duplicate
+            # timestamps: FakeClock loops may re-enter at the same now).
+            for name, hist in self._watched.items():
+                if not hist or hist[-1][0] < now:
+                    hist.append((now, self.registry.series(name)))
+            ctx = Ctx(self, now)
+            for rule in self.rules:
+                try:
+                    if isinstance(rule, RecordingRule):
+                        self._record(rule, ctx)
+                    else:
+                        self._alert(rule, ctx, now)
+                except Exception:
+                    log.exception("rule %s failed", getattr(
+                        rule, "name", getattr(rule, "record", rule)))
+
+    def _record(self, rule: RecordingRule, ctx: Ctx) -> None:
+        for lbls, v in _normalize(rule.expr(ctx)).items():
+            # Dict variant: source label keys are data and may collide
+            # with the kwargs form's reserved parameter names.
+            self.registry.set_gauge_series(
+                rule.record, v, {**dict(lbls), **rule.labels}
+            )
+
+    def _alert(self, rule: AlertingRule, ctx: Ctx, now: float) -> None:
+        values = _normalize(rule.expr(ctx))
+        st = self._state.setdefault(rule.name, {})
+        for lbls, v in values.items():
+            cur = st.get(lbls)
+            breached = rule.breached(v)
+            if cur is None:
+                if breached:
+                    cur = {"state": "inactive", "since": now, "value": v}
+                    st[lbls] = cur
+                else:
+                    continue
+            cur["value"] = v
+            if cur["state"] == "inactive":
+                if breached:
+                    self._transition(rule, lbls, cur, "pending", v, now)
+            elif cur["state"] == "pending" and not breached:
+                self._transition(rule, lbls, cur, "inactive", v, now)
+            # pending→firing in the SAME tick the hold elapses (for_s=0
+            # traverses inactive→pending→firing in one tick — the full
+            # FSM path is always walked, never skipped).
+            if cur["state"] == "pending" and breached and (
+                now - cur["since"] >= rule.for_s
+            ):
+                self._transition(rule, lbls, cur, "firing", v, now)
+            elif cur["state"] == "firing" and not breached:
+                self._transition(rule, lbls, cur, "resolved", v, now)
+        # Series that vanished from the registry resolve/deactivate too.
+        for lbls in [k for k in st if k not in values]:
+            cur = st[lbls]
+            if cur["state"] == "firing":
+                self._transition(rule, lbls, cur, "resolved",
+                                 cur["value"], now)
+            elif cur["state"] == "pending":
+                self._transition(rule, lbls, cur, "inactive",
+                                 cur["value"], now)
+            else:
+                del st[lbls]
+        self._export_firing(rule, st)
+
+    def _transition(self, rule: AlertingRule, lbls: LabelSet, cur: dict,
+                    to: str, v: float, now: float) -> None:
+        frm = cur["state"]
+        # "resolved" is a recorded transition, not a resting state.
+        cur["state"] = "inactive" if to == "resolved" else to
+        cur["since"] = now
+        self.timeline.append({
+            "t": now, "alert": rule.name, "labels": dict(lbls),
+            "from": frm, "to": to, "value": v,
+        })
+        self.registry.inc(
+            "alert_transitions_total", alertname=rule.name, to=to
+        )
+        if to in ("firing", "resolved") and self.notify is not None:
+            try:
+                self.notify(rule, dict(lbls), to, v)
+            except Exception:
+                log.exception("alert notifier failed for %s", rule.name)
+
+    def _export_firing(self, rule: AlertingRule, st: dict) -> None:
+        firing = sum(1 for c in st.values() if c["state"] == "firing")
+        self.registry.set_gauge(
+            "alerts_firing", float(firing), alertname=rule.name
+        )
+
+    # -- introspection (the /alerts surface) -------------------------------
+    def active_alerts(self) -> list[dict]:
+        """Pending + firing alert instances, firing first."""
+        now = self.clock.now()
+        out = []
+        with self._lock:
+            for rule in self.rules:
+                if not isinstance(rule, AlertingRule):
+                    continue
+                for lbls, cur in self._state.get(rule.name, {}).items():
+                    if cur["state"] not in ("pending", "firing"):
+                        continue
+                    out.append({
+                        "alertname": rule.name,
+                        "labels": dict(lbls),
+                        "state": cur["state"],
+                        "since": cur["since"],
+                        "active_s": max(0.0, now - cur["since"]),
+                        "value": cur["value"],
+                        "severity": rule.severity,
+                        "annotation": rule.annotate(lbls, cur["value"]),
+                    })
+        out.sort(key=lambda a: (a["state"] != "firing", a["alertname"]))
+        return out
+
+    def snapshot(self, limit: int = 100) -> dict:
+        """The ``/alerts`` JSON body: active alerts + recent transitions.
+        The timeline copy happens under the evaluator lock — an HTTP
+        thread iterating the deque while a tick appends would otherwise
+        hit the same mutated-during-iteration race the registry's
+        percentile fix closes."""
+        alerts = self.active_alerts()
+        with self._lock:
+            # limit<=0 means none: a bare [-0:] slice would return ALL.
+            transitions = (
+                list(self.timeline)[-int(limit):] if limit > 0 else []
+            )
+        return {
+            "now": self.clock.now(),
+            "alerts": alerts,
+            "transitions": transitions,
+        }
+
+    # -- the tick loop -----------------------------------------------------
+    def start(self) -> "RuleEvaluator":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="rule-evaluator", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _loop(self) -> None:
+        cond = threading.Condition()
+        while not self._stop.is_set():
+            if self.clock.now() - self._last_eval >= self.interval:
+                try:
+                    self.evaluate_once()
+                except Exception:
+                    log.exception("rule evaluation tick failed")
+            with cond:
+                # Short waits so stop() is responsive under RealClock and
+                # FakeClock's cheap poll keeps ticks aligned to fake time.
+                self.clock.wait(cond, 0.25)
+
+
+def _is_5xx(code: str) -> bool:
+    return str(code).startswith("5")
+
+
+def default_rule_pack(
+    *,
+    slo: float = 0.99,
+    burn_window: float = 300.0,
+    burn_threshold: float = 14.4,
+    queue_depth: float = 10.0,
+    queue_for_s: float = 30.0,
+    kv_ratio: float = 0.9,
+    kv_for_s: float = 10.0,
+    breaker_for_s: float = 10.0,
+    pool_for_s: float = 30.0,
+) -> list:
+    """The platform's default recording + alerting rules.
+
+    Recording: HTTP error ratio and SLO burn rate over ``burn_window``
+    (from ``http_requests_total``), reconcile-duration and serve-TTFT
+    p95s (exact, from the histogram reservoirs).  Alerting: QueueBacklog
+    (per workqueue), KVCacheSaturation, HighErrorBurnRate (on the
+    recorded burn rate — 14.4 is the standard fast-burn page threshold),
+    BreakerOpen (per endpoint; state 2 = open), PoolDegraded (per pool;
+    ratio 1.0 = all desired replicas ready)."""
+    return [
+        RecordingRule(
+            "http_error_ratio",
+            lambda ctx: ctx.ratio(
+                ctx.rate("http_requests_total", burn_window, code=_is_5xx),
+                ctx.rate("http_requests_total", burn_window),
+            ),
+        ),
+        RecordingRule(
+            "slo_burn_rate",
+            lambda ctx: ctx.gauge("http_error_ratio") / max(1e-9, 1.0 - slo),
+        ),
+        RecordingRule(
+            "reconcile_duration_p95",
+            lambda ctx: ctx.percentiles("reconcile_duration_seconds", 0.95),
+        ),
+        RecordingRule(
+            "serve_ttft_p95",
+            lambda ctx: ctx.percentiles("serve_ttft_seconds", 0.95),
+        ),
+        AlertingRule(
+            "QueueBacklog",
+            lambda ctx: ctx.series("workqueue_depth"),
+            above=queue_depth, for_s=queue_for_s,
+            annotation="workqueue {queue} backlog at {value:.0f} items",
+        ),
+        AlertingRule(
+            "KVCacheSaturation",
+            lambda ctx: ctx.series("serve_kv_occupancy_ratio"),
+            above=kv_ratio, for_s=kv_for_s,
+            annotation="KV cache {value:.0%} full — admissions will defer",
+        ),
+        AlertingRule(
+            "HighErrorBurnRate",
+            lambda ctx: ctx.gauge("slo_burn_rate"),
+            above=burn_threshold, for_s=60.0, severity="page",
+            annotation=(
+                "error budget burning {value:.1f}x too fast over the "
+                "short window"
+            ),
+        ),
+        AlertingRule(
+            "BreakerOpen",
+            lambda ctx: ctx.series("circuit_breaker_state"),
+            above=1.5, for_s=breaker_for_s,
+            annotation="circuit breaker {endpoint} is open",
+        ),
+        AlertingRule(
+            "PoolDegraded",
+            lambda ctx: ctx.series("pool_ready_ratio"),
+            below=1.0, for_s=pool_for_s,
+            annotation="pool {pool} ({kind}) at {value:.0%} of desired",
+        ),
+    ]

@@ -8,6 +8,7 @@ here rather than an afterthought.
 
 from __future__ import annotations
 
+import re
 import threading
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
@@ -44,10 +45,20 @@ class Histogram:
         self.counts[-1] += 1
 
     def percentile(self, q: float) -> float:
-        """Exact q-quantile over the (rolling) reservoir; 0.0 if empty."""
-        if not self.raw:
+        """Exact q-quantile over the (rolling) reservoir; 0.0 if empty.
+
+        Concurrency-safe access goes through ``MetricsRegistry.percentile``
+        (which holds the registry lock that ``observe`` also holds); a bare
+        call retries if a concurrent append mutates the deque mid-sort."""
+        while True:
+            try:
+                s = sorted(self.raw)
+                break
+            except RuntimeError:
+                # deque mutated during iteration — take a fresh snapshot
+                continue
+        if not s:
             return 0.0
-        s = sorted(self.raw)
         k = min(len(s) - 1, max(0, int(q * len(s))))
         return s[k]
 
@@ -57,29 +68,85 @@ class Histogram:
 
 
 class MetricsRegistry:
-    """Thread-safe counters, gauges, histograms with label support."""
+    """Thread-safe counters, gauges, histograms with label support.
 
-    def __init__(self):
+    ``max_series_per_name`` caps unique label-sets per metric name: writes
+    past the cap collapse to the single series ``{other="true"}`` and bump
+    ``metrics_series_dropped_total{metric}`` — the registry never evicts,
+    so a direct ``inc()`` site fed attacker-controlled label values must
+    not be able to mint unbounded series (the same property
+    ``RequestMetricsMixin._route`` enforces for HTTP routes)."""
+
+    _OVERFLOW = (("other", "true"),)
+
+    def __init__(self, max_series_per_name: int = 256):
         self._lock = threading.Lock()
         self._counters: dict[tuple, float] = defaultdict(float)
         self._gauges: dict[tuple, float] = {}
         self._hists: dict[tuple, Histogram] = {}
+        self.max_series_per_name = max(1, int(max_series_per_name))
+        self._series_seen: dict[str, set] = defaultdict(set)
 
     @staticmethod
     def _key(name: str, labels: dict | None) -> tuple:
         return (name, tuple(sorted((labels or {}).items())))
 
+    def _key_write(self, name: str, labels: dict | None) -> tuple:
+        """The write-path key: tracks per-name label-set cardinality and
+        collapses overflow.  Lock held by caller; reads use ``_key`` (a
+        lookup must never mint a series)."""
+        k = self._key(name, labels)
+        lbls = k[1]
+        if not lbls:
+            return k
+        seen = self._series_seen[name]
+        if lbls in seen:
+            return k
+        if len(seen) >= self.max_series_per_name:
+            # Bounded by the number of metric NAMES, so this counter's own
+            # label can't itself explode.
+            self._counters[
+                ("metrics_series_dropped_total", (("metric", name),))
+            ] += 1
+            return (name, self._OVERFLOW)
+        seen.add(lbls)
+        return k
+
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
         with self._lock:
-            self._counters[self._key(name, labels)] += value
+            self._counters[self._key_write(name, labels)] += value
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
         with self._lock:
-            self._gauges[self._key(name, labels)] = value
+            self._gauges[self._key_write(name, labels)] = value
+
+    def set_gauge_series(self, name: str, value: float,
+                         labels: dict) -> None:
+        """Explicit-dict variant of ``set_gauge`` for label keys the
+        kwargs form reserves (``name``/``value``) — the path rebuilding a
+        registry from a parsed exposition, where label keys are data."""
+        with self._lock:
+            self._gauges[self._key_write(name, labels)] = value
+
+    def remove_gauge(self, name: str, **labels) -> None:
+        """Delete one gauge series — the ONLY eviction the registry
+        allows, for per-object gauges whose object is gone (a deleted
+        pool's ready-ratio).  Counters/histograms stay append-only; a
+        stale gauge would otherwise keep object-scoped alerts firing
+        forever against nothing.  The label-set's cardinality slot is
+        freed too (unless a counter/histogram still holds the same
+        series): object churn must not ratchet toward the cap, or the
+        N+1th pool's gauges would collapse into the overflow series —
+        which nothing can ever clear."""
+        with self._lock:
+            k = self._key(name, labels)
+            self._gauges.pop(k, None)
+            if k not in self._counters and k not in self._hists:
+                self._series_seen.get(name, set()).discard(k[1])
 
     def observe(self, name: str, value: float, **labels) -> None:
         with self._lock:
-            k = self._key(name, labels)
+            k = self._key_write(name, labels)
             if k not in self._hists:
                 self._hists[k] = Histogram()
             self._hists[k].observe(value)
@@ -95,6 +162,38 @@ class MetricsRegistry:
     def histogram(self, name: str, **labels) -> Histogram | None:
         with self._lock:
             return self._hists.get(self._key(name, labels))
+
+    def percentile(self, name: str, q: float, **labels) -> float:
+        """Exact q-quantile of a histogram's reservoir, snapshotted UNDER
+        the registry lock — ``observe`` holds the same lock, so the sort
+        can never race a concurrent append (the ``RuntimeError: deque
+        mutated during iteration`` hazard of sorting a live handle)."""
+        with self._lock:
+            h = self._hists.get(self._key(name, labels))
+            return h.percentile(q) if h is not None else 0.0
+
+    def series(self, name: str) -> dict[tuple, float]:
+        """Snapshot every series of *name* across counters and gauges:
+        ``{label_tuple: value}`` — the rules engine's read surface."""
+        with self._lock:
+            out: dict[tuple, float] = {}
+            for (n, lbls), v in self._counters.items():
+                if n == name:
+                    out[lbls] = v
+            for (n, lbls), v in self._gauges.items():
+                if n == name:
+                    out[lbls] = v
+            return out
+
+    def hist_percentiles(self, name: str, q: float) -> dict[tuple, float]:
+        """Per-label-set exact percentiles for one histogram family,
+        computed under the lock: ``{label_tuple: quantile}``."""
+        with self._lock:
+            return {
+                lbls: h.percentile(q)
+                for (n, lbls), h in self._hists.items()
+                if n == name
+            }
 
     def render(self) -> str:
         """Prometheus text exposition format (scrape-compatible subset)."""
@@ -124,6 +223,35 @@ def _fmt(labels: tuple) -> str:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in labels)
     return "{" + inner + "}"
+
+
+_EXPO_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$"
+)
+_EXPO_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_exposition(text: str) -> dict[str, dict[tuple, float]]:
+    """Parse the text exposition format ``render`` emits back into
+    ``{name: {label_tuple: value}}`` — what lets ``obs top`` render a
+    fleet-utilization snapshot from ONE ``/metrics`` scrape (or the
+    persisted ``metrics.prom``) without any client library."""
+    out: dict[str, dict[tuple, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _EXPO_LINE.match(line)
+        if m is None:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = tuple(sorted(_EXPO_LABEL.findall(raw_labels or "")))
+        out.setdefault(name, {})[labels] = value
+    return out
 
 
 global_metrics = MetricsRegistry()

@@ -5,7 +5,9 @@ length and storage usage plus quota alerting (GPU调度平台搭建.md:798-807)
 but ships no endpoint.  Here the controller manager's metrics registry is
 served on a real ``/metrics`` endpoint (text exposition format) with
 ``/healthz``/``/readyz`` probes — what a Prometheus in the cluster would
-scrape off this control plane.
+scrape off this control plane — plus ``/alerts``: the in-process rules
+engine's firing/pending alerts and transition timeline as JSON
+(utils/alerts.py), the quota-alerting half of the same prose spec.
 """
 
 from __future__ import annotations
@@ -15,18 +17,22 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .metrics import MetricsRegistry, global_metrics
+from .metrics import MetricsRegistry, global_metrics, parse_exposition
 from .tracing import Tracer, global_tracer, parse_traceparent
 
 
 class MetricsServer:
-    """Serves /metrics, /debug/traces, /healthz, /readyz on a daemon
-    thread.
+    """Serves /metrics, /alerts, /debug/traces, /healthz, /readyz on a
+    daemon thread.
 
     ``port=0`` binds an ephemeral port (tests); ``.port`` is the bound one.
     ``ready_check`` lets the owner gate readiness (e.g. manager started).
     ``/debug/traces`` exposes the tracer's assembled traces as JSON,
     filterable by ``trace_id=``, ``min_ms=``, ``name=``, ``limit=``.
+    ``alerts`` is a ``utils.alerts.RuleEvaluator``; without one,
+    ``/alerts`` answers 404.  The handler instruments ITSELF through
+    ``RequestMetricsMixin`` (server label ``"obs"``), so scrape traffic
+    shows up in ``http_requests_total`` like every other HTTP plane.
     """
 
     def __init__(
@@ -36,54 +42,37 @@ class MetricsServer:
         port: int = 0,
         ready_check=None,
         tracer: Tracer | None = None,
+        alerts=None,
     ):
         self.registry = registry or global_metrics
         self.tracer = tracer or global_tracer
+        self.alerts = alerts
         self.started_at = time.time()
         self._ready_check = ready_check
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (stdlib API name)
-                if self.path == "/metrics":
+        class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
+            metrics_server_label = "obs"
+            known_routes = (
+                "/debug/traces", "/metrics", "/alerts", "/healthz",
+                "/readyz",
+            )
+
+            def _get(self):
+                path = self.path.split("?")[0]
+                if path == "/metrics":
                     body = outer.registry.render().encode()
                     self._send(200, body, "text/plain; version=0.0.4")
-                elif self.path.split("?")[0] == "/debug/traces":
-                    from urllib.parse import parse_qs, urlparse
-
-                    q = parse_qs(urlparse(self.path).query)
-
-                    def one(key, default=""):
-                        return (q.get(key) or [default])[0]
-
-                    try:
-                        min_ms = float(one("min_ms", "0"))
-                        limit = int(one("limit", "50"))
-                    except ValueError:
-                        return self._send(
-                            400,
-                            json.dumps({
-                                "error": "min_ms/limit must be numeric"
-                            }).encode(),
-                            "application/json",
-                        )
-                    traces = outer.tracer.traces(
-                        trace_id=one("trace_id") or None,
-                        min_ms=min_ms,
-                        name=one("name"),
-                        limit=limit,
-                    )
-                    self._send(
-                        200,
-                        json.dumps({"traces": traces}).encode(),
-                        "application/json",
-                    )
-                elif self.path == "/healthz":
+                elif path == "/alerts":
+                    self._alerts()
+                elif path == "/debug/traces":
+                    self._traces()
+                elif path == "/healthz":
                     body = json.dumps(
                         {"ok": True, "uptime_s": time.time() - outer.started_at}
                     ).encode()
                     self._send(200, body, "application/json")
-                elif self.path == "/readyz":
+                elif path == "/readyz":
                     ready = (
                         outer._ready_check() if outer._ready_check else True
                     )
@@ -95,7 +84,74 @@ class MetricsServer:
                 else:
                     self._send(404, b"not found", "text/plain")
 
+            def _post(self):
+                self._send(404, b"not found", "text/plain")
+
+            def _query(self):
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+
+                def one(key, default=""):
+                    return (q.get(key) or [default])[0]
+
+                return one
+
+            def _alerts(self):
+                if outer.alerts is None:
+                    return self._send(
+                        404,
+                        json.dumps(
+                            {"error": "no rules engine attached"}
+                        ).encode(),
+                        "application/json",
+                    )
+                one = self._query()
+                try:
+                    limit = int(one("limit", "100"))
+                except ValueError:
+                    return self._send(
+                        400,
+                        json.dumps({"error": "limit must be an int"}).encode(),
+                        "application/json",
+                    )
+                snap = outer.alerts.snapshot(limit=limit)
+                state = one("state")
+                if state:
+                    snap["alerts"] = [
+                        a for a in snap["alerts"] if a["state"] == state
+                    ]
+                self._send(
+                    200, json.dumps(snap).encode(), "application/json"
+                )
+
+            def _traces(self):
+                one = self._query()
+                try:
+                    min_ms = float(one("min_ms", "0"))
+                    limit = int(one("limit", "50"))
+                except ValueError:
+                    return self._send(
+                        400,
+                        json.dumps({
+                            "error": "min_ms/limit must be numeric"
+                        }).encode(),
+                        "application/json",
+                    )
+                traces = outer.tracer.traces(
+                    trace_id=one("trace_id") or None,
+                    min_ms=min_ms,
+                    name=one("name"),
+                    limit=limit,
+                )
+                self._send(
+                    200,
+                    json.dumps({"traces": traces}).encode(),
+                    "application/json",
+                )
+
             def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self._last_code = code
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -146,8 +202,9 @@ class RequestMetricsMixin:
     known_routes: tuple[str, ...] = ()
     trace_ctx = None
     # Probe routes don't open spans: a kubelet hitting /healthz every few
-    # seconds would churn real traces out of the bounded ring.
-    trace_exempt_routes: tuple[str, ...] = ("/healthz", "/readyz")
+    # seconds would churn real traces out of the bounded ring.  /metrics
+    # scrapes are probe-cadence traffic too.
+    trace_exempt_routes: tuple[str, ...] = ("/healthz", "/readyz", "/metrics")
 
     def _route(self) -> str:
         path = self.path.split("?")[0]
@@ -191,3 +248,91 @@ class RequestMetricsMixin:
 
     def do_POST(self):  # noqa: N802
         self._timed("POST", self._post)
+
+
+def render_top(text: str) -> str:
+    """The ``obs top`` view: a fleet-utilization snapshot rendered from
+    ONE ``/metrics`` exposition (a live scrape or the persisted
+    ``metrics.prom``) — KV/batch occupancy on the serve plane, per-queue
+    depth/age on the control plane, ready ratios per pool, and the train
+    plane's step cadence.  Families absent from the scrape render as
+    "-" rather than erroring: a control-plane-only snapshot is normal."""
+    fam = parse_exposition(text)
+
+    def one(name, default=None):
+        series = fam.get(name)
+        if not series:
+            return default
+        return next(iter(series.values()))
+
+    def pct(v):
+        return f"{v:6.1%}" if v is not None else "     -"
+
+    def num(v, fmt="{:,.1f}"):
+        return fmt.format(v) if v is not None else "-"
+
+    lines = ["FLEET UTILIZATION", ""]
+    lines.append("serve plane")
+    lines.append(
+        f"  kv occupancy {pct(one('serve_kv_occupancy_ratio'))}"
+        f"   batch fill {pct(one('serve_slot_fill_ratio'))}"
+        f"   slots active {num(one('serve_slots_active'), '{:,.0f}')}"
+    )
+    lines.append(
+        f"  pending reqs {num(one('serve_pending_requests'), '{:,.0f}'):>7}"
+        f"   decode tok/s {num(one('serve_decode_tokens_per_second'))}"
+        f"   kv blocks used {num(one('serve_kv_blocks_used'), '{:,.0f}')}"
+    )
+    lines.append("")
+    lines.append("controller queues")
+    depths = fam.get("workqueue_depth", {})
+    ages = fam.get("workqueue_oldest_age_seconds", {})
+    if depths:
+        lines.append(f"  {'QUEUE':<24} {'DEPTH':>6} {'OLDEST(S)':>10}")
+        for lbls, depth in sorted(depths.items()):
+            name = dict(lbls).get("queue", "?")
+            age = ages.get(lbls)
+            lines.append(
+                f"  {name:<24} {depth:>6.0f} "
+                f"{age if age is not None else float('nan'):>10.1f}"
+            )
+    else:
+        lines.append("  (no workqueue gauges in this snapshot)")
+    lines.append("")
+    lines.append("accelerator pools")
+    ready = fam.get("pool_ready_replicas", {})
+    desired = fam.get("pool_desired_replicas", {})
+    ratios = fam.get("pool_ready_ratio", {})
+    if ratios or ready:
+        lines.append(
+            f"  {'KIND':<14} {'POOL':<20} {'READY':>5} {'DESIRED':>7} "
+            f"{'RATIO':>7}"
+        )
+        for lbls in sorted(set(ready) | set(ratios)):
+            d = dict(lbls)
+            r = ratios.get(lbls)
+            pool = d.get("pool", "?")
+            if d.get("namespace"):
+                pool = f"{d['namespace']}/{pool}"
+            lines.append(
+                f"  {d.get('kind', '?'):<14} {pool:<20} "
+                f"{num(ready.get(lbls), '{:,.0f}'):>5} "
+                f"{num(desired.get(lbls), '{:,.0f}'):>7} "
+                f"{pct(r):>7}"
+            )
+    else:
+        lines.append("  (no pool gauges in this snapshot)")
+    lines.append("")
+    lines.append("train plane")
+    lines.append(
+        f"  last step {num(one('train_last_step_seconds'), '{:.3f}')} s"
+        f"   tokens/s {num(one('train_tokens_per_second'))}"
+    )
+    firing = fam.get("alerts_firing", {})
+    hot = {dict(l).get("alertname", "?"): v for l, v in firing.items() if v}
+    lines.append("")
+    lines.append(
+        "alerts firing: "
+        + (", ".join(sorted(hot)) if hot else "none")
+    )
+    return "\n".join(lines)

@@ -1,0 +1,682 @@
+"""Rules engine (ISSUE 4): recording/alerting rules evaluate
+deterministically under FakeClock, the default pack fires and resolves on
+synthetic registry state, /alerts serves the engine's view, and the
+metrics-registry hardening (percentile snapshot, cardinality cap) holds
+under concurrency.  Named test_alert_rules so it sorts early inside the
+tier-1 870 s window."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from k8s_gpu_tpu.utils.alerts import (
+    AlertingRule,
+    RecordingRule,
+    RuleEvaluator,
+    default_rule_pack,
+)
+from k8s_gpu_tpu.utils.clock import FakeClock
+from k8s_gpu_tpu.utils.metrics import MetricsRegistry, parse_exposition
+from k8s_gpu_tpu.utils.obs import MetricsServer, render_top
+
+
+def _tick(ev, clock, dt=0.0):
+    if dt:
+        clock.advance(dt)
+    ev.evaluate_once()
+
+
+def _states(ev):
+    return {
+        (a["alertname"], tuple(sorted(a["labels"].items()))): a["state"]
+        for a in ev.active_alerts()
+    }
+
+
+def _fingerprint(ev):
+    return [
+        (t["t"], t["alert"], tuple(sorted(t["labels"].items())),
+         t["from"], t["to"])
+        for t in ev.timeline
+    ]
+
+
+# -- registry hardening -----------------------------------------------------
+
+def test_percentile_hammer_under_concurrent_observe():
+    """registry.percentile snapshots under the registry lock — concurrent
+    observe() appends must never blow up the sort (the deque-mutated-
+    during-iteration race) and the result stays within observed range."""
+    reg = MetricsRegistry()
+    stop = threading.Event()
+
+    def hammer(tid):
+        i = 0
+        while not stop.is_set():
+            reg.observe("lat", (i % 100) / 100.0, worker=str(tid))
+            reg.observe("lat", (i % 100) / 100.0)
+            i += 1
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(2000):
+            p = reg.percentile("lat", 0.95)
+            assert 0.0 <= p <= 1.0
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+
+def test_histogram_direct_percentile_retries_on_mutation():
+    """The bare Histogram path stays usable too (bench holds direct
+    handles): a hammered direct percentile never raises."""
+    reg = MetricsRegistry()
+    reg.observe("h", 0.5)
+    h = reg.histogram("h")
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            reg.observe("h", (i % 100) / 100.0)
+            i += 1
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for _ in range(2000):
+            assert 0.0 <= h.percentile(0.5) <= 1.0
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_label_cardinality_guard_collapses_overflow():
+    reg = MetricsRegistry(max_series_per_name=4)
+    for i in range(10):
+        reg.inc("req_total", route=f"/r{i}")
+    series = reg.series("req_total")
+    # 4 real series + the single collapsed overflow series.
+    assert len(series) == 5
+    assert reg.counter("req_total", other="true") == 6.0
+    assert reg.counter(
+        "metrics_series_dropped_total", metric="req_total"
+    ) == 6.0
+    # Existing series keep incrementing normally past the cap.
+    reg.inc("req_total", route="/r0")
+    assert reg.counter("req_total", route="/r0") == 2.0
+    # Gauges and histograms ride the same guard.
+    for i in range(10):
+        reg.set_gauge("g", float(i), src=f"s{i}")
+        reg.observe("h", 0.1, src=f"s{i}")
+    assert reg.gauge("g", other="true") is not None
+    assert reg.histogram("h", other="true") is not None
+    # Unlabeled series never count against the cap.
+    reg.inc("req_total")
+    assert reg.counter("req_total") == 1.0
+
+
+def test_remove_gauge_frees_cardinality_slot():
+    """Object churn (create/delete pools forever) must not ratchet
+    toward the cap: removing a gauge frees its slot unless a counter or
+    histogram still holds the same series — otherwise the N+1th pool's
+    gauges would collapse into the overflow series, which nothing can
+    ever clear."""
+    reg = MetricsRegistry(max_series_per_name=4)
+    for i in range(20):
+        reg.set_gauge("pool_ready_ratio", 0.5, pool=f"p{i}")
+        reg.remove_gauge("pool_ready_ratio", pool=f"p{i}")
+    # Every write landed on a real series, never the overflow.
+    assert reg.counter(
+        "metrics_series_dropped_total", metric="pool_ready_ratio"
+    ) == 0.0
+    assert reg.series("pool_ready_ratio") == {}
+    # A counter sharing the series pins the slot (counters never evict).
+    reg.inc("shared", pool="x")
+    reg.set_gauge("shared", 1.0, pool="x")
+    reg.remove_gauge("shared", pool="x")
+    reg.set_gauge("shared", 2.0, pool="x")
+    assert reg.gauge("shared", pool="x") == 2.0
+
+
+def test_snapshot_limit_zero_returns_no_transitions():
+    clock, reg = FakeClock(), MetricsRegistry()
+    rule = AlertingRule("Hot", lambda ctx: ctx.gauge("t"), above=1.0)
+    ev = RuleEvaluator([rule], clock=clock, registry=reg)
+    reg.set_gauge("t", 5.0)
+    ev.evaluate_once()
+    assert len(ev.snapshot(limit=100)["transitions"]) == 2
+    assert ev.snapshot(limit=0)["transitions"] == []
+    assert len(ev.snapshot(limit=1)["transitions"]) == 1
+
+
+def test_parse_exposition_roundtrip():
+    reg = MetricsRegistry()
+    reg.inc("c_total", 3.0, kind="A")
+    reg.set_gauge("g", 0.5, pool="p", kind="B")
+    reg.observe("lat", 0.02)
+    fam = parse_exposition(reg.render())
+    assert fam["c_total"][(("kind", "A"),)] == 3.0
+    assert fam["g"][(("kind", "B"), ("pool", "p"))] == 0.5
+    assert fam["lat_count"][()] == 1.0
+    assert any(k for k in fam if k == "lat_bucket")
+
+
+# -- rules engine core ------------------------------------------------------
+
+def test_recording_rule_writes_gauge_visible_to_later_rules():
+    clock, reg = FakeClock(), MetricsRegistry()
+    reg.inc("widgets_total", 8.0, kind="a")
+    reg.inc("widgets_total", 2.0, kind="b")
+    rules = [
+        RecordingRule(
+            "widget_a_ratio",
+            lambda ctx: ctx.ratio(
+                ctx.sum("widgets_total", kind="a"),
+                ctx.sum("widgets_total"),
+            ),
+        ),
+        AlertingRule(
+            "WidgetSkew", lambda ctx: ctx.gauge("widget_a_ratio"),
+            above=0.5, for_s=0.0,
+        ),
+    ]
+    ev = RuleEvaluator(rules, clock=clock, registry=reg)
+    ev.evaluate_once()
+    assert reg.gauge("widget_a_ratio") == pytest.approx(0.8)
+    # Pack order: the recorded gauge fed the alert in the SAME tick.
+    assert _states(ev) == {("WidgetSkew", ()): "firing"}
+
+
+def test_alert_fsm_hold_duration_and_transitions():
+    clock, reg = FakeClock(), MetricsRegistry()
+    rule = AlertingRule(
+        "Hot", lambda ctx: ctx.gauge("temp"), above=100.0, for_s=30.0
+    )
+    ev = RuleEvaluator([rule], clock=clock, registry=reg)
+    reg.set_gauge("temp", 50.0)
+    _tick(ev, clock)
+    assert _states(ev) == {}
+    reg.set_gauge("temp", 150.0)
+    _tick(ev, clock, 10.0)          # breach starts → pending
+    assert _states(ev) == {("Hot", ()): "pending"}
+    _tick(ev, clock, 10.0)          # held 10 s < 30 s → still pending
+    assert _states(ev) == {("Hot", ()): "pending"}
+    assert reg.gauge("alerts_firing", alertname="Hot") == 0.0
+    _tick(ev, clock, 25.0)          # held 35 s ≥ 30 s → firing
+    assert _states(ev) == {("Hot", ()): "firing"}
+    assert reg.gauge("alerts_firing", alertname="Hot") == 1.0
+    reg.set_gauge("temp", 20.0)
+    _tick(ev, clock, 5.0)           # clears → resolved (then inactive)
+    assert _states(ev) == {}
+    assert reg.gauge("alerts_firing", alertname="Hot") == 0.0
+    assert [(t["from"], t["to"]) for t in ev.timeline] == [
+        ("inactive", "pending"), ("pending", "firing"),
+        ("firing", "resolved"),
+    ]
+    assert reg.counter(
+        "alert_transitions_total", alertname="Hot", to="firing"
+    ) == 1.0
+
+
+def test_pending_deflickers_without_firing():
+    """A breach shorter than for_s never fires (and never notifies)."""
+    clock, reg = FakeClock(), MetricsRegistry()
+    fired = []
+    rule = AlertingRule(
+        "Flap", lambda ctx: ctx.gauge("v"), above=1.0, for_s=60.0
+    )
+    ev = RuleEvaluator(
+        [rule], clock=clock, registry=reg,
+        notify=lambda *a: fired.append(a),
+    )
+    reg.set_gauge("v", 5.0)
+    _tick(ev, clock)
+    reg.set_gauge("v", 0.0)
+    _tick(ev, clock, 10.0)
+    assert _states(ev) == {}
+    assert fired == []
+    assert [(t["from"], t["to"]) for t in ev.timeline] == [
+        ("inactive", "pending"), ("pending", "inactive"),
+    ]
+
+
+def test_per_labelset_fsm_is_independent():
+    clock, reg = FakeClock(), MetricsRegistry()
+    rule = AlertingRule(
+        "Deep", lambda ctx: ctx.series("depth"), above=5.0, for_s=0.0
+    )
+    ev = RuleEvaluator([rule], clock=clock, registry=reg)
+    reg.set_gauge("depth", 10.0, queue="a")
+    reg.set_gauge("depth", 1.0, queue="b")
+    _tick(ev, clock)
+    st = _states(ev)
+    assert st[("Deep", (("queue", "a"),))] == "firing"
+    assert ("Deep", (("queue", "b"),)) not in st
+    assert reg.gauge("alerts_firing", alertname="Deep") == 1.0
+    reg.set_gauge("depth", 9.0, queue="b")
+    _tick(ev, clock, 1.0)
+    assert reg.gauge("alerts_firing", alertname="Deep") == 2.0
+
+
+def test_counter_rate_and_burn_rate():
+    clock, reg = FakeClock(), MetricsRegistry()
+    pack = default_rule_pack(slo=0.99, burn_window=300.0,
+                             burn_threshold=14.4)
+    ev = RuleEvaluator(pack, clock=clock, registry=reg)
+    # 100 req/tick, 30% 5xx → error ratio 0.3 → burn 0.3/0.01 = 30 > 14.4.
+    for _ in range(8):
+        reg.inc("http_requests_total", 70.0, code="200", server="lm")
+        reg.inc("http_requests_total", 30.0, code="503", server="lm")
+        _tick(ev, clock, 10.0)
+    assert reg.gauge("http_error_ratio") == pytest.approx(0.3)
+    assert reg.gauge("slo_burn_rate") == pytest.approx(30.0)
+    st = _states(ev)
+    assert st.get(("HighErrorBurnRate", ())) in ("pending", "firing")
+    # Hold 60 s from when the burn first breached; keep burning.
+    for _ in range(6):
+        reg.inc("http_requests_total", 70.0, code="200", server="lm")
+        reg.inc("http_requests_total", 30.0, code="503", server="lm")
+        _tick(ev, clock, 10.0)
+    assert _states(ev)[("HighErrorBurnRate", ())] == "firing"
+    # Traffic goes clean → ratio decays inside the window → resolves.
+    for _ in range(40):
+        reg.inc("http_requests_total", 100.0, code="200", server="lm")
+        _tick(ev, clock, 10.0)
+    assert ("HighErrorBurnRate", ()) not in _states(ev)
+    assert reg.counter(
+        "alert_transitions_total", alertname="HighErrorBurnRate",
+        to="resolved",
+    ) == 1.0
+
+
+# -- the default pack, rule by rule ----------------------------------------
+
+@pytest.mark.parametrize(
+    "alert,gauge,labels,bad,good",
+    [
+        ("QueueBacklog", "workqueue_depth", {"queue": "TpuPodSlice"},
+         50.0, 1.0),
+        ("KVCacheSaturation", "serve_kv_occupancy_ratio", {}, 0.97, 0.2),
+        ("BreakerOpen", "circuit_breaker_state",
+         {"endpoint": "cloudtpu.list"}, 2.0, 0.0),
+        ("PoolDegraded", "pool_ready_ratio",
+         {"kind": "TpuPodSlice", "pool": "demo"}, 0.5, 1.0),
+    ],
+)
+def test_default_pack_fires_and_resolves(alert, gauge, labels, bad, good):
+    clock, reg = FakeClock(), MetricsRegistry()
+    ev = RuleEvaluator(default_rule_pack(), clock=clock, registry=reg)
+    key = (alert, tuple(sorted(labels.items())))
+    reg.set_gauge(gauge, bad, **labels)
+    _tick(ev, clock)
+    assert _states(ev)[key] == "pending"
+    _tick(ev, clock, 120.0)  # past every rule's hold duration
+    assert _states(ev)[key] == "firing"
+    assert reg.gauge("alerts_firing", alertname=alert) == 1.0
+    reg.set_gauge(gauge, good, **labels)
+    _tick(ev, clock, 10.0)
+    assert key not in _states(ev)
+    assert reg.gauge("alerts_firing", alertname=alert) == 0.0
+    path = [(t["from"], t["to"]) for t in ev.timeline
+            if t["alert"] == alert]
+    assert path == [("inactive", "pending"), ("pending", "firing"),
+                    ("firing", "resolved")]
+
+
+def test_two_runs_identical_timelines():
+    """Determinism: the same scripted registry mutations under FakeClock
+    produce bit-identical transition timelines."""
+
+    def run():
+        clock, reg = FakeClock(), MetricsRegistry()
+        ev = RuleEvaluator(default_rule_pack(), clock=clock, registry=reg)
+        reg.set_gauge("circuit_breaker_state", 2.0, endpoint="e1")
+        reg.set_gauge("pool_ready_ratio", 0.0, kind="TpuPodSlice",
+                      pool="p")
+        _tick(ev, clock)
+        _tick(ev, clock, 15.0)
+        _tick(ev, clock, 20.0)
+        reg.set_gauge("circuit_breaker_state", 0.0, endpoint="e1")
+        reg.set_gauge("pool_ready_ratio", 1.0, kind="TpuPodSlice",
+                      pool="p")
+        _tick(ev, clock, 10.0)
+        return _fingerprint(ev)
+
+    a, b = run(), run()
+    assert a == b and len(a) >= 6
+
+
+def test_vanished_series_resolves():
+    """A label-set that disappears from the registry (restarted process,
+    replaced endpoint) resolves instead of firing forever."""
+    clock = FakeClock()
+    values = {"x": {(("q", "a"),): 10.0}}
+    rule = AlertingRule("Gone", lambda ctx: values["x"], above=1.0,
+                        for_s=0.0)
+    ev = RuleEvaluator([rule], clock=clock, registry=MetricsRegistry())
+    _tick(ev, clock)
+    assert len(_states(ev)) == 1
+    values["x"] = {}
+    _tick(ev, clock, 1.0)
+    assert _states(ev) == {}
+    assert ev.timeline[-1]["to"] == "resolved"
+
+
+# -- workqueue + notifier + manager wiring ---------------------------------
+
+def test_workqueue_exports_depth_and_oldest_age(clock):
+    from k8s_gpu_tpu.controller.workqueue import RateLimitingQueue
+
+    reg = MetricsRegistry()
+    q = RateLimitingQueue(clock=clock, name="demo", registry=reg)
+    q.add("a")
+    clock.advance(5.0)
+    q.add("b")
+    q.export_gauges()
+    assert reg.gauge("workqueue_depth", queue="demo") == 2.0
+    assert reg.gauge(
+        "workqueue_oldest_age_seconds", queue="demo"
+    ) == pytest.approx(5.0)
+    assert q.get(block=False) == "a"
+    q.export_gauges()
+    assert reg.gauge("workqueue_depth", queue="demo") == 1.0
+    # b was enqueued at t=5 → age 0 now.
+    assert reg.gauge(
+        "workqueue_oldest_age_seconds", queue="demo"
+    ) == pytest.approx(0.0)
+    q.done("a")
+    assert q.get(block=False) == "b"
+    q.export_gauges()
+    assert reg.gauge("workqueue_depth", queue="demo") == 0.0
+    assert reg.gauge(
+        "workqueue_oldest_age_seconds", queue="demo"
+    ) == pytest.approx(0.0)
+
+
+def test_workqueue_scheduled_future_items_are_not_backlog(clock):
+    """Steady-state resyncs parked on add_after deadlines must NOT count
+    as depth (QueueBacklog would fire forever on a healthy idle fleet);
+    they join the backlog the tick they come due."""
+    from k8s_gpu_tpu.controller.workqueue import RateLimitingQueue
+
+    reg = MetricsRegistry()
+    q = RateLimitingQueue(clock=clock, name="demo", registry=reg)
+    for i in range(15):
+        q.add_after(f"resync-{i}", 60.0)
+    q.export_gauges()
+    assert reg.gauge("workqueue_depth", queue="demo") == 0.0
+    assert reg.gauge(
+        "workqueue_oldest_age_seconds", queue="demo"
+    ) == 0.0
+    clock.advance(70.0)
+    q.export_gauges()
+    assert reg.gauge("workqueue_depth", queue="demo") == 15.0
+    # Due at t=60, now t=70 → the oldest has waited 10 s past deadline.
+    assert reg.gauge(
+        "workqueue_oldest_age_seconds", queue="demo"
+    ) == pytest.approx(10.0)
+
+
+def test_pool_gauges_cleared_on_deletion(kube):
+    """A deleted pool's gauges are retired — a stale ratio would keep
+    PoolDegraded firing against an object that no longer exists."""
+    from k8s_gpu_tpu.controller.manager import Request
+    from k8s_gpu_tpu.operators.pool_gauges import export_pool_gauges
+    from k8s_gpu_tpu.operators.azurevmpool import AzureVmPoolReconciler
+
+    reg = MetricsRegistry()
+    export_pool_gauges(reg, "AzureVmPool", "default", "gone",
+                       ready=1, desired=2)
+    clock2, ev = FakeClock(), None
+    rule_ev = RuleEvaluator(default_rule_pack(pool_for_s=0.0),
+                            clock=clock2, registry=reg)
+    rule_ev.evaluate_once()
+    assert [a["alertname"] for a in rule_ev.active_alerts()] == [
+        "PoolDegraded"
+    ]
+    rec = AzureVmPoolReconciler(kube, client_factory=None, metrics=reg)
+    rec.reconcile(Request("default", "gone"))  # object absent → clear
+    assert reg.gauge("pool_ready_ratio", kind="AzureVmPool",
+                     namespace="default", pool="gone") is None
+    clock2.advance(1.0)
+    rule_ev.evaluate_once()  # vanished series resolves the alert
+    assert rule_ev.active_alerts() == []
+    assert rule_ev.timeline[-1]["to"] == "resolved"
+
+
+def test_alert_event_notifier_records_warning_event(kube):
+    from k8s_gpu_tpu.api import TpuPodSlice
+    from k8s_gpu_tpu.controller.alerting import AlertEventNotifier
+
+    ps = TpuPodSlice()
+    ps.metadata.name = "demo"
+    kube.create(ps)
+    rule = AlertingRule(
+        "PoolDegraded", lambda ctx: 0.0, below=1.0,
+        annotation="pool {pool} at {value:.0%}",
+    )
+    notifier = AlertEventNotifier(kube)
+    notifier(rule, {"kind": "TpuPodSlice", "pool": "demo"}, "firing", 0.5)
+    evs = [e for e in kube.list("Event") if e.reason == "PoolDegraded"]
+    assert len(evs) == 1
+    assert evs[0].type == "Warning"
+    assert evs[0].involved_name == "demo"
+    notifier(rule, {"kind": "TpuPodSlice", "pool": "demo"}, "resolved", 1.0)
+    evs = [e for e in kube.list("Event") if e.reason == "PoolDegraded"]
+    assert {e.type for e in evs} == {"Warning", "Normal"}
+    # No object reference → logged, never raises.
+    notifier(rule, {"endpoint": "cloudtpu.list"}, "firing", 2.0)
+
+
+def test_manager_owns_evaluator_and_queue_collector(kube, clock):
+    from k8s_gpu_tpu.controller import Manager, Reconciler, Request, Result
+
+    class Nop(Reconciler):
+        def reconcile(self, req):
+            return Result()
+
+    reg = MetricsRegistry()
+    ev = RuleEvaluator(default_rule_pack(), clock=clock, registry=reg)
+    mgr = Manager(kube, clock=clock, metrics=reg, alerts=ev)
+    mgr.register("TpuPodSlice", Nop())
+    try:
+        mgr.start()
+        assert ev._thread is not None and ev._thread.is_alive()
+        # The collector refreshes queue gauges on evaluation.
+        mgr._controllers["TpuPodSlice"].queue.add(Request("default", "x"))
+        ev.evaluate_once()
+        assert reg.gauge("workqueue_depth", queue="TpuPodSlice") is not None
+    finally:
+        mgr.stop()
+    assert ev._thread is None
+
+
+# -- /alerts endpoint + chaos e2e ------------------------------------------
+
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, json.loads(r.read())
+
+
+def test_alerts_endpoint_shows_breaker_open_under_chaos(clock):
+    """End-to-end: an injected cloud outage opens the breaker; /alerts
+    shows BreakerOpen firing with its transition history."""
+    from k8s_gpu_tpu.cloud.base import CloudError
+    from k8s_gpu_tpu.cloud.resilience import (
+        BreakerBank, ResilientBackend, RetryPolicy,
+    )
+
+    reg = MetricsRegistry()
+
+    class Broken:
+        def list_resources(self, tags):
+            raise CloudError("chaos: injected outage")
+
+        def is_ready(self, r):
+            return True
+
+    bank = BreakerBank(clock=clock, name="cloudtpu",
+                       failure_threshold=3, registry=reg)
+    backend = ResilientBackend(
+        Broken(), bank, policy=RetryPolicy(max_attempts=1, budget=0),
+        clock=clock, registry=reg,
+    )
+    ev = RuleEvaluator(default_rule_pack(breaker_for_s=10.0),
+                       clock=clock, registry=reg)
+    for _ in range(3):
+        with pytest.raises(CloudError):
+            backend.list_resources({})
+    assert reg.gauge(
+        "circuit_breaker_state", endpoint="cloudtpu.list"
+    ) == 2.0
+    ev.evaluate_once()
+    clock.advance(15.0)
+    ev.evaluate_once()
+    srv = MetricsServer(reg, alerts=ev).start()
+    try:
+        code, body = _get_json(srv.port, "/alerts")
+        assert code == 200
+        firing = [a for a in body["alerts"] if a["state"] == "firing"]
+        assert [a["alertname"] for a in firing] == ["BreakerOpen"]
+        assert firing[0]["labels"] == {"endpoint": "cloudtpu.list"}
+        tos = [t["to"] for t in body["transitions"]
+               if t["alert"] == "BreakerOpen"]
+        assert tos == ["pending", "firing"]
+        # state filter
+        code, body = _get_json(srv.port, "/alerts?state=pending")
+        assert body["alerts"] == []
+    finally:
+        srv.stop()
+
+
+def test_alerts_endpoint_without_engine_404s():
+    srv = MetricsServer(MetricsRegistry()).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(srv.port, "/alerts")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_metrics_server_self_instrumentation():
+    """The obs server's own handler rides RequestMetricsMixin: scrapes
+    show up in http_requests_total{server=obs} with route collapse."""
+    from k8s_gpu_tpu.utils.metrics import global_metrics
+
+    srv = MetricsServer(MetricsRegistry()).start()
+    base = global_metrics.counter(
+        "http_requests_total", server="obs", method="GET",
+        route="/metrics", code="200",
+    )
+    other = global_metrics.counter(
+        "http_requests_total", server="obs", method="GET",
+        route="other", code="404",
+    )
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics"
+        ) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/../../etc/passwd"
+            )
+    finally:
+        srv.stop()
+    assert global_metrics.counter(
+        "http_requests_total", server="obs", method="GET",
+        route="/metrics", code="200",
+    ) == base + 1
+    # Unknown paths collapse to the fixed "other" label.
+    assert global_metrics.counter(
+        "http_requests_total", server="obs", method="GET",
+        route="other", code="404",
+    ) == other + 1
+
+
+# -- obs top ----------------------------------------------------------------
+
+def test_render_top_from_one_scrape():
+    reg = MetricsRegistry()
+    reg.set_gauge("serve_kv_occupancy_ratio", 0.42)
+    reg.set_gauge("serve_slot_fill_ratio", 0.5)
+    reg.set_gauge("serve_slots_active", 4.0)
+    reg.set_gauge("workqueue_depth", 3.0, queue="TpuPodSlice")
+    reg.set_gauge("workqueue_oldest_age_seconds", 7.5, queue="TpuPodSlice")
+    reg.set_gauge("pool_ready_replicas", 1.0, kind="TpuPodSlice",
+                  pool="demo")
+    reg.set_gauge("pool_desired_replicas", 2.0, kind="TpuPodSlice",
+                  pool="demo")
+    reg.set_gauge("pool_ready_ratio", 0.5, kind="TpuPodSlice", pool="demo")
+    reg.set_gauge("alerts_firing", 1.0, alertname="PoolDegraded")
+    out = render_top(reg.render())
+    assert "42.0%" in out          # kv occupancy
+    assert "50.0%" in out          # batch fill + pool ratio
+    assert "TpuPodSlice" in out and "7.5" in out
+    assert "demo" in out
+    assert "PoolDegraded" in out
+
+
+def test_render_top_tolerates_sparse_snapshot():
+    out = render_top(MetricsRegistry().render())
+    assert "no workqueue gauges" in out
+    assert "no pool gauges" in out
+
+
+def test_pool_gauges_namespaced_no_cross_talk():
+    """Same-named pools in different namespaces are distinct series;
+    clearing one must not wipe the other's gauges."""
+    from k8s_gpu_tpu.operators.pool_gauges import (
+        clear_pool_gauges, export_pool_gauges,
+    )
+
+    reg = MetricsRegistry()
+    export_pool_gauges(reg, "AzureVmPool", "ns-a", "demo", 0, 3)
+    export_pool_gauges(reg, "AzureVmPool", "ns-b", "demo", 3, 3)
+    assert reg.gauge("pool_ready_ratio", kind="AzureVmPool",
+                     namespace="ns-a", pool="demo") == 0.0
+    assert reg.gauge("pool_ready_ratio", kind="AzureVmPool",
+                     namespace="ns-b", pool="demo") == 1.0
+    clear_pool_gauges(reg, "AzureVmPool", "ns-a", "demo")
+    assert reg.gauge("pool_ready_ratio", kind="AzureVmPool",
+                     namespace="ns-a", pool="demo") is None
+    assert reg.gauge("pool_ready_ratio", kind="AzureVmPool",
+                     namespace="ns-b", pool="demo") == 1.0
+
+
+def test_pool_gauges_cover_degraded_states(kube):
+    """The reconciler exports ready/desired/ratio on every status
+    projection — a provisioning pool reads degraded, not stale."""
+    from k8s_gpu_tpu.cloud.fake_cloudtpu import (
+        FakeCloudTpu, cloudtpu_client_factory,
+    )
+    from k8s_gpu_tpu.api import TpuPodSlice
+    from k8s_gpu_tpu.controller.manager import Request
+    from k8s_gpu_tpu.operators import TpuPodSliceReconciler
+    from k8s_gpu_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    cloud = FakeCloudTpu(clock=clock, accepted_delay=100.0)
+    rec = TpuPodSliceReconciler(
+        kube, cloudtpu_client_factory(cloud), metrics=reg
+    )
+    ps = TpuPodSlice()
+    ps.metadata.name = "p1"
+    ps.spec.accelerator_type = "v4-8"
+    kube.create(ps)
+    rec.reconcile(Request("default", "p1"))
+    labels = {"kind": "TpuPodSlice", "namespace": "default", "pool": "p1"}
+    assert reg.gauge("pool_ready_ratio", **labels) == 0.0
+    assert reg.gauge("pool_desired_replicas", **labels) == 1.0
+    clock.advance(200.0)
+    rec.reconcile(Request("default", "p1"))
+    assert reg.gauge("pool_ready_ratio", **labels) == 1.0
+    assert reg.gauge("pool_ready_replicas", **labels) == 1.0

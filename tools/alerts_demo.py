@@ -1,0 +1,277 @@
+"""Alerts smoke (``make alerts-demo``): drive a chaos scenario through the
+in-process rules engine and print the alert timeline plus the `obs top`
+fleet-utilization snapshot.
+
+What it proves, end to end and deterministically:
+
+  1. a fault-injected cloud outage opens the circuit breaker and a pool
+     stalls degraded; BreakerOpen and PoolDegraded traverse the full
+     pending → firing → resolved FSM under ``FakeClock``, with matching
+     Warning/Normal Events on the affected TpuPodSlice and
+     ``alerts_firing`` / ``alert_transitions_total`` updates;
+  2. rule evaluation is DETERMINISTIC: two runs over fresh registries
+     produce bit-identical transition timelines;
+  3. `obs top` renders KV occupancy, batch slot fill, queue depths, and
+     pool ready-ratios from ONE ``/metrics`` scrape of a live
+     ``MetricsServer`` (the serve gauges come from a real
+     ``ContinuousBatcher`` decoding a tiny model).
+
+Exits non-zero if any invariant fails.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from k8s_gpu_tpu.api import TpuPodSlice  # noqa: E402
+from k8s_gpu_tpu.cloud import (  # noqa: E402
+    FakeCloudTpu,
+    RetryPolicy,
+    cloudtpu_client_factory,
+    resilient_factory,
+)
+from k8s_gpu_tpu.cloud.resilience import BreakerBank  # noqa: E402
+from k8s_gpu_tpu.controller import (  # noqa: E402
+    AlertEventNotifier,
+    FakeKube,
+    RateLimitingQueue,
+)
+from k8s_gpu_tpu.controller.manager import Request  # noqa: E402
+from k8s_gpu_tpu.operators import TpuPodSliceReconciler  # noqa: E402
+from k8s_gpu_tpu.utils import (  # noqa: E402
+    FakeClock,
+    FaultInjector,
+    FaultPlan,
+    MetricsRegistry,
+    MetricsServer,
+    RuleEvaluator,
+    default_rule_pack,
+    render_top,
+)
+from k8s_gpu_tpu.utils.metrics import global_metrics  # noqa: E402
+
+
+def run_alert_scenario(registry: MetricsRegistry):
+    """One deterministic chaos pass: outage → breaker open → alerts fire
+    → heal → alerts resolve.  Everything (reconciles, clock, evaluator
+    ticks) is driven inline — no threads, so two runs are bit-identical."""
+    clock = FakeClock()
+    kube = FakeKube()
+    injector = FaultInjector(registry=registry)
+    # Short provisioning so the pool goes Ready promptly once healed.
+    cloud = FakeCloudTpu(
+        clock=clock, accepted_delay=2.0, provisioning_delay=2.0,
+        injector=injector,
+    )
+    bank = BreakerBank(
+        clock=clock, name="cloudtpu", failure_threshold=3,
+        reset_timeout=30.0, registry=registry,
+    )
+    factory = resilient_factory(
+        cloudtpu_client_factory(cloud),
+        policy=RetryPolicy(max_attempts=1, budget=0, jitter=0.0),
+        clock=clock, breakers=bank,
+    )
+    rec = TpuPodSliceReconciler(kube, factory, metrics=registry)
+    evaluator = RuleEvaluator(
+        default_rule_pack(breaker_for_s=10.0, pool_for_s=30.0,
+                          queue_for_s=10.0),
+        clock=clock, registry=registry,
+        notify=AlertEventNotifier(kube),
+    )
+    ps = TpuPodSlice()
+    ps.metadata.name = "demo"
+    ps.spec.accelerator_type = "v4-8"
+    kube.create(ps)
+    req = Request("default", "demo")
+
+    # t=0: one healthy pass creates the queued resource (still
+    # provisioning → pool_ready_ratio 0), plus a named workqueue backlog
+    # so QueueBacklog has a series to evaluate.
+    rec.reconcile(req)
+    wq = RateLimitingQueue(clock=clock, name="TpuPodSlice",
+                           registry=registry)
+    # The collector hook is how production queues stay fresh (the
+    # manager registers its queues the same way).
+    evaluator.collectors.append(wq.export_gauges)
+    for i in range(12):
+        wq.add(("default", f"obj-{i}"))
+    evaluator.evaluate_once()  # PoolDegraded/QueueBacklog go pending
+
+    # t=2: total cloud outage on list — three consecutive failures open
+    # the breaker, the fourth pass short-circuits.
+    clock.advance(2.0)
+    injector.arm("cloudtpu.list", FaultPlan(seed=1, rate=1.0))
+    for _ in range(4):
+        rec.reconcile(req)
+    evaluator.evaluate_once()  # BreakerOpen pending
+
+    clock.advance(12.0)  # t=14: past BreakerOpen's 10 s hold
+    evaluator.evaluate_once()  # BreakerOpen (and QueueBacklog) firing
+
+    clock.advance(21.0)  # t=35: past PoolDegraded's 30 s hold
+    evaluator.evaluate_once()  # PoolDegraded firing
+
+    # t=44: outage over, breaker past reset_timeout — the half-open probe
+    # succeeds, the QR is long ACTIVE, the pool goes Ready; the backlog
+    # drains.
+    clock.advance(9.0)
+    injector.disarm("cloudtpu.list")
+    rec.reconcile(req)
+    while wq.get(block=False) is not None:
+        pass
+    evaluator.evaluate_once()  # everything resolves
+    return evaluator, kube, clock
+
+
+def fingerprint(evaluator) -> list:
+    return [
+        (t["t"], t["alert"], tuple(sorted(t["labels"].items())),
+         t["from"], t["to"])
+        for t in evaluator.timeline
+    ]
+
+
+def hot_serve_scrape(port: str | int, tries: int = 5) -> str:
+    """Start a real ContinuousBatcher on a tiny model and scrape
+    ``/metrics`` WHILE it decodes, returning the first exposition whose
+    slot-fill gauge reads hot.  Two co-tenant streams at 1-step rounds
+    give ~80 dispatch windows per attempt; if a whole pair completes
+    between polls (slow box), a fresh pair is submitted — bounded
+    retries, then the caller's assertion fails loudly."""
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+    from k8s_gpu_tpu.utils.metrics import parse_exposition
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8,
+        d_ff=64, max_seq=48, use_flash=False, dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    b = ContinuousBatcher(
+        model, params, slots=2, steps_per_round=1, pipeline_depth=1,
+    ).start()
+    hot = ""
+    try:
+        for _ in range(tries):
+            h1 = b.submit([1, 2, 3], max_new_tokens=40)
+            h2 = b.submit([4, 5, 6, 7], max_new_tokens=40)
+            it1, it2 = iter(h1), iter(h2)
+            next(it1)  # first token on host → decode is under way
+            while True:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10
+                ) as r:
+                    text = r.read().decode()
+                fam = parse_exposition(text)
+                fill = fam.get("serve_slot_fill_ratio", {}).get((), 0.0)
+                occ = fam.get(
+                    "serve_kv_occupancy_ratio", {}
+                ).get((), 0.0)
+                if fill > 0.0 and occ > 0.0:
+                    hot = text
+                    break
+                if next(it1, None) is None:  # stream over — too slow
+                    break
+            for _ in it1:
+                pass
+            for _ in it2:
+                pass
+            if hot:
+                return hot
+        return ""
+    finally:
+        b.stop()
+
+
+def main() -> int:
+    # -- determinism: two fresh runs, identical transition timelines ------
+    ev_a, _, _ = run_alert_scenario(MetricsRegistry())
+    ev_b, _, _ = run_alert_scenario(MetricsRegistry())
+    if fingerprint(ev_a) != fingerprint(ev_b):
+        print("FAIL: rule evaluation is not deterministic:\n"
+              f"  run A: {fingerprint(ev_a)}\n  run B: {fingerprint(ev_b)}",
+              file=sys.stderr)
+        return 1
+
+    # -- display run against the global registry (the scrape source) ------
+    evaluator, kube, _ = run_alert_scenario(global_metrics)
+
+    print("alert timeline (FakeClock seconds):")
+    for t in evaluator.timeline:
+        lbls = ",".join(f"{k}={v}" for k, v in sorted(t["labels"].items()))
+        print(f"  t={t['t']:>5.1f}  {t['alert']:<18} "
+              f"{t['from']:>8} → {t['to']:<8}  {lbls}")
+
+    # At least one rule must traverse the full pending→firing→resolved FSM.
+    walked = set()
+    per_alert: dict = {}
+    for t in evaluator.timeline:
+        key = (t["alert"], tuple(sorted(t["labels"].items())))
+        per_alert.setdefault(key, []).append(t["to"])
+    for key, path in per_alert.items():
+        if path == ["pending", "firing", "resolved"]:
+            walked.add(key[0])
+    if not walked:
+        print("FAIL: no rule traversed pending→firing→resolved",
+              file=sys.stderr)
+        return 1
+    print(f"\nfull pending→firing→resolved traversals: {sorted(walked)}")
+
+    warnings = [
+        e for e in kube.list("Event")
+        if e.type == "Warning" and e.reason in walked
+    ]
+    if not warnings:
+        print("FAIL: no Warning Event recorded for a firing alert",
+              file=sys.stderr)
+        return 1
+    print("warning events on affected objects:")
+    for e in warnings:
+        print(f"  {e.involved_kind}/{e.involved_name}: "
+              f"{e.reason}: {e.message}")
+
+    fired = global_metrics.counter(
+        "alert_transitions_total", alertname="PoolDegraded", to="firing"
+    )
+    if fired < 1:
+        print("FAIL: alert_transitions_total did not record the firing",
+              file=sys.stderr)
+        return 1
+
+    # -- serve-plane gauges from a real batcher, then ONE hot scrape ------
+    print("\ndecoding through a tiny batcher for serve-plane gauges...")
+    srv = MetricsServer(global_metrics).start()
+    try:
+        text = hot_serve_scrape(srv.port)
+    finally:
+        srv.stop()
+    if not text:
+        print("FAIL: no scrape caught the batcher mid-decode "
+              "(slot fill / kv occupancy never read > 0)", file=sys.stderr)
+        return 1
+    needed = (
+        "serve_kv_occupancy_ratio", "serve_slot_fill_ratio",
+        "workqueue_depth", "pool_ready_ratio",
+    )
+    missing = [n for n in needed if n not in text]
+    if missing:
+        print(f"FAIL: scrape is missing gauges: {missing}", file=sys.stderr)
+        return 1
+    print("\n" + render_top(text))
+    print("\nALERTS DEMO OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
