@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo alerts-demo
+.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo alerts-demo prefix-demo
 
 docker-build:
 	@for img in $(IMAGES); do \
@@ -70,3 +70,10 @@ chaos-demo:
 # scrape.  Non-zero exit if any invariant fails.
 alerts-demo:
 	python tools/alerts_demo.py
+
+# Prefix-cache smoke: 8 requests sharing a 1k-token system prompt on
+# the paged KV pool — prints the hit rate, physical blocks shared, and
+# warm-vs-cold TTFT.  Non-zero exit if sharing, the >= 2x TTFT win, or
+# the refcount leak check fails.
+prefix-demo:
+	python tools/prefix_demo.py

@@ -121,9 +121,6 @@ class InferenceService(CustomResource):
             )
         if s.paged_blocks < 0:
             raise ValidationError("spec.pagedBlocks must be >= 0")
-        if s.paged_blocks and (s.draft.id or s.draft_mode):
-            raise ValidationError(
-                "spec.pagedBlocks and speculative drafting are not yet "
-                "combinable (the draft pool splices dense rows) — pick "
-                "one per service"
-            )
+        # pagedBlocks + draft/draftMode compose since the paged pool
+        # grew block-level prefix sharing: speculative verify extends
+        # run directly on the paged pool (serve/batcher.py).

@@ -33,7 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.compat import large_thread_stack
-from .batcher import ContinuousBatcher, RequestHandle, prompt_bucket
+from .batcher import (
+    ContinuousBatcher, RequestHandle, _suffix_bucket, prompt_bucket,
+)
 
 
 @dataclass
@@ -180,6 +182,30 @@ class DisaggregatedLm:
             logits = lg[:, chunk.size - 1]
         return cache, logits
 
+    def _prefill_exact(self, ids, bank, aidx):
+        """One RIGHT-padded bucketed extend on a fresh off-pool row —
+        exact geometry (pos = n, pad = 0), so the decode side's paged
+        admission splices page-ALIGNED blocks (a left-padded row would
+        shift every token's cache position by the bucket pad).  Pad
+        garbage lands above the live length: masks never attend it and
+        decode overwrites it in order.  One compile per pow2 bucket."""
+        from .engine import _empty_cache
+
+        n = int(ids.size)
+        w = min(_suffix_bucket(n), self.engine.max_seq)
+        cache = _empty_cache(self.engine.cfg, 1, self.engine.max_seq,
+                             self.engine.kv_quant)
+        padded = jnp.zeros((1, w), jnp.int32).at[0, :n].set(
+            jnp.asarray(ids)
+        )
+        cache, lg = self._extend_jit(
+            self.params, cache, padded,
+            jnp.asarray([0]), jnp.asarray([0]), jnp.asarray([0]),
+            adapters=bank.banked,
+            adapter_idx=jnp.asarray([aidx]) if bank.banked else None,
+        )
+        return cache, lg[:, n - 1]
+
     # -- worker ------------------------------------------------------------
     def _worker(self) -> None:
         bank = self.batcher.bank
@@ -196,6 +222,17 @@ class DisaggregatedLm:
                     aidx = bank.index(job.adapter)
                     if self.chunk_tokens and not self.engine.cfg.moe:
                         row, logits = self._prefill_chunked(
+                            job.ids, bank, aidx
+                        )
+                        n_tokens, pad = int(job.ids.size), 0
+                    elif self.batcher.paged and not self.engine.cfg.moe:
+                        # Paged decode side: emit page-aligned blocks
+                        # (exact geometry, no left pad).  MoE keeps the
+                        # whole-prompt prefill below — its left-padded
+                        # row still splices into blocks correctly, the
+                        # pad positions simply occupy (masked) block
+                        # space.
+                        row, logits = self._prefill_exact(
                             job.ids, bank, aidx
                         )
                         n_tokens, pad = int(job.ids.size), 0

@@ -289,17 +289,34 @@ class InferenceEngine:
         ``arr`` [L, NB, KH, page, *rest] through per-row page tables
         ``pages`` [B, MP] at positions ``pos`` ([B] when Sq == 1, else
         the window starts).  Logical position p of row b lives at
-        physical (pages[b, p // page], p % page)."""
+        physical (pages[b, p // page], p % page).
+
+        Positions past the table (p >= MP*page) route to block 0, the
+        trash block — NOT clamped to the last entry.  Garbage rows of
+        retired-but-unnoticed slots keep advancing their positions
+        (speculative rounds advance up to K+1 per sub-round), and XLA's
+        clamped gather would otherwise alias their writes onto the
+        table's LAST mapped block — for a max-length tenant that is a
+        live (possibly shared) block.  This guard is what makes paged
+        KV safe under speculative decode's rollback/overrun behavior."""
         B, _, sq = val.shape[0], val.shape[1], val.shape[2]
+        mp = pages.shape[1]
         rows = jnp.arange(B)
         if sq == 1:
-            blk = pages[rows, pos // page]          # [B]
+            p_idx = pos // page
+            blk = jnp.where(
+                p_idx < mp, pages[rows, jnp.minimum(p_idx, mp - 1)], 0
+            )                                       # [B]
             off = pos % page                        # [B]
             return arr.at[layer, blk, :, off].set(
                 val[:, :, 0].astype(arr.dtype)
             )
         q_pos = pos[:, None] + jnp.arange(sq, dtype=jnp.int32)[None]  # [B,W]
-        blk = pages[rows[:, None], q_pos // page]   # [B, W]
+        p_idx = q_pos // page
+        blk = jnp.where(
+            p_idx < mp,
+            pages[rows[:, None], jnp.minimum(p_idx, mp - 1)], 0,
+        )                                           # [B, W]
         off = q_pos % page                          # [B, W]
         return arr.at[layer, blk, :, off].set(
             jnp.moveaxis(val, 2, 1).astype(arr.dtype)
@@ -606,9 +623,10 @@ class InferenceEngine:
 
     def extend_multi(self, params, cache, tokens, start, rope_start,
                      kv_start, adapters=None, adapter_idx=None,
-                     t_hi=None):
+                     t_hi=None, pages=None, page: int = 0):
         """Multi-token cached forward where every row writes its *own*
-        window — the speculative-decoding verify kernel.
+        window — the speculative-decoding verify kernel, and (with
+        ``pages``) the paged pool's prefill/suffix-extend kernel.
 
         tokens [B, W]; start/rope_start/kv_start [B] int32.  Row b writes
         K/V for its W tokens at cache positions start[b]..start[b]+W-1 and
@@ -620,11 +638,21 @@ class InferenceEngine:
         Rollback is free: a later round that re-writes positions ≤ p and
         masks t ≤ p never sees the stale K/V a rejected draft left behind
         (same property decode_step relies on across requeued slots).
-        """
+
+        ``pages`` [B, MP] int32 + ``page`` (static): paged-KV mode —
+        ``cache`` leaves are the [L, NB, KH, page, ...] physical pool;
+        window writes scatter through the page tables (_paged_store's
+        window branch; out-of-table positions land in the trash block)
+        and reads gather whole pages, so t_hi rounds up to a page
+        multiple.  This is what makes speculative verify — and shared-
+        prefix admission — run directly on the paged pool."""
         B, W = tokens.shape
         start = jnp.asarray(start, jnp.int32)
         q_pos = start[:, None] + jnp.arange(W, dtype=jnp.int32)[None]  # [B, W]
-        t = jnp.arange(t_hi if t_hi is not None else self.max_seq)
+        T = t_hi if t_hi is not None else self.max_seq
+        if pages is not None:
+            T = -(-T // page) * page  # whole pages only
+        t = jnp.arange(T)
         mask = (
             (t[None, None, :] <= q_pos[:, :, None])
             & (t[None, None, :] >= jnp.asarray(kv_start, jnp.int32)[:, None, None])
@@ -641,7 +669,7 @@ class InferenceEngine:
         logits, cache = self._run_blocks(
             params, x, cache, rope, start, mask, moe_full_capacity=True,
             adapters=adapters, adapter_idx=adapter_idx,
-            unroll_layers=True,
+            unroll_layers=True, pages=pages, page=page,
         )
         return cache, logits
 

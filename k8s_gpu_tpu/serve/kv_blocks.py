@@ -1,0 +1,172 @@
+"""Refcounted paged-KV block pool with content-hash prefix sharing.
+
+The allocator behind the batcher's paged mode (vLLM-style *automatic
+prefix caching*, TPU-shaped): physical blocks of ``page_size`` positions
+are the unit of both allocation and reuse.  A prompt's page-aligned
+chunks are hashed as a **chain** — chunk i's hash covers every token
+before it, because a block's K/V content depends on the whole prefix
+through attention, not just its own tokens — and full prompt blocks are
+registered ``hash → block id`` after prefill.  A later request whose
+chain matches maps its page table to the *same* physical blocks and
+only computes its suffix.
+
+Lifecycle of a block:
+
+- **free**: on the free list, content meaningless;
+- **pinned** (refcount >= 1): referenced by one or more live slots'
+  page tables.  Never evicted, never re-allocated; shared prefix
+  blocks are read-only by construction (decode writes land at
+  positions past the prompt, which always map to a request's private
+  tail blocks);
+- **cached** (refcount 0, registered hash): retired but kept — sits in
+  an LRU so the next request with the same prefix can re-acquire it.
+  Evicted (hash dropped, block back to the free list) only when an
+  allocation needs the space, oldest first.
+
+Occupancy accounting counts **physical** blocks: a block shared by N
+slots is one pinned block, not N — per-request block lists would
+double-count shared prefixes and false-fire KVCacheSaturation.
+
+Host-side only, single-threaded (the batcher's scheduler thread owns
+every call); device safety of immediate block reuse rides the batcher's
+dispatch-FIFO argument (serve/batcher.py paged-KV comments).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+
+import numpy as np
+
+
+def chunk_hashes(ids: np.ndarray, page: int) -> list[bytes]:
+    """Chained hashes of the FULL page-aligned chunks of ``ids``:
+    h_i = H(h_{i-1} || tokens[i*page:(i+1)*page]).  Only full chunks —
+    a partial tail block is never shared (its content would change as
+    decode writes into it); the partial tail is instead recomputed into
+    a private block, which is this cache's copy-on-write."""
+    ids = np.ascontiguousarray(ids, np.int32)
+    out: list[bytes] = []
+    h = b""
+    for i in range(int(ids.size) // page):
+        m = hashlib.blake2b(digest_size=16)
+        m.update(h)
+        m.update(ids[i * page:(i + 1) * page].tobytes())
+        h = m.digest()
+        out.append(h)
+    return out
+
+
+class BlockPool:
+    """Block allocator: free list + refcounts + hash table + LRU.
+
+    ``n_blocks`` counts the whole pool including block 0 — the trash
+    block, which is never allocated (retired page-table rows point at
+    it so in-flight garbage writes land somewhere harmless)."""
+
+    def __init__(self, n_blocks: int, page_size: int):
+        self.n_blocks = int(n_blocks)
+        self.page = int(page_size)
+        self._free: list[int] = list(range(1, self.n_blocks))
+        self._ref: dict[int, int] = {}
+        self._blk_of: dict[bytes, int] = {}       # hash -> block
+        self._hash_of: dict[int, bytes] = {}      # block -> hash
+        # refcount-0 registered blocks, oldest first (the eviction order)
+        self._lru: "collections.OrderedDict[int, bool]" = (
+            collections.OrderedDict()
+        )
+        self.evictions = 0
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def usable(self) -> int:
+        return self.n_blocks - 1
+
+    @property
+    def allocatable_count(self) -> int:
+        """Blocks an alloc() could hand out: free + evictable-cached."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def pinned_count(self) -> int:
+        """Physical blocks held by live slots — shared blocks count ONCE
+        (the occupancy number KVCacheSaturation must see)."""
+        return self.usable - self.allocatable_count
+
+    @property
+    def shared_count(self) -> int:
+        """Physical blocks referenced by >= 2 live slots."""
+        return sum(1 for r in self._ref.values() if r >= 2)
+
+    @property
+    def cached_count(self) -> int:
+        """Refcount-0 blocks kept for reuse (evictable)."""
+        return len(self._lru)
+
+    def refcount(self, blk: int) -> int:
+        return self._ref.get(blk, 0)
+
+    def allocatable_blocks(self) -> list[int]:
+        """Sorted ids of every block an alloc() could hand out — the
+        post-shutdown leak-check surface (a clean pool returns all
+        blocks here, whether plain-free or cached)."""
+        return sorted(list(self._free) + list(self._lru))
+
+    # -- sharing -----------------------------------------------------------
+    def acquire(self, h: bytes) -> int | None:
+        """Pin the block registered under ``h`` (refcount++), pulling it
+        out of the LRU if it was resting there.  None on miss."""
+        blk = self._blk_of.get(h)
+        if blk is None:
+            return None
+        if self._ref.get(blk, 0) == 0:
+            self._lru.pop(blk, None)
+        self._ref[blk] = self._ref.get(blk, 0) + 1
+        return blk
+
+    def register(self, blk: int, h: bytes) -> None:
+        """Record ``blk``'s content hash so later prompts can share it.
+        First writer wins: a hash already mapped (or a block already
+        registered) keeps its existing entry — admissions are serialized
+        on the scheduler thread, so a would-be duplicate writer would
+        have matched instead."""
+        if h in self._blk_of or blk in self._hash_of:
+            return
+        self._blk_of[h] = blk
+        self._hash_of[blk] = h
+
+    # -- allocation --------------------------------------------------------
+    def alloc(self, n: int) -> list[int] | None:
+        """Take ``n`` fresh blocks (refcount 1 each), evicting LRU
+        cached blocks as needed.  None when even full eviction cannot
+        cover — the caller defers (or fails) without side effects."""
+        if n <= 0:
+            return []
+        if len(self._free) + len(self._lru) < n:
+            return None
+        while len(self._free) < n:
+            blk, _ = self._lru.popitem(last=False)  # oldest first
+            del self._blk_of[self._hash_of.pop(blk)]
+            self._free.append(blk)
+            self.evictions += 1
+        taken = self._free[:n]
+        del self._free[:n]
+        for b in taken:
+            self._ref[b] = 1
+        return taken
+
+    def release(self, blk: int) -> None:
+        """Drop one reference.  At refcount 0 a registered block parks
+        in the LRU (content kept for the next sharer); an unregistered
+        one returns straight to the free list."""
+        r = self._ref.get(blk, 0) - 1
+        if r > 0:
+            self._ref[blk] = r
+            return
+        self._ref.pop(blk, None)
+        if blk in self._hash_of:
+            self._lru[blk] = True
+            self._lru.move_to_end(blk)
+        else:
+            self._free.append(blk)

@@ -458,6 +458,11 @@ def default_rule_pack(
             annotation="workqueue {queue} backlog at {value:.0f} items",
         ),
         AlertingRule(
+            # The input gauge is PHYSICAL occupancy: the paged batcher
+            # counts a block shared by N slots once and refcount-0
+            # cached (reclaimable) blocks as free, so block-granular
+            # prefix sharing can't double-count its way over the
+            # threshold (serve/kv_blocks.py, docs/platform/kv-cache.md).
             "KVCacheSaturation",
             lambda ctx: ctx.series("serve_kv_occupancy_ratio"),
             above=kv_ratio, for_s=kv_for_s,

@@ -1,0 +1,256 @@
+"""Block-level shared prefix caching on the paged KV pool (ISSUE 5).
+
+The paged pool's prefix cache is refcounted and block-granular
+(serve/kv_blocks.py): page-aligned prompt chunks are chain-hashed to
+physical block ids, so requests sharing a system prompt map their page
+tables to the SAME blocks; a partial tail block is recomputed into a
+private block (copy-on-write); eviction is LRU over refcount-0 blocks.
+Contract pinned here:
+
+1. decode equivalence: paged shared-prefix streams are token-for-token
+   identical to the dense batcher (greedy and sampled), and greedy
+   stays exact when speculative decode rides the SAME paged pool — the
+   composability the r5 constructor still refused;
+2. sharing is physical: two admissions with a common prefix hold the
+   same block ids, refcounted, counted ONCE by occupancy;
+3. no leaks: 200 admit/retire churn cycles return every block to the
+   allocatable set;
+4. eviction under pressure never takes a referenced block.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.serve import ContinuousBatcher, DisaggregatedLm
+from k8s_gpu_tpu.serve.batcher import _Request
+from k8s_gpu_tpu.utils.metrics import global_metrics
+
+CFG = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_head=16,
+    d_ff=64, max_seq=128, use_flash=False, dtype=jnp.float32,
+)
+MODEL = TransformerLM(CFG)
+PARAMS = MODEL.init(jax.random.PRNGKey(0))
+
+PAGE = 16
+PREFIX = [(i * 7 + 3) % 120 for i in range(40)]   # 2 full pages + tail
+
+
+def _dense(reqs, **bkw):
+    b = ContinuousBatcher(MODEL, PARAMS, slots=4, **bkw).start()
+    try:
+        hs = [b.submit(ids, **kw) for ids, kw in reqs]
+        return [h.result() for h in hs]
+    finally:
+        b.stop()
+
+
+def _paged(reqs, paged_blocks=64, **bkw):
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=4, paged_blocks=paged_blocks,
+        page_size=PAGE, **bkw,
+    ).start()
+    try:
+        hs = [b.submit(ids, **kw) for ids, kw in reqs]
+        outs = [h.result() for h in hs]
+    finally:
+        b.stop()
+    # every test doubles as a leak check: all blocks allocatable again
+    assert sorted(b._free_blocks) == list(range(1, b.paged_blocks))
+    return outs, b
+
+
+def test_shared_prefix_greedy_bitexact_vs_dense():
+    reqs = [(PREFIX + [60 + i, 61 + i], dict(max_new_tokens=10))
+            for i in range(4)]
+    dense = _dense(reqs)
+    paged, b = _paged(reqs)
+    assert paged == dense
+    # requests 2..4 matched the pages request 1 registered
+    assert global_metrics.counter("serve_prefix_cache_hits_total") >= 3
+
+
+def test_shared_prefix_sampled_bitexact_vs_dense():
+    reqs = [
+        (PREFIX + [50 + i], dict(max_new_tokens=8, temperature=0.9,
+                                 seed=13 + i))
+        for i in range(3)
+    ]
+    dense = _dense(reqs)
+    paged, _ = _paged(reqs)
+    assert paged == dense
+
+
+def test_sharing_is_physical_and_counted_once():
+    """Two planned admissions with a common prefix reference the SAME
+    physical blocks; occupancy counts them once (the KVCacheSaturation
+    fix — per-request lists would double-count)."""
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=4, paged_blocks=64, page_size=PAGE
+    )
+    ids = np.asarray(PREFIX + [99, 98], np.int32)
+    r1 = _Request(ids=ids, max_new=8, temperature=0.0, top_p=0.0, seed=0)
+    r2 = _Request(ids=ids, max_new=8, temperature=0.0, top_p=0.0, seed=1)
+    assert b._paged_plan(r1) and b._paged_plan(r2)
+    assert r1.prefix_tokens == 0 and r2.prefix_tokens == 2 * PAGE
+    assert r2.blocks[:2] == r1.blocks[:2]       # same physical blocks
+    assert set(r2.blocks[2:]).isdisjoint(r1.blocks)  # private tails
+    assert b._pool.shared_count == 2
+    assert b._pool.refcount(r1.blocks[0]) == 2
+    # physical accounting: pinned < sum of per-request holdings
+    assert b._pool.pinned_count == len(r1.blocks) + len(r2.blocks) - 2
+    b._update_util_gauges()
+    assert global_metrics.gauge("serve_kv_blocks_used") == (
+        b._pool.pinned_count
+    )
+    assert global_metrics.gauge("serve_kv_blocks_shared") == 2.0
+    for r in (r1, r2):
+        for blk in r.blocks:
+            b._pool.release(blk)
+    assert b._pool.pinned_count == 0
+
+
+def test_refcount_churn_returns_pool_to_all_free():
+    """200 admit/retire cycles over rotating prompts (sharing, misses,
+    and LRU eviction all exercised) leave zero pinned blocks."""
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=4, paged_blocks=16, page_size=PAGE
+    ).start()
+    try:
+        for i in range(200):
+            # leading token varies -> a distinct hash chain per cycle,
+            # so registrations accumulate and the LRU really evicts;
+            # revisited chains (i wraps at 120) hit the cache if they
+            # survived or recompute if evicted — both must be clean
+            ids = [i % 120] + PREFIX[:32] + [i % 64]
+            assert len(b.submit(ids, max_new_tokens=2).result()) == 2
+    finally:
+        b.stop()
+    assert b._pool.pinned_count == 0
+    assert sorted(b._free_blocks) == list(range(1, 16))
+    assert b._pool.evictions > 0  # pressure really evicted cached blocks
+
+
+def test_eviction_keeps_referenced_blocks_pinned():
+    """A live request's blocks survive heavy churn that evicts every
+    refcount-0 cached block around them — its stream still matches the
+    dense path."""
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=4, paged_blocks=16, page_size=PAGE
+    ).start()
+    try:
+        long_ids = PREFIX + [77]
+        slow = b.submit(long_ids, max_new_tokens=24)
+        for i in range(12):  # distinct prompts -> register + evict churn
+            b.submit([(i * 11 + 5) % 120 for _ in range(20)],
+                     max_new_tokens=2).result()
+        got = slow.result()
+    finally:
+        b.stop()
+    assert got == _dense([(long_ids, dict(max_new_tokens=24))])[0]
+    assert sorted(b._free_blocks) == list(range(1, 16))
+
+
+def test_paged_ngram_spec_greedy_bitexact_vs_dense():
+    """paged KV + speculative decode + shared prefix in ONE batcher —
+    the composability r5 refused.  Greedy spec is verify-gated, so the
+    stream must equal the dense plain batcher's bit-for-bit."""
+    reqs = [(PREFIX + [30 + i], dict(max_new_tokens=12)) for i in range(3)]
+    reqs += [(list(range(2, 24)), dict(max_new_tokens=12))]  # cold, no share
+    dense = _dense(reqs)
+    paged, b = _paged(reqs, draft="ngram", spec_k=4)
+    assert paged == dense
+    assert b.spec_stats["drafted"] > 0  # spec rounds really ran
+
+
+def test_paged_neural_spec_greedy_bitexact_vs_dense():
+    """Neural draft on the paged pool (target-as-draft: the machinery
+    ceiling) — greedy parity with the dense plain path."""
+    reqs = [(PREFIX + [41 + i], dict(max_new_tokens=10)) for i in range(2)]
+    dense = _dense(reqs)
+    paged, _ = _paged(reqs, draft=(MODEL, PARAMS), spec_k=2)
+    assert paged == dense
+
+
+def test_precache_prefix_warms_block_cache():
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=4, paged_blocks=64, page_size=PAGE
+    ).start()
+    try:
+        b.precache_prefix(PREFIX)
+        assert b._pool.cached_count >= 2  # full pages parked at refcount 0
+        h0 = global_metrics.counter("serve_prefix_cache_hits_total")
+        got = b.submit(PREFIX + [88], max_new_tokens=8).result()
+        assert global_metrics.counter("serve_prefix_cache_hits_total") == (
+            h0 + 1
+        )
+    finally:
+        b.stop()
+    assert got == _dense([(PREFIX + [88], dict(max_new_tokens=8))])[0]
+
+
+def test_disagg_over_paged_pool_matches_dense():
+    """Disaggregated prefill hands page-aligned rows to a paged decode
+    batcher; streams match the dense batcher and blocks free."""
+    ids = PREFIX + [12, 13]
+    dense = _dense([(ids, dict(max_new_tokens=10))])[0]
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=4, paged_blocks=64, page_size=PAGE
+    ).start()
+    d = DisaggregatedLm(MODEL, PARAMS, batcher=b).start()
+    try:
+        got = d.submit(ids, max_new_tokens=10).result()
+    finally:
+        d.stop()
+        b.stop()
+    assert got == dense
+    assert sorted(b._free_blocks) == list(range(1, 64))
+
+
+def test_ngram_gate_falls_back_below_breakeven():
+    """Sampled traffic on a random-init model accepts almost nothing —
+    the adaptive gate must stop paying for ngram rounds (plain-round
+    fallback), which is what keeps ngram never-slower-than-plain."""
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=2, draft="ngram", spec_k=4,
+    ).start()
+    b.ngram_min_obs = 8
+    b.ngram_probe_s = 1000.0
+    try:
+        out = b.submit(
+            list(range(2, 22)), max_new_tokens=48, temperature=1.0, seed=3
+        ).result()
+        assert len(out) == 48
+        st = b.spec_stats
+    finally:
+        b.stop()
+    assert st["fallback_rounds"] > 0
+    assert st["drafted"] > 0  # it measured before gating
+
+
+def test_moe_paged_skips_sharing_but_serves():
+    """MoE on the paged pool: no block sharing (chunked prefill would
+    diverge from the one-shot oracle) but paged serving still works via
+    the dense-splice path, and precache refuses loudly."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_head=16,
+        d_ff=64, max_seq=128, use_flash=False, dtype=jnp.float32,
+        num_experts=4,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(1))
+    b = ContinuousBatcher(
+        model, params, slots=2, paged_blocks=32, page_size=PAGE
+    ).start()
+    try:
+        with pytest.raises(ValueError, match="MoE"):
+            b.precache_prefix(PREFIX)
+        got = b.submit(PREFIX + [9], max_new_tokens=6).result()
+        assert len(got) == 6
+        assert b._pool.cached_count == 0  # nothing registered
+    finally:
+        b.stop()
+    assert sorted(b._free_blocks) == list(range(1, 32))

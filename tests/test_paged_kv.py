@@ -124,13 +124,14 @@ def test_block_pressure_deferrals_stay_fifo():
     the deferred queue — a popped-and-refused request goes back to the
     FRONT (appendleft), so deferrals admit in submission order even while
     the allocator repeatedly refuses the head."""
-    # 12 blocks of 16 (11 usable).  The holder takes 6
-    # (ceil((64+30)/16)), leaving 5 — the two big deferrals need 6 each,
-    # so both sit in _overflow through many scheduler passes (each pass
-    # pops the head, fails, re-queues: the rotation site) until the
-    # holder retires, then must admit b2 BEFORE b3.
+    # 10 blocks of 16 (9 usable).  The holder takes 5 (ceil((40+30)/16)
+    # — unpadded allocation since the block-prefix-cache rework), leaving
+    # 4 — the two big deferrals need 5 each, so both sit in _overflow
+    # through many scheduler passes (each pass pops the head, fails,
+    # re-queues: the rotation site) until the holder retires, then must
+    # admit b2 BEFORE b3.
     b = ContinuousBatcher(
-        MODEL, PARAMS, slots=4, paged_blocks=12, page_size=16
+        MODEL, PARAMS, slots=4, paged_blocks=10, page_size=16
     ).start()
     try:
         holder = b.submit(list(range(2, 42)), max_new_tokens=30)
@@ -144,7 +145,7 @@ def test_block_pressure_deferrals_stay_fifo():
         assert holder._req.t_admit < big2._req.t_admit < big3._req.t_admit
     finally:
         b.stop()
-    assert sorted(b._free_blocks) == list(range(1, 12))
+    assert sorted(b._free_blocks) == list(range(1, 10))
 
 
 def test_pool_floor_guarantees_progress():
@@ -208,25 +209,27 @@ def test_inferenceservice_paged_spec_validation():
     svc.spec.paged_blocks = 128
     svc.validate()  # paged alone is fine
     svc.spec.draft_mode = "ngram"
-    with pytest.raises(ValidationError, match="pagedBlocks"):
-        svc.validate()
+    svc.validate()  # paged + speculative drafting composes now
     svc.spec.draft_mode = ""
     svc.spec.paged_blocks = -1
     with pytest.raises(ValidationError, match=">= 0"):
         svc.validate()
 
 
-def test_paged_rejects_incompatible_modes():
-    with pytest.raises(ValueError, match="speculative"):
-        ContinuousBatcher(
-            MODEL, PARAMS, slots=2, draft="ngram",
-            paged_blocks=32, page_size=16,
-        )
+def test_paged_composes_with_spec_and_prefix():
+    """The r5 restrictions are lifted: paged + ngram drafting constructs
+    (greedy parity lives in test_block_prefix_cache.py), and paged
+    precache_prefix warms the BLOCK cache instead of raising."""
     b = ContinuousBatcher(
-        MODEL, PARAMS, slots=2, paged_blocks=32, page_size=16
-    )
-    with pytest.raises(ValueError, match="prefix"):
-        b.precache_prefix([3, 5, 7])
+        MODEL, PARAMS, slots=2, draft="ngram",
+        paged_blocks=32, page_size=16,
+    ).start()
+    try:
+        got = b.submit(PROMPTS[1], max_new_tokens=8).result()
+        assert len(got) == 8
+    finally:
+        b.stop()
+    assert sorted(b._free_blocks) == list(range(1, 32))
     with pytest.raises(ValueError, match="max_seq"):
         ContinuousBatcher(
             MODEL, PARAMS, slots=2, paged_blocks=32, page_size=48

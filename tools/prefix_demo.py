@@ -1,0 +1,139 @@
+"""Prefix-cache smoke (``make prefix-demo``): 8 requests sharing a
+1k-token system prompt on the paged KV pool, end to end.
+
+What it proves:
+
+  1. block-granular sharing is AUTOMATIC: the first request over the
+     system prompt registers its page-aligned chunks in the pool's
+     content cache (serve/kv_blocks.py); the other 7 map their page
+     tables to the SAME physical blocks — `serve_prefix_cache_hits_total`
+     counts 7 hits and `serve_kv_blocks_shared` shows the prefix pages
+     referenced by every live slot at once;
+  2. a warm admission beats a cold one on time-to-first-token by >= 2x
+     (it extends only the suffix past the cached chain; the cold path
+     computes all ~1k prompt tokens) — compile time is excluded by
+     warming both bucket variants on throwaway same-length prefixes;
+  3. refcounts leak nothing: after every request retires, the whole
+     pool is allocatable again (shared blocks park in the LRU at
+     refcount 0, ready for the next matching prompt).
+
+Exits non-zero if any invariant fails.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM  # noqa: E402
+from k8s_gpu_tpu.serve import ContinuousBatcher  # noqa: E402
+from k8s_gpu_tpu.utils.metrics import global_metrics  # noqa: E402
+
+PAGE = 64
+SYS_LEN = 1024  # the shared "system prompt": 16 full pages
+
+
+def _prefix(tag: int) -> list[int]:
+    return [(j * 17 + tag * 131 + 3) % 120 + 2 for j in range(SYS_LEN)]
+
+
+def _ttft(b: ContinuousBatcher, prompt: list[int], n_new: int = 4) -> float:
+    h = b.submit(prompt, max_new_tokens=n_new)
+    h.result()
+    return h._req.t_first - h._req.t_submit
+
+
+def main() -> int:
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_head=16,
+        d_ff=64, max_seq=2048, use_flash=False, dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    b = ContinuousBatcher(
+        model, params, slots=8, paged_blocks=64, page_size=PAGE
+    ).start()
+    failures: list[str] = []
+    try:
+        # Compile warmup on a throwaway same-length prefix: one cold
+        # (full-prompt bucket) + one warm (suffix bucket) admission.
+        _ttft(b, _prefix(900) + [5])
+        _ttft(b, _prefix(900) + [7])
+
+        cold_s = _ttft(b, _prefix(901) + [9])  # fresh chain: a real miss
+
+        sys_prompt = _prefix(0)
+        h0 = global_metrics.counter("serve_prefix_cache_hits_total")
+        hs = [b.submit(sys_prompt + [20 + i], max_new_tokens=16)
+              for i in range(8)]
+        # Poll the gauge, not b._pool directly: BlockPool is scheduler-
+        # thread-only (its refcount dict mutates under admissions), and
+        # the batcher exports serve_kv_blocks_shared at every admission/
+        # retire boundary exactly for cross-thread observers like this.
+        shared_peak = 0.0
+        # Bounded poll: a dead scheduler marks requests aborted without
+        # ever setting t_first — break instead of spinning so the demo
+        # fails through result()'s truncation check, not a hang.
+        poll_deadline = time.monotonic() + 120.0
+        while any(h._req.t_first == 0.0 for h in hs):
+            if (any(h.aborted for h in hs)
+                    or time.monotonic() > poll_deadline):
+                break
+            shared_peak = max(
+                shared_peak,
+                global_metrics.gauge("serve_kv_blocks_shared") or 0.0,
+            )
+            time.sleep(0.005)
+        shared_peak = int(max(
+            shared_peak,
+            global_metrics.gauge("serve_kv_blocks_shared") or 0.0,
+        ))
+        for h in hs:
+            h.result()
+        hits = global_metrics.counter("serve_prefix_cache_hits_total") - h0
+
+        warm_s = _ttft(b, sys_prompt + [99])  # solo: clean warm TTFT
+        speedup = cold_s / warm_s
+
+        print("PREFIX CACHE DEMO — 8 requests x 1024-token system prompt")
+        print(f"  prefix cache hits        : {hits}/8 admissions "
+              f"(first one registers, the rest share)")
+        print(f"  physical blocks shared   : {shared_peak} "
+              f"(prefix pages referenced by >= 2 live slots)")
+        print(f"  TTFT cold                : {cold_s * 1e3:8.1f} ms")
+        print(f"  TTFT warm (shared chain) : {warm_s * 1e3:8.1f} ms")
+        print(f"  warm-vs-cold speedup     : {speedup:8.2f}x")
+
+        if hits < 7:
+            failures.append(f"expected >= 7 prefix-cache hits, saw {hits}")
+        if shared_peak < SYS_LEN // PAGE:
+            failures.append(
+                f"expected >= {SYS_LEN // PAGE} shared blocks, "
+                f"saw {shared_peak}"
+            )
+        if speedup < 2.0:
+            failures.append(f"warm TTFT speedup {speedup:.2f}x < 2.0x")
+    finally:
+        b.stop()
+    if sorted(b._free_blocks) != list(range(1, b.paged_blocks)):
+        failures.append("block leak: pool did not return to all-free")
+    else:
+        print("  refcount leak check      : clean (pool all-free "
+              "after retirement)")
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
