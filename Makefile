@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo alerts-demo prefix-demo
+.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo alerts-demo prefix-demo fleet-demo
 
 docker-build:
 	@for img in $(IMAGES); do \
@@ -77,3 +77,12 @@ alerts-demo:
 # the refcount leak check fails.
 prefix-demo:
 	python tools/prefix_demo.py
+
+# Fleet telemetry smoke: 3 in-process batcher replicas with per-replica
+# registries serve skewed per-tenant traffic; the federation collector
+# scrapes/relabels/aggregates them, the fleet view identifies the hot
+# replica and hot tenant, killing a replica fires FleetReplicaDown
+# (and reviving resolves it), and every request's journal record
+# cross-links to a resolvable trace.  Non-zero exit on any failure.
+fleet-demo:
+	python tools/fleet_demo.py

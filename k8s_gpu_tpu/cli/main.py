@@ -769,6 +769,22 @@ def _obs_fetch(url: str, path: str) -> str | None:
         return None
 
 
+def _parse_scrape_targets(urls) -> dict:
+    """Shared ``--scrape-url`` parsing for `obs fleet`/`obs serve`:
+    ``NAME=URL`` keeps the explicit name; a bare URL is named by its
+    host:port (the replica label must not carry a scheme)."""
+    from urllib.parse import urlparse
+
+    targets = {}
+    for u in urls or []:
+        name, sep, rest = u.partition("=")
+        if not sep:
+            parsed = urlparse(u if "//" in u else f"//{u}")
+            name, rest = parsed.netloc or u, u
+        targets[name] = rest
+    return targets
+
+
 def _obs_snapshot() -> str | None:
     """The last platform invocation's persisted exposition, or None
     (with the hint printed) when no run has happened yet."""
@@ -841,18 +857,101 @@ def cmd_obs(args) -> int:
         print("\n".join(lines))
         return 0
     if args.obs_cmd == "top":
-        # Fleet-utilization snapshot from ONE /metrics exposition: a live
-        # scrape with --url, or the persisted metrics.prom of the last
-        # platform invocation.
-        from ..utils.obs import render_top
+        # Fleet-utilization snapshot.  One source (a --url scrape or the
+        # persisted metrics.prom) renders the classic single-process
+        # view; REPEATED --url scrapes every replica through the
+        # federation collector's relabel/aggregate path and renders one
+        # column per replica plus the fleet-aggregate column.
+        from ..utils.obs import render_top, render_top_columns
 
+        urls = args.url or []
+        if len(urls) > 1:
+            from ..utils.federation import FleetCollector
+
+            texts = {}
+            for name, u in _parse_scrape_targets(urls).items():
+                text = _obs_fetch(u, "/metrics")
+                if text is None:
+                    return 1
+                texts[name] = text
+            fc = FleetCollector(
+                {name: (lambda t=t: t) for name, t in texts.items()}
+            )
+            fc.scrape_once()
+            print(render_top_columns(fc.snapshot()))
+            return 0
         text = (
-            _obs_fetch(args.url, "/metrics") if args.url
+            _obs_fetch(urls[0], "/metrics") if urls
             else _obs_snapshot()
         )
         if text is None:
             return 1
         print(render_top(text))
+        return 0
+    if args.obs_cmd == "fleet":
+        # The federated fleet view: --url fetches a running obs server's
+        # /fleet snapshot (a FleetCollector lives there); repeated
+        # --scrape-url builds a one-shot local collector over raw
+        # /metrics endpoints instead.
+        from ..utils.obs import render_fleet
+
+        if args.url:
+            body = _obs_fetch(args.url, "/fleet?refresh=1")
+            if body is None:
+                return 1
+            try:
+                snap = json.loads(body)
+                snap["replicas"]
+            except (ValueError, KeyError, TypeError) as e:
+                print(f"fetch failed: {e}", file=sys.stderr)
+                return 1
+        elif args.scrape_url:
+            from ..utils.federation import FleetCollector
+
+            fc = FleetCollector(_parse_scrape_targets(args.scrape_url))
+            up = fc.scrape_once()
+            snap = fc.snapshot()
+            if not any(up.values()):
+                print("no replica scrape succeeded", file=sys.stderr)
+                print(render_fleet(snap))
+                return 1
+        else:
+            print("obs fleet needs --url (a /fleet server) or repeated "
+                  "--scrape-url NAME=URL", file=sys.stderr)
+            return 2
+        print(render_fleet(snap))
+        return 0
+    if args.obs_cmd == "requests":
+        # The per-request journal: what /debug/requests serves, with
+        # the trace id column cross-linking into `obs traces --trace`.
+        from urllib.parse import urlencode
+
+        from ..utils.obs import render_requests
+
+        if not args.url:
+            print("obs requests needs --url of a metrics server with a "
+                  "journal attached (/debug/requests)", file=sys.stderr)
+            return 2
+        params = {
+            k: v for k, v in (
+                ("tenant", args.tenant), ("reason", args.reason),
+                ("trace_id", args.trace), ("limit", args.limit),
+            ) if v
+        }
+        body = _obs_fetch(args.url, f"/debug/requests?{urlencode(params)}")
+        if body is None:
+            return 1
+        try:
+            recs = json.loads(body)["requests"]
+            if not isinstance(recs, list):
+                raise ValueError("'requests' is not a list")
+        except (ValueError, KeyError, TypeError) as e:
+            print(f"fetch failed: {e}", file=sys.stderr)
+            return 1
+        print(render_requests(recs))
+        if any(r.get("trace_id") for r in recs):
+            print("\n(follow a request: obs traces --url "
+                  f"{args.url} --trace <TRACE>)")
         return 0
     if args.obs_cmd == "alerts":
         if args.url:
@@ -978,11 +1077,19 @@ def cmd_obs(args) -> int:
         p.settle()
         p.close()
         # The manager's rules engine rides along so /alerts serves the
-        # session's final pending/firing set and timeline.
+        # session's final pending/firing set and timeline; --scrape-url
+        # targets federate into /fleet on demand.
+        fleet = None
+        if args.scrape_url:
+            from ..utils.federation import FleetCollector
+
+            fleet = FleetCollector(_parse_scrape_targets(args.scrape_url))
         srv = MetricsServer(
-            port=args.port, alerts=getattr(p.mgr, "alerts", None)
+            port=args.port, alerts=getattr(p.mgr, "alerts", None),
+            fleet=fleet,
         ).start()
-        print(f"serving /metrics /alerts /healthz /readyz on :{srv.port}")
+        print(f"serving /metrics /alerts /fleet /healthz /readyz on "
+              f":{srv.port}")
         return _serve_until(srv, args.for_seconds)
     return 1
 
@@ -1303,12 +1410,42 @@ def build_parser() -> argparse.ArgumentParser:
     p_otop = obs_sub.add_parser(
         "top",
         help="fleet-utilization snapshot (KV occupancy, batch fill, "
-             "queue depths, pool ready-ratios) from one /metrics scrape",
+             "queue depths, pool ready-ratios) from one /metrics scrape "
+             "— repeat --url to federate N replicas into per-replica "
+             "columns plus a fleet-aggregate column",
     )
-    p_otop.add_argument("--url", default="",
+    p_otop.add_argument("--url", action="append", default=None,
                         help="base URL of a running metrics server "
-                             "(/metrics); default: the persisted "
+                             "(/metrics); repeatable — one column per "
+                             "replica; default: the persisted "
                              "metrics.prom")
+    p_ofleet = obs_sub.add_parser(
+        "fleet",
+        help="federated fleet view: per-replica liveness + key gauges "
+             "and the per-tenant SLO table, from a /fleet server "
+             "(--url) or direct replica scrapes (--scrape-url)",
+    )
+    p_ofleet.add_argument("--url", default="",
+                          help="base URL of a metrics server with a "
+                               "fleet collector attached (/fleet)")
+    p_ofleet.add_argument("--scrape-url", action="append", default=None,
+                          help="NAME=URL (or bare URL) of one replica's "
+                               "metrics server; repeatable")
+    p_oreq = obs_sub.add_parser(
+        "requests",
+        help="per-request journal (lifecycle, latency, prefix/spec "
+             "evidence, trace cross-link) from /debug/requests",
+    )
+    p_oreq.add_argument("--url", default="",
+                        help="base URL of a metrics server with a "
+                             "request journal attached")
+    p_oreq.add_argument("--tenant", default="", help="filter by tenant")
+    p_oreq.add_argument("--reason", default="",
+                        help="filter by finish reason (eos|budget|"
+                             "deadline|queue_full|no_capacity|aborted)")
+    p_oreq.add_argument("--trace", default="",
+                        help="exact trace id filter")
+    p_oreq.add_argument("--limit", type=int, default=30)
     p_ot = obs_sub.add_parser(
         "traces", help="render recorded spans as flame-style trees"
     )
@@ -1326,6 +1463,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_os.add_argument("--port", type=int, default=0)
     p_os.add_argument("--for-seconds", type=float, default=0.0,
                       help="exit after N seconds (0 = until interrupted)")
+    p_os.add_argument("--scrape-url", action="append", default=None,
+                      help="NAME=URL of a replica /metrics endpoint to "
+                           "federate; repeatable — serves /fleet")
     p_obs.set_defaults(fn=cmd_obs)
 
     p_srv = sub.add_parser(

@@ -41,13 +41,20 @@ from pathlib import Path
 
 def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
                        keep_finished: int = 20, devenv: bool = False,
-                       assets=None):
+                       assets=None, fleet_targets=None):
     """The platform's controller set on *kube* — THE single wiring,
     shared by the in-cluster controller role and the CLI's local
     platform (cli/platform_local.py) so the two cannot drift.
 
     ``assets``: an AssetStore — enables the GitOps reconciler
     (pull-based Application sync needs the repository assets).
+    ``fleet_targets``: ``{replica_name: url_or_callable}`` — wires a
+    federation collector (utils/federation.py) into the manager's rule
+    evaluator, so every alert tick scrapes the serving fleet first and
+    the default pack's fleet rules (FleetReplicaDown, per-replica
+    saturation, TenantSloBurnRate over federated counters) evaluate
+    against live fleet state; the collector rides on ``mgr.fleet`` for
+    a MetricsServer's ``/fleet``.
     Returns (manager, storage_provisioner); the caller may add device
     capacity to ``storage.pools`` before ``mgr.start()``."""
     from ..cloud.fake_cloudtpu import FakeCloudTpu, cloudtpu_client_factory
@@ -76,6 +83,25 @@ def controller_manager(kube, cloud=None, *, provision_poll: float = 5.0,
         default_rule_pack(), notify=AlertEventNotifier(kube)
     )
     mgr = Manager(kube, alerts=evaluator)
+    # Fleet federation: the collector scrapes BEFORE each rule tick
+    # (evaluator collector), into the same registry the rules read —
+    # the evaluator runs over fleet state unchanged.
+    mgr.fleet = None
+    if fleet_targets:
+        from ..utils.federation import FleetCollector
+
+        # Federation fans every source family out per replica (and a
+        # histogram family per tenant per le-bucket), so the evaluator's
+        # registry needs the collector's cardinality headroom — the
+        # default 256 cap would collapse a healthy fleet into the
+        # uncleareable overflow series and break the death-purge.
+        evaluator.registry.max_series_per_name = max(
+            evaluator.registry.max_series_per_name, 4096
+        )
+        mgr.fleet = FleetCollector(
+            fleet_targets, registry=evaluator.registry,
+            clock=evaluator.clock,
+        ).attach(evaluator)
     mgr.register("Deployment", DeploymentReconciler(kube))
     mgr.register(
         "TpuPodSlice",

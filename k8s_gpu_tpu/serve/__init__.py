@@ -6,6 +6,7 @@ from .bundle import export_servable, load_servable
 from .constrain import RegexConstraint, compile_constraint
 from .disagg import DisaggregatedLm
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
+from .journal import RequestJournal, RequestRecord
 from .jsonschema import SchemaError, schema_to_regex
 from .quant import quantize_params
 from .server import LmServer
@@ -14,6 +15,7 @@ from .speculative import distill_draft, rejection_sample
 __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
     "ContinuousBatcher", "Overloaded", "RequestHandle",
+    "RequestJournal", "RequestRecord",
     "quantize_params", "export_servable", "load_servable",
     "DisaggregatedLm", "RegexConstraint", "compile_constraint",
     "distill_draft", "rejection_sample", "schema_to_regex", "SchemaError",

@@ -51,11 +51,12 @@ from jax.sharding import Mesh
 
 from ..utils.compat import large_thread_stack, serialize_xla_compiles
 from ..utils.faults import global_faults
-from ..utils.metrics import global_metrics
+from ..utils.metrics import MetricsRegistry, global_metrics
 from ..utils.tracing import global_tracer
 from .engine import (
     InferenceEngine, _empty_cache, _empty_cache_paged, nucleus_mask,
 )
+from .journal import RequestJournal, RequestRecord
 from .kv_blocks import BlockPool, chunk_hashes
 from .speculative import reject_row
 
@@ -206,6 +207,21 @@ class _Request:
     # NOTHING per round.  Spans are created at round/segment
     # granularity only, never per token.
     trace_ctx: object = None
+    # SLO accounting dimension (caller-supplied request metadata;
+    # "default" for untagged traffic).  Labels the latency histograms,
+    # shed counter, and the goodput/total token counters at retirement.
+    tenant: str = "default"
+    # Admission path (_seated's path argument) — journal evidence of
+    # HOW the request was admitted; "" for requests shed pre-admission.
+    path: str = ""
+    # Prompt length captured at SUBMIT: ids.size, or the precomputed
+    # row's n_tokens — ``precomputed`` itself is dropped at seating (its
+    # HBM lifetime ends there), so the journal can't read it back.
+    prompt_tokens: int = 0
+    # Per-request speculative-decode evidence for the journal: drafted
+    # proposals and verify-kept acceptances attributable to THIS row.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 class RequestHandle:
@@ -289,8 +305,19 @@ class ContinuousBatcher:
         paged_blocks: int = 0,
         page_size: int = 64,
         max_pending: int = 0,
+        metrics: MetricsRegistry | None = None,
+        journal: RequestJournal | None = None,
     ):
-        """``max_pending`` > 0 bounds the unadmitted-request queue:
+        """``metrics``: the registry this batcher's serve-plane
+        telemetry lands in (default: the process-global one).  A
+        multi-replica process gives each batcher its OWN registry so
+        per-replica gauges don't clobber each other — the federation
+        collector (utils/federation.py) then scrapes each replica's
+        exposition and relabels with ``replica=``.  ``journal``: the
+        per-request lifecycle ring (serve/journal.py); one is created
+        when not supplied.
+
+        ``max_pending`` > 0 bounds the unadmitted-request queue:
         ``submit`` raises ``Overloaded`` at the bound (admission control —
         the server's 429 path) instead of queueing unboundedly.  0 keeps
         the historical unbounded behavior for direct embedders.
@@ -416,6 +443,8 @@ class ContinuousBatcher:
         self.params = params
         self.slots = slots
         self.eos_id = eos_id
+        self.metrics = metrics if metrics is not None else global_metrics
+        self.journal = journal if journal is not None else RequestJournal()
         # Collect per-token logprobs: a full-vocab log_softmax per decode
         # step plus an extra host fetch per round — off by default; the
         # LM server turns it on (its API exposes "logprobs").
@@ -1409,6 +1438,7 @@ class ContinuousBatcher:
         adapter: str | None = None,
         constraint: str | None = None,
         deadline: float | None = None,
+        tenant: str | None = None,
     ) -> RequestHandle:
         """Queue a request; returns a handle streaming generated ids.
         Raises ValueError when the prompt cannot fit, KeyError for an
@@ -1416,7 +1446,12 @@ class ContinuousBatcher:
         ``max_pending`` is configured and the queue is full.
         ``deadline`` is an absolute ``time.monotonic()`` instant: work
         still queued (or still decoding) past it is dropped, not
-        computed."""
+        computed.  ``tenant`` labels the request's SLO accounting
+        (latency histograms, shed counter, goodput/total tokens) and
+        its journal record; None/"" means ``"default"``.  Cardinality
+        is bounded by the registry's per-name series cap — a flood of
+        distinct tenant strings collapses into the overflow series,
+        never unbounded growth."""
         # error/timeout only: this site has no clock to realize a
         # "slow" decision, and a silently-skipped delay must not be
         # counted as an injection.
@@ -1445,6 +1480,8 @@ class ContinuousBatcher:
             deadline=deadline,
             t_submit=time.monotonic(),
             trace_ctx=global_tracer.current(),
+            tenant=str(tenant) if tenant else "default",
+            prompt_tokens=int(ids.size),
         )
         with self._lifecycle:
             if self._dead:
@@ -1454,7 +1491,11 @@ class ContinuousBatcher:
             try:
                 self._pending.put_nowait(req)
             except queue.Full:
-                global_metrics.inc("serve_shed_total", reason="queue_full")
+                self.metrics.inc(
+                    "serve_shed_total", reason="queue_full",
+                    tenant=req.tenant,
+                )
+                self._journal(req, "queue_full")
                 raise Overloaded(
                     f"pending queue full ({self.max_pending} requests); "
                     "retry later"
@@ -1467,7 +1508,7 @@ class ContinuousBatcher:
         max_new_tokens: int = 32, temperature: float = 0.0,
         top_p: float = 0.0, seed: int = 0,
         adapter: str | None = None, on_admit=None,
-        constraint: str | None = None,
+        constraint: str | None = None, tenant: str | None = None,
     ) -> RequestHandle:
         """Admit a request whose prefill ran elsewhere (serve/disagg.py):
         ``row_cache`` is a [L, 1, H, max_seq, Dh] K/V tree computed at a
@@ -1527,6 +1568,8 @@ class ContinuousBatcher:
             on_admit=on_admit,
             t_submit=time.monotonic(),
             trace_ctx=global_tracer.current(),
+            tenant=str(tenant) if tenant else "default",
+            prompt_tokens=int(n_tokens),
         )
         with self._lifecycle:
             if self._dead:
@@ -1536,7 +1579,11 @@ class ContinuousBatcher:
             try:
                 self._pending.put_nowait(req)
             except queue.Full:
-                global_metrics.inc("serve_shed_total", reason="queue_full")
+                self.metrics.inc(
+                    "serve_shed_total", reason="queue_full",
+                    tenant=req.tenant,
+                )
+                self._journal(req, "queue_full")
                 raise Overloaded(
                     f"pending queue full ({self.max_pending} requests); "
                     "retry later"
@@ -1870,9 +1917,10 @@ class ContinuousBatcher:
         """Common tail of every admission: bookkeeping + C32 counters
         (admissions by path, live-slot gauge, pending-queue gauge)."""
         req.slot = slot
+        req.path = path
         self._active[slot] = req
         req.t_admit = time.monotonic()
-        global_metrics.observe(
+        self.metrics.observe(
             "serve_queue_wait_seconds", req.t_admit - req.t_submit
         )
         if req.trace_ctx is not None:
@@ -1888,7 +1936,7 @@ class ContinuousBatcher:
         # round that is 100% garbage (and every tail round sizes one
         # bucket too large).  _process's admit branch releases it.
         req.inflight_steps = 1
-        global_metrics.inc("serve_admissions_total", path=path)
+        self.metrics.inc("serve_admissions_total", path=path)
         # Prefix-cache accounting (dense entry cache AND paged block
         # cache): one hit/miss per admission that CONSULTED it —
         # precomputed (disagg) rows, adapter rows (cached K/V are
@@ -1899,10 +1947,10 @@ class ContinuousBatcher:
             self._paged_share if self.paged else True
         )
         if path in ("prefix_exact", "prefix_suffix", "paged_shared"):
-            global_metrics.inc("serve_prefix_cache_hits_total")
+            self.metrics.inc("serve_prefix_cache_hits_total")
         elif consulted and path in ("cold", "cold_fused", "paged_cold"):
-            global_metrics.inc("serve_prefix_cache_misses_total")
-        global_metrics.set_gauge(
+            self.metrics.inc("serve_prefix_cache_misses_total")
+        self.metrics.set_gauge(
             "serve_pending_requests", float(self._pending.qsize())
         )
         self._update_util_gauges()
@@ -1924,8 +1972,8 @@ class ContinuousBatcher:
           rolling host-wall-clock window (dispatch cadence included — the
           streaming rate callers actually see)."""
         live = [r for r in self._active if r is not None]
-        global_metrics.set_gauge("serve_slots_active", float(len(live)))
-        global_metrics.set_gauge(
+        self.metrics.set_gauge("serve_slots_active", float(len(live)))
+        self.metrics.set_gauge(
             "serve_slot_fill_ratio",
             len(live) / self.slots if self.slots else 0.0,
         )
@@ -1937,11 +1985,11 @@ class ContinuousBatcher:
             # next allocation, so they are capacity, not pressure.
             usable = self._pool.usable
             used = self._pool.pinned_count
-            global_metrics.set_gauge("serve_kv_blocks_used", float(used))
-            global_metrics.set_gauge(
+            self.metrics.set_gauge("serve_kv_blocks_used", float(used))
+            self.metrics.set_gauge(
                 "serve_kv_blocks_shared", float(self._pool.shared_count)
             )
-            global_metrics.set_gauge(
+            self.metrics.set_gauge(
                 "serve_kv_blocks_cached", float(self._pool.cached_count)
             )
             occ = used / usable if usable else 0.0
@@ -1951,12 +1999,12 @@ class ContinuousBatcher:
                 sum(min(r.pos_hint, self.engine.max_seq) for r in live) / cap
                 if cap else 0.0
             )
-        global_metrics.set_gauge("serve_kv_occupancy_ratio", occ)
+        self.metrics.set_gauge("serve_kv_occupancy_ratio", occ)
         now = time.monotonic()
         self._tput_samples.append((now, self._emit_total))
         t0, n0 = self._tput_samples[0]
         if now - t0 > 0.0:
-            global_metrics.set_gauge(
+            self.metrics.set_gauge(
                 "serve_decode_tokens_per_second",
                 (self._emit_total - n0) / (now - t0),
             )
@@ -2147,7 +2195,7 @@ class ContinuousBatcher:
         if self._gate_fallback:
             # Point of no return: the plain round below WILL dispatch.
             self._ngram_fallback_rounds += 1
-            global_metrics.inc("serve_spec_fallback_rounds_total")
+            self.metrics.inc("serve_spec_fallback_rounds_total")
         # Dispatch timestamp BEFORE the jit call: on backends where
         # dispatch is synchronous (CPU) a post-call stamp would make a
         # timed round's dispatch→consume interval read ~0.
@@ -2299,23 +2347,49 @@ class ContinuousBatcher:
             if not req.deadline_expired:
                 # An expired row is a shed, not a completion — it must
                 # not pollute the completion/latency series.
-                global_metrics.inc("serve_completions_total")
-                global_metrics.observe(
+                self.metrics.inc("serve_completions_total")
+                self.metrics.observe(
                     "serve_generated_tokens", float(req.emitted)
                 )
                 # C32 latency budget surface: time-to-first-token and mean
                 # inter-token gap per request (emission-side wall-clock —
                 # tokens reach the host in round batches, so the gap is the
                 # per-request STREAMING rate, dispatch cadence included).
+                # Each lands twice: unlabeled (the all-tenant aggregate
+                # the bench and the default p95 rule read) and
+                # tenant-labeled (the per-tenant SLO view).
                 if req.emitted >= 1 and req.t_first > 0.0:
-                    global_metrics.observe(
-                        "serve_ttft_seconds", req.t_first - req.t_submit
+                    ttft = req.t_first - req.t_submit
+                    self.metrics.observe("serve_ttft_seconds", ttft)
+                    self.metrics.observe(
+                        "serve_ttft_seconds", ttft, tenant=req.tenant
                     )
                 if req.emitted >= 2 and req.t_first > 0.0:
-                    global_metrics.observe(
-                        "serve_inter_token_seconds",
-                        (req.t_last - req.t_first) / (req.emitted - 1),
+                    gap = (req.t_last - req.t_first) / (req.emitted - 1)
+                    self.metrics.observe("serve_inter_token_seconds", gap)
+                    self.metrics.observe(
+                        "serve_inter_token_seconds", gap,
+                        tenant=req.tenant,
                     )
+            # Per-tenant goodput accounting: every generated token
+            # counts in the total; only tokens of requests that
+            # FINISHED inside their latency budget count as goodput.
+            # A zero inc still mints the tenant's series, so a tenant
+            # whose every request sheds is visible at rate 0 instead of
+            # absent.
+            good = (
+                req.emitted
+                if not (req.deadline_expired or req.aborted) else 0
+            )
+            self.metrics.inc(
+                "serve_tenant_tokens_total", float(req.emitted),
+                tenant=req.tenant,
+            )
+            self.metrics.inc(
+                "serve_tenant_goodput_tokens_total", float(good),
+                tenant=req.tenant,
+            )
+            self._journal(req, self._finish_reason(req))
         if self.paged and req is not None and req.blocks:
             # Point the slot at the trash block and release the blocks'
             # references — a shared prefix block stays pinned while any
@@ -2336,13 +2410,68 @@ class ContinuousBatcher:
         self._active[slot] = None
         self._update_util_gauges()
 
+    @staticmethod
+    def _finish_reason(req: _Request) -> str:
+        """Journal vocabulary for a retired row (serve/journal.py):
+        deadline beats aborted beats budget; anything retired early
+        with budget remaining stopped on EOS."""
+        if req.deadline_expired:
+            return "deadline"
+        if req.aborted:
+            return "aborted"
+        if req.emitted >= req.max_new:
+            return "budget"
+        return "eos"
+
+    def _journal(self, req: _Request, reason: str) -> None:
+        """One lifecycle record per terminal outcome — completion,
+        shed, or abort — into the bounded journal ring.  Scheduler
+        thread (and the submit thread for door sheds); pure host
+        bookkeeping, no device work."""
+        self.journal.append(RequestRecord(
+            tenant=req.tenant,
+            trace_id=(
+                req.trace_ctx.trace_id if req.trace_ctx is not None
+                else ""
+            ),
+            reason=reason,
+            path=req.path,
+            slot=req.slot,
+            prompt_tokens=req.prompt_tokens,
+            tokens=req.emitted,
+            queue_wait_s=(
+                max(0.0, req.t_admit - req.t_submit)
+                if req.t_admit > 0.0 else 0.0
+            ),
+            ttft_s=(
+                max(0.0, req.t_first - req.t_submit)
+                if req.t_first > 0.0 else 0.0
+            ),
+            tpot_s=(
+                (req.t_last - req.t_first) / (req.emitted - 1)
+                if req.emitted >= 2 and req.t_first > 0.0 else 0.0
+            ),
+            prefix_blocks=(
+                (req.prefix_tokens or 0) // self.page_size
+                if self.paged else 0
+            ),
+            spec_drafted=req.spec_drafted,
+            spec_accepted=req.spec_accepted,
+            deadline_expired=req.deadline_expired,
+            t_submit=req.t_submit,
+            t_done=time.monotonic(),
+        ))
+
     def _shed_expired(self, req: _Request) -> None:
         """Drop an expired request AT ADMISSION: no prefill or decode
         round ever runs for it — the "dropped, not computed" half of the
         deadline contract."""
         req.deadline_expired = True
         req.aborted = True
-        global_metrics.inc("serve_shed_total", reason="deadline")
+        self.metrics.inc(
+            "serve_shed_total", reason="deadline", tenant=req.tenant
+        )
+        self._journal(req, "deadline")
         req.out.put(None)
 
     def _expire_live(self, slot: int, req: _Request) -> bool:
@@ -2355,7 +2484,9 @@ class ContinuousBatcher:
             return False
         req.deadline_expired = True
         req.aborted = True
-        global_metrics.inc("serve_shed_total", reason="deadline")
+        self.metrics.inc(
+            "serve_shed_total", reason="deadline", tenant=req.tenant
+        )
         self._retire(slot)
         return True
 
@@ -2522,10 +2653,13 @@ class ContinuousBatcher:
                         break
                 if row_d:
                     # Per-slot rolling window — the ngram gate's
-                    # per-tenant acceptance evidence (_spec_gate).
+                    # per-tenant acceptance evidence (_spec_gate) —
+                    # plus the request's own journal evidence.
                     self._slot_spec.setdefault(
                         i, collections.deque(maxlen=8)
                     ).append((row_d, row_a))
+                    req.spec_drafted += row_d
+                    req.spec_accepted += row_a
                 if req.trace_ctx is not None and req.emitted > n0:
                     global_tracer.add_span(
                         "serve.round", parent=req.trace_ctx,
@@ -2656,6 +2790,7 @@ class ContinuousBatcher:
                                 req.aborted = True
                                 if req.on_admit is not None:
                                     req.on_admit()
+                                self._journal(req, "no_capacity")
                                 req.out.put(None)
                                 continue
                             # Back at the FRONT: this req was popleft'd
@@ -2709,6 +2844,7 @@ class ContinuousBatcher:
                         req.aborted = True
                         if req.on_admit is not None:
                             req.on_admit()
+                        self._journal(req, "aborted")
                         req.out.put(None)
                         raise
                 # Keep the device busy: dispatch the next round before
@@ -2740,11 +2876,13 @@ class ContinuousBatcher:
                 for r in self._active:
                     if r is not None:
                         r.aborted = True
+                        self._journal(r, "aborted")
                         r.out.put(None)
                 if self.paged:
                     while self._overflow:
                         r = self._overflow.popleft()
                         r.aborted = True
+                        self._journal(r, "aborted")
                         r.out.put(None)
                 while True:
                     try:
@@ -2757,4 +2895,5 @@ class ContinuousBatcher:
                     # semaphore doesn't leak a permit.
                     if r.on_admit is not None:
                         r.on_admit()
+                    self._journal(r, "aborted")
                     r.out.put(None)

@@ -1,0 +1,112 @@
+"""Per-request serving journal: one lifecycle record per retired request.
+
+Metrics aggregate (``serve_ttft_seconds`` cannot say WHICH request blew
+the budget) and traces sample the slow path span-by-span but take a
+trace id to find.  The journal is the middle layer: a bounded ring of
+one compact record per request the batcher finished with — completed,
+budget-exhausted, deadline-shed, queue-shed, or aborted — carrying the
+whole latency story (queue wait, TTFT, per-token gap), the efficiency
+story (prefix-cache blocks hit, speculative acceptance), and the trace
+id that cross-links into ``/debug/traces`` for span-level detail.
+
+``ContinuousBatcher`` owns one and appends at every terminal point;
+``MetricsServer`` exports it at ``/debug/requests`` and ``obs
+requests`` renders it.  Overflow drops the oldest record (it is recent
+behavior the journal is for — the same bound philosophy as the
+histogram reservoirs and the trace ring); ``dropped`` counts evictions.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import asdict, dataclass, field
+
+
+# Terminal reasons a record can carry (the ``reason`` vocabulary):
+#   eos            the model emitted the stop token
+#   budget         max_new_tokens reached
+#   deadline       the latency budget expired (at admission or mid-stream)
+#   queue_full     shed at the door — max_pending admission control
+#   no_capacity    paged mode could not seat the prompt even on an idle pool
+#   aborted        batcher crash/shutdown cut the stream
+FINISH_REASONS = (
+    "eos", "budget", "deadline", "queue_full", "no_capacity", "aborted",
+)
+
+
+@dataclass
+class RequestRecord:
+    """One retired request, flattened for JSON (``to_dict``)."""
+
+    tenant: str = "default"
+    trace_id: str = ""
+    reason: str = ""
+    path: str = ""            # admission path ("" when shed pre-admission)
+    slot: int = -1
+    prompt_tokens: int = 0
+    tokens: int = 0           # generated tokens actually delivered
+    queue_wait_s: float = 0.0
+    ttft_s: float = 0.0       # 0.0 when no token was emitted
+    tpot_s: float = 0.0       # mean inter-token gap; 0.0 under 2 tokens
+    prefix_blocks: int = 0    # shared KV blocks acquired from the cache
+    spec_drafted: int = 0     # speculative proposals for this request
+    spec_accepted: int = 0    # ...and how many the verify kept
+    deadline_expired: bool = False
+    t_submit: float = 0.0     # time.monotonic() domain, like spans
+    t_done: float = 0.0
+    extra: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        if not d["extra"]:
+            d.pop("extra")
+        return d
+
+
+class RequestJournal:
+    """Thread-safe bounded ring of ``RequestRecord``s."""
+
+    def __init__(self, maxlen: int = 512):
+        self._lock = threading.Lock()
+        self._ring: "deque[RequestRecord]" = deque(
+            maxlen=max(1, int(maxlen))
+        )
+        self.dropped = 0
+
+    def append(self, rec: RequestRecord) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(rec)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def snapshot(
+        self,
+        limit: int = 100,
+        tenant: str = "",
+        reason: str = "",
+        trace_id: str = "",
+    ) -> list[dict]:
+        """Newest-first records as dicts, optionally filtered; the
+        ``/debug/requests`` body.  ``limit <= 0`` returns none (the
+        bare ``[-0:]`` hazard the alerts snapshot also guards)."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            recs = list(self._ring)
+        out = []
+        for rec in reversed(recs):
+            if tenant and rec.tenant != tenant:
+                continue
+            if reason and rec.reason != reason:
+                continue
+            if trace_id and rec.trace_id != trace_id:
+                continue
+            out.append(rec.to_dict())
+            if len(out) >= limit:
+                break
+        return out

@@ -23,9 +23,9 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..data.tokenizer import BpeTokenizer
-from ..utils.metrics import global_metrics
 from ..utils.obs import RequestMetricsMixin
 from .batcher import ContinuousBatcher, Overloaded
+from .journal import RequestRecord as JournalRecord
 
 # Advisory client backoff on 429/503: long enough to drain a round or
 # two, short enough that a recovered server re-fills quickly.
@@ -54,6 +54,7 @@ class LmServer:
         paged_blocks: int = 0,
         page_size: int = 64,
         max_pending: int = 64,
+        metrics=None,
     ):
         """``max_pending`` bounds the batcher's unadmitted-request queue:
         at the bound, /generate sheds with 429 + Retry-After instead of
@@ -61,6 +62,17 @@ class LmServer:
         may carry an ``x-request-deadline-ms`` header — a per-request
         latency budget propagated into the batcher; work still queued or
         decoding past it is dropped and answered 504.
+
+        Requests may also carry a tenant tag — ``{"tenant": "..."}`` in
+        the body, or the ``x-tenant`` header as a fallback — which
+        labels the batcher's per-tenant SLO accounting (TTFT/inter-token
+        histograms, shed counter, goodput/total token counters) and the
+        request journal; untagged traffic is tenant ``"default"``
+        (docs/platform/serving.md, "The tenant label contract").
+
+        ``metrics``: a ``MetricsRegistry`` for the batcher's serve-plane
+        telemetry — each replica of a multi-replica deployment gets its
+        own so the federation collector can tell them apart.
 
         ``adapters``: name → (lora_params, LoraConfig); requests pick
         one with {"adapter": "<name>"} — multi-tenant fine-tunes served
@@ -87,8 +99,11 @@ class LmServer:
             constraints=cbank, eos_id=eos_id, logprobs=True,
             draft=draft, spec_k=spec_k, kv_quant=kv_quant,
             paged_blocks=paged_blocks, page_size=page_size,
-            max_pending=max_pending,
+            max_pending=max_pending, metrics=metrics,
         )
+        # The per-request lifecycle ring — hand to a MetricsServer's
+        # ``journal=`` to serve it at /debug/requests.
+        self.journal = self.batcher.journal
         self.tokenizer = tokenizer
         self.started_at = time.time()
         self.cap = max_new_tokens_cap
@@ -158,6 +173,18 @@ class LmServer:
                 if constraint is not None and not isinstance(constraint, str):
                     return self._json(
                         400, {"error": "constraint must be a string"})
+                # Tenant tag: body field first, x-tenant header as the
+                # proxy-injected fallback; absent/empty → "default".
+                # Length-capped — it becomes a metric label, and the
+                # registry's cardinality guard bounds the SERIES count
+                # but not one value's byte length.
+                tenant = body.get("tenant")
+                if tenant is None:
+                    tenant = self.headers.get("x-tenant") or ""
+                if not isinstance(tenant, str):
+                    return self._json(
+                        400, {"error": "tenant must be a string"})
+                tenant = tenant.strip()[:64] or "default"
                 stream = bool(body.get("stream", False))
                 want_lp = bool(body.get("logprobs", False))
                 # Per-request latency budget: x-request-deadline-ms is a
@@ -178,11 +205,23 @@ class LmServer:
                         })
                     if budget_ms <= 0:
                         # A shed like any other deadline drop — the 504
-                        # rate must move the same observable the batcher's
-                        # admission/round gates do.
-                        global_metrics.inc(
-                            "serve_shed_total", reason="deadline"
+                        # rate must move the same observables the
+                        # batcher's admission/round gates do (counter
+                        # AND journal), in the BATCHER's registry so a
+                        # per-replica deployment attributes it right.
+                        outer.batcher.metrics.inc(
+                            "serve_shed_total", reason="deadline",
+                            tenant=tenant,
                         )
+                        ctx = getattr(self, "trace_ctx", None)
+                        outer.journal.append(JournalRecord(
+                            tenant=tenant,
+                            trace_id=ctx.trace_id if ctx else "",
+                            reason="deadline",
+                            deadline_expired=True,
+                            t_submit=time.monotonic(),
+                            t_done=time.monotonic(),
+                        ))
                         return self._json(
                             504, {"error": "deadline exceeded"})
                     deadline = time.monotonic() + budget_ms / 1000.0
@@ -198,6 +237,7 @@ class LmServer:
                         adapter=adapter,
                         constraint=constraint,
                         deadline=deadline,
+                        tenant=tenant,
                     )
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})

@@ -6,9 +6,16 @@ from .alerts import (
 )
 from .clock import Clock, RealClock, FakeClock
 from .faults import FaultInjector, FaultPlan, InjectedFault, global_faults
+from .federation import FleetCollector, bucket_quantile
 from .metrics import MetricsRegistry, global_metrics, parse_exposition
 from .logstore import LogEntry, LogStore, LogStoreHandler, global_logstore
-from .obs import MetricsServer, render_top
+from .obs import (
+    MetricsServer,
+    render_fleet,
+    render_requests,
+    render_top,
+    render_top_columns,
+)
 from .profiling import profile_trainer, step_annotation, trace, trace_files
 from .tracing import (
     SpanContext,
@@ -27,8 +34,13 @@ __all__ = [
     "Clock",
     "RealClock",
     "FakeClock",
+    "FleetCollector",
+    "bucket_quantile",
     "parse_exposition",
+    "render_fleet",
+    "render_requests",
     "render_top",
+    "render_top_columns",
     "FaultInjector",
     "FaultPlan",
     "InjectedFault",

@@ -421,16 +421,69 @@ def default_rule_pack(
     kv_for_s: float = 10.0,
     breaker_for_s: float = 10.0,
     pool_for_s: float = 30.0,
+    tenant_slo: float | None = None,
+    tenant_burn_threshold: float | None = None,
+    tenant_for_s: float = 60.0,
+    replica_down_for_s: float = 0.0,
 ) -> list:
     """The platform's default recording + alerting rules.
 
     Recording: HTTP error ratio and SLO burn rate over ``burn_window``
     (from ``http_requests_total``), reconcile-duration and serve-TTFT
-    p95s (exact, from the histogram reservoirs).  Alerting: QueueBacklog
+    p95s (exact, from the histogram reservoirs), and the per-tenant
+    goodput burn rate (from ``serve_tenant_{goodput_,}tokens_total`` —
+    serve/batcher.py's tenant accounting).  Alerting: QueueBacklog
     (per workqueue), KVCacheSaturation, HighErrorBurnRate (on the
     recorded burn rate — 14.4 is the standard fast-burn page threshold),
     BreakerOpen (per endpoint; state 2 = open), PoolDegraded (per pool;
-    ratio 1.0 = all desired replicas ready)."""
+    ratio 1.0 = all desired replicas ready), TenantSloBurnRate (per
+    tenant, on the recorded goodput burn), and FleetReplicaDown (per
+    replica, on ``fleet_replica_up`` — the federation collector drops
+    it to 0 after M consecutive scrape failures, so the hold lives in
+    the collector's ``down_after`` and ``replica_down_for_s`` defaults
+    to 0: the M-th failed scrape walks pending→firing in one tick).
+
+    ``tenant_slo``/``tenant_burn_threshold`` default to ``slo``/
+    ``burn_threshold``.  Rules whose input families are absent (no
+    tenants served yet, no federation collector feeding the registry)
+    simply have no label-sets to evaluate — the pack is safe to run on
+    any registry."""
+    t_slo = slo if tenant_slo is None else tenant_slo
+    t_burn = (
+        burn_threshold if tenant_burn_threshold is None
+        else tenant_burn_threshold
+    )
+
+    def _tenant_burn(ctx: Ctx) -> dict:
+        # One FSM per tenant, replica dimension collapsed: in a
+        # federated registry the token counters carry replica= labels,
+        # and per-(tenant, replica) burn FSMs would page N times for
+        # one tenant's breach.  ``ctx.rate(..., tenant=t)`` sums the
+        # matching series whatever other labels ride along.
+        out: dict[LabelSet, float] = {}
+        tenants = {
+            dict(lbls).get("tenant")
+            for lbls in ctx.series("serve_tenant_tokens_total")
+        }
+        # Seed the goodput watch alongside the total watch so both
+        # families have rate history from the same tick onward.
+        ctx.rate("serve_tenant_goodput_tokens_total", burn_window)
+        for t in sorted(t for t in tenants if t):
+            key = (("tenant", t),)
+            total = ctx.rate(
+                "serve_tenant_tokens_total", burn_window, tenant=t
+            )
+            if total <= 0.0:
+                out[key] = 0.0
+                continue
+            good = ctx.rate(
+                "serve_tenant_goodput_tokens_total", burn_window,
+                tenant=t,
+            )
+            bad_ratio = max(0.0, total - good) / total
+            out[key] = bad_ratio / max(1e-9, 1.0 - t_slo)
+        return out
+
     return [
         RecordingRule(
             "http_error_ratio",
@@ -451,6 +504,7 @@ def default_rule_pack(
             "serve_ttft_p95",
             lambda ctx: ctx.percentiles("serve_ttft_seconds", 0.95),
         ),
+        RecordingRule("tenant_slo_burn_rate", _tenant_burn),
         AlertingRule(
             "QueueBacklog",
             lambda ctx: ctx.series("workqueue_depth"),
@@ -488,5 +542,23 @@ def default_rule_pack(
             lambda ctx: ctx.series("pool_ready_ratio"),
             below=1.0, for_s=pool_for_s,
             annotation="pool {pool} ({kind}) at {value:.0%} of desired",
+        ),
+        AlertingRule(
+            "TenantSloBurnRate",
+            lambda ctx: ctx.series("tenant_slo_burn_rate"),
+            above=t_burn, for_s=tenant_for_s, severity="page",
+            annotation=(
+                "tenant {tenant} burning its goodput budget {value:.1f}x "
+                "too fast"
+            ),
+        ),
+        AlertingRule(
+            "FleetReplicaDown",
+            lambda ctx: ctx.series("fleet_replica_up"),
+            below=0.5, for_s=replica_down_for_s, severity="page",
+            annotation=(
+                "replica {replica} unreachable — scrape failed for "
+                "consecutive federation ticks"
+            ),
         ),
     ]

@@ -1,0 +1,441 @@
+"""Fleet metrics federation: many `/metrics` islands → one registry.
+
+Every replica in the serving fleet (and every control-plane process)
+exports its own Prometheus-style exposition, but a per-process endpoint
+cannot answer "which replica is hot" or "which tenant is burning its
+SLO" — the questions the prefix-aware router and the telemetry-driven
+autoscaler (ROADMAP item 1) have to ask every tick.  ``FleetCollector``
+is the aggregation substrate:
+
+- **scrape**: each target is a replica name mapped to either a base URL
+  (``http://host:port`` — ``/metrics`` is fetched) or a zero-arg
+  callable returning exposition text (in-process replicas, fakes in
+  tests — fully deterministic under ``FakeClock``).  Targets iterate in
+  sorted name order, so two scrape passes over the same inputs produce
+  a bit-identical fleet registry.
+- **relabel**: every scraped series lands in the fleet registry with a
+  ``replica=<name>`` label added, preserving the source labels — the
+  per-replica detail plane (``serve_slot_fill_ratio{replica="r1"}``).
+- **aggregate**: per-metric policy.  Counters (``_total``/``_count``/
+  ``_sum``/``_bucket`` suffixes) are summed *at read time* — the rules
+  engine's ``ctx.sum``/``ctx.rate`` already sum across matching
+  label-sets, so storing a fleet-sum series under the same name would
+  double-count every rate.  Gauges additionally get a STORED aggregate
+  series under the same name without the ``replica`` label (``sum``,
+  ``max``, ``min`` or ``avg`` per ``GAUGE_AGG``; default ``max`` — the
+  hot-spot view), so ``ctx.gauge(name)`` reads the fleet value and
+  per-replica label-sets keep their own alert FSMs.  Histogram
+  percentiles merge at read time from the summed ``_bucket`` series
+  (``FleetCollector.percentile`` — raw reservoirs don't cross the text
+  format, so the fleet quantile interpolates inside the merged bucket,
+  the standard ``histogram_quantile`` estimate).
+- **liveness**: a scrape failure bumps ``fleet_scrape_failures_total``
+  and a replica whose scrape fails ``down_after`` CONSECUTIVE passes
+  drops ``fleet_replica_up{replica=}`` to 0 and has its per-replica
+  series purged (a dead replica's last-seen gauges must not keep
+  per-replica alerts firing against nothing — the same vanished-series
+  contract the pool gauges follow).  ``FleetReplicaDown`` in the
+  default rule pack alerts on exactly this gauge, and recovery flips it
+  back to 1 (the alert resolves).
+
+The existing ``RuleEvaluator`` runs over the fleet registry unchanged:
+``attach(evaluator)`` registers ``scrape_once`` as an evaluator
+collector, so every tick scrapes the fleet BEFORE rules evaluate —
+fleet-level burn rates and per-replica saturation alerts fall out of
+the default pack with zero new engine code.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .clock import Clock, RealClock
+from .metrics import MetricsRegistry, _fmt, parse_exposition
+
+_COUNTERISH = ("_total", "_count", "_sum", "_bucket")
+
+# Stored-aggregate policy for gauge families (the fleet series written
+# WITHOUT the replica label).  Everything absent defaults to "max":
+# for saturation-shaped gauges the fleet answer is its hottest member.
+GAUGE_AGG: dict[str, str] = {
+    "serve_slot_fill_ratio": "avg",
+    "serve_pending_requests": "sum",
+    "serve_slots_active": "sum",
+    "serve_decode_tokens_per_second": "sum",
+    "serve_kv_blocks_used": "sum",
+    "serve_kv_blocks_shared": "sum",
+    "serve_kv_blocks_cached": "sum",
+    "workqueue_depth": "sum",
+    "train_tokens_per_second": "sum",
+    "pool_ready_ratio": "min",
+}
+
+# Families the collector never writes aggregates for: the fleet
+# evaluator OWNS these names in the fleet registry (an aggregate would
+# clobber its output); per-replica relabeled copies are still written.
+_NO_AGG = frozenset({"alerts_firing"})
+
+# The per-replica gauge set /fleet snapshots and the CLI renderers
+# surface (full detail stays queryable from the registry itself).
+KEY_GAUGES = (
+    "serve_slot_fill_ratio",
+    "serve_kv_occupancy_ratio",
+    "serve_pending_requests",
+    "serve_decode_tokens_per_second",
+    "serve_slots_active",
+    "workqueue_depth",
+)
+
+
+def _series_key(name: str, labels: dict) -> str:
+    return f"{name}{_fmt(tuple(sorted(labels.items())))}"
+
+
+def bucket_quantile(series: dict, q: float) -> float | None:
+    """``histogram_quantile`` over cumulative ``_bucket`` series that
+    may span replicas: per-``le`` counts sum (cumulative merges stay
+    cumulative), then the quantile interpolates linearly inside the
+    first bucket whose merged count covers rank ``q*n``.  None when the
+    merged histogram is empty."""
+    merged: dict[float, float] = {}
+    for lbls, v in series.items():
+        le = dict(lbls).get("le")
+        if le is None:
+            continue
+        try:
+            b = float(le)
+        except ValueError:
+            continue
+        merged[b] = merged.get(b, 0.0) + v
+    if not merged:
+        return None
+    bounds = sorted(merged)
+    total = merged[bounds[-1]]
+    if total <= 0.0:
+        return None
+    rank = max(0.0, min(1.0, q)) * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for b in bounds:
+        cum = merged[b]
+        if cum >= rank:
+            if b == float("inf"):
+                # Observation above the last finite bucket: the best
+                # honest answer is that bucket's bound.
+                return prev_bound
+            span = cum - prev_cum
+            frac = (rank - prev_cum) / span if span > 0 else 1.0
+            return prev_bound + (b - prev_bound) * frac
+        prev_bound, prev_cum = b, cum
+    return bounds[-1]
+
+
+class FleetCollector:
+    """Scrapes a named set of exposition targets into one fleet
+    ``MetricsRegistry`` (see module docstring for the model)."""
+
+    def __init__(
+        self,
+        targets: dict | None = None,
+        registry: MetricsRegistry | None = None,
+        clock: Clock | None = None,
+        down_after: int = 3,
+        gauge_agg: dict | None = None,
+        timeout: float = 5.0,
+        max_series_per_name: int = 4096,
+    ):
+        """``targets``: ``{replica_name: url_or_callable}``.  A fresh
+        fleet registry gets a higher cardinality cap than the default —
+        every source series fans out per replica, and the guard must
+        bound tenants-x-replicas, not clip a healthy fleet."""
+        self.registry = registry or MetricsRegistry(
+            max_series_per_name=max_series_per_name
+        )
+        self.clock = clock or RealClock()
+        self.down_after = max(1, int(down_after))
+        self.timeout = float(timeout)
+        self.gauge_agg = {**GAUGE_AGG, **(gauge_agg or {})}
+        self._lock = threading.Lock()
+        # Serializes whole scrape passes: the evaluator tick thread and
+        # a /fleet?refresh=1 HTTP handler can both call scrape_once —
+        # interleaved passes would double-step the consecutive-failure
+        # counters past the purge threshold and race the stale-series
+        # diffs.  Distinct from (and always taken outside) _lock.
+        self._scrape_lock = threading.Lock()
+        self._targets: dict[str, object] = {}
+        self._fails: dict[str, int] = {}
+        self._last_ok: dict[str, float] = {}
+        self._last_fams: dict[str, dict] = {}
+        # Per-replica (name, label_tuple) gauge keys currently written
+        # into the fleet registry — the purge/diff bookkeeping.
+        self._ingested: dict[str, set] = {}
+        self._agg_keys: set = set()
+        self._scrapes = 0
+        for name, target in (targets or {}).items():
+            self.add_target(name, target)
+
+    # -- target management -------------------------------------------------
+    def add_target(self, name: str, target) -> None:
+        with self._lock:
+            self._targets[str(name)] = target
+            self._fails.setdefault(str(name), 0)
+
+    def remove_target(self, name: str) -> None:
+        with self._lock:
+            self._targets.pop(name, None)
+            self._fails.pop(name, None)
+            self._last_ok.pop(name, None)
+            self._last_fams.pop(name, None)
+        self._purge(name)
+        self.registry.remove_gauge("fleet_replica_up", replica=name)
+        self.registry.remove_gauge(
+            "fleet_scrape_age_seconds", replica=name
+        )
+
+    @property
+    def never_scraped(self) -> bool:
+        return self._scrapes == 0
+
+    def attach(self, evaluator) -> "FleetCollector":
+        """Register the scrape as an evaluator collector: every rule
+        tick scrapes the fleet first, so rules always see this tick's
+        replicas.  The evaluator's clock should be this collector's
+        clock (one time domain)."""
+        evaluator.collectors.append(self.scrape_once)
+        return self
+
+    # -- scraping ----------------------------------------------------------
+    def _fetch(self, target) -> str:
+        if callable(target):
+            return target()
+        import urllib.request
+
+        url = str(target).rstrip("/")
+        if not url.endswith("/metrics"):
+            url += "/metrics"
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            return r.read().decode()
+
+    def scrape_once(self) -> dict[str, bool]:
+        """One federation pass over every target (sorted order —
+        deterministic); returns ``{replica: scraped_ok}``.  Concurrent
+        calls serialize — the second caller scrapes right after the
+        first, never interleaved with it."""
+        with self._scrape_lock:
+            return self._scrape_once_locked()
+
+    def _scrape_once_locked(self) -> dict[str, bool]:
+        now = self.clock.now()
+        with self._lock:
+            targets = sorted(self._targets.items())
+        up: dict[str, bool] = {}
+        for name, target in targets:
+            try:
+                fams = parse_exposition(self._fetch(target))
+            except Exception:
+                fails = self._fails.get(name, 0) + 1
+                self._fails[name] = fails
+                self.registry.inc(
+                    "fleet_scrape_failures_total", replica=name
+                )
+                if fails >= self.down_after:
+                    # The M-th consecutive failure: the replica is DOWN.
+                    # Purge its per-replica series so stale last-seen
+                    # gauges can't keep replica-scoped alerts firing,
+                    # but keep (and zero) the up gauge — it IS the
+                    # FleetReplicaDown signal.  (>= so a skipped count
+                    # can never skip the purge; re-purging is a no-op.)
+                    with self._lock:
+                        self._last_fams.pop(name, None)
+                    self._purge(name)
+                    self.registry.set_gauge(
+                        "fleet_replica_up", 0.0, replica=name
+                    )
+                up[name] = False
+                continue
+            self._fails[name] = 0
+            with self._lock:
+                self._last_ok[name] = now
+                self._last_fams[name] = fams
+            self.registry.set_gauge("fleet_replica_up", 1.0, replica=name)
+            self._ingest(name, fams)
+            up[name] = True
+        self._aggregate()
+        with self._lock:
+            for name, _ in targets:
+                last = self._last_ok.get(name)
+                if last is not None:
+                    self.registry.set_gauge(
+                        "fleet_scrape_age_seconds", now - last,
+                        replica=name,
+                    )
+        self.registry.set_gauge("fleet_replicas", float(len(targets)))
+        self.registry.set_gauge(
+            "fleet_replicas_up", float(sum(1 for v in up.values() if v))
+        )
+        self._scrapes += 1
+        return up
+
+    def _ingest(self, replica: str, fams: dict) -> None:
+        """Write one replica's parsed families into the fleet registry
+        with ``replica=`` added; series that vanished since the last
+        scrape of this replica are removed (gauge semantics: a scrape
+        REPLACES the replica's contribution, it never accretes)."""
+        fresh: set = set()
+        for mname, series in fams.items():
+            if mname.startswith("fleet_"):
+                continue  # never re-federate collector output
+            for lbls, v in series.items():
+                d = dict(lbls)
+                d["replica"] = replica
+                self.registry.set_gauge_series(mname, v, d)
+                fresh.add((mname, tuple(sorted(d.items()))))
+        with self._lock:
+            stale = self._ingested.get(replica, set()) - fresh
+            self._ingested[replica] = fresh
+        for mname, lbls in stale:
+            self.registry.remove_gauge(mname, **dict(lbls))
+
+    def _purge(self, replica: str) -> None:
+        with self._lock:
+            keys = self._ingested.pop(replica, set())
+        for mname, lbls in keys:
+            self.registry.remove_gauge(mname, **dict(lbls))
+
+    def _aggregate(self) -> None:
+        """Stored gauge aggregates across UP replicas: same name, the
+        source label-set minus ``replica``.  Counter-suffixed families
+        are skipped — their fleet value is the read-time sum the rules
+        engine already computes, and a stored sum would double every
+        ``ctx.rate``."""
+        with self._lock:
+            fams_by_rep = sorted(self._last_fams.items())
+        groups: dict[tuple, list[float]] = {}
+        for _, fams in fams_by_rep:
+            for mname, series in fams.items():
+                if (
+                    mname.endswith(_COUNTERISH)
+                    or mname.startswith("fleet_")
+                    or mname in _NO_AGG
+                ):
+                    continue
+                for lbls, v in series.items():
+                    groups.setdefault((mname, lbls), []).append(v)
+        fresh: set = set()
+        for (mname, lbls), vals in groups.items():
+            how = self.gauge_agg.get(mname, "max")
+            if how == "sum":
+                v = sum(vals)
+            elif how == "min":
+                v = min(vals)
+            elif how == "avg":
+                v = sum(vals) / len(vals)
+            else:
+                v = max(vals)
+            self.registry.set_gauge_series(mname, v, dict(lbls))
+            fresh.add((mname, lbls))
+        with self._lock:
+            stale = self._agg_keys - fresh
+            self._agg_keys = fresh
+        for mname, lbls in stale:
+            self.registry.remove_gauge(mname, **dict(lbls))
+
+    # -- read surface ------------------------------------------------------
+    def percentile(self, name: str, q: float, **where) -> float | None:
+        """Fleet quantile for histogram family *name*, merged across
+        replicas from the federated ``_bucket`` series; ``where``
+        filters labels (e.g. ``replica="r1"`` for one replica's view)."""
+        series = {
+            lbls: v
+            for lbls, v in self.registry.series(f"{name}_bucket").items()
+            if all(dict(lbls).get(k) == v2 for k, v2 in where.items())
+        }
+        return bucket_quantile(series, q)
+
+    def replica_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._targets)
+
+    def snapshot(self) -> dict:
+        """The ``/fleet`` JSON body: per-replica liveness + key gauges,
+        fleet aggregates, and per-tenant token/goodput totals summed
+        across replicas (the "which tenant is burning" table)."""
+        now = self.clock.now()
+        with self._lock:
+            targets = sorted(self._targets)
+            fails = dict(self._fails)
+            last_ok = dict(self._last_ok)
+            fams = {k: v for k, v in self._last_fams.items()}
+        replicas = []
+        for name in targets:
+            f = fams.get(name, {})
+            gauges = {}
+            for g in KEY_GAUGES:
+                series = f.get(g)
+                if not series:
+                    continue
+                if len(series) == 1:
+                    gauges[g] = next(iter(series.values()))
+                else:
+                    # Multi-series family on one replica (e.g. a queue
+                    # label): keep the labeled breakdown.
+                    gauges[g] = {
+                        _series_key(g, dict(lbls)): v
+                        for lbls, v in sorted(series.items())
+                    }
+            ttft = self.percentile(
+                "serve_ttft_seconds", 0.95, replica=name
+            )
+            last = last_ok.get(name)
+            replicas.append({
+                "replica": name,
+                "up": fails.get(name, 0) < self.down_after,
+                "consecutive_failures": fails.get(name, 0),
+                "last_scrape_age_s": (
+                    round(now - last, 3) if last is not None else None
+                ),
+                "gauges": gauges,
+                "ttft_p95_s": ttft,
+            })
+        aggregates = {}
+        for g in KEY_GAUGES:
+            vals = self.registry.series(g)
+            # The stored aggregate is the series WITHOUT a replica label.
+            flat = {
+                lbls: v for lbls, v in vals.items()
+                if "replica" not in dict(lbls)
+            }
+            if flat:
+                aggregates[g] = {
+                    "agg": self.gauge_agg.get(g, "max"),
+                    "value": (
+                        next(iter(flat.values())) if len(flat) == 1
+                        else {
+                            _series_key(g, dict(lbls)): v
+                            for lbls, v in sorted(flat.items())
+                        }
+                    ),
+                }
+        tenants: dict[str, dict] = {}
+        for metric, key in (
+            ("serve_tenant_tokens_total", "tokens"),
+            ("serve_tenant_goodput_tokens_total", "goodput_tokens"),
+        ):
+            for lbls, v in self.registry.series(metric).items():
+                t = dict(lbls).get("tenant")
+                if t is None:
+                    continue
+                tenants.setdefault(t, {"tokens": 0.0, "goodput_tokens": 0.0})
+                tenants[t][key] += v
+        for t, d in tenants.items():
+            burn = self.registry.gauge("tenant_slo_burn_rate", tenant=t)
+            if burn is not None:
+                d["slo_burn_rate"] = burn
+        return {
+            "now": now,
+            "down_after": self.down_after,
+            "scrapes": self._scrapes,
+            "replicas": replicas,
+            "aggregates": aggregates,
+            "tenants": {t: tenants[t] for t in sorted(tenants)},
+            "ttft_p95_s": self.percentile("serve_ttft_seconds", 0.95),
+        }

@@ -218,24 +218,71 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+def escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline (the only three the spec escapes).  Without it a
+    label value carrying a quote breaks the line's label block and a
+    newline splits the sample across two unparseable lines — a tenant
+    name is caller-supplied data, so the exposition must round-trip it."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def unescape_label_value(v: str) -> str:
+    """Inverse of ``escape_label_value``; unknown escapes pass through
+    backslash-dropped, matching Prometheus's lenient readers."""
+    if "\\" not in v:
+        return v
+    out = []
+    i, n = 0, len(v)
+    while i < n:
+        c = v[i]
+        if c == "\\" and i + 1 < n:
+            nxt = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 def _fmt(labels: tuple) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels
+    )
     return "{" + inner + "}"
 
 
 _EXPO_LINE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$"
 )
-_EXPO_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+# Label values may contain any character; escaped sequences (\\, \",
+# \n) ride as two-character pairs, so the value body is "anything but a
+# bare quote or backslash, or an escape pair".
+_EXPO_LABEL = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'
+)
 
 
 def parse_exposition(text: str) -> dict[str, dict[tuple, float]]:
     """Parse the text exposition format ``render`` emits back into
-    ``{name: {label_tuple: value}}`` — what lets ``obs top`` render a
+    ``{name: {label_tuple: value}}`` — what lets ``obs top`` (and the
+    fleet federation collector, utils/federation.py) render a
     fleet-utilization snapshot from ONE ``/metrics`` scrape (or the
-    persisted ``metrics.prom``) without any client library."""
+    persisted ``metrics.prom``) without any client library.
+
+    Hardened against the full text-format value range: escaped label
+    values (``\\"``, ``\\\\``, ``\\n``) round-trip against ``render``'s
+    own output, and ``NaN``/``+Inf``/``-Inf`` sample values parse to
+    their float counterparts (Prometheus stale markers and unbounded
+    buckets are real scrape content, not malformed lines)."""
     out: dict[str, dict[tuple, float]] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -246,10 +293,14 @@ def parse_exposition(text: str) -> dict[str, dict[tuple, float]]:
             continue
         name, raw_labels, raw_value = m.groups()
         try:
+            # float() accepts "NaN", "+Inf", "-Inf" (any case) natively.
             value = float(raw_value)
         except ValueError:
             continue
-        labels = tuple(sorted(_EXPO_LABEL.findall(raw_labels or "")))
+        labels = tuple(sorted(
+            (k, unescape_label_value(v))
+            for k, v in _EXPO_LABEL.findall(raw_labels or "")
+        ))
         out.setdefault(name, {})[labels] = value
     return out
 

@@ -30,7 +30,14 @@ class MetricsServer:
     ``/debug/traces`` exposes the tracer's assembled traces as JSON,
     filterable by ``trace_id=``, ``min_ms=``, ``name=``, ``limit=``.
     ``alerts`` is a ``utils.alerts.RuleEvaluator``; without one,
-    ``/alerts`` answers 404.  The handler instruments ITSELF through
+    ``/alerts`` answers 404.  ``fleet`` is a
+    ``utils.federation.FleetCollector`` — ``/fleet`` serves its
+    snapshot (``?refresh=1`` forces a scrape pass; a never-scraped
+    collector scrapes once on first read so a bare ``obs fleet`` works
+    without an evaluator ticking).  ``journal`` is a
+    ``serve.journal.RequestJournal`` — ``/debug/requests`` serves its
+    per-request records, filterable by ``tenant=``, ``reason=``,
+    ``trace_id=``, ``limit=``.  The handler instruments ITSELF through
     ``RequestMetricsMixin`` (server label ``"obs"``), so scrape traffic
     shows up in ``http_requests_total`` like every other HTTP plane.
     """
@@ -43,10 +50,14 @@ class MetricsServer:
         ready_check=None,
         tracer: Tracer | None = None,
         alerts=None,
+        fleet=None,
+        journal=None,
     ):
         self.registry = registry or global_metrics
         self.tracer = tracer or global_tracer
         self.alerts = alerts
+        self.fleet = fleet
+        self.journal = journal
         self.started_at = time.time()
         self._ready_check = ready_check
         outer = self
@@ -54,8 +65,8 @@ class MetricsServer:
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
             metrics_server_label = "obs"
             known_routes = (
-                "/debug/traces", "/metrics", "/alerts", "/healthz",
-                "/readyz",
+                "/debug/requests", "/debug/traces", "/metrics", "/alerts",
+                "/fleet", "/healthz", "/readyz",
             )
 
             def _get(self):
@@ -67,6 +78,10 @@ class MetricsServer:
                     self._alerts()
                 elif path == "/debug/traces":
                     self._traces()
+                elif path == "/debug/requests":
+                    self._requests()
+                elif path == "/fleet":
+                    self._fleet()
                 elif path == "/healthz":
                     body = json.dumps(
                         {"ok": True, "uptime_s": time.time() - outer.started_at}
@@ -123,6 +138,54 @@ class MetricsServer:
                     ]
                 self._send(
                     200, json.dumps(snap).encode(), "application/json"
+                )
+
+            def _fleet(self):
+                if outer.fleet is None:
+                    return self._send(
+                        404,
+                        json.dumps(
+                            {"error": "no fleet collector attached"}
+                        ).encode(),
+                        "application/json",
+                    )
+                one = self._query()
+                if one("refresh") == "1" or outer.fleet.never_scraped:
+                    outer.fleet.scrape_once()
+                self._send(
+                    200,
+                    json.dumps(outer.fleet.snapshot()).encode(),
+                    "application/json",
+                )
+
+            def _requests(self):
+                if outer.journal is None:
+                    return self._send(
+                        404,
+                        json.dumps(
+                            {"error": "no request journal attached"}
+                        ).encode(),
+                        "application/json",
+                    )
+                one = self._query()
+                try:
+                    limit = int(one("limit", "100"))
+                except ValueError:
+                    return self._send(
+                        400,
+                        json.dumps({"error": "limit must be an int"}).encode(),
+                        "application/json",
+                    )
+                recs = outer.journal.snapshot(
+                    limit=limit,
+                    tenant=one("tenant"),
+                    reason=one("reason"),
+                    trace_id=one("trace_id"),
+                )
+                self._send(
+                    200,
+                    json.dumps({"requests": recs}).encode(),
+                    "application/json",
                 )
 
             def _traces(self):
@@ -335,4 +398,143 @@ def render_top(text: str) -> str:
         "alerts firing: "
         + (", ".join(sorted(hot)) if hot else "none")
     )
+    return "\n".join(lines)
+
+
+def _flatval(v, fmt="{:,.2f}") -> str:
+    """One cell of a fleet table: a scalar formats; a labeled breakdown
+    (multi-series family) collapses to its sum for the columnar view."""
+    if v is None:
+        return "-"
+    if isinstance(v, dict):
+        v = sum(v.values())
+    return fmt.format(v)
+
+
+def render_top_columns(snap: dict) -> str:
+    """The multi-replica ``obs top``: one column per replica plus the
+    FLEET aggregate column, rendered from a ``FleetCollector.snapshot``
+    (relabel/aggregate already applied — the CLI never re-implements
+    the policy).  Rows are the key serve/controller gauges; a down
+    replica renders "down" instead of stale numbers."""
+    reps = snap.get("replicas", [])
+    names = [r["replica"] for r in reps]
+    width = max([10] + [len(n) + 2 for n in names])
+    rows = [
+        ("slot fill", "serve_slot_fill_ratio", "{:.1%}"),
+        ("kv occupancy", "serve_kv_occupancy_ratio", "{:.1%}"),
+        ("pending", "serve_pending_requests", "{:,.0f}"),
+        ("decode tok/s", "serve_decode_tokens_per_second", "{:,.1f}"),
+        ("slots active", "serve_slots_active", "{:,.0f}"),
+        ("queue depth", "workqueue_depth", "{:,.0f}"),
+    ]
+    agg = snap.get("aggregates", {})
+    lines = [
+        "FLEET UTILIZATION  "
+        f"({len(reps)} replicas, "
+        f"{sum(1 for r in reps if r['up'])} up)",
+        "",
+        "  " + f"{'':<14}" + "".join(f"{n:>{width}}" for n in names)
+        + f"{'FLEET':>{width}}",
+    ]
+    for label, gauge, fmt in rows:
+        cells = []
+        for r in reps:
+            if not r["up"]:
+                cells.append(f"{'down':>{width}}")
+            else:
+                cells.append(
+                    f"{_flatval(r['gauges'].get(gauge), fmt):>{width}}"
+                )
+        a = agg.get(gauge)
+        fleet_cell = _flatval(a["value"], fmt) if a else "-"
+        how = f" ({a['agg']})" if a else ""
+        lines.append(
+            f"  {label:<14}" + "".join(cells)
+            + f"{fleet_cell:>{width}}" + how
+        )
+    p95 = [
+        f"{(r['ttft_p95_s'] * 1000):.0f}ms"
+        if r["up"] and r.get("ttft_p95_s") is not None else "-"
+        for r in reps
+    ]
+    fp = snap.get("ttft_p95_s")
+    lines.append(
+        f"  {'ttft p95':<14}" + "".join(f"{c:>{width}}" for c in p95)
+        + f"{(f'{fp * 1000:.0f}ms' if fp is not None else '-'):>{width}}"
+        + " (merged)"
+    )
+    return "\n".join(lines)
+
+
+def render_fleet(snap: dict) -> str:
+    """The ``obs fleet`` view of one ``/fleet`` snapshot: replica
+    liveness + key gauges per row, then the per-tenant SLO table."""
+    reps = snap.get("replicas", [])
+    lines = [
+        f"FLEET  ({len(reps)} replicas, "
+        f"{sum(1 for r in reps if r['up'])} up; "
+        f"down after {snap.get('down_after', '?')} failed scrapes)",
+        "",
+        f"  {'REPLICA':<18} {'UP':<4} {'FILL':>7} {'KV OCC':>7} "
+        f"{'PENDING':>8} {'TOK/S':>8} {'TTFT P95':>9} {'AGE(S)':>7}",
+    ]
+    for r in reps:
+        g = r.get("gauges", {})
+        p95 = r.get("ttft_p95_s")
+        age = r.get("last_scrape_age_s")
+        lines.append(
+            f"  {r['replica']:<18} "
+            f"{'up' if r['up'] else 'DOWN':<4} "
+            f"{_flatval(g.get('serve_slot_fill_ratio'), '{:.1%}'):>7} "
+            f"{_flatval(g.get('serve_kv_occupancy_ratio'), '{:.1%}'):>7} "
+            f"{_flatval(g.get('serve_pending_requests'), '{:,.0f}'):>8} "
+            f"{_flatval(g.get('serve_decode_tokens_per_second'), '{:,.1f}'):>8} "
+            f"{(f'{p95 * 1000:.0f}ms' if p95 is not None else '-'):>9} "
+            f"{(f'{age:.1f}' if age is not None else '-'):>7}"
+        )
+    tenants = snap.get("tenants", {})
+    if tenants:
+        lines.append("")
+        lines.append(
+            f"  {'TENANT':<18} {'TOKENS':>10} {'GOODPUT':>10} "
+            f"{'GOODPUT%':>9} {'BURN':>7}"
+        )
+        for t, d in tenants.items():
+            tot = d.get("tokens", 0.0)
+            good = d.get("goodput_tokens", 0.0)
+            burn = d.get("slo_burn_rate")
+            lines.append(
+                f"  {t:<18} {tot:>10,.0f} {good:>10,.0f} "
+                f"{(good / tot if tot else 1.0):>9.1%} "
+                f"{(f'{burn:.1f}x' if burn is not None else '-'):>7}"
+            )
+    return "\n".join(lines)
+
+
+def render_requests(records: list[dict]) -> str:
+    """The ``obs requests`` view of ``/debug/requests`` records —
+    newest first, one line per retired request, trace id last so the
+    eye can carry it into ``obs traces --trace <id>``."""
+    if not records:
+        return "no journal records (no requests retired yet)"
+    lines = [
+        f"  {'TENANT':<12} {'REASON':<11} {'PATH':<13} {'TOK':>5} "
+        f"{'WAIT(MS)':>9} {'TTFT(MS)':>9} {'TPOT(MS)':>9} "
+        f"{'PFX':>4} {'ACC%':>5}  TRACE"
+    ]
+    for r in records:
+        acc = (
+            f"{r['spec_accepted'] / r['spec_drafted']:.0%}"
+            if r.get("spec_drafted") else "-"
+        )
+        lines.append(
+            f"  {r['tenant']:<12} {r['reason']:<11} "
+            f"{(r.get('path') or '-'):<13} {r['tokens']:>5} "
+            f"{r['queue_wait_s'] * 1000:>9.1f} "
+            f"{r['ttft_s'] * 1000:>9.1f} "
+            f"{r['tpot_s'] * 1000:>9.1f} "
+            f"{r.get('prefix_blocks', 0):>4} {acc:>5}  "
+            f"{r.get('trace_id') or '-'}"
+        )
     return "\n".join(lines)

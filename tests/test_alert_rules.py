@@ -329,6 +329,73 @@ def test_default_pack_fires_and_resolves(alert, gauge, labels, bad, good):
                     ("firing", "resolved")]
 
 
+def test_fleet_replica_down_fsm_lifecycle():
+    """FleetReplicaDown fires the tick the collector drops
+    fleet_replica_up to 0 (the M-consecutive-failures hold lives in the
+    collector's down_after, so for_s defaults to 0 and the FSM walks
+    inactive→pending→firing in ONE tick) and resolves on recovery."""
+    clock, reg = FakeClock(), MetricsRegistry()
+    ev = RuleEvaluator(default_rule_pack(), clock=clock, registry=reg)
+    reg.set_gauge("fleet_replica_up", 1.0, replica="r0")
+    reg.set_gauge("fleet_replica_up", 1.0, replica="r1")
+    _tick(ev, clock)
+    assert _states(ev) == {}
+    reg.set_gauge("fleet_replica_up", 0.0, replica="r1")
+    _tick(ev, clock, 10.0)
+    key = ("FleetReplicaDown", (("replica", "r1"),))
+    assert _states(ev)[key] == "firing"
+    assert ("FleetReplicaDown", (("replica", "r0"),)) not in _states(ev)
+    assert reg.gauge("alerts_firing",
+                     alertname="FleetReplicaDown") == 1.0
+    reg.set_gauge("fleet_replica_up", 1.0, replica="r1")
+    _tick(ev, clock, 10.0)
+    assert key not in _states(ev)
+    path = [(t["from"], t["to"]) for t in ev.timeline
+            if t["alert"] == "FleetReplicaDown"]
+    assert path == [("inactive", "pending"), ("pending", "firing"),
+                    ("firing", "resolved")]
+
+
+def test_tenant_slo_burn_rate_fsm_lifecycle():
+    """TenantSloBurnRate: the recorded per-tenant goodput burn (from
+    counter rates — needs history across ticks) breaches for the hot
+    tenant only, fires after its 60 s hold, and resolves once goodput
+    recovers inside the rate window."""
+    clock, reg = FakeClock(), MetricsRegistry()
+    ev = RuleEvaluator(default_rule_pack(), clock=clock, registry=reg)
+    key = ("TenantSloBurnRate", (("tenant", "hot"),))
+    # hot: 50% of tokens miss the deadline → burn 50/1% = 50x > 14.4;
+    # cool: full goodput → burn 0.
+    for _ in range(8):
+        reg.inc("serve_tenant_tokens_total", 100.0, tenant="hot")
+        reg.inc("serve_tenant_goodput_tokens_total", 50.0, tenant="hot")
+        reg.inc("serve_tenant_tokens_total", 100.0, tenant="cool")
+        reg.inc("serve_tenant_goodput_tokens_total", 100.0,
+                tenant="cool")
+        _tick(ev, clock, 10.0)
+    assert reg.gauge("tenant_slo_burn_rate",
+                     tenant="hot") == pytest.approx(50.0)
+    assert reg.gauge("tenant_slo_burn_rate", tenant="cool") == 0.0
+    assert _states(ev).get(key) in ("pending", "firing")
+    assert ("TenantSloBurnRate", (("tenant", "cool"),)) not in _states(ev)
+    for _ in range(6):
+        reg.inc("serve_tenant_tokens_total", 100.0, tenant="hot")
+        reg.inc("serve_tenant_goodput_tokens_total", 50.0, tenant="hot")
+        _tick(ev, clock, 10.0)
+    assert _states(ev)[key] == "firing"
+    # Recovery: goodput == total until the bad rate ages out of the
+    # 300 s window.
+    for _ in range(40):
+        reg.inc("serve_tenant_tokens_total", 100.0, tenant="hot")
+        reg.inc("serve_tenant_goodput_tokens_total", 100.0, tenant="hot")
+        _tick(ev, clock, 10.0)
+    assert key not in _states(ev)
+    path = [(t["from"], t["to"]) for t in ev.timeline
+            if t["alert"] == "TenantSloBurnRate"]
+    assert path == [("inactive", "pending"), ("pending", "firing"),
+                    ("firing", "resolved")]
+
+
 def test_two_runs_identical_timelines():
     """Determinism: the same scripted registry mutations under FakeClock
     produce bit-identical transition timelines."""

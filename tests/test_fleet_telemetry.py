@@ -1,0 +1,518 @@
+"""Fleet telemetry plane (ISSUE 6): metrics federation across replicas,
+per-tenant SLO accounting, and the per-request journal.  Deterministic
+under FakeClock — in-process fake scrape targets, no network except the
+endpoint tests' loopback.  Named test_fleet_telemetry so it sorts early
+inside the tier-1 870 s window."""
+
+import json
+import math
+import time
+import urllib.request
+
+import pytest
+
+from k8s_gpu_tpu.serve.journal import RequestJournal, RequestRecord
+from k8s_gpu_tpu.utils.alerts import RuleEvaluator, default_rule_pack
+from k8s_gpu_tpu.utils.clock import FakeClock
+from k8s_gpu_tpu.utils.federation import FleetCollector, bucket_quantile
+from k8s_gpu_tpu.utils.metrics import MetricsRegistry, parse_exposition
+from k8s_gpu_tpu.utils.obs import (
+    MetricsServer,
+    render_fleet,
+    render_requests,
+    render_top_columns,
+)
+
+
+# -- exposition hardening (satellite 1) --------------------------------------
+
+def test_exposition_escaped_labels_roundtrip():
+    """Label values carrying quotes, backslashes, and newlines survive a
+    render → parse round trip against the registry's OWN output — a
+    tenant string is caller data, and before escaping any of these
+    broke the line format."""
+    reg = MetricsRegistry()
+    nasty = ['he said "hi"', "back\\slash", "multi\nline", 'mix\\"\n"']
+    for i, v in enumerate(nasty):
+        reg.inc("c_total", float(i + 1), tenant=v)
+        reg.set_gauge("g", float(i), tenant=v)
+    fam = parse_exposition(reg.render())
+    for i, v in enumerate(nasty):
+        assert fam["c_total"][(("tenant", v),)] == float(i + 1)
+        assert fam["g"][(("tenant", v),)] == float(i)
+
+
+def test_exposition_nan_inf_values_parse():
+    reg = MetricsRegistry()
+    reg.set_gauge("a", float("nan"))
+    reg.set_gauge("b", float("inf"), k="x")
+    reg.set_gauge("c", float("-inf"))
+    fam = parse_exposition(reg.render())
+    assert math.isnan(fam["a"][()])
+    assert fam["b"][(("k", "x"),)] == float("inf")
+    assert fam["c"][()] == float("-inf")
+    # Prometheus-style spellings parse too (other exporters emit them).
+    fam = parse_exposition('x{le="+Inf"} 5\ny NaN\n')
+    assert fam["x"][(("le", "+Inf"),)] == 5.0
+    assert math.isnan(fam["y"][()])
+
+
+def test_exposition_skips_malformed_lines():
+    fam = parse_exposition(
+        "# comment\n"
+        "\n"
+        "no_value\n"
+        "bad value notanumber\n"
+        "ok 1.5\n"
+        'half{broken="x 2\n'
+    )
+    assert fam == {"ok": {(): 1.5}}
+
+
+# -- the federation collector -------------------------------------------------
+
+def _three_replicas():
+    regs = {f"r{i}": MetricsRegistry() for i in range(3)}
+    for i, reg in enumerate(regs.values()):
+        reg.set_gauge("serve_slot_fill_ratio", 0.25 * (i + 1))
+        reg.set_gauge("serve_kv_occupancy_ratio", 0.2 * (i + 1))
+        reg.set_gauge("serve_pending_requests", float(i))
+        reg.inc("http_requests_total", 10.0 * (i + 1), code="200")
+        reg.inc("serve_tenant_tokens_total", 100.0 * (i + 1),
+                tenant="acme")
+    return regs
+
+
+def test_fleet_relabels_and_applies_policies():
+    regs = _three_replicas()
+    fc = FleetCollector(
+        {n: (lambda r=r: r.render()) for n, r in regs.items()},
+        clock=FakeClock(),
+    )
+    assert fc.scrape_once() == {"r0": True, "r1": True, "r2": True}
+    reg = fc.registry
+    # Relabel: every source series exists with replica=.
+    assert reg.gauge("serve_slot_fill_ratio", replica="r1") == 0.5
+    assert reg.gauge("http_requests_total", code="200",
+                     replica="r2") == 30.0
+    # Gauge aggregates: stored under the same name, no replica label.
+    assert reg.gauge("serve_slot_fill_ratio") == pytest.approx(0.5)  # avg
+    assert reg.gauge("serve_kv_occupancy_ratio") == pytest.approx(0.6)  # max
+    assert reg.gauge("serve_pending_requests") == 3.0                 # sum
+    # Counters: NO stored aggregate — the fleet sum is read-time (a
+    # stored sum would double every ctx.rate over the family).
+    assert reg.gauge("http_requests_total", code="200") is None
+    series = reg.series("http_requests_total")
+    assert sum(series.values()) == 60.0 and len(series) == 3
+    # Liveness gauges.
+    assert reg.gauge("fleet_replicas") == 3.0
+    assert reg.gauge("fleet_replicas_up") == 3.0
+    assert reg.gauge("fleet_replica_up", replica="r0") == 1.0
+
+
+def test_fleet_two_runs_bit_identical():
+    """The acceptance bar: two scripted runs — scrapes, mutations, a
+    replica death and revival, rule evaluation — produce a bit-identical
+    fleet registry exposition AND alert timeline."""
+
+    def run():
+        regs = _three_replicas()
+        clock = FakeClock()
+        alive = {n: True for n in regs}
+
+        def target(n):
+            def t():
+                if not alive[n]:
+                    raise RuntimeError("dead")
+                return regs[n].render()
+            return t
+
+        fc = FleetCollector({n: target(n) for n in regs}, clock=clock,
+                            down_after=2)
+        ev = RuleEvaluator(default_rule_pack(), clock=clock,
+                           registry=fc.registry)
+        fc.attach(ev)
+        ev.evaluate_once()
+        clock.advance(10.0)
+        regs["r1"].set_gauge("serve_kv_occupancy_ratio", 0.97)
+        ev.evaluate_once()
+        alive["r2"] = False
+        for _ in range(3):
+            clock.advance(10.0)
+            ev.evaluate_once()
+        alive["r2"] = True
+        clock.advance(10.0)
+        ev.evaluate_once()
+        timeline = [
+            (t["t"], t["alert"], tuple(sorted(t["labels"].items())),
+             t["from"], t["to"])
+            for t in ev.timeline
+        ]
+        return fc.registry.render(), timeline
+
+    (render_a, tl_a), (render_b, tl_b) = run(), run()
+    assert render_a == render_b
+    assert tl_a == tl_b
+    # The scripted run includes a FleetReplicaDown fire/resolve cycle.
+    down = [(frm, to) for _, alert, _, frm, to in tl_a
+            if alert == "FleetReplicaDown"]
+    assert down == [("inactive", "pending"), ("pending", "firing"),
+                    ("firing", "resolved")]
+
+
+def test_replica_death_purges_series_and_alert_resolves_on_revival():
+    regs = _three_replicas()
+    clock = FakeClock()
+    alive = {n: True for n in regs}
+
+    def target(n):
+        def t():
+            if not alive[n]:
+                raise RuntimeError("dead")
+            return regs[n].render()
+        return t
+
+    fc = FleetCollector({n: target(n) for n in regs}, clock=clock,
+                        down_after=3)
+    ev = RuleEvaluator(default_rule_pack(), clock=clock,
+                       registry=fc.registry)
+    fc.attach(ev)
+    ev.evaluate_once()
+    alive["r2"] = False
+    # Two failures: still counted up (down_after=3), series intact.
+    for _ in range(2):
+        clock.advance(10.0)
+        ev.evaluate_once()
+    assert fc.registry.gauge("fleet_replica_up", replica="r2") == 1.0
+    assert fc.registry.gauge(
+        "serve_slot_fill_ratio", replica="r2") == 0.75
+    assert not any(a["alertname"] == "FleetReplicaDown"
+                   for a in ev.active_alerts())
+    # Third consecutive failure: down, purged, firing.
+    clock.advance(10.0)
+    ev.evaluate_once()
+    assert fc.registry.gauge("fleet_replica_up", replica="r2") == 0.0
+    assert fc.registry.gauge(
+        "serve_slot_fill_ratio", replica="r2") is None
+    assert fc.registry.counter(
+        "fleet_scrape_failures_total", replica="r2") == 3.0
+    firing = [a for a in ev.active_alerts()
+              if a["alertname"] == "FleetReplicaDown"]
+    assert len(firing) == 1 and firing[0]["state"] == "firing"
+    assert firing[0]["labels"] == {"replica": "r2"}
+    # The aggregate dropped the dead member (max over r0/r1 only).
+    assert fc.registry.gauge(
+        "serve_kv_occupancy_ratio") == pytest.approx(0.4)
+    # Revival: up again, series restored, alert resolves.
+    alive["r2"] = True
+    clock.advance(10.0)
+    ev.evaluate_once()
+    assert fc.registry.gauge("fleet_replica_up", replica="r2") == 1.0
+    assert fc.registry.gauge(
+        "serve_slot_fill_ratio", replica="r2") == 0.75
+    assert not any(a["alertname"] == "FleetReplicaDown"
+                   for a in ev.active_alerts())
+    assert ev.timeline[-1]["to"] == "resolved"
+
+
+def test_fleet_vanished_source_series_removed_on_next_scrape():
+    """A gauge the replica stopped exporting (remove_gauge on the
+    source) leaves the fleet registry too — scrapes replace, never
+    accrete."""
+    reg = MetricsRegistry()
+    reg.set_gauge("pool_ready_ratio", 0.5, pool="p1")
+    fc = FleetCollector({"r0": lambda: reg.render()}, clock=FakeClock())
+    fc.scrape_once()
+    assert fc.registry.gauge(
+        "pool_ready_ratio", pool="p1", replica="r0") == 0.5
+    reg.remove_gauge("pool_ready_ratio", pool="p1")
+    fc.scrape_once()
+    assert fc.registry.gauge(
+        "pool_ready_ratio", pool="p1", replica="r0") is None
+    assert fc.registry.gauge("pool_ready_ratio", pool="p1") is None
+
+
+def test_fleet_percentile_merges_buckets_across_replicas():
+    regs = {"a": MetricsRegistry(), "b": MetricsRegistry()}
+    # Replica a: 9 fast (≤10 ms); replica b: 9 slow (≤500 ms) — the
+    # fleet p95 must land in b's range, each replica's own in its own.
+    for _ in range(9):
+        regs["a"].observe("serve_ttft_seconds", 0.008)
+        regs["b"].observe("serve_ttft_seconds", 0.4)
+    fc = FleetCollector(
+        {n: (lambda r=r: r.render()) for n, r in regs.items()},
+        clock=FakeClock(),
+    )
+    fc.scrape_once()
+    fleet = fc.percentile("serve_ttft_seconds", 0.95)
+    fast = fc.percentile("serve_ttft_seconds", 0.95, replica="a")
+    slow = fc.percentile("serve_ttft_seconds", 0.95, replica="b")
+    assert fast is not None and fast <= 0.01 + 1e-9
+    assert slow is not None and 0.1 <= slow <= 0.5
+    assert fleet is not None and 0.1 <= fleet <= 0.5
+    # Degenerate inputs answer None, never raise.
+    assert bucket_quantile({}, 0.95) is None
+    assert fc.percentile("no_such_metric", 0.5) is None
+
+
+def test_fleet_snapshot_shape_and_tenant_table():
+    regs = _three_replicas()
+    fc = FleetCollector(
+        {n: (lambda r=r: r.render()) for n, r in regs.items()},
+        clock=FakeClock(),
+    )
+    fc.scrape_once()
+    snap = fc.snapshot()
+    assert [r["replica"] for r in snap["replicas"]] == ["r0", "r1", "r2"]
+    assert all(r["up"] for r in snap["replicas"])
+    assert snap["replicas"][1]["gauges"]["serve_slot_fill_ratio"] == 0.5
+    assert snap["aggregates"]["serve_pending_requests"]["value"] == 3.0
+    assert snap["aggregates"]["serve_pending_requests"]["agg"] == "sum"
+    assert snap["tenants"]["acme"]["tokens"] == 600.0
+    # JSON-serializable end to end (the /fleet contract).
+    json.dumps(snap)
+    # Renderers accept the same shape.
+    out = render_fleet(snap)
+    assert "r0" in out and "acme" in out
+    cols = render_top_columns(snap)
+    assert "FLEET" in cols and "r2" in cols and "(sum)" in cols
+
+
+# -- request journal ----------------------------------------------------------
+
+def test_journal_ring_bounds_and_filters():
+    j = RequestJournal(maxlen=4)
+    for i in range(10):
+        j.append(RequestRecord(
+            tenant="acme" if i % 2 else "blue",
+            reason="eos" if i < 8 else "deadline",
+            tokens=i, trace_id=f"t{i}",
+        ))
+    assert len(j) == 4 and j.dropped == 6
+    recs = j.snapshot()
+    # Newest first, only the last 4 survive the ring.
+    assert [r["tokens"] for r in recs] == [9, 8, 7, 6]
+    assert [r["tokens"] for r in j.snapshot(limit=2)] == [9, 8]
+    assert j.snapshot(limit=0) == []
+    assert [r["tokens"] for r in j.snapshot(tenant="acme")] == [9, 7]
+    assert [r["tokens"] for r in j.snapshot(reason="deadline")] == [9, 8]
+    assert [r["tokens"] for r in j.snapshot(trace_id="t7")] == [7]
+
+
+def test_fleet_and_requests_endpoints():
+    regs = _three_replicas()
+    fc = FleetCollector(
+        {n: (lambda r=r: r.render()) for n, r in regs.items()},
+        clock=FakeClock(),
+    )
+    j = RequestJournal()
+    j.append(RequestRecord(tenant="acme", reason="eos", tokens=3,
+                           trace_id="abc123"))
+    j.append(RequestRecord(tenant="blue", reason="deadline", tokens=0,
+                           deadline_expired=True))
+    srv = MetricsServer(MetricsRegistry(), fleet=fc, journal=j).start()
+    try:
+        # Never-scraped collector scrapes lazily on first /fleet read.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/fleet"
+        ) as r:
+            snap = json.loads(r.read())
+        assert snap["tenants"]["acme"]["tokens"] == 600.0
+        assert len(snap["replicas"]) == 3
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/requests?tenant=acme"
+        ) as r:
+            body = json.loads(r.read())
+        assert [x["trace_id"] for x in body["requests"]] == ["abc123"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/requests?reason=deadline"
+        ) as r:
+            body = json.loads(r.read())
+        assert len(body["requests"]) == 1
+        assert body["requests"][0]["deadline_expired"] is True
+    finally:
+        srv.stop()
+    # Without a collector/journal the routes 404.
+    srv = MetricsServer(MetricsRegistry()).start()
+    try:
+        for path in ("/fleet", "/debug/requests"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}"
+                )
+            assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_render_requests_handles_empty_and_spec_columns():
+    assert "no journal records" in render_requests([])
+    out = render_requests([RequestRecord(
+        tenant="acme", reason="budget", path="paged_shared", tokens=8,
+        queue_wait_s=0.002, ttft_s=0.05, tpot_s=0.01, prefix_blocks=3,
+        spec_drafted=16, spec_accepted=12, trace_id="deadbeef",
+    ).to_dict()])
+    assert "paged_shared" in out and "75%" in out and "deadbeef" in out
+
+
+# -- tenant accounting through a real batcher --------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8,
+        d_ff=64, max_seq=48, use_flash=False, dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def test_batcher_tenant_accounting_and_journal(tiny_lm):
+    """One tiny batcher, its own registry: tenant-labeled latency and
+    token counters land per tenant, a deadline shed counts in the total
+    but not the goodput, and every retired request has a journal record
+    whose trace id resolves in the tracer (/debug/traces cross-link)."""
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+    from k8s_gpu_tpu.utils.tracing import global_tracer
+
+    model, params = tiny_lm
+    reg = MetricsRegistry()
+    b = ContinuousBatcher(model, params, slots=2, metrics=reg).start()
+    try:
+        with global_tracer.span("test.acme"):
+            h1 = b.submit([1, 2, 3], max_new_tokens=4, tenant="acme")
+        h2 = b.submit([4, 5, 6], max_new_tokens=4, tenant="blue")
+        h3 = b.submit([7, 8], max_new_tokens=4, tenant="acme",
+                      deadline=time.monotonic() - 0.001)
+        assert len(h1.result()) == 4 and len(h2.result()) == 4
+        assert h3.result() == [] and h3.deadline_expired
+        # Totals count every request's tokens; goodput only in-budget.
+        assert reg.counter("serve_tenant_tokens_total",
+                           tenant="acme") == 4.0
+        assert reg.counter("serve_tenant_goodput_tokens_total",
+                           tenant="acme") == 4.0
+        assert reg.counter("serve_tenant_tokens_total",
+                           tenant="blue") == 4.0
+        assert reg.counter("serve_shed_total", reason="deadline",
+                           tenant="acme") == 1.0
+        # Latency series: unlabeled aggregate AND per-tenant.
+        assert reg.histogram("serve_ttft_seconds").n >= 2
+        assert reg.histogram("serve_ttft_seconds", tenant="acme").n == 1
+        assert reg.histogram("serve_ttft_seconds", tenant="blue").n == 1
+        # Journal: one record per request, reasons right.
+        recs = b.journal.snapshot()
+        assert len(recs) == 3
+        reasons = sorted(r["reason"] for r in recs)
+        assert reasons == ["budget", "budget", "deadline"]
+        done = [r for r in recs
+                if r["tenant"] == "acme" and r["reason"] == "budget"][0]
+        assert done["tokens"] == 4 and done["ttft_s"] > 0.0
+        assert done["queue_wait_s"] >= 0.0 and done["path"]
+        # Trace cross-link: the traced submit's record resolves.
+        assert done["trace_id"]
+        assert global_tracer.get_trace(done["trace_id"]) is not None
+        shed = [r for r in recs if r["reason"] == "deadline"][0]
+        assert shed["tokens"] == 0 and shed["deadline_expired"]
+    finally:
+        b.stop()
+
+
+def test_tenant_cardinality_bounded_through_batcher(tiny_lm):
+    """A flood of distinct tenant strings cannot mint unbounded series:
+    past the registry cap the batcher's tenant counters collapse into
+    the {other="true"} overflow series."""
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+
+    model, params = tiny_lm
+    reg = MetricsRegistry(max_series_per_name=4)
+    b = ContinuousBatcher(model, params, slots=2, metrics=reg).start()
+    try:
+        handles = [
+            b.submit([1, 2], max_new_tokens=1, tenant=f"tenant-{i}")
+            for i in range(8)
+        ]
+        for h in handles:
+            assert len(h.result()) == 1
+    finally:
+        b.stop()
+    series = reg.series("serve_tenant_tokens_total")
+    # 4 real tenant series + the single collapsed overflow series.
+    assert len(series) == 5
+    assert reg.counter("serve_tenant_tokens_total", other="true") == 4.0
+    assert reg.counter(
+        "metrics_series_dropped_total",
+        metric="serve_tenant_tokens_total",
+    ) > 0.0
+
+
+def test_lm_server_tenant_extraction_and_door_shed_journal(tiny_lm):
+    """The HTTP tenant contract: body field first, x-tenant header as
+    fallback, length-capped; the pre-submit 504 shed lands in the
+    batcher's registry AND journal.  HTTP surface only — the batcher
+    scheduler never starts, no device program compiles here."""
+    from k8s_gpu_tpu.data import BpeTokenizer
+    from k8s_gpu_tpu.serve import LmServer
+
+    model, params = tiny_lm
+    tok = BpeTokenizer.train("aa bb cc dd " * 30, vocab_size=80)
+    reg = MetricsRegistry()
+    srv = LmServer(model, params, tok, metrics=reg)
+    srv._thread.start()
+    try:
+        seen = []
+
+        class FakeHandle:
+            aborted = False
+            deadline_expired = False
+            logprobs = []
+
+            def result(self):
+                return [1]
+
+        def fake_submit(ids, **kw):
+            seen.append(kw)
+            return FakeHandle()
+
+        srv.batcher.submit = fake_submit
+
+        def post(payload, headers=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json",
+                         **(headers or {})},
+            )
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, _ = post({"prompt": "aa", "tenant": "body-tenant"},
+                       headers={"x-tenant": "header-tenant"})
+        assert code == 200 and seen[-1]["tenant"] == "body-tenant"
+        code, _ = post({"prompt": "aa"},
+                       headers={"x-tenant": "header-tenant"})
+        assert code == 200 and seen[-1]["tenant"] == "header-tenant"
+        code, _ = post({"prompt": "aa"})
+        assert code == 200 and seen[-1]["tenant"] == "default"
+        code, _ = post({"prompt": "aa", "tenant": "x" * 200})
+        assert code == 200 and len(seen[-1]["tenant"]) == 64
+        code, _ = post({"prompt": "aa", "tenant": 7})
+        assert code == 400
+        # Door shed: expired budget → 504 + counter + journal record.
+        code, _ = post({"prompt": "aa", "tenant": "late"},
+                       headers={"x-request-deadline-ms": "0"})
+        assert code == 504
+        assert reg.counter("serve_shed_total", reason="deadline",
+                           tenant="late") == 1.0
+        recs = srv.journal.snapshot(tenant="late")
+        assert len(recs) == 1 and recs[0]["reason"] == "deadline"
+    finally:
+        srv._httpd.shutdown()
+        srv._httpd.server_close()
