@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo alerts-demo prefix-demo fleet-demo
+.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo
 
 docker-build:
 	@for img in $(IMAGES); do \
@@ -86,3 +86,12 @@ prefix-demo:
 # cross-links to a resolvable trace.  Non-zero exit on any failure.
 fleet-demo:
 	python tools/fleet_demo.py
+
+# Fleet router smoke: 4 paged replicas behind the prefix-affinity
+# router serve skewed multi-tenant traffic (each tenant's shared prompt
+# lands on ONE replica — per-replica hit rates from the federated
+# counters prove it), a backlog fires FleetQueueBacklog and the
+# autoscaler adds a replica, and the prefix-aware scale-down drains the
+# fewest-chains replica with zero dropped requests.
+router-demo:
+	python tools/router_demo.py

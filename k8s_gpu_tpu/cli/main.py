@@ -953,6 +953,51 @@ def cmd_obs(args) -> int:
             print("\n(follow a request: obs traces --url "
                   f"{args.url} --trace <TRACE>)")
         return 0
+    if args.obs_cmd == "route":
+        # Routing explain: which replica the prefix-affinity router
+        # would pick for a prompt, and what every candidate scored.
+        # --scrape-url replicas bring live load through the federation
+        # collector; --replica names route on pure affinity.
+        from ..serve.router import FleetRouter
+        from ..utils.metrics import MetricsRegistry
+        from ..utils.obs import render_route
+
+        try:
+            ids = [int(x) for x in args.ids.replace(",", " ").split()]
+        except ValueError:
+            print("--ids must be token ids: --ids 1,2,3", file=sys.stderr)
+            return 2
+        if not ids:
+            print("--ids must carry at least one token id",
+                  file=sys.stderr)
+            return 2
+        collector = None
+        if args.scrape_url:
+            from ..utils.federation import FleetCollector
+
+            targets = _parse_scrape_targets(args.scrape_url)
+            collector = FleetCollector(targets)
+            up = collector.scrape_once()
+            if not any(up.values()):
+                print("no replica scrape succeeded", file=sys.stderr)
+                return 1
+            names = sorted(targets)
+        elif args.replica:
+            names = sorted(args.replica)
+        else:
+            print("obs route needs replicas: repeated --scrape-url "
+                  "NAME=URL (live load) or --replica NAME (affinity "
+                  "only)", file=sys.stderr)
+            return 2
+        router = FleetRouter(
+            page_size=args.page_size, collector=collector,
+            metrics=MetricsRegistry(),
+        )
+        for n in names:
+            router.add_replica(n)
+        dec = router.route(ids)
+        print(render_route(dec, router.snapshot()))
+        return 0
     if args.obs_cmd == "alerts":
         if args.url:
             # A running MetricsServer's /alerts — the rules engine's live
@@ -1446,6 +1491,24 @@ def build_parser() -> argparse.ArgumentParser:
     p_oreq.add_argument("--trace", default="",
                         help="exact trace id filter")
     p_oreq.add_argument("--limit", type=int, default=30)
+    p_orte = obs_sub.add_parser(
+        "route",
+        help="explain a routing decision: which replica the "
+             "prefix-affinity router picks for a prompt's token ids, "
+             "with every candidate's score",
+    )
+    p_orte.add_argument("--ids", required=True,
+                        help="prompt token ids, comma- or "
+                             "space-separated (obs route --ids 1,2,3)")
+    p_orte.add_argument("--scrape-url", action="append", default=None,
+                        help="NAME=URL of one replica's metrics server; "
+                             "repeatable — live load enters the score")
+    p_orte.add_argument("--replica", action="append", default=None,
+                        help="replica NAME without a metrics endpoint "
+                             "(affinity-only routing); repeatable")
+    p_orte.add_argument("--page-size", type=int, default=64,
+                        help="paged-KV page size the replicas run "
+                             "(chain hashes must chunk identically)")
     p_ot = obs_sub.add_parser(
         "traces", help="render recorded spans as flame-style trees"
     )

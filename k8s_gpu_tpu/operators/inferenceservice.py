@@ -14,10 +14,19 @@ reconcile makes it so —
   endpoint, loaded from the AssetStore via serve.bundle.load_servable
   (the train→export→serve journey, GPU调度平台搭建.md:686-697) — so
   status.endpoints are connectable, not decorative;
-- queue-depth autoscaling: with spec.maxReplicas set, the replica set is
-  resized to clamp(ceil(pending / targetPendingPerReplica), min, max)
-  from the live batchers' pending-request depth — the serving analogue
-  of the TrainJob autoscaler's scale-from-zero.
+- telemetry-driven autoscaling: with spec.maxReplicas set, each replica
+  runs its batcher on a PRIVATE metrics registry, a per-service
+  ``FleetCollector`` federates them, and the ``router_rule_pack``
+  alerts (queue backlog per replica, TTFT-p95 burn, sustained low slot
+  fill) drive a deterministic ``FleetAutoscaler`` FSM — sized scale-up
+  on backlog/latency burn, one-step scale-down on sustained idle, a
+  cooldown between actions so the set never flaps (serve/router.py;
+  this replaced the bare ceil(pending/target) rule).
+- prefix-aware scale-down: surplus replicas are retired HIGHEST INDEX
+  first by default, but with a ``router=`` (a serve.FleetRouter whose
+  replica names are this service's pod names) the victim is the
+  replica owning the FEWEST warm prefix chains, and the retirement is
+  announced via ``router.drain`` so its hash range re-homes first.
 
 Deletion stops every server, frees every carve-out, then drops the
 finalizer.
@@ -26,7 +35,6 @@ finalizer.
 from __future__ import annotations
 
 import logging
-import math
 
 from ..api.core import Pod
 from ..api.inferenceservice import InferenceService
@@ -37,13 +45,14 @@ from ..controller.manager import Reconciler, Request, Result
 from ..scheduling.labels import TPU_RESOURCE
 from ..scheduling.placement import PlacementError
 from ..scheduling.sharing import grant_chips_from_cluster, resync_node_chips
+from ..utils.clock import Clock, RealClock
 from ..utils.metrics import MetricsRegistry, global_metrics
 
 log = logging.getLogger("k8s_gpu_tpu.operators.inferenceservice")
 
 FINALIZER = "tpu.k8sgpu.dev/inferenceservice-cleanup"
 
-AUTOSCALE_POLL = 5.0  # re-evaluate queue depth while autoscaling
+AUTOSCALE_POLL = 5.0  # re-evaluate the scale signals while autoscaling
 
 
 def pod_name(svc: InferenceService, i: int) -> str:
@@ -65,17 +74,36 @@ class InferenceServiceReconciler(Reconciler):
         store=None,
         run_servers: bool = True,
         metrics: MetricsRegistry | None = None,
+        clock: Clock | None = None,
+        router=None,
+        autoscale_params: dict | None = None,
     ):
         """``store``: the AssetStore servable bundles load from (required
         when run_servers).  ``run_servers=False`` reconciles placement
-        and status only — no JAX, no HTTP — for control-plane tests."""
+        and status only — no JAX, no HTTP — for control-plane tests.
+
+        ``clock`` drives the autoscaler FSM and its alert-rule holds
+        (FakeClock in tests).  ``router``: an optional
+        ``serve.FleetRouter`` whose replica names are this service's
+        pod names — scale-down then retires the replica owning the
+        fewest warm prefix chains and announces the drain so its hash
+        range re-homes first.  ``autoscale_params`` overrides
+        ``FleetAutoscaler`` knobs (cooldown_s, max_step, ...)."""
         self.kube = kube
         self.store = store
         self.run_servers = run_servers
         self.metrics = metrics or global_metrics
+        self.clock = clock or RealClock()
+        self.router = router
+        self.autoscale_params = dict(autoscale_params or {})
         self.recorder = EventRecorder(kube, "inferenceservice-controller")
         # (namespace, service, pod) → live LmServer.
         self._servers: dict[tuple, object] = {}
+        # (namespace, service, pod) → that replica's private metrics
+        # registry — the federation targets the autoscaler scrapes.
+        self._registries: dict[tuple, MetricsRegistry] = {}
+        # (namespace, service) → {"collector", "evaluator", "scaler"}.
+        self._fleet: dict[tuple, dict] = {}
         # Resolved (space, id, version) → loaded (model, params,
         # tokenizer): replicas of one service — and services sharing a
         # bundle — share the host-side weights (each server still owns
@@ -129,16 +157,35 @@ class InferenceServiceReconciler(Reconciler):
 
         desired = self._desired_replicas(svc)
 
-        # Scale down: retire surplus replicas (highest index first).
-        existing = self._owned_pods(svc)
-        for p in existing:
+        # Index the owned pods; a pod outside the name scheme retires.
+        pods: dict[int, Pod] = {}
+        for p in self._owned_pods(svc):
             idx = self._index_of(svc, p.metadata.name)
-            if idx is None or idx >= desired:
+            if idx is None:
+                self._retire_pod(svc, p)
+            else:
+                pods[idx] = p
+
+        # Scale down: retire surplus replicas.  Indices need NOT stay
+        # contiguous — prefix-aware victim choice may retire a low
+        # index and keep higher ones (the kept set is status truth).
+        if len(pods) > desired:
+            for p in self._scale_down_victims(
+                svc, pods, len(pods) - desired
+            ):
+                pods.pop(self._index_of(svc, p.metadata.name), None)
                 self._retire_pod(svc, p)
 
-        # Scale up / self-heal: ensure pods 0..desired-1.
+        # Scale up / self-heal: keep every surviving index, fill the
+        # shortfall with the lowest free indices.
+        target = set(pods)
+        i = 0
+        while len(target) < desired:
+            if i not in target:
+                target.add(i)
+            i += 1
         short = None
-        for i in range(desired):
+        for i in sorted(target):
             try:
                 self._ensure_replica(svc, i)
             except PlacementError as e:
@@ -150,7 +197,7 @@ class InferenceServiceReconciler(Reconciler):
                 # instead of retrying forever with chips held.
                 return self._fail(svc, f"model bundle unusable: {e}")
 
-        return self._update_status(svc, desired, short)
+        return self._update_status(svc, desired, sorted(target), short)
 
     def _fail(self, svc: InferenceService, msg: str) -> Result:
         for p in self._owned_pods(svc):
@@ -226,6 +273,41 @@ class InferenceServiceReconciler(Reconciler):
         if self.run_servers:
             self._ensure_server(svc, name)
 
+    def _scale_down_victims(
+        self, svc: InferenceService, pods: dict, n: int
+    ) -> list[Pod]:
+        """The ``n`` surplus replicas to retire.  Default order:
+        highest index first (the historical contract).  With a router
+        attached whose replica names are this service's pod names, the
+        choice is prefix-aware — fewest warm chains first (least cache
+        state lost; ties break on higher index) — and each victim's
+        drain is ANNOUNCED to the router before its pod dies, so new
+        traffic re-homes off its hash range immediately."""
+        order = sorted(pods.items(), key=lambda kv: -kv[0])
+        routed = (
+            set(self.router.replica_names())
+            if self.router is not None else set()
+        )
+        if routed:
+            order = sorted(
+                pods.items(),
+                key=lambda kv: (
+                    self.router.chains_owned(kv[1].metadata.name)
+                    if kv[1].metadata.name in routed else 0,
+                    -kv[0],
+                ),
+            )
+        victims = [p for _, p in order[:n]]
+        for p in victims:
+            if p.metadata.name in routed:
+                chains = self.router.drain(p.metadata.name)
+                self.recorder.event(
+                    svc, "Normal", "ReplicaDraining",
+                    f"{p.metadata.name} draining ({chains} warm "
+                    "chains re-homing) before retirement",
+                )
+        return victims
+
     def _ensure_server(self, svc: InferenceService, pod: str) -> None:
         key = (svc.metadata.namespace, svc.metadata.name, pod)
         if key in self._servers:
@@ -242,6 +324,10 @@ class InferenceServiceReconciler(Reconciler):
             dkey, (dm, dp, _) = self._load(svc.spec.draft)
             used.append(dkey)
             draft = (dm, dp)
+        # A private registry per replica: the per-service federation
+        # collector scrapes these, so the autoscaler's signals are
+        # per-replica truth instead of a global-registry mash.
+        reg = MetricsRegistry()
         server = LmServer(
             model, params, tok,
             slots=svc.spec.slots,
@@ -252,8 +338,10 @@ class InferenceServiceReconciler(Reconciler):
             kv_quant=svc.spec.kv_quant,
             paged_blocks=svc.spec.paged_blocks,
             page_size=svc.spec.paged_page_size,
+            metrics=reg,
         ).start()
         self._servers[key] = server
+        self._registries[key] = reg
         self._server_bundles[key] = used
         for k in used:
             self._bundle_refs[k] = self._bundle_refs.get(k, 0) + 1
@@ -271,6 +359,12 @@ class InferenceServiceReconciler(Reconciler):
             except Exception:
                 log.exception("stopping server for %s", pod)
         self._release_bundles(self._server_bundles.pop(key, []))
+        self._registries.pop(key, None)
+        st = self._fleet.get(key[:2])
+        if st is not None:
+            st["collector"].remove_target(pod)
+        if self.router is not None and pod in self.router.replica_names():
+            self.router.remove_replica(pod)
 
     def _retire_pod(self, svc: InferenceService, pod: Pod) -> None:
         self._stop_server(svc, pod.metadata.name)
@@ -296,6 +390,65 @@ class InferenceServiceReconciler(Reconciler):
                 total += server.batcher.pending_requests
         return total
 
+    def _fleet_state(self, svc: InferenceService) -> dict:
+        """Per-service autoscale plumbing, created lazily: a
+        ``FleetCollector`` over the live replicas' private registries, a
+        ``RuleEvaluator`` running ``router_rule_pack`` on the federated
+        registry, and the ``FleetAutoscaler`` FSM — all on this
+        reconciler's clock, so the whole loop replays deterministically
+        under ``FakeClock``."""
+        from ..serve.router import FleetAutoscaler, router_rule_pack
+        from ..utils.alerts import RuleEvaluator
+        from ..utils.federation import FleetCollector
+
+        key = (svc.metadata.namespace, svc.metadata.name)
+        s = svc.spec
+        knobs = (
+            s.min_replicas, s.max_replicas, s.target_pending_per_replica,
+        )
+        st = self._fleet.get(key)
+        if st is not None and st["knobs"] != knobs:
+            # Spec change: rebuild the policy plumbing so new bounds and
+            # thresholds apply (the FSM holds restart — a spec edit is a
+            # deliberate operator action, not flapping).
+            st = None
+        if st is None:
+            collector = FleetCollector({}, clock=self.clock)
+            evaluator = RuleEvaluator(
+                router_rule_pack(
+                    collector,
+                    backlog_per_replica=float(
+                        s.target_pending_per_replica
+                    ),
+                    backlog_for_s=AUTOSCALE_POLL,
+                    ttft_for_s=AUTOSCALE_POLL,
+                    low_fill_for_s=4 * AUTOSCALE_POLL,
+                ),
+                clock=self.clock,
+                registry=collector.registry,
+            )
+            scaler = FleetAutoscaler(
+                min_replicas=s.min_replicas,
+                max_replicas=s.max_replicas,
+                clock=self.clock,
+                target_pending_per_replica=s.target_pending_per_replica,
+                metrics=self.metrics,
+                **self.autoscale_params,
+            )
+            st = {
+                "collector": collector,
+                "evaluator": evaluator,
+                "scaler": scaler,
+                "knobs": knobs,
+            }
+            self._fleet[key] = st
+        # Keep the scrape targets in lockstep with the live replicas.
+        targets = set(st["collector"].replica_names())
+        for (kns, kname, pod), reg in self._registries.items():
+            if (kns, kname) == key and pod not in targets:
+                st["collector"].add_target(pod, reg.render)
+        return st
+
     def _desired_replicas(self, svc: InferenceService) -> int:
         s = svc.spec
         if not s.max_replicas:
@@ -307,20 +460,45 @@ class InferenceServiceReconciler(Reconciler):
             return max(s.min_replicas, min(s.max_replicas, s.replicas))
         pending = self._pending(svc)
         svc.status.pending_requests = pending
-        want = math.ceil(pending / s.target_pending_per_replica)
-        # min_replicas is the floor even at zero pending.
-        return max(s.min_replicas, min(s.max_replicas, want))
+        st = self._fleet_state(svc)
+        # Scrape the replicas, then overwrite the pending aggregate with
+        # the reconciler's own (freshest) sum so the rules and the
+        # sizing math read one number, then evaluate the rule holds.
+        st["collector"].scrape_once()
+        st["collector"].registry.set_gauge(
+            "serve_pending_requests", float(pending)
+        )
+        st["evaluator"].evaluate_once()
+        firing = {
+            a["alertname"]
+            for a in st["evaluator"].active_alerts()
+            if a["state"] == "firing"
+        }
+        d = st["scaler"].decide(
+            replicas=svc.status.replicas, pending=pending, firing=firing,
+        )
+        if d.direction:
+            self.recorder.event(
+                svc, "Normal",
+                "AutoscaleUp" if d.direction > 0 else "AutoscaleDown",
+                f"{svc.status.replicas} -> {d.target} replicas "
+                f"({d.reason})",
+            )
+        return max(s.min_replicas, min(s.max_replicas, d.target))
 
     # -- status ------------------------------------------------------------
     def _update_status(
-        self, svc: InferenceService, desired: int, short: str | None
+        self, svc: InferenceService, desired: int,
+        indices: list[int], short: str | None
     ) -> Result:
+        """``indices``: the kept replica index set (not necessarily
+        contiguous after a prefix-aware scale-down)."""
         pods = {
             self._index_of(svc, p.metadata.name): p
             for p in self._owned_pods(svc)
         }
         endpoints, placements, ready = [], {}, 0
-        for i in range(desired):
+        for i in indices:
             p = pods.get(i)
             if p is None:
                 continue
@@ -374,6 +552,9 @@ class InferenceServiceReconciler(Reconciler):
     def _teardown(self, svc: InferenceService) -> Result:
         for p in self._owned_pods(svc):
             self._retire_pod(svc, p)
+        self._fleet.pop(
+            (svc.metadata.namespace, svc.metadata.name), None
+        )
         if FINALIZER in svc.metadata.finalizers:
             svc.metadata.finalizers.remove(FINALIZER)
             try:

@@ -9,6 +9,13 @@ from .engine import DecodeOutput, InferenceEngine, SamplingConfig
 from .journal import RequestJournal, RequestRecord
 from .jsonschema import SchemaError, schema_to_regex
 from .quant import quantize_params
+from .router import (
+    FleetAutoscaler,
+    FleetRouter,
+    RouteDecision,
+    ScaleDecision,
+    router_rule_pack,
+)
 from .server import LmServer
 from .speculative import distill_draft, rejection_sample
 
@@ -16,6 +23,8 @@ __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
     "ContinuousBatcher", "Overloaded", "RequestHandle",
     "RequestJournal", "RequestRecord",
+    "FleetRouter", "RouteDecision", "FleetAutoscaler", "ScaleDecision",
+    "router_rule_pack",
     "quantize_params", "export_servable", "load_servable",
     "DisaggregatedLm", "RegexConstraint", "compile_constraint",
     "distill_draft", "rejection_sample", "schema_to_regex", "SchemaError",

@@ -222,6 +222,12 @@ class _Request:
     # proposals and verify-kept acceptances attributable to THIS row.
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # Fleet-routing evidence (serve/router.py dispatch, or the LM
+    # server's x-route-replica/x-route-reason headers): which replica a
+    # front-end chose and why — journaled so `obs requests` explains
+    # placement.  "" for direct submits.
+    route_replica: str = ""
+    route_reason: str = ""
 
 
 class RequestHandle:
@@ -1439,6 +1445,7 @@ class ContinuousBatcher:
         constraint: str | None = None,
         deadline: float | None = None,
         tenant: str | None = None,
+        route: tuple | None = None,
     ) -> RequestHandle:
         """Queue a request; returns a handle streaming generated ids.
         Raises ValueError when the prompt cannot fit, KeyError for an
@@ -1451,7 +1458,9 @@ class ContinuousBatcher:
         its journal record; None/"" means ``"default"``.  Cardinality
         is bounded by the registry's per-name series cap — a flood of
         distinct tenant strings collapses into the overflow series,
-        never unbounded growth."""
+        never unbounded growth.  ``route``: ``(replica, reason)`` from
+        a fleet front-end (serve/router.py) — journaled so the request
+        record explains its placement."""
         # error/timeout only: this site has no clock to realize a
         # "slow" decision, and a silently-skipped delay must not be
         # counted as an injection.
@@ -1482,6 +1491,8 @@ class ContinuousBatcher:
             trace_ctx=global_tracer.current(),
             tenant=str(tenant) if tenant else "default",
             prompt_tokens=int(ids.size),
+            route_replica=str(route[0]) if route else "",
+            route_reason=str(route[1]) if route else "",
         )
         with self._lifecycle:
             if self._dead:
@@ -1509,6 +1520,7 @@ class ContinuousBatcher:
         top_p: float = 0.0, seed: int = 0,
         adapter: str | None = None, on_admit=None,
         constraint: str | None = None, tenant: str | None = None,
+        route: tuple | None = None,
     ) -> RequestHandle:
         """Admit a request whose prefill ran elsewhere (serve/disagg.py):
         ``row_cache`` is a [L, 1, H, max_seq, Dh] K/V tree computed at a
@@ -1570,6 +1582,8 @@ class ContinuousBatcher:
             trace_ctx=global_tracer.current(),
             tenant=str(tenant) if tenant else "default",
             prompt_tokens=int(n_tokens),
+            route_replica=str(route[0]) if route else "",
+            route_reason=str(route[1]) if route else "",
         )
         with self._lifecycle:
             if self._dead:
@@ -2436,6 +2450,8 @@ class ContinuousBatcher:
             ),
             reason=reason,
             path=req.path,
+            replica=req.route_replica,
+            route_reason=req.route_reason,
             slot=req.slot,
             prompt_tokens=req.prompt_tokens,
             tokens=req.emitted,

@@ -43,6 +43,12 @@ class RequestRecord:
     trace_id: str = ""
     reason: str = ""
     path: str = ""            # admission path ("" when shed pre-admission)
+    # Fleet routing evidence (serve/router.py): which replica the
+    # front-end chose and why ("" when the request reached the batcher
+    # without going through a router) — `obs requests` explains
+    # placement from these.
+    replica: str = ""
+    route_reason: str = ""    # affinity | load | fallback | ""
     slot: int = -1
     prompt_tokens: int = 0
     tokens: int = 0           # generated tokens actually delivered

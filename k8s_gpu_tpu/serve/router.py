@@ -1,0 +1,693 @@
+"""Prefix-affinity fleet router + telemetry-driven replica autoscaling.
+
+One ``ContinuousBatcher`` is fast (paged KV, block-granular prefix
+caching, speculative rounds) but serves one device.  Fleet scale means N
+replicas behind a front-end — and a naive round-robin front-end destroys
+the prefix-cache win: a shared system prompt's KV blocks end up cold on
+every replica instead of warm on one.  This module is the front-end
+policy plane (ROADMAP item 1):
+
+- **FleetRouter** — routes each request by the *page-aligned chain
+  hash* of its prompt (``kv_blocks.chunk_hashes`` — the exact key the
+  paged pool's content cache indexes by, so "the router's chain" and
+  "the replica's warm blocks" are the same bytes).  Traffic sharing a
+  prefix chain lands on the chain's owner replica; brand-new chains are
+  placed by rendezvous hashing on the chain ROOT (the first full page —
+  every future sharer of the prefix hashes to the same root, so the
+  mapping re-converges even if the router's warm table was evicted or
+  the router restarted); prompts with no full shareable page fall
+  through to least-loaded placement.  Candidates are scored on cache
+  affinity × live load read through a ``FleetCollector`` with bounded
+  staleness, a two-threshold hysteresis band marks replicas *hot* (a
+  hot replica sheds NEW prefixes to other replicas but keeps serving
+  the chains already warm on it, so load spills without thrashing the
+  cache), and every tie breaks on the replica name — routing is a pure
+  function of (request sequence, replica set, load snapshot), which is
+  what the two-run determinism test pins.
+- **FleetAutoscaler** — a deterministic scale FSM driven by the
+  federated alert signals (``router_rule_pack``): queue backlog and
+  TTFT-p95 burn scale UP (sized by pending / target-per-replica,
+  clamped to ``max_step``), sustained low slot fill scales DOWN one
+  step, and a cooldown after every action prevents flapping.  Scale-down
+  is prefix-aware: ``FleetRouter.scale_down_victim`` picks the replica
+  owning the fewest warm chains, and ``drain`` announces it so its hash
+  range re-homes (new traffic immediately routes elsewhere; the warm
+  table entries re-assign on next touch) before the replica retires.
+
+The router is transport-agnostic: replicas register a ``submit``
+callable (an in-process ``ContinuousBatcher.submit``, or an HTTP client
+posting ``/generate`` with ``x-route-replica``/``x-route-reason``
+headers for the journal stamp).  ``dispatch`` retries on replica
+failure — a dead replica is marked down, its traffic re-routes, and no
+request is lost (the chaos test injects ``serve.submit`` faults through
+``utils/faults.py`` to pin exactly this).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import math
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils.alerts import AlertingRule, RecordingRule
+from ..utils.clock import Clock, RealClock
+from ..utils.metrics import MetricsRegistry, global_metrics
+from .kv_blocks import chunk_hashes
+
+# Decision vocabulary (the serve_router_decisions_total{reason=} label
+# and the journal's route_reason):
+#   affinity  routed by chain hash — to the warm owner, or by rendezvous
+#             for a brand-new chain (the canonical cache home either way)
+#   load      no shareable full page: least-loaded placement
+#   fallback  the chain's warm owner was unusable (hot / draining /
+#             down): re-scored onto the best remaining replica
+ROUTE_REASONS = ("affinity", "load", "fallback")
+
+
+@dataclass
+class RouteDecision:
+    """One routing decision, with its audit trail."""
+
+    replica: str
+    reason: str
+    chain_depth: int = 0   # full shareable pages in the prompt
+    warm_depth: int = 0    # deepest chain prefix already warm on replica
+    scores: dict = field(default_factory=dict)  # replica -> score
+
+
+class FleetRouter:
+    """Prefix-affinity router over a named replica set (module
+    docstring for the model).  Thread-safe; every route/registration
+    call serializes on one lock — the policy is host-side bookkeeping,
+    never device work."""
+
+    def __init__(
+        self,
+        *,
+        page_size: int = 64,
+        collector=None,
+        metrics: MetricsRegistry | None = None,
+        clock: Clock | None = None,
+        staleness_s: float = 10.0,
+        hot_enter: float = 0.85,
+        hot_exit: float = 0.70,
+        affinity_weight: float = 1.0,
+        load_weight: float = 1.0,
+        pending_norm: float = 16.0,
+        max_tracked_chains: int = 4096,
+    ):
+        """``page_size`` must match the replicas' paged-KV page size —
+        the chain hashes only line up with the block cache when the
+        chunking does.  ``collector`` (a ``utils.federation
+        .FleetCollector``) supplies live per-replica load; without one
+        every replica reads load 0 and routing is pure affinity +
+        name-order tie-breaks.  ``staleness_s`` bounds how old the load
+        snapshot may be before a route triggers a fresh scrape.
+        ``hot_enter``/``hot_exit`` are the hysteresis band: a replica
+        whose load crosses ``hot_enter`` sheds new prefixes until it
+        drops below ``hot_exit``.  ``max_tracked_chains`` bounds the
+        warm-chain table (LRU eviction — an evicted chain re-homes by
+        rendezvous, which lands it back on the same replica)."""
+        self.page = max(1, int(page_size))
+        self.collector = collector
+        self.metrics = metrics if metrics is not None else global_metrics
+        self.clock = clock or RealClock()
+        self.staleness_s = float(staleness_s)
+        self.hot_enter = float(hot_enter)
+        self.hot_exit = float(hot_exit)
+        self.affinity_weight = float(affinity_weight)
+        self.load_weight = float(load_weight)
+        self.pending_norm = max(1.0, float(pending_norm))
+        self.max_tracked_chains = max(16, int(max_tracked_chains))
+        self._lock = threading.Lock()
+        self._replicas: dict[str, object] = {}   # name -> submit | None
+        self._draining: set[str] = set()
+        self._down: set[str] = set()
+        self._hot: set[str] = set()
+        # chain hash -> owning replica, LRU order (oldest first).
+        self._chains: "collections.OrderedDict[bytes, str]" = (
+            collections.OrderedDict()
+        )
+        self._chain_counts: dict[str, int] = {}
+        # Staleness bookkeeping has its OWN lock: the scrape must run
+        # OUTSIDE self._lock (a hung HTTP target would otherwise stall
+        # every concurrent route for its whole timeout).
+        self._refresh_lock = threading.Lock()
+        self._last_refresh = float("-inf")
+
+    # -- replica set -------------------------------------------------------
+    def add_replica(self, name: str, submit=None) -> None:
+        """Register a replica; ``submit(ids, *, route=..., **kw)`` is
+        what ``dispatch`` calls (route-only use may pass None)."""
+        with self._lock:
+            self._replicas[str(name)] = submit
+            self._down.discard(str(name))
+            self._chain_counts.setdefault(str(name), 0)
+            self._export_gauges()
+
+    def remove_replica(self, name: str) -> None:
+        """Deregister and forget the replica's warm chains (they
+        re-home by rendezvous on next touch)."""
+        with self._lock:
+            self._replicas.pop(name, None)
+            self._draining.discard(name)
+            self._down.discard(name)
+            self._hot.discard(name)
+            for h in [h for h, r in self._chains.items() if r == name]:
+                del self._chains[h]
+            self._chain_counts.pop(name, None)
+            self.metrics.remove_gauge(
+                "serve_router_chains_owned", replica=name
+            )
+            self._export_gauges()
+
+    def drain(self, name: str) -> int:
+        """Announce a scale-down: the replica stops receiving new
+        requests and its hash range re-homes (warm entries reassign as
+        they are touched).  Returns the warm-chain count it owned —
+        the work that will re-home."""
+        with self._lock:
+            if name not in self._replicas:
+                return 0
+            self._draining.add(name)
+            self.metrics.inc("serve_router_drains_total")
+            self._export_gauges()
+            return self._chain_counts.get(name, 0)
+
+    def mark_down(self, name: str) -> None:
+        """Exclude a replica observed failing (dispatch does this); its
+        chains re-home lazily, exactly like a drain it didn't ask for."""
+        with self._lock:
+            if name in self._replicas:
+                self._down.add(name)
+                self._export_gauges()
+
+    def mark_up(self, name: str) -> None:
+        with self._lock:
+            self._down.discard(name)
+            self._export_gauges()
+
+    def replica_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def chains_owned(self, name: str) -> int:
+        with self._lock:
+            return self._chain_counts.get(name, 0)
+
+    def scale_down_victim(self) -> str | None:
+        """The prefix-aware scale-down choice: the eligible replica
+        owning the FEWEST warm chains (least cache state to lose; ties
+        break on name).  None with <= 1 eligible replica."""
+        with self._lock:
+            eligible = self._eligible_locked()
+            if len(eligible) <= 1:
+                return None
+            return min(
+                eligible,
+                key=lambda r: (self._chain_counts.get(r, 0), r),
+            )
+
+    # -- load --------------------------------------------------------------
+    def _eligible_locked(self) -> list[str]:
+        out = []
+        for name in sorted(self._replicas):
+            if name in self._draining or name in self._down:
+                continue
+            if self.collector is not None:
+                up = self.collector.registry.gauge(
+                    "fleet_replica_up", replica=name
+                )
+                if up is not None and up < 0.5:
+                    continue
+            out.append(name)
+        return out
+
+    def _maybe_refresh(self) -> None:
+        """The bounded-staleness contract: a load snapshot older than
+        ``staleness_s`` triggers one scrape before the next route reads
+        it.  Runs WITHOUT the router lock (the collector serializes its
+        own passes) so a slow scrape target can't stall routing."""
+        if self.collector is None:
+            return
+        now = self.clock.now()
+        with self._refresh_lock:
+            if now - self._last_refresh < self.staleness_s:
+                return
+            self._last_refresh = now
+        try:
+            self.collector.scrape_once()
+        except Exception:
+            pass  # stale beats absent; liveness gates eligibility
+
+    def _loads_locked(self) -> dict[str, float]:
+        """Per-replica load in [0, 1] from the federated gauges (call
+        ``_maybe_refresh`` first, outside the lock).  No collector →
+        all zeros (affinity-only routing)."""
+        if self.collector is None:
+            return {name: 0.0 for name in self._replicas}
+        reg = self.collector.registry
+        loads = {}
+        for name in self._replicas:
+            fill = reg.gauge("serve_slot_fill_ratio", replica=name) or 0.0
+            kv = reg.gauge(
+                "serve_kv_occupancy_ratio", replica=name
+            ) or 0.0
+            pend = reg.gauge(
+                "serve_pending_requests", replica=name
+            ) or 0.0
+            # Queue pressure dominates: pending work queues BEHIND the
+            # slots, so it saturates the pending term before fill/kv
+            # alone can mark a replica hot.
+            loads[name] = min(1.0, (
+                0.4 * fill + 0.2 * kv
+                + 0.4 * min(1.0, pend / self.pending_norm)
+            ))
+        # Hysteresis band update rides every load read.
+        for name, load in loads.items():
+            if load >= self.hot_enter:
+                self._hot.add(name)
+            elif load <= self.hot_exit:
+                self._hot.discard(name)
+        return loads
+
+    def _score(self, warm: int, depth: int, load: float) -> float:
+        aff = warm / depth if depth else 0.0
+        return self.affinity_weight * aff - self.load_weight * load
+
+    @staticmethod
+    def _rendezvous(key: bytes, pool: list[str]) -> str:
+        """Highest-random-weight owner of ``key`` among ``pool`` —
+        stable under membership change (only keys owned by a removed
+        replica move)."""
+        return max(
+            pool,
+            key=lambda r: (
+                hashlib.blake2b(
+                    key + r.encode(), digest_size=8
+                ).digest(),
+                r,
+            ),
+        )
+
+    # -- routing -----------------------------------------------------------
+    def route(self, ids, exclude: set | None = None) -> RouteDecision:
+        """Choose a replica for a prompt (token ids).  ``exclude`` is a
+        per-request blacklist (dispatch's retry path).  Raises
+        RuntimeError when no replica is eligible."""
+        ids = np.asarray(ids, np.int32).ravel()
+        n = int(ids.size)
+        # Only FULL pages are shareable, and at least one suffix token
+        # must remain for the extend — the same cap _paged_plan applies,
+        # so the router's chain and the block cache's chain agree.
+        depth = max(0, (n - 1)) // self.page
+        hashes = chunk_hashes(ids, self.page)[:depth] if depth else []
+        self._maybe_refresh()
+        with self._lock:
+            loads = self._loads_locked()
+            eligible = [
+                r for r in self._eligible_locked()
+                if not exclude or r not in exclude
+            ]
+            if not eligible:
+                raise RuntimeError(
+                    "FleetRouter: no eligible replica "
+                    f"({len(self._replicas)} registered, "
+                    f"{len(self._draining)} draining, "
+                    f"{len(self._down)} down)"
+                )
+            # Warm lookup: per replica, the DEEPEST chain prefix of this
+            # prompt already owned by it.  ``warm_any`` remembers that
+            # some (now unusable) replica was warm — that distinguishes
+            # a "fallback" from a brand-new chain.
+            warm: dict[str, int] = {}
+            warm_any = False
+            for i in range(depth - 1, -1, -1):
+                o = self._chains.get(hashes[i])
+                if o is None:
+                    continue
+                warm_any = True
+                if o in eligible and o not in warm:
+                    warm[o] = i + 1
+            scores = {
+                r: self._score(warm.get(r, 0), depth, loads.get(r, 0.0))
+                for r in eligible
+            }
+            if depth == 0:
+                # No shareable page: pure load placement.
+                chosen = min(
+                    eligible, key=lambda r: (loads.get(r, 0.0), r)
+                )
+                reason = "load"
+            else:
+                owner = None
+                if warm:
+                    owner = sorted(
+                        warm.items(), key=lambda kv: (-kv[1], kv[0])
+                    )[0][0]
+                if owner is not None:
+                    # Warm traffic sticks to its owner even when the
+                    # owner is hot — the hysteresis sheds NEW prefixes,
+                    # never thrashes warm cache state (a genuinely
+                    # overloaded owner sheds through Overloaded at
+                    # dispatch, which retries elsewhere).
+                    chosen, reason = owner, "affinity"
+                else:
+                    pool = [
+                        r for r in eligible if r not in self._hot
+                    ] or eligible
+                    if not warm_any:
+                        # Brand-new chain: rendezvous on the chain root
+                        # (h1 covers the first page — every sharer of
+                        # the prefix computes the same root) among the
+                        # non-hot replicas.
+                        chosen = self._rendezvous(hashes[0], pool)
+                        reason = "affinity"
+                    else:
+                        # Warm only somewhere unusable (draining or
+                        # down replica): best remaining by score.
+                        chosen = sorted(
+                            pool, key=lambda r: (-scores[r], r)
+                        )[0]
+                        reason = "fallback"
+            self._record_chains_locked(hashes, chosen)
+            self.metrics.inc(
+                "serve_router_decisions_total", reason=reason
+            )
+            return RouteDecision(
+                replica=chosen,
+                reason=reason,
+                chain_depth=depth,
+                warm_depth=warm.get(chosen, 0),
+                scores=scores,
+            )
+
+    def _record_chains_locked(self, hashes, chosen: str) -> None:
+        for h in hashes:
+            prev = self._chains.pop(h, None)
+            if prev is not None:
+                self._chain_counts[prev] = (
+                    self._chain_counts.get(prev, 1) - 1
+                )
+            self._chains[h] = chosen
+            self._chain_counts[chosen] = (
+                self._chain_counts.get(chosen, 0) + 1
+            )
+        while len(self._chains) > self.max_tracked_chains:
+            _, owner = self._chains.popitem(last=False)
+            self._chain_counts[owner] = (
+                self._chain_counts.get(owner, 1) - 1
+            )
+        if hashes:
+            self._export_gauges()
+
+    def _export_gauges(self) -> None:
+        for name in self._replicas:
+            self.metrics.set_gauge(
+                "serve_router_chains_owned",
+                float(self._chain_counts.get(name, 0)),
+                replica=name,
+            )
+        self.metrics.set_gauge(
+            "serve_router_replicas", float(len(self._replicas))
+        )
+        self.metrics.set_gauge(
+            "serve_router_replicas_draining",
+            float(len(self._draining)),
+        )
+
+    # -- dispatch ----------------------------------------------------------
+    def dispatch(self, ids, **submit_kwargs):
+        """Route then submit, retrying on replica failure: a replica
+        whose submit raises is marked DOWN and the request re-routes
+        (``serve_router_rehash_total``) — zero requests are lost to a
+        replica death.  An ``Overloaded`` shed retries elsewhere
+        WITHOUT marking the replica down (full is a load signal, not a
+        death); ``ValueError``/``KeyError`` are REQUEST faults (prompt
+        too long, unknown adapter) that would fail identically on
+        every replica — they propagate immediately and never poison
+        the replica set.  When every candidate was tried, the last
+        replica error is re-raised (so a fleet-wide ``Overloaded``
+        stays a shed signal, not a routing RuntimeError).  Returns
+        ``(handle, RouteDecision)``."""
+        from .batcher import Overloaded
+
+        tried: set[str] = set()
+        last_err: Exception | None = None
+        for _ in range(max(1, len(self.replica_names()))):
+            try:
+                dec = self.route(ids, exclude=tried)
+            except RuntimeError:
+                if last_err is not None:
+                    raise last_err
+                raise
+            with self._lock:
+                fn = self._replicas.get(dec.replica)
+            if fn is None:
+                raise RuntimeError(
+                    f"replica {dec.replica!r} registered without a "
+                    "submit callable"
+                )
+            try:
+                handle = fn(
+                    ids, route=(dec.replica, dec.reason),
+                    **submit_kwargs,
+                )
+                return handle, dec
+            except Overloaded as e:
+                tried.add(dec.replica)
+                last_err = e
+                self.metrics.inc("serve_router_rehash_total")
+            except (ValueError, KeyError):
+                raise
+            except Exception as e:
+                tried.add(dec.replica)
+                last_err = e
+                self.mark_down(dec.replica)
+                self.metrics.inc("serve_router_rehash_total")
+        raise last_err if last_err is not None else RuntimeError(
+            "FleetRouter: dispatch found no replica"
+        )
+
+    # -- read surface ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The router's explain view (``obs route`` / the demo): per
+        replica, its role flags, warm-chain count, and current load."""
+        self._maybe_refresh()
+        with self._lock:
+            loads = self._loads_locked()
+            return {
+                "page_size": self.page,
+                "tracked_chains": len(self._chains),
+                "replicas": [
+                    {
+                        "replica": name,
+                        "chains": self._chain_counts.get(name, 0),
+                        "load": round(loads.get(name, 0.0), 4),
+                        "hot": name in self._hot,
+                        "draining": name in self._draining,
+                        "down": name in self._down,
+                    }
+                    for name in sorted(self._replicas)
+                ],
+            }
+
+
+# -- autoscaling --------------------------------------------------------------
+
+# Alert names the autoscaler listens for (router_rule_pack emits them).
+SCALE_UP_ALERTS = frozenset({"FleetQueueBacklog", "FleetTtftBurn"})
+SCALE_DOWN_ALERTS = frozenset({"FleetLowFill"})
+
+
+def router_rule_pack(
+    collector=None,
+    *,
+    backlog_per_replica: float = 4.0,
+    backlog_for_s: float = 10.0,
+    ttft_slo_s: float = 2.0,
+    ttft_for_s: float = 10.0,
+    ttft_window_s: float = 60.0,
+    low_fill: float = 0.25,
+    low_fill_for_s: float = 30.0,
+) -> list:
+    """The serving-plane scaling triggers, as ordinary alert rules over
+    a federated registry (``utils/alerts.py`` — same FSM, same
+    determinism):
+
+    - ``fleet_pending_per_replica`` (recording): fleet pending-request
+      sum over live replicas — scale-invariant backlog;
+    - ``FleetQueueBacklog``: sustained backlog above the per-replica
+      target → scale up;
+    - ``fleet_ttft_p95`` (recording) + ``FleetTtftBurn``: fleet TTFT
+      p95 above the SLO → scale up (latency burn, the signal queue
+      depth alone misses when requests are long).  The p95 is computed
+      from the WINDOWED increase of the federated ``_bucket`` series
+      (``ctx.rate`` per ``le``, merged across replicas) — a cumulative
+      quantile would let one compile-era 30 s TTFT keep the alert
+      firing forever, which both blocks every future scale-down and
+      pages on history instead of state;
+    - ``FleetLowFill``: fleet-average slot fill sustained below
+      ``low_fill`` → scale down one step.
+
+    ``collector`` is accepted for wiring symmetry (the federated
+    ``_bucket`` series it writes are what the p95 reads); a
+    non-federated registry (unit tests, one replica) falls back to the
+    registry's own histogram reservoirs."""
+
+    def _p95(ctx):
+        series = ctx.series("serve_ttft_seconds_bucket")
+        if not series:
+            return ctx.percentile("serve_ttft_seconds", 0.95)
+        merged = {}
+        for le in sorted({dict(lbls).get("le") for lbls in series}):
+            if le is None:
+                continue
+            merged[(("le", le),)] = ctx.rate(
+                "serve_ttft_seconds_bucket", ttft_window_s, le=le
+            )
+        from ..utils.federation import bucket_quantile
+
+        v = bucket_quantile(merged, 0.95)
+        return 0.0 if v is None else v
+
+    return [
+        RecordingRule(
+            "fleet_pending_per_replica",
+            lambda ctx: ctx.gauge("serve_pending_requests")
+            / max(1.0, ctx.gauge("fleet_replicas_up", 1.0)),
+        ),
+        RecordingRule("fleet_ttft_p95", _p95),
+        AlertingRule(
+            "FleetQueueBacklog",
+            lambda ctx: ctx.gauge("fleet_pending_per_replica"),
+            above=backlog_per_replica, for_s=backlog_for_s,
+            annotation=(
+                "fleet backlog at {value:.1f} pending per replica — "
+                "scale up"
+            ),
+        ),
+        AlertingRule(
+            "FleetTtftBurn",
+            lambda ctx: ctx.gauge("fleet_ttft_p95"),
+            above=ttft_slo_s, for_s=ttft_for_s, severity="page",
+            annotation=(
+                "fleet TTFT p95 at {value:.2f}s over the SLO — scale up"
+            ),
+        ),
+        AlertingRule(
+            "FleetLowFill",
+            lambda ctx: ctx.gauge("serve_slot_fill_ratio"),
+            below=low_fill, for_s=low_fill_for_s,
+            annotation=(
+                "fleet slot fill at {value:.0%} — sustained idle "
+                "capacity, scale down"
+            ),
+        ),
+    ]
+
+
+@dataclass
+class ScaleDecision:
+    target: int
+    reason: str      # backlog | ttft_burn | low_fill | hold | cooldown
+    direction: int   # +1 up, -1 down, 0 hold
+
+
+class FleetAutoscaler:
+    """Deterministic replica-count FSM over the alert signals.
+
+    ``decide`` is a pure function of (replicas, pending, firing set,
+    clock time, last-action time): the same scripted sequence produces
+    the same decisions under ``FakeClock`` — the up/down/cooldown test
+    replays exactly that.  Scale-up is SIZED (``ceil(pending /
+    target_pending_per_replica)``, stepped by at most ``max_step``);
+    scale-down is one replica at a time (cache state re-homes per
+    drain, and one step per cooldown bounds the churn)."""
+
+    def __init__(
+        self,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+        clock: Clock | None = None,
+        cooldown_s: float = 30.0,
+        max_step: int = 2,
+        target_pending_per_replica: float = 4.0,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.clock = clock or RealClock()
+        self.cooldown_s = float(cooldown_s)
+        self.max_step = max(1, int(max_step))
+        self.target_pending_per_replica = max(
+            1.0, float(target_pending_per_replica)
+        )
+        self.metrics = metrics if metrics is not None else global_metrics
+        self._last_action = float("-inf")
+
+    def decide(
+        self,
+        *,
+        replicas: int,
+        pending: float = 0.0,
+        firing=(),
+        now: float | None = None,
+    ) -> ScaleDecision:
+        """``firing``: alert names currently firing (the evaluator's
+        ``active_alerts`` filtered to state == "firing")."""
+        now = self.clock.now() if now is None else now
+        firing = set(firing)
+        replicas = max(1, int(replicas))
+        in_cooldown = now - self._last_action < self.cooldown_s
+        up = firing & SCALE_UP_ALERTS
+        if up:
+            if in_cooldown:
+                return self._hold(replicas, "cooldown")
+            need = (
+                math.ceil(pending / self.target_pending_per_replica)
+                if pending > 0 else replicas + 1
+            )
+            step = min(self.max_step, max(1, need - replicas))
+            target = min(self.max_replicas, replicas + step)
+            if target > replicas:
+                reason = (
+                    "backlog" if "FleetQueueBacklog" in up
+                    else "ttft_burn"
+                )
+                return self._act(replicas, target, reason, now)
+            return self._hold(replicas, "hold")
+        if firing & SCALE_DOWN_ALERTS and pending <= 0:
+            if in_cooldown:
+                return self._hold(replicas, "cooldown")
+            target = max(self.min_replicas, replicas - 1)
+            if target < replicas:
+                return self._act(replicas, target, "low_fill", now)
+        return self._hold(replicas, "hold")
+
+    def _hold(self, replicas: int, reason: str) -> ScaleDecision:
+        self.metrics.set_gauge(
+            "serve_autoscaler_target_replicas", float(replicas)
+        )
+        return ScaleDecision(target=replicas, reason=reason, direction=0)
+
+    def _act(
+        self, replicas: int, target: int, reason: str, now: float
+    ) -> ScaleDecision:
+        self._last_action = now
+        direction = 1 if target > replicas else -1
+        self.metrics.inc(
+            "serve_autoscaler_actions_total",
+            direction="up" if direction > 0 else "down",
+        )
+        self.metrics.set_gauge(
+            "serve_autoscaler_target_replicas", float(target)
+        )
+        return ScaleDecision(
+            target=target, reason=reason, direction=direction
+        )

@@ -185,6 +185,18 @@ class LmServer:
                     return self._json(
                         400, {"error": "tenant must be a string"})
                 tenant = tenant.strip()[:64] or "default"
+                # Fleet front-end stamp: a router forwarding to this
+                # replica announces its decision in headers so the
+                # journal record explains placement (serve/router.py;
+                # length-capped like the tenant label).
+                route = None
+                route_replica = self.headers.get("x-route-replica")
+                if route_replica:
+                    route = (
+                        route_replica.strip()[:64],
+                        (self.headers.get("x-route-reason") or ""
+                         ).strip()[:16] or "forwarded",
+                    )
                 stream = bool(body.get("stream", False))
                 want_lp = bool(body.get("logprobs", False))
                 # Per-request latency budget: x-request-deadline-ms is a
@@ -218,6 +230,8 @@ class LmServer:
                             tenant=tenant,
                             trace_id=ctx.trace_id if ctx else "",
                             reason="deadline",
+                            replica=route[0] if route else "",
+                            route_reason=route[1] if route else "",
                             deadline_expired=True,
                             t_submit=time.monotonic(),
                             t_done=time.monotonic(),
@@ -238,6 +252,7 @@ class LmServer:
                         constraint=constraint,
                         deadline=deadline,
                         tenant=tenant,
+                        route=route,
                     )
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})

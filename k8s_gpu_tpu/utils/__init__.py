@@ -13,6 +13,7 @@ from .obs import (
     MetricsServer,
     render_fleet,
     render_requests,
+    render_route,
     render_top,
     render_top_columns,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "parse_exposition",
     "render_fleet",
     "render_requests",
+    "render_route",
     "render_top",
     "render_top_columns",
     "FaultInjector",

@@ -518,23 +518,63 @@ def render_requests(records: list[dict]) -> str:
     eye can carry it into ``obs traces --trace <id>``."""
     if not records:
         return "no journal records (no requests retired yet)"
-    lines = [
-        f"  {'TENANT':<12} {'REASON':<11} {'PATH':<13} {'TOK':>5} "
-        f"{'WAIT(MS)':>9} {'TTFT(MS)':>9} {'TPOT(MS)':>9} "
+    routed = any(r.get("replica") for r in records)
+    head = f"  {'TENANT':<12} {'REASON':<11} {'PATH':<13} "
+    if routed:
+        head += f"{'REPLICA':<12} {'ROUTE':<9} "
+    head += (
+        f"{'TOK':>5} {'WAIT(MS)':>9} {'TTFT(MS)':>9} {'TPOT(MS)':>9} "
         f"{'PFX':>4} {'ACC%':>5}  TRACE"
-    ]
+    )
+    lines = [head]
     for r in records:
         acc = (
             f"{r['spec_accepted'] / r['spec_drafted']:.0%}"
             if r.get("spec_drafted") else "-"
         )
-        lines.append(
+        line = (
             f"  {r['tenant']:<12} {r['reason']:<11} "
-            f"{(r.get('path') or '-'):<13} {r['tokens']:>5} "
+            f"{(r.get('path') or '-'):<13} "
+        )
+        if routed:
+            line += (
+                f"{(r.get('replica') or '-'):<12} "
+                f"{(r.get('route_reason') or '-'):<9} "
+            )
+        line += (
+            f"{r['tokens']:>5} "
             f"{r['queue_wait_s'] * 1000:>9.1f} "
             f"{r['ttft_s'] * 1000:>9.1f} "
             f"{r['tpot_s'] * 1000:>9.1f} "
             f"{r.get('prefix_blocks', 0):>4} {acc:>5}  "
             f"{r.get('trace_id') or '-'}"
+        )
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def render_route(decision, snap: dict) -> str:
+    """The ``obs route`` explain view: one routing decision (a
+    ``serve.router.RouteDecision``) plus the router snapshot's
+    per-replica table — why THIS replica, and what the alternatives
+    scored."""
+    lines = [
+        f"ROUTE  -> {decision.replica}  ({decision.reason}; chain depth "
+        f"{decision.chain_depth}, warm depth {decision.warm_depth})",
+        "",
+        f"  {'REPLICA':<18} {'SCORE':>8} {'CHAINS':>7} {'LOAD':>7} "
+        f"{'FLAGS':<18}",
+    ]
+    by_name = {r["replica"]: r for r in snap.get("replicas", [])}
+    for name in sorted(set(decision.scores) | set(by_name)):
+        r = by_name.get(name, {})
+        flags = [f for f in ("hot", "draining", "down") if r.get(f)]
+        score = decision.scores.get(name)
+        lines.append(
+            f"  {name + (' *' if name == decision.replica else ''):<18} "
+            f"{(f'{score:+.3f}' if score is not None else '-'):>8} "
+            f"{r.get('chains', 0):>7} "
+            f"{_flatval(r.get('load'), '{:.1%}'):>7} "
+            f"{','.join(flags) or '-':<18}"
         )
     return "\n".join(lines)

@@ -200,26 +200,95 @@ def test_real_servers_serve_http(bundle_store):
 
 def test_autoscale_grows_and_shrinks_on_queue_depth(bundle_store,
                                                     monkeypatch):
-    kube, rec = _cluster(run_servers=True, store=bundle_store)
+    """The telemetry-driven policy (serve/router.py): a sustained
+    backlog fires FleetQueueBacklog and scales up SIZED by pending /
+    target; sustained low fill scales down one step per cooldown
+    window back to the floor — all deterministic under FakeClock."""
+    from k8s_gpu_tpu.utils.clock import FakeClock
+
+    clk = FakeClock()
+    kube = FakeKube()
+    for i in range(2):
+        kube.create(_tpu_node(f"tpu-{i}"))
+    rec = InferenceServiceReconciler(
+        kube, store=bundle_store, run_servers=True, clock=clk,
+        autoscale_params={"cooldown_s": 5.0, "max_step": 2},
+    )
     kube.create(_svc(replicas=1, slots=2, min_replicas=1, max_replicas=3,
                      target_pending_per_replica=2))
     try:
         res = _reconcile(kube, rec)
         assert res.requeue_after is not None  # keeps watching the queue
         assert kube.get("InferenceService", "chat").status.replicas == 1
-        # Pretend 5 requests are queued → ceil(5/2) = 3 replicas.
+        # 5 queued at target 2/replica: backlog breaches, holds for
+        # backlog_for_s (= AUTOSCALE_POLL), then fires → ceil(5/2) = 3.
         monkeypatch.setattr(rec, "_pending", lambda svc: 5)
-        _reconcile(kube, rec)
+        _reconcile(kube, rec)                 # alert goes pending
+        assert kube.get("InferenceService", "chat").status.replicas == 1
+        clk.advance(5.0)
+        _reconcile(kube, rec)                 # hold elapsed → firing
         svc = kube.get("InferenceService", "chat")
         assert svc.status.replicas == 3, svc.status
         assert svc.status.ready_replicas == 3
-        # Queue drains → back to the min floor.
+        # Queue drains, fill stays 0: FleetLowFill fires after its
+        # sustained hold, then one step down per cooldown window.
         monkeypatch.setattr(rec, "_pending", lambda svc: 0)
-        _reconcile(kube, rec)
+        for _ in range(8):
+            clk.advance(10.0)
+            _reconcile(kube, rec)
         assert kube.get("InferenceService", "chat").status.replicas == 1
     finally:
         kube.delete("InferenceService", "chat")
         _reconcile(kube, rec)
+
+
+def test_prefix_aware_scale_down_retires_fewest_chains(bundle_store):
+    """With a FleetRouter attached (replica names = pod names), a
+    scale-down retires the replica owning the FEWEST warm prefix
+    chains — not the highest index — announces the drain, and the
+    survivors keep their (non-contiguous) indices."""
+    from k8s_gpu_tpu.serve.router import FleetRouter
+    from k8s_gpu_tpu.utils.metrics import MetricsRegistry
+
+    router = FleetRouter(page_size=8, metrics=MetricsRegistry())
+    kube = FakeKube()
+    kube.create(_tpu_node("tpu-0"))
+    rec = InferenceServiceReconciler(kube, run_servers=False,
+                                     router=router)
+    kube.create(_svc(replicas=3, chips=1))
+    _reconcile(kube, rec)
+    pods = sorted(
+        p.metadata.name for p in kube.list("Pod", namespace="default")
+    )
+    assert pods == ["chat-r-0", "chat-r-1", "chat-r-2"]
+    for p in pods:
+        router.add_replica(p)
+    # Warm chains: r-0 owns two tenants' chains, r-2 owns one, r-1 none.
+    prefix_a, prefix_b, prefix_c = (
+        list(range(1, 9)), list(range(10, 18)), list(range(20, 28))
+    )
+    for ids in (prefix_a, prefix_b, prefix_c):
+        router.route(ids + [40])
+    # Rendezvous spread is hash-determined; pin the expectation from
+    # the observed ownership: the victim must be the minimum owner.
+    owned = {p: router.chains_owned(p) for p in pods}
+    expect_victim = min(pods, key=lambda p: (owned[p], p))
+    svc = kube.get("InferenceService", "chat")
+    svc.spec.replicas = 2
+    kube.update(svc)
+    _reconcile(kube, rec)
+    left = sorted(
+        p.metadata.name for p in kube.list("Pod", namespace="default")
+    )
+    assert expect_victim not in left and len(left) == 2, (owned, left)
+    assert expect_victim not in router.replica_names()
+    events = [e for e in kube.list("Event", namespace="default")
+              if e.reason == "ReplicaDraining"]
+    assert events and expect_victim in events[-1].message
+    # Status stays coherent over the non-contiguous index set.
+    svc = kube.get("InferenceService", "chat")
+    assert svc.status.replicas == 2 and svc.status.ready_replicas == 2
+    assert len(svc.status.endpoints) == 2
 
 
 def test_manager_integration_real_clock(bundle_store):
