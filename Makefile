@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: docker-build docker-push deploy undeploy test trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo
+.PHONY: docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo
 
 docker-build:
 	@for img in $(IMAGES); do \
@@ -49,6 +49,22 @@ test:
 # accumulation threshold, but `make test` is the canonical full run.
 test-single:
 	python -m pytest tests/ -x -q
+
+# graftcheck: the AST invariant linter (k8s_gpu_tpu/analysis) — the
+# determinism planes carry no ambient time/randomness/set-order, every
+# metric mint site honors the registry contract and observability.md,
+# and lock-guarded fields are touched under their lock.  Findings are
+# compared against config/analysis_baseline.json (pinned debt only
+# shrinks); non-zero exit on any new finding or stale baseline entry.
+# docs/platform/invariants.md documents every rule.
+check:
+	python -m k8s_gpu_tpu.analysis
+
+# graftcheck demo: seeds one violation of each rule into a scratch tree,
+# shows the linter catching all of them, then shows the runtime
+# instrumented lock catching an unguarded write a static pass can't see.
+analysis-demo:
+	python tools/analysis_demo.py
 
 # End-to-end tracing smoke: apiserver create (traceparent in) → workqueue
 # → reconcile → fake cloud call → /debug/traces shows one linked trace.

@@ -1,0 +1,332 @@
+"""graftcheck — AST invariant linter for the fleet's hardest-won contracts.
+
+Seven PRs in, the properties that keep this codebase correct are
+*contracts*, not code: two-run bit-identical routing/alerting under an
+injected ``Clock``, a metrics registry with reserved labels and
+cardinality rules, and lock-guarded shared state crossed by the
+batcher / router / federation threads.  Every one of them was enforced
+only by reviewer memory, and every one was violated at least once
+(CHANGES.md: the ``name=`` label collision, the ``Histogram.percentile``
+deque race, wall-clock leaks into FakeClock planes).  Before the
+fleet-scale items multiply the threads and processes that must uphold
+them, this package encodes the contracts as a static-analysis pass —
+the VirtualFlow split (PAPERS.md, arXiv 2009.09523) applied to process
+hygiene: the checker owns the invariant; modules just have to pass it.
+
+Three passes, all stdlib-``ast``, zero dependencies:
+
+- **determinism** (``determinism.py``): in the deterministic planes
+  (router, journal, alerts, federation, metrics, tracing, operators,
+  controller, resilience, plus the token/asset expiry modules) forbid
+  ``time.time()`` / ``time.monotonic()`` / ``datetime.now()`` /
+  unseeded ``random.*`` and iteration over bare ``set`` values — wall
+  time must flow through ``utils/clock.py`` and orderings must be
+  sorted, or routing/alert-FSM replay breaks.
+- **metrics contract** (``metrics_contract.py``): collect every metric
+  mint site across the package, then check reserved labels, per-metric
+  label-set consistency, counter/gauge kind and suffix discipline, and
+  two-way drift against the tables in
+  ``docs/platform/observability.md``.
+- **lock discipline** (``lockcheck.py``): for any class owning a
+  ``threading.Lock``/``RLock``, infer (or read the declared
+  ``_GUARDED_BY``) guarded field set and flag reads/writes outside the
+  lock — a static race lint over exactly the classes where PRs 4-7
+  each fixed a real race.  The same ``_GUARDED_BY`` declarations drive
+  the *runtime* half (``utils.faults.guard_declared``): an instrumented
+  lock that asserts guarded-field access under real concurrency.
+
+Findings are deterministic (sorted ``path:line rule-id message`` lines,
+byte-identical across runs) and compared against a committed baseline
+(``config/analysis_baseline.json``) keyed by (path, rule, detail) — NOT
+line numbers, so unrelated edits don't churn it.  Pre-existing debt is
+pinned; new violations fail; baseline entries matching nothing are
+*stale* and fail too, so the file can only shrink.  Inline escape
+hatch: ``# graftcheck: ignore[rule-id]`` on the offending line.
+
+Run it: ``python -m k8s_gpu_tpu.analysis`` / ``make check`` /
+``obs lint``; ``tests/test_analysis_selfcheck.py`` runs all passes over
+the repo inside tier-1, so the contracts are enforced with no external
+CI.  docs/platform/invariants.md documents every rule and its war story.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# Rule-id vocabulary (docs/platform/invariants.md documents each):
+#   det-wallclock   time.time()/time.monotonic() in a deterministic plane
+#   det-datetime    datetime.now()/utcnow()/today() in a deterministic plane
+#   det-random      unseeded random.* in a deterministic plane
+#   det-set-iter    iteration over a bare set value (unordered replay)
+#   met-reserved-label   minting the registry's reserved labels
+#   met-label-mismatch   one metric name, multiple label-key sets
+#   met-kind-conflict    one name minted as both counter and gauge/histogram
+#   met-counter-suffix   counter without _total / gauge with _total
+#   met-undocumented     minted metric absent from observability.md
+#   met-doc-stale        documented metric minted nowhere
+#   lock-guard           guarded field accessed outside its lock
+RULES = (
+    "det-wallclock", "det-datetime", "det-random", "det-set-iter",
+    "met-reserved-label", "met-label-mismatch", "met-kind-conflict",
+    "met-counter-suffix", "met-undocumented", "met-doc-stale",
+    "lock-guard",
+)
+
+_PRAGMA = re.compile(r"graftcheck:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation.  ``detail`` is the line-number-free identity
+    (symbol + enclosing scope) the baseline keys on, so pinned debt
+    survives unrelated edits above it."""
+
+    path: str      # repo-root-relative, posix separators
+    line: int
+    rule: str
+    detail: str    # e.g. "time.time in TokenIssuer.issue"
+    message: str = field(compare=False, default="")
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}|{self.rule}|{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+def suppressed_rules(source_line: str) -> set[str] | None:
+    """Rules an inline ``# graftcheck: ignore[...]`` pragma on this
+    source line suppresses; empty set = all rules; None = no pragma."""
+    m = _PRAGMA.search(source_line)
+    if m is None:
+        return None
+    if not m.group(1):
+        return set()
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def apply_pragmas(findings: list[Finding], sources: dict[str, list[str]]) -> list[Finding]:
+    """Drop findings whose source line carries a matching pragma.
+    ``sources`` maps repo-relative path -> source lines."""
+    out = []
+    for f in findings:
+        lines = sources.get(f.path)
+        if lines and 1 <= f.line <= len(lines):
+            rules = suppressed_rules(lines[f.line - 1])
+            if rules is not None and (not rules or f.rule in rules):
+                continue
+        out.append(f)
+    return out
+
+
+# -- repo walking ------------------------------------------------------------
+
+def package_files(repo_root: Path, package: str = "k8s_gpu_tpu") -> list[Path]:
+    pkg = Path(repo_root) / package
+    return sorted(
+        p for p in pkg.rglob("*.py") if "__pycache__" not in p.parts
+    )
+
+
+def rel(repo_root: Path, path: Path) -> str:
+    return path.relative_to(repo_root).as_posix()
+
+
+class ScopeVisitor(ast.NodeVisitor):
+    """AST visitor tracking the enclosing class/function scope name —
+    what finding ``detail``s are keyed on (stable across line drift).
+    Shared by every pass so finding identities can never drift between
+    them."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.scope: list[str] = []
+        self.findings: list[Finding] = []
+
+    @property
+    def where(self) -> str:
+        return ".".join(self.scope) or "<module>"
+
+    def _scoped(self, node):
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_FunctionDef = _scoped
+    visit_AsyncFunctionDef = _scoped
+    visit_ClassDef = _scoped
+
+    def add(self, node, rule: str, detail_sym: str, message: str) -> None:
+        self.findings.append(Finding(
+            path=self.path,
+            line=node.lineno,
+            rule=rule,
+            detail=f"{detail_sym} in {self.where}",
+            message=f"{message} (in {self.where})",
+        ))
+
+
+def parse_package(
+    repo_root: Path, files: list[Path]
+) -> tuple[dict[str, list[str]], dict]:
+    """One read + one ast.parse per file, shared by every pass:
+    ``(sources, trees)`` keyed by repo-relative path.  An unparseable
+    module stores its ``SyntaxError`` in ``trees`` (the determinism
+    pass surfaces it; the others skip)."""
+    sources: dict[str, list[str]] = {}
+    trees: dict = {}
+    for p in files:
+        path = rel(repo_root, p)
+        text = p.read_text()
+        sources[path] = text.splitlines()
+        try:
+            trees[path] = ast.parse(text)
+        except SyntaxError as e:
+            trees[path] = e
+    return sources, trees
+
+
+def tree_for(p: Path, path: str, trees: dict | None):
+    """Shared-parse lookup (``parse_package``); parses on demand when a
+    pass is driven directly without the shared cache.  Returns the AST,
+    or the ``SyntaxError`` for an unparseable module."""
+    if trees is not None and path in trees:
+        return trees[path]
+    try:
+        return ast.parse(p.read_text())
+    except SyntaxError as e:
+        return e
+
+
+def run_all(
+    repo_root: Path | str,
+    package: str = "k8s_gpu_tpu",
+    doc_path: Path | str | None = None,
+) -> list[Finding]:
+    """All three passes over one repo tree, sorted deterministically.
+    ``doc_path`` defaults to docs/platform/observability.md under the
+    root; a missing doc skips only the two doc-drift rules (fixture
+    trees without docs still exercise everything else)."""
+    from . import determinism, lockcheck, metrics_contract
+
+    repo_root = Path(repo_root)
+    files = package_files(repo_root, package)
+    sources, trees = parse_package(repo_root, files)
+    if doc_path is None:
+        doc_path = repo_root / "docs" / "platform" / "observability.md"
+    findings: list[Finding] = []
+    findings += determinism.check(repo_root, files, trees=trees)
+    findings += metrics_contract.check(
+        repo_root, files, Path(doc_path), trees=trees
+    )
+    findings += lockcheck.check(repo_root, files, trees=trees)
+    findings = apply_pragmas(findings, sources)
+    return sorted(findings)
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: Path | str | None) -> list[dict]:
+    """Baseline entries: ``[{"path", "rule", "detail"}, ...]``.  Missing
+    file = empty baseline (everything is a new finding)."""
+    if path is None:
+        return []
+    p = Path(path)
+    if not p.exists():
+        return []
+    data = json.loads(p.read_text())
+    return list(data.get("entries", []))
+
+
+def save_baseline(path: Path | str, findings: list[Finding]) -> None:
+    entries = sorted(
+        {(f.path, f.rule, f.detail) for f in findings}
+    )
+    Path(path).write_text(json.dumps({
+        "_comment": (
+            "graftcheck pinned debt. Entries match findings by "
+            "(path, rule, detail) — never line numbers. Entries that "
+            "stop matching are STALE and fail the check: this file "
+            "only shrinks. docs/platform/invariants.md explains each "
+            "rule; regenerate with python -m k8s_gpu_tpu.analysis "
+            "--write-baseline (and justify any growth in review)."
+        ),
+        "entries": [
+            {"path": p, "rule": r, "detail": d} for p, r, d in entries
+        ],
+    }, indent=2) + "\n")
+
+
+def run_report(
+    repo_root: Path | str,
+    baseline_path: Path | str | None = "auto",
+    package: str = "k8s_gpu_tpu",
+    doc_path: Path | str | None = None,
+) -> dict:
+    """Findings vs baseline: the shape ``__main__``, ``obs lint`` and
+    the self-check test all consume.
+
+    ``ok`` is True only when every finding is baselined AND every
+    baseline entry still matches something (stale entries fail — the
+    baseline may only shrink)."""
+    repo_root = Path(repo_root)
+    if baseline_path == "auto":
+        baseline_path = repo_root / "config" / "analysis_baseline.json"
+    findings = run_all(repo_root, package=package, doc_path=doc_path)
+    entries = load_baseline(baseline_path)
+    keys = {(e["path"], e["rule"], e["detail"]) for e in entries}
+    new = [f for f in findings if (f.path, f.rule, f.detail) not in keys]
+    matched = {
+        (f.path, f.rule, f.detail) for f in findings
+    } & keys
+    stale = sorted(k for k in keys if k not in matched)
+    return {
+        "findings": findings,
+        "new": new,
+        "suppressed": len(findings) - len(new),
+        "baseline_entries": len(entries),
+        "stale": stale,
+        "ok": not new and not stale,
+    }
+
+
+def format_report(report: dict) -> str:
+    """Deterministic text report — byte-identical for identical inputs
+    (no timestamps, no absolute paths)."""
+    lines = [f.render() for f in report["new"]]
+    for path, rule, detail in report["stale"]:
+        lines.append(
+            f"{path}:0 baseline-stale entry ({rule} {detail}) matches "
+            "no finding — remove it from config/analysis_baseline.json"
+        )
+    lines.append(
+        f"graftcheck: {len(report['new'])} new finding(s), "
+        f"{report['suppressed']} baselined, "
+        f"{len(report['stale'])} stale baseline entr(y/ies)"
+    )
+    lines.append("OK" if report["ok"] else "FAIL")
+    return "\n".join(lines) + "\n"
+
+
+def report_to_json(report: dict) -> str:
+    return json.dumps({
+        "new": [
+            {
+                "path": f.path, "line": f.line, "rule": f.rule,
+                "detail": f.detail, "message": f.message,
+            }
+            for f in report["new"]
+        ],
+        "suppressed": report["suppressed"],
+        "baseline_entries": report["baseline_entries"],
+        "stale": [
+            {"path": p, "rule": r, "detail": d}
+            for p, r, d in report["stale"]
+        ],
+        "ok": report["ok"],
+    }, indent=2, sort_keys=True) + "\n"

@@ -1,0 +1,56 @@
+"""``python -m k8s_gpu_tpu.analysis`` — run every graftcheck pass.
+
+Exit 0 iff every finding is baselined and no baseline entry is stale.
+``--write-baseline`` pins the CURRENT findings (use once to absorb
+pre-existing debt; growth needs review justification).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import format_report, report_to_json, run_all, run_report, save_baseline
+
+
+def _default_root() -> Path:
+    # <root>/k8s_gpu_tpu/analysis/__main__.py -> <root>
+    return Path(__file__).resolve().parents[2]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m k8s_gpu_tpu.analysis",
+        description="graftcheck: AST invariant linter "
+                    "(determinism / metrics contract / lock discipline)",
+    )
+    ap.add_argument("--root", type=Path, default=_default_root(),
+                    help="repo root (contains k8s_gpu_tpu/ and docs/)")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help="baseline file (default: "
+                         "<root>/config/analysis_baseline.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="pin every current finding into the baseline")
+    args = ap.parse_args(argv)
+
+    baseline = (
+        args.baseline if args.baseline is not None
+        else args.root / "config" / "analysis_baseline.json"
+    )
+    if args.write_baseline:
+        findings = run_all(args.root)
+        baseline.parent.mkdir(parents=True, exist_ok=True)
+        save_baseline(baseline, findings)
+        print(f"pinned {len(findings)} finding(s) into {baseline}")
+        return 0
+    report = run_report(args.root, baseline_path=baseline)
+    out = report_to_json(report) if args.json else format_report(report)
+    sys.stdout.write(out)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

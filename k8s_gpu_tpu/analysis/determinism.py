@@ -1,0 +1,236 @@
+"""Pass 1 — determinism: no ambient time, randomness, or set order in
+the replay-deterministic planes.
+
+The planes listed in ``DETERMINISTIC_PLANES`` are the modules whose
+behavior must be a pure function of (inputs, injected Clock): the alert
+FSM's two-run identical timelines, the router's bit-identical routing,
+the federation collector's bit-identical fleet registry, and the
+token/asset expiry paths that must be ``FakeClock``-testable.  Ambient
+wall time (``time.time``/``time.monotonic``/``datetime.now``) silently
+re-couples them to the host; unseeded ``random.*`` re-couples them to
+interpreter state; iterating a bare ``set`` re-couples them to hash
+randomization.  Each is flagged at the call/loop site:
+
+- ``det-wallclock``: route time through ``utils.clock.Clock`` —
+  ``clock.now()`` for durations/deadlines, ``clock.wall()`` for
+  display/expiry epochs.
+- ``det-datetime``: same, for the ``datetime`` spellings.
+- ``det-random``: seed it — ``random.Random(seed)`` is fine (the fault
+  injector's whole design), module-level ``random.random()`` etc. is
+  not.
+- ``det-set-iter``: iterate ``sorted(...)`` instead.  (Set *membership*
+  and set algebra are fine — only iteration order leaks.)
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from . import Finding, ScopeVisitor, rel, tree_for
+
+# Repo-root-relative path prefixes of the deterministic planes.  The
+# serve batcher is deliberately absent: it is the real-time plane (its
+# latency measurements ARE wall-clock); everything that must replay —
+# routing, journal identity, alert FSMs, federation, operators — is in.
+DETERMINISTIC_PLANES = (
+    "k8s_gpu_tpu/serve/router.py",
+    "k8s_gpu_tpu/serve/journal.py",
+    "k8s_gpu_tpu/utils/alerts.py",
+    "k8s_gpu_tpu/utils/federation.py",
+    "k8s_gpu_tpu/utils/metrics.py",
+    "k8s_gpu_tpu/utils/tracing.py",
+    "k8s_gpu_tpu/operators/",
+    "k8s_gpu_tpu/controller/",
+    "k8s_gpu_tpu/cloud/resilience.py",
+    # The expiry planes: token/code TTLs and asset/image timestamps
+    # must be FakeClock-testable (ISSUE 8 satellite).
+    "k8s_gpu_tpu/platform/assets.py",
+    "k8s_gpu_tpu/platform/registry.py",
+    "k8s_gpu_tpu/platform/apiserver.py",
+    "k8s_gpu_tpu/auth/oidc.py",
+)
+
+_WALLCLOCK_ATTRS = {"time", "monotonic"}
+_DATETIME_ATTRS = {"now", "utcnow", "today"}
+# random.Random(seed)/SystemRandom()/seed() are the sanctioned forms;
+# everything else on the module is ambient-state randomness.
+_RANDOM_OK = {"Random", "SystemRandom", "seed"}
+
+
+def in_planes(path: str, planes=DETERMINISTIC_PLANES) -> bool:
+    return any(
+        path == p or (p.endswith("/") and path.startswith(p))
+        for p in planes
+    )
+
+
+class _DeterminismVisitor(ScopeVisitor):
+    def __init__(self, path: str, tree: ast.AST):
+        super().__init__(path)
+        # Names bound by `from time import time` etc., so the bare-name
+        # call forms are caught too.  from_random maps alias -> original
+        # name, so `from random import Random` keeps its seeded-form
+        # sanction under any local name.
+        self.from_time: set[str] = set()
+        self.from_datetime: set[str] = set()
+        self.from_random: dict[str, str] = {}
+        self.time_aliases = {"time"}
+        self.datetime_aliases = {"datetime"}
+        self.random_aliases = {"random"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name
+                    if a.name == "time":
+                        self.time_aliases.add(alias)
+                    elif a.name == "datetime":
+                        self.datetime_aliases.add(alias)
+                    elif a.name == "random":
+                        self.random_aliases.add(alias)
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    alias = a.asname or a.name
+                    if node.module == "time" and a.name in _WALLCLOCK_ATTRS:
+                        self.from_time.add(alias)
+                    elif node.module == "datetime" and a.name in (
+                        "datetime", "date"
+                    ):
+                        self.from_datetime.add(alias)
+                    elif node.module == "random":
+                        self.from_random[alias] = a.name
+
+    # -- calls ---------------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            base, attr = f.value.id, f.attr
+            if base in self.time_aliases and attr in _WALLCLOCK_ATTRS:
+                self.add(
+                    node, "det-wallclock", f"time.{attr}",
+                    f"time.{attr}() in a deterministic plane — inject "
+                    "utils.clock.Clock (clock.now() for durations, "
+                    "clock.wall() for epoch timestamps)",
+                )
+            elif attr in _DATETIME_ATTRS and (
+                base in self.datetime_aliases or base in self.from_datetime
+            ):
+                self.add(
+                    node, "det-datetime", f"datetime.{attr}",
+                    f"datetime.{attr}() in a deterministic plane — "
+                    "inject utils.clock.Clock instead",
+                )
+            elif base in self.random_aliases and attr not in _RANDOM_OK:
+                self.add(
+                    node, "det-random", f"random.{attr}",
+                    f"unseeded random.{attr}() in a deterministic plane "
+                    "— draw from a random.Random(seed) instance",
+                )
+            elif (
+                base in self.random_aliases and attr == "Random"
+                and not node.args and not node.keywords
+            ):
+                self.add(
+                    node, "det-random", "random.Random()",
+                    "random.Random() without a seed in a deterministic "
+                    "plane — pass an explicit seed",
+                )
+        elif isinstance(f, ast.Name):
+            if f.id in self.from_time:
+                self.add(
+                    node, "det-wallclock", f"time.{f.id}",
+                    f"{f.id}() (from time) in a deterministic plane — "
+                    "inject utils.clock.Clock",
+                )
+            elif f.id in self.from_random:
+                orig = self.from_random[f.id]
+                if orig not in _RANDOM_OK:
+                    self.add(
+                        node, "det-random", f"random.{orig}",
+                        f"{f.id}() (random.{orig}) in a deterministic "
+                        "plane — draw from a random.Random(seed) "
+                        "instance",
+                    )
+                elif orig == "Random" and not node.args and not node.keywords:
+                    self.add(
+                        node, "det-random", "random.Random()",
+                        "random.Random() without a seed in a "
+                        "deterministic plane — pass an explicit seed",
+                    )
+        # datetime.datetime.now() spelled fully qualified
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _DATETIME_ATTRS
+            and isinstance(f.value, ast.Attribute)
+            and isinstance(f.value.value, ast.Name)
+            and f.value.value.id in self.datetime_aliases
+            and f.value.attr in ("datetime", "date")
+        ):
+            self.add(
+                node, "det-datetime", f"datetime.{f.attr}",
+                f"datetime.{f.attr}() in a deterministic plane — "
+                "inject utils.clock.Clock instead",
+            )
+        self.generic_visit(node)
+
+    # -- set iteration -------------------------------------------------------
+    def _check_iter(self, node, iter_node) -> None:
+        bad = None
+        if isinstance(iter_node, ast.Set):
+            bad = "a set literal"
+        elif (
+            isinstance(iter_node, ast.Call)
+            and isinstance(iter_node.func, ast.Name)
+            and iter_node.func.id in ("set", "frozenset")
+        ):
+            bad = f"{iter_node.func.id}(...)"
+        elif isinstance(iter_node, ast.SetComp):
+            bad = "a set comprehension"
+        if bad is not None:
+            self.add(
+                node, "det-set-iter", "set-iteration",
+                f"iterating {bad} in a deterministic plane — set order "
+                "is hash-randomized; wrap in sorted(...)",
+            )
+
+    def visit_For(self, node: ast.For):
+        self._check_iter(node, node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension_iters(self, node):
+        for gen in node.generators:
+            self._check_iter(node, gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = visit_comprehension_iters
+    visit_DictComp = visit_comprehension_iters
+    visit_GeneratorExp = visit_comprehension_iters
+
+    def visit_SetComp(self, node: ast.SetComp):
+        # A set comprehension's OUTPUT being a set is fine (building
+        # sets is encouraged); only its input iteration is checked.
+        for gen in node.generators:
+            self._check_iter(node, gen.iter)
+        self.generic_visit(node)
+
+
+def check(repo_root: Path, files: list[Path],
+          planes=DETERMINISTIC_PLANES, trees: dict | None = None
+          ) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in files:
+        path = rel(repo_root, p)
+        if not in_planes(path, planes):
+            continue
+        tree = tree_for(p, path, trees)
+        if isinstance(tree, SyntaxError):
+            findings.append(Finding(
+                path=path, line=tree.lineno or 0, rule="det-wallclock",
+                detail="syntax-error",
+                message=f"unparseable module: {tree.msg}",
+            ))
+            continue
+        v = _DeterminismVisitor(path, tree)
+        v.visit(tree)
+        findings += v.findings
+    return findings

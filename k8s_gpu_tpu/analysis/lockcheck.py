@@ -1,0 +1,278 @@
+"""Pass 3 — lock discipline: guarded fields are touched under their lock.
+
+Every shared-state race fixed in PRs 4-7 had the same shape: a class
+owns a ``threading.Lock``, most accesses to some field take it, and one
+path doesn't (``Histogram.percentile`` sorting a live deque, the
+workqueue gauge scan, the federation failure counters).  This pass
+makes the contract checkable:
+
+- **declared contract** (preferred): a class carries
+
+  .. code-block:: python
+
+      _GUARDED_BY = {"_lock": ("_chains", "_chain_counts"), ...}
+
+  mapping each lock attribute to the fields it guards.  Every read or
+  write of a declared field must happen inside ``with self.<lock>:``
+  (any of the field's declared locks), in ``__init__`` (construction),
+  or in a helper the caller locks for — marked by a ``_locked`` name
+  suffix or a docstring containing "lock held" / "caller holds".  The
+  SAME declaration drives the runtime half
+  (``utils.faults.guard_declared``), so the static and dynamic
+  checkers enforce one contract by construction.
+
+- **inference** (undeclared classes): a field written under
+  ``with self.<lock>:`` is a guard candidate; it is treated as guarded
+  when the majority of its access sites are lock-held (counting
+  exempt-method accesses as held).  The majority filter keeps
+  single-owner-thread state that a shutdown path happens to touch
+  under an unrelated lock (the batcher's overflow deque) from
+  poisoning the whole class with false positives.
+
+Findings: ``lock-guard`` at each unlocked access of a guarded field.
+``guarded_fields_for(cls)`` is the tiny runtime mirror the stress test
+uses.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from . import Finding, rel, tree_for
+
+_MUTATORS = {
+    "append", "appendleft", "extend", "add", "discard", "remove",
+    "pop", "popitem", "popleft", "clear", "update", "setdefault",
+    "move_to_end", "insert", "sort",
+}
+
+_HELD_MARKERS = ("lock held", "caller holds", "held by caller",
+                 "holds the lock", "holds this lock")
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in ("Lock", "RLock"):
+        return True
+    if isinstance(f, ast.Name) and f.id in ("Lock", "RLock"):
+        return True
+    return False
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _method_exempt(fn: ast.FunctionDef) -> bool:
+    if fn.name == "__init__" or fn.name.endswith("_locked"):
+        return True
+    doc = ast.get_docstring(fn) or ""
+    low = doc.lower()
+    return any(m in low for m in _HELD_MARKERS)
+
+
+def _declared_guards(cls: ast.ClassDef) -> dict[str, tuple[str, ...]] | None:
+    """The class-body ``_GUARDED_BY`` literal, if present."""
+    for stmt in cls.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and stmt.targets[0].id == "_GUARDED_BY"
+            and isinstance(stmt.value, ast.Dict)
+        ):
+            out: dict[str, tuple[str, ...]] = {}
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                    continue
+                fields = []
+                if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                    for e in v.elts:
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                            fields.append(e.value)
+                elif isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    fields.append(v.value)
+                out[k.value] = tuple(fields)
+            return out
+    return None
+
+
+class _Access:
+    __slots__ = ("field", "line", "write", "held", "method", "exempt")
+
+    def __init__(self, field, line, write, held, method, exempt):
+        self.field = field
+        self.line = line
+        self.write = write
+        self.held = held          # frozenset of lock attrs held here
+        self.method = method
+        self.exempt = exempt
+
+
+def _collect_accesses(
+    cls: ast.ClassDef, locks: set[str]
+) -> list[_Access]:
+    accesses: list[_Access] = []
+
+    def walk(node, held: frozenset, method: str, exempt: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            # Nested callables may run on another thread later (the
+            # batcher's lambdas, handler closures) and nested classes
+            # have their own self — both are out of this scope.
+            return
+        if isinstance(node, ast.With):
+            entered = set()
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr in locks:
+                    entered.add(attr)
+                else:
+                    walk(item.context_expr, held, method, exempt)
+            inner = held | frozenset(entered)
+            for stmt in node.body:
+                walk(stmt, inner, method, exempt)
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr not in locks:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            accesses.append(_Access(
+                attr, node.lineno, write, held, method, exempt
+            ))
+            return  # self.<attr> has no interesting children
+        # Container writes: self.F[...] = / del self.F[...] and
+        # self.F.append(...)-style mutator calls read the attribute in
+        # Load ctx — upgrade them to writes here, where the parent
+        # shape is visible.
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            tgt = _self_attr(node.value)
+            if tgt is not None and tgt not in locks:
+                accesses.append(_Access(
+                    tgt, node.lineno, True, held, method, exempt
+                ))
+                walk(node.slice, held, method, exempt)
+                return
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr in _MUTATORS:
+            tgt = _self_attr(node.func.value)
+            if tgt is not None and tgt not in locks:
+                accesses.append(_Access(
+                    tgt, node.lineno, True, held, method, exempt
+                ))
+                for a in node.args:
+                    walk(a, held, method, exempt)
+                for k in node.keywords:
+                    walk(k.value, held, method, exempt)
+                return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held, method, exempt)
+
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            exempt = _method_exempt(stmt)
+            for sub in stmt.body:
+                walk(sub, frozenset(), stmt.name, exempt)
+    return accesses
+
+
+def _class_locks(cls: ast.ClassDef) -> set[str]:
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    locks.add(attr)
+    return locks
+
+
+def analyze_class(cls: ast.ClassDef) -> list[tuple[_Access, str]]:
+    """(access, lock-name) pairs that violate the class's guard
+    contract — the core both ``check`` and the fixture tests drive."""
+    declared = _declared_guards(cls)
+    # Declared lock attrs count as locks even when their constructor
+    # isn't a literal threading.Lock()/RLock() call (a factory, a
+    # `lock or Lock()` default): a declared contract must never
+    # silently decay into an unchecked one because the assignment
+    # shape changed.  A typo'd lock name in _GUARDED_BY fails loud —
+    # no with-block ever matches it, so every access is flagged.
+    locks = _class_locks(cls) | set(declared or ())
+    if not locks:
+        return []
+    accesses = _collect_accesses(cls, locks)
+    guards: dict[str, frozenset[str]] = {}
+    if declared is not None:
+        for lock, fields in declared.items():
+            for f in fields:
+                guards[f] = guards.get(f, frozenset()) | {lock}
+    else:
+        # Inference: fields written under a lock, majority lock-held.
+        candidates: dict[str, set[str]] = {}
+        for a in accesses:
+            if a.write and a.held:
+                for lk in a.held:
+                    candidates.setdefault(a.field, set()).add(lk)
+        for field, lks in candidates.items():
+            sites = [a for a in accesses if a.field == field]
+            held_n = sum(
+                1 for a in sites
+                if a.exempt or (a.held & lks)
+            )
+            if held_n > len(sites) - held_n:
+                guards[field] = frozenset(lks)
+    violations: list[tuple[_Access, str]] = []
+    for a in accesses:
+        lks = guards.get(a.field)
+        if lks is None or a.exempt:
+            continue
+        if not (a.held & lks):
+            violations.append((a, sorted(lks)[0]))
+    return violations
+
+
+def check(repo_root: Path, files: list[Path],
+          trees: dict | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in files:
+        path = rel(repo_root, p)
+        tree = tree_for(p, path, trees)
+        if isinstance(tree, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for a, lock in analyze_class(node):
+                rw = "write" if a.write else "read"
+                findings.append(Finding(
+                    path=path, line=a.line, rule="lock-guard",
+                    detail=(
+                        f"{node.name}.{a.field} {rw} in {a.method}"
+                    ),
+                    message=(
+                        f"self.{a.field} {rw} outside `with "
+                        f"self.{lock}:` in {node.name}.{a.method} — "
+                        "guarded field (see the class's _GUARDED_BY / "
+                        "inferred guard set)"
+                    ),
+                ))
+    return findings
+
+
+def guarded_fields_for(cls: type) -> dict[str, tuple[str, ...]]:
+    """The runtime mirror: a class's declared guard map (empty when the
+    class declares none).  ``utils.faults.guard_declared`` instruments
+    exactly this, so the stress test and the static pass enforce one
+    contract."""
+    return dict(getattr(cls, "_GUARDED_BY", {}) or {})

@@ -1,0 +1,349 @@
+"""Pass 2 — the metrics contract: every mint site across the package,
+checked against the registry's rules and the observability doc.
+
+The registry (``utils/metrics.py``) has rules that nothing enforced:
+``name`` is reserved by the kwargs API (PR 4 hit this — the workqueue
+label had to become ``queue=``), ``replica`` belongs to the fleet plane
+(the federation collector relabels every scraped series with it; a
+per-replica component minting its own ``replica=`` would collide on
+federation), one metric name must keep one label-key set (two shapes
+under one name make ``ctx.rate``/``series`` sum across apples and
+oranges), and counters/gauges are different types with different
+suffixes (a gauge named ``_total`` would be rate()'d by the rules
+engine).  ``docs/platform/observability.md`` is the operator contract:
+a minted-but-undocumented family is invisible ops surface, a
+documented-but-unminted family is a dashboard reading zeros forever.
+
+Mint sites collected:
+
+- ``.inc("name", ...)`` / ``.set_gauge("name", ...)`` /
+  ``.observe("name", ...)`` / ``.set_gauge_series("name", ...)`` /
+  ``.remove_gauge("name", ...)`` with a literal metric name;
+- ``RecordingRule("name", ...)`` — recorded series are minted by the
+  rules engine at evaluation time;
+- the registry's own internal ``self._counters[("name", ...)] += ...``
+  (how ``metrics_series_dropped_total`` is minted).
+
+Dynamic names (f-strings, variables) are invisible to this pass by
+design — the convention is that every *family* name appears literally
+somewhere, which is also what keeps the doc greppable.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+from . import Finding, ScopeVisitor, rel, tree_for
+
+_MINT_ATTRS = {
+    "inc": "counter",
+    "set_gauge": "gauge",
+    "set_gauge_series": "gauge",
+    "remove_gauge": "gauge-remove",
+    "observe": "histogram",
+}
+
+# Modules allowed to mint the ``replica=`` label: the fleet plane —
+# federation writes it by relabeling, the fleet router is front-end
+# state (chains per replica), never scraped per-replica.
+FLEET_PLANE = (
+    "k8s_gpu_tpu/utils/federation.py",
+    "k8s_gpu_tpu/serve/router.py",
+)
+
+RESERVED_LABELS = ("name", "replica")
+
+_METRIC_NAME = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# What counts as a metric token when scanning the doc (doc→code drift).
+_DOC_SUFFIXES = (
+    "_total", "_seconds", "_ratio", "_count", "_sum", "_bucket",
+    "_rate", "_replicas", "_bytes", "_up", "_p95", "_per_second",
+    "_per_replica",
+)
+_DOC_PREFIXES = (
+    "serve_", "fleet_", "pool_", "workqueue_", "train_", "trainjob_",
+    "tracing_", "circuit_breaker_", "cloud_", "http_", "alerts_",
+    "alert_", "faults_", "reconcile_", "metrics_", "tenant_",
+    "autoscale_", "inferenceservice_", "gc_",
+)
+_BACKTICK = re.compile(r"`([^`]+)`")
+
+
+@dataclass
+class MintSite:
+    path: str
+    line: int
+    name: str
+    kind: str            # counter | gauge | gauge-remove | histogram | recorded
+    labels: tuple | None  # sorted label-key tuple; None = data-driven dict
+    where: str
+
+
+class _MintVisitor(ScopeVisitor):
+    def __init__(self, path: str):
+        super().__init__(path)
+        self.sites: list[MintSite] = []
+
+    @staticmethod
+    def _literal_name(node: ast.Call) -> str | None:
+        if node.args and isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            name = node.args[0].value
+            if _METRIC_NAME.match(name) and "_" in name:
+                return name
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MINT_ATTRS:
+            name = self._literal_name(node)
+            if name is not None:
+                kind = _MINT_ATTRS[f.attr]
+                if f.attr == "set_gauge_series":
+                    labels = None  # labels ride as a data dict
+                else:
+                    labels = tuple(sorted(
+                        k.arg for k in node.keywords
+                        if k.arg is not None and k.arg != "value"
+                    ))
+                self.sites.append(MintSite(
+                    self.path, node.lineno, name, kind, labels, self.where
+                ))
+        elif isinstance(f, ast.Name) and f.id == "RecordingRule":
+            name = self._literal_name(node)
+            if name is not None:
+                self.sites.append(MintSite(
+                    self.path, node.lineno, name, "recorded", None,
+                    self.where,
+                ))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        # self._counters[("name", ...)] += v — the registry's internal
+        # mint form (metrics_series_dropped_total).
+        t = node.target
+        if (
+            isinstance(t, ast.Subscript)
+            and isinstance(t.value, ast.Attribute)
+            and t.value.attr in ("_counters", "_gauges")
+            and isinstance(t.slice, ast.Tuple)
+            and t.slice.elts
+            and isinstance(t.slice.elts[0], ast.Constant)
+            and isinstance(t.slice.elts[0].value, str)
+        ):
+            name = t.slice.elts[0].value
+            if _METRIC_NAME.match(name) and "_" in name:
+                kind = (
+                    "counter" if t.value.attr == "_counters" else "gauge"
+                )
+                self.sites.append(MintSite(
+                    self.path, node.lineno, name, kind, None, self.where
+                ))
+        self.generic_visit(node)
+
+
+def collect_mints(repo_root: Path, files: list[Path],
+                  trees: dict | None = None) -> list[MintSite]:
+    sites: list[MintSite] = []
+    for p in files:
+        path = rel(repo_root, p)
+        tree = tree_for(p, path, trees)
+        if isinstance(tree, SyntaxError):
+            continue
+        v = _MintVisitor(path)
+        v.visit(tree)
+        sites += v.sites
+    return sites
+
+
+def doc_metric_tokens(doc_path: Path) -> list[tuple[str, int]]:
+    """Metric names the doc commits to, with their line numbers.
+    Extraction is deliberately conservative: backticked tokens only,
+    label blocks stripped, a recognized metric suffix or family prefix
+    required, wildcards skipped."""
+    tokens: list[tuple[str, int]] = []
+    if not doc_path.exists():
+        return tokens
+    for lineno, line in enumerate(doc_path.read_text().splitlines(), 1):
+        for span in _BACKTICK.findall(line):
+            for piece in re.split(r"[\s/|]+", span):
+                piece = re.sub(r"\{.*$", "", piece).strip()
+                if not piece or "*" in piece:
+                    continue
+                if not _METRIC_NAME.match(piece):
+                    continue
+                if not (
+                    piece.endswith(_DOC_SUFFIXES)
+                    or piece.startswith(_DOC_PREFIXES)
+                ):
+                    continue
+                tokens.append((piece, lineno))
+    return tokens
+
+
+def _base_family(name: str) -> str:
+    """``_bucket``/``_sum``/``_count`` series belong to their histogram
+    family — documenting ``serve_ttft_seconds_bucket`` is covered by the
+    ``serve_ttft_seconds`` mint."""
+    for suf in ("_bucket", "_sum", "_count"):
+        if name.endswith(suf):
+            return name[: -len(suf)]
+    return name
+
+
+def check(repo_root: Path, files: list[Path], doc_path: Path,
+          trees: dict | None = None) -> list[Finding]:
+    sites = collect_mints(repo_root, files, trees=trees)
+    findings: list[Finding] = []
+    by_name: dict[str, list[MintSite]] = {}
+    for s in sites:
+        by_name.setdefault(s.name, []).append(s)
+
+    # -- reserved labels -----------------------------------------------------
+    for s in sites:
+        if s.labels is None:
+            continue
+        for lab in s.labels:
+            if lab == "name" or (
+                lab == "replica" and s.path not in FLEET_PLANE
+            ):
+                scope_note = (
+                    "reserved by the registry kwargs API"
+                    if lab == "name" else
+                    "reserved for the fleet plane (federation relabels "
+                    "every scraped series with it)"
+                )
+                findings.append(Finding(
+                    path=s.path, line=s.line, rule="met-reserved-label",
+                    detail=f"{s.name}{{{lab}=}} in {s.where}",
+                    message=(
+                        f"metric {s.name} minted with reserved label "
+                        f"{lab!r} — {scope_note}"
+                    ),
+                ))
+
+    # -- label-set consistency ----------------------------------------------
+    for name, ss in sorted(by_name.items()):
+        keysets = sorted({
+            s.labels for s in ss
+            if s.labels is not None and s.labels != ()
+        })
+        if len(keysets) > 1:
+            # The canonical set is the most-used one (ties: smallest);
+            # every site using another shape is a finding.  The empty
+            # label-set may coexist (the unlabeled-aggregate contract
+            # serve_ttft_seconds documents).
+            counts = {
+                ks: sum(1 for s in ss if s.labels == ks)
+                for ks in keysets
+            }
+            canonical = sorted(
+                keysets, key=lambda ks: (-counts[ks], ks)
+            )[0]
+            for s in ss:
+                if s.labels in (None, (), canonical):
+                    continue
+                findings.append(Finding(
+                    path=s.path, line=s.line, rule="met-label-mismatch",
+                    detail=(
+                        f"{name}{{{','.join(s.labels)}}} in {s.where}"
+                    ),
+                    message=(
+                        f"metric {name} minted with label set "
+                        f"{{{','.join(s.labels)}}} but "
+                        f"{{{','.join(canonical)}}} elsewhere — one "
+                        "family, one label-key set"
+                    ),
+                ))
+
+    # -- kind conflicts + suffix discipline ----------------------------------
+    for name, ss in sorted(by_name.items()):
+        kinds = {
+            s.kind for s in ss if s.kind not in ("gauge-remove", "recorded")
+        }
+        if "counter" in kinds and (kinds & {"gauge", "histogram"}):
+            s0 = min(ss, key=lambda s: (s.path, s.line))
+            findings.append(Finding(
+                path=s0.path, line=s0.line, rule="met-kind-conflict",
+                detail=f"{name} kinds {'+'.join(sorted(kinds))}",
+                message=(
+                    f"metric {name} is minted as "
+                    f"{' and '.join(sorted(kinds))} — counters are "
+                    "never set, gauges are never inc'd"
+                ),
+            ))
+        if "gauge-remove" in {s.kind for s in ss} and kinds == {"counter"}:
+            s0 = min(
+                (s for s in ss if s.kind == "gauge-remove"),
+                key=lambda s: (s.path, s.line),
+            )
+            findings.append(Finding(
+                path=s0.path, line=s0.line, rule="met-kind-conflict",
+                detail=f"{name} remove_gauge-on-counter",
+                message=(
+                    f"remove_gauge on {name}, which is minted as a "
+                    "counter — counters are append-only"
+                ),
+            ))
+        for s in ss:
+            if s.kind == "counter" and not name.endswith("_total"):
+                findings.append(Finding(
+                    path=s.path, line=s.line, rule="met-counter-suffix",
+                    detail=f"{name} counter-sans-_total in {s.where}",
+                    message=(
+                        f"counter {name} must end in _total (the rules "
+                        "engine treats the suffix as rate-able)"
+                    ),
+                ))
+            elif s.kind in ("gauge", "recorded") and name.endswith("_total"):
+                findings.append(Finding(
+                    path=s.path, line=s.line, rule="met-counter-suffix",
+                    detail=f"{name} gauge-with-_total in {s.where}",
+                    message=(
+                        f"gauge {name} must not end in _total — "
+                        "_total promises monotone counter semantics"
+                    ),
+                ))
+
+    # -- two-way doc drift ---------------------------------------------------
+    doc_text = doc_path.read_text() if doc_path.exists() else None
+    if doc_text is not None:
+        doc_rel = doc_path.name if repo_root not in doc_path.parents else \
+            rel(repo_root, doc_path)
+        minted = {s.name for s in sites}
+        word = {
+            name: re.search(rf"\b{re.escape(name)}\b", doc_text)
+            for name in minted
+        }
+        for name, ss in sorted(by_name.items()):
+            if word[name] is None:
+                s0 = min(ss, key=lambda s: (s.path, s.line))
+                findings.append(Finding(
+                    path=s0.path, line=s0.line, rule="met-undocumented",
+                    detail=f"{name} undocumented",
+                    message=(
+                        f"metric {name} is minted but absent from "
+                        f"{doc_rel} — add it to the metric tables"
+                    ),
+                ))
+        minted_families = {_base_family(n) for n in minted} | minted
+        seen_doc: set[str] = set()
+        for token, lineno in doc_metric_tokens(doc_path):
+            fam = _base_family(token)
+            if fam in minted_families or token in seen_doc:
+                continue
+            seen_doc.add(token)
+            findings.append(Finding(
+                path=doc_rel, line=lineno, rule="met-doc-stale",
+                detail=f"{token} documented-not-minted",
+                message=(
+                    f"documented metric {token} is minted nowhere in "
+                    "the package — stale doc row or a missing "
+                    "instrumentation site"
+                ),
+            ))
+    return findings

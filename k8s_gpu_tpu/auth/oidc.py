@@ -18,10 +18,10 @@ import hmac
 import json
 import os
 import secrets
-import time
 from dataclasses import dataclass, field
 from hashlib import sha256
 
+from ..utils.clock import Clock, RealClock
 from .directory import AuthError, User, UserDirectory
 
 DEFAULT_TTL = 8 * 3600.0  # seconds; a working-day session
@@ -52,6 +52,11 @@ class TokenIssuer:
     issuer: str = "tpu-platform"
     clients: set[str] = field(default_factory=lambda: {"tpu-portal", "tpu-cli"})
     _codes: dict[str, PendingCode] = field(default_factory=dict)
+    # Injected time source: code/token expiry reads ``clock.wall()``
+    # (epoch domain — ``exp``/``iat`` claims stay JWT-conventional), so
+    # a FakeClock test can expire a token by advancing fake time
+    # instead of sleeping through a TTL.
+    clock: Clock = field(default_factory=RealClock)
 
     # -- auth-code flow ----------------------------------------------------
     def authorize(self, username: str, password: str, client_id: str) -> str:
@@ -61,11 +66,11 @@ class TokenIssuer:
             raise AuthError(f"unknown client {client_id!r}")
         self.directory.authenticate(username, password)
         # Purge abandoned codes so the dict is bounded by the flow rate.
-        now = time.time()
+        now = self.clock.wall()
         for stale in [c for c, p in self._codes.items() if now > p.expires]:
             del self._codes[stale]
         code = secrets.token_urlsafe(24)
-        self._codes[code] = PendingCode(username, client_id, time.time() + CODE_TTL)
+        self._codes[code] = PendingCode(username, client_id, now + CODE_TTL)
         return code
 
     def exchange_code(self, code: str, client_id: str) -> str:
@@ -73,13 +78,13 @@ class TokenIssuer:
         pending = self._codes.pop(code, None)
         if pending is None or pending.client_id != client_id:
             raise AuthError("invalid authorization code")
-        if time.time() > pending.expires:
+        if self.clock.wall() > pending.expires:
             raise AuthError("authorization code expired")
         return self.issue(self.directory.get(pending.username), client_id)
 
     # -- tokens ------------------------------------------------------------
     def issue(self, user: User, client_id: str, ttl: float = DEFAULT_TTL) -> str:
-        now = time.time()
+        now = self.clock.wall()
         header = {"alg": "HS256", "typ": "JWT"}
         payload = {
             "iss": self.issuer,
@@ -113,7 +118,7 @@ class TokenIssuer:
             raise AuthError(f"malformed token: {e}") from e
         if payload.get("iss") != self.issuer:
             raise AuthError("wrong issuer")
-        if time.time() > float(payload.get("exp", 0)):
+        if self.clock.wall() > float(payload.get("exp", 0)):
             raise AuthError("token expired")
         if audience is not None and payload.get("aud") != audience:
             raise AuthError(

@@ -805,6 +805,33 @@ def cmd_obs(args) -> int:
 
     from .platform_local import state_dir
 
+    if args.obs_cmd == "lint":
+        # Static analysis over the working tree: no platform state, no
+        # login — the same passes `make check` and the tier-1
+        # self-check run (docs/platform/invariants.md).
+        from pathlib import Path
+
+        from ..analysis import report_to_json, run_report
+        from ..utils.obs import render_lint
+
+        root = Path(args.root) if args.root else Path(__file__).resolve().parents[2]
+        if not (root / "k8s_gpu_tpu").is_dir():
+            print(f"obs lint: no k8s_gpu_tpu package under {root} — "
+                  "pass --root <repo checkout>", file=sys.stderr)
+            return 2
+        baseline = root / "config" / "analysis_baseline.json"
+        if not baseline.exists():
+            # An installed tree ships no baseline/config; without it the
+            # pinned debt reads as new findings, which would be a lie.
+            print(f"obs lint: no baseline at {baseline}; findings are "
+                  "reported unsuppressed (run from a repo checkout or "
+                  "pass --root)", file=sys.stderr)
+        report = run_report(root)
+        if args.json:
+            print(report_to_json(report), end="")
+        else:
+            print(render_lint(report))
+        return 0 if report["ok"] else 1
     _require_login(CliConfig.load())
     if args.obs_cmd == "logs":
         logfile = state_dir() / "logs.jsonl"
@@ -1509,6 +1536,16 @@ def build_parser() -> argparse.ArgumentParser:
     p_orte.add_argument("--page-size", type=int, default=64,
                         help="paged-KV page size the replicas run "
                              "(chain hashes must chunk identically)")
+    p_olint = obs_sub.add_parser(
+        "lint",
+        help="graftcheck: AST invariant linter over the working tree "
+             "(determinism planes, metrics contract, lock discipline) "
+             "against config/analysis_baseline.json",
+    )
+    p_olint.add_argument("--json", action="store_true",
+                         help="machine-readable report")
+    p_olint.add_argument("--root", default="",
+                         help="repo root (default: the installed tree)")
     p_ot = obs_sub.add_parser(
         "traces", help="render recorded spans as flame-style trees"
     )

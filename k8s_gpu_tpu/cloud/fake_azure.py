@@ -70,6 +70,8 @@ class FakeAzureCloud:
         self._lock = threading.RLock()
 
     def _settle(self) -> None:
+        """Advance provisioning states.  Lock held by caller (every
+        verb settles under ``self._lock`` before answering)."""
         now = self.clock.now()
         for vm in self.vms.values():
             if (

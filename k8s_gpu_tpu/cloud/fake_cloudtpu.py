@@ -72,6 +72,8 @@ class FakeCloudTpu:
 
     # -- lifecycle ---------------------------------------------------------
     def _settle(self) -> None:
+        """Advance queued-resource states.  Lock held by caller (every
+        verb settles under ``self._lock`` before answering)."""
         now = self.clock.now()
         for qr in self.queued_resources.values():
             if qr.state in ("FAILED", "SUSPENDED", "ACTIVE", "DELETING"):

@@ -57,6 +57,8 @@ class FakeKube:
         return (kind, namespace, name)
 
     def _next_rv(self) -> int:
+        """Monotone resourceVersion.  Lock held by caller (every
+        store-mutating verb)."""
         self._rv += 1
         return self._rv
 
@@ -173,6 +175,8 @@ class FakeKube:
             self._notify("DELETED", cur)
 
     def _maybe_finalize_delete(self, k: tuple[str, str, str]) -> None:
+        """Complete a finalizer-deferred delete.  Lock held by caller
+        (``update``/``patch_status``)."""
         cur = self._store.get(k)
         if (
             cur is not None

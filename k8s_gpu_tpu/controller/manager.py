@@ -173,7 +173,7 @@ class Manager:
                 # assembles consistently with every other span — mixing
                 # domains made trace durations nonsense under FakeClock.
                 wait_s = max(0.0, self.clock.now() - entry[1])
-                now = time.monotonic()
+                now = time.monotonic()  # graftcheck: ignore[det-wallclock]
                 global_tracer.add_span(
                     "queue.wait", parent=parent,
                     start=now - wait_s, end=now,
@@ -243,8 +243,8 @@ class Manager:
         processing and nothing scheduled within *min_future_delay* clock
         seconds — i.e. only periodic resyncs remain.  Optionally also until
         *predicate()* is true.  Returns False on timeout."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = time.monotonic() + timeout  # graftcheck: ignore[det-wallclock]
+        while time.monotonic() < deadline:  # graftcheck: ignore[det-wallclock]
             quiet = all(
                 c.queue.idle_no_backlog()
                 and (

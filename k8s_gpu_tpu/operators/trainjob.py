@@ -379,7 +379,7 @@ class TrainJobReconciler(Reconciler):
             checkpoint_interval=job.spec.checkpoint_interval_steps,
             placements=placements,
             node_uids={
-                n: uid for n in set(placements.values())
+                n: uid for n in sorted(set(placements.values()))
                 if (uid := node_uid(n)) is not None
             },
             _node_uid=node_uid,

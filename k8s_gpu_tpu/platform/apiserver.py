@@ -35,7 +35,6 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
@@ -44,6 +43,7 @@ from ..api.schema import all_schemas, schema_for_kind
 from ..api.serialize import from_manifest, known_kinds, to_manifest
 from ..api.types import ValidationError
 from ..controller.kubefake import Conflict
+from ..utils.clock import Clock, RealClock
 from ..utils.obs import RequestMetricsMixin
 from .assets import AssetStore
 
@@ -163,6 +163,7 @@ class PlatformApiServer:
         verify_token: Callable[[str], object] | None = None,
         max_upload: int = MAX_UPLOAD,
         kube=None,
+        clock: Clock | None = None,
     ):
         """``kube``: a controller.kubefake.FakeKube — attaching one turns
         on the web-console routes (dashboard + object browser)."""
@@ -171,7 +172,10 @@ class PlatformApiServer:
         self.verify_token = verify_token
         self.max_upload = max_upload
         self.kube = kube
-        self.started_at = time.time()
+        # Uptime reads the injected clock (epoch domain) so /healthz is
+        # FakeClock-testable like every other deterministic surface.
+        self.clock = clock or RealClock()
+        self.started_at = self.clock.wall()
         outer = self
 
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
@@ -207,7 +211,7 @@ class PlatformApiServer:
                 u = urlparse(self.path)
                 if u.path == "/healthz":
                     return self._json(200, {
-                        "ok": True, "uptime_s": time.time() - outer.started_at,
+                        "ok": True, "uptime_s": outer.clock.wall() - outer.started_at,
                     })
                 if u.path in ("/", "/ui") and outer.kube is not None:
                     body = _CONSOLE_HTML.encode()

@@ -14,9 +14,10 @@ import hashlib
 import json
 import re
 import shutil
-import time
 from dataclasses import dataclass, field
 from pathlib import Path
+
+from ..utils.clock import Clock, RealClock
 
 # space/kind/id become directory names; with network surfaces (REST
 # import, ssh PUT) forwarding client strings here, anything outside this
@@ -48,9 +49,12 @@ class Asset:
 class AssetStore:
     """Directory layout: <root>/<space>/<kind>/<id>/<version>/payload + meta."""
 
-    def __init__(self, root: str | Path):
+    def __init__(self, root: str | Path, clock: Clock | None = None):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
+        # ``created_at`` stamps come from the injected clock's epoch
+        # domain, so version timestamps are FakeClock-testable.
+        self.clock = clock or RealClock()
 
     def _dir(self, space: str, kind: str, id: str, version: str) -> Path:
         _check_components(space, kind, id, version)
@@ -89,7 +93,7 @@ class AssetStore:
             kind=kind,
             sha256=hashlib.sha256(data).hexdigest(),
             size=len(data),
-            created_at=time.time(),
+            created_at=self.clock.wall(),
             path=str(d / "payload"),
         )
         (staged / "meta.json").write_text(json.dumps(vars(meta)))
@@ -118,7 +122,7 @@ class AssetStore:
             meta = Asset(
                 space=space, id=id, version=version, kind=kind,
                 sha256=h.hexdigest(), size=payload.stat().st_size,
-                created_at=time.time(), path=str(d / "payload"),
+                created_at=self.clock.wall(), path=str(d / "payload"),
             )
             (staged / "meta.json").write_text(json.dumps(vars(meta)))
             self._commit(staged, d)
@@ -135,7 +139,7 @@ class AssetStore:
                 for p in (staged / "payload").rglob("*")
                 if p.is_file()
             )
-            meta = Asset(space, id, version, kind, "", size, time.time(),
+            meta = Asset(space, id, version, kind, "", size, self.clock.wall(),
                          str(d / "payload"))
             (staged / "meta.json").write_text(json.dumps(vars(meta)))
             self._commit(staged, d)

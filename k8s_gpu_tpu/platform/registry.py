@@ -11,8 +11,9 @@ images from running").
 from __future__ import annotations
 
 import hashlib
-import time
 from dataclasses import dataclass, field
+
+from ..utils.clock import Clock, RealClock
 
 
 class RegistryError(Exception):
@@ -54,7 +55,9 @@ class ImageRegistry:
         scan_on_push: bool = True,
         scanner=default_scanner,
         immutable_tags: bool = False,
+        clock: Clock | None = None,
     ):
+        self.clock = clock or RealClock()
         self.scan_on_push = scan_on_push
         self.scanner = scanner
         self.immutable_tags = immutable_tags
@@ -82,7 +85,7 @@ class ImageRegistry:
             tag=tag,
             digest=digest,
             size=len(content),
-            created_at=time.time(),
+            created_at=self.clock.wall(),
         )
         if self.scan_on_push:
             findings = list(self.scanner(content))

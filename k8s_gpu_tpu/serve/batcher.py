@@ -291,6 +291,19 @@ class ContinuousBatcher:
     (per-request top_k would make the sampling shape request-dependent).
     """
 
+    # Lock contract (graftcheck lockcheck + utils.faults
+    # guard_declared).  Everything else host-side is single-owner
+    # scheduler-thread state: ``_pending`` is a thread-safe Queue whose
+    # maxsize IS the admission bound, and ``_lifecycle`` exists for
+    # exactly one shared flag — submit-vs-drain on ``_dead`` (either a
+    # request lands before the drain empties the queue, or submit sees
+    # _dead and raises).  ``_prefix`` is the dense prefill cache shared
+    # between precache callers and the scheduler.
+    _GUARDED_BY = {
+        "_lifecycle": ("_dead",),
+        "_prefix_lock": ("_prefix",),
+    }
+
     def __init__(
         self,
         model,

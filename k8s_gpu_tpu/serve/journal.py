@@ -73,6 +73,11 @@ class RequestRecord:
 class RequestJournal:
     """Thread-safe bounded ring of ``RequestRecord``s."""
 
+    # Lock contract (graftcheck lockcheck + utils.faults
+    # guard_declared): the scheduler thread appends while /debug/requests
+    # handlers snapshot.
+    _GUARDED_BY = {"_lock": ("_ring", "dropped")}
+
     def __init__(self, maxlen: int = 512):
         self._lock = threading.Lock()
         self._ring: "deque[RequestRecord]" = deque(

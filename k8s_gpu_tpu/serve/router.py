@@ -85,6 +85,20 @@ class FleetRouter:
     call serializes on one lock — the policy is host-side bookkeeping,
     never device work."""
 
+    # Lock contract, statically verified by k8s_gpu_tpu/analysis
+    # (lockcheck) and enforced under real concurrency by
+    # utils.faults.guard_declared in the race stress test: the replica
+    # sets and the warm-chain table are shared between every routing /
+    # registration / dispatch thread; staleness bookkeeping has its own
+    # lock so a slow scrape can't stall routing.
+    _GUARDED_BY = {
+        "_lock": (
+            "_replicas", "_draining", "_down", "_hot", "_chains",
+            "_chain_counts",
+        ),
+        "_refresh_lock": ("_last_refresh",),
+    }
+
     def __init__(
         self,
         *,
@@ -406,6 +420,8 @@ class FleetRouter:
             self._export_gauges()
 
     def _export_gauges(self) -> None:
+        """Refresh the serve_router_* gauges.  Lock held by caller
+        (every mutation path calls this before releasing _lock)."""
         for name in self._replicas:
             self.metrics.set_gauge(
                 "serve_router_chains_owned",

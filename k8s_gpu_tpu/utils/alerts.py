@@ -174,6 +174,10 @@ class RuleEvaluator:
     the manager registers one).  ``notify(rule, labels, transition,
     value)`` fires on transitions to ``firing``/``resolved``."""
 
+    _GUARDED_BY = {
+        "_lock": ("_watched", "_state", "_last_eval", "timeline"),
+    }
+
     def __init__(
         self,
         rules,
@@ -195,6 +199,8 @@ class RuleEvaluator:
         )
         self._history_samples = history_samples
         self._lock = threading.Lock()
+        # Lock contract (graftcheck lockcheck + utils.faults
+        # guard_declared): tick thread vs the /alerts HTTP readers.
         self._watched: dict[str, collections.deque] = {}
         # alertname -> label-set -> {"state", "since", "value"}
         self._state: dict[str, dict[LabelSet, dict]] = {}
@@ -212,6 +218,9 @@ class RuleEvaluator:
     # -- counter-rate history ---------------------------------------------
     def _rate(self, name: str, window: float, where: dict,
               now: float) -> float:
+        """Windowed counter rate from the per-tick snapshots.  Lock
+        held by caller (rule expressions run inside the evaluation
+        tick's lock)."""
         hist = self._watched.get(name)
         if hist is None:
             # Self-registering watch: seed the history with this tick's
@@ -260,6 +269,8 @@ class RuleEvaluator:
                         rule, "name", getattr(rule, "record", rule)))
 
     def _record(self, rule: RecordingRule, ctx: Ctx) -> None:
+        """Evaluate one recording rule.  Lock held by caller
+        (``evaluate_once``)."""
         for lbls, v in _normalize(rule.expr(ctx)).items():
             # Dict variant: source label keys are data and may collide
             # with the kwargs form's reserved parameter names.
@@ -268,6 +279,8 @@ class RuleEvaluator:
             )
 
     def _alert(self, rule: AlertingRule, ctx: Ctx, now: float) -> None:
+        """Walk one alerting rule's per-label-set FSM.  Lock held by
+        caller (``evaluate_once``)."""
         values = _normalize(rule.expr(ctx))
         st = self._state.setdefault(rule.name, {})
         for lbls, v in values.items():
@@ -309,6 +322,7 @@ class RuleEvaluator:
 
     def _transition(self, rule: AlertingRule, lbls: LabelSet, cur: dict,
                     to: str, v: float, now: float) -> None:
+        """Record one FSM transition.  Lock held by caller."""
         frm = cur["state"]
         # "resolved" is a recorded transition, not a resting state.
         cur["state"] = "inactive" if to == "resolved" else to
@@ -327,6 +341,7 @@ class RuleEvaluator:
                 log.exception("alert notifier failed for %s", rule.name)
 
     def _export_firing(self, rule: AlertingRule, st: dict) -> None:
+        """Refresh the alerts_firing gauge.  Lock held by caller."""
         firing = sum(1 for c in st.values() if c["state"] == "firing")
         self.registry.set_gauge(
             "alerts_firing", float(firing), alertname=rule.name
@@ -395,7 +410,12 @@ class RuleEvaluator:
     def _loop(self) -> None:
         cond = threading.Condition()
         while not self._stop.is_set():
-            if self.clock.now() - self._last_eval >= self.interval:
+            # _last_eval is tick-thread-written under the lock and read
+            # here; take the lock for the read too (the lock contract
+            # the analysis pass enforces — and the honest ordering).
+            with self._lock:
+                due = self.clock.now() - self._last_eval >= self.interval
+            if due:
                 try:
                     self.evaluate_once()
                 except Exception:

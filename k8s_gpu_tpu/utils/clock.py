@@ -15,10 +15,25 @@ import time as _time
 
 
 class Clock:
-    """Monotonic time source + interruptible wait."""
+    """Monotonic time source + interruptible wait.
+
+    ``now()`` is the MONOTONIC domain (durations, deadlines, FSM holds);
+    ``wall()`` is the EPOCH domain (display timestamps, token/code
+    expiry claims, asset ``created_at``).  Splitting them lets modules
+    that need human-meaningful timestamps stay FakeClock-testable — the
+    graftcheck determinism pass (k8s_gpu_tpu/analysis) forbids ambient
+    ``time.time()``/``time.monotonic()`` in the deterministic planes,
+    and these two methods are the sanctioned replacements.
+    """
 
     def now(self) -> float:
         raise NotImplementedError
+
+    def wall(self) -> float:
+        """Epoch seconds for display/expiry timestamps.  FakeClock
+        keeps one time line (wall == now), so a test that advances fake
+        time advances token expiry with it."""
+        return self.now()
 
     def wait(self, cond: threading.Condition, timeout: float | None) -> None:
         """Wait on *cond* (already held) up to *timeout* clock-seconds."""
@@ -43,6 +58,9 @@ class Clock:
 class RealClock(Clock):
     def now(self) -> float:
         return _time.monotonic()
+
+    def wall(self) -> float:
+        return _time.time()
 
     def wait(self, cond: threading.Condition, timeout: float | None) -> None:
         cond.wait(timeout)

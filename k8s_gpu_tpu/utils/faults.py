@@ -27,6 +27,18 @@ identically under the tier-1 budget): every plan decision comes from a
 Every injection counts in ``faults_injected_total{site,kind}`` so a chaos
 run can prove faults actually fired (a green run with zero injections is
 a broken harness, not a robust system).
+
+This module also hosts the RUNTIME half of the lock-discipline checker
+(the static half is ``k8s_gpu_tpu/analysis`` pass 3): an
+``InstrumentedLock`` that records its owner threads, and
+``guard_object``/``guard_declared`` which rebind an instance's class so
+every access to a *guarded field* asserts the declared lock is held by
+the accessing thread.  Violations are RECORDED, not raised — a race
+detector that kills the first worker thread it disagrees with would
+hide every later violation and wedge the stress harness; the test
+asserts the violation list is empty (or, for the seeded-race case,
+isn't).  ``_GUARDED_BY`` on the batcher / router / federation /
+registry classes is the single source of truth both halves enforce.
 """
 
 from __future__ import annotations
@@ -99,6 +111,8 @@ class FaultInjector:
     """Named injection sites; ``global_faults`` is the default wired into
     production code, and chaos harnesses may construct private instances
     (the fakes take ``injector=``) for isolation."""
+
+    _GUARDED_BY = {"_lock": ("_sites",)}
 
     def __init__(self, registry: MetricsRegistry | None = None):
         self.registry = registry or global_metrics
@@ -180,3 +194,145 @@ class FaultInjector:
 
 
 global_faults = FaultInjector()
+
+
+# -- runtime lock-discipline checker ------------------------------------------
+
+@dataclass(frozen=True)
+class LockViolation:
+    """One guarded-field access that did not hold its lock.
+
+    ``mode`` is "write" for an attribute rebind (``__setattr__``) and
+    "access" for everything ``__getattribute__`` sees — which includes
+    container mutations (``self._chains[k] = v`` reaches the guard as
+    a Load of ``_chains``), so "access" must not be read as
+    read-only."""
+
+    cls: str
+    field: str
+    mode: str      # "access" (read or container mutation) | "write"
+    lock: str
+    thread: str
+
+    def __str__(self) -> str:
+        return (
+            f"{self.cls}.{self.field} {self.mode} by thread "
+            f"{self.thread!r} without holding {self.lock}"
+        )
+
+
+class InstrumentedLock:
+    """Wraps a ``threading.Lock``/``RLock``, tracking per-thread hold
+    counts so ``held_by_me`` answers "does MY thread hold this lock" —
+    the question the guarded-field check asks.  Re-entrant holds count
+    (an RLock-wrapped instance nests correctly); the bookkeeping dict
+    is only ever mutated by the thread that just acquired/released, and
+    entries are removed at zero so it stays bounded by live holders."""
+
+    def __init__(self, inner=None):
+        self._inner = inner if inner is not None else threading.Lock()
+        self._holds: dict[int, int] = {}
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            me = threading.get_ident()
+            self._holds[me] = self._holds.get(me, 0) + 1
+        return ok
+
+    def release(self) -> None:
+        me = threading.get_ident()
+        n = self._holds.get(me, 0)
+        if n > 0:
+            if n == 1:
+                self._holds.pop(me, None)
+            else:
+                self._holds[me] = n - 1
+            self._inner.release()
+            return
+        # Cross-thread handoff: a plain Lock may legally be released by
+        # a thread that never acquired it — the ACQUIRER's hold ends
+        # here, so its entry must not linger (a stale entry would make
+        # held_by_me lie True for it forever, silently disabling the
+        # detector).  Snapshot before releasing: an RLock's release
+        # raises for a non-owner, leaving bookkeeping untouched.
+        holders = list(self._holds)
+        self._inner.release()
+        for h in holders:
+            self._holds.pop(h, None)
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    @property
+    def held_by_me(self) -> bool:
+        return self._holds.get(threading.get_ident(), 0) > 0
+
+
+def guard_object(obj, guards: dict, violations: list | None = None) -> list:
+    """Turn *obj* into its own race detector.
+
+    ``guards`` maps lock attribute -> iterable of guarded field names
+    (the ``_GUARDED_BY`` shape).  Each named lock is wrapped in an
+    ``InstrumentedLock`` and the instance's class is rebound to a
+    subclass whose ``__getattribute__``/``__setattr__`` append a
+    ``LockViolation`` whenever a guarded field is touched by a thread
+    not holding its lock.  Returns the (shared) violations list.
+
+    Install while the object is quiescent (before the hammering starts):
+    the lock attribute swap itself is not atomic with respect to a
+    thread already blocked on the old lock object.
+    """
+    violations = violations if violations is not None else []
+    base = type(obj)
+    field_lock = {
+        f: lock for lock, fields in guards.items() for f in fields
+    }
+    for lock_attr in guards:
+        inner = object.__getattribute__(obj, lock_attr)
+        if not isinstance(inner, InstrumentedLock):
+            object.__setattr__(obj, lock_attr, InstrumentedLock(inner))
+
+    def _check(self, name: str, mode: str) -> None:
+        lock_attr = field_lock.get(name)
+        if lock_attr is None:
+            return
+        lk = object.__getattribute__(self, lock_attr)
+        if isinstance(lk, InstrumentedLock) and not lk.held_by_me:
+            violations.append(LockViolation(
+                cls=base.__name__, field=name, mode=mode,
+                lock=lock_attr, thread=threading.current_thread().name,
+            ))
+
+    class Guarded(base):
+        def __getattribute__(self, name):
+            if name in field_lock:
+                _check(self, name, "access")
+            return super().__getattribute__(name)
+
+        def __setattr__(self, name, value):
+            if name in field_lock:
+                _check(self, name, "write")
+            super().__setattr__(name, value)
+
+    Guarded.__name__ = f"Guarded[{base.__name__}]"
+    Guarded.__qualname__ = Guarded.__name__
+    obj.__class__ = Guarded
+    return violations
+
+
+def guard_declared(obj, violations: list | None = None) -> list:
+    """``guard_object`` driven by the class's own ``_GUARDED_BY``
+    declaration — the same contract the static lockcheck pass verifies,
+    so the stress test and the linter cannot drift apart.  A class
+    without a declaration is a no-op (returns the list unchanged)."""
+    guards = getattr(type(obj), "_GUARDED_BY", None) or {}
+    if violations is None:
+        violations = []
+    if guards:
+        guard_object(obj, guards, violations)
+    return violations

@@ -133,6 +133,18 @@ class FleetCollector:
     """Scrapes a named set of exposition targets into one fleet
     ``MetricsRegistry`` (see module docstring for the model)."""
 
+    # Lock contract (graftcheck lockcheck + utils.faults
+    # guard_declared): the target map and scrape bookkeeping are shared
+    # between the evaluator tick thread, /fleet HTTP handlers, and
+    # router refreshes.  ``_scrape_lock`` serializes whole passes and
+    # guards nothing by itself — it is ordering, not state.
+    _GUARDED_BY = {
+        "_lock": (
+            "_targets", "_fails", "_last_ok", "_last_fams",
+            "_ingested", "_agg_keys", "_scrapes",
+        ),
+    }
+
     def __init__(
         self,
         targets: dict | None = None,
@@ -193,7 +205,8 @@ class FleetCollector:
 
     @property
     def never_scraped(self) -> bool:
-        return self._scrapes == 0
+        with self._lock:
+            return self._scrapes == 0
 
     def attach(self, evaluator) -> "FleetCollector":
         """Register the scrape as an evaluator collector: every rule
@@ -232,8 +245,9 @@ class FleetCollector:
             try:
                 fams = parse_exposition(self._fetch(target))
             except Exception:
-                fails = self._fails.get(name, 0) + 1
-                self._fails[name] = fails
+                with self._lock:
+                    fails = self._fails.get(name, 0) + 1
+                    self._fails[name] = fails
                 self.registry.inc(
                     "fleet_scrape_failures_total", replica=name
                 )
@@ -252,8 +266,8 @@ class FleetCollector:
                     )
                 up[name] = False
                 continue
-            self._fails[name] = 0
             with self._lock:
+                self._fails[name] = 0
                 self._last_ok[name] = now
                 self._last_fams[name] = fams
             self.registry.set_gauge("fleet_replica_up", 1.0, replica=name)
@@ -272,7 +286,8 @@ class FleetCollector:
         self.registry.set_gauge(
             "fleet_replicas_up", float(sum(1 for v in up.values() if v))
         )
-        self._scrapes += 1
+        with self._lock:
+            self._scrapes += 1
         return up
 
     def _ingest(self, replica: str, fams: dict) -> None:
@@ -365,6 +380,7 @@ class FleetCollector:
             fails = dict(self._fails)
             last_ok = dict(self._last_ok)
             fams = {k: v for k, v in self._last_fams.items()}
+            scrapes = self._scrapes
         replicas = []
         for name in targets:
             f = fams.get(name, {})
@@ -433,7 +449,7 @@ class FleetCollector:
         return {
             "now": now,
             "down_after": self.down_after,
-            "scrapes": self._scrapes,
+            "scrapes": scrapes,
             "replicas": replicas,
             "aggregates": aggregates,
             "tenants": {t: tenants[t] for t in sorted(tenants)},

@@ -79,6 +79,14 @@ class MetricsRegistry:
 
     _OVERFLOW = (("other", "true"),)
 
+    # Lock contract (graftcheck lockcheck + utils.faults
+    # guard_declared): every store is written by arbitrary caller
+    # threads and read by scrape/rules threads; the percentile fix (PR
+    # 4) exists because one read path skipped this lock.
+    _GUARDED_BY = {
+        "_lock": ("_counters", "_gauges", "_hists", "_series_seen"),
+    }
+
     def __init__(self, max_series_per_name: int = 256):
         self._lock = threading.Lock()
         self._counters: dict[tuple, float] = defaultdict(float)

@@ -553,6 +553,43 @@ def render_requests(records: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def render_lint(report: dict) -> str:
+    """The ``obs lint`` table view of a graftcheck report
+    (``k8s_gpu_tpu.analysis.run_report`` shape): per-rule counts, then
+    each new finding and stale baseline entry.  Deterministic — the
+    report carries no timestamps and findings arrive pre-sorted."""
+    new = report["new"]
+    lines = [
+        "GRAFTCHECK  "
+        f"({len(new)} new, {report['suppressed']} baselined, "
+        f"{len(report['stale'])} stale baseline)",
+    ]
+    by_rule: dict[str, int] = {}
+    for f in new:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    if by_rule:
+        lines.append("")
+        lines.append(f"  {'RULE':<22} {'COUNT':>5}")
+        for rule in sorted(by_rule):
+            lines.append(f"  {rule:<22} {by_rule[rule]:>5}")
+        lines.append("")
+        for f in new:
+            lines.append(f"  {f.path}:{f.line}")
+            lines.append(f"    [{f.rule}] {f.message}")
+    for path, rule, detail in report["stale"]:
+        lines.append(
+            f"  STALE baseline entry: {path} [{rule}] {detail} — "
+            "remove it from config/analysis_baseline.json"
+        )
+    lines.append("")
+    lines.append(
+        "clean — every contract holds" if report["ok"]
+        else "FAIL — fix the findings or (for pre-existing debt only) "
+             "pin them: python -m k8s_gpu_tpu.analysis --write-baseline"
+    )
+    return "\n".join(lines)
+
+
 def render_route(decision, snap: dict) -> str:
     """The ``obs route`` explain view: one routing decision (a
     ``serve.router.RouteDecision``) plus the router snapshot's

@@ -1,0 +1,508 @@
+"""graftcheck rule fixtures: each invariant catches its known-violation
+snippet and stays quiet on the sanctioned form (ISSUE 8 satellite).
+
+These tests drive the passes over synthetic mini-repos (tmp_path trees
+mirroring the real layout), so they pin the RULES; the self-check test
+(test_analysis_selfcheck.py) pins the REPO.  Named to sort early in the
+alphabetical tier-1 window.
+"""
+
+import textwrap
+import threading
+
+from k8s_gpu_tpu.analysis import (
+    format_report, run_all, run_report, save_baseline,
+)
+from k8s_gpu_tpu.utils.faults import (
+    InstrumentedLock, LockViolation, guard_declared, guard_object,
+)
+
+
+def make_repo(tmp_path, files: dict, doc: str | None = None):
+    for relpath, src in files.items():
+        p = tmp_path / relpath
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    if doc is not None:
+        d = tmp_path / "docs" / "platform" / "observability.md"
+        d.parent.mkdir(parents=True, exist_ok=True)
+        d.write_text(textwrap.dedent(doc))
+    return tmp_path
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- pass 1: determinism -------------------------------------------------------
+
+def test_wallclock_in_router_plane_is_flagged(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/router.py": """
+            import time
+
+            def route():
+                return time.time() + time.monotonic()
+        """,
+    })
+    fs = run_all(root)
+    assert [f.rule for f in fs] == ["det-wallclock", "det-wallclock"]
+    # Both calls share a line; findings sort by detail within it.
+    assert fs[0].detail == "time.monotonic in route"
+    assert fs[1].detail == "time.time in route"
+    assert fs[0].line == 5
+
+
+def test_wallclock_outside_planes_is_not_flagged(tmp_path):
+    # serve/batcher.py is the real-time plane — deliberately NOT in the
+    # deterministic set; its latency measurements ARE wall clock.
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/batcher.py": """
+            import time
+
+            def measure():
+                return time.time()
+        """,
+    })
+    assert run_all(root) == []
+
+
+def test_unseeded_random_flagged_seeded_allowed(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/cloud/resilience.py": """
+            import random
+
+            GOOD = random.Random(7).random()
+            GOOD2 = random.Random("endpoint:3")
+
+            def jitter():
+                return random.random() + random.randrange(3)
+        """,
+    })
+    fs = run_all(root)
+    assert [f.rule for f in fs] == ["det-random", "det-random"]
+    assert {f.detail for f in fs} == {
+        "random.random in jitter", "random.randrange in jitter",
+    }
+
+
+def test_from_import_forms_are_caught(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/journal.py": """
+            from random import random
+            from time import monotonic
+
+            def stamp():
+                return monotonic() + random()
+        """,
+    })
+    assert rules_of(run_all(root)) == {"det-wallclock", "det-random"}
+
+
+def test_from_import_seeded_random_stays_sanctioned(tmp_path):
+    # `from random import Random` keeps the seeded-form sanction: only
+    # the seedless constructor is ambient randomness.
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/journal.py": """
+            from random import Random, choice
+
+            def draw(seq):
+                rng = Random(7)          # sanctioned
+                bad = Random()           # seedless → flagged
+                return rng.random(), choice(seq)   # choice → flagged
+        """,
+    })
+    fs = run_all(root)
+    assert sorted(f.detail for f in fs) == [
+        "random.Random() in draw", "random.choice in draw",
+    ]
+
+
+def test_datetime_now_is_flagged(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/operators/gc.py": """
+            import datetime
+            from datetime import datetime as dt
+
+            def when():
+                return datetime.datetime.now(), dt.utcnow()
+        """,
+    })
+    fs = run_all(root)
+    assert [f.rule for f in fs] == ["det-datetime", "det-datetime"]
+
+
+def test_set_iteration_flagged_sorted_allowed(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/controller/events.py": """
+            def emit(pods):
+                out = []
+                for p in set(pods):          # flagged
+                    out.append(p)
+                for p in sorted(set(pods)):  # sanctioned
+                    out.append(p)
+                for p in {1, 2, 3}:          # flagged (literal)
+                    out.append(p)
+                seen = {x for x in pods}     # building a set is fine
+                return out, seen
+        """,
+    })
+    fs = run_all(root)
+    assert [f.rule for f in fs] == ["det-set-iter", "det-set-iter"]
+    assert [f.line for f in fs] == [4, 8]
+
+
+def test_pragma_suppresses_one_rule(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/router.py": """
+            import time
+
+            def a():
+                return time.time()  # graftcheck: ignore[det-wallclock]
+
+            def b():
+                return time.time()  # graftcheck: ignore[det-random]
+        """,
+    })
+    fs = run_all(root)
+    # a()'s pragma names the rule and suppresses; b()'s names another
+    # rule and does not.
+    assert [f.detail for f in fs] == ["time.time in b"]
+
+
+# -- pass 2: metrics contract --------------------------------------------------
+
+def test_label_set_mismatch_flagged_unlabeled_aggregate_allowed(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/metrics_site.py": """
+            def record(m, v):
+                m.observe("ttft_seconds", v)                  # unlabeled OK
+                m.observe("ttft_seconds", v, tenant="t")      # canonical
+                m.observe("ttft_seconds", v, tenant="t")
+                m.observe("ttft_seconds", v, queue="q")       # mismatch
+        """,
+    })
+    fs = run_all(root)
+    assert [f.rule for f in fs] == ["met-label-mismatch"]
+    assert fs[0].line == 6
+    assert "queue" in fs[0].detail
+
+
+def test_counter_set_as_gauge_and_suffixes(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/metrics_site.py": """
+            def record(m):
+                m.inc("requests_total")
+                m.set_gauge("requests_total", 3.0)   # kind conflict
+                m.inc("shed_count")                  # counter sans _total
+                m.set_gauge("drops_total", 1.0)      # gauge with _total
+        """,
+    })
+    fs = run_all(root)
+    # requests_total fires BOTH rules at the set_gauge site: the kind
+    # conflict and the gauge-with-_total suffix breach.
+    assert sorted(f.rule for f in fs) == [
+        "met-counter-suffix", "met-counter-suffix",
+        "met-counter-suffix", "met-kind-conflict",
+    ]
+
+
+def test_reserved_labels_scoped_to_fleet_plane(tmp_path):
+    src = """
+        def record(m):
+            m.set_gauge("pool_fill_ratio", 1.0, replica="r0")
+    """
+    # Outside the fleet plane: flagged.
+    root = make_repo(tmp_path / "a", {
+        "k8s_gpu_tpu/serve/metrics_site.py": src,
+    })
+    assert rules_of(run_all(root)) == {"met-reserved-label"}
+    # utils/federation.py owns the replica label: allowed.
+    root2 = make_repo(tmp_path / "b", {
+        "k8s_gpu_tpu/utils/federation.py": src,
+    })
+    assert run_all(root2) == []
+
+
+def test_doc_drift_both_directions(tmp_path):
+    root = make_repo(
+        tmp_path,
+        {
+            "k8s_gpu_tpu/serve/metrics_site.py": """
+                def record(m):
+                    m.inc("serve_widgets_total")
+                    m.inc("serve_undocumented_total")
+            """,
+        },
+        doc="""
+            | metric | meaning |
+            |---|---|
+            | `serve_widgets_total` | widgets |
+            | `serve_ghost_total` | documented but minted nowhere |
+        """,
+    )
+    fs = run_all(root)
+    assert {(f.rule, f.detail.split()[0]) for f in fs} == {
+        ("met-undocumented", "serve_undocumented_total"),
+        ("met-doc-stale", "serve_ghost_total"),
+    }
+
+
+def test_recording_rule_counts_as_mint(tmp_path):
+    root = make_repo(
+        tmp_path,
+        {
+            "k8s_gpu_tpu/serve/rules_site.py": """
+                def pack():
+                    return [RecordingRule("serve_burn_rate", None)]
+            """,
+        },
+        doc="`serve_burn_rate` is recorded each tick.\n",
+    )
+    assert run_all(root) == []
+
+
+# -- pass 3: lock discipline ---------------------------------------------------
+
+def test_inferred_guard_flags_unlocked_access(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/shared.py": """
+            import threading
+
+            class Table:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._rows = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._rows[k] = v
+
+                def drop(self, k):
+                    with self._lock:
+                        self._rows.pop(k, None)
+
+                def racy_len(self):
+                    return len(self._rows)
+        """,
+    })
+    fs = run_all(root)
+    assert [f.rule for f in fs] == ["lock-guard"]
+    assert fs[0].detail == "Table._rows read in racy_len"
+
+
+def test_single_owner_state_not_poisoned_by_shutdown_lock(tmp_path):
+    # The batcher pattern: scheduler-private state touched under an
+    # unrelated lifecycle lock exactly once (the drain) must not turn
+    # every scheduler access into a finding — the majority filter.
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/shared.py": """
+            import threading
+
+            class Loop:
+                def __init__(self):
+                    self._lifecycle = threading.Lock()
+                    self._overflow = []
+
+                def step(self):
+                    self._overflow.append(1)
+                    if self._overflow:
+                        self._overflow.pop()
+
+                def tail(self):
+                    return list(self._overflow)
+
+                def drain(self):
+                    with self._lifecycle:
+                        self._overflow.clear()
+        """,
+    })
+    assert run_all(root) == []
+
+
+def test_locked_suffix_and_docstring_exemptions(tmp_path):
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/shared.py": """
+            import threading
+
+            class Table:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._rows = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._rows[k] = v
+                        self._size_locked()
+
+                def _size_locked(self):
+                    return len(self._rows)
+
+                def _export(self):
+                    \"\"\"Lock held by caller.\"\"\"
+                    return dict(self._rows)
+        """,
+    })
+    assert run_all(root) == []
+
+
+def test_declared_contract_beats_majority(tmp_path):
+    # With _GUARDED_BY declared, even a single unlocked write is a
+    # finding — no majority vote.
+    root = make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/shared.py": """
+            import threading
+
+            class Flag:
+                _GUARDED_BY = {"_lock": ("_dead",)}
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._dead = False
+
+                def kill(self):
+                    self._dead = True
+        """,
+    })
+    fs = run_all(root)
+    assert [f.detail for f in fs] == ["Flag._dead write in kill"]
+
+
+# -- baseline + determinism of the report --------------------------------------
+
+def _violating_repo(tmp_path):
+    return make_repo(tmp_path, {
+        "k8s_gpu_tpu/serve/router.py": """
+            import time
+
+            def route():
+                return time.time()
+        """,
+    })
+
+
+def test_baseline_suppresses_pinned_debt(tmp_path):
+    root = _violating_repo(tmp_path)
+    baseline = root / "config" / "analysis_baseline.json"
+    baseline.parent.mkdir(parents=True)
+    save_baseline(baseline, run_all(root))
+    report = run_report(root)
+    assert report["ok"] and report["suppressed"] == 1 and not report["new"]
+
+
+def test_stale_baseline_entry_fails(tmp_path):
+    root = _violating_repo(tmp_path)
+    baseline = root / "config" / "analysis_baseline.json"
+    baseline.parent.mkdir(parents=True)
+    save_baseline(baseline, run_all(root))
+    # Fix the violation; the pinned entry now matches nothing — the
+    # baseline must shrink, so the check fails until it does.
+    (root / "k8s_gpu_tpu" / "serve" / "router.py").write_text(
+        "def route():\n    return 0.0\n"
+    )
+    report = run_report(root)
+    assert not report["ok"]
+    assert report["stale"] == [(
+        "k8s_gpu_tpu/serve/router.py", "det-wallclock",
+        "time.time in route",
+    )]
+    assert "baseline-stale" in format_report(report)
+
+
+def test_baseline_keys_survive_line_drift(tmp_path):
+    root = _violating_repo(tmp_path)
+    baseline = root / "config" / "analysis_baseline.json"
+    baseline.parent.mkdir(parents=True)
+    save_baseline(baseline, run_all(root))
+    # Prepend unrelated lines: the finding's line number moves, the
+    # (path, rule, detail) key does not.
+    p = root / "k8s_gpu_tpu" / "serve" / "router.py"
+    p.write_text("# comment\n# comment\n" + p.read_text())
+    assert run_report(root)["ok"]
+
+
+def test_report_is_byte_identical_across_runs(tmp_path):
+    root = _violating_repo(tmp_path)
+    a = format_report(run_report(root, baseline_path=None))
+    b = format_report(run_report(root, baseline_path=None))
+    assert a == b
+    assert a.encode() == b.encode()
+
+
+# -- runtime half: the instrumented lock ---------------------------------------
+
+def test_guard_object_records_unlocked_access():
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+    b = Box()
+    v = guard_object(b, {"_lock": ("_items",)})
+    b.add(1)
+    assert v == []
+    b._items.append(2)  # unguarded container mutation, seen as access
+    assert len(v) == 1
+    assert v[0].field == "_items" and v[0].mode == "access"
+    assert isinstance(v[0], LockViolation) and "_lock" in str(v[0])
+
+
+def test_guard_declared_reads_class_contract():
+    class Flag:
+        _GUARDED_BY = {"_lock": ("_dead",)}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._dead = False
+
+        def kill(self):
+            with self._lock:
+                self._dead = True
+
+    f = Flag()
+    v = guard_declared(f)
+    f.kill()
+    assert v == []
+    f._dead = True  # the seeded unguarded write
+    assert [x.mode for x in v] == ["write"]
+
+
+def test_instrumented_rlock_reentrancy():
+    lk = InstrumentedLock(threading.RLock())
+    assert not lk.held_by_me
+    with lk:
+        with lk:
+            assert lk.held_by_me
+        assert lk.held_by_me
+    assert not lk.held_by_me
+
+
+def test_guard_concurrent_clean_hammer():
+    class Box:
+        _GUARDED_BY = {"_lock": ("_items",)}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+    b = Box()
+    v = guard_declared(b)
+    threads = [
+        threading.Thread(target=lambda: [b.add(i) for i in range(300)])
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert v == []
+    with b._lock:
+        assert len(b._items) == 1200
