@@ -15,7 +15,12 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo
+
+# The default verify path (bare `make`): graftcheck invariants + the
+# attribution-plane smoke.  The full suite stays `make test` (it takes
+# minutes); image builds stay `make docker-build`.
+verify: check profile-demo
 
 docker-build:
 	@for img in $(IMAGES); do \
@@ -102,6 +107,13 @@ prefix-demo:
 # cross-links to a resolvable trace.  Non-zero exit on any failure.
 fleet-demo:
 	python tools/fleet_demo.py
+
+# Performance-attribution smoke: a live batcher under mixed traffic
+# (the phase table identifies the dominant phase), a seeded shape-churn
+# burst walks CompileStorm pending→firing→resolved under FakeClock, and
+# the Chrome/Perfetto trace export is written and schema-validated.
+profile-demo:
+	python tools/profile_demo.py
 
 # Fleet router smoke: 4 paged replicas behind the prefix-affinity
 # router serve skewed multi-tenant traffic (each tenant's shared prompt

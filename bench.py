@@ -106,18 +106,11 @@ def _enable_compile_cache() -> None:
         pass  # older jax: cache unavailable, bench still correct
 
 
-# Peak dense bf16 FLOP/s by device kind (public spec sheets).  Used only to
-# turn measured FLOP/s into MFU; unknown kinds report mfu=0.0 and the raw
-# FLOP/s stands on its own.
-PEAK_BF16_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,  # Trillium
-    "TPU v6e": 918e12,
-}
+# Peak bf16 FLOP/s by device kind and the analytic per-step FLOP count
+# live in k8s_gpu_tpu.train.runner since ISSUE 9 (the running trainer
+# exports a continuous `train_mfu` gauge from the same numbers); the
+# bench imports them lazily inside train_bench so device pinning
+# (_pin_cpu) still precedes the first jax import.
 
 
 def reconcile_to_ready(accel: str, slice_count: int = 1) -> tuple[float, int]:
@@ -194,17 +187,6 @@ def _flagship_config(on_tpu: bool):
     ), 8
 
 
-def model_flops_per_step(cfg, n_params: int, batch: int) -> float:
-    """Analytic model FLOPs for one fwd+bwd step (PaLM appendix-B
-    convention): 6·N per token for the matmul path + attention scores
-    12·B·H·Dh·S²·L, halved for causality.  Remat recompute is *not*
-    counted — MFU measures useful model FLOPs."""
-    tokens = batch * cfg.max_seq
-    matmul = 6.0 * n_params * tokens
-    attn = 12.0 * batch * cfg.n_heads * cfg.d_head * cfg.max_seq ** 2 * cfg.n_layers / 2.0
-    return matmul + attn
-
-
 def train_bench() -> dict:
     """Steady-state train-step timing on the flagship; returns timings plus
     the model handle for the decode probe.  Each step syncs on float(loss),
@@ -214,6 +196,10 @@ def train_bench() -> dict:
     from k8s_gpu_tpu.models import TransformerLM
     from k8s_gpu_tpu.parallel.mesh import MeshConfig, mesh_from_devices
     from k8s_gpu_tpu.train import TrainConfig, Trainer
+    from k8s_gpu_tpu.train.runner import (
+        PEAK_BF16_FLOPS, model_flops_per_step,
+    )
+    from k8s_gpu_tpu.utils.metrics import global_metrics
 
     devs = jax.devices()
     on_tpu = devs[0].platform == "tpu"
@@ -315,6 +301,15 @@ def train_bench() -> dict:
             # Loss after the post-window convergence phase — the model
             # the serving probes actually serve.
             "serve_target_loss": float(serve_loss),
+            # Continuous attribution (ISSUE 9): the live gauges the
+            # running trainer now exports — the rolling-MFU gauge and
+            # the per-step phase split (shard_batch / step_dispatch /
+            # loss_sync shares of the profiler window).
+            "train_mfu_gauge": global_metrics.gauge("train_mfu") or 0.0,
+            "train_phase_shares": {
+                ph: round(st["share"], 4)
+                for ph, st in trainer.profiler.snapshot()["phases"].items()
+            },
         },
     }
 
@@ -524,6 +519,15 @@ def batched_decode_probe(model, params) -> dict:
             "cb_decode_tokens_per_s_8req": n8 / dt8,
             "cb_batch_scaling_x": (n8 / dt8) / (n1 / dt1),
         }
+        # Attribution columns (ISSUE 9): the batcher's own phase-share
+        # split over the measured window — throughput AND where the
+        # scheduler spent it land in the same bench row, so a kernel
+        # win/regression is attributable from BENCH_r06 alone.
+        psnap = b.profiler.snapshot()
+        for ph, st in psnap["phases"].items():
+            out[f"cb_phase_share_{ph}"] = st["share"]
+            out[f"cb_phase_p95_{ph}_s"] = st["p95_s"]
+        out["cb_phase_residual_share"] = psnap["residual_share"]
         # Per-request latency percentiles from the batcher's own C32
         # telemetry (VERDICT r4 ask #2's done-criterion) — exact over
         # the histogram's raw-observation reservoir.
@@ -1159,6 +1163,8 @@ def main() -> None:
         "cb_router_tokens_per_s_4rep", "cb_router_prefix_hit_ratio",
         "cb_router_affinity_hit_x", "cb_router_vs_single_x",
         "cb_router_ttft_p95_s", "cb_router_rr_ttft_p95_s",
+        "cb_phase_share_decode_dispatch", "cb_phase_residual_share",
+        "train_mfu_gauge",
     )
     compact = {
         "metric": out["metric"],

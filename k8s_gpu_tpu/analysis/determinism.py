@@ -40,6 +40,11 @@ DETERMINISTIC_PLANES = (
     "k8s_gpu_tpu/utils/federation.py",
     "k8s_gpu_tpu/utils/metrics.py",
     "k8s_gpu_tpu/utils/tracing.py",
+    # The attribution plane (ISSUE 9): the phase profiler's two-run
+    # bit-identical /debug/profile contract, and the jax.profiler
+    # wrappers whose wall window now flows through Clock.
+    "k8s_gpu_tpu/utils/profiler.py",
+    "k8s_gpu_tpu/utils/profiling.py",
     "k8s_gpu_tpu/operators/",
     "k8s_gpu_tpu/controller/",
     "k8s_gpu_tpu/cloud/resilience.py",
@@ -51,7 +56,10 @@ DETERMINISTIC_PLANES = (
     "k8s_gpu_tpu/auth/oidc.py",
 )
 
-_WALLCLOCK_ATTRS = {"time", "monotonic"}
+# perf_counter joined in ISSUE 9: the profiling plane's wall reads must
+# flow through Clock like every other duration source (the two real-
+# duration measurement sites in manager/trainjob carry pragmas).
+_WALLCLOCK_ATTRS = {"time", "monotonic", "perf_counter"}
 _DATETIME_ATTRS = {"now", "utcnow", "today"}
 # random.Random(seed)/SystemRandom()/seed() are the sanctioned forms;
 # everything else on the module is ambient-state randomness.

@@ -980,6 +980,60 @@ def cmd_obs(args) -> int:
             print("\n(follow a request: obs traces --url "
                   f"{args.url} --trace <TRACE>)")
         return 0
+    if args.obs_cmd == "profile":
+        # Continuous performance attribution: the /debug/profile view
+        # (per-phase p50/p95/share, compile telemetry, per-axis
+        # collective bandwidth), plus the Chrome/Perfetto export of the
+        # span ring + phase samples.
+        from ..utils.obs import render_profile
+
+        if args.url:
+            body = _obs_fetch(args.url, "/debug/profile")
+            if body is None:
+                return 1
+            try:
+                snap = json.loads(body)
+                snap["phases"]
+            except (ValueError, KeyError, TypeError) as e:
+                print(f"fetch failed: {e}", file=sys.stderr)
+                return 1
+            if args.chrome_trace:
+                from pathlib import Path
+
+                from ..utils.profiler import chrome_trace
+
+                tr_body = _obs_fetch(
+                    args.url, f"/debug/traces?limit={args.limit}"
+                )
+                try:
+                    traces = (
+                        json.loads(tr_body)["traces"] if tr_body else []
+                    )
+                except (ValueError, KeyError, TypeError):
+                    traces = []
+                data = chrome_trace(traces, snap)
+                Path(args.chrome_trace).write_text(json.dumps(data))
+                print(
+                    f"chrome trace written to {args.chrome_trace} "
+                    f"({len(data['traceEvents'])} events) — load it at "
+                    "ui.perfetto.dev or chrome://tracing"
+                )
+            print(render_profile(snap))
+            return 0
+        if args.chrome_trace:
+            print("--chrome-trace needs --url (the live span ring and "
+                  "phase samples live in the serving process)",
+                  file=sys.stderr)
+            return 2
+        # Offline: reconstruct the attribution view from the persisted
+        # exposition (share gauges + histogram buckets).
+        from ..utils.profiler import snapshot_from_exposition
+
+        text = _obs_snapshot()
+        if text is None:
+            return 1
+        print(render_profile(snapshot_from_exposition(text)))
+        return 0
     if args.obs_cmd == "route":
         # Routing explain: which replica the prefix-affinity router
         # would pick for a prompt, and what every candidate scored.
@@ -1518,6 +1572,23 @@ def build_parser() -> argparse.ArgumentParser:
     p_oreq.add_argument("--trace", default="",
                         help="exact trace id filter")
     p_oreq.add_argument("--limit", type=int, default=30)
+    p_oprof = obs_sub.add_parser(
+        "profile",
+        help="continuous performance attribution: per-phase p50/p95/"
+             "share for the live batcher/trainer, XLA compile "
+             "telemetry, per-axis collective bandwidth (/debug/profile)",
+    )
+    p_oprof.add_argument("--url", default="",
+                         help="base URL of a metrics server with a "
+                              "phase profiler attached "
+                              "(/debug/profile); default: reconstruct "
+                              "from the persisted metrics.prom")
+    p_oprof.add_argument("--chrome-trace", default="",
+                         help="write a Chrome/Perfetto trace JSON "
+                              "(span ring + phase samples) to PATH; "
+                              "requires --url")
+    p_oprof.add_argument("--limit", type=int, default=200,
+                         help="max traces pulled for the chrome export")
     p_orte = obs_sub.add_parser(
         "route",
         help="explain a routing decision: which replica the "

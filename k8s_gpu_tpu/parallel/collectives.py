@@ -16,6 +16,18 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _shard_map(f, **kw):
+    """``jax.shard_map`` with a fallback to the pre-promotion spelling:
+    this environment's jax pin (0.4.x) only ships
+    ``jax.experimental.shard_map.shard_map`` (the top-level name raises
+    an accelerated-deprecation AttributeError), while the bench host's
+    newer jax has the promoted API.  Same call convention either way."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(f, **kw)
+
+
 def psum_smoke(mesh: Mesh | None = None) -> dict:
     """All-reduce a per-device arange over every mesh axis and check the
     result analytically.  Returns {ok, n_devices, wall_s}."""
@@ -28,7 +40,7 @@ def psum_smoke(mesh: Mesh | None = None) -> dict:
     def body(x):
         return jax.lax.psum(x, axis_names)
 
-    shaped = jax.shard_map(
+    shaped = _shard_map(
         body,
         mesh=mesh,
         in_specs=P(axis_names),  # leading dim sharded over ALL mesh axes
@@ -63,7 +75,7 @@ def all_reduce_bandwidth_probe(
 
     @jax.jit
     def reduce(x):
-        return jax.shard_map(
+        return _shard_map(
             lambda s: jax.lax.psum(s, mesh.axis_names),
             mesh=mesh,
             in_specs=P(mesh.axis_names),
@@ -79,3 +91,68 @@ def all_reduce_bandwidth_probe(
     nbytes = elems * 2
     algo_bw = 2 * (n - 1) / max(n, 1) * nbytes / dt / 1e9
     return {"n_devices": n, "bytes": nbytes, "time_s": dt, "algo_gbps": algo_bw}
+
+
+def per_axis_bandwidth_probe(
+    mesh: Mesh, mib: float = 1.0, iters: int = 2, registry=None
+) -> dict:
+    """Per-AXIS collective bandwidth — interconnect measured like cores
+    (ROADMAP item 5; Gridiron, PAPERS.md arXiv 2201.04322).  The whole-
+    mesh probe above can't distinguish an ICI axis from a DCN one, which
+    is exactly the distinction multislice placement quality lives on: on
+    a dcn-dp × ici-tp mesh the dp number is the cross-slice DCN path and
+    the tp number the in-slice ICI path.
+
+    For each mesh axis of size > 1, times a psum over ONLY that axis on
+    an all-axes-sharded bf16 buffer (~``mib`` MiB per device) and
+    exports:
+
+    - ``collective_bytes_per_second{axis}`` gauge — achieved algo
+      bandwidth (2·(k-1)/k · shard bytes / t, the all-reduce convention
+      the whole-mesh probe uses);
+    - ``collective_seconds{axis,op}`` histogram — the raw per-op wall.
+
+    Returns ``{axis: {devices, seconds, bytes_per_second}}``.  The
+    multislice dryrun (``__graft_entry__.dryrun_multichip``) runs this
+    on its dcn-dp × ici-tp mesh, so placement quality is a number on
+    ``/metrics`` (and ``/debug/profile``), not a topology assumption."""
+    from ..utils.metrics import global_metrics
+
+    reg = registry if registry is not None else global_metrics
+    iters = max(1, int(iters))
+    n = mesh.size
+    elems = max(1, int(mib * 1024 * 1024) // 2)
+    sharding = NamedSharding(mesh, P(mesh.axis_names))
+    x = jax.jit(
+        lambda: jnp.ones((n, elems), dtype=jnp.bfloat16),
+        out_shardings=sharding,
+    )()
+    out: dict[str, dict] = {}
+    for axis in mesh.axis_names:
+        k = int(mesh.shape[axis])
+        if k <= 1:
+            continue
+
+        @jax.jit
+        def reduce(x, _axis=axis):
+            return _shard_map(
+                lambda s: jax.lax.psum(s, _axis),
+                mesh=mesh,
+                in_specs=P(mesh.axis_names),
+                out_specs=P(mesh.axis_names),
+            )(x)
+
+        reduce(x).block_until_ready()  # warm compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = reduce(x)
+        r.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        shard_bytes = elems * 2  # bf16, one (1, elems) block per device
+        bw = 2 * (k - 1) / k * shard_bytes / max(dt, 1e-12)
+        reg.observe("collective_seconds", dt, axis=axis, op="psum")
+        reg.set_gauge("collective_bytes_per_second", bw, axis=axis)
+        out[axis] = {
+            "devices": k, "seconds": dt, "bytes_per_second": bw,
+        }
+    return out

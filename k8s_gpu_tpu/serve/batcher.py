@@ -49,9 +49,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from ..utils.compat import large_thread_stack, serialize_xla_compiles
+from ..utils.compat import (
+    install_compile_telemetry, large_thread_stack, serialize_xla_compiles,
+)
 from ..utils.faults import global_faults
 from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.profiler import PhaseProfiler
 from ..utils.tracing import global_tracer
 from .engine import (
     InferenceEngine, _empty_cache, _empty_cache_paged, nucleus_mask,
@@ -326,6 +329,7 @@ class ContinuousBatcher:
         max_pending: int = 0,
         metrics: MetricsRegistry | None = None,
         journal: RequestJournal | None = None,
+        profiler: PhaseProfiler | None = None,
     ):
         """``metrics``: the registry this batcher's serve-plane
         telemetry lands in (default: the process-global one).  A
@@ -464,6 +468,21 @@ class ContinuousBatcher:
         self.eos_id = eos_id
         self.metrics = metrics if metrics is not None else global_metrics
         self.journal = journal if journal is not None else RequestJournal()
+        # Continuous phase attribution (ISSUE 9): scheduler-thread seams
+        # recorded as disjoint self-time phases — admission, paged_plan,
+        # prefill_dispatch, decode_dispatch, decode_consume, spec_draft,
+        # spec_verify, retire — exported as serve_phase_seconds{phase}
+        # histograms + serve_phase_share{phase} gauges so "where does a
+        # decode round spend its time" is a number on /metrics, not a
+        # one-shot offline study (utils/profiler.py).
+        self.profiler = (
+            profiler if profiler is not None
+            else PhaseProfiler(plane="serve", registry=self.metrics)
+        )
+        # Steady-state recompiles are the silent killer the zero-
+        # recompile CI test only catches offline; xla_compiles_total /
+        # xla_compile_seconds make them a live rate CompileStorm pages on.
+        install_compile_telemetry()
         # Collect per-token logprobs: a full-vocab log_softmax per decode
         # step plus an extra host fetch per round — off by default; the
         # LM server turns it on (its API exposes "logprobs").
@@ -2035,6 +2054,10 @@ class ContinuousBatcher:
                 "serve_decode_tokens_per_second",
                 (self._emit_total - n0) / (now - t0),
             )
+        # Phase attribution rides the same cadence: the rolling window's
+        # share-of-wall split lands as serve_phase_share{phase} gauges
+        # (plus phase="residual" for the unattributed remainder).
+        self.profiler.export_shares()
 
     def _adaptive_k(self) -> int:
         """Draft-window size from measured rolling acceptance.
@@ -2277,17 +2300,21 @@ class ContinuousBatcher:
             advance = n_rounds * (K + 1)
             t_hi = self._t_hi(live, advance)
             pages_op = jnp.asarray(self._pages) if self.paged else None
-            if self.spec_mode == "ngram":
-                self._dev, (toks, ns, lps) = self._round_spec_ngram_jit(
-                    self.params, self._dev, self.bank.banked, use_top_p,
-                    n_rounds, t_hi, K, pages_op,
-                )
-            else:
-                self._dev, (toks, ns, lps) = self._round_spec_jit(
-                    self.params, self.draft_params, self._dev,
-                    self.bank.banked, use_top_p, n_rounds, t_hi, K,
-                    pages_op,
-                )
+            # Speculative dispatch is its own phase (the draft+verify
+            # program enqueue — self-time subtracts from the enclosing
+            # decode_dispatch, which keeps the gate/sizing overhead).
+            with self.profiler.phase("spec_draft"):
+                if self.spec_mode == "ngram":
+                    self._dev, (toks, ns, lps) = self._round_spec_ngram_jit(
+                        self.params, self._dev, self.bank.banked, use_top_p,
+                        n_rounds, t_hi, K, pages_op,
+                    )
+                else:
+                    self._dev, (toks, ns, lps) = self._round_spec_jit(
+                        self.params, self.draft_params, self._dev,
+                        self.bank.banked, use_top_p, n_rounds, t_hi, K,
+                        pages_op,
+                    )
             # Budget-gate charge: EXPECTED tokens from rolling acceptance,
             # not the all-accepted worst case — a worst-case charge at
             # acceptance a<1 makes the gate think the budget is covered
@@ -2368,6 +2395,10 @@ class ContinuousBatcher:
         req.out.put((int(tok), float(lp)))
 
     def _retire(self, slot: int) -> None:
+        with self.profiler.phase("retire"):
+            self._retire_inner(slot)
+
+    def _retire_inner(self, slot: int) -> None:
         req = self._active[slot]
         if req is not None:
             req.out.put(None)  # completion sentinel
@@ -2548,15 +2579,26 @@ class ContinuousBatcher:
 
     def _drain_one(self, inflight: collections.deque) -> None:
         """Pop and process the next in-flight item; consecutive admits
-        are coalesced into one fetch (_process_admits)."""
+        are coalesced into one fetch (_process_admits).  Consumption is
+        phase-attributed here, at the item boundary: the first-token
+        fetch of an admit completes admission, a spec round's fetch +
+        accept walk is the verify cost, everything else is plain decode
+        consumption (retire nests inside and subtracts its self-time)."""
         item = inflight.popleft()
         if item[0] == "admit" and inflight and inflight[0][0] == "admit":
             batch = [item]
             while inflight and inflight[0][0] == "admit":
                 batch.append(inflight.popleft())
-            self._process_admits(batch)
+            with self.profiler.phase("admission"):
+                self._process_admits(batch)
         else:
-            self._process(item)
+            name = {
+                "admit": "admission",
+                "admit_round": "admission",
+                "spec": "spec_verify",
+            }.get(item[0], "decode_consume")
+            with self.profiler.phase(name):
+                self._process(item)
         self._update_util_gauges()
 
     def _process(self, item: tuple) -> None:
@@ -2798,91 +2840,108 @@ class ContinuousBatcher:
                             req = self._pending.get_nowait()
                         except queue.Empty:
                             break
-                    # Deadline gate BEFORE any allocation or device
-                    # program: work that expired while queued is shed,
-                    # never prefilled.
-                    if (
-                        req.deadline is not None
-                        and time.monotonic() > req.deadline
-                    ):
-                        self._shed_expired(req)
-                        continue
-                    if self.paged:
-                        if not self._paged_plan(req):
-                            if not any(
-                                r is not None for r in self._active
-                            ):
-                                # Nothing is holding blocks (refcount-0
-                                # cached blocks are evictable), so the
-                                # request simply cannot fit — fail it,
-                                # don't spin.
-                                req.aborted = True
-                                if req.on_admit is not None:
-                                    req.on_admit()
-                                self._journal(req, "no_capacity")
-                                req.out.put(None)
-                                continue
-                            # Back at the FRONT: this req was popleft'd
-                            # for the retry, and append would rotate the
-                            # deferred queue — later arrivals would leap
-                            # ahead of it on every pressure stall
-                            # (ADVICE: FIFO across block-pressure
-                            # deferrals).  Deferral holds NO block
-                            # references (the plan released any shared
-                            # acquisitions on failure); the retry
-                            # re-matches against the then-current cache.
-                            self._overflow.appendleft(req)
-                            break
+                    # Admission phase (profiler): pop-to-dispatch, with
+                    # the paged block plan and the admit program dispatch
+                    # as nested sub-phases (their self-time subtracts, so
+                    # shares stay disjoint).  push/pop instead of `with`
+                    # keeps the continue/break control flow readable.
+                    self.profiler.push("admission")
                     try:
-                        # Idle cold solo start → fuse admission with the
-                        # first tail-sized round in one dispatch (plain
-                        # mode; prefix/disagg admissions keep their own
-                        # cheaper programs).  The prefix lookup runs once
-                        # here and feeds both the gate and the unfused
-                        # admit path.
-                        entry = (
-                            self._match_prefix(req.ids)
-                            if req.aidx == 0 and req.precomputed is None
-                            and not self.paged
-                            else None
-                        )
-                        fused = (
-                            self.spec_mode is None
-                            and not self.paged  # paged admit is unfused
-                            and not inflight
-                            and req.precomputed is None
-                            and req.max_new > 1
-                            and self._pending.empty()
-                            and not any(
-                                r is not None for r in self._active
+                        # Deadline gate BEFORE any allocation or device
+                        # program: work that expired while queued is shed,
+                        # never prefilled.
+                        if (
+                            req.deadline is not None
+                            and time.monotonic() > req.deadline
+                        ):
+                            self._shed_expired(req)
+                            continue
+                        if self.paged:
+                            with self.profiler.phase("paged_plan"):
+                                planned = self._paged_plan(req)
+                            if not planned:
+                                if not any(
+                                    r is not None for r in self._active
+                                ):
+                                    # Nothing is holding blocks (refcount-0
+                                    # cached blocks are evictable), so the
+                                    # request simply cannot fit — fail it,
+                                    # don't spin.
+                                    req.aborted = True
+                                    if req.on_admit is not None:
+                                        req.on_admit()
+                                    self._journal(req, "no_capacity")
+                                    req.out.put(None)
+                                    continue
+                                # Back at the FRONT: this req was popleft'd
+                                # for the retry, and append would rotate the
+                                # deferred queue — later arrivals would leap
+                                # ahead of it on every pressure stall
+                                # (ADVICE: FIFO across block-pressure
+                                # deferrals).  Deferral holds NO block
+                                # references (the plan released any shared
+                                # acquisitions on failure); the retry
+                                # re-matches against the then-current cache.
+                                self._overflow.appendleft(req)
+                                break
+                        try:
+                            # Idle cold solo start → fuse admission with the
+                            # first tail-sized round in one dispatch (plain
+                            # mode; prefix/disagg admissions keep their own
+                            # cheaper programs).  The prefix lookup runs once
+                            # here and feeds both the gate and the unfused
+                            # admit path.
+                            entry = (
+                                self._match_prefix(req.ids)
+                                if req.aidx == 0 and req.precomputed is None
+                                and not self.paged
+                                else None
                             )
-                            and entry is None
-                        )
-                        if fused:
-                            inflight.append(
-                                self._dispatch_admit_round(req, slot)
+                            fused = (
+                                self.spec_mode is None
+                                and not self.paged  # paged admit is unfused
+                                and not inflight
+                                and req.precomputed is None
+                                and req.max_new > 1
+                                and self._pending.empty()
+                                and not any(
+                                    r is not None for r in self._active
+                                )
+                                and entry is None
                             )
-                        else:
-                            inflight.append(
-                                self._dispatch_admit(req, slot, entry)
-                            )
-                    except BaseException:
-                        # The popped request is in neither _pending nor
-                        # _active yet — the crash drain below would miss
-                        # it and its caller would block forever.
-                        req.aborted = True
-                        if req.on_admit is not None:
-                            req.on_admit()
-                        self._journal(req, "aborted")
-                        req.out.put(None)
-                        raise
+                            with self.profiler.phase("prefill_dispatch"):
+                                if fused:
+                                    inflight.append(
+                                        self._dispatch_admit_round(req, slot)
+                                    )
+                                else:
+                                    inflight.append(
+                                        self._dispatch_admit(req, slot, entry)
+                                    )
+                        except BaseException:
+                            # The popped request is in neither _pending nor
+                            # _active yet — the crash drain below would miss
+                            # it and its caller would block forever.
+                            req.aborted = True
+                            if req.on_admit is not None:
+                                req.on_admit()
+                            self._journal(req, "aborted")
+                            req.out.put(None)
+                            raise
+                    finally:
+                        self.profiler.pop()
                 # Keep the device busy: dispatch the next round before
                 # fetching results of previous ones.  A None dispatch
                 # means every live row's budget is already covered by
                 # in-flight rounds — process one instead so the loop
                 # always makes progress toward retiring those rows.
                 if any(r is not None for r in self._active):
-                    item = self._dispatch_round(inflight)
+                    # decode_dispatch self-time = gate/sizing + the plain
+                    # round's program enqueue; the spec program enqueue
+                    # (spec_draft) and any timed-round drain consumption
+                    # nest inside and subtract.
+                    with self.profiler.phase("decode_dispatch"):
+                        item = self._dispatch_round(inflight)
                     if item is not None:
                         inflight.append(item)
                     elif inflight:

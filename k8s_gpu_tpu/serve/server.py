@@ -104,6 +104,9 @@ class LmServer:
         # The per-request lifecycle ring — hand to a MetricsServer's
         # ``journal=`` to serve it at /debug/requests.
         self.journal = self.batcher.journal
+        # The phase profiler — hand to a MetricsServer's ``profile=`` to
+        # serve the attribution snapshot at /debug/profile (obs profile).
+        self.profiler = self.batcher.profiler
         self.tokenizer = tokenizer
         self.started_at = time.time()
         self.cap = max_new_tokens_cap

@@ -25,9 +25,47 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import MeshConfig, build_mesh
 from ..parallel.sharding import ParamRules
+from ..utils.compat import install_compile_telemetry
 from ..utils.metrics import global_metrics
+from ..utils.profiler import PhaseProfiler
 
 log = logging.getLogger("k8s_gpu_tpu.train")
+
+
+# Peak dense bf16 FLOP/s by device kind (public spec sheets) — the MFU
+# denominator.  Unknown kinds (CPU, future chips) read 0.0: the gauge
+# then reports 0 and the raw FLOP/s stands on its own.  Lives here (not
+# bench.py) since ISSUE 9 so the RUNNING trainer can export `train_mfu`
+# continuously; the bench imports it.
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops() -> float:
+    """Peak bf16 FLOP/s of device 0, or 0.0 for unknown kinds."""
+    devs = jax.devices()
+    return PEAK_BF16_FLOPS.get(devs[0].device_kind, 0.0) if devs else 0.0
+
+
+def model_flops_per_step(cfg, n_params: int, batch: int) -> float:
+    """Analytic model FLOPs for one fwd+bwd step (PaLM appendix-B
+    convention): 6·N per token for the matmul path + attention scores
+    12·B·H·Dh·S²·L, halved for causality.  Remat recompute is *not*
+    counted — MFU measures useful model FLOPs."""
+    tokens = batch * cfg.max_seq
+    matmul = 6.0 * n_params * tokens
+    attn = (
+        12.0 * batch * cfg.n_heads * cfg.d_head
+        * cfg.max_seq ** 2 * cfg.n_layers / 2.0
+    )
+    return matmul + attn
 
 
 def _check_kv_tp(cfg, mesh) -> None:
@@ -180,12 +218,27 @@ class Trainer:
         train_config: TrainConfig | None = None,
         rules: ParamRules | None = None,
         batch_specs: tuple | None = None,
+        peak_flops: float | None = None,
+        profiler: PhaseProfiler | None = None,
     ):
+        """``peak_flops``: MFU denominator override (None = detect from
+        the device kind; 0.0 on unknown hardware keeps the gauge at 0).
+        ``profiler``: the phase profiler the per-step split lands in
+        (default: a fresh one over the global registry) — exported as
+        ``train_phase_seconds{phase}`` / ``train_phase_share{phase}``
+        plus the rolling ``train_mfu`` gauge."""
         self.model = model
         self.mesh = mesh or build_mesh(mesh_config)
         self.tc = train_config or TrainConfig()
         self.rules = rules or ParamRules()
         self.optimizer = make_optimizer(self.tc)
+        self.peak_flops = peak_flops
+        self.profiler = (
+            profiler if profiler is not None else PhaseProfiler(plane="train")
+        )
+        self._n_params: int | None = None
+        self._step_ewma_s: float | None = None
+        install_compile_telemetry()
         # Batch sharding: explicit specs, or inferred per-array in
         # shard_batch (leading dim over dp; dim 1 over sp only for rank>=2
         # arrays on a sequence-parallel mesh).
@@ -346,18 +399,21 @@ class Trainer:
                 self._step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
             else:
                 self._step = jax.jit(step_fn, donate_argnums=(0, 1))
-        batch = self.shard_batch(*batch)
+        with self.profiler.phase("shard_batch"):
+            batch = self.shard_batch(*batch)
         t0 = time.perf_counter()
-        if self.tc.ema_decay > 0:
-            self.params, self.opt_state, self.ema, loss = self._step(
-                self.params, self.opt_state, self.ema, *batch
-            )
-        else:
-            self.params, self.opt_state, loss = self._step(
-                self.params, self.opt_state, *batch
-            )
+        with self.profiler.phase("step_dispatch"):
+            if self.tc.ema_decay > 0:
+                self.params, self.opt_state, self.ema, loss = self._step(
+                    self.params, self.opt_state, self.ema, *batch
+                )
+            else:
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, *batch
+                )
         if sync:
-            loss = float(loss)
+            with self.profiler.phase("loss_sync"):
+                loss = float(loss)
         dt = time.perf_counter() - t0
         global_metrics.observe("train_step_seconds", dt)
         # Fleet telemetry (ISSUE 4): instantaneous step cadence and token
@@ -370,7 +426,48 @@ class Trainer:
             global_metrics.set_gauge(
                 "train_tokens_per_second", float(batch[0].size) / dt
             )
+        self._update_mfu(dt, batch)
+        self.profiler.export_shares()
         return loss
+
+    def _update_mfu(self, dt: float, batch: tuple) -> None:
+        """Rolling MFU gauge (`train_mfu`) from the model's analytic
+        FLOP estimate over an EWMA of the measured step time — the
+        bench's one-shot MFU made continuous.  Models without a
+        transformer-shaped config (no analytic FLOP count) skip the
+        gauge rather than publish a wrong number; unknown device kinds
+        (CPU) read 0.0 against a zero peak."""
+        cfg = getattr(self.model, "cfg", None)
+        if (
+            dt <= 0.0 or not batch
+            or cfg is None
+            or not all(hasattr(cfg, a) for a in
+                       ("max_seq", "n_heads", "d_head", "n_layers"))
+        ):
+            return
+        if self._n_params is None:
+            # First measured step: jit compile ran inside this window
+            # (seconds against a sub-second steady step), and seeding
+            # the EWMA with it would understate MFU for many steps —
+            # the same compile-warmup skip every timed surface here
+            # applies (bench warmup, the batcher's timed-round skip).
+            self._n_params = sum(
+                int(x.size) for x in jax.tree.leaves(self.params)
+            )
+            return
+        flops = model_flops_per_step(
+            cfg, self._n_params, int(batch[0].shape[0])
+        )
+        self._step_ewma_s = (
+            dt if self._step_ewma_s is None
+            else 0.2 * dt + 0.8 * self._step_ewma_s
+        )
+        peak = (
+            self.peak_flops if self.peak_flops is not None
+            else device_peak_flops()
+        )
+        mfu = (flops / self._step_ewma_s / peak) if peak > 0.0 else 0.0
+        global_metrics.set_gauge("train_mfu", mfu)
 
     def step_many(self, xs, ys) -> float:
         """Run ``xs.shape[0]`` chained optimizer steps as ONE jitted

@@ -12,11 +12,13 @@ from .logstore import LogEntry, LogStore, LogStoreHandler, global_logstore
 from .obs import (
     MetricsServer,
     render_fleet,
+    render_profile,
     render_requests,
     render_route,
     render_top,
     render_top_columns,
 )
+from .profiler import PhaseProfiler, chrome_trace, profile_snapshot
 from .profiling import profile_trainer, step_annotation, trace, trace_files
 from .tracing import (
     SpanContext,
@@ -54,6 +56,10 @@ __all__ = [
     "LogStoreHandler",
     "global_logstore",
     "MetricsServer",
+    "PhaseProfiler",
+    "chrome_trace",
+    "profile_snapshot",
+    "render_profile",
     "SpanContext",
     "Tracer",
     "format_traceparent",

@@ -445,6 +445,9 @@ def default_rule_pack(
     tenant_burn_threshold: float | None = None,
     tenant_for_s: float = 60.0,
     replica_down_for_s: float = 0.0,
+    compile_storm_rate: float = 0.1,
+    compile_window: float = 60.0,
+    compile_for_s: float = 30.0,
 ) -> list:
     """The platform's default recording + alerting rules.
 
@@ -461,7 +464,11 @@ def default_rule_pack(
     replica, on ``fleet_replica_up`` — the federation collector drops
     it to 0 after M consecutive scrape failures, so the hold lives in
     the collector's ``down_after`` and ``replica_down_for_s`` defaults
-    to 0: the M-th failed scrape walks pending→firing in one tick).
+    to 0: the M-th failed scrape walks pending→firing in one tick),
+    and CompileStorm (rate of ``xla_compiles_total`` over
+    ``compile_window`` — steady-state serving compiles zero new
+    executables, so a sustained rate above ``compile_storm_rate``
+    means shapes are churning on live traffic).
 
     ``tenant_slo``/``tenant_burn_threshold`` default to ``slo``/
     ``burn_threshold``.  Rules whose input families are absent (no
@@ -579,6 +586,24 @@ def default_rule_pack(
             annotation=(
                 "replica {replica} unreachable — scrape failed for "
                 "consecutive federation ticks"
+            ),
+        ),
+        AlertingRule(
+            # Steady-state serving/training compiles ZERO new XLA
+            # executables after warmup (the conftest recompile guard
+            # pins that in CI); a sustained nonzero compile rate in
+            # production means a static-shape regression is minting
+            # fresh programs on live traffic — seconds of dead air per
+            # compile on a tunneled TPU.  The 30 s hold lets warmup
+            # bursts (restart, new bucket ladder) pass without paging.
+            "CompileStorm",
+            lambda ctx: ctx.rate("xla_compiles_total", compile_window),
+            above=compile_storm_rate, for_s=compile_for_s,
+            severity="page",
+            annotation=(
+                "XLA recompiling at {value:.2f}/s in steady state — "
+                "static-shape regression? (utils/compat.py compile "
+                "telemetry; obs profile shows the compile counters)"
             ),
         ),
     ]

@@ -14,6 +14,8 @@ import threading
 _compile_lock = threading.Lock()
 _install_lock = threading.Lock()
 _installed = False
+_telemetry_installed = False
+_telemetry_registry = None
 
 
 @contextlib.contextmanager
@@ -72,3 +74,52 @@ def serialize_xla_compiles() -> None:
 
         _compiler.backend_compile_and_load = locked
         _installed = True
+
+
+def install_compile_telemetry(registry=None) -> None:
+    """Promote XLA compile counting from a test-only conftest fixture
+    into runtime telemetry: every real backend compile (the
+    ``/jax/core/compile/backend_compile_duration`` jax.monitoring event
+    — executable-cache hits fire nothing) bumps ``xla_compiles_total``
+    and lands its duration in ``xla_compile_seconds``.
+
+    Steady-state continuous batching compiles ZERO new executables after
+    warmup (the recompile guard ``tests/conftest.py`` pins in CI); a
+    nonzero steady-state rate is the silent killer — every stray compile
+    is seconds of dead air per occurrence on a tunneled TPU — and the
+    ``CompileStorm`` rule in ``utils.alerts.default_rule_pack`` alerts
+    on exactly this counter's rate.
+
+    Idempotent and process-global (jax.monitoring has no per-listener
+    unregister): the first caller's *registry* wins — pass one only in
+    single-registry processes; the default is the process-global
+    registry, which is correct for multi-replica processes too (compiles
+    are a per-process resource, not a per-replica one)."""
+    global _telemetry_installed, _telemetry_registry
+    with _install_lock:
+        if _telemetry_installed:
+            return
+        from .metrics import global_metrics
+
+        _telemetry_registry = registry if registry is not None else global_metrics
+        import jax
+
+        def _on_event(event, duration, **kw):
+            if event == "/jax/core/compile/backend_compile_duration":
+                _telemetry_registry.inc("xla_compiles_total")
+                _telemetry_registry.observe(
+                    "xla_compile_seconds", float(duration)
+                )
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        _telemetry_installed = True
+
+
+def xla_compile_count() -> int:
+    """Process-wide backend-compile count from the installed telemetry
+    (0 until ``install_compile_telemetry`` has run) — the recompile
+    guard's read surface: ``snap = xla_compile_count(); ...;
+    assert xla_compile_count() == snap``."""
+    if _telemetry_registry is None:
+        return 0
+    return int(_telemetry_registry.counter("xla_compiles_total"))

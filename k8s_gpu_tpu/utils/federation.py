@@ -68,6 +68,14 @@ GAUGE_AGG: dict[str, str] = {
     "workqueue_depth": "sum",
     "train_tokens_per_second": "sum",
     "pool_ready_ratio": "min",
+    # Attribution plane (ISSUE 9): a phase's fleet share/MFU is the
+    # replica mean (summing shares of one wall clock is meaningless);
+    # bandwidth keeps the default-max "hottest member" view but is
+    # listed here so the policy is explicit, not accidental.
+    "serve_phase_share": "avg",
+    "train_phase_share": "avg",
+    "train_mfu": "avg",
+    "collective_bytes_per_second": "max",
 }
 
 # Families the collector never writes aggregates for: the fleet

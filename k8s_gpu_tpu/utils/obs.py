@@ -37,7 +37,11 @@ class MetricsServer:
     without an evaluator ticking).  ``journal`` is a
     ``serve.journal.RequestJournal`` — ``/debug/requests`` serves its
     per-request records, filterable by ``tenant=``, ``reason=``,
-    ``trace_id=``, ``limit=``.  The handler instruments ITSELF through
+    ``trace_id=``, ``limit=``.  ``profile`` is a
+    ``utils.profiler.PhaseProfiler`` — ``/debug/profile`` serves the
+    continuous performance-attribution snapshot (per-phase p50/p95/
+    share, XLA compile telemetry, per-axis collective bandwidth —
+    ``obs profile`` renders it).  The handler instruments ITSELF through
     ``RequestMetricsMixin`` (server label ``"obs"``), so scrape traffic
     shows up in ``http_requests_total`` like every other HTTP plane.
     """
@@ -52,12 +56,14 @@ class MetricsServer:
         alerts=None,
         fleet=None,
         journal=None,
+        profile=None,
     ):
         self.registry = registry or global_metrics
         self.tracer = tracer or global_tracer
         self.alerts = alerts
         self.fleet = fleet
         self.journal = journal
+        self.profile = profile
         self.started_at = time.time()
         self._ready_check = ready_check
         outer = self
@@ -65,8 +71,8 @@ class MetricsServer:
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
             metrics_server_label = "obs"
             known_routes = (
-                "/debug/requests", "/debug/traces", "/metrics", "/alerts",
-                "/fleet", "/healthz", "/readyz",
+                "/debug/profile", "/debug/requests", "/debug/traces",
+                "/metrics", "/alerts", "/fleet", "/healthz", "/readyz",
             )
 
             def _get(self):
@@ -80,6 +86,8 @@ class MetricsServer:
                     self._traces()
                 elif path == "/debug/requests":
                     self._requests()
+                elif path == "/debug/profile":
+                    self._profile()
                 elif path == "/fleet":
                     self._fleet()
                 elif path == "/healthz":
@@ -157,6 +165,23 @@ class MetricsServer:
                     json.dumps(outer.fleet.snapshot()).encode(),
                     "application/json",
                 )
+
+            def _profile(self):
+                if outer.profile is None:
+                    return self._send(
+                        404,
+                        json.dumps(
+                            {"error": "no phase profiler attached"}
+                        ).encode(),
+                        "application/json",
+                    )
+                from .profiler import profile_snapshot
+
+                body = json.dumps(
+                    profile_snapshot(outer.profile, outer.registry),
+                    sort_keys=True,
+                ).encode()
+                self._send(200, body, "application/json")
 
             def _requests(self):
                 if outer.journal is None:
@@ -550,6 +575,63 @@ def render_requests(records: list[dict]) -> str:
             f"{r.get('trace_id') or '-'}"
         )
         lines.append(line)
+    return "\n".join(lines)
+
+
+def render_profile(snap: dict) -> str:
+    """The ``obs profile`` view of one ``/debug/profile`` snapshot (or
+    its ``snapshot_from_exposition`` offline reconstruction): the
+    per-phase attribution table, the residual, compile telemetry, and
+    the per-axis collective bandwidth — with the jax.profiler deep-dive
+    path cross-linked at the bottom."""
+    phases = snap.get("phases", {})
+    plane = snap.get("plane") or "?"
+    lines = [
+        f"PHASE ATTRIBUTION  (plane={plane}, "
+        f"window {snap.get('window_s', 0):g}s, "
+        f"span {snap.get('span_s', 0):.1f}s)",
+        "",
+        f"  {'PHASE':<22} {'COUNT':>7} {'P50(MS)':>9} {'P95(MS)':>9} "
+        f"{'EWMA(MS)':>9} {'SHARE':>7}",
+    ]
+    if not phases:
+        lines.append("  (no phase samples recorded yet)")
+    for ph in sorted(
+        phases, key=lambda p: -phases[p].get("share", 0.0)
+    ):
+        st = phases[ph]
+        ewma = st.get("ewma_s")
+        lines.append(
+            f"  {ph:<22} {st.get('count', 0):>7} "
+            f"{st.get('p50_s', 0.0) * 1000:>9.2f} "
+            f"{st.get('p95_s', 0.0) * 1000:>9.2f} "
+            f"{(f'{ewma * 1000:.2f}' if ewma is not None else '-'):>9} "
+            f"{st.get('share', 0.0):>7.1%}"
+        )
+    res = snap.get("residual_share")
+    if res is not None:
+        lines.append(f"  {'(residual)':<22} {'':>7} {'':>9} {'':>9} {'':>9} "
+                     f"{res:>7.1%}")
+    comp = snap.get("compile") or {}
+    lines.append("")
+    lines.append(
+        f"xla compiles: {comp.get('compiles_total', 0):.0f} total, "
+        f"{comp.get('compile_seconds_sum', 0.0):.2f}s spent, "
+        f"p95 {comp.get('compile_p95_s', 0.0) * 1000:.0f}ms "
+        "(steady state should add zero — CompileStorm pages on the rate)"
+    )
+    coll = snap.get("collectives") or {}
+    if coll:
+        lines.append("")
+        lines.append(f"  {'AXIS':<8} {'BANDWIDTH':>12}")
+        for axis in sorted(coll):
+            bw = coll[axis].get("bytes_per_second", 0.0)
+            lines.append(f"  {axis:<8} {bw / 1e9:>10.3f} GB/s")
+    lines.append("")
+    lines.append(
+        "deep dive (per-op device timing, HBM): utils.profiling.trace / "
+        "profile_trainer -> jax.profiler xplane (TensorBoard/xprof)"
+    )
     return "\n".join(lines)
 
 

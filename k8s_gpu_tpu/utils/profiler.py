@@ -1,0 +1,484 @@
+"""Continuous phase-level performance attribution — where the time goes.
+
+ROADMAP items 2 (prefill/decode worker-ratio shifting), 3 (raw speed) and
+5 (bandwidth-aware scheduling) all steer on the SAME missing signal: the
+running system's per-phase time split.  Until now that split existed only
+as a one-shot offline study (`docs/perf/mfu_breakdown.md` via
+`tools/profile_step.py`) — a kernel win or regression was invisible until
+the next manual bench.  This module is the always-on half:
+
+- **PhaseProfiler** — named-phase instrumentation for a hot loop.  A
+  per-thread phase *stack* attributes SELF time (entering a nested phase
+  pauses the enclosing one), so wrapping coarse regions around fine ones
+  keeps every phase disjoint and the shares a true partition.  Per phase:
+  a bounded reservoir (exact p50/p95 over recent samples), an EWMA, and
+  share-of-window accounting over a rolling wall window with the
+  *residual* (unattributed time) reported — shares sum to <= 1.0 by
+  construction.  All time flows through an injected ``utils.clock.Clock``
+  (default ``RealClock``), so a ``FakeClock`` run is two-run
+  bit-identical — the same determinism contract the alert FSM and the
+  federation collector already keep (graftcheck enforces it: this module
+  is in the determinism planes).
+- **profile_snapshot** — the ``/debug/profile`` JSON body: per-phase
+  p50/p95/ewma/share + residual, XLA compile telemetry
+  (``xla_compiles_total`` / ``xla_compile_seconds``, installed by
+  ``utils.compat.install_compile_telemetry``), and the per-axis
+  collective bandwidth gauges (``parallel/collectives.py``).
+- **chrome_trace** — Chrome/Perfetto trace-event export of the span ring
+  (``/debug/traces`` shape) plus the profiler's rolling phase samples;
+  ``obs profile --chrome-trace out.json`` writes it, and the file loads
+  directly in ui.perfetto.dev.
+
+Metric families (one label set each; ``docs/platform/observability.md``
+documents them and graftcheck keeps the two in sync):
+``serve_phase_seconds{phase}`` / ``serve_phase_share{phase}`` for the
+serve plane (the continuous batcher's seams), ``train_phase_seconds`` /
+``train_phase_share`` for the training runner, which also exports the
+rolling ``train_mfu`` gauge.  For the TPU-native deep dive (per-op device
+timing, HBM), the ``jax.profiler`` wrappers in ``utils/profiling.py``
+remain the tool — this module answers "which phase", that one answers
+"which op".
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from contextlib import contextmanager
+
+from .clock import Clock, RealClock
+from .metrics import MetricsRegistry, global_metrics, parse_exposition
+
+
+class _PhaseStat:
+    """Cumulative per-phase accounting (guarded by the profiler lock)."""
+
+    __slots__ = ("count", "total_s", "ewma_s", "reservoir")
+
+    def __init__(self, reservoir: int):
+        self.count = 0
+        self.total_s = 0.0
+        self.ewma_s = 0.0
+        # Per-INSTANCE reservoir, deliberately separate from the
+        # registry histogram the same sample lands in: the registry may
+        # be shared (global_metrics across several batchers/trainers in
+        # one process — the bench does exactly this), so its reservoir
+        # mixes instances and outlives restarts; snapshot()'s p50/p95
+        # must describe THIS profiler's window only.
+        self.reservoir: "deque[float]" = deque(maxlen=reservoir)
+
+
+class _Seg:
+    """One open frame of the per-thread phase stack."""
+
+    __slots__ = ("name", "acc", "last")
+
+    def __init__(self, name: str, now: float):
+        self.name = name
+        self.acc = 0.0   # self-time accumulated before the current run
+        self.last = now  # start of the current run
+
+
+class PhaseProfiler:
+    """Bounded, Clock-driven phase accounting for one plane.
+
+    ``plane`` selects the metric family the samples land in:
+    ``"serve"`` → ``serve_phase_seconds{phase}`` histograms +
+    ``serve_phase_share{phase}`` gauges, ``"train"`` → the ``train_``
+    pair.  ``window_s`` is the share-accounting window;
+    ``reservoir`` bounds the per-phase percentile reservoir and
+    ``max_samples`` the rolling (t_end, phase, dt) sample ring the
+    share math and the Chrome-trace export read.
+
+    Threading: ``phase``/``push``/``pop`` keep a *per-thread* stack
+    (nested phases record self-time, never double-count); the shared
+    stats/window are lock-guarded — scrape/snapshot readers on HTTP
+    threads race the recording thread safely.
+    """
+
+    _GUARDED_BY = {"_lock": ("_stats", "_window", "_win_sums")}
+
+    def __init__(
+        self,
+        plane: str = "serve",
+        registry: MetricsRegistry | None = None,
+        clock: Clock | None = None,
+        window_s: float = 60.0,
+        reservoir: int = 512,
+        ewma_alpha: float = 0.2,
+        max_samples: int = 2048,
+    ):
+        if plane not in ("serve", "train"):
+            raise ValueError(
+                f"unknown profiler plane {plane!r}: 'serve' or 'train'"
+            )
+        self.plane = plane
+        self.registry = registry if registry is not None else global_metrics
+        self.clock = clock or RealClock()
+        self.window_s = max(1e-6, float(window_s))
+        self.reservoir = max(8, int(reservoir))
+        self.alpha = min(1.0, max(1e-6, float(ewma_alpha)))
+        self._lock = threading.Lock()
+        self._stats: dict[str, _PhaseStat] = {}
+        # Rolling (t_end, phase, self_seconds) samples — the share window
+        # AND the Chrome-trace phase track.  Bounded manually (not via
+        # deque maxlen) so the incremental per-phase window sums below
+        # stay exact: every eviction subtracts what the append added.
+        self._max_samples = max(64, int(max_samples))
+        self._window: "deque[tuple]" = deque()
+        # phase -> seconds currently inside the window.  Incremental so
+        # export_shares is O(evicted + phases), not O(window) — it runs
+        # on the batcher's gauge-refresh cadence (every drain).
+        self._win_sums: dict[str, float] = {}
+        self._t0 = self.clock.now()
+        self._tls = threading.local()
+
+    # -- recording ---------------------------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def push(self, name: str) -> None:
+        """Enter *name* on this thread's phase stack.  The enclosing
+        phase (if any) stops accumulating — nested phases record SELF
+        time, so shares stay a partition of wall time."""
+        now = self.clock.now()
+        stack = self._stack()
+        if stack:
+            top = stack[-1]
+            top.acc += now - top.last
+        stack.append(_Seg(name, now))
+
+    def pop(self) -> float:
+        """Exit the current phase, record its self-time sample, resume
+        the enclosing phase.  Returns the recorded seconds."""
+        now = self.clock.now()
+        stack = self._stack()
+        seg = stack.pop()
+        if stack:
+            stack[-1].last = now
+        dt = seg.acc + (now - seg.last)
+        self.record(seg.name, dt, end=now)
+        return dt
+
+    @contextmanager
+    def phase(self, name: str):
+        """``with profiler.phase("decode_dispatch"): ...`` — the stack
+        form of ``record`` (exception-safe; nested phases subtract)."""
+        self.push(name)
+        try:
+            yield
+        finally:
+            self.pop()
+
+    def record(self, name: str, seconds: float, end: float | None = None) -> None:
+        """Record one completed phase sample of *seconds* ending at
+        *end* (default: now).  The direct form for callers that already
+        hold both timestamps."""
+        dt = max(0.0, float(seconds))
+        now = self.clock.now() if end is None else end
+        with self._lock:
+            st = self._stats.get(name)
+            if st is None:
+                st = self._stats[name] = _PhaseStat(self.reservoir)
+            st.count += 1
+            st.total_s += dt
+            st.reservoir.append(dt)
+            st.ewma_s = (
+                dt if st.count == 1
+                else self.alpha * dt + (1.0 - self.alpha) * st.ewma_s
+            )
+            self._evict_locked(now - self.window_s)
+            while len(self._window) >= self._max_samples:
+                _, old_name, old_dt = self._window.popleft()
+                self._win_sums[old_name] -= old_dt
+            self._window.append((now, name, dt))
+            self._win_sums[name] = self._win_sums.get(name, 0.0) + dt
+        # Outside the profiler lock: the registry has its own.
+        if self.plane == "train":
+            self.registry.observe("train_phase_seconds", dt, phase=name)
+        else:
+            self.registry.observe("serve_phase_seconds", dt, phase=name)
+
+    def _evict_locked(self, cut: float) -> None:
+        """Drop window samples older than *cut*, keeping the per-phase
+        sums exact.  Lock held by caller."""
+        while self._window and self._window[0][0] < cut:
+            _, name, dt = self._window.popleft()
+            self._win_sums[name] -= dt
+
+    # -- shares ------------------------------------------------------------
+    def shares(self, now: float | None = None) -> tuple[dict, float, float]:
+        """``(per_phase_share, residual, span_s)`` over the trailing
+        window.  A sample straddling the window edge attributes fully,
+        so the raw sums can slightly exceed the span — shares are then
+        normalized so they stay a partition (sum <= 1.0) and the
+        residual is the honest unattributed remainder."""
+        now = self.clock.now() if now is None else now
+        with self._lock:
+            self._evict_locked(now - self.window_s)
+            # Clamp at 0: subtract-on-evict float drift must never leak
+            # a tiny negative share.
+            per = {
+                name: max(0.0, v) for name, v in self._win_sums.items()
+            }
+            phases = sorted(self._stats)
+        span = min(self.window_s, max(1e-9, now - self._t0))
+        # Edge samples attribute fully, so the measured total can poke
+        # past the span — dividing by max(span, total) keeps the shares
+        # a partition (sum <= 1.0) without distorting the common case.
+        denom = max(span, sum(per.values()))
+        out = {ph: per.get(ph, 0.0) / denom for ph in phases}
+        residual = max(0.0, 1.0 - sum(out.values()))
+        return out, residual, span
+
+    def export_shares(self) -> None:
+        """Write the current shares as ``{plane}_phase_share{phase}``
+        gauges (plus ``phase="residual"``) into the registry — called
+        from the instrumented loop at its own cadence (the batcher's
+        gauge refresh, the trainer's step tail)."""
+        per, residual, _ = self.shares()
+        if self.plane == "train":
+            for ph, v in per.items():
+                self.registry.set_gauge("train_phase_share", v, phase=ph)
+            self.registry.set_gauge(
+                "train_phase_share", residual, phase="residual"
+            )
+        else:
+            for ph, v in per.items():
+                self.registry.set_gauge("serve_phase_share", v, phase=ph)
+            self.registry.set_gauge(
+                "serve_phase_share", residual, phase="residual"
+            )
+
+    # -- read surface ------------------------------------------------------
+    @staticmethod
+    def _quantile(sorted_vals: list, q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        k = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+        return sorted_vals[k]
+
+    def snapshot(self) -> dict:
+        """The profiler's half of the ``/debug/profile`` body: per-phase
+        count/total/ewma/p50/p95/share, the residual, and the rolling
+        sample ring (the Chrome-trace phase track).  Deterministic under
+        ``FakeClock``: every number derives from recorded samples and
+        clock reads — two identically-scripted runs serialize
+        byte-identically."""
+        now = self.clock.now()
+        per, residual, span = self.shares(now)
+        with self._lock:
+            stats = {
+                ph: (st.count, st.total_s, st.ewma_s, sorted(st.reservoir))
+                for ph, st in self._stats.items()
+            }
+            samples = [[t, ph, dt] for t, ph, dt in self._window]
+        phases = {}
+        for ph in sorted(stats):
+            count, total_s, ewma_s, res = stats[ph]
+            phases[ph] = {
+                "count": count,
+                "total_s": round(total_s, 9),
+                "ewma_s": round(ewma_s, 9),
+                "p50_s": round(self._quantile(res, 0.5), 9),
+                "p95_s": round(self._quantile(res, 0.95), 9),
+                "share": round(per.get(ph, 0.0), 9),
+            }
+        return {
+            "plane": self.plane,
+            "now": now,
+            "window_s": self.window_s,
+            "span_s": round(span, 9),
+            "phases": phases,
+            "residual_share": round(residual, 9),
+            "samples": samples,
+        }
+
+
+def profile_snapshot(
+    profiler: PhaseProfiler | None = None,
+    registry: MetricsRegistry | None = None,
+) -> dict:
+    """The full ``/debug/profile`` JSON body: the profiler's phase view
+    plus the registry-resident attribution families — XLA compile
+    telemetry and the per-axis collective bandwidth gauges.  Either half
+    may be absent (a control-plane-only registry has no phases; a fresh
+    profiler has no compiles) — the shape stays stable."""
+    reg = registry if registry is not None else (
+        profiler.registry if profiler is not None else global_metrics
+    )
+    snap = (
+        profiler.snapshot() if profiler is not None
+        else {
+            "plane": None, "now": 0.0, "window_s": 0.0, "span_s": 0.0,
+            "phases": {}, "residual_share": None, "samples": [],
+        }
+    )
+    hist = reg.histogram("xla_compile_seconds")
+    snap["compile"] = {
+        "compiles_total": reg.counter("xla_compiles_total"),
+        "compile_seconds_sum": round(hist.total, 9) if hist else 0.0,
+        "compile_p95_s": round(
+            reg.percentile("xla_compile_seconds", 0.95), 9
+        ),
+    }
+    coll: dict[str, dict] = {}
+    for lbls, v in sorted(reg.series("collective_bytes_per_second").items()):
+        axis = dict(lbls).get("axis")
+        if axis:
+            coll[axis] = {"bytes_per_second": v}
+    for lbls, q in sorted(
+        reg.hist_percentiles("collective_seconds", 0.5).items()
+    ):
+        d = dict(lbls)
+        axis, op = d.get("axis"), d.get("op", "?")
+        if axis:
+            coll.setdefault(axis, {}).setdefault("p50_s", {})[op] = round(q, 9)
+    snap["collectives"] = coll
+    snap["deep_dive"] = (
+        "TPU-native per-op timing: utils.profiling.trace / "
+        "profile_trainer (jax.profiler xplane -> TensorBoard/xprof)"
+    )
+    return snap
+
+
+def snapshot_from_exposition(text: str) -> dict:
+    """Reconstruct a ``/debug/profile``-shaped snapshot from one
+    Prometheus text exposition (a live ``/metrics`` scrape or the
+    persisted ``metrics.prom``) — the ``obs profile`` offline path.
+    Percentiles come from the cumulative ``_bucket`` series (the
+    ``histogram_quantile`` estimate, ``utils.federation.bucket_quantile``);
+    shares/residual from the exported share gauges.  Train-plane phases
+    ride the same table prefixed ``train:``."""
+    from .federation import bucket_quantile
+
+    fams = parse_exposition(text)
+    phases: dict[str, dict] = {}
+    residual = None
+    for plane, share_fam, sec_fam in (
+        ("serve", "serve_phase_share", "serve_phase_seconds"),
+        ("train", "train_phase_share", "train_phase_seconds"),
+    ):
+        shares = fams.get(share_fam, {})
+        buckets = fams.get(f"{sec_fam}_bucket", {})
+        counts = fams.get(f"{sec_fam}_count", {})
+        names = set()
+        for lbls in list(shares) + list(counts):
+            ph = dict(lbls).get("phase")
+            if ph and ph != "residual":
+                names.add(ph)
+        for ph in sorted(names):
+            key = ph if plane == "serve" else f"train:{ph}"
+            sub = {
+                l: v for l, v in buckets.items()
+                if dict(l).get("phase") == ph
+            }
+            phases[key] = {
+                "count": int(counts.get((("phase", ph),), 0.0)),
+                "p50_s": bucket_quantile(sub, 0.5) or 0.0,
+                "p95_s": bucket_quantile(sub, 0.95) or 0.0,
+                "share": shares.get((("phase", ph),), 0.0),
+            }
+        r = shares.get((("phase", "residual"),))
+        if r is not None and plane == "serve":
+            residual = r
+    compiles = sum(fams.get("xla_compiles_total", {}).values())
+    csum = sum(fams.get("xla_compile_seconds_sum", {}).values())
+    coll = {}
+    for lbls, v in sorted(
+        fams.get("collective_bytes_per_second", {}).items()
+    ):
+        axis = dict(lbls).get("axis")
+        if axis:
+            coll[axis] = {"bytes_per_second": v}
+    return {
+        "plane": "snapshot",
+        "now": 0.0,
+        "window_s": 0.0,
+        "span_s": 0.0,
+        "phases": phases,
+        "residual_share": residual,
+        "samples": [],
+        "compile": {
+            "compiles_total": compiles,
+            "compile_seconds_sum": csum,
+            "compile_p95_s": bucket_quantile(
+                fams.get("xla_compile_seconds_bucket", {}), 0.95
+            ) or 0.0,
+        },
+        "collectives": coll,
+        "deep_dive": (
+            "TPU-native per-op timing: utils.profiling.trace / "
+            "profile_trainer (jax.profiler xplane -> TensorBoard/xprof)"
+        ),
+    }
+
+
+# -- Chrome/Perfetto trace export --------------------------------------------
+
+def _walk_tree(node: dict, pid: int, tid: int, events: list) -> None:
+    start = float(node.get("start", 0.0))
+    dur_ms = float(node.get("duration_ms", 0.0))
+    args = dict(node.get("attributes") or {})
+    if node.get("status", "ok") != "ok":
+        args["status"] = node.get("status")
+    events.append({
+        "name": str(node.get("name", "?")),
+        "ph": "X",
+        "ts": start * 1e6,
+        "dur": max(0.0, dur_ms * 1e3),
+        "pid": pid,
+        "tid": tid,
+        "args": {k: str(v) for k, v in sorted(args.items())},
+    })
+    for child in node.get("children", ()):
+        _walk_tree(child, pid, tid, events)
+
+
+def chrome_trace(traces: list | None = None,
+                 profile: dict | None = None) -> dict:
+    """Chrome trace-event JSON (the Perfetto-loadable format) from the
+    assembled span ring (the ``/debug/traces`` shape) and a profile
+    snapshot's rolling phase samples.  Spans render under pid 1 (one
+    Perfetto track per trace), phase samples under pid 2 (one track per
+    phase).  Events are sorted by timestamp — monotonic ``ts`` is part
+    of the format contract the export test pins."""
+    events: list[dict] = []
+    meta: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": "spans"}},
+        {"name": "process_name", "ph": "M", "pid": 2, "tid": 0,
+         "args": {"name": "phases"}},
+    ]
+    for i, trace in enumerate(traces or []):
+        tid = i + 1
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+            "args": {"name": f"trace {str(trace.get('trace_id', '?'))[:8]}"},
+        })
+        for root in trace.get("tree", ()):
+            _walk_tree(root, 1, tid, events)
+    if profile:
+        names = sorted({ph for _, ph, _ in profile.get("samples", [])})
+        tids = {ph: i + 1 for i, ph in enumerate(names)}
+        for ph, tid in tids.items():
+            meta.append({
+                "name": "thread_name", "ph": "M", "pid": 2, "tid": tid,
+                "args": {"name": ph},
+            })
+        for t_end, ph, dt in profile.get("samples", []):
+            events.append({
+                "name": str(ph),
+                "ph": "X",
+                "ts": (float(t_end) - float(dt)) * 1e6,
+                "dur": float(dt) * 1e6,
+                "pid": 2,
+                "tid": tids[ph],
+                "args": {},
+            })
+    events.sort(key=lambda e: (e["ts"], e["pid"], e["tid"]))
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}

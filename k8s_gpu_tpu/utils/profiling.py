@@ -7,13 +7,21 @@ captures device/XLA activity into a TensorBoard-readable directory, step
 annotations so train steps show as named rows, and a helper that profiles
 N steps of a Trainer.  On TPU the trace includes per-op device timing and
 HBM usage — the tool for verifying the MXU is actually busy.
+
+This is the DEEP-DIVE path; the always-on counterpart is
+``utils/profiler.py`` (continuous phase attribution: ``/debug/profile``,
+``obs profile``) — it answers "which phase", this module answers "which
+op".  Wall-clock here flows through an injected ``utils.clock.Clock``
+(graftcheck det-wallclock compliance: this module is in the determinism
+planes), so a ``FakeClock`` caller replays deterministically.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
 from pathlib import Path
+
+from .clock import Clock, RealClock
 
 
 @contextlib.contextmanager
@@ -39,19 +47,37 @@ def step_annotation(name: str, step: int):
 
 
 def profile_trainer(trainer, data_iter, steps: int,
-                    log_dir: str | Path) -> dict:
+                    log_dir: str | Path,
+                    clock: Clock | None = None) -> dict:
     """Profile *steps* steps (after one un-traced warmup/compile step so the
     trace shows steady-state device time, not compilation).  Returns
-    {trace_dir, steps, mean_step_s}."""
-    batch = next(data_iter)
+    {trace_dir, steps, mean_step_s}.
+
+    ``data_iter`` must yield at least ``steps + 1`` batches (the extra one
+    feeds the warmup step); a shorter iterator raises ``ValueError``
+    up front instead of leaking a bare ``StopIteration`` mid-trace."""
+    clock = clock or RealClock()
+
+    def draw(drawn: int):
+        try:
+            return next(data_iter)
+        except StopIteration:
+            raise ValueError(
+                f"data_iter exhausted after {drawn} batches: "
+                f"profile_trainer(steps={steps}) draws steps + 1 batches "
+                "(one un-traced warmup step precedes the trace window) — "
+                "pass an iterator yielding at least that many"
+            ) from None
+
+    batch = draw(0)
     trainer.step(*batch)  # compile outside the trace
-    t0 = time.perf_counter()
+    t0 = clock.now()
     with trace(log_dir) as d:
         for i in range(steps):
             with step_annotation("train", i):
-                batch = next(data_iter)
+                batch = draw(i + 1)
                 trainer.step(*batch)
-    wall = time.perf_counter() - t0
+    wall = clock.now() - t0
     return {
         "trace_dir": str(d),
         "steps": steps,

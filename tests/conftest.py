@@ -60,39 +60,25 @@ def _bound_compile_accumulation():
     gc.collect()
 
 
-class _CompileCounter:
-    """Counts real XLA compilations via jax.monitoring duration events
-    (``/jax/core/compile/backend_compile_duration`` fires once per
-    backend compile; executable-cache hits fire nothing).  One listener
-    for the whole process — jax.monitoring has no per-listener
-    unregister, and a dead counter costs one string compare per event."""
-
-    def __init__(self):
-        self.n = 0
-
-    def _on_event(self, event, duration, **kw):
-        if event == "/jax/core/compile/backend_compile_duration":
-            self.n += 1
-
-
-_xla_compile_counter = None
-
-
 @pytest.fixture
 def xla_compiles():
     """The recompile guard (ISSUE 8 satellite): ``snap = fx(); ...;
     assert fx() == snap`` pins a code path as compiling ZERO new
     executables — the steady-state continuous-batching contract that
     silent static-shape regressions (ROADMAP item 3's kernel work)
-    would break first."""
-    global _xla_compile_counter
-    if _xla_compile_counter is None:
-        _xla_compile_counter = _CompileCounter()
-        jax.monitoring.register_event_duration_secs_listener(
-            _xla_compile_counter._on_event
-        )
-    counter = _xla_compile_counter
-    return lambda: counter.n
+    would break first.
+
+    Since ISSUE 9 the listener is the RUNTIME compile telemetry
+    (``utils.compat.install_compile_telemetry``: every backend compile
+    bumps ``xla_compiles_total`` / ``xla_compile_seconds`` — the same
+    counter the ``CompileStorm`` alerting rule pages on), so CI and
+    production watch one instrumentation path."""
+    from k8s_gpu_tpu.utils.compat import (
+        install_compile_telemetry, xla_compile_count,
+    )
+
+    install_compile_telemetry()
+    return xla_compile_count
 
 
 @pytest.fixture

@@ -1,0 +1,350 @@
+"""Continuous performance attribution (ISSUE 9): phase profiler
+determinism, /debug/profile, CompileStorm, Chrome-trace export, the
+batcher's phase seams under a real paged+spec run, fleet aggregation of
+the new gauges, per-axis collective bandwidth, and the profile_trainer
+StopIteration regression.  (Named to sort early in the tier-1 window.)"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.utils.alerts import RuleEvaluator, default_rule_pack
+from k8s_gpu_tpu.utils.clock import FakeClock
+from k8s_gpu_tpu.utils.metrics import MetricsRegistry, global_metrics
+from k8s_gpu_tpu.utils.obs import MetricsServer, render_profile
+from k8s_gpu_tpu.utils.profiler import (
+    PhaseProfiler, chrome_trace, profile_snapshot, snapshot_from_exposition,
+)
+
+
+def _scripted(clock: FakeClock):
+    """One deterministic profiler run: nested phases, a direct record,
+    idle gaps — the fixture both bit-identical tests replay."""
+    reg = MetricsRegistry()
+    prof = PhaseProfiler(plane="serve", registry=reg, clock=clock)
+    with prof.phase("decode_dispatch"):
+        clock.advance(0.05)
+        with prof.phase("spec_draft"):
+            clock.advance(0.02)
+        clock.advance(0.01)
+    prof.record("retire", 0.001)
+    clock.advance(0.5)
+    with prof.phase("decode_dispatch"):
+        clock.advance(0.04)
+    clock.advance(0.1)
+    prof.export_shares()
+    return prof, reg
+
+
+# -- profiler core -----------------------------------------------------------
+
+def test_nested_phases_record_self_time():
+    prof, reg = _scripted(FakeClock())
+    snap = prof.snapshot()
+    # decode_dispatch self-time excludes the nested spec_draft segment:
+    # 0.05 + 0.01 + 0.04; spec_draft carries its own 0.02.
+    assert snap["phases"]["decode_dispatch"]["total_s"] == pytest.approx(0.10)
+    assert snap["phases"]["spec_draft"]["total_s"] == pytest.approx(0.02)
+    assert snap["phases"]["retire"]["total_s"] == pytest.approx(0.001)
+    # The histogram family landed per-phase in the registry.
+    h = reg.histogram("serve_phase_seconds", phase="decode_dispatch")
+    assert h is not None and h.n == 2
+
+
+def test_shares_sum_to_at_most_one_with_residual():
+    prof, reg = _scripted(FakeClock())
+    snap = prof.snapshot()
+    shares = [st["share"] for st in snap["phases"].values()]
+    assert sum(shares) <= 1.0 + 1e-9
+    # Measured 0.121 s over a 0.721 s span; the rest is residual.
+    assert snap["residual_share"] == pytest.approx(
+        1.0 - sum(shares), abs=1e-9
+    )
+    assert snap["residual_share"] > 0.5  # mostly-idle script
+    # Exported gauges mirror the snapshot, residual included.
+    assert reg.gauge(
+        "serve_phase_share", phase="decode_dispatch"
+    ) == pytest.approx(snap["phases"]["decode_dispatch"]["share"], rel=1e-6)
+    assert reg.gauge("serve_phase_share", phase="residual") is not None
+
+
+def test_profile_snapshot_two_runs_bit_identical():
+    a = json.dumps(profile_snapshot(*_scripted(FakeClock())), sort_keys=True)
+    b = json.dumps(profile_snapshot(*_scripted(FakeClock())), sort_keys=True)
+    assert a == b
+
+
+def test_debug_profile_endpoint_bit_identical_and_404():
+    import urllib.error
+    import urllib.request
+
+    bodies = []
+    for _ in range(2):
+        prof, reg = _scripted(FakeClock())
+        srv = MetricsServer(registry=reg, profile=prof).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/profile", timeout=5
+            ) as r:
+                bodies.append(r.read())
+        finally:
+            srv.stop()
+    assert bodies[0] == bodies[1]
+    snap = json.loads(bodies[0])
+    assert "decode_dispatch" in snap["phases"]
+    assert "compile" in snap and "collectives" in snap
+    # render_profile consumes the endpoint shape without error.
+    assert "PHASE ATTRIBUTION" in render_profile(snap)
+    srv = MetricsServer(registry=MetricsRegistry()).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/profile", timeout=5
+            )
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_snapshot_from_exposition_reconstructs_phases():
+    prof, reg = _scripted(FakeClock())
+    snap = snapshot_from_exposition(reg.render())
+    dd = snap["phases"]["decode_dispatch"]
+    assert dd["count"] == 2 and dd["share"] > 0.0
+    assert dd["p95_s"] > 0.0
+    assert snap["residual_share"] is not None
+
+
+# -- CompileStorm ------------------------------------------------------------
+
+def _compile_storm_rule(reg, clock):
+    rules = [
+        r for r in default_rule_pack()
+        if getattr(r, "name", "") == "CompileStorm"
+    ]
+    assert rules, "CompileStorm missing from the default pack"
+    return RuleEvaluator(rules, clock=clock, registry=reg)
+
+
+def test_compile_storm_fires_on_burst_and_resolves():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    ev = _compile_storm_rule(reg, clock)
+    ev.evaluate_once()  # t=0 seeds the rate watch
+    for _ in range(5):  # recompile burst: 20 compiles / 10 s = 2/s
+        reg.inc("xla_compiles_total", 20.0)
+        clock.advance(10.0)
+        ev.evaluate_once()
+    timeline = [t["to"] for t in ev.timeline]
+    assert "pending" in timeline and "firing" in timeline, timeline
+    assert reg.gauge("alerts_firing", alertname="CompileStorm") == 1.0
+    # Storm over: the rate window drains, the alert resolves.
+    for _ in range(10):
+        clock.advance(10.0)
+        ev.evaluate_once()
+    timeline = [t["to"] for t in ev.timeline]
+    assert timeline[-1] == "resolved", timeline
+    assert reg.gauge("alerts_firing", alertname="CompileStorm") == 0.0
+
+
+def test_compile_storm_silent_at_zero_rate():
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    ev = _compile_storm_rule(reg, clock)
+    reg.inc("xla_compiles_total", 5.0)  # warmup compiles, then steady
+    for _ in range(8):
+        clock.advance(10.0)
+        ev.evaluate_once()
+    assert not list(ev.timeline)
+    assert reg.gauge("alerts_firing", alertname="CompileStorm") == 0.0
+
+
+def test_runtime_compile_telemetry_counts_real_compiles(xla_compiles):
+    n0 = xla_compiles()
+    jax.jit(lambda x: x * 3 + 1)(jnp.ones((517,)))  # fresh shape
+    assert xla_compiles() > n0
+    assert global_metrics.histogram("xla_compile_seconds") is not None
+
+
+# -- Chrome trace ------------------------------------------------------------
+
+def test_chrome_trace_valid_json_monotonic_ts():
+    from k8s_gpu_tpu.utils.tracing import Tracer
+
+    clock = FakeClock()
+    tracer = Tracer(registry=MetricsRegistry(), clock=clock)
+    with tracer.span("http POST /generate") as sp:
+        clock.advance(0.01)
+        tracer.add_span(
+            "serve.round", parent=sp.context,
+            start=clock.now(), end=clock.now() + 0.005, round=1,
+        )
+        clock.advance(0.02)
+    prof, _ = _scripted(FakeClock(100.0))
+    data = chrome_trace(tracer.traces(), prof.snapshot())
+    text = json.dumps(data)
+    loaded = json.loads(text)
+    xs = [e for e in loaded["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) >= 5  # 2 spans + 4 phase samples
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+    for e in xs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0.0
+    # Both processes present with thread-name metadata.
+    metas = [e for e in loaded["traceEvents"] if e["ph"] == "M"]
+    assert any(e["pid"] == 1 and e["name"] == "thread_name" for e in metas)
+    assert any(e["pid"] == 2 and e["name"] == "thread_name" for e in metas)
+
+
+# -- the batcher's real seams ------------------------------------------------
+
+def test_batcher_phase_histograms_paged_spec_run():
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=16,
+        d_ff=64, max_seq=64,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    reg = MetricsRegistry()
+    b = ContinuousBatcher(
+        model, params, slots=4, paged_blocks=24, page_size=8,
+        metrics=reg, draft="ngram", spec_k=4,
+    ).start()
+    try:
+        shared = [(j * 5 + 2) % 60 + 2 for j in range(16)]
+        hs = [
+            b.submit(shared + [10 + i], max_new_tokens=24, seed=i)
+            for i in range(3)
+        ]
+        total = sum(len(h.result()) for h in hs)
+        assert total == 72
+    finally:
+        b.stop()
+    for phase in ("admission", "paged_plan", "prefill_dispatch",
+                  "decode_dispatch", "spec_draft", "spec_verify",
+                  "retire"):
+        h = reg.histogram("serve_phase_seconds", phase=phase)
+        assert h is not None and h.n > 0, phase
+    snap = b.profiler.snapshot()
+    assert sum(s["share"] for s in snap["phases"].values()) <= 1.0 + 1e-9
+    # Share gauges exported into the batcher's own registry.
+    assert reg.gauge("serve_phase_share", phase="decode_dispatch") is not None
+    assert reg.gauge("serve_phase_share", phase="residual") is not None
+    # The full snapshot serializes (the /debug/profile body for this
+    # replica) and names the deep-dive path.
+    body = json.dumps(profile_snapshot(b.profiler, reg))
+    assert "jax.profiler" in body
+
+
+# -- fleet aggregation -------------------------------------------------------
+
+def test_fleet_aggregates_phase_and_mfu_gauges():
+    from k8s_gpu_tpu.utils.federation import FleetCollector
+
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.set_gauge("serve_phase_share", 0.5, phase="decode_dispatch")
+    r2.set_gauge("serve_phase_share", 0.3, phase="decode_dispatch")
+    r1.set_gauge("train_mfu", 0.4)
+    r2.set_gauge("train_mfu", 0.2)
+    r1.set_gauge("collective_bytes_per_second", 1e9, axis="dp")
+    r2.set_gauge("collective_bytes_per_second", 3e9, axis="dp")
+    fc = FleetCollector(
+        {"r1": r1.render, "r2": r2.render}, clock=FakeClock()
+    )
+    fc.scrape_once()
+    reg = fc.registry
+    # Relabeled per-replica detail...
+    assert reg.gauge(
+        "serve_phase_share", phase="decode_dispatch", replica="r1"
+    ) == 0.5
+    # ...and the stored aggregates per policy: avg for shares/MFU, max
+    # (hottest member) for bandwidth.
+    assert reg.gauge(
+        "serve_phase_share", phase="decode_dispatch"
+    ) == pytest.approx(0.4)
+    assert reg.gauge("train_mfu") == pytest.approx(0.3)
+    assert reg.gauge(
+        "collective_bytes_per_second", axis="dp"
+    ) == pytest.approx(3e9)
+
+
+# -- per-axis collective bandwidth -------------------------------------------
+
+def test_per_axis_bandwidth_probe_multislice():
+    from k8s_gpu_tpu.parallel.collectives import per_axis_bandwidth_probe
+    from k8s_gpu_tpu.parallel.mesh import MeshConfig, multislice_mesh
+
+    mesh = multislice_mesh(MeshConfig(dp=4, tp=2), num_slices=2)
+    reg = MetricsRegistry()
+    out = per_axis_bandwidth_probe(mesh, mib=0.05, iters=1, registry=reg)
+    assert set(out) == {"dp", "tp"}  # size-1 axes skipped
+    for axis in ("dp", "tp"):
+        assert out[axis]["bytes_per_second"] > 0.0
+        assert reg.gauge(
+            "collective_bytes_per_second", axis=axis
+        ) == pytest.approx(out[axis]["bytes_per_second"])
+        h = reg.histogram("collective_seconds", axis=axis, op="psum")
+        assert h is not None and h.n == 1
+    # The snapshot surfaces them per axis.
+    snap = profile_snapshot(registry=reg)
+    assert set(snap["collectives"]) == {"dp", "tp"}
+
+
+# -- trainer plane -----------------------------------------------------------
+
+def _tiny_trainer():
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+    from k8s_gpu_tpu.parallel import MeshConfig
+    from k8s_gpu_tpu.parallel.mesh import build_mesh
+    from k8s_gpu_tpu.train import TrainConfig, Trainer
+
+    model = TransformerLM(TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=16,
+        d_ff=64, max_seq=16, use_flash=False))
+    return Trainer(
+        model, mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TrainConfig(warmup_steps=1),
+        peak_flops=1e12,
+    )
+
+
+def test_train_step_exports_phase_split_and_rolling_mfu():
+    trainer = _tiny_trainer()
+    trainer.init(jax.random.PRNGKey(0))
+    toks = np.random.default_rng(0).integers(0, 64, (4, 17), dtype=np.int32)
+    for _ in range(2):
+        trainer.step(toks[:, :-1], toks[:, 1:])
+    snap = trainer.profiler.snapshot()
+    for phase in ("shard_batch", "step_dispatch", "loss_sync"):
+        assert snap["phases"][phase]["count"] == 2, phase
+    mfu = global_metrics.gauge("train_mfu")
+    assert mfu is not None and mfu > 0.0  # peak_flops override: nonzero
+    assert global_metrics.gauge(
+        "train_phase_share", phase="step_dispatch"
+    ) is not None
+    h = global_metrics.histogram("train_phase_seconds", phase="loss_sync")
+    assert h is not None and h.n >= 2
+
+
+def test_profile_trainer_guards_short_iterator(tmp_path):
+    from k8s_gpu_tpu.utils.profiling import profile_trainer
+
+    class NullTrainer:
+        def step(self, *batch):
+            return 0.0
+
+    with pytest.raises(ValueError, match="exhausted after 0 batches"):
+        profile_trainer(NullTrainer(), iter([]), steps=2,
+                        log_dir=tmp_path / "p0")
+    # Exhausting MID-window (warmup consumed the only batch) names the
+    # steps+1 contract instead of leaking a bare StopIteration.
+    with pytest.raises(ValueError, match=r"steps \+ 1"):
+        profile_trainer(NullTrainer(), iter([(np.zeros(1),)]), steps=2,
+                        log_dir=tmp_path / "p1")

@@ -26,12 +26,15 @@ def main() -> None:
     import jax.numpy as jnp
 
     sys.path.insert(0, ".")
-    from bench import (
-        PEAK_BF16_FLOPS, _flagship_config, model_flops_per_step,
-    )
+    from bench import _flagship_config
     from k8s_gpu_tpu.models import TransformerLM
     from k8s_gpu_tpu.parallel.mesh import MeshConfig, mesh_from_devices
     from k8s_gpu_tpu.train import TrainConfig, Trainer
+    # The FLOP/peak tables moved into the trainer (ISSUE 9) so the
+    # running system exports train_mfu from the same numbers.
+    from k8s_gpu_tpu.train.runner import (
+        PEAK_BF16_FLOPS, model_flops_per_step,
+    )
 
     devs = jax.devices()
     on_tpu = devs[0].platform == "tpu"
