@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo
 
 # The default verify path (bare `make`): graftcheck invariants + the
 # attribution-plane smoke.  The full suite stays `make test` (it takes
@@ -114,6 +114,14 @@ fleet-demo:
 # the Chrome/Perfetto trace export is written and schema-validated.
 profile-demo:
 	python tools/profile_demo.py
+
+# Fused paged-attention kernel A/B, end to end on CPU interpret mode:
+# op-level kernel-vs-oracle parity (f32 + int8 KV + trash-block poison),
+# then batcher streams gather-vs-kernel byte-identical — greedy and with
+# an int8-compute speculative draft.  The perf ratio itself
+# (cb_paged_kernel_vs_gather_x) is bench.py's job on a TPU host.
+kernel-demo:
+	python tools/kernel_demo.py
 
 # Fleet router smoke: 4 paged replicas behind the prefix-affinity
 # router serve skewed multi-tenant traffic (each tenant's shared prompt

@@ -556,6 +556,8 @@ def paged_kv_probe(model, params) -> dict:
     the block-granular prefix cache) and cb_paged_spec_tokens_per_s
     (paged + speculative + shared prefix in one batcher — the
     composability the r5 constructor refused)."""
+    import jax
+
     from k8s_gpu_tpu.serve import ContinuousBatcher
     from k8s_gpu_tpu.serve.batcher import prompt_bucket
 
@@ -601,6 +603,40 @@ def paged_kv_probe(model, params) -> dict:
         out["cb_paged_tokens_per_s_4req"] = _best_rate(lambda: run(4))
     finally:
         b.stop()
+
+    # Fused paged-decode kernel A/B (ROADMAP item 3): the SAME batcher
+    # config with attn_impl="paged_kernel" vs the gather baseline above
+    # — the only difference is whether decode materializes gathered K/V
+    # or streams blocks through VMEM in-kernel.  TPU-only: off-TPU the
+    # kernel runs in the Pallas interpreter (a correctness path the
+    # parity suite uses, not a perf path), so a CPU ratio would measure
+    # the interpreter, not the kernel.
+    if jax.devices()[0].platform == "tpu":
+        bk = ContinuousBatcher(
+            model, params, slots=8, paged_blocks=n_blocks, page_size=page,
+            attn_impl="paged_kernel",
+        ).start()
+        try:
+            run_k = lambda n_req: sum(
+                len(h.result())
+                for h in [bk.submit(ids, max_new_tokens=n_new)
+                          for _ in range(n_req)]
+            )
+            run_k(1)
+            run_k(4)  # warm both variants
+            out["cb_paged_kernel_tokens_per_s_4req"] = _best_rate(
+                lambda: run_k(4)
+            )
+            out["cb_paged_kernel_vs_gather_x"] = (
+                out["cb_paged_kernel_tokens_per_s_4req"]
+                / out["cb_paged_tokens_per_s_4req"]
+            )
+        finally:
+            bk.stop()
+    else:
+        out["cb_paged_kernel_vs_gather_x"] = (
+            "skipped: kernel A/B requires a TPU device"
+        )
 
     # Shared-prompt scenario (ISSUE 5): block-granular prefix sharing on
     # the paged pool.  A warm admission extends only the suffix past the
@@ -951,6 +987,30 @@ def spec_batcher_probe(model, params) -> dict:
         )
     finally:
         spec.stop()
+    # int8 draft compute A/B: the SAME distilled draft, weights stored
+    # int8 and matmuls run int8×int8→int32 (serve/quant.py:int8_dot) —
+    # the draft's whole job is being cheap, and quantization error only
+    # moves acceptance (the verify pass is exact for ANY draft), so an
+    # aggressive draft is safe where an aggressive target is not.
+    spec8 = ContinuousBatcher(
+        model, params, slots=8, draft=(dm, dp), spec_k=4, draft_int8=True,
+    ).start()
+    try:
+        run(spec8, 1)
+        for _ in range(3):  # same adaptive-K settling as the float draft
+            run(spec8, 4)
+        out["cb_spec_int8_tokens_per_s_4req"] = _best_rate(
+            lambda: run(spec8, 4)
+        )
+        out["cb_spec_int8_measured_acceptance"] = (
+            spec8.spec_stats["acceptance"]
+        )
+        out["cb_draft_int8_vs_bf16_x"] = (
+            out["cb_spec_int8_tokens_per_s_4req"]
+            / out["cb_spec_tokens_per_s_4req"]
+        )
+    finally:
+        spec8.stop()
     # Machinery ceiling: the target AS its own draft.  On a trained
     # model this reads ~1.0; on the barely-trained bench flagship it
     # reads the fraction of decode positions whose argmax margin
@@ -1157,6 +1217,7 @@ def main() -> None:
         "decode_tokens_per_s_int8", "cb_decode_tokens_per_s_1req",
         "cb_decode_tokens_per_s_8req", "cb_batch_scaling_x",
         "cb_spec_vs_plain_x", "cb_spec_measured_acceptance",
+        "cb_draft_int8_vs_bf16_x", "cb_paged_kernel_vs_gather_x",
         "cb_ngram_vs_plain_x", "cb_ngram_vs_plain_x_repetitive",
         "kv_quant_capacity_x", "paged_kv_capacity_x",
         "cb_prefix_ttft_x", "cb_paged_spec_tokens_per_s",

@@ -33,6 +33,10 @@ from . import Finding, ScopeVisitor, rel, tree_for
 # serve batcher is deliberately absent: it is the real-time plane (its
 # latency measurements ARE wall-clock); everything that must replay —
 # routing, journal identity, alert FSMs, federation, operators — is in.
+# ops/ (Pallas kernels, ISSUE 11) is likewise absent by design: kernel
+# code is the real-time plane's compute half — its determinism bar is
+# numeric parity vs an oracle (tests/test_paged_attention_kernel.py),
+# not Clock injection, and it has no ambient-time surface to lint.
 DETERMINISTIC_PLANES = (
     "k8s_gpu_tpu/serve/router.py",
     "k8s_gpu_tpu/serve/journal.py",

@@ -110,6 +110,13 @@ class TransformerConfig:
     # lockstep SPMD (win needs pp >= 4).  Only meaningful with
     # pp_schedule="1f1b".
     pp_virtual_stages: int = 1
+    # Paged-KV attention read for SERVING decode/verify: "gather"
+    # materializes the first t_hi pages row-contiguously per layer
+    # (serve/engine.py:_paged_read); "paged_kernel" streams blocks
+    # through the fused Pallas kernel (ops/paged_attention.py) that
+    # consumes the page tables in-kernel, falling back to gather when
+    # shapes don't tile.  InferenceEngine(attn_impl=...) overrides.
+    attn_impl: str = "gather"
 
     @property
     def moe(self) -> bool:

@@ -1,3 +1,9 @@
 from .attention import flash_attention, reference_attention
+from .paged_attention import paged_attention, paged_attention_reference
 
-__all__ = ["flash_attention", "reference_attention"]
+__all__ = [
+    "flash_attention",
+    "reference_attention",
+    "paged_attention",
+    "paged_attention_reference",
+]

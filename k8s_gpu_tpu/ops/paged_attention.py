@@ -1,0 +1,241 @@
+"""Fused Pallas paged/ragged attention for decode — ROADMAP item 3.
+
+The serving engine's paged decode read (`serve/engine.py:_paged_read`)
+pays a gather tax: every layer of every decode step materializes a
+row-contiguous ``[B, KH, t_hi, Dh]`` copy of the K/V pool (×4 leaves
+under int8 KV) before a single MAC runs.  This kernel consumes the page
+tables **in-kernel** instead — the grid walks each row's ``pages``
+entries and streams physical K/V blocks through VMEM with an online
+(streaming) softmax, so decode never materializes gathered K/V.  The
+layering follows VirtualFlow's logical/physical decoupling (PAPERS.md,
+arXiv 2009.09523): engine code above this line speaks logical KV
+positions; the physical block layout is this kernel's alone.
+
+Mechanics
+---------
+- ``pages`` rides as a **scalar-prefetch** operand
+  (``pltpu.PrefetchScalarGridSpec``): the BlockSpec index map reads
+  ``pages[b, j]`` to pick which physical block the grid step ``(b, h,
+  j)`` streams — the block table IS the DMA schedule, no gather HLO.
+- Ragged ``t_hi``: the grid's trailing axis is ``p_hi = t_hi // page``
+  pages; per-row masking ``kv_start[b] <= t <= start[b] (+ q offset)``
+  is rebuilt in-kernel from iota, matching the engine's mask exactly.
+- Trash-block guard: dead table entries are **0** (the trash block — see
+  ``_paged_store``), never a clamped live index, so a row whose table
+  ends before ``p_hi`` streams the trash block and masks it out rather
+  than reading another tenant's K/V.
+- int8 KV (`serve/quant.py` layout): the pool arrives int8 with f32
+  scales ``[NB, KH, page]``; each block dequantizes in VMEM right after
+  its DMA (``k * scale[:, None]``) so HBM traffic stays 1 byte/elem.
+- GQA: the G query heads sharing a KV head fold into the kernel's row
+  axis (``R = Sq * G``), so each K/V block is streamed once per KV head.
+
+Contract mirrors ``ops/attention.py``: a pure-jnp ``reference`` oracle
+(bit-identical to the engine's gather path), ``interpret=None`` auto-
+selects the Pallas interpreter off-TPU so the same tests run on CPU, and
+``paged_attention`` falls back to the oracle automatically when shapes
+don't tile (the fallback matrix is documented in
+docs/platform/kv-cache.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_INF, _auto_interpret
+
+
+def _sublane(dtype) -> int:
+    """Minimum second-to-minor tile for the dtype (Mosaic packing)."""
+    if dtype == jnp.int8:
+        return 32
+    if dtype == jnp.bfloat16:
+        return 16
+    return 8
+
+
+def paged_attention_reference(q, k_pool, v_pool, pages, start, kv_start,
+                              *, page: int, t_hi: int,
+                              k_scale=None, v_scale=None):
+    """Gather-path oracle: logical-view attention over the first
+    ``t_hi // page`` table entries of every row — the same math as
+    ``_paged_read`` + ``_attend_cached`` (GQA grouped, f32 softmax,
+    -1e30 mask fill), kept here so the kernel has an in-module parity
+    target and a fallback that never diverges from the engine.
+
+    q [B, Sq, H, Dh]; pools [NB, KH, page, Dh]; pages [B, MP] int32;
+    start/kv_start [B] int32 (query j of row b sits at start[b] + j).
+    Returns [B, Sq, H, Dh] in q.dtype.
+    """
+    B, Sq, H, Dh = q.shape
+    KH = k_pool.shape[1]
+    G = H // KH
+    p_hi = t_hi // page
+    tbl = pages[:, :p_hi]                                  # hoisted bound
+    k = jnp.moveaxis(k_pool[tbl], 2, 1).reshape(B, KH, p_hi * page, Dh)
+    v = jnp.moveaxis(v_pool[tbl], 2, 1).reshape(B, KH, p_hi * page, Dh)
+    if k_scale is not None:
+        ks = jnp.moveaxis(k_scale[tbl], 2, 1).reshape(B, KH, p_hi * page)
+        vs = jnp.moveaxis(v_scale[tbl], 2, 1).reshape(B, KH, p_hi * page)
+        k = k.astype(q.dtype) * ks[..., None].astype(q.dtype)
+        v = v.astype(q.dtype) * vs[..., None].astype(q.dtype)
+    t = jnp.arange(p_hi * page)
+    q_pos = start[:, None] + jnp.arange(Sq)                # [B, Sq]
+    mask = (
+        (t[None, None, :] <= q_pos[:, :, None])
+        & (t[None, None, :] >= kv_start[:, None, None])
+    )                                                      # [B, Sq, T]
+    scale = Dh ** -0.5
+    qg = q.reshape(B, Sq, KH, G, Dh)
+    s = jnp.einsum("bqhgd,bhtd->bhgqt", qg, k) * scale
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhgqt,bhtd->bqhgd", p, v)
+    return o.reshape(B, Sq, H, Dh)
+
+
+def _decode_kernel(pages_ref, start_ref, kvs_ref, q_ref, k_ref, v_ref,
+                   *rest, page: int, p_hi: int, group: int, scale: float,
+                   quant: bool):
+    """Grid (B, KH, p_hi); one invocation streams one K/V block.  The
+    softmax carry (m, l, acc) lives in VMEM scratch across the trailing
+    grid axis — init at j == 0, emit at j == p_hi - 1."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        o_ref, m_s, l_s, acc_s = rest
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    q = q_ref[0, 0].astype(jnp.float32)                    # [R, Dh]
+    kb = k_ref[0, 0].astype(jnp.float32)                   # [page, Dh]
+    vb = v_ref[0, 0].astype(jnp.float32)
+    if quant:
+        kb = kb * ks_ref[0, 0].astype(jnp.float32)[:, None]
+        vb = vb * vs_ref[0, 0].astype(jnp.float32)[:, None]
+
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                              # [R, page]
+
+    R = q.shape[0]
+    t = j * page + jax.lax.broadcasted_iota(jnp.int32, (R, page), 1)
+    # Row r of the folded (Sq, G) axis belongs to query r // group; rows
+    # past Sq*G are padding and simply see a wider (harmless) mask.
+    q_pos = start_ref[b] + jax.lax.broadcasted_iota(
+        jnp.int32, (R, page), 0) // group
+    s = jnp.where((t <= q_pos) & (t >= kvs_ref[b]), s, NEG_INF)
+
+    m_prev = m_s[...][:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l_s[...] = (l_s[...][:, 0] * alpha + p.sum(axis=-1))[:, None]
+    acc_s[...] = acc_s[...] * alpha[:, None] + jax.lax.dot_general(
+        p, vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_s[...] = m_new[:, None]
+
+    @pl.when(j == p_hi - 1)
+    def _():
+        o_ref[0, 0] = (acc_s[...] / l_s[...]).astype(o_ref.dtype)
+
+
+def supported(q_shape, kv_dtype, *, page: int, t_hi: int, max_pages: int,
+              interpret: bool) -> bool:
+    """Fallback matrix (docs/platform/kv-cache.md): the kernel runs iff
+    the geometry is sane (whole pages, table wide enough) and — when
+    compiling for a real TPU — the blocks tile Mosaic's (sublane, 128)
+    registers.  The interpreter has no tiling constraint, so the CPU
+    parity suite exercises every geometry the engine produces."""
+    B, Sq, H, Dh = q_shape
+    if t_hi % page != 0 or t_hi < page:
+        return False
+    if t_hi // page > max_pages:
+        return False
+    if interpret:
+        return True
+    return Dh % 128 == 0 and page % _sublane(kv_dtype) == 0
+
+
+def paged_attention(q, k_pool, v_pool, pages, start, kv_start,
+                    *, page: int, t_hi: int, k_scale=None, v_scale=None,
+                    interpret: bool | None = None):
+    """Fused paged decode attention.  q [B, Sq, H, Dh] against the
+    physical pool [NB, KH, page, Dh] through per-row page tables
+    [B, MP]; row b's query j attends logical positions
+    [kv_start[b], start[b] + j] within the first ``t_hi`` slots.
+    Shapes that don't satisfy :func:`supported` fall back to the
+    gather-path oracle — same result, no caller-visible seam."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    B, Sq, H, Dh = q.shape
+    NB, KH = k_pool.shape[0], k_pool.shape[1]
+    G = H // KH
+    if not supported(q.shape, k_pool.dtype, page=page, t_hi=t_hi,
+                     max_pages=pages.shape[1], interpret=interpret):
+        return paged_attention_reference(
+            q, k_pool, v_pool, pages, start, kv_start,
+            page=page, t_hi=t_hi, k_scale=k_scale, v_scale=v_scale,
+        )
+    p_hi = t_hi // page
+    R = Sq * G
+    tile = _sublane(q.dtype)
+    R_pad = -(-R // tile) * tile
+    # Fold (Sq, G) into the kernel's row axis, one KV head per program.
+    qr = q.reshape(B, Sq, KH, G, Dh).transpose(0, 2, 1, 3, 4)
+    qr = qr.reshape(B, KH, R, Dh)
+    if R_pad != R:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, R_pad - R), (0, 0)))
+
+    quant = k_scale is not None
+    row_spec = pl.BlockSpec(
+        (1, 1, R_pad, Dh), lambda b, h, j, pg, st, kv: (b, h, 0, 0))
+    blk_spec = pl.BlockSpec(
+        (1, 1, page, Dh), lambda b, h, j, pg, st, kv: (pg[b, j], h, 0, 0))
+    in_specs = [row_spec, blk_spec, blk_spec]
+    operands = [qr, k_pool, v_pool]
+    if quant:
+        scl_spec = pl.BlockSpec(
+            (1, 1, page), lambda b, h, j, pg, st, kv: (pg[b, j], h, 0))
+        in_specs += [scl_spec, scl_spec]
+        operands += [k_scale, v_scale]
+
+    kern = functools.partial(
+        _decode_kernel, page=page, p_hi=p_hi, group=G,
+        scale=Dh ** -0.5, quant=quant,
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(B, KH, p_hi),
+            in_specs=in_specs,
+            out_specs=row_spec,
+            scratch_shapes=[
+                pltpu.VMEM((R_pad, 1), jnp.float32),
+                pltpu.VMEM((R_pad, 1), jnp.float32),
+                pltpu.VMEM((R_pad, Dh), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KH, R_pad, Dh), q.dtype),
+        interpret=interpret,
+    )(pages.astype(jnp.int32), jnp.asarray(start, jnp.int32),
+      jnp.asarray(kv_start, jnp.int32), *operands)
+    out = out[:, :, :R]
+    return out.reshape(B, KH, Sq, G, Dh).transpose(0, 2, 1, 3, 4) \
+              .reshape(B, Sq, H, Dh)

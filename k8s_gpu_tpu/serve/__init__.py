@@ -17,7 +17,7 @@ from .router import (
     router_rule_pack,
 )
 from .server import LmServer
-from .speculative import distill_draft, rejection_sample
+from .speculative import distill_draft, int8_draft, rejection_sample
 
 __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
@@ -27,5 +27,6 @@ __all__ = [
     "router_rule_pack",
     "quantize_params", "export_servable", "load_servable",
     "DisaggregatedLm", "RegexConstraint", "compile_constraint",
-    "distill_draft", "rejection_sample", "schema_to_regex", "SchemaError",
+    "distill_draft", "int8_draft", "rejection_sample",
+    "schema_to_regex", "SchemaError",
 ]

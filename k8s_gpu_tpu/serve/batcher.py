@@ -323,7 +323,9 @@ class ContinuousBatcher:
         logprobs: bool = False,
         draft=None,
         spec_k: int = 4,
+        draft_int8: bool = False,
         kv_quant: bool = False,
+        attn_impl: str | None = None,
         paged_blocks: int = 0,
         page_size: int = 64,
         max_pending: int = 0,
@@ -372,9 +374,24 @@ class ContinuousBatcher:
         masking draft proposals by a state that far ahead has no
         well-defined trace).
 
+        ``draft_int8``: quantize the neural draft's weights int8
+        (serve/quant.py) and run its matmuls as true int8 × int8
+        (engine int8_compute) — the draft streams half the bytes and
+        computes at integer width, so every speculative round's drafting
+        half gets cheaper.  Draft quantization error can only lower the
+        acceptance rate, never correctness: the target verify is exact
+        for ANY draft distribution.  ``_param_bytes`` sees the quantized
+        tree, so the byte-ratio round sizing adjusts automatically.
+
         ``kv_quant``: int8 pool KV cache with per-(head, position) scales
         (engine.__init__) — ~1.9× the slots at fixed HBM.  The draft's
         (much smaller) cache stays at model dtype.
+
+        ``attn_impl``: paged attention read implementation for the
+        TARGET engine — "gather" (default) or "paged_kernel" (the fused
+        Pallas kernel, ops/paged_attention.py).  Ignored for dense
+        pools; on non-TPU backends the kernel runs in the Pallas
+        interpreter (parity, not speed).
 
         ``paged_blocks`` > 0: paged KV — the pool is ``paged_blocks``
         physical blocks of ``page_size`` positions shared by all slots
@@ -402,7 +419,8 @@ class ContinuousBatcher:
         from .lora_bank import AdapterBank
 
         self.engine = InferenceEngine(
-            model, max_seq=max_seq, mesh=mesh, kv_quant=kv_quant
+            model, max_seq=max_seq, mesh=mesh, kv_quant=kv_quant,
+            attn_impl=attn_impl,
         )
         self.bank = AdapterBank(adapters or {})
         self.cbank = constraints
@@ -459,8 +477,13 @@ class ContinuousBatcher:
                 # Same max_seq: the draft pool mirrors the target pool's
                 # geometry so positions line up row-for-row.
                 self.draft_engine = InferenceEngine(
-                    draft_model, max_seq=self.engine.max_seq, mesh=mesh
+                    draft_model, max_seq=self.engine.max_seq, mesh=mesh,
+                    int8_compute=draft_int8,
                 )
+                if draft_int8:
+                    from .speculative import int8_draft
+
+                    draft_params = int8_draft(draft_params)
                 self.draft_params = draft_params
                 self.spec_mode = "neural"
         self.params = params
@@ -1952,6 +1975,10 @@ class ContinuousBatcher:
             use_top_p, n_steps, t,
         )
         self._seated(req, slot, first, lp, "cold_fused")
+        if self.paged and self.engine.attn_impl == "paged_kernel":
+            # The fused program body ends in a _round_dev decode round,
+            # which reads through the kernel like any other round.
+            self.metrics.inc("serve_paged_kernel_rounds_total")
         req.inflight_steps += n_steps
         req.pos_hint += n_steps
         self._round_count += 1
@@ -2315,6 +2342,8 @@ class ContinuousBatcher:
                         self.bank.banked, use_top_p, n_rounds, t_hi, K,
                         pages_op,
                     )
+            if self.paged and self.engine.attn_impl == "paged_kernel":
+                self.metrics.inc("serve_paged_kernel_rounds_total")
             # Budget-gate charge: EXPECTED tokens from rolling acceptance,
             # not the all-accepted worst case — a worst-case charge at
             # acceptance a<1 makes the gate think the budget is covered
@@ -2371,6 +2400,10 @@ class ContinuousBatcher:
             use_top_p, n_steps, t_hi,
             jnp.asarray(self._pages) if self.paged else None,
         )
+        if self.paged and self.engine.attn_impl == "paged_kernel":
+            # A/B attribution for the fused-kernel rollout: operators can
+            # split fleet decode throughput by which read path served it.
+            self.metrics.inc("serve_paged_kernel_rounds_total")
         for _, r in live:
             r.inflight_steps += n_steps
             r.pos_hint += n_steps

@@ -31,7 +31,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.transformer import (
     TransformerConfig, TransformerLM, emb_lookup, wt,
 )
+from ..ops.paged_attention import paged_attention
 from .lora_bank import lora_delta
+from .quant import int8_dot
 
 
 @dataclass(frozen=True)
@@ -150,6 +152,8 @@ class InferenceEngine:
         max_seq: int | None = None,
         mesh: Mesh | None = None,
         kv_quant: bool = False,
+        attn_impl: str | None = None,
+        int8_compute: bool = False,
     ):
         """``mesh``: shard serving over devices — heads ('tp') on the KV
         cache and, via the params' own shardings, the projection matmuls;
@@ -161,12 +165,39 @@ class InferenceEngine:
         f32 scales (_quantize_kv) — ~1.9× the slot capacity at fixed HBM
         and half the bytes on every bandwidth-bound decode cache read;
         weights stay whatever ``params`` carries (serve/quant.py is the
-        weight side)."""
+        weight side).
+
+        ``attn_impl``: how paged decode/verify reads attention —
+        ``"gather"`` (materialize the first t_hi pages row-contiguously,
+        the default) or ``"paged_kernel"`` (the fused Pallas kernel in
+        ops/paged_attention.py consumes the page tables in-kernel; falls
+        back to gather automatically when shapes don't tile).  ``None``
+        defers to ``cfg.attn_impl``.  Dense caches are untouched either
+        way.
+
+        ``int8_compute``: run the q/k/v/o, MLP and head matmuls as true
+        int8 × int8 → int32 (quant.int8_dot: dynamic per-row activation
+        quantization against the leaf's per-channel scales) wherever the
+        param leaf is quantized.  Meant for the speculative DRAFT engine
+        — draft quantization error only moves the acceptance rate, never
+        correctness — so MoE params are unsupported here."""
         self.model = model
         self.cfg = model.cfg
         self.max_seq = max_seq or self.cfg.max_seq
         self.mesh = mesh
         self.kv_quant = bool(kv_quant)
+        self.attn_impl = attn_impl or getattr(self.cfg, "attn_impl", "gather")
+        if self.attn_impl not in ("gather", "paged_kernel"):
+            raise ValueError(
+                f"attn_impl={self.attn_impl!r} — expected 'gather' or "
+                "'paged_kernel'"
+            )
+        self.int8_compute = bool(int8_compute)
+        if self.int8_compute and self.cfg.moe:
+            raise ValueError(
+                "int8_compute targets dense draft models — MoE dispatch "
+                "keeps the wt() dequant path"
+            )
         if mesh is not None:
             tp = mesh.shape.get("tp", 1)
             if tp > 1 and self.cfg.kv_heads % tp != 0:
@@ -323,10 +354,14 @@ class InferenceEngine:
         )
 
     @staticmethod
-    def _paged_read(arr, pages, p_hi: int, layer: int):
-        """Gather a row-contiguous view [B, KH, p_hi*page, *rest] of the
-        first ``p_hi`` logical pages of every row."""
-        sel = arr[layer][pages[:, :p_hi]]           # [B, P, KH, page, *rest]
+    def _paged_read(arr, tbl, layer: int):
+        """Gather a row-contiguous view [B, KH, P*page, *rest] of the
+        pages in ``tbl`` [B, P].  ``tbl`` is the page table already
+        sliced to the read bound (``pages[:, :p_hi]``) — the caller
+        hoists the bound ONCE so all four pool leaves (k/v + scales
+        under kv_quant) gather through the same sliced-table operand
+        and none of them touches entries past ``p_hi``."""
+        sel = arr[layer][tbl]                       # [B, P, KH, page, *rest]
         sel = jnp.moveaxis(sel, 2, 1)               # [B, KH, P, page, *rest]
         return sel.reshape(
             sel.shape[0], sel.shape[1], sel.shape[2] * sel.shape[3],
@@ -335,7 +370,8 @@ class InferenceEngine:
 
     def _block_cached(self, x, lp, lc, positions, start, mask,
                       moe_full_capacity=None, lp_ad=None, adapter_idx=None,
-                      layer=None, pages=None, page: int = 0):
+                      layer=None, pages=None, page: int = 0,
+                      kv_start=None):
         """One transformer block over query slice x [B,Sq,D] with the K/V for
         the slice written into the layer cache ``lc`` (k/v [+ k_s/v_s
         when kv_quant]) at ``start``.  Returns (x_out, new_lc).
@@ -355,9 +391,14 @@ class InferenceEngine:
         m = self.model
         dt = self.cfg.dtype
         h = m._rmsnorm(x, lp["ln1"])
-        q = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wq"], dt))
-        k = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wk"], dt))
-        v = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wv"], dt))
+        if self.int8_compute and isinstance(lp["wq"], dict):
+            q = int8_dot(h, lp["wq"], dt)
+            k = int8_dot(h, lp["wk"], dt)
+            v = int8_dot(h, lp["wv"], dt)
+        else:
+            q = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wq"], dt))
+            k = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wk"], dt))
+            v = jnp.einsum("bsd,dhk->bshk", h, wt(lp["wv"], dt))
         if lp_ad is not None:
             # Per-row LoRA deltas (serve/lora_bank.py): same inputs the
             # base matmuls consume, low-rank path gathered by row index.
@@ -387,17 +428,34 @@ class InferenceEngine:
             else:
                 lc["k"] = self._paged_store(lc["k"], k, pages, start, page, layer)
                 lc["v"] = self._paged_store(lc["v"], v, pages, start, page, layer)
-            p_hi = mask.shape[-1] // page
-            k_read = self._paged_read(lc["k"], pages, p_hi, layer)
-            v_read = self._paged_read(lc["v"], pages, p_hi, layer)
-            ks_read = (self._paged_read(lc["k_s"], pages, p_hi, layer)
-                       if "k_s" in lc else None)
-            vs_read = (self._paged_read(lc["v_s"], pages, p_hi, layer)
-                       if "v_s" in lc else None)
-            o = self._attend_cached(
-                q, k_read, v_read, mask,
-                k_scale=ks_read, v_scale=vs_read,
-            )
+            T_eff = mask.shape[-1]
+            if (self.attn_impl == "paged_kernel" and kv_start is not None
+                    and jnp.ndim(start) == 1):
+                # Fused path (ops/paged_attention.py): the kernel walks
+                # the page tables itself — no gathered K/V copy.  The
+                # per-row mask is rebuilt in-kernel from start/kv_start,
+                # the same formula decode_step_multi/extend_multi used
+                # to build ``mask``; shapes that don't tile fall back to
+                # the gather oracle inside the wrapper.
+                o = paged_attention(
+                    q, lc["k"][layer], lc["v"][layer], pages,
+                    start, kv_start, page=page, t_hi=T_eff,
+                    k_scale=lc["k_s"][layer] if "k_s" in lc else None,
+                    v_scale=lc["v_s"][layer] if "v_s" in lc else None,
+                )
+            else:
+                p_hi = T_eff // page
+                tbl = pages[:, :p_hi]  # bound hoisted: one slice, 4 gathers
+                k_read = self._paged_read(lc["k"], tbl, layer)
+                v_read = self._paged_read(lc["v"], tbl, layer)
+                ks_read = (self._paged_read(lc["k_s"], tbl, layer)
+                           if "k_s" in lc else None)
+                vs_read = (self._paged_read(lc["v_s"], tbl, layer)
+                           if "v_s" in lc else None)
+                o = self._attend_cached(
+                    q, k_read, v_read, mask,
+                    k_scale=ks_read, v_scale=vs_read,
+                )
             return self._block_epilogue(
                 x, o, lp, lp_ad, adapter_idx, mask, moe_full_capacity
             ), lc
@@ -439,7 +497,11 @@ class InferenceEngine:
         paged cache branches of _block_cached."""
         m = self.model
         dt = self.cfg.dtype
-        attn_out = jnp.einsum("bshk,hkd->bsd", o, wt(lp["wo"], dt))
+        int8 = self.int8_compute and isinstance(lp["wo"], dict)
+        if int8:
+            attn_out = int8_dot(o, lp["wo"], dt)
+        else:
+            attn_out = jnp.einsum("bshk,hkd->bsd", o, wt(lp["wo"], dt))
         if lp_ad is not None and "wo" in lp_ad:
             o_flat = o.reshape(o.shape[0], o.shape[1], -1)
             attn_out = attn_out + lora_delta(
@@ -461,13 +523,18 @@ class InferenceEngine:
                 token_mask=mask.any(-1),
             )
             x = x + y
+        elif int8:
+            g = int8_dot(h2, lp["wi_gate"], dt)
+            u = int8_dot(h2, lp["wi_up"], dt)
+            x = x + int8_dot(jax.nn.silu(g) * u, lp["wo_mlp"], dt)
         else:
             x = x + m._dense_mlp(h2, lp)
         return x
 
     def _run_blocks(self, params, x, cache, positions, start, mask,
                     moe_full_capacity=None, adapters=None, adapter_idx=None,
-                    unroll_layers=False, pages=None, page: int = 0):
+                    unroll_layers=False, pages=None, page: int = 0,
+                    kv_start=None):
         """``unroll_layers``: decode paths set True — a Python loop over
         layers scatters each K/V write straight into the stacked cache
         (in-place under XLA aliasing), where the layer scan would round-
@@ -488,7 +555,7 @@ class InferenceEngine:
                     x, lp, new_cache, positions, start, mask,
                     moe_full_capacity=moe_full_capacity,
                     lp_ad=lp_ad, adapter_idx=adapter_idx, layer=l,
-                    pages=pages, page=page,
+                    pages=pages, page=page, kv_start=kv_start,
                 )
             return self._head(params, x), new_cache
         assert pages is None, "paged KV requires the unrolled decode path"
@@ -520,9 +587,12 @@ class InferenceEngine:
         """Shared epilogue for both _run_blocks paths: final RMSNorm +
         vocabulary projection in f32."""
         x = self.model._rmsnorm(x, params["final_norm"])
-        logits = jnp.einsum(
-            "bsd,dv->bsv", x, wt(params["head"], self.cfg.dtype)
-        )
+        if self.int8_compute and isinstance(params["head"], dict):
+            logits = int8_dot(x, params["head"], self.cfg.dtype)
+        else:
+            logits = jnp.einsum(
+                "bsd,dv->bsv", x, wt(params["head"], self.cfg.dtype)
+            )
         return logits.astype(jnp.float32)
 
     # -- public jittable pieces -------------------------------------------
@@ -618,6 +688,7 @@ class InferenceEngine:
             params, x, cache, jnp.asarray(rope_pos, jnp.int32)[:, None], pos,
             mask, adapters=adapters, adapter_idx=adapter_idx,
             unroll_layers=True, pages=pages, page=page,
+            kv_start=jnp.asarray(kv_start, jnp.int32),
         )
         return cache, logits[:, 0]
 
@@ -670,6 +741,7 @@ class InferenceEngine:
             params, x, cache, rope, start, mask, moe_full_capacity=True,
             adapters=adapters, adapter_idx=adapter_idx,
             unroll_layers=True, pages=pages, page=page,
+            kv_start=jnp.asarray(kv_start, jnp.int32),
         )
         return cache, logits
 

@@ -22,6 +22,7 @@ part of the serving stack that replaces its Ollama delegation.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 # Contraction axes per stacked weight leaf (models/transformer.py:init):
@@ -70,6 +71,57 @@ def quantize_params(params: dict, *, quantize_embed: bool = True) -> dict:
             continue
         out[name] = _quantize_leaf(params[name], axes)
     return out
+
+
+def quantize_act(x):
+    """x [..., K] → (int8 values, f32 per-row scale [...]): symmetric
+    absmax over the contraction axis — the activation half of an int8 ×
+    int8 matmul.  Dynamic (computed inside the trace per step): decode
+    activations are [B, 1, D]-tiny, so the absmax costs nothing next to
+    the weight stream it unlocks."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def int8_dot(x, leaf, out_dtype):
+    """True int8 matmul against a quantized leaf: quantize ``x`` per row,
+    contract int8 × int8 → int32 on the device's integer path, rescale by
+    (activation scale ⊗ per-channel weight scale).  Versus the ``wt``
+    dequant-into-matmul form this also halves the *compute* width — the
+    draft model's whole reason to exist is being cheap, and speculative
+    acceptance tolerates draft quantization error (the verify pass is
+    exact regardless of what the draft proposes).
+
+    ``leaf`` is a per-layer slice of the ``{"q", "s"}`` form: contraction
+    axes are the leading axes of ``q`` (the ones ``s`` keeps at 1).  ``x``
+    contracts its trailing axes against them (e.g. [B, S, H, Dh] against
+    wo's [H, Dh, D]); output keeps x's leading axes + the weight's output
+    axes."""
+    w, s = leaf["q"], leaf["s"]
+    n_c = sum(1 for i in range(w.ndim) if s.shape[i] == 1 and w.shape[i] > 1)
+    n_c = max(n_c, 1)
+    k_tot = 1
+    for d in w.shape[:n_c]:
+        k_tot *= d
+    # Collapse trailing x axes until the contraction width matches.
+    n_x, prod = 0, 1
+    while prod < k_tot:
+        n_x += 1
+        prod *= x.shape[-n_x]
+    assert prod == k_tot, (x.shape, w.shape)
+    lead = x.shape[:-n_x]
+    xq, ax = quantize_act(x.reshape(*lead, k_tot))
+    y = jax.lax.dot_general(
+        xq.reshape(-1, k_tot), w.reshape(k_tot, -1),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32)
+    y = y * ax.reshape(-1, 1) * s.reshape(1, -1)
+    return y.reshape(*lead, *w.shape[n_c:]).astype(out_dtype)
 
 
 def quantized_bytes(params: dict) -> tuple[int, int]:

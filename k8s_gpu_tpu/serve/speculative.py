@@ -124,6 +124,23 @@ def rejection_sample(key, p, q, g):
     return jax.vmap(reject_row)(jax.random.split(key, B), p, q, g)
 
 
+def int8_draft(draft_params):
+    """Prepare a draft param tree for int8 compute (the batcher's
+    ``draft_int8=True``): weights quantized int8 + per-channel scales
+    (serve/quant.py), consumed by an ``InferenceEngine(int8_compute=
+    True)`` whose matmuls then run int8 × int8 → int32.
+
+    This is SAFE aggressiveness, and the reason it lives in this module:
+    the acceptance test above (``reject_row``) is exact for *any* draft
+    distribution q — a quantized draft can only shift q away from p and
+    lower the acceptance rate, never corrupt the output stream.  The
+    same argument does NOT cover the target: its probabilities define
+    correctness, so the target keeps its serving dtype."""
+    from .quant import quantize_params
+
+    return quantize_params(draft_params)
+
+
 def distill_draft(target_model, tparams, draft_cfg=None, *, steps: int = 200,
                   batch: int = 8, seq_len: int = 64, lr: float = 3e-3,
                   key=None, data_temperature: float = 1.0,

@@ -1,0 +1,306 @@
+"""Fused Pallas paged-attention decode kernel — ROADMAP item 3 parity.
+
+The kernel (`ops/paged_attention.py`) consumes block tables in-kernel,
+so the one thing it must never do is read the wrong physical block.
+Contract, bottom-up:
+
+1. op PARITY: kernel output matches the gather-path oracle
+   (`paged_attention_reference`, bit-identical math to the engine's
+   `_paged_read` + `_attend_cached`) across MHA/GQA, single- and
+   multi-query rows, f32/bf16, and ragged ``t_hi`` edges;
+2. ISOLATION: poisoning the trash block and every block outside a row's
+   table (another tenant's live data) changes NOTHING — a spec-decode
+   overrun streams trash block 0, not a neighbor's KV;
+3. int8-KV parity: the in-kernel dequant (scale applied in VMEM) agrees
+   with the oracle exactly and with float attention within quant
+   tolerance;
+4. engine streams: a `paged_kernel` batcher is token-for-token identical
+   to the gather batcher — greedy, sampled, speculative (ngram + neural
+   + int8 draft), and int8-KV;
+5. steady-state decode with the kernel enabled compiles ZERO new XLA
+   executables (the conftest compile-telemetry guard).
+
+Everything runs on CPU through the Pallas interpreter
+(``interpret=None`` auto-selects it off-TPU) — same code path Mosaic
+compiles on a real TPU, minus the tiling constraint.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+    supported,
+)
+from k8s_gpu_tpu.serve import ContinuousBatcher
+
+PAGE = 8
+
+
+def _setup(B, Sq, H, KH, Dh, MP, dtype, seed=0):
+    """Random pool + valid page tables: row b owns blocks
+    [1 + b*live, ...) (block 0 is the trash block), start mid-window."""
+    rng = np.random.default_rng(seed)
+    NB = 1 + B * MP
+    q = jnp.asarray(rng.standard_normal((B, Sq, H, Dh)), dtype)
+    k = jnp.asarray(rng.standard_normal((NB, KH, PAGE, Dh)), dtype)
+    v = jnp.asarray(rng.standard_normal((NB, KH, PAGE, Dh)), dtype)
+    pages = jnp.asarray(
+        [[1 + b * MP + j for j in range(MP)] for b in range(B)], jnp.int32)
+    return q, k, v, pages
+
+
+@pytest.mark.parametrize(
+    "H,KH,Sq,dtype,tol",
+    [
+        (2, 2, 1, jnp.float32, 2e-5),    # MHA single-token decode
+        (4, 2, 1, jnp.float32, 2e-5),    # GQA
+        (4, 1, 3, jnp.float32, 2e-5),    # MQA, multi-query (spec verify)
+        (4, 2, 5, jnp.bfloat16, 5e-2),   # GQA wide row, low precision
+    ],
+)
+def test_kernel_matches_oracle(H, KH, Sq, dtype, tol):
+    q, k, v, pages = _setup(3, Sq, H, KH, 16, 4, dtype)
+    t_hi = 3 * PAGE
+    start = jnp.asarray([t_hi - Sq, PAGE + 1, 2 * PAGE - Sq], jnp.int32)
+    kv_start = jnp.asarray([0, 2, PAGE], jnp.int32)
+    ref = paged_attention_reference(
+        q, k, v, pages, start, kv_start, page=PAGE, t_hi=t_hi)
+    out = paged_attention(
+        q, k, v, pages, start, kv_start, page=PAGE, t_hi=t_hi)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=tol)
+
+
+@pytest.mark.parametrize("t_hi", [PAGE, 2 * PAGE, 4 * PAGE])
+def test_ragged_t_hi_edges(t_hi):
+    """The grid's trailing axis follows the decode bucket: one page,
+    mid-table, and the full table must all agree with the oracle."""
+    q, k, v, pages = _setup(2, 1, 2, 2, 16, 4, jnp.float32, seed=1)
+    start = jnp.asarray([t_hi - 1, max(t_hi - PAGE, 0)], jnp.int32)
+    kv_start = jnp.zeros((2,), jnp.int32)
+    ref = paged_attention_reference(
+        q, k, v, pages, start, kv_start, page=PAGE, t_hi=t_hi)
+    out = paged_attention(
+        q, k, v, pages, start, kv_start, page=PAGE, t_hi=t_hi)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_trash_block_and_cross_tenant_isolation():
+    """The regression the `_paged_read` hoist protects: rows whose table
+    ends before ``p_hi`` stream trash block 0 (masked out), NEVER a high
+    block index holding another tenant's live KV.  Poisoning the trash
+    block and every foreign block must leave both paths bit-unchanged."""
+    B, Sq, H, KH, Dh, MP = 2, 1, 2, 2, 16, 4
+    q, k, v, pages = _setup(B, Sq, H, KH, Dh, MP, jnp.float32, seed=2)
+    # Row tables end after 2 live pages; dead entries point at trash 0.
+    pages = jnp.asarray([[1, 2, 0, 0], [3, 4, 0, 0]], jnp.int32)
+    t_hi = 4 * PAGE                       # bucket wider than either row
+    start = jnp.asarray([2 * PAGE - 1, PAGE + 3], jnp.int32)
+    kv_start = jnp.zeros((B,), jnp.int32)
+
+    args = dict(page=PAGE, t_hi=t_hi)
+    ref = paged_attention_reference(q, k, v, pages, start, kv_start, **args)
+    out = paged_attention(q, k, v, pages, start, kv_start, **args)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    # Poison trash block 0 and blocks 5.. (a third tenant's live data).
+    k_p = k.at[0].set(1e4).at[5:].set(-1e4)
+    v_p = v.at[0].set(1e4).at[5:].set(-1e4)
+    ref_p = paged_attention_reference(
+        q, k_p, v_p, pages, start, kv_start, **args)
+    out_p = paged_attention(q, k_p, v_p, pages, start, kv_start, **args)
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out))
+    np.testing.assert_array_equal(np.asarray(ref_p), np.asarray(ref))
+
+
+def test_int8_kv_parity():
+    """int8 pool + per-(block, head, slot) scales: kernel dequant-in-VMEM
+    vs oracle is exact-ish (same math, different order); both stay within
+    quant tolerance of float attention on the dequantized pool."""
+    B, Sq, H, KH, Dh, MP = 2, 1, 4, 2, 16, 3
+    qf, kf, vf, pages = _setup(B, Sq, H, KH, Dh, MP, jnp.float32, seed=3)
+    t_hi = 3 * PAGE
+    start = jnp.asarray([t_hi - 1, 2 * PAGE], jnp.int32)
+    kv_start = jnp.zeros((B,), jnp.int32)
+
+    def quant(x):                          # engine's _quantize_kv grain
+        amax = jnp.max(jnp.abs(x), axis=-1)
+        s = jnp.maximum(amax, 1e-8) / 127.0
+        return (jnp.clip(jnp.round(x / s[..., None]), -127, 127)
+                .astype(jnp.int8), s)
+
+    kq, ks = quant(kf)
+    vq, vs = quant(vf)
+    args = dict(page=PAGE, t_hi=t_hi, k_scale=ks, v_scale=vs)
+    ref = paged_attention_reference(
+        qf, kq, vq, pages, start, kv_start, **args)
+    out = paged_attention(qf, kq, vq, pages, start, kv_start, **args)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    exact = paged_attention_reference(
+        qf, kq.astype(jnp.float32) * ks[..., None],
+        vq.astype(jnp.float32) * vs[..., None],
+        pages, start, kv_start, page=PAGE, t_hi=t_hi)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(exact), atol=1e-4)
+
+
+def test_supported_fallback_matrix():
+    """Geometry gates always apply; Mosaic tiling gates only off the
+    interpreter — the documented matrix in docs/platform/kv-cache.md."""
+    shape = (2, 1, 4, 128)
+    ok = dict(page=32, t_hi=64, max_pages=4)
+    assert supported(shape, jnp.bfloat16, interpret=False, **ok)
+    # Partial page / zero pages / table too narrow: never supported.
+    assert not supported(shape, jnp.bfloat16, interpret=True,
+                         page=32, t_hi=40, max_pages=4)
+    assert not supported(shape, jnp.bfloat16, interpret=True,
+                         page=32, t_hi=0, max_pages=4)
+    assert not supported(shape, jnp.bfloat16, interpret=True,
+                         page=32, t_hi=192, max_pages=4)
+    # Tiling constraints bind on TPU only.
+    assert not supported((2, 1, 4, 16), jnp.bfloat16, interpret=False, **ok)
+    assert supported((2, 1, 4, 16), jnp.bfloat16, interpret=True, **ok)
+    assert not supported(shape, jnp.int8, interpret=False,
+                         page=16, t_hi=64, max_pages=4)
+    assert supported(shape, jnp.int8, interpret=False,
+                     page=32, t_hi=64, max_pages=4)
+
+
+def test_fallback_result_matches_kernel():
+    """An unsupported-on-TPU geometry routed through the fallback gives
+    the same answer the kernel gives on the interpreter — the seam the
+    engine relies on being invisible."""
+    q, k, v, pages = _setup(2, 1, 2, 2, 16, 4, jnp.float32, seed=4)
+    start = jnp.asarray([PAGE, 2 * PAGE + 1], jnp.int32)
+    kv_start = jnp.zeros((2,), jnp.int32)
+    # t_hi not a page multiple → fallback even on the interpreter.
+    kw = dict(page=PAGE, t_hi=2 * PAGE, k_scale=None, v_scale=None)
+    via_kernel = paged_attention(
+        q, k, v, pages, start, kv_start, interpret=True, **kw)
+    via_ref = paged_attention_reference(
+        q, k, v, pages, start, kv_start, page=PAGE, t_hi=2 * PAGE)
+    np.testing.assert_allclose(
+        np.asarray(via_kernel), np.asarray(via_ref), atol=2e-5)
+
+
+# -- engine-level stream parity ------------------------------------------------
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8,
+    n_kv_heads=2, d_ff=64, max_seq=64, use_flash=False, dtype=jnp.float32,
+)
+MODEL = TransformerLM(CFG)
+PARAMS = MODEL.init(jax.random.PRNGKey(0))
+
+DRAFT_CFG = TransformerConfig(
+    vocab_size=64, d_model=16, n_layers=1, n_heads=2, d_head=8,
+    d_ff=32, max_seq=64, use_flash=False, dtype=jnp.float32,
+)
+DRAFT_MODEL = TransformerLM(DRAFT_CFG)
+DRAFT_PARAMS = DRAFT_MODEL.init(jax.random.PRNGKey(1))
+
+PROMPTS = [
+    [3, 5, 7, 11, 2, 9, 3, 5, 7, 11],   # repetitive (ngram-friendly)
+    [1, 2, 3, 4, 5, 6, 7, 8, 9],
+    list(range(20, 45)),                 # crosses pages
+]
+
+
+def _run(reqs, **kw):
+    kw.setdefault("paged_blocks", 24)
+    kw.setdefault("page_size", 8)
+    b = ContinuousBatcher(MODEL, PARAMS, slots=4, steps_per_round=4,
+                          **kw).start()
+    try:
+        handles = [b.submit(ids, **r) for ids, r in reqs]
+        return [h.result() for h in handles]
+    finally:
+        b.stop()
+
+
+def test_engine_stream_parity_greedy_and_sampled():
+    """Same batcher, kernel on/off: byte-identical token streams."""
+    greedy = [(p, dict(max_new_tokens=10)) for p in PROMPTS]
+    assert (_run(greedy, attn_impl="paged_kernel")
+            == _run(greedy, attn_impl="gather"))
+    sampled = [
+        (p, dict(max_new_tokens=8, temperature=0.7 + 0.1 * i, seed=i + 1))
+        for i, p in enumerate(PROMPTS)
+    ]
+    assert (_run(sampled, attn_impl="paged_kernel")
+            == _run(sampled, attn_impl="gather"))
+
+
+def test_engine_stream_parity_staggered_tables():
+    """Staggered admits interleave the block allocator's assignments, so
+    each slot's table is non-contiguous and neighbors' live blocks sit at
+    indices just past a row's own — the cross-tenant layout the hoisted
+    `_paged_read` bound and the trash-block guard both protect."""
+    def staggered(attn_impl):
+        b = ContinuousBatcher(MODEL, PARAMS, slots=4, paged_blocks=24,
+                              page_size=8, steps_per_round=2,
+                              attn_impl=attn_impl).start()
+        try:
+            h0 = b.submit(PROMPTS[2], max_new_tokens=14)
+            h1 = b.submit(PROMPTS[0], max_new_tokens=6)
+            r1 = h1.result()             # retires early: blocks recycle
+            h2 = b.submit(PROMPTS[1], max_new_tokens=10)
+            return [h0.result(), r1, h2.result()]
+        finally:
+            b.stop()
+
+    assert staggered("paged_kernel") == staggered("gather")
+
+
+def test_spec_decode_stream_parity():
+    """Speculative verify reads multi-query rows through the kernel; the
+    accept/reject outcome (hence the stream) must not move — ngram draft,
+    neural draft, and the int8-compute draft all stay exact."""
+    reqs = [(p, dict(max_new_tokens=10)) for p in PROMPTS[:2]]
+    base = _run(reqs, attn_impl="gather")
+    assert _run(reqs, attn_impl="paged_kernel",
+                draft="ngram", spec_k=3) == base
+    assert _run(reqs, attn_impl="paged_kernel",
+                draft=(DRAFT_MODEL, DRAFT_PARAMS), spec_k=3) == base
+    assert _run(reqs, attn_impl="paged_kernel",
+                draft=(DRAFT_MODEL, DRAFT_PARAMS), spec_k=3,
+                draft_int8=True) == base
+
+
+def test_kv_quant_stream_parity():
+    """int8 pool: both paths read the same quantized blocks, so streams
+    agree even though they differ from the float streams."""
+    reqs = [(p, dict(max_new_tokens=10)) for p in PROMPTS]
+    assert (_run(reqs, attn_impl="paged_kernel", kv_quant=True)
+            == _run(reqs, attn_impl="gather", kv_quant=True))
+
+
+def test_steady_state_zero_recompile_with_kernel(xla_compiles):
+    """The kernel call sits inside the decode trace — steady-state rounds
+    with it enabled must compile zero new executables, same bar the
+    gather path holds (test_analysis_selfcheck.py)."""
+    b = ContinuousBatcher(MODEL, PARAMS, slots=2, paged_blocks=24,
+                          page_size=8, attn_impl="paged_kernel").start()
+    try:
+        def wave():
+            handles = [b.submit(p, max_new_tokens=5) for p in PROMPTS[:2]]
+            return [h.result() for h in handles]
+
+        warm = wave()
+        wave()
+        before = xla_compiles()
+        steady1 = wave()
+        steady2 = wave()
+        assert xla_compiles() == before, (
+            "paged kernel decode recompiled in steady state"
+        )
+        assert steady1 == warm and steady2 == warm
+    finally:
+        b.stop()

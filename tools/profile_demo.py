@@ -8,6 +8,11 @@ Three acts, each asserting its invariant (non-zero exit on failure):
    synchronous compute — decode rounds ARE the work), shares must sum
    to <= 1.0 with the residual reported, and `/debug/profile` must
    serve the same snapshot over HTTP.
+1b. **Kernel-path attribution** (ISSUE 11) — the same profiler over a
+   `attn_impl="paged_kernel"` + speculative batcher: with the gather
+   tax gone the window must belong to the COMPUTE phases
+   (prefill/decode dispatch + spec draft/verify), not the scheduling
+   phases around them — the shape the fused kernel exists to produce.
 2. **CompileStorm** — a seeded shape-churn burst (fresh jit shapes →
    real backend compiles through the runtime compile telemetry) walks
    the `CompileStorm` rule pending→firing→resolved under FakeClock.
@@ -111,6 +116,49 @@ def act1_phase_table() -> ContinuousBatcher:
     return b
 
 
+def act1b_kernel_shares() -> None:
+    print()
+    print("=" * 64)
+    print("ACT 1b — paged-kernel + spec decode: shares shift toward compute")
+    print("=" * 64)
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=16,
+        d_ff=64, max_seq=128,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    bk = ContinuousBatcher(
+        model, params, slots=4, paged_blocks=40, page_size=16,
+        attn_impl="paged_kernel", draft="ngram", spec_k=3,
+    ).start()
+    try:
+        prompts = [(([3, 5, 7, 11] * 8)[: 4 + i % 9]) for i in range(8)]
+        for _ in range(2):  # wave 1 compiles, wave 2 is steady state
+            hs = [bk.submit(p, max_new_tokens=24) for p in prompts]
+            total = sum(len(h.result()) for h in hs)
+    finally:
+        bk.stop()
+    print(f"served {total} tokens through the fused kernel path\n")
+
+    snap = profile_snapshot(bk.profiler, global_metrics)
+    print(render_profile(snap))
+    phases = snap["phases"]
+    compute = ("prefill_dispatch", "decode_dispatch",
+               "spec_draft", "spec_verify")
+    c_share = sum(phases[p]["share"] for p in compute if p in phases)
+    s_share = sum(s["share"] for p, s in phases.items() if p not in compute)
+    assert "spec_verify" in phases, sorted(phases)
+    assert c_share > s_share, (
+        f"compute phases {c_share:.3f} <= scheduling {s_share:.3f} — "
+        "the kernel path should leave dispatch/verify holding the window"
+    )
+    kr = bk.metrics.counter("serve_paged_kernel_rounds_total")
+    assert kr > 0, "kernel rounds counter never incremented"
+    print(f"\nOK: compute phases hold {c_share:.0%} vs scheduling "
+          f"{s_share:.0%}; {kr:.0f} kernel rounds counted "
+          "(serve_paged_kernel_rounds_total)")
+
+
 def act2_compile_storm() -> None:
     print()
     print("=" * 64)
@@ -180,6 +228,7 @@ def act3_chrome_trace(b: ContinuousBatcher) -> None:
 
 def main() -> int:
     b = act1_phase_table()
+    act1b_kernel_shares()
     act2_compile_storm()
     act3_chrome_trace(b)
     print()
