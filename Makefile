@@ -15,12 +15,18 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity
 
 # The default verify path (bare `make`): graftcheck invariants + the
-# attribution-plane smoke.  The full suite stays `make test` (it takes
-# minutes); image builds stay `make docker-build`.
-verify: check profile-demo
+# attribution-plane smoke + the flash-v2 parity suite (ISSUE 12 — every
+# knob's fwd/bwd parity, the fallback mint chain, and the zero-recompile
+# train-step guard, all CPU-safe through the Pallas interpreter).  The
+# full suite stays `make test` (it takes minutes); image builds stay
+# `make docker-build`.
+verify: check profile-demo flash-v2-parity
+
+flash-v2-parity:
+	python -m pytest tests/test_flash_v2.py -q -p no:cacheprovider
 
 docker-build:
 	@for img in $(IMAGES); do \
@@ -115,11 +121,13 @@ fleet-demo:
 profile-demo:
 	python tools/profile_demo.py
 
-# Fused paged-attention kernel A/B, end to end on CPU interpret mode:
+# Kernel A/Bs, end to end on CPU interpret mode: fused paged-attention
 # op-level kernel-vs-oracle parity (f32 + int8 KV + trash-block poison),
-# then batcher streams gather-vs-kernel byte-identical — greedy and with
-# an int8-compute speculative draft.  The perf ratio itself
-# (cb_paged_kernel_vs_gather_x) is bench.py's job on a TPU host.
+# batcher streams gather-vs-kernel byte-identical — greedy and with an
+# int8-compute speculative draft — and the train-side flash-v2 act
+# (rope in-kernel + GQA streaming + q pipeline: fwd/grad parity and the
+# fallback mint chain).  The perf ratios (cb_paged_kernel_vs_gather_x,
+# train_flash_v2_vs_v1_x) are bench.py's job on a TPU host.
 kernel-demo:
 	python tools/kernel_demo.py
 

@@ -94,6 +94,29 @@ class TransformerConfig:
     # tuned on-chip: 512x512 at seq 2048 / d_head 128).
     flash_block_q: int = 0
     flash_block_k: int = 0
+    # Flash-v2 kernel restructuring (ISSUE 12) — three individually
+    # A/B-able knobs on the unsharded-sequence training path
+    # (ops/attention.py:flash_attention_v2):
+    #   flash_fuse_rope  — rotary embedding applied in-kernel from
+    #       program-id-derived positions (drops the two pre-kernel _rope
+    #       HBM passes over q and k); gradients still land in the
+    #       unrotated parameter basis via the VJP's transpose rotation.
+    #   flash_kv_grouped — stream K/V at the physical [B, KH, S, Dh]
+    #       with the G = H/KH query heads folded into the kernel's row
+    #       axis (paged_attention-style); deletes the _repeat_kv
+    #       materialization from the flash path.  Also threads grouped
+    #       K/V through ring attention (head-count-agnostic) and through
+    #       ulysses when (kv_heads/tp) % sp == 0.
+    #   flash_q_pipeline — P > 1 processes P q-tiles per program against
+    #       one shared K/V stream (0/1 = off).
+    # Shapes outside the support matrix demote v2 → v1 → oracle, minting
+    # `flash_fallback_total{reason}` at each hop; the sp-sharded path
+    # keeps rope outside (reason="sp_fused_rope" — the kernel cannot see
+    # a shard's global position offset).  docs/platform/training.md has
+    # the full matrix.
+    flash_fuse_rope: bool = False
+    flash_kv_grouped: bool = False
+    flash_q_pipeline: int = 0
     # Microbatches for the pipeline schedule (0 = schedule default: pp for
     # gpipe, 2·pp for 1f1b).
     pp_microbatches: int = 0
@@ -238,23 +261,59 @@ class TransformerLM:
     def _attention(self, x, lp, positions, mesh, seq_sharded):
         cfg = self.cfg
         dt = cfg.dtype
+        grp = cfg.n_heads // cfg.kv_heads
         q = jnp.einsum("bsd,dhk->bshk", x, wt(lp["wq"], dt))
         k = jnp.einsum("bsd,dhk->bshk", x, wt(lp["wk"], dt))
         v = jnp.einsum("bsd,dhk->bshk", x, wt(lp["wv"], dt))
-        q = self._rope(q, positions)
-        k = self._rope(k, positions)
-        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
-        k, v = self._repeat_kv(k), self._repeat_kv(v)
-        if seq_sharded:
-            if cfg.sp_attention == "ulysses":
-                from ..parallel.ulysses import ulysses_attention
+        # Flash-v2 eligibility: the fused kernel derives positions from
+        # program ids, so it only applies when positions are the dense
+        # arange over an unsharded sequence (training); decode's per-row
+        # [B, S] positions and sp-sharded shards keep rope outside.
+        v2_knobs = (
+            cfg.flash_fuse_rope
+            or (cfg.flash_kv_grouped and grp > 1)
+            or cfg.flash_q_pipeline > 1
+        )
+        use_v2 = (
+            cfg.use_flash and not seq_sharded and v2_knobs and positions.ndim == 1
+        )
+        fuse_rope = use_v2 and cfg.flash_fuse_rope
+        if cfg.flash_fuse_rope and not fuse_rope:
+            from ..utils.metrics import global_metrics
 
+            global_metrics.inc("flash_fallback_total", reason="sp_fused_rope")
+        if not fuse_rope:
+            q = self._rope(q, positions)
+            k = self._rope(k, positions)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B,H,S,Dh]
+        grouped = cfg.flash_kv_grouped and grp > 1
+        if seq_sharded:
+            sp_grouped = grouped
+            if cfg.sp_attention == "ulysses":
+                from ..parallel.ulysses import ulysses_attention, ulysses_grouped_ok
+
+                if sp_grouped and not ulysses_grouped_ok(
+                    q.shape[1], k.shape[1], mesh
+                ):
+                    from ..utils.metrics import global_metrics
+
+                    global_metrics.inc(
+                        "flash_fallback_total", reason="ulysses_kv_heads"
+                    )
+                    sp_grouped = False
+                if not sp_grouped:
+                    k, v = self._repeat_kv(k), self._repeat_kv(v)
                 o = ulysses_attention(
                     q, k, v, mesh,
                     block_q=cfg.flash_block_q or None,
                     block_k=cfg.flash_block_k or None,
                 )
             elif cfg.sp_attention == "ring":
+                # ring's internals are head-count-agnostic: grouped K/V
+                # ride the ring at KH heads (G× less ICI traffic) and
+                # expand only inside the per-step block attend.
+                if not sp_grouped:
+                    k, v = self._repeat_kv(k), self._repeat_kv(v)
                 o = ring_attention(
                     q, k, v, mesh,
                     block_q=cfg.flash_block_q or None,
@@ -265,15 +324,29 @@ class TransformerLM:
                     f"unknown sp_attention {cfg.sp_attention!r}; "
                     "expected 'ring' or 'ulysses'"
                 )
+        elif use_v2:
+            from ..ops.attention import flash_attention_v2
+
+            if not grouped:
+                k, v = self._repeat_kv(k), self._repeat_kv(v)
+            o = flash_attention_v2(
+                q, k, v, causal=True,
+                rope_theta=cfg.rope_theta if fuse_rope else None,
+                block_q=cfg.flash_block_q or None,
+                block_k=cfg.flash_block_k or None,
+                q_pipeline=max(1, cfg.flash_q_pipeline),
+            )
         elif cfg.use_flash:
             from ..ops.attention import flash_attention
 
+            k, v = self._repeat_kv(k), self._repeat_kv(v)
             o = flash_attention(
                 q, k, v, causal=True,
                 block_q=cfg.flash_block_q or None,
                 block_k=cfg.flash_block_k or None,
             )
         else:
+            k, v = self._repeat_kv(k), self._repeat_kv(v)
             o = plain_causal_attention(q, k, v)
         o = o.transpose(0, 2, 1, 3)  # [B,S,H,Dh]
         return jnp.einsum("bshk,hkd->bsd", o, wt(lp["wo"], dt))

@@ -1,9 +1,18 @@
-from .attention import flash_attention, reference_attention
+from .attention import (
+    flash_attention,
+    flash_attention_v2,
+    flash_attention_v2_lse,
+    reference_attention,
+    rope_rotate,
+)
 from .paged_attention import paged_attention, paged_attention_reference
 
 __all__ = [
     "flash_attention",
+    "flash_attention_v2",
+    "flash_attention_v2_lse",
     "reference_attention",
+    "rope_rotate",
     "paged_attention",
     "paged_attention_reference",
 ]

@@ -13,6 +13,19 @@ blocks) and one producing dk/dv (grid over k blocks) — so training never
 materializes the O(S²) score matrix either.  The only non-kernel work in
 the backward is the elementwise delta = rowsum(dO ⊙ O), which XLA fuses.
 
+A **v2 path** (ISSUE 12) restructures the same kernels around three
+individually A/B-able changes: RoPE applied in-kernel from program-id-
+derived positions (the VJP applies the transpose rotation in the dq and
+dk/dv kernels, so gradients land in the *unrotated* parameter basis),
+GQA-native K/V streaming (K/V arrive at the physical ``[B, KH, S, D]``
+and the ``G = H/KH`` query heads fold into the q row axis,
+paged_attention-style, so each K/V block is DMA'd once per KV head), and
+a ``q_pipeline`` factor running P q-tiles per program against one shared
+K/V stream.  Shapes outside the support matrix demote v2 → v1 →
+reference oracle, minting ``flash_fallback_total{reason}`` at every hop
+(increments happen at trace time — once per compiled path, not per
+step).
+
 On CPU (tests) the same kernels run under ``interpret=True`` so the kernel
 logic itself is exercised without TPU hardware.
 """
@@ -20,10 +33,13 @@ logic itself is exercised without TPU hardware.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from ..utils.metrics import global_metrics
 
 NEG_INF = -1e30
 
@@ -48,6 +64,48 @@ def reference_attention_lse(q, k, v, causal: bool = True):
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
     return out, jax.scipy.special.logsumexp(s, axis=-1)
+
+
+def rope_rotate(x, theta, *, sign: float = 1.0):
+    """Rotary embedding over the trailing ``[..., S, D]`` axes at positions
+    ``arange(S)`` — the jnp twin of the in-kernel rotation, used by the v2
+    demotion path and the rotated-basis parity tests.  Same math as
+    ``TransformerLM._rope`` (half-split convention, f32 compute, cast
+    back).  ``sign=-1`` applies the transpose (inverse) rotation."""
+    s, d = x.shape[-2], x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs  # [S, half]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles) * sign
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _rope_block(x, pos0, theta, sign=1.0):
+    """In-kernel rotation of an f32 tile ``[rows, D]`` whose row ``i`` sits
+    at sequence position ``pos0 + i`` (``pos0`` may be traced — it derives
+    from a program id).  The angle table is rebuilt from iota per call:
+    O(rows·D/2) transcendentals against the tile's O(rows·D·block) MACs,
+    in exchange for never touching HBM with a rotated copy."""
+    rows, d = x.shape
+    half = d // 2
+    pos = (
+        pos0 + jax.lax.broadcasted_iota(jnp.int32, (rows, half), 0)
+    ).astype(jnp.float32)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (rows, half), 1).astype(
+        jnp.float32
+    )
+    # exp(-i/half · ln θ) == θ^(-i/half), expressed without a pow lowering.
+    freqs = jnp.exp(idx * (-math.log(theta) / half))
+    angles = pos * freqs
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles) * sign
+    x1 = x[:, :half]
+    x2 = x[:, half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
@@ -349,6 +407,359 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# -- v2: RoPE in-kernel, GQA-native K/V streaming, wider q-block pipeline ----
+#
+# Layout: q [B, H, S, D] with H = KH·G folds to [B·KH, G·S, D] (head
+# h = kh·G + g — the same grouping as _repeat_kv and paged_attention's row
+# fold); K/V stay physical at [B·KH, S, D], so each K/V block is DMA'd once
+# per KV head instead of once per query head.  Because S % block_q == 0,
+# every q block lies inside ONE group member: its sequence offset is
+# p0 = row0 % S — derivable from the program id, which is what lets RoPE
+# and the causal bound run in-kernel on the folded axis.  The pipeline
+# factor P hands each program P q-tiles against one resident K/V stream
+# (q/o/lse block shapes grow to P·block_q rows; the sub-tile loop below
+# unrolls at trace time).  VMEM note: the dkv kernel stages the full
+# folded q/dO (G·S·D elements per KV head) — fine for the flagship's
+# G = 1..4 at S = 2048, and the support matrix keeps geometry honest.
+
+
+def _fwd_kernel_v2(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
+                   seq_len, causal, scale, pipeline, rope_theta):
+    """One (batch·kv-head, q-super-tile) program: for each of P sub-tiles,
+    stream K/V blocks through the v1 online softmax; with rope fused,
+    rotate the resident q tile and every streamed k block in-kernel."""
+    qs = pl.program_id(1)
+    num_k_blocks = seq_len // block_k
+    for t in range(pipeline):
+        p0 = ((qs * pipeline + t) * block_q) % seq_len
+        q = q_ref[0, pl.ds(t * block_q, block_q), :].astype(jnp.float32)
+        if rope_theta is not None:
+            q = _rope_block(q, p0, rope_theta)
+        m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q,), jnp.float32)
+        acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+        if causal:
+            last = (p0 + block_q + block_k - 1) // block_k
+            upper = jnp.minimum(num_k_blocks, last)
+        else:
+            upper = num_k_blocks
+        q_pos = p0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+
+        def body(j, carry, q=q, q_pos=q_pos):
+            m, l, acc = carry
+            kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            if rope_theta is not None:
+                kb = _rope_block(kb, j * block_k, rope_theta)
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                k_pos = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l = l * alpha + p.sum(axis=-1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l, acc
+
+        m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+        o_ref[0, pl.ds(t * block_q, block_q), :] = (
+            acc / l[:, None]
+        ).astype(o_ref.dtype)
+        lse_ref[0, pl.ds(t * block_q, block_q), :] = (m + jnp.log(l))[:, None]
+
+
+def _bwd_dq_kernel_v2(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                      *, block_q, block_k, seq_len, causal, scale, pipeline,
+                      rope_theta):
+    """dq for one (batch·kv-head, q-super-tile): recompute the rotated q/k
+    exactly as the forward did, accumulate dq in the ROTATED basis, then
+    apply the transpose rotation once at the end so the emitted gradient
+    lands in the unrotated parameter basis."""
+    qs = pl.program_id(1)
+    num_k_blocks = seq_len // block_k
+    for t in range(pipeline):
+        p0 = ((qs * pipeline + t) * block_q) % seq_len
+        q = q_ref[0, pl.ds(t * block_q, block_q), :].astype(jnp.float32)
+        if rope_theta is not None:
+            q = _rope_block(q, p0, rope_theta)
+        g = g_ref[0, pl.ds(t * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(t * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(t * block_q, block_q), 0]
+        if causal:
+            last = (p0 + block_q + block_k - 1) // block_k
+            upper = jnp.minimum(num_k_blocks, last)
+        else:
+            upper = num_k_blocks
+        q_pos = p0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+
+        def body(j, dq, q=q, g=g, lse=lse, delta=delta, q_pos=q_pos):
+            kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            if rope_theta is not None:
+                kb = _rope_block(kb, j * block_k, rope_theta)
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                k_pos = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            dp = jax.lax.dot_general(
+                g, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[:, None]) * scale
+            return dq + jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+        dq = jax.lax.fori_loop(0, upper, body, dq0)
+        if rope_theta is not None:
+            # q_rot = R(p)·q  ⇒  dq = R(p)ᵀ·dq_rot — rotation with -sin.
+            dq = _rope_block(dq, p0, rope_theta, sign=-1.0)
+        dq_ref[0, pl.ds(t * block_q, block_q), :] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_v2(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, *, block_q, seq_len, causal, scale,
+                       group, rope_theta):
+    """dk/dv for one (batch·kv-head, k-block): the folded group's G query
+    sub-sequences stream through ONE carry, so dk/dv accumulate across the
+    group in-kernel (no post-hoc segment-sum); dk leaves through the
+    transpose rotation when rope is fused (v is never rotated, so dv and
+    the delta/lse plumbing are rope-free)."""
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    kp0 = ki * block_k
+    kb = k_ref[0].astype(jnp.float32)  # [bk, D]
+    vb = v_ref[0].astype(jnp.float32)
+    if rope_theta is not None:
+        kb = _rope_block(kb, kp0, rope_theta)
+    num_q_blocks = seq_len // block_q
+    # For causal attention, q blocks strictly above this k block's diagonal
+    # contribute nothing — start each group member's stream at the diagonal.
+    lower = kp0 // block_q if causal else 0
+    k_pos = kp0 + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    dk = jnp.zeros((block_k, kb.shape[-1]), jnp.float32)
+    dv = jnp.zeros((block_k, vb.shape[-1]), jnp.float32)
+    for gi in range(group):
+        base = gi * seq_len
+
+        def body(i, carry, base=base):
+            dk, dv = carry
+            row = base + i * block_q
+            qb = q_ref[0, pl.ds(row, block_q), :].astype(jnp.float32)
+            if rope_theta is not None:
+                qb = _rope_block(qb, i * block_q, rope_theta)
+            gb = g_ref[0, pl.ds(row, block_q), :].astype(jnp.float32)
+            lse_b = lse_ref[0, pl.ds(row, block_q), 0]
+            delta_b = delta_ref[0, pl.ds(row, block_q), 0]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                q_pos = i * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            p = jnp.exp(s - lse_b[:, None])
+            dv = dv + jax.lax.dot_general(
+                p, gb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                gb, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_b[:, None]) * scale
+            dk = dk + jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk, dv
+
+        dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body, (dk, dv))
+    if rope_theta is not None:
+        dk = _rope_block(dk, kp0, rope_theta, sign=-1.0)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_v2_forward(q, k, v, causal, block_q, block_k, interpret, pipeline,
+                      rope_theta):
+    b, h, s, d = q.shape
+    kh = k.shape[1]
+    grp = h // kh
+    rows = grp * s
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    scale = d**-0.5
+    # [B, H, S, D] = [B, KH, G, S, D] row-major → one reshape folds (KH)
+    # into batch and (G, S) into rows.
+    qr = q.reshape(b * kh, rows, d)
+    kr = k.reshape(b * kh, s, d)
+    vr = v.reshape(b * kh, s, d)
+    sup = pipeline * bq
+    kernel = functools.partial(
+        _fwd_kernel_v2, block_q=bq, block_k=bk, seq_len=s, causal=causal,
+        scale=scale, pipeline=pipeline, rope_theta=rope_theta,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * kh, rows // sup),
+        in_specs=[
+            pl.BlockSpec((1, sup, d), lambda bh, qs: (bh, qs, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qs: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qs: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, sup, d), lambda bh, qs: (bh, qs, 0)),
+            pl.BlockSpec((1, sup, 1), lambda bh, qs: (bh, qs, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * kh, rows, d), q.dtype),
+            jax.ShapeDtypeStruct((b * kh, rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d), lse
+
+
+def _flash_v2_backward(q, k, v, o, lse, g, causal, block_q, block_k,
+                       interpret, pipeline, rope_theta, g_lse=None):
+    b, h, s, d = q.shape
+    kh = k.shape[1]
+    grp = h // kh
+    rows = grp * s
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    scale = d**-0.5
+    qr = q.reshape(b * kh, rows, d)
+    kr = k.reshape(b * kh, s, d)
+    vr = v.reshape(b * kh, s, d)
+    gr = g.reshape(b * kh, rows, d)
+    # Same delta/g_lse folding as the v1 backward, in the folded layout.
+    delta = jnp.sum(
+        gr.astype(jnp.float32)
+        * o.reshape(b * kh, rows, d).astype(jnp.float32),
+        axis=-1,
+        keepdims=True,
+    )  # [b·kh, rows, 1]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
+    sup = pipeline * bq
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel_v2, block_q=bq, block_k=bk, seq_len=s, causal=causal,
+        scale=scale, pipeline=pipeline, rope_theta=rope_theta,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * kh, rows // sup),
+        in_specs=[
+            pl.BlockSpec((1, sup, d), lambda bh, qs: (bh, qs, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qs: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qs: (bh, 0, 0)),
+            pl.BlockSpec((1, sup, d), lambda bh, qs: (bh, qs, 0)),
+            pl.BlockSpec((1, sup, 1), lambda bh, qs: (bh, qs, 0)),
+            pl.BlockSpec((1, sup, 1), lambda bh, qs: (bh, qs, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, sup, d), lambda bh, qs: (bh, qs, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * kh, rows, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel_v2, block_q=bq, seq_len=s, causal=causal,
+        scale=scale, group=grp, rope_theta=rope_theta,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * kh, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, rows, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, rows, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, rows, 1), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, rows, 1), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * kh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * kh, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse, delta)
+
+    return (
+        dq.reshape(b, h, s, d),
+        dk.reshape(b, kh, s, d),
+        dv.reshape(b, kh, s, d),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_v2(q, k, v, causal, block_q, block_k, interpret, pipeline,
+              rope_theta):
+    """v2 twin of ``_flash``: same (out, lse [B,H,S]) contract (lse stays
+    a first-class differentiable output for ring's merge), with K/V at
+    the physical [B, KH, S, D] and rope/pipeline as kernel constants.
+    Gradients are emitted in the UNROTATED basis — the backward kernels
+    recompute the rotated q/k and apply the transpose rotation to dq/dk
+    before writing."""
+    out, lse = _flash_v2_forward(
+        q, k, v, causal, block_q, block_k, interpret, pipeline, rope_theta
+    )
+    return out, lse.reshape(q.shape[0], q.shape[1], q.shape[2])
+
+
+def _flash_v2_fwd(q, k, v, causal, block_q, block_k, interpret, pipeline,
+                  rope_theta):
+    out, lse = _flash_v2_forward(
+        q, k, v, causal, block_q, block_k, interpret, pipeline, rope_theta
+    )
+    primal = (out, lse.reshape(q.shape[0], q.shape[1], q.shape[2]))
+    return primal, (q, k, v, out, lse)
+
+
+def _flash_v2_bwd(causal, block_q, block_k, interpret, pipeline, rope_theta,
+                  res, g):
+    q, k, v, o, lse = res
+    g_o, g_lse = g
+    return _flash_v2_backward(
+        q, k, v, o, lse, g_o, causal, block_q, block_k, interpret,
+        pipeline, rope_theta, g_lse=g_lse.reshape(lse.shape),
+    )
+
+
+_flash_v2.defvjp(_flash_v2_fwd, _flash_v2_bwd)
+
+
 def default_flash_blocks(seq_len: int) -> tuple[int, int]:
     """Shape-aware block defaults, measured on the v5e chip (BENCH r3):
     512x512 beats 256x256 and 128x128 at seq 2048 / d_head 128 (45.8 →
@@ -387,6 +798,45 @@ def flash_attention(
     )[0]
 
 
+def _v1_plan(s, dtype, block_q, block_k):
+    """Resolve the v1 block geometry → (bq, bk, fallback_reason|None).
+
+    ONE function owns the fallback matrix, so the entry point, the v2
+    demotion chain, and ``describe_train_attention`` can never disagree
+    about which path a shape compiles."""
+    if block_q is None or block_k is None:
+        auto_q, auto_k = default_flash_blocks(s)
+        block_q = block_q or auto_q
+        block_k = block_k or auto_k
+        if min(block_q, block_k) < 8:
+            # Degenerate tiling (odd/short seq): the einsum oracle beats a
+            # 1-wide kernel.
+            return block_q, block_k, "degenerate_seq"
+    bq, bk = min(block_q, s), min(block_k, s)
+    if s % bq != 0 or s % bk != 0:
+        return bq, bk, "seq_indivisible"
+    # Blocks must also respect the TPU vector tiling (sublane 16 for
+    # bf16, 8 for f32) — clamping a pinned block to an odd S (e.g. 512
+    # clamped to 65) divides evenly yet makes Mosaic reject the kernel
+    # ("index in dimension 1 is not a multiple of 8").
+    tile = 16 if jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16) else 8
+    if bq % tile != 0 or bk % tile != 0:
+        return bq, bk, "sublane_misaligned"
+    return bq, bk, None
+
+
+def _v2_plan(s, grp, dtype, block_q, block_k, pipeline):
+    """v2 support matrix: v1's geometry rules on the per-sequence blocks
+    (q blocks must not cross a folded group boundary, which S % bq == 0
+    guarantees), plus the pipeline factor dividing the folded q-block
+    count.  Reasons carry a ``v2_`` prefix so the fallback counter
+    attributes the hop, not just the geometry."""
+    bq, bk, reason = _v1_plan(s, dtype, block_q, block_k)
+    if reason is None and pipeline > 1 and ((grp * s) // bq) % pipeline != 0:
+        reason = "pipeline_indivisible"
+    return bq, bk, ("v2_" + reason) if reason is not None else None
+
+
 def flash_attention_lse(
     q,
     k,
@@ -399,25 +849,141 @@ def flash_attention_lse(
     """Blockwise attention returning (out, lse [B, H, S]) — the contract
     ring attention needs to merge per-hop block results (the online-
     softmax combine is a function of normalized outputs + logsumexps).
-    Same auto-block/fallback/auto-interpret rules as flash_attention."""
+    Same auto-block/fallback/auto-interpret rules as flash_attention.
+    Every fallback to the reference oracle mints
+    ``flash_fallback_total{reason}`` (at trace time — once per compiled
+    path), so a caller pinning bad blocks can no longer silently train
+    on the O(S²) einsum."""
     s = q.shape[2]
-    if block_q is None or block_k is None:
-        auto_q, auto_k = default_flash_blocks(s)
-        block_q = block_q or auto_q
-        block_k = block_k or auto_k
-        if min(block_q, block_k) < 8:
-            # Degenerate tiling (odd/short seq): the einsum oracle beats a
-            # 1-wide kernel.
-            return reference_attention_lse(q, k, v, causal)
-    bq, bk = min(block_q, s), min(block_k, s)
-    # Blocks must also respect the TPU vector tiling (sublane 16 for
-    # bf16, 8 for f32) — clamping a pinned block to an odd S (e.g. 512
-    # clamped to 65) divides evenly yet makes Mosaic reject the kernel
-    # ("index in dimension 1 is not a multiple of 8").
-    tile = 16 if q.dtype == jnp.bfloat16 else 8
-    if (s % bq != 0 or s % bk != 0
-            or bq % tile != 0 or bk % tile != 0):
+    bq, bk, reason = _v1_plan(s, q.dtype, block_q, block_k)
+    if reason is not None:
+        global_metrics.inc("flash_fallback_total", reason=reason)
         return reference_attention_lse(q, k, v, causal)
     if interpret is None:
         interpret = _auto_interpret()
     return _flash(q, k, v, causal, bq, bk, interpret)
+
+
+def flash_attention_v2(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    rope_theta: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    q_pipeline: int = 1,
+    interpret: bool | None = None,
+):
+    """v2 blockwise attention → [B, H, S, D].  See flash_attention_v2_lse."""
+    return flash_attention_v2_lse(
+        q, k, v, causal=causal, rope_theta=rope_theta, block_q=block_q,
+        block_k=block_k, q_pipeline=q_pipeline, interpret=interpret,
+    )[0]
+
+
+def flash_attention_v2_lse(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    rope_theta: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    q_pipeline: int = 1,
+    interpret: bool | None = None,
+):
+    """v2 entry: q [B, H, S, D] against K/V at the PHYSICAL [B, KH, S, D]
+    (KH must divide H; KH == H is plain MHA) → (out [B, H, S, D],
+    lse [B, H, S]).
+
+    ``rope_theta`` fuses the rotary embedding in-kernel at positions
+    ``arange(S)`` (the training/prefill layout — callers with per-row or
+    offset positions must rotate outside and pass None); gradients land
+    in the unrotated basis.  ``q_pipeline`` = P > 1 processes P q-tiles
+    per program against one shared K/V stream.  With no feature active
+    (KH == H, P == 1, no rope) the call routes to the v1 entry directly —
+    zero extra compile surface.  Shapes outside the support matrix mint
+    ``flash_fallback_total{reason="v2_*"}`` and demote to the v1 path
+    (rope applied as a jnp pass, K/V re-broadcast), which may mint again
+    and demote to the reference oracle — one mint per hop."""
+    b, h, s, d = q.shape
+    kh = k.shape[1]
+    if h % kh != 0:
+        raise ValueError(
+            f"query heads {h} must be a multiple of KV heads {kh}"
+        )
+    if v.shape != k.shape:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    if rope_theta is not None and d % 2 != 0:
+        raise ValueError(f"fused rope needs an even head dim, got d={d}")
+    grp = h // kh
+    pipeline = max(1, q_pipeline)
+    if grp == 1 and pipeline == 1 and rope_theta is None:
+        return flash_attention_lse(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+    bq, bk, reason = _v2_plan(s, grp, q.dtype, block_q, block_k, pipeline)
+    if reason is not None:
+        global_metrics.inc("flash_fallback_total", reason=reason)
+        if rope_theta is not None:
+            q = rope_rotate(q, rope_theta)
+            k = rope_rotate(k, rope_theta)
+        if grp > 1:
+            k = jnp.repeat(k, grp, axis=1)
+            v = jnp.repeat(v, grp, axis=1)
+        return flash_attention_lse(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+    if interpret is None:
+        interpret = _auto_interpret()
+    return _flash_v2(
+        q, k, v, causal, bq, bk, interpret, pipeline,
+        float(rope_theta) if rope_theta is not None else None,
+    )
+
+
+def describe_train_attention(cfg, *, seq_sharded: bool = False) -> str:
+    """One-line name of the attention path a TransformerConfig-shaped
+    config compiles for the training step (duck-typed — any object with
+    the flash knobs works).  The trainer logs it once at startup so a
+    silent oracle fallback shows in the job log, not only in
+    ``flash_fallback_total``."""
+    if not getattr(cfg, "use_flash", False):
+        return "plain-causal (use_flash off)"
+    s = int(getattr(cfg, "max_seq", 0))
+    dtype = getattr(cfg, "dtype", jnp.float32)
+    bq_arg = getattr(cfg, "flash_block_q", 0) or None
+    bk_arg = getattr(cfg, "flash_block_k", 0) or None
+    rope = bool(getattr(cfg, "flash_fuse_rope", False))
+    if seq_sharded:
+        sp = getattr(cfg, "sp_attention", "ring")
+        extra = " (rope outside: sp_fused_rope)" if rope else ""
+        return f"sp-{sp}{extra}"
+    heads = int(getattr(cfg, "n_heads", 1))
+    kh = int(getattr(cfg, "kv_heads", heads) or heads)
+    grp = heads // kh if getattr(cfg, "flash_kv_grouped", False) else 1
+    pipeline = max(1, int(getattr(cfg, "flash_q_pipeline", 0)))
+    if grp > 1 or rope or pipeline > 1:
+        bq, bk, reason = _v2_plan(s, grp, dtype, bq_arg, bk_arg, pipeline)
+        if reason is None:
+            knobs = ",".join(
+                name for name, on in (
+                    ("rope", rope),
+                    (f"gqa={grp}", grp > 1),
+                    (f"pipeline={pipeline}", pipeline > 1),
+                ) if on
+            )
+            return f"flash-v2[{knobs}] blocks {bq}x{bk}"
+        bq, bk, r1 = _v1_plan(s, dtype, bq_arg, bk_arg)
+        if r1 is None:
+            return f"flash-v1 blocks {bq}x{bk} (v2 fallback: {reason})"
+        return f"reference-oracle ({reason} -> {r1})"
+    bq, bk, r1 = _v1_plan(s, dtype, bq_arg, bk_arg)
+    if r1 is None:
+        return f"flash-v1 blocks {bq}x{bk}"
+    return f"reference-oracle ({r1})"

@@ -16,16 +16,31 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _shard_map(f, **kw):
+def shard_map_compat(f, **kw):
     """``jax.shard_map`` with a fallback to the pre-promotion spelling:
     this environment's jax pin (0.4.x) only ships
     ``jax.experimental.shard_map.shard_map`` (the top-level name raises
     an accelerated-deprecation AttributeError), while the bench host's
-    newer jax has the promoted API.  Same call convention either way."""
+    newer jax has the promoted API.  The promoted API also renamed
+    ``check_rep`` → ``check_vma``; callers pass the new spelling and the
+    shim translates when falling back.  Shared by every shard_map call
+    site in the package (ring/ulysses/pipeline/collectives)."""
     sm = getattr(jax, "shard_map", None)
     if sm is None:
         from jax.experimental.shard_map import shard_map as sm
+
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        if "axis_names" in kw:
+            # Partial-manual spelling flipped polarity across the
+            # promotion: new API names the MANUAL axes, the experimental
+            # one names the AUTO complement.
+            manual = frozenset(kw.pop("axis_names"))
+            kw["auto"] = frozenset(kw["mesh"].axis_names) - manual
     return sm(f, **kw)
+
+
+_shard_map = shard_map_compat
 
 
 def psum_smoke(mesh: Mesh | None = None) -> dict:

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .collectives import shard_map_compat
+
 
 def gpipe(
     stage_fn,
@@ -49,6 +51,19 @@ def gpipe(
 
     p_spec = params_spec or P(axis_name)
     in_x_spec = x_spec or P()
+
+    # Validate eagerly (outside the shard_map trace): the traced body's
+    # exception surfaces as whatever the shard_map impl wraps it in.
+    batch_div = 1
+    if len(in_x_spec) > 0 and in_x_spec[0] is not None:
+        ax0 = in_x_spec[0]
+        for ax in ax0 if isinstance(ax0, tuple) else (ax0,):
+            batch_div *= mesh.shape.get(ax, 1)
+    if (x.shape[0] // batch_div) % M != 0:
+        raise ValueError(
+            f"local batch {x.shape[0] // batch_div} not divisible by "
+            f"{M} microbatches"
+        )
 
     def body(params, xfull):
         # xfull is the LOCAL batch shard (B / prod(x_spec axes)).
@@ -108,7 +123,7 @@ def gpipe(
         if ax is None:
             continue
         manual |= set(ax) if isinstance(ax, tuple) else {ax}
-    return jax.shard_map(
+    return shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(p_spec, in_x_spec),
@@ -318,7 +333,7 @@ def one_f_one_b(
         return loss, dparams, dtail, dx
 
     manual = {axis_name, *batch_axes}
-    return jax.shard_map(
+    return shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(p_spec, P(), in_x_spec, in_x_spec),
@@ -588,7 +603,7 @@ def interleaved_1f1b(
         return loss, dparams, dtail, dx
 
     manual = {axis_name, *batch_axes}
-    loss, dchunked, dtail, dx = jax.shard_map(
+    loss, dchunked, dtail, dx = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(p_spec, P(), in_x_spec, in_x_spec),

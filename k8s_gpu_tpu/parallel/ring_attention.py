@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .collectives import shard_map_compat
+
 NEG_INF = -1e30
 
 
@@ -84,6 +86,15 @@ def _from_zigzag(lo, hi, axis_name, n):
     return jnp.concatenate([r1, r2], axis=-2)
 
 
+def _expand_kv(q, t):
+    """Broadcast grouped K/V [B, KH, C, D] to q's head count.  The ring's
+    collectives and zigzag transforms are head-count-agnostic, so grouped
+    K/V travel the ICI at KH heads (G× less ring traffic) and expand only
+    where an attend needs matched heads."""
+    g = q.shape[1] // t.shape[1]
+    return t if g == 1 else jnp.repeat(t, g, axis=1)
+
+
 def _block_attend(q, k, v, causal, block_q, block_k):
     """One block attend → (normalized out f32, lse f32).
 
@@ -91,12 +102,20 @@ def _block_attend(q, k, v, causal, block_q, block_k):
     attention memory is O(block·C) instead of the (C/2)² score block the
     r2 einsum path materialized (VERDICT r2 weak #5); when shapes don't
     tile (tiny tests) it falls back to the einsum oracle inside
-    flash_attention_lse."""
-    from ..ops.attention import flash_attention_lse
+    flash_attention_lse.  Grouped K/V (fewer heads than q) route to the
+    GQA-native v2 kernel so each K/V block is streamed once per KV head."""
+    if k.shape[1] != q.shape[1]:
+        from ..ops.attention import flash_attention_v2_lse
 
-    o, lse = flash_attention_lse(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k
-    )
+        o, lse = flash_attention_v2_lse(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k
+        )
+    else:
+        from ..ops.attention import flash_attention_lse
+
+        o, lse = flash_attention_lse(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k
+        )
     return o.astype(jnp.float32), lse
 
 
@@ -123,7 +142,7 @@ def _ring_attention_local(q, k, v, *, axis_name, n_blocks,
     blocks [B, H, S/sp, D]."""
     n = n_blocks
     if n == 1:
-        return plain_causal_attention(q, k, v)
+        return plain_causal_attention(q, _expand_kv(q, k), _expand_kv(q, v))
     b, h, c, d = q.shape
     assert c % 2 == 0, f"local seq {c} must be even for zigzag ring"
 
@@ -192,10 +211,13 @@ def ring_attention(
 ) -> jax.Array:
     """Causal self-attention with sequence sharded over *axis_name*.
 
-    q, k, v: [B, H, S, D] (global view; S sharded over sp, B over dp,
-    H over tp).  Returns [B, H, S, D] with the same sharding.  Per-hop
-    block attends run the Pallas flash kernel with these block sizes
-    (None = shape-aware auto-selection).
+    q: [B, H, S, D]; k, v: [B, H, S, D] or grouped [B, KH, S, D] with
+    H % KH == 0 (global view; S sharded over sp, B over dp, heads over
+    tp — grouped K/V require KH % tp == 0).  Grouped K/V ride the ring
+    at KH heads and route each block attend to the GQA-native v2 kernel.
+    Returns [B, H, S, D] with the same sharding.  Per-hop block attends
+    run the Pallas flash kernel with these block sizes (None =
+    shape-aware auto-selection).
     """
     n_blocks = mesh.shape[axis_name]
     spec = P(batch_axes, head_axes, axis_name, None)
@@ -206,7 +228,7 @@ def ring_attention(
         block_q=block_q,
         block_k=block_k,
     )
-    return jax.shard_map(
+    return shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -27,6 +27,8 @@ from functools import partial
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .collectives import shard_map_compat
+
 
 def _ulysses_local(q, k, v, *, axis_name, block_q, block_k):
     """Per-device body under shard_map: inputs are the local sequence
@@ -46,12 +48,51 @@ def _ulysses_local(q, k, v, *, axis_name, block_q, block_k):
     q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     # The local attend is full-sequence ordinary causal attention — the
     # Pallas flash kernel drops in directly (O(block·S) memory; falls back
-    # to the einsum oracle when the sequence doesn't tile).
-    from ..ops.attention import flash_attention
+    # to the einsum oracle when the sequence doesn't tile).  Grouped K/V
+    # (KH < H, pre-validated by ulysses_grouped_ok: the tiled all_to_all
+    # hands query chunk i exactly KV-head chunk i, so the grouping is
+    # preserved shard-locally) route to the GQA-native v2 kernel.
+    if k.shape[1] != q.shape[1]:
+        from ..ops.attention import flash_attention_v2
 
-    o = flash_attention(q, k, v, causal=True, block_q=block_q,
-                        block_k=block_k)
+        o = flash_attention_v2(q, k, v, causal=True, block_q=block_q,
+                               block_k=block_k)
+    else:
+        from ..ops.attention import flash_attention
+
+        o = flash_attention(q, k, v, causal=True, block_q=block_q,
+                            block_k=block_k)
     return heads_to_seq(o)
+
+
+def ulysses_grouped_ok(
+    h: int,
+    kh: int,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    head_axes=("tp",),
+) -> bool:
+    """True when grouped K/V [B, KH, S, D] can ride ulysses' all-to-alls
+    without breaking the query↔KV head pairing.
+
+    The tiled seq→heads all_to_all hands device i head chunk i.  Query
+    chunk i covers heads [i·G·KHs, (i+1)·G·KHs) and KV chunk i covers
+    heads [i·KHs, (i+1)·KHs), where KHs = local KV heads / sp — these
+    pair up exactly iff the local KV head count divides by sp.  Otherwise
+    a query lands on a device that doesn't hold its KV head; the model
+    falls back to broadcast K/V and mints
+    flash_fallback_total{reason="ulysses_kv_heads"}.
+    """
+    if h % kh != 0:
+        return False
+    sp = mesh.shape.get(axis_name, 1)
+    tp = 1
+    for ax in head_axes:
+        tp *= mesh.shape.get(ax, 1)
+    if kh % tp != 0:
+        return False
+    return (kh // tp) % sp == 0
 
 
 def ulysses_attention(
@@ -71,6 +112,8 @@ def ulysses_attention(
     Same contract as ring_attention: q,k,v [B, H, S, D] global view with
     S over sp, B over dp, H over tp; returns the same sharding.  Requires
     the local head count to be divisible by mesh.shape[axis_name].
+    Grouped K/V [B, KH, S, D] are accepted when ulysses_grouped_ok holds
+    (local KV heads divide by sp) and run the GQA-native v2 kernel.
     Block sizes feed the flash kernel (None = shape-aware auto).
     """
     sp = mesh.shape[axis_name]
@@ -83,10 +126,18 @@ def ulysses_attention(
             f"ulysses needs local heads ({q.shape[1]}/{tp}={local_heads}) "
             f"divisible by sp={sp}; use ring attention instead"
         )
+    if k.shape[1] != q.shape[1] and not ulysses_grouped_ok(
+        q.shape[1], k.shape[1], mesh, axis_name=axis_name, head_axes=head_axes
+    ):
+        raise ValueError(
+            f"ulysses grouped K/V needs local KV heads "
+            f"({k.shape[1]}/{tp}) divisible by sp={sp}; broadcast K/V "
+            "to the full head count first (see ulysses_grouped_ok)"
+        )
     spec = P(batch_axes, head_axes, axis_name, None)
     body = partial(_ulysses_local, axis_name=axis_name,
                    block_q=block_q, block_k=block_k)
-    return jax.shard_map(
+    return shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -359,6 +359,22 @@ class Trainer:
             f"unknown pp_schedule {sched!r}; expected '1f1b' or 'gpipe'"
         )
 
+    def _log_attention_path(self) -> None:
+        """Log once, at first-step compile time, which attention path the
+        train step resolved to.  A pinned flash_block that silently demotes
+        to the O(S²) oracle is otherwise invisible until the MFU gauge
+        disappoints (ISSUE 12 satellite: silent-fallback observability)."""
+        cfg = getattr(self.model, "cfg", None)
+        if cfg is None or not hasattr(cfg, "use_flash"):
+            return
+        from ..ops.attention import describe_train_attention
+
+        seq_sharded = self.mesh.shape.get("sp", 1) > 1
+        log.info(
+            "train step attention path: %s",
+            describe_train_attention(cfg, seq_sharded=seq_sharded),
+        )
+
     def step(self, *batch, sync: bool = True):
         """One optimizer step.  ``sync=False`` returns the DEVICE loss
         without a host round-trip: steps chain through the donated
@@ -399,6 +415,7 @@ class Trainer:
                 self._step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
             else:
                 self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+            self._log_attention_path()
         with self.profiler.phase("shard_batch"):
             batch = self.shard_batch(*batch)
         t0 = time.perf_counter()

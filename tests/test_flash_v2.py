@@ -1,0 +1,493 @@
+"""Flash attention v2 (ISSUE 12): RoPE fused in-kernel, GQA-native K/V
+streaming, and the wider q-block pipeline.
+
+Parity discipline: every knob is proven independently and all-on, forward
+AND backward, against the composition ``reference_attention ∘ rope_rotate
+∘ repeat_kv`` — the exact math the v1 path runs.  The rotated-basis
+gradient contract (the VJP's transpose rotation returns dq/dk in the
+UNROTATED parameter basis) is proven by comparing against gradients taken
+through the outside-rope composition, not by argument.  All on CPU via
+the Pallas interpreter (`conftest` pins JAX_PLATFORMS=cpu).
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.ops.attention import (
+    describe_train_attention,
+    flash_attention,
+    flash_attention_lse,
+    flash_attention_v2,
+    flash_attention_v2_lse,
+    reference_attention,
+    reference_attention_lse,
+    rope_rotate,
+)
+from k8s_gpu_tpu.utils.metrics import global_metrics
+
+THETA = 10000.0
+
+
+def qkv(key, b=2, h=4, kh=2, s=64, d=16, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), dtype)
+    k = jax.random.normal(ks[1], (b, kh, s, d), dtype)
+    v = jax.random.normal(ks[2], (b, kh, s, d), dtype)
+    return q, k, v
+
+
+def oracle(q, k, v, *, causal=True, rope=False):
+    """The v1 math: optional outside rope, broadcast K/V, einsum oracle."""
+    g = q.shape[1] // k.shape[1]
+    if rope:
+        q, k = rope_rotate(q, THETA), rope_rotate(k, THETA)
+    k, v = jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
+    return reference_attention(q, k, v, causal)
+
+
+def _tol(dtype):
+    return 2e-2 if dtype == jnp.bfloat16 else 2e-5
+
+
+# ---------------------------------------------------------------- forward
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("kh", [4, 2, 1])  # MHA / GQA / MQA
+@pytest.mark.parametrize("causal", [True, False])
+def test_fwd_gqa_parity(dtype, kh, causal):
+    q, k, v = qkv(jax.random.PRNGKey(0), kh=kh, dtype=dtype)
+    got = flash_attention_v2(q, k, v, causal=causal, block_q=16, block_k=16)
+    want = oracle(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=_tol(dtype),
+    )
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("causal", [True, False])
+def test_fwd_rope_parity(dtype, causal):
+    q, k, v = qkv(jax.random.PRNGKey(1), kh=4, dtype=dtype)
+    got = flash_attention_v2(
+        q, k, v, causal=causal, rope_theta=THETA, block_q=16, block_k=16
+    )
+    want = oracle(q, k, v, causal=causal, rope=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=_tol(dtype),
+    )
+
+
+@pytest.mark.parametrize("pipeline", [2, 4])
+def test_fwd_pipeline_parity(pipeline):
+    q, k, v = qkv(jax.random.PRNGKey(2), kh=4, s=128, d=32)
+    got = flash_attention_v2(
+        q, k, v, causal=True, block_q=16, block_k=16, q_pipeline=pipeline
+    )
+    want = oracle(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fwd_all_knobs_parity(dtype):
+    q, k, v = qkv(jax.random.PRNGKey(3), kh=2, s=128, dtype=dtype)
+    got = flash_attention_v2(
+        q, k, v, causal=True, rope_theta=THETA, block_q=16, block_k=16,
+        q_pipeline=2,
+    )
+    want = oracle(q, k, v, rope=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=_tol(dtype),
+    )
+
+
+def test_lse_matches_reference():
+    q, k, v = qkv(jax.random.PRNGKey(4), kh=2)
+    _, lse = flash_attention_v2_lse(q, k, v, causal=True, block_q=16,
+                                    block_k=16)
+    g = q.shape[1] // k.shape[1]
+    _, want = reference_attention_lse(
+        q, jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1), True
+    )
+    assert lse.shape == q.shape[:3]
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want), atol=2e-5)
+
+
+# --------------------------------------------------------------- backward
+
+def test_grad_all_knobs_rotated_basis():
+    """The decisive gradient check: all-knobs v2 (rope IN-kernel) vs
+    gradients taken through the outside-rope oracle composition.  If the
+    VJP's transpose rotation were wrong, dq/dk would come back in the
+    rotated basis and diverge by O(1)."""
+    q, k, v = qkv(jax.random.PRNGKey(5), kh=2, s=128)
+
+    def loss_v2(q, k, v):
+        o = flash_attention_v2(
+            q, k, v, causal=True, rope_theta=THETA, block_q=16, block_k=16,
+            q_pipeline=2,
+        )
+        return (o.astype(jnp.float32) ** 2).mean()
+
+    def loss_ref(q, k, v):
+        return (oracle(q, k, v, rope=True).astype(jnp.float32) ** 2).mean()
+
+    g2 = jax.grad(loss_v2, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g2, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grad_gqa_only(causal):
+    q, k, v = qkv(jax.random.PRNGKey(6), kh=1)  # MQA: hardest fold
+
+    def loss_v2(q, k, v):
+        o = flash_attention_v2(q, k, v, causal=causal, block_q=16, block_k=16)
+        return (o.astype(jnp.float32) ** 2).mean()
+
+    def loss_ref(q, k, v):
+        return (oracle(q, k, v, causal=causal).astype(jnp.float32) ** 2).mean()
+
+    g2 = jax.grad(loss_v2, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g2, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_grad_rope_matches_v1_outside_rope():
+    """Fused-rope gradients equal v1-kernel gradients with rope applied
+    as a separate jnp pass — the exact substitution _attention makes."""
+    q, k, v = qkv(jax.random.PRNGKey(7), kh=4)
+
+    def loss_v2(q, k, v):
+        o = flash_attention_v2(
+            q, k, v, causal=True, rope_theta=THETA, block_q=16, block_k=16
+        )
+        return (o.astype(jnp.float32) ** 2).mean()
+
+    def loss_v1(q, k, v):
+        o = flash_attention(
+            rope_rotate(q, THETA), rope_rotate(k, THETA), v,
+            causal=True, block_q=16, block_k=16,
+        )
+        return (o.astype(jnp.float32) ** 2).mean()
+
+    g2 = jax.grad(loss_v2, argnums=(0, 1, 2))(q, k, v)
+    g1 = jax.grad(loss_v1, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g2, g1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_grad_lse_cotangent():
+    """lse is a first-class differentiable output (ring's merge contract
+    differentiates through it): a loss touching BOTH out and lse must
+    match the oracle composition's gradients."""
+    q, k, v = qkv(jax.random.PRNGKey(8), kh=2)
+
+    def loss_v2(q, k, v):
+        o, lse = flash_attention_v2_lse(
+            q, k, v, causal=True, rope_theta=THETA, block_q=16, block_k=16
+        )
+        return (o.astype(jnp.float32) ** 2).mean() + 0.1 * lse.sum()
+
+    def loss_ref(q, k, v):
+        g = q.shape[1] // k.shape[1]
+        o, lse = reference_attention_lse(
+            rope_rotate(q, THETA),
+            jnp.repeat(rope_rotate(k, THETA), g, axis=1),
+            jnp.repeat(v, g, axis=1), True,
+        )
+        return (o.astype(jnp.float32) ** 2).mean() + 0.1 * lse.sum()
+
+    g2 = jax.grad(loss_v2, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g2, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_backward_never_calls_reference():
+    """The v2 VJP must be the fused kernels, not a silent fallback: the
+    backward jaxpr contains the pallas calls and no softmax."""
+    q, k, v = qkv(jax.random.PRNGKey(9), kh=2)
+
+    def loss(q, k, v):
+        o = flash_attention_v2(
+            q, k, v, causal=True, rope_theta=THETA, block_q=16, block_k=16,
+            q_pipeline=2,
+        )
+        return (o.astype(jnp.float32) ** 2).mean()
+
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v))
+    assert jaxpr.count("pallas_call") >= 3  # fwd + dq + dkv
+    assert "softmax" not in jaxpr
+
+
+# ---------------------------------------------------- fallbacks & guards
+
+def _minted(before, after):
+    return sorted(
+        ln.split("{")[1].split("}")[0]
+        for ln in after.splitlines()
+        if ln.startswith("flash_fallback_total")
+        and ln not in before.splitlines()
+    )
+
+
+def test_fallback_counter_two_hop():
+    """An untileable shape demotes v2 → v1 → oracle and mints the counter
+    at BOTH hops, attributed per hop by the v2_ prefix."""
+    q, k, v = qkv(jax.random.PRNGKey(10), kh=2, s=65, dtype=jnp.bfloat16)
+    before = global_metrics.render()
+    got = flash_attention_v2(q, k, v, causal=True, block_q=512, block_k=512)
+    minted = _minted(before, global_metrics.render())
+    assert any("v2_sublane_misaligned" in m for m in minted), minted
+    assert any(
+        "sublane_misaligned" in m and "v2_" not in m for m in minted
+    ), minted
+    want = oracle(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=2e-2
+    )
+
+
+def test_fallback_pipeline_indivisible_lands_on_v1():
+    """A pipeline factor that doesn't divide the folded q-block count
+    demotes ONE hop (to v1, which compiles fine) — one mint only."""
+    q, k, v = qkv(jax.random.PRNGKey(11), kh=4, s=64)
+    before = global_metrics.render()
+    got = flash_attention_v2(
+        q, k, v, causal=True, block_q=32, block_k=32, q_pipeline=3
+    )
+    minted = _minted(before, global_metrics.render())
+    assert minted == ['reason="v2_pipeline_indivisible"'], minted
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(oracle(q, k, v)), atol=2e-5
+    )
+
+
+def test_v1_fallback_mints_counter():
+    """Satellite bugfix: the v1 entry itself now mints on oracle fallback
+    (the silent-einsum regression the issue names)."""
+    q, k, v = qkv(jax.random.PRNGKey(12), kh=4, s=65, dtype=jnp.bfloat16)
+    before = global_metrics.render()
+    flash_attention_lse(q, k, v, causal=True, block_q=512, block_k=512)
+    minted = _minted(before, global_metrics.render())
+    assert minted == ['reason="sublane_misaligned"'], minted
+
+
+def test_validation_errors():
+    q, k, v = qkv(jax.random.PRNGKey(13), kh=4)
+    with pytest.raises(ValueError, match="multiple of KV heads"):
+        flash_attention_v2(q, k[:, :3], v[:, :3], causal=True)
+    with pytest.raises(ValueError, match="k/v shape mismatch"):
+        flash_attention_v2(q, k, v[:, :1], causal=True)
+    with pytest.raises(ValueError, match="even head dim"):
+        flash_attention_v2(q[..., :15], k[..., :15], v[..., :15],
+                           causal=True, rope_theta=THETA)
+
+
+def test_no_knobs_routes_to_v1():
+    """KH == H, P == 1, no rope: the v2 entry must not add compile
+    surface — identical jaxpr to the v1 entry."""
+    import re
+
+    q, k, v = qkv(jax.random.PRNGKey(14), kh=4)
+    j1 = str(jax.make_jaxpr(
+        lambda a, b, c: flash_attention_lse(a, b, c, True, 16, 16)
+    )(q, k, v))
+    j2 = str(jax.make_jaxpr(
+        lambda a, b, c: flash_attention_v2_lse(
+            a, b, c, causal=True, block_q=16, block_k=16
+        )
+    )(q, k, v))
+    strip = lambda s: re.sub(r"0x[0-9a-f]+", "0x", s)  # closure addresses
+    assert strip(j1) == strip(j2)
+
+
+def test_describe_train_attention_matrix():
+    class Cfg:
+        use_flash = True
+        max_seq = 64
+        dtype = jnp.float32
+        flash_block_q = 16
+        flash_block_k = 16
+        n_heads = 4
+        kv_heads = 2
+        sp_attention = "ring"
+        flash_fuse_rope = True
+        flash_kv_grouped = True
+        flash_q_pipeline = 2
+
+    assert describe_train_attention(Cfg()) == (
+        "flash-v2[rope,gqa=2,pipeline=2] blocks 16x16"
+    )
+    assert describe_train_attention(Cfg(), seq_sharded=True) == (
+        "sp-ring (rope outside: sp_fused_rope)"
+    )
+
+    c2 = Cfg()
+    c2.flash_q_pipeline = 3  # 4 folded blocks % 3 != 0 → v1
+    assert "v2 fallback: v2_pipeline_indivisible" in describe_train_attention(c2)
+
+    c3 = Cfg()
+    c3.max_seq = 65
+    c3.flash_block_q = 512
+    c3.flash_block_k = 512
+    assert describe_train_attention(c3).startswith("reference-oracle")
+
+    c4 = Cfg()
+    c4.use_flash = False
+    assert describe_train_attention(c4) == "plain-causal (use_flash off)"
+
+
+# ------------------------------------------------- model & trainer wiring
+
+def _model_cfg(**kw):
+    from k8s_gpu_tpu.models import TransformerConfig
+
+    base = dict(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_head=16, d_ff=64, max_seq=64, use_flash=True,
+        flash_block_q=16, flash_block_k=16, dtype=jnp.float32,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _mesh1():
+    from k8s_gpu_tpu.parallel.mesh import MeshConfig, mesh_from_devices
+
+    return mesh_from_devices(jax.devices()[:1], MeshConfig(dp=1))
+
+
+def test_train_step_all_knobs_matches_v1():
+    """The acceptance bar: the all-knobs train step's losses track the
+    v1-config step within dtype tolerance over several steps — identical
+    init, identical data, only the attention path differs."""
+    from k8s_gpu_tpu.models import TransformerLM
+    from k8s_gpu_tpu.train import TrainConfig, Trainer
+
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, 64)
+    losses = {}
+    for name, cfg in (
+        ("v1", _model_cfg()),
+        ("v2", _model_cfg(flash_fuse_rope=True, flash_kv_grouped=True,
+                          flash_q_pipeline=2)),
+    ):
+        tr = Trainer(TransformerLM(cfg), mesh=_mesh1(),
+                     train_config=TrainConfig(warmup_steps=1))
+        tr.init(jax.random.PRNGKey(0))
+        losses[name] = [
+            float(tr.step(toks[:, :-1], toks[:, 1:])) for _ in range(3)
+        ]
+    np.testing.assert_allclose(losses["v2"], losses["v1"], atol=5e-5)
+
+
+def test_train_step_zero_recompile_with_v2(xla_compiles):
+    """Steady-state train steps with every v2 knob on compile nothing new."""
+    from k8s_gpu_tpu.models import TransformerLM
+    from k8s_gpu_tpu.train import TrainConfig, Trainer
+
+    cfg = _model_cfg(flash_fuse_rope=True, flash_kv_grouped=True,
+                     flash_q_pipeline=2)
+    tr = Trainer(TransformerLM(cfg), mesh=_mesh1(),
+                 train_config=TrainConfig(warmup_steps=1))
+    tr.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, 64)
+    tr.step(toks[:, :-1], toks[:, 1:])
+    tr.step(toks[:, :-1], toks[:, 1:])
+    before = xla_compiles()
+    tr.step(toks[:, :-1], toks[:, 1:])
+    tr.step(toks[:, :-1], toks[:, 1:])
+    assert xla_compiles() == before, "v2 train step recompiled in steady state"
+
+
+def test_trainer_logs_attention_path(caplog):
+    from k8s_gpu_tpu.models import TransformerLM
+    from k8s_gpu_tpu.train import TrainConfig, Trainer
+
+    cfg = _model_cfg(flash_fuse_rope=True, flash_kv_grouped=True,
+                     flash_q_pipeline=2)
+    tr = Trainer(TransformerLM(cfg), mesh=_mesh1(),
+                 train_config=TrainConfig(warmup_steps=1))
+    tr.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, 64)
+    with caplog.at_level(logging.INFO, logger="k8s_gpu_tpu.train"):
+        tr.step(toks[:, :-1], toks[:, 1:])
+    msgs = [r.message for r in caplog.records
+            if "attention path" in r.message]
+    assert msgs and "flash-v2[rope,gqa=2,pipeline=2]" in msgs[0], msgs
+
+
+def test_model_sp_keeps_rope_outside_and_mints():
+    """The sp-sharded path cannot fuse rope (a shard's global position
+    offset is invisible to the kernel): the model rotates outside, mints
+    sp_fused_rope, and still matches the unsharded forward."""
+    from k8s_gpu_tpu.models import TransformerLM
+    from k8s_gpu_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    cfg = _model_cfg(flash_fuse_rope=True, flash_kv_grouped=True,
+                     sp_attention="ring")
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 64), 0, 64)
+    want, _ = model.forward(params, toks)
+    mesh = build_mesh(MeshConfig(dp=1, sp=2), n_devices=2)
+    before = global_metrics.render()
+    got, _ = model.forward(params, toks, mesh=mesh)
+    minted = _minted(before, global_metrics.render())
+    assert any("sp_fused_rope" in m for m in minted), minted
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-4
+    )
+
+
+# --------------------------------------------------- sp grouped K/V plumbing
+
+def test_ring_grouped_kv_parity():
+    from k8s_gpu_tpu.parallel.mesh import MeshConfig, build_mesh
+    from k8s_gpu_tpu.parallel.ring_attention import (
+        plain_causal_attention, ring_attention,
+    )
+
+    q, k, v = qkv(jax.random.PRNGKey(15), kh=2, s=64)
+    g = q.shape[1] // k.shape[1]
+    want = plain_causal_attention(
+        q, jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
+    )
+    mesh = build_mesh(MeshConfig(dp=1, sp=2), n_devices=2)
+    got = ring_attention(q, k, v, mesh, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    # n == 1 ring (sp=1) expands grouped K/V for the plain path.
+    mesh1 = build_mesh(MeshConfig(dp=2, sp=1), n_devices=2)
+    got1 = ring_attention(q, k, v, mesh1, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(got1), np.asarray(want), atol=2e-5)
+
+
+def test_ulysses_grouped_kv_parity_and_guard():
+    from k8s_gpu_tpu.parallel.mesh import MeshConfig, build_mesh
+    from k8s_gpu_tpu.parallel.ring_attention import plain_causal_attention
+    from k8s_gpu_tpu.parallel.ulysses import (
+        ulysses_attention, ulysses_grouped_ok,
+    )
+
+    q, k, v = qkv(jax.random.PRNGKey(16), kh=2, s=64)
+    g = q.shape[1] // k.shape[1]
+    want = plain_causal_attention(
+        q, jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
+    )
+    mesh = build_mesh(MeshConfig(dp=1, sp=2), n_devices=2)
+    assert ulysses_grouped_ok(q.shape[1], k.shape[1], mesh)
+    got = ulysses_attention(q, k, v, mesh, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    # sp=4 would strand queries away from their KV head: loud, not wrong.
+    mesh4 = build_mesh(MeshConfig(dp=1, sp=4), n_devices=4)
+    assert not ulysses_grouped_ok(q.shape[1], k.shape[1], mesh4)
+    with pytest.raises(ValueError, match="grouped"):
+        ulysses_attention(q, k, v, mesh4, block_q=16, block_k=16)

@@ -108,8 +108,10 @@ def _naive_ring(q, k, v, mesh, axis_name="sp"):
         return (o / l[..., None]).astype(q.dtype)
 
     spec = P(("dp",), ("tp",), axis_name, None)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from k8s_gpu_tpu.parallel.collectives import shard_map_compat
+
+    return shard_map_compat(body, mesh=mesh, in_specs=(spec,) * 3,
+                            out_specs=spec, check_vma=False)(q, k, v)
 
 
 def _matmul_flops(jaxpr, mult=1):
