@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo
 
 # The default verify path (bare `make`): graftcheck invariants + the
 # attribution-plane smoke + the flash-v2 parity suite (ISSUE 12 — every
@@ -23,7 +23,7 @@ IMAGES = operator trainer devenv
 # train-step guard, all CPU-safe through the Pallas interpreter).  The
 # full suite stays `make test` (it takes minutes); image builds stay
 # `make docker-build`.
-verify: check profile-demo flash-v2-parity
+verify: check profile-demo goodput-demo flash-v2-parity
 
 flash-v2-parity:
 	python -m pytest tests/test_flash_v2.py -q -p no:cacheprovider
@@ -120,6 +120,14 @@ fleet-demo:
 # the Chrome/Perfetto trace export is written and schema-validated.
 profile-demo:
 	python tools/profile_demo.py
+
+# Training-goodput smoke: a tiny training run's wall-clock partition is
+# exhaustive and exact, a seeded preemption walks GoodputDegraded
+# pending→firing→resolved across checkpoint restore, heartbeats name
+# the seeded straggler host, and two scripted runs serve byte-identical
+# /debug/goodput bodies.
+goodput-demo:
+	python tools/goodput_demo.py
 
 # Kernel A/Bs, end to end on CPU interpret mode: fused paged-attention
 # op-level kernel-vs-oracle parity (f32 + int8 KV + trash-block poison),

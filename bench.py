@@ -206,7 +206,16 @@ def train_bench() -> dict:
     cfg, batch = _flagship_config(on_tpu)
     model = TransformerLM(cfg)
     mesh = mesh_from_devices(devs[:1], MeshConfig(dp=1))
-    trainer = Trainer(model, mesh=mesh, train_config=TrainConfig(warmup_steps=1))
+    # Goodput ledger (ISSUE 13) riding the bench run: the same
+    # wall-clock partition a production trainer exports, so the report
+    # carries where the bench's non-step time went (CPU-safe — the
+    # ledger is pure bookkeeping around the step calls).
+    from k8s_gpu_tpu.utils.goodput import GoodputLedger
+
+    ledger = GoodputLedger()
+    trainer = Trainer(model, mesh=mesh,
+                      train_config=TrainConfig(warmup_steps=1),
+                      ledger=ledger)
 
     t0 = time.perf_counter()
     trainer.init(jax.random.PRNGKey(0))
@@ -309,6 +318,18 @@ def train_bench() -> dict:
             "train_phase_shares": {
                 ph: round(st["share"], 4)
                 for ph, st in trainer.profiler.snapshot()["phases"].items()
+            },
+            # Goodput account (ISSUE 13): productive share of the bench
+            # run's lifetime, plus each non-productive segment's share
+            # (train_nonproductive_share_compile dominates on first
+            # contact — compile IS the bench's overhead story).
+            "train_goodput_ratio": round(
+                ledger.snapshot()["goodput_ratio_total"], 4
+            ),
+            **{
+                f"train_nonproductive_share_{seg}": round(st["share"], 4)
+                for seg, st in ledger.snapshot()["segments"].items()
+                if seg != "step"
             },
         },
     }

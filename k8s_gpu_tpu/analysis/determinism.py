@@ -49,6 +49,10 @@ DETERMINISTIC_PLANES = (
     # wrappers whose wall window now flows through Clock.
     "k8s_gpu_tpu/utils/profiler.py",
     "k8s_gpu_tpu/utils/profiling.py",
+    # The goodput ledger (ISSUE 13): the two-run bit-identical
+    # /debug/goodput contract — segment partition, incident ring and
+    # straggler math are pure functions of (calls, injected Clock).
+    "k8s_gpu_tpu/utils/goodput.py",
     "k8s_gpu_tpu/operators/",
     "k8s_gpu_tpu/controller/",
     "k8s_gpu_tpu/cloud/resilience.py",

@@ -1034,6 +1034,35 @@ def cmd_obs(args) -> int:
             return 1
         print(render_profile(snapshot_from_exposition(text)))
         return 0
+    if args.obs_cmd == "goodput":
+        # Training goodput: the /debug/goodput view — the wall-clock
+        # partition (where did the time go), the windowed goodput
+        # ratio, checkpoint save/restore percentiles, straggler
+        # attribution, and the incident flight recorder.
+        from ..utils.obs import render_goodput
+
+        if args.url:
+            body = _obs_fetch(args.url, "/debug/goodput")
+            if body is None:
+                return 1
+            try:
+                snap = json.loads(body)
+                snap["segments"]
+            except (ValueError, KeyError, TypeError) as e:
+                print(f"fetch failed: {e}", file=sys.stderr)
+                return 1
+            print(render_goodput(snap))
+            return 0
+        # Offline: reconstruct the goodput view from the persisted
+        # exposition (nonproductive counters, step-time histogram sum,
+        # ratio/skew gauges, checkpoint buckets, incident counters).
+        from ..utils.goodput import goodput_snapshot_from_exposition
+
+        text = _obs_snapshot()
+        if text is None:
+            return 1
+        print(render_goodput(goodput_snapshot_from_exposition(text)))
+        return 0
     if args.obs_cmd == "route":
         # Routing explain: which replica the prefix-affinity router
         # would pick for a prompt, and what every candidate scored.
@@ -1589,6 +1618,18 @@ def build_parser() -> argparse.ArgumentParser:
                               "requires --url")
     p_oprof.add_argument("--limit", type=int, default=200,
                          help="max traces pulled for the chrome export")
+    p_ogp = obs_sub.add_parser(
+        "goodput",
+        help="training goodput ledger: wall-clock attribution by "
+             "segment, windowed goodput ratio, checkpoint percentiles, "
+             "straggler attribution and the incident flight recorder "
+             "(/debug/goodput)",
+    )
+    p_ogp.add_argument("--url", default="",
+                       help="base URL of a metrics server with a "
+                            "goodput ledger attached (/debug/goodput); "
+                            "default: reconstruct from the persisted "
+                            "metrics.prom")
     p_orte = obs_sub.add_parser(
         "route",
         help="explain a routing decision: which replica the "

@@ -32,6 +32,7 @@ from ..controller.kubefake import Conflict, FakeKube, NotFound
 from ..controller.manager import Reconciler, Request, Result
 from .pool_gauges import clear_pool_gauges, export_pool_gauges
 from ..scheduling.labels import LABEL_POOL, TPU_RESOURCE, node_labels_for_host
+from ..utils.goodput import record_incident
 from ..utils.metrics import MetricsRegistry, global_metrics
 from ..utils.tracing import global_tracer
 
@@ -142,6 +143,20 @@ class TpuPodSliceReconciler(Reconciler):
                 ps, "Warning" if broken else "Normal", "QueuedResourceDeleted",
                 f"deleted queued resource {stale.name} (state={stale.state})",
             )
+            if broken and stale is qr:
+                # Cross-stamp the goodput incident timeline with the same
+                # causing Event: a FAILED/SUSPENDED slice is an eviction
+                # from the trainer's point of view.
+                record_incident(
+                    "eviction",
+                    detail=(
+                        f"queued resource {stale.name} state={stale.state}"
+                    ),
+                    event=(
+                        "Warning/QueuedResourceDeleted "
+                        f"{ps.metadata.namespace}/{ps.metadata.name}"
+                    ),
+                )
             if stale is qr:
                 # Only the primary QR's nodes were ever joined; deleting a
                 # stray must not evict the healthy slice's nodes.

@@ -30,6 +30,7 @@ from ..controller.manager import Reconciler, Request, Result
 from ..scheduling.labels import LABEL_ACCELERATOR, LABEL_SLICE, TPU_RESOURCE
 from ..scheduling.placement import PlacementError, multislice_spread, place_gang
 from ..scheduling.queueing import QueueAdmitter
+from ..utils.goodput import record_incident
 from ..utils.metrics import MetricsRegistry, global_metrics
 from ..utils.tracing import global_tracer
 
@@ -295,6 +296,17 @@ class TrainJobReconciler(Reconciler):
                     job, "Warning", "Restarting", job.status.message
                 )
                 self.metrics.inc("trainjob_restarts_total", kind=kind)
+                # Cross-stamp the goodput incident timeline: any attached
+                # ledger gets the same causing Event the operator emitted,
+                # so `obs goodput` and `kubectl describe` tell one story.
+                record_incident(
+                    "preemption" if kind == "preempted" else "restart",
+                    detail=job.status.message,
+                    event=(
+                        "Warning/Restarting "
+                        f"{job.metadata.namespace}/{job.metadata.name}"
+                    ),
+                )
                 return Result(requeue_after=CAPACITY_POLL)
             log.exception("job %s workload failed", job.metadata.name)
             self._teardown_pods(job, "Failed")

@@ -8,7 +8,8 @@ pipeline:
     tokenizer's character alphabet ──token walk──► two arrays:
 
         next_state [S, V] int32   (-1 = dead)
-        allowed    [S, V] bool    (token keeps the string in-language)
+        allowed    [S, V] bool    (token keeps the string in-language
+                                   AND completable by this vocabulary)
 
 Everything data-dependent at decode time is a GATHER on those arrays:
 each row carries its DFA state; the state's `allowed` row masks the
@@ -326,8 +327,10 @@ def compile_constraint(pattern: str, token_strings: list[str]) -> RegexConstrain
     """Build the [S, V] token tables for *pattern* over a vocabulary.
 
     ``token_strings[v]`` is the text token v decodes to.  A token is
-    allowed in state s iff walking its characters stays in-language;
-    empty tokens are never allowed (they would stall the automaton)."""
+    allowed in state s iff walking its characters stays in-language AND
+    the landing state can still reach acceptance via tokens of this
+    vocabulary; empty tokens are never allowed (they would stall the
+    automaton)."""
     ast = _parse(pattern)
     nfa = _Nfa()
     s0, s_end = _build(nfa, ast)
@@ -382,6 +385,24 @@ def compile_constraint(pattern: str, token_strings: list[str]) -> RegexConstrain
         for ch in tok:
             cur = trans[ch][cur]
         next_state[:, v] = np.where(cur == DEAD, -1, cur)
+    # Prefix-validity is not completability: a token can keep the string
+    # in-language while landing in a state no token in THIS vocabulary
+    # can ever extend to acceptance (a bare '"' walking into the middle
+    # of a property name the tokenizer only carries whole).  The decode
+    # loop then dead-ends and retires the row on EOS with an unparseable
+    # prefix.  Prune to token-live states — accepting, or with some
+    # transition into a live state — as a fixpoint over the TOKEN tables
+    # (character-level liveness is not enough: the stranded state above
+    # is char-live but token-dead).
+    valid = next_state >= 0
+    tgt = np.where(valid, next_state, 0)
+    live = accepting.copy()
+    while True:
+        grown = live | (valid & live[tgt]).any(axis=1)
+        if (grown == live).all():
+            break
+        live = grown
+    next_state = np.where(valid & live[tgt], next_state, -1)
     return RegexConstraint(
         next_state=jnp.asarray(next_state),
         allowed=jnp.asarray(next_state >= 0),

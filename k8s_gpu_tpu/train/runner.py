@@ -17,15 +17,19 @@ from __future__ import annotations
 
 import logging
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..api.workload import WorkloadInterrupted
 from ..parallel.mesh import MeshConfig, build_mesh
 from ..parallel.sharding import ParamRules
 from ..utils.compat import install_compile_telemetry
+from ..utils.faults import global_faults
+from ..utils.goodput import GoodputLedger
 from ..utils.metrics import global_metrics
 from ..utils.profiler import PhaseProfiler
 
@@ -220,13 +224,18 @@ class Trainer:
         batch_specs: tuple | None = None,
         peak_flops: float | None = None,
         profiler: PhaseProfiler | None = None,
+        ledger: GoodputLedger | None = None,
     ):
         """``peak_flops``: MFU denominator override (None = detect from
         the device kind; 0.0 on unknown hardware keeps the gauge at 0).
         ``profiler``: the phase profiler the per-step split lands in
         (default: a fresh one over the global registry) — exported as
         ``train_phase_seconds{phase}`` / ``train_phase_share{phase}``
-        plus the rolling ``train_mfu`` gauge."""
+        plus the rolling ``train_mfu`` gauge.  ``ledger``: an optional
+        ``utils.goodput.GoodputLedger`` — when present, init/compile/
+        data-wait/step boundaries land in its wall-clock partition and
+        each step feeds a per-host heartbeat (straggler attribution);
+        None (the default) costs nothing."""
         self.model = model
         self.mesh = mesh or build_mesh(mesh_config)
         self.tc = train_config or TrainConfig()
@@ -236,6 +245,9 @@ class Trainer:
         self.profiler = (
             profiler if profiler is not None else PhaseProfiler(plane="train")
         )
+        self.ledger = ledger
+        self._host = f"host{jax.process_index()}"
+        self._steps_done = 0
         self._n_params: int | None = None
         self._step_ewma_s: float | None = None
         install_compile_telemetry()
@@ -254,8 +266,19 @@ class Trainer:
 
         self._loss_takes_mesh = "mesh" in inspect.signature(model.loss).parameters
 
+    def _seg(self, name: str):
+        """Ledger segment context, or a no-op when no ledger rides."""
+        return (
+            self.ledger.segment(name) if self.ledger is not None
+            else nullcontext()
+        )
+
     # -- setup -------------------------------------------------------------
     def init(self, key) -> None:
+        with self._seg("init"):
+            self._init(key)
+
+    def _init(self, key) -> None:
         _check_kv_tp(getattr(self.model, "cfg", None), self.mesh)
         axes = self.model.logical_axes()
         shardings = jax.tree.map(
@@ -383,6 +406,13 @@ class Trainer:
         ~60-100 ms of pure latency (measured at ~40% of the flagship
         step, tools/profile_step.py), which a loop that only logs every
         N steps never needs to pay."""
+        # Ledger boundary: the first call traces+compiles the step
+        # program inside jax.jit — that wall time is a `compile`
+        # segment; every later call is a productive `step` segment.
+        with self._seg("compile" if self._step is None else "step"):
+            return self._timed_step(*batch, sync=sync)
+
+    def _timed_step(self, *batch, sync: bool = True):
         if self._step is None:
             if self._use_1f1b():
                 if self.tc.grad_accum_steps > 1:
@@ -445,6 +475,12 @@ class Trainer:
             )
         self._update_mfu(dt, batch)
         self.profiler.export_shares()
+        self._steps_done += 1
+        if self.ledger is not None:
+            # Per-host step heartbeat — the straggler-attribution feed.
+            # Single-host runs report skew 1.0; a multi-host gang's
+            # slowest reporter becomes `train_straggler_host`.
+            self.ledger.heartbeat(self._host, self._steps_done, dt)
         return loss
 
     def _update_mfu(self, dt: float, batch: tuple) -> None:
@@ -496,6 +532,13 @@ class Trainer:
         EMA composes (the shadow updates inside the scan)."""
         if self._use_1f1b():
             raise ValueError("step_many supports the dense/gpipe step")
+        with self._seg(
+            "compile" if getattr(self, "_step_many", None) is None
+            else "step"
+        ):
+            return self._run_step_many(xs, ys)
+
+    def _run_step_many(self, xs, ys) -> float:
         if getattr(self, "_step_many", None) is None:
             step_fn = make_train_step(
                 self._loss, self.optimizer,
@@ -540,7 +583,22 @@ class Trainer:
         dispatched rather than once per step."""
         losses = []
         for i in range(steps):
-            batch = next(data_iter)
+            # Chaos seam (utils/faults.py): a seeded plan armed at
+            # `train.preempt` interrupts the loop exactly like a real
+            # slice preemption surfacing through ctx.heartbeat — the
+            # ledger opens a `preempted` segment (closed by the
+            # checkpoint restore on resume) and stamps the incident.
+            try:
+                global_faults.fire(
+                    "train.preempt", error_type=WorkloadInterrupted
+                )
+            except WorkloadInterrupted as e:
+                if self.ledger is not None:
+                    self.ledger.incident("preemption", detail=str(e))
+                    self.ledger.begin("preempted")
+                raise
+            with self._seg("data_wait"):
+                batch = next(data_iter)
             at_log = i % log_every == 0 or i == steps - 1
             loss = self.step(*batch, sync=at_log)
             losses.append(loss)

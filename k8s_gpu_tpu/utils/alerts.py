@@ -448,6 +448,12 @@ def default_rule_pack(
     compile_storm_rate: float = 0.1,
     compile_window: float = 60.0,
     compile_for_s: float = 30.0,
+    goodput_ratio: float = 0.5,
+    goodput_for_s: float = 30.0,
+    checkpoint_stall_s: float = 120.0,
+    checkpoint_for_s: float = 0.0,
+    straggler_skew: float = 1.5,
+    straggler_for_s: float = 30.0,
 ) -> list:
     """The platform's default recording + alerting rules.
 
@@ -469,6 +475,18 @@ def default_rule_pack(
     ``compile_window`` — steady-state serving compiles zero new
     executables, so a sustained rate above ``compile_storm_rate``
     means shapes are churning on live traffic).
+
+    Training-goodput trio (ISSUE 13, fed by ``utils/goodput.py`` and
+    ``train/checkpoint.py``): GoodputDegraded on the windowed
+    ``train_goodput_ratio`` below ``goodput_ratio`` (the gauge defaults
+    to 1.0 when no trainer is running, so the rule is inert on
+    serve-only registries), CheckpointStall on the per-op
+    ``train_checkpoint_seconds`` p95 above ``checkpoint_stall_s``
+    (saves are infrequent, so ``checkpoint_for_s`` defaults to 0 — one
+    breaching tick walks pending→firing), and StragglerDetected on
+    ``train_step_skew_ratio`` above ``straggler_skew`` (the slowest
+    host is named by ``train_straggler_host`` — `obs goodput` shows
+    it).
 
     ``tenant_slo``/``tenant_burn_threshold`` default to ``slo``/
     ``burn_threshold``.  Rules whose input families are absent (no
@@ -604,6 +622,38 @@ def default_rule_pack(
                 "XLA recompiling at {value:.2f}/s in steady state — "
                 "static-shape regression? (utils/compat.py compile "
                 "telemetry; obs profile shows the compile counters)"
+            ),
+        ),
+        AlertingRule(
+            # Windowed goodput (productive step-seconds over the
+            # ledger's rolling window), so the alert RESOLVES once a
+            # recovered run refills the window — a cumulative ratio
+            # would stay breached forever after one long outage.
+            "GoodputDegraded",
+            lambda ctx: ctx.gauge("train_goodput_ratio", default=1.0),
+            below=goodput_ratio, for_s=goodput_for_s,
+            annotation=(
+                "training goodput at {value:.0%} of wall-clock — "
+                "obs goodput shows where the time went"
+            ),
+        ),
+        AlertingRule(
+            "CheckpointStall",
+            lambda ctx: ctx.percentiles("train_checkpoint_seconds", 0.95),
+            above=checkpoint_stall_s, for_s=checkpoint_for_s,
+            annotation=(
+                "checkpoint {op} p95 at {value:.0f}s — the run stalls "
+                "this long every interval (train_checkpoint_seconds)"
+            ),
+        ),
+        AlertingRule(
+            "StragglerDetected",
+            lambda ctx: ctx.gauge("train_step_skew_ratio", default=1.0),
+            above=straggler_skew, for_s=straggler_for_s,
+            annotation=(
+                "slowest host runs steps {value:.1f}x the median — the "
+                "gang waits for it every step (train_straggler_host "
+                "names it)"
             ),
         ),
     ]

@@ -96,3 +96,33 @@ class FakeClock(Clock):
         # Short real-time poll; notify_all() wakes us earlier.  Fake time is
         # never advanced here.
         cond.wait(0.0005 if timeout is not None else 0.002)
+
+
+class TickingFakeClock(FakeClock):
+    """FakeClock whose ``now()`` auto-advances by a fixed dyadic tick.
+
+    A plain FakeClock reads the same instant until a test advances it,
+    which makes every instrumented duration zero — useless for code
+    whose OUTPUT is a duration partition (the goodput ledger).  This
+    variant moves time forward one ``tick`` per ``now()`` read, so a
+    scripted run accrues durations proportional to its clock-read
+    sequence while staying fully deterministic: two identical runs make
+    identical read sequences and therefore identical timelines.
+    ``advance``/``set_time`` still work for the big jumps (an outage, a
+    rule-evaluator hold window).
+
+    The default tick is 2**-9 s: dyadic, so every sum of ticks and
+    advances (use dyadic advances: 0.5, 10.0, ...) is float-exact AND
+    survives the snapshot layer's ``round(x, 9)`` unchanged — the
+    exhaustive-partition invariant (segments + residual == elapsed,
+    exactly) holds bit-for-bit.
+    """
+
+    def __init__(self, start: float = 0.0, tick: float = 0.001953125):
+        super().__init__(start)
+        self._tick = tick
+
+    def now(self) -> float:
+        with self._lock:
+            self._now += self._tick
+            return self._now

@@ -76,6 +76,15 @@ GAUGE_AGG: dict[str, str] = {
     "train_phase_share": "avg",
     "train_mfu": "avg",
     "collective_bytes_per_second": "max",
+    # Goodput plane (ISSUE 13): fleet goodput is the replica mean
+    # (each replica partitions its own wall clock); skew keeps the
+    # default-max shape explicitly (the fleet's worst straggler is the
+    # answer), and the straggler marker / checkpoint size follow it —
+    # "which host, how big" are hottest-member questions.
+    "train_goodput_ratio": "avg",
+    "train_step_skew_ratio": "max",
+    "train_straggler_host": "max",
+    "train_checkpoint_bytes": "max",
 }
 
 # Families the collector never writes aggregates for: the fleet

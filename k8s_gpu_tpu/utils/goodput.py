@@ -1,0 +1,576 @@
+"""Training goodput ledger & incident flight recorder — where a run's
+wall-clock went, and what interrupted it.
+
+The training plane so far exports instantaneous gauges
+(``train_last_step_seconds``, ``train_mfu``) — rates, not an account.
+ROADMAP item 4's claim ("training resumes within one step of a
+preemption") is unprovable without one: you need the run's *elapsed*
+time partitioned into productive steps vs everything else, and a
+timeline of the preemptions/evictions/restarts that carved it up.
+VirtualFlow frames elasticity as delivered-vs-ideal throughput across
+resource changes; this module is that measurement substrate:
+
+- **GoodputLedger** — a Clock-driven, exhaustive, NON-overlapping
+  partition of the run's wall-clock into named segments (``SEGMENTS``
+  below).  Exactly one segment is open at a time (``begin`` closes the
+  previous one at the same instant); time between an ``end`` and the
+  next ``begin`` is the *residual* — unattributed but never lost:
+  ``sum(segments) + residual == elapsed`` exactly, the same honest
+  remainder the phase profiler reports.  Productive time is the
+  ``step`` segment; ``train_goodput_ratio`` is productive share over a
+  rolling window (so the gauge recovers after an outage leaves the
+  window), and every non-productive segment close feeds
+  ``train_nonproductive_seconds_total{segment}``.
+- **incident timeline** — a bounded ring of
+  preemption/eviction/restart/resize events, each stamped with the
+  active trace id and the operator Event that caused it
+  (``record_incident`` is the operators' cross-stamp hook: the
+  TrainJob restart seam and the TpuPodSlice broken-queued-resource
+  seam call it next to their Warning Events).
+- **straggler attribution** — per-host step heartbeats; the slowest
+  host's EWMA over the median is ``train_step_skew_ratio`` and the
+  host itself is named by ``train_straggler_host{host}``.
+
+All time flows through an injected ``utils.clock.Clock`` (default
+``RealClock``); under ``FakeClock``/``TickingFakeClock`` two scripted
+runs serialize byte-identical ``/debug/goodput`` bodies — this module
+is in graftcheck's determinism planes, the same contract the profiler,
+alert FSM and federation collector keep.  The chaos path is
+``utils/faults.py``: ``Trainer.fit`` fires the ``train.preempt`` site
+each iteration, so a seeded plan preempts mid-fit deterministically
+and the ledger records the ``preempted`` segment + incident.
+
+Metric families (documented in ``docs/platform/observability.md``;
+graftcheck keeps doc and code in sync): ``train_goodput_ratio``,
+``train_nonproductive_seconds_total{segment}``,
+``train_incidents_total{kind}``, ``train_step_skew_ratio``,
+``train_straggler_host{host}``.  The checkpoint families
+(``train_checkpoint_seconds{op}``, ``train_checkpoint_bytes``,
+``train_checkpoint_failures_total{op}``) are minted by
+``train/checkpoint.py`` and assembled into the ``/debug/goodput`` body
+here.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from contextlib import contextmanager
+
+from .clock import Clock, RealClock
+from .metrics import MetricsRegistry, global_metrics, parse_exposition
+
+# The exhaustive segment taxonomy.  ``step`` is the only productive
+# segment — goodput is optimizer progress, and everything else (even
+# compile, even checkpoints) is overhead the ratio must charge for.
+SEGMENTS = (
+    "init", "compile", "data_wait", "step", "checkpoint_save",
+    "checkpoint_restore", "preempted", "reshard", "idle",
+)
+PRODUCTIVE = ("step",)
+
+# Incident kinds the flight recorder accepts — anything else raises, so
+# a typo'd kind can't silently mint a new counter series.
+INCIDENT_KINDS = (
+    "preemption", "eviction", "restart", "resize", "resume",
+)
+
+
+class _SegStat:
+    """Cumulative per-segment accounting (guarded by the ledger lock)."""
+
+    __slots__ = ("count", "total_s")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+
+
+class GoodputLedger:
+    """Clock-driven wall-clock partition + incident ring for one run.
+
+    ``window_s`` is the rolling window the ``train_goodput_ratio``
+    gauge is computed over — cumulative ratio never recovers from a
+    long outage, windowed ratio does once productive steps refill the
+    window.  ``max_incidents``/``max_samples`` bound the incident ring
+    and the windowed sample ring.
+
+    Threading: recording (``begin``/``end``/``heartbeat``/``incident``)
+    and reading (``snapshot`` on an HTTP thread) share the lock;
+    metric writes happen outside it (the registry has its own).
+    """
+
+    _GUARDED_BY = {
+        "_lock": ("_totals", "_open", "_window", "_win_prod",
+                  "_incidents", "_hosts", "_straggler"),
+    }
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        clock: Clock | None = None,
+        window_s: float = 300.0,
+        max_incidents: int = 256,
+        max_samples: int = 2048,
+        ewma_alpha: float = 0.3,
+    ):
+        self.registry = registry if registry is not None else global_metrics
+        self.clock = clock or RealClock()
+        self.window_s = max(1e-6, float(window_s))
+        self.alpha = min(1.0, max(1e-6, float(ewma_alpha)))
+        self._lock = threading.Lock()
+        self._t0 = self.clock.now()
+        self._totals: dict[str, _SegStat] = {}
+        self._open: tuple[str, float] | None = None  # (segment, start)
+        # Rolling (t_end, segment, dt) closed samples + incremental
+        # productive-seconds sum — the windowed-ratio math, profiler
+        # idiom (manual bound so every eviction subtracts its append).
+        self._max_samples = max(64, int(max_samples))
+        self._window: "deque[tuple]" = deque()
+        self._win_prod = 0.0
+        self._incidents: "deque[dict]" = deque(maxlen=max(8, max_incidents))
+        # host -> {"step", "t", "last_s", "ewma_s"}
+        self._hosts: dict[str, dict] = {}
+        self._straggler: str | None = None
+
+    # -- the segment partition ---------------------------------------------
+    def begin(self, segment: str) -> None:
+        """Open *segment*, closing the currently-open one (if any) at
+        the same instant — the partition never overlaps and never gaps
+        across a begin→begin chain."""
+        if segment not in SEGMENTS:
+            raise ValueError(
+                f"unknown goodput segment {segment!r}; one of {SEGMENTS}"
+            )
+        now = self.clock.now()
+        with self._lock:
+            closed = self._close_locked(now)
+            self._open = (segment, now)
+        self._export_closed(closed, now)
+
+    def end(self) -> None:
+        """Close the open segment.  Time until the next ``begin`` is
+        residual — reported, never silently attributed.  No-op when
+        nothing is open."""
+        now = self.clock.now()
+        with self._lock:
+            closed = self._close_locked(now)
+            self._open = None
+        self._export_closed(closed, now)
+
+    @contextmanager
+    def segment(self, name: str):
+        """``with ledger.segment("data_wait"): ...`` — the exception-
+        safe form.  Segments are FLAT, not nested: entering one while
+        another is open closes the outer one (the partition stays
+        non-overlapping by construction)."""
+        self.begin(name)
+        try:
+            yield
+        finally:
+            self.end()
+
+    def _close_locked(self, now: float):
+        """Fold the open segment into totals + window.  Lock held.
+        Returns ``(segment, dt)`` or None for the metric export the
+        caller performs outside the lock."""
+        if self._open is None:
+            return None
+        seg, start = self._open
+        dt = max(0.0, now - start)
+        st = self._totals.get(seg)
+        if st is None:
+            st = self._totals[seg] = _SegStat()
+        st.count += 1
+        st.total_s += dt
+        self._evict_locked(now - self.window_s)
+        while len(self._window) >= self._max_samples:
+            _, old_seg, old_dt = self._window.popleft()
+            if old_seg in PRODUCTIVE:
+                self._win_prod -= old_dt
+        self._window.append((now, seg, dt))
+        if seg in PRODUCTIVE:
+            self._win_prod += dt
+        return (seg, dt)
+
+    def _evict_locked(self, cut: float) -> None:
+        while self._window and self._window[0][0] < cut:
+            _, seg, dt = self._window.popleft()
+            if seg in PRODUCTIVE:
+                self._win_prod -= dt
+
+    def _export_closed(self, closed, now: float) -> None:
+        """Registry writes for one closed segment — outside the lock."""
+        if closed is None:
+            return
+        seg, dt = closed
+        if seg not in PRODUCTIVE and dt > 0.0:
+            self.registry.inc(
+                "train_nonproductive_seconds_total", dt, segment=seg
+            )
+        self.registry.set_gauge(
+            "train_goodput_ratio", self._windowed_ratio(now)
+        )
+
+    # -- goodput -----------------------------------------------------------
+    def _windowed_ratio(self, now: float) -> float:
+        """Productive share of the trailing window.  The open segment's
+        elapsed-so-far counts toward its kind, so a long outage drags
+        the ratio down WHILE it is happening, not only at close."""
+        with self._lock:
+            self._evict_locked(now - self.window_s)
+            prod = max(0.0, self._win_prod)
+            if self._open is not None and self._open[0] in PRODUCTIVE:
+                prod += max(0.0, now - self._open[1])
+        span = min(self.window_s, max(1e-9, now - self._t0))
+        return min(1.0, prod / span)
+
+    def goodput_ratio(self) -> float:
+        """The windowed ratio, read fresh (the gauge's value source)."""
+        return self._windowed_ratio(self.clock.now())
+
+    def export_gauges(self) -> None:
+        """Refresh ``train_goodput_ratio`` from the current instant —
+        register this as a ``RuleEvaluator`` collector so the gauge
+        decays DURING an outage (no segment closes while preempted,
+        so close-driven refresh alone would leave it stale)."""
+        self.registry.set_gauge(
+            "train_goodput_ratio", self._windowed_ratio(self.clock.now())
+        )
+
+    # -- incidents ---------------------------------------------------------
+    def incident(
+        self,
+        kind: str,
+        detail: str = "",
+        trace_id: str = "",
+        event: str = "",
+    ) -> None:
+        """Append one flight-recorder entry.  ``trace_id`` defaults to
+        the calling thread's active tracing span (the operator Event
+        handlers and the chaos seam run under one); ``event`` names the
+        operator Event that caused it (``"Warning/Restarting ns/job"``)."""
+        if kind not in INCIDENT_KINDS:
+            raise ValueError(
+                f"unknown incident kind {kind!r}; one of {INCIDENT_KINDS}"
+            )
+        if not trace_id:
+            from .tracing import global_tracer
+
+            ctx = global_tracer.current()
+            trace_id = ctx.trace_id if ctx is not None else ""
+        now = self.clock.now()
+        rec = {
+            "t": round(now, 9),
+            "kind": kind,
+            "detail": detail,
+            "trace_id": trace_id,
+            "event": event,
+        }
+        with self._lock:
+            self._incidents.append(rec)
+        self.registry.inc("train_incidents_total", kind=kind)
+
+    # -- straggler attribution ---------------------------------------------
+    def heartbeat(self, host: str, step: int, step_seconds: float) -> None:
+        """One host's per-step heartbeat.  With >= 2 reporting hosts the
+        slowest EWMA over the median EWMA is the skew ratio, and the
+        slowest host is published as ``train_straggler_host{host}``
+        (value: its EWMA step seconds).  In a gang-scheduled step every
+        host waits for the slowest — the skew ratio IS the wasted
+        fraction."""
+        now = self.clock.now()
+        dt = max(0.0, float(step_seconds))
+        with self._lock:
+            h = self._hosts.get(host)
+            if h is None:
+                h = self._hosts[host] = {
+                    "step": 0, "t": now, "last_s": 0.0, "ewma_s": 0.0,
+                }
+                h["ewma_s"] = dt
+            else:
+                h["ewma_s"] = self.alpha * dt + (1.0 - self.alpha) * h["ewma_s"]
+            h["step"] = int(step)
+            h["t"] = now
+            h["last_s"] = dt
+            skew, slowest, prev = self._skew_locked()
+            self._straggler = slowest
+        self.registry.set_gauge("train_step_skew_ratio", skew)
+        if prev is not None and prev != slowest:
+            self.registry.remove_gauge("train_straggler_host", host=prev)
+        if slowest is not None:
+            with self._lock:
+                val = self._hosts[slowest]["ewma_s"]
+            self.registry.set_gauge(
+                "train_straggler_host", val, host=slowest
+            )
+        self.registry.set_gauge(
+            "train_goodput_ratio", self._windowed_ratio(now)
+        )
+
+    def _skew_locked(self):
+        """``(skew_ratio, straggler_host | None, previous_straggler)``.
+        Lock held.  One host reports skew 1.0 and no straggler —
+        attribution needs a comparison set."""
+        prev = self._straggler
+        if len(self._hosts) < 2:
+            return 1.0, None, prev
+        ewmas = sorted(
+            (h["ewma_s"], name) for name, h in sorted(self._hosts.items())
+        )
+        slowest_s, slowest = ewmas[-1]
+        mid = ewmas[len(ewmas) // 2][0] if len(ewmas) % 2 else (
+            (ewmas[len(ewmas) // 2 - 1][0] + ewmas[len(ewmas) // 2][0]) / 2.0
+        )
+        skew = slowest_s / max(1e-9, mid)
+        return skew, slowest, prev
+
+    # -- read surface ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The ledger's half of the ``/debug/goodput`` body.  The open
+        segment's elapsed-so-far is folded into its segment entry, so
+        ``sum(seconds) + residual_s == elapsed_s`` EXACTLY — the
+        exhaustive-partition invariant tests pin bit-for-bit under
+        FakeClock.  All floats are ``round(x, 9)`` and every dict
+        iterates sorted, so two identically-scripted runs serialize
+        byte-identically (``json.dumps(..., sort_keys=True)``)."""
+        now = self.clock.now()
+        elapsed = max(0.0, now - self._t0)
+        with self._lock:
+            totals = {
+                seg: (st.count, st.total_s)
+                for seg, st in self._totals.items()
+            }
+            open_seg = self._open
+            incidents = list(self._incidents)
+            hosts = {
+                name: dict(h) for name, h in self._hosts.items()
+            }
+            skew, slowest, _ = self._skew_locked()
+        if open_seg is not None:
+            seg, start = open_seg
+            count, total = totals.get(seg, (0, 0.0))
+            totals[seg] = (count + 1, total + max(0.0, now - start))
+        attributed = sum(t for _, t in totals.values())
+        residual = max(0.0, elapsed - attributed)
+        productive = sum(
+            totals.get(seg, (0, 0.0))[1] for seg in PRODUCTIVE
+        )
+        segments = {}
+        for seg in sorted(totals):
+            count, total = totals[seg]
+            segments[seg] = {
+                "count": count,
+                "seconds": round(total, 9),
+                "share": round(total / elapsed, 9) if elapsed > 0 else 0.0,
+            }
+        return {
+            "now": round(now, 9),
+            "started": round(self._t0, 9),
+            "elapsed_s": round(elapsed, 9),
+            "window_s": self.window_s,
+            "segments": segments,
+            "open": open_seg[0] if open_seg is not None else None,
+            "residual_s": round(residual, 9),
+            "residual_share": (
+                round(residual / elapsed, 9) if elapsed > 0 else 0.0
+            ),
+            "productive_s": round(productive, 9),
+            "goodput_ratio": round(self._windowed_ratio(now), 9),
+            "goodput_ratio_total": (
+                round(productive / elapsed, 9) if elapsed > 0 else 0.0
+            ),
+            "hosts": {
+                name: {
+                    "step": h["step"],
+                    "last_s": round(h["last_s"], 9),
+                    "ewma_s": round(h["ewma_s"], 9),
+                    "age_s": round(max(0.0, now - h["t"]), 9),
+                }
+                for name, h in sorted(hosts.items())
+            },
+            "straggler": (
+                {"host": slowest, "skew_ratio": round(skew, 9)}
+                if slowest is not None else None
+            ),
+            "incidents": incidents,
+        }
+
+
+# -- operator cross-stamp hook ------------------------------------------------
+#
+# Operators (trainjob/tpupodslice reconcilers) run in the control plane
+# and must not grow a constructor dependency on the training plane's
+# ledger; instead the run that owns a ledger attaches it here and the
+# operators' incident seams call the module function.  No ledger
+# attached -> a no-op (the default outside training runs).
+
+_ATTACH_LOCK = threading.Lock()
+_LEDGERS: list[GoodputLedger] = []
+
+
+def attach_ledger(ledger: GoodputLedger) -> None:
+    with _ATTACH_LOCK:
+        if ledger not in _LEDGERS:
+            _LEDGERS.append(ledger)
+
+
+def detach_ledger(ledger: GoodputLedger | None = None) -> None:
+    """Detach one ledger, or every ledger when None (test teardown)."""
+    with _ATTACH_LOCK:
+        if ledger is None:
+            _LEDGERS.clear()
+        elif ledger in _LEDGERS:
+            _LEDGERS.remove(ledger)
+
+
+def record_incident(
+    kind: str, detail: str = "", trace_id: str = "", event: str = ""
+) -> None:
+    """Cross-stamp an operator-observed incident into every attached
+    ledger — called at the seams that also emit the Warning Event (the
+    TrainJob ``Restarting`` block, the TpuPodSlice broken-queued-
+    resource deletion), so the flight recorder and the Event stream
+    tell one story."""
+    with _ATTACH_LOCK:
+        sinks = list(_LEDGERS)
+    for ledger in sinks:
+        ledger.incident(kind, detail=detail, trace_id=trace_id, event=event)
+
+
+# -- the /debug/goodput body --------------------------------------------------
+
+def goodput_snapshot(
+    ledger: GoodputLedger | None = None,
+    registry: MetricsRegistry | None = None,
+) -> dict:
+    """The full ``/debug/goodput`` JSON body: the ledger's partition +
+    incident timeline plus the registry-resident checkpoint telemetry
+    (``train/checkpoint.py`` mints it).  Either half may be absent —
+    the shape stays stable."""
+    reg = registry if registry is not None else (
+        ledger.registry if ledger is not None else global_metrics
+    )
+    snap = (
+        ledger.snapshot() if ledger is not None
+        else {
+            "now": 0.0, "started": 0.0, "elapsed_s": 0.0, "window_s": 0.0,
+            "segments": {}, "open": None, "residual_s": 0.0,
+            "residual_share": 0.0, "productive_s": 0.0,
+            "goodput_ratio": None, "goodput_ratio_total": 0.0,
+            "hosts": {}, "straggler": None, "incidents": [],
+        }
+    )
+    ckpt: dict[str, dict] = {}
+    for lbls, q in sorted(
+        reg.hist_percentiles("train_checkpoint_seconds", 0.95).items()
+    ):
+        op = dict(lbls).get("op")
+        if op:
+            ckpt[op] = {"p95_s": round(q, 9)}
+    for lbls, v in sorted(
+        reg.series("train_checkpoint_failures_total").items()
+    ):
+        op = dict(lbls).get("op")
+        if op:
+            ckpt.setdefault(op, {})["failures"] = v
+    snap["checkpoint"] = {
+        "ops": ckpt,
+        "last_bytes": reg.gauge("train_checkpoint_bytes"),
+    }
+    return snap
+
+
+def goodput_snapshot_from_exposition(text: str) -> dict:
+    """Reconstruct a ``/debug/goodput``-shaped snapshot from one
+    Prometheus text exposition (live scrape or the persisted
+    ``metrics.prom``) — the ``obs goodput`` offline path.  Productive
+    seconds come from the ``train_step_seconds`` histogram sum,
+    non-productive from the per-segment counter, checkpoint
+    percentiles from the cumulative buckets
+    (``utils.federation.bucket_quantile``).  The incident RING does
+    not ride the exposition — only the per-kind counters do — so
+    ``incidents`` is empty and ``incident_counts`` carries what the
+    scrape knows."""
+    from .federation import bucket_quantile
+
+    fams = parse_exposition(text)
+    productive = sum(fams.get("train_step_seconds_sum", {}).values())
+    step_count = int(sum(fams.get("train_step_seconds_count", {}).values()))
+    totals: dict[str, float] = {}
+    for lbls, v in sorted(
+        fams.get("train_nonproductive_seconds_total", {}).items()
+    ):
+        seg = dict(lbls).get("segment")
+        if seg:
+            totals[seg] = totals.get(seg, 0.0) + v
+    if productive > 0.0:
+        totals["step"] = productive
+    elapsed = sum(totals.values())
+    segments = {
+        seg: {
+            "count": step_count if seg == "step" else 0,
+            "seconds": round(t, 9),
+            "share": round(t / elapsed, 9) if elapsed > 0 else 0.0,
+        }
+        for seg, t in sorted(totals.items())
+    }
+    ratio = None
+    series = fams.get("train_goodput_ratio", {})
+    if series:
+        ratio = next(iter(series.values()))
+    skew_series = fams.get("train_step_skew_ratio", {})
+    skew = next(iter(skew_series.values())) if skew_series else None
+    straggler = None
+    for lbls, v in sorted(fams.get("train_straggler_host", {}).items()):
+        host = dict(lbls).get("host")
+        if host:
+            straggler = {
+                "host": host,
+                "skew_ratio": skew if skew is not None else 0.0,
+            }
+    ckpt: dict[str, dict] = {}
+    for op in ("restore", "save"):
+        sub = {
+            l: v
+            for l, v in fams.get("train_checkpoint_seconds_bucket", {}).items()
+            if dict(l).get("op") == op
+        }
+        if sub:
+            ckpt[op] = {"p95_s": bucket_quantile(sub, 0.95) or 0.0}
+    for lbls, v in sorted(
+        fams.get("train_checkpoint_failures_total", {}).items()
+    ):
+        op = dict(lbls).get("op")
+        if op:
+            ckpt.setdefault(op, {})["failures"] = v
+    bytes_series = fams.get("train_checkpoint_bytes", {})
+    incident_counts = {
+        dict(lbls).get("kind", "?"): v
+        for lbls, v in sorted(fams.get("train_incidents_total", {}).items())
+    }
+    return {
+        "now": 0.0,
+        "started": 0.0,
+        "elapsed_s": round(elapsed, 9),
+        "window_s": 0.0,
+        "segments": segments,
+        "open": None,
+        "residual_s": 0.0,
+        "residual_share": 0.0,
+        "productive_s": round(productive, 9),
+        "goodput_ratio": ratio,
+        "goodput_ratio_total": (
+            round(productive / elapsed, 9) if elapsed > 0 else 0.0
+        ),
+        "hosts": {},
+        "straggler": straggler,
+        "incidents": [],
+        "incident_counts": incident_counts,
+        "checkpoint": {
+            "ops": ckpt,
+            "last_bytes": (
+                next(iter(bytes_series.values())) if bytes_series else None
+            ),
+        },
+    }

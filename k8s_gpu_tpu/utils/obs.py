@@ -41,7 +41,12 @@ class MetricsServer:
     ``utils.profiler.PhaseProfiler`` — ``/debug/profile`` serves the
     continuous performance-attribution snapshot (per-phase p50/p95/
     share, XLA compile telemetry, per-axis collective bandwidth —
-    ``obs profile`` renders it).  The handler instruments ITSELF through
+    ``obs profile`` renders it).  ``goodput`` is a
+    ``utils.goodput.GoodputLedger`` — ``/debug/goodput`` serves the
+    training wall-clock partition, straggler attribution, checkpoint
+    telemetry and incident timeline (``obs goodput`` renders it;
+    byte-identical across two scripted FakeClock runs).
+    The handler instruments ITSELF through
     ``RequestMetricsMixin`` (server label ``"obs"``), so scrape traffic
     shows up in ``http_requests_total`` like every other HTTP plane.
     """
@@ -57,6 +62,7 @@ class MetricsServer:
         fleet=None,
         journal=None,
         profile=None,
+        goodput=None,
     ):
         self.registry = registry or global_metrics
         self.tracer = tracer or global_tracer
@@ -64,6 +70,7 @@ class MetricsServer:
         self.fleet = fleet
         self.journal = journal
         self.profile = profile
+        self.goodput = goodput
         self.started_at = time.time()
         self._ready_check = ready_check
         outer = self
@@ -71,8 +78,9 @@ class MetricsServer:
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
             metrics_server_label = "obs"
             known_routes = (
-                "/debug/profile", "/debug/requests", "/debug/traces",
-                "/metrics", "/alerts", "/fleet", "/healthz", "/readyz",
+                "/debug/goodput", "/debug/profile", "/debug/requests",
+                "/debug/traces", "/metrics", "/alerts", "/fleet",
+                "/healthz", "/readyz",
             )
 
             def _get(self):
@@ -88,6 +96,8 @@ class MetricsServer:
                     self._requests()
                 elif path == "/debug/profile":
                     self._profile()
+                elif path == "/debug/goodput":
+                    self._goodput()
                 elif path == "/fleet":
                     self._fleet()
                 elif path == "/healthz":
@@ -179,6 +189,24 @@ class MetricsServer:
 
                 body = json.dumps(
                     profile_snapshot(outer.profile, outer.registry),
+                    sort_keys=True,
+                ).encode()
+                self._send(200, body, "application/json")
+
+            def _goodput(self):
+                if outer.goodput is None:
+                    return self._send(
+                        404,
+                        json.dumps(
+                            {"error": "no goodput ledger attached"}
+                        ).encode(),
+                        "application/json",
+                    )
+                from .goodput import goodput_snapshot
+
+                # sort_keys: the two-run byte-identical contract.
+                body = json.dumps(
+                    goodput_snapshot(outer.goodput, outer.registry),
                     sort_keys=True,
                 ).encode()
                 self._send(200, body, "application/json")
@@ -632,6 +660,91 @@ def render_profile(snap: dict) -> str:
         "deep dive (per-op device timing, HBM): utils.profiling.trace / "
         "profile_trainer -> jax.profiler xplane (TensorBoard/xprof)"
     )
+    return "\n".join(lines)
+
+
+def render_goodput(snap: dict) -> str:
+    """The ``obs goodput`` view of one ``/debug/goodput`` snapshot (or
+    its ``goodput_snapshot_from_exposition`` offline reconstruction):
+    the wall-clock segment partition with the residual, the windowed
+    goodput ratio, checkpoint telemetry, straggler attribution, and
+    the incident flight-recorder timeline."""
+    segments = snap.get("segments", {})
+    elapsed = snap.get("elapsed_s", 0.0)
+    ratio = snap.get("goodput_ratio")
+    lines = [
+        f"TRAINING GOODPUT  (elapsed {elapsed:.1f}s, productive "
+        f"{snap.get('productive_s', 0.0):.1f}s = "
+        f"{snap.get('goodput_ratio_total', 0.0):.1%} lifetime"
+        + (f", windowed {ratio:.1%}" if ratio is not None else "")
+        + ")",
+        "",
+        f"  {'SEGMENT':<20} {'SECONDS':>10} {'SHARE':>7} {'COUNT':>7}",
+    ]
+    if not segments:
+        lines.append("  (no segments recorded yet)")
+    for seg in sorted(
+        segments, key=lambda s: -segments[s].get("seconds", 0.0)
+    ):
+        st = segments[seg]
+        mark = " *" if snap.get("open") == seg else ""
+        lines.append(
+            f"  {seg + mark:<20} {st.get('seconds', 0.0):>10.3f} "
+            f"{st.get('share', 0.0):>7.1%} {st.get('count', 0):>7}"
+        )
+    res = snap.get("residual_s")
+    if res is not None and segments:
+        lines.append(
+            f"  {'(residual)':<20} {res:>10.3f} "
+            f"{snap.get('residual_share', 0.0):>7.1%} {'':>7}"
+        )
+    ck = snap.get("checkpoint") or {}
+    ops = ck.get("ops") or {}
+    if ops or ck.get("last_bytes") is not None:
+        parts = []
+        for op in sorted(ops):
+            d = ops[op]
+            cell = f"{op} p95 {d.get('p95_s', 0.0):.2f}s"
+            if d.get("failures"):
+                cell += f" ({d['failures']:.0f} failed)"
+            parts.append(cell)
+        if ck.get("last_bytes") is not None:
+            parts.append(f"last {ck['last_bytes'] / 1e6:.2f} MB")
+        lines.append("")
+        lines.append("checkpoints: " + ", ".join(parts))
+    strag = snap.get("straggler")
+    hosts = snap.get("hosts", {})
+    if strag is not None:
+        lines.append(
+            f"straggler: {strag['host']} at "
+            f"{strag.get('skew_ratio', 0.0):.2f}x the median step "
+            f"({len(hosts)} hosts reporting)"
+        )
+    elif hosts:
+        lines.append(f"straggler: none ({len(hosts)} host(s) reporting)")
+    incidents = snap.get("incidents", [])
+    counts = snap.get("incident_counts", {})
+    if incidents:
+        lines.append("")
+        lines.append("INCIDENTS  (oldest first)")
+        lines.append(
+            f"  {'T(S)':>9} {'KIND':<11} {'TRACE':<17} EVENT / DETAIL"
+        )
+        for inc in incidents:
+            what = " — ".join(
+                x for x in (inc.get("event"), inc.get("detail")) if x
+            )
+            lines.append(
+                f"  {inc.get('t', 0.0):>9.1f} {inc.get('kind', '?'):<11} "
+                f"{(inc.get('trace_id') or '-')[:16]:<17} {what}"
+            )
+    elif counts:
+        lines.append("")
+        lines.append(
+            "incidents (counters only — the timeline lives on "
+            "/debug/goodput): "
+            + ", ".join(f"{k}={v:.0f}" for k, v in sorted(counts.items()))
+        )
     return "\n".join(lines)
 
 

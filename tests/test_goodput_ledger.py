@@ -1,0 +1,397 @@
+"""Goodput ledger & incident flight recorder (ISSUE 13): the wall-clock
+partition is exhaustive and bit-exact under FakeClock, two scripted runs
+serve byte-identical /debug/goodput bodies, a seeded chaos preemption
+mid-fit walks GoodputDegraded through its full FSM with the incident
+cross-linked to a trace id, straggler attribution names the seeded slow
+host, and the checkpoint path mints its telemetry without perf_counter.
+All advances are dyadic (2**-k) so float sums stay exact."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.api.workload import WorkloadInterrupted
+from k8s_gpu_tpu.utils.alerts import RuleEvaluator, default_rule_pack
+from k8s_gpu_tpu.utils.clock import FakeClock, TickingFakeClock
+from k8s_gpu_tpu.utils.faults import FaultPlan, global_faults
+from k8s_gpu_tpu.utils.goodput import (
+    SEGMENTS,
+    GoodputLedger,
+    attach_ledger,
+    detach_ledger,
+    goodput_snapshot,
+    goodput_snapshot_from_exposition,
+    record_incident,
+)
+from k8s_gpu_tpu.utils.metrics import MetricsRegistry
+from k8s_gpu_tpu.utils.obs import MetricsServer, render_goodput
+from k8s_gpu_tpu.utils.tracing import global_tracer
+
+
+# -- the partition invariant -------------------------------------------------
+
+def test_partition_exhaustive_and_exact():
+    """sum(segments) + residual == elapsed EXACTLY — begins chain without
+    gaps, end→begin leaves a residual, and the open segment's elapsed-
+    so-far is folded into the snapshot."""
+    clk = FakeClock()
+    led = GoodputLedger(registry=MetricsRegistry(), clock=clk)
+    led.begin("init")
+    clk.advance(0.5)
+    led.begin("compile")          # closes init at the same instant
+    clk.advance(2.25)
+    led.begin("step")
+    clk.advance(0.125)
+    led.end()                     # residual gap starts here
+    clk.advance(0.0625)
+    led.begin("step")
+    clk.advance(0.25)             # left open: folded into snapshot
+    snap = led.snapshot()
+    total = sum(v["seconds"] for v in snap["segments"].values())
+    assert total + snap["residual_s"] == snap["elapsed_s"]
+    assert snap["elapsed_s"] == 3.1875
+    assert snap["residual_s"] == 0.0625
+    assert snap["open"] == "step"
+    assert snap["segments"]["step"] == {
+        "count": 2, "seconds": 0.375, "share": round(0.375 / 3.1875, 9),
+    }
+    assert snap["productive_s"] == 0.375
+
+
+def test_unknown_segment_and_incident_kind_raise():
+    led = GoodputLedger(registry=MetricsRegistry(), clock=FakeClock())
+    with pytest.raises(ValueError, match="unknown goodput segment"):
+        led.begin("lunch")
+    with pytest.raises(ValueError, match="unknown incident kind"):
+        led.incident("gremlins")
+    assert "step" in SEGMENTS
+
+
+def test_nonproductive_counter_feeds_per_segment():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    led = GoodputLedger(registry=reg, clock=clk)
+    led.begin("data_wait")
+    clk.advance(1.5)
+    led.begin("step")
+    clk.advance(4.0)
+    led.begin("checkpoint_save")
+    clk.advance(0.5)
+    led.end()
+    assert reg.counter(
+        "train_nonproductive_seconds_total", segment="data_wait"
+    ) == 1.5
+    assert reg.counter(
+        "train_nonproductive_seconds_total", segment="checkpoint_save"
+    ) == 0.5
+    # productive time never lands in the nonproductive family
+    assert not reg.counter(
+        "train_nonproductive_seconds_total", segment="step"
+    )
+    assert reg.gauge("train_goodput_ratio") == pytest.approx(4.0 / 6.0)
+
+
+# -- bit-identical /debug/goodput --------------------------------------------
+
+def _scripted(clock):
+    reg = MetricsRegistry()
+    led = GoodputLedger(registry=reg, clock=clock, window_s=64.0)
+    led.begin("init")
+    clock.advance(0.5)
+    led.begin("compile")
+    clock.advance(2.0)
+    led.begin("data_wait")
+    clock.advance(0.0625)
+    led.begin("step")
+    clock.advance(0.25)
+    led.end()
+    clock.advance(0.125)
+    led.incident(
+        "preemption", detail="queued resource suspended",
+        trace_id="feedfacefeedface", event="Warning/Restarting default/j",
+    )
+    led.begin("preempted")
+    clock.advance(4.0)
+    led.begin("checkpoint_restore")
+    clock.advance(1.0)
+    led.begin("step")
+    clock.advance(0.25)
+    led.end()
+    led.heartbeat("host0", 2, 0.25)
+    led.heartbeat("host1", 2, 0.5)
+    return led, reg
+
+
+def test_debug_goodput_endpoint_bit_identical_and_404():
+    bodies = []
+    for _ in range(2):
+        led, reg = _scripted(FakeClock())
+        srv = MetricsServer(registry=reg, goodput=led).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/goodput", timeout=5
+            ) as r:
+                bodies.append(r.read())
+        finally:
+            srv.stop()
+    assert bodies[0] == bodies[1]
+    snap = json.loads(bodies[0])
+    total = sum(v["seconds"] for v in snap["segments"].values())
+    assert total + snap["residual_s"] == snap["elapsed_s"]
+    assert snap["incidents"][0]["trace_id"] == "feedfacefeedface"
+    assert snap["straggler"]["host"] == "host1"
+    assert "checkpoint" in snap
+    # the renderer consumes the endpoint shape, identically each run
+    views = [render_goodput(json.loads(b)) for b in bodies]
+    assert views[0] == views[1]
+    assert "TRAINING GOODPUT" in views[0]
+    assert "preemption" in views[0]
+    srv = MetricsServer(registry=MetricsRegistry()).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/goodput", timeout=5
+            )
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_snapshot_from_exposition_reconstructs_offline_view():
+    """The `obs goodput` offline path: nonproductive counters + incident
+    counters survive the exposition round-trip; the ring itself does not
+    (only counts), and the renderer says so."""
+    led, reg = _scripted(FakeClock())
+    snap = goodput_snapshot_from_exposition(reg.render())
+    assert snap["segments"]["preempted"]["seconds"] == 4.0
+    assert snap["segments"]["compile"]["seconds"] == 2.0
+    assert snap["incident_counts"] == {"preemption": 1.0}
+    assert snap["incidents"] == []
+    assert snap["straggler"]["host"] == "host1"
+    out = render_goodput(snap)
+    assert "preemption" in out
+
+
+# -- the operator cross-stamp hook -------------------------------------------
+
+def test_record_incident_fans_out_to_attached_ledgers():
+    led = GoodputLedger(registry=MetricsRegistry(), clock=FakeClock())
+    try:
+        record_incident("restart", detail="before attach")   # no-op
+        attach_ledger(led)
+        attach_ledger(led)                                   # idempotent
+        record_incident(
+            "eviction", detail="queued resource qr0 state=SUSPENDED",
+            event="Warning/QueuedResourceDeleted default/pool",
+        )
+        incs = led.snapshot()["incidents"]
+        assert [i["kind"] for i in incs] == ["eviction"]
+        assert incs[0]["event"].startswith("Warning/QueuedResourceDeleted")
+    finally:
+        detach_ledger(None)
+    record_incident("restart", detail="after detach")        # no-op again
+    assert len(led.snapshot()["incidents"]) == 1
+
+
+# -- straggler attribution ----------------------------------------------------
+
+def test_straggler_attribution_names_seeded_slow_host():
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    led = GoodputLedger(registry=reg, clock=clk)
+    led.heartbeat("host0", 1, 0.1)
+    assert led.snapshot()["straggler"] is None      # needs a comparison set
+    assert reg.gauge("train_step_skew_ratio") == 1.0
+    for step in (2, 3, 4):
+        led.heartbeat("host0", step, 0.1)
+        led.heartbeat("host1", step, 0.5)
+        led.heartbeat("host2", step, 0.125)
+        clk.advance(0.5)
+    snap = led.snapshot()
+    assert snap["straggler"]["host"] == "host1"
+    assert snap["straggler"]["skew_ratio"] > 1.5
+    assert reg.gauge("train_step_skew_ratio") > 1.5
+    assert reg.gauge("train_straggler_host", host="host1") > 0.0
+    # the straggler heals: host1 speeds up, host0 degrades -> relabel
+    for step in (5, 6, 7, 8, 9, 10):
+        led.heartbeat("host0", step, 1.0)
+        led.heartbeat("host1", step, 0.1)
+        led.heartbeat("host2", step, 0.125)
+    snap = led.snapshot()
+    assert snap["straggler"]["host"] == "host0"
+    assert reg.gauge("train_straggler_host", host="host1") is None
+    assert reg.gauge("train_straggler_host", host="host0") > 0.0
+
+
+# -- checkpoint telemetry -----------------------------------------------------
+
+def _tiny_trainer(ledger=None):
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+    from k8s_gpu_tpu.parallel import MeshConfig
+    from k8s_gpu_tpu.parallel.mesh import build_mesh
+    from k8s_gpu_tpu.train import TrainConfig, Trainer
+
+    model = TransformerLM(TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=16,
+        d_ff=64, max_seq=16, use_flash=False))
+    return Trainer(
+        model, mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TrainConfig(warmup_steps=1),
+        peak_flops=1e12, ledger=ledger,
+    )
+
+
+def _batches(n=64):
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (4, 17), dtype=np.int32)
+    for _ in range(n):
+        yield (toks[:, :-1], toks[:, 1:])
+
+
+def test_checkpoint_save_restore_telemetry(tmp_path):
+    from k8s_gpu_tpu.train.checkpoint import attach_to_trainer
+
+    clk = TickingFakeClock()
+    reg = MetricsRegistry()
+    led = GoodputLedger(registry=reg, clock=clk)
+    trainer = _tiny_trainer(led)
+    trainer.init(jax.random.PRNGKey(0))
+    ckpt, save, resume = attach_to_trainer(
+        trainer, tmp_path / "ck", clock=clk, registry=reg
+    )
+    try:
+        save(1)
+        h = reg.histogram("train_checkpoint_seconds", op="save")
+        assert h is not None and h.n == 1
+        assert reg.gauge("train_checkpoint_bytes") > 0.0
+        step = resume()
+        assert step == 1
+        h = reg.histogram("train_checkpoint_seconds", op="restore")
+        assert h is not None and h.n == 1
+        # the trainer's ledger recorded both as segments
+        segs = led.snapshot()["segments"]
+        assert segs["checkpoint_save"]["count"] == 1
+        assert segs["checkpoint_save"]["seconds"] > 0.0
+        assert segs["checkpoint_restore"]["count"] == 1
+        # failure path: a raising save increments the counter and raises
+        ckpt._mgr = _RaisingMgr()
+        with pytest.raises(RuntimeError, match="disk full"):
+            save(2)
+        assert reg.counter(
+            "train_checkpoint_failures_total", op="save"
+        ) == 1.0
+        # the /debug/goodput body assembles the checkpoint half
+        snap = goodput_snapshot(led, reg)
+        assert snap["checkpoint"]["ops"]["save"]["p95_s"] > 0.0
+        assert snap["checkpoint"]["ops"]["save"]["failures"] == 1.0
+        assert snap["checkpoint"]["last_bytes"] > 0.0
+    finally:
+        ckpt.close()
+
+
+class _RaisingMgr:
+    def save(self, *a, **k):
+        raise RuntimeError("disk full")
+
+    def wait_until_finished(self):
+        pass
+
+    def close(self):
+        pass
+
+
+# -- seeded chaos: preemption mid-fit walks the full FSM ----------------------
+
+def test_preemption_chaos_goodput_fsm_and_recovery(tmp_path, xla_compiles):
+    """The acceptance scenario end-to-end: a seeded `train.preempt` fault
+    interrupts fit under a trace span; the ledger opens `preempted` and
+    stamps the incident with the trace id; GoodputDegraded walks
+    inactive→pending→firing; checkpoint restore + productive window
+    recovers the ratio and resolves it; the partition stays exact and
+    the resumed steps compile nothing new."""
+    from k8s_gpu_tpu.train.checkpoint import attach_to_trainer
+
+    clk = TickingFakeClock()
+    reg = MetricsRegistry()
+    led = GoodputLedger(registry=reg, clock=clk, window_s=8.0)
+    trainer = _tiny_trainer(led)
+    trainer.init(jax.random.PRNGKey(0))
+    data = _batches()
+    losses = trainer.fit(data, steps=2, log_every=1)
+    assert len(losses) == 2
+    snap = led.snapshot()
+    assert snap["segments"]["compile"]["count"] >= 1
+    assert snap["segments"]["step"]["count"] >= 1
+    assert snap["segments"]["data_wait"]["count"] == 2
+    compiles = xla_compiles()
+    ckpt, save, resume = attach_to_trainer(
+        trainer, tmp_path / "ck", clock=clk, registry=reg
+    )
+    try:
+        save(2)
+        # -- the incident: first fire of the armed site interrupts fit
+        global_faults.arm("train.preempt", FaultPlan(flaky=1))
+        try:
+            with global_tracer.span("train.run", job="chaos"):
+                with pytest.raises(WorkloadInterrupted):
+                    trainer.fit(data, steps=2, log_every=1)
+        finally:
+            global_faults.disarm()
+        snap = led.snapshot()
+        assert snap["open"] == "preempted"
+        inc = snap["incidents"][-1]
+        assert inc["kind"] == "preemption"
+        assert inc["trace_id"]                       # span cross-link
+        assert reg.counter(
+            "train_incidents_total", kind="preemption"
+        ) == 1.0
+        # -- the rule pack watches the decaying windowed ratio
+        rules = [
+            r for r in default_rule_pack(
+                goodput_ratio=0.5, goodput_for_s=30.0
+            )
+            if getattr(r, "name", "") == "GoodputDegraded"
+        ]
+        assert len(rules) == 1
+        ev = RuleEvaluator(rules, clock=clk, registry=reg, interval=10.0)
+        ev.collectors.append(led.export_gauges)
+        clk.advance(16.0)                            # outage in progress
+        ev.evaluate_once()
+        assert _states(ev) == {"GoodputDegraded": "pending"}
+        assert led.goodput_ratio() < 1.0
+        clk.advance(40.0)                            # held >= for_s
+        ev.evaluate_once()
+        assert _states(ev) == {"GoodputDegraded": "firing"}
+        # -- recovery: restore closes `preempted`, steps refill the window
+        step = resume()
+        assert step == 2
+        led.incident("resume", detail="restored step 2")
+        losses = trainer.fit(data, steps=2, log_every=1)
+        assert len(losses) == 2
+        led.begin("step")
+        clk.advance(6.0)                             # productive window
+        led.end()
+        ev.evaluate_once()
+        assert _states(ev) == {}                     # resolved -> inactive
+        assert [(t["from"], t["to"]) for t in ev.timeline] == [
+            ("inactive", "pending"), ("pending", "firing"),
+            ("firing", "resolved"),
+        ]
+        assert led.goodput_ratio() > 0.5
+        # -- the partition never leaked a second
+        snap = led.snapshot()
+        total = sum(v["seconds"] for v in snap["segments"].values())
+        assert total + snap["residual_s"] == snap["elapsed_s"]
+        assert snap["segments"]["preempted"]["seconds"] >= 56.0
+        # -- resumed steps reused the jitted step: zero new executables
+        assert xla_compiles() == compiles
+    finally:
+        ckpt.close()
+
+
+def _states(ev):
+    return {a["alertname"]: a["state"] for a in ev.active_alerts()}

@@ -75,6 +75,13 @@ def test_generate_errors(server):
     assert code == 400 and "too long" in out["error"]
 
 
+@pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="env: jaxlib CPU backend raises 'Multiprocess computations "
+    "aren't implemented on the CPU backend' — the dist-psum workload "
+    "launches real jax.distributed worker processes and needs a TPU/GPU "
+    "host",
+)
 def test_dist_psum_workload():
     from k8s_gpu_tpu.train.registry import get_workload
 

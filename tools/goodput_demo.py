@@ -1,0 +1,238 @@
+"""Training-goodput smoke (`make goodput-demo`) — ISSUE 13.
+
+Four acts, each asserting its invariant (non-zero exit on failure):
+
+1. **The wall-clock account** — a real (tiny) training run under a
+   `TickingFakeClock` ledger: init/compile/data-wait/step boundaries
+   land in the partition, a checkpoint save records its segment and
+   telemetry, `sum(segments) + residual == elapsed` holds exactly, and
+   `/debug/goodput` serves the same body over HTTP.
+2. **Seeded preemption → full FSM** — a chaos plan armed at
+   `train.preempt` interrupts `fit` under a trace span; the incident is
+   stamped with the trace id, the windowed ratio decays through the
+   outage, and `GoodputDegraded` walks pending→firing→resolved across
+   checkpoint restore + recovery.
+3. **Straggler attribution** — seeded per-host heartbeats name the slow
+   host (`train_straggler_host{host}`) and the skew gauge crosses the
+   `StragglerDetected` threshold.
+4. **Two-run determinism** — two identically-scripted runs serve
+   byte-identical `/debug/goodput` bodies (the graftcheck determinism-
+   plane contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from k8s_gpu_tpu.api.workload import WorkloadInterrupted  # noqa: E402
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM  # noqa: E402
+from k8s_gpu_tpu.parallel import MeshConfig  # noqa: E402
+from k8s_gpu_tpu.parallel.mesh import build_mesh  # noqa: E402
+from k8s_gpu_tpu.train import TrainConfig, Trainer  # noqa: E402
+from k8s_gpu_tpu.train.checkpoint import attach_to_trainer  # noqa: E402
+from k8s_gpu_tpu.utils.alerts import RuleEvaluator, default_rule_pack  # noqa: E402
+from k8s_gpu_tpu.utils.clock import FakeClock, TickingFakeClock  # noqa: E402
+from k8s_gpu_tpu.utils.faults import FaultPlan, global_faults  # noqa: E402
+from k8s_gpu_tpu.utils.goodput import (  # noqa: E402
+    GoodputLedger, goodput_snapshot,
+)
+from k8s_gpu_tpu.utils.metrics import MetricsRegistry  # noqa: E402
+from k8s_gpu_tpu.utils.obs import MetricsServer, render_goodput  # noqa: E402
+from k8s_gpu_tpu.utils.tracing import global_tracer  # noqa: E402
+
+
+def _trainer(ledger: GoodputLedger) -> Trainer:
+    model = TransformerLM(TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=16,
+        d_ff=64, max_seq=16, use_flash=False))
+    return Trainer(
+        model, mesh=build_mesh(MeshConfig(dp=1), n_devices=1),
+        train_config=TrainConfig(warmup_steps=1),
+        peak_flops=1e12, ledger=ledger,
+    )
+
+
+def _batches(n: int = 256):
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (4, 17), dtype=np.int32)
+    for _ in range(n):
+        yield (toks[:, :-1], toks[:, 1:])
+
+
+def act1_account():
+    print("=" * 64)
+    print("ACT 1 — the wall-clock account from a live training run")
+    print("=" * 64)
+    clk = TickingFakeClock()
+    reg = MetricsRegistry()
+    led = GoodputLedger(registry=reg, clock=clk, window_s=8.0)
+    trainer = _trainer(led)
+    trainer.init(jax.random.PRNGKey(0))
+    data = _batches()
+    trainer.fit(data, steps=4, log_every=2)
+    ckdir = os.path.join(tempfile.mkdtemp(prefix="goodput_demo_"), "ck")
+    ckpt, save, resume = attach_to_trainer(
+        trainer, ckdir, clock=clk, registry=reg
+    )
+    save(4)
+
+    snap = goodput_snapshot(led, reg)
+    print(render_goodput(snap))
+    total = sum(v["seconds"] for v in snap["segments"].values())
+    assert total + snap["residual_s"] == snap["elapsed_s"], (
+        total, snap["residual_s"], snap["elapsed_s"]
+    )
+    for seg in ("init", "compile", "data_wait", "step", "checkpoint_save"):
+        assert seg in snap["segments"], (seg, sorted(snap["segments"]))
+    assert snap["checkpoint"]["ops"]["save"]["p95_s"] > 0.0
+    assert snap["checkpoint"]["last_bytes"] > 0.0
+
+    srv = MetricsServer(registry=reg, goodput=led).start()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}/debug/goodput", timeout=5
+    ) as r:
+        body = json.loads(r.read())
+    srv.stop()
+    assert body["segments"].keys() == snap["segments"].keys()
+    print(f"\nOK: partition exact ({total:.3f}s attributed + "
+          f"{snap['residual_s']:.3f}s residual == {snap['elapsed_s']:.3f}s "
+          "elapsed), checkpoint telemetry minted, /debug/goodput serves it")
+    return clk, reg, led, trainer, data, ckpt, save, resume
+
+
+def act2_preemption(clk, reg, led, trainer, data, resume) -> None:
+    print()
+    print("=" * 64)
+    print("ACT 2 — seeded preemption: incident, decay, pending→firing→resolved")
+    print("=" * 64)
+    global_faults.arm("train.preempt", FaultPlan(flaky=1))
+    try:
+        with global_tracer.span("goodput-demo train", job="demo"):
+            try:
+                trainer.fit(data, steps=2, log_every=1)
+            except WorkloadInterrupted as e:
+                print(f"preempted as planned: {e}")
+    finally:
+        global_faults.disarm()
+    inc = led.snapshot()["incidents"][-1]
+    assert inc["kind"] == "preemption", inc
+    assert inc["trace_id"], "incident not cross-linked to the active span"
+    print(f"incident stamped: kind={inc['kind']} trace={inc['trace_id'][:16]}")
+
+    rules = [
+        r for r in default_rule_pack(goodput_ratio=0.5, goodput_for_s=30.0)
+        if getattr(r, "name", "") == "GoodputDegraded"
+    ]
+    ev = RuleEvaluator(rules, clock=clk, registry=reg)
+    ev.collectors.append(led.export_gauges)
+    states = []
+    clk.advance(16.0)
+    ev.evaluate_once()
+    states.append(_state(ev))
+    clk.advance(40.0)
+    ev.evaluate_once()
+    states.append(_state(ev))
+    resume()
+    led.incident("resume", detail="restored from checkpoint")
+    trainer.fit(data, steps=2, log_every=1)
+    led.begin("step")
+    clk.advance(6.0)
+    led.end()
+    ev.evaluate_once()
+    states.append(_state(ev))
+    timeline = [t["to"] for t in ev.timeline]
+    print(f"per-tick states: {states}")
+    print(f"transitions:     {timeline}")
+    assert states == ["pending", "firing", "-"], states
+    assert timeline == ["pending", "firing", "resolved"], timeline
+    ratio = led.goodput_ratio()
+    assert ratio > 0.5, ratio
+    print(f"OK: GoodputDegraded walked the full FSM; windowed ratio "
+          f"recovered to {ratio:.0%}")
+
+
+def _state(ev) -> str:
+    active = ev.active_alerts()
+    return active[0]["state"] if active else "-"
+
+
+def act3_straggler(led, reg) -> None:
+    print()
+    print("=" * 64)
+    print("ACT 3 — straggler attribution from per-host heartbeats")
+    print("=" * 64)
+    for step in range(1, 6):
+        led.heartbeat("host0", step, 0.1)
+        led.heartbeat("host1", step, 0.45)
+        led.heartbeat("host2", step, 0.12)
+    snap = led.snapshot()
+    s = snap["straggler"]
+    assert s is not None and s["host"] == "host1", s
+    assert reg.gauge("train_step_skew_ratio") > 1.5
+    assert reg.gauge("train_straggler_host", host="host1") > 0.0
+    print(f"OK: host1 named straggler at {s['skew_ratio']:.2f}x the median "
+          "(train_step_skew_ratio over the StragglerDetected threshold)")
+
+
+def act4_determinism() -> None:
+    print()
+    print("=" * 64)
+    print("ACT 4 — two scripted runs serve byte-identical /debug/goodput")
+    print("=" * 64)
+
+    def run() -> bytes:
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        led = GoodputLedger(registry=reg, clock=clk, window_s=64.0)
+        led.begin("init")
+        clk.advance(0.5)
+        led.begin("step")
+        clk.advance(2.0)
+        led.end()
+        led.incident("preemption", detail="scripted", trace_id="cafe" * 4)
+        led.begin("preempted")
+        clk.advance(4.0)
+        led.end()
+        led.heartbeat("host0", 1, 0.25)
+        led.heartbeat("host1", 1, 0.5)
+        srv = MetricsServer(registry=reg, goodput=led).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/goodput", timeout=5
+            ) as r:
+                return r.read()
+        finally:
+            srv.stop()
+
+    a, b = run(), run()
+    assert a == b, "two identically-scripted runs diverged"
+    print(f"OK: {len(a)} bytes, bit-identical across runs")
+
+
+def main() -> int:
+    clk, reg, led, trainer, data, ckpt, save, resume = act1_account()
+    try:
+        act2_preemption(clk, reg, led, trainer, data, resume)
+    finally:
+        ckpt.close()
+    act3_straggler(led, reg)
+    act4_determinism()
+    print()
+    print("goodput-demo: all invariants hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
