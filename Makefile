@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo
 
 # The default verify path (bare `make`): graftcheck invariants + the
 # attribution-plane smoke + the flash-v2 parity suite (ISSUE 12 — every
@@ -23,7 +23,7 @@ IMAGES = operator trainer devenv
 # train-step guard, all CPU-safe through the Pallas interpreter).  The
 # full suite stays `make test` (it takes minutes); image builds stay
 # `make docker-build`.
-verify: check profile-demo goodput-demo flash-v2-parity
+verify: check profile-demo goodput-demo canary-demo flash-v2-parity
 
 flash-v2-parity:
 	python -m pytest tests/test_flash_v2.py -q -p no:cacheprovider
@@ -128,6 +128,14 @@ profile-demo:
 # /debug/goodput bodies.
 goodput-demo:
 	python tools/goodput_demo.py
+
+# Black-box probing end to end on CPU (ISSUE 14): the chaos drill (a
+# 3-replica fleet, seeded faults + one corrupting replica — FSM walk,
+# ReplicaUnhealthy fire/resolve, router quarantine, budget spend stays
+# visible), the /healthz + /readyz contract over real HTTP, the canary
+# self-pollution guard, and two-run byte-identical /debug/probes.
+canary-demo:
+	python tools/canary_demo.py
 
 # Kernel A/Bs, end to end on CPU interpret mode: fused paged-attention
 # op-level kernel-vs-oracle parity (f32 + int8 KV + trash-block poison),

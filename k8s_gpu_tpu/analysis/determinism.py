@@ -40,6 +40,10 @@ from . import Finding, ScopeVisitor, rel, tree_for
 DETERMINISTIC_PLANES = (
     "k8s_gpu_tpu/serve/router.py",
     "k8s_gpu_tpu/serve/journal.py",
+    # The canary prober (ISSUE 14): the health FSM's two-run
+    # byte-identical /debug/probes contract — probe timing and FSM
+    # walks are pure functions of (targets' behavior, injected Clock).
+    "k8s_gpu_tpu/serve/canary.py",
     "k8s_gpu_tpu/utils/alerts.py",
     "k8s_gpu_tpu/utils/federation.py",
     "k8s_gpu_tpu/utils/metrics.py",

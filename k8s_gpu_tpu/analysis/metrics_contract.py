@@ -52,6 +52,9 @@ _MINT_ATTRS = {
 FLEET_PLANE = (
     "k8s_gpu_tpu/utils/federation.py",
     "k8s_gpu_tpu/serve/router.py",
+    # The canary prober probes replicas from outside (ISSUE 14): its
+    # probe_* families are per-replica by construction.
+    "k8s_gpu_tpu/serve/canary.py",
 )
 
 RESERVED_LABELS = ("name", "replica")
@@ -68,7 +71,7 @@ _DOC_PREFIXES = (
     "serve_", "fleet_", "pool_", "workqueue_", "train_", "trainjob_",
     "tracing_", "circuit_breaker_", "cloud_", "http_", "alerts_",
     "alert_", "faults_", "reconcile_", "metrics_", "tenant_",
-    "autoscale_", "inferenceservice_", "gc_",
+    "autoscale_", "inferenceservice_", "gc_", "probe_", "slo_",
 )
 _BACKTICK = re.compile(r"`([^`]+)`")
 

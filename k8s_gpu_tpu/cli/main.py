@@ -963,6 +963,8 @@ def cmd_obs(args) -> int:
             k: v for k, v in (
                 ("tenant", args.tenant), ("reason", args.reason),
                 ("trace_id", args.trace), ("limit", args.limit),
+                # probes=0 drops canary records (synthetic traffic).
+                ("probes", "0" if args.no_probes else ""),
             ) if v
         }
         body = _obs_fetch(args.url, f"/debug/requests?{urlencode(params)}")
@@ -1062,6 +1064,43 @@ def cmd_obs(args) -> int:
         if text is None:
             return 1
         print(render_goodput(goodput_snapshot_from_exposition(text)))
+        return 0
+    if args.obs_cmd == "probes":
+        # Black-box canary view: the /debug/probes snapshot — per-replica
+        # health FSM, K-of-N windows, failure tally, recent transitions.
+        from ..utils.obs import render_probes
+
+        if not args.url:
+            print("obs probes needs --url of a metrics server with a "
+                  "canary prober attached (/debug/probes)",
+                  file=sys.stderr)
+            return 2
+        body = _obs_fetch(args.url, "/debug/probes")
+        if body is None:
+            return 1
+        try:
+            snap = json.loads(body)
+            snap["replicas"]
+        except (ValueError, KeyError, TypeError) as e:
+            print(f"fetch failed: {e}", file=sys.stderr)
+            return 1
+        print(render_probes(snap))
+        return 0
+    if args.obs_cmd == "slo":
+        # The error-budget plane: per-objective budget remaining and
+        # fast/slow burn (the slo_* recording rules) plus per-replica
+        # probe health, read straight off /metrics — so it also works
+        # offline against a persisted exposition snapshot.
+        from ..utils.metrics import parse_exposition
+        from ..utils.obs import render_slo
+
+        text = (
+            _obs_fetch(args.url, "/metrics") if args.url
+            else _obs_snapshot()
+        )
+        if text is None:
+            return 1
+        print(render_slo(parse_exposition(text)))
         return 0
     if args.obs_cmd == "route":
         # Routing explain: which replica the prefix-affinity router
@@ -1601,6 +1640,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_oreq.add_argument("--trace", default="",
                         help="exact trace id filter")
     p_oreq.add_argument("--limit", type=int, default=30)
+    p_oreq.add_argument("--no-probes", action="store_true",
+                        help="drop synthetic canary-probe records "
+                             "(tenant _canary)")
     p_oprof = obs_sub.add_parser(
         "profile",
         help="continuous performance attribution: per-phase p50/p95/"
@@ -1630,6 +1672,24 @@ def build_parser() -> argparse.ArgumentParser:
                             "goodput ledger attached (/debug/goodput); "
                             "default: reconstruct from the persisted "
                             "metrics.prom")
+    p_oprb = obs_sub.add_parser(
+        "probes",
+        help="black-box canary probes: per-replica health FSM state, "
+             "K-of-N windows, failure tally by reason, recent "
+             "transitions (/debug/probes)",
+    )
+    p_oprb.add_argument("--url", default="",
+                        help="base URL of a metrics server with a "
+                             "canary prober attached (/debug/probes)")
+    p_oslo = obs_sub.add_parser(
+        "slo",
+        help="the error-budget plane: per-objective budget remaining "
+             "and fast/slow burn plus per-replica probe health, read "
+             "off /metrics",
+    )
+    p_oslo.add_argument("--url", default="",
+                        help="base URL of a metrics server; default: "
+                             "the persisted metrics.prom")
     p_orte = obs_sub.add_parser(
         "route",
         help="explain a routing decision: which replica the "

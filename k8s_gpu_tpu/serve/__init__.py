@@ -3,10 +3,11 @@ int8 weight-only quantization, LM HTTP server."""
 
 from .batcher import ContinuousBatcher, Overloaded, RequestHandle
 from .bundle import export_servable, load_servable
+from .canary import CanaryProber
 from .constrain import RegexConstraint, compile_constraint
 from .disagg import DisaggregatedLm
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
-from .journal import RequestJournal, RequestRecord
+from .journal import PROBE_TENANT, RequestJournal, RequestRecord
 from .jsonschema import SchemaError, schema_to_regex
 from .quant import quantize_params
 from .router import (
@@ -23,6 +24,7 @@ __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
     "ContinuousBatcher", "Overloaded", "RequestHandle",
     "RequestJournal", "RequestRecord",
+    "CanaryProber", "PROBE_TENANT",
     "FleetRouter", "RouteDecision", "FleetAutoscaler", "ScaleDecision",
     "router_rule_pack",
     "quantize_params", "export_servable", "load_servable",

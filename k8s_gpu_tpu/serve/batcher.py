@@ -59,7 +59,7 @@ from ..utils.tracing import global_tracer
 from .engine import (
     InferenceEngine, _empty_cache, _empty_cache_paged, nucleus_mask,
 )
-from .journal import RequestJournal, RequestRecord
+from .journal import PROBE_TENANT, RequestJournal, RequestRecord
 from .kv_blocks import BlockPool, chunk_hashes
 from .speculative import reject_row
 
@@ -726,6 +726,11 @@ class ContinuousBatcher:
         # scheduler-thread only, a handful of host ops per round.
         self._emit_total = 0
         self._tput_samples: collections.deque = collections.deque(maxlen=64)
+        # Readiness latch (serve/server.py /readyz): flips True at the
+        # first emitted token — prefill AND decode programs compiled and
+        # produced output.  Monotonic single-writer bool (scheduler
+        # thread sets, HTTP threads read); no lock needed.
+        self._warmed = False
         self._admit_jit = jax.jit(self._admit_dev, donate_argnums=(1,))
         # use_top_p is static: two compiled round variants, and the
         # common no-nucleus traffic never pays the full-vocab sort.
@@ -1772,6 +1777,24 @@ class ContinuousBatcher:
         return self._pending.qsize()
 
     @property
+    def scheduler_alive(self) -> bool:
+        """Liveness of the decode scheduler: started, not crashed, not
+        stopped — one of the three readiness legs /readyz gates on
+        (serve/server.py, docs/platform/serving.md 'The health
+        contract')."""
+        with self._lifecycle:
+            dead = self._dead
+        return not dead and self._thread.is_alive()
+
+    @property
+    def past_first_compile(self) -> bool:
+        """True once the engine has emitted a token — prefill and decode
+        programs compiled and producing output.  A fresh replica warms on
+        its first request; the canary's first probe does it for an idle
+        one (serve/canary.py)."""
+        return self._warmed
+
+    @property
     def spec_stats(self) -> dict:
         """Measured speculative acceptance over live rows: drafted /
         accepted counts and the rate (0.0 when spec is off or nothing
@@ -2419,6 +2442,7 @@ class ContinuousBatcher:
               lp: float = 0.0) -> None:
         req.emitted += 1
         self._emit_total += 1
+        self._warmed = True
         req.t_last = time.monotonic()
         if req.emitted == 1:
             req.t_first = req.t_last
@@ -2435,6 +2459,15 @@ class ContinuousBatcher:
         req = self._active[slot]
         if req is not None:
             req.out.put(None)  # completion sentinel
+            # Self-pollution guard (serve/canary.py): canary probes ride
+            # the reserved tenant and are excluded from every user-facing
+            # SLO series — the latency histograms (their outside-in view
+            # is probe_ttft_seconds, and synthetic traffic must not move
+            # the serve_ttft_p95 rule) and the goodput-vs-total tenant
+            # counters (a probe is not tenant work).  Completion/token
+            # throughput counters still count them: the scheduler really
+            # did that work, and bench's cb_canary_overhead_x reads it.
+            probe = req.tenant == PROBE_TENANT
             if not req.deadline_expired:
                 # An expired row is a shed, not a completion — it must
                 # not pollute the completion/latency series.
@@ -2449,13 +2482,13 @@ class ContinuousBatcher:
                 # Each lands twice: unlabeled (the all-tenant aggregate
                 # the bench and the default p95 rule read) and
                 # tenant-labeled (the per-tenant SLO view).
-                if req.emitted >= 1 and req.t_first > 0.0:
+                if req.emitted >= 1 and req.t_first > 0.0 and not probe:
                     ttft = req.t_first - req.t_submit
                     self.metrics.observe("serve_ttft_seconds", ttft)
                     self.metrics.observe(
                         "serve_ttft_seconds", ttft, tenant=req.tenant
                     )
-                if req.emitted >= 2 and req.t_first > 0.0:
+                if req.emitted >= 2 and req.t_first > 0.0 and not probe:
                     gap = (req.t_last - req.t_first) / (req.emitted - 1)
                     self.metrics.observe("serve_inter_token_seconds", gap)
                     self.metrics.observe(
@@ -2468,18 +2501,19 @@ class ContinuousBatcher:
             # A zero inc still mints the tenant's series, so a tenant
             # whose every request sheds is visible at rate 0 instead of
             # absent.
-            good = (
-                req.emitted
-                if not (req.deadline_expired or req.aborted) else 0
-            )
-            self.metrics.inc(
-                "serve_tenant_tokens_total", float(req.emitted),
-                tenant=req.tenant,
-            )
-            self.metrics.inc(
-                "serve_tenant_goodput_tokens_total", float(good),
-                tenant=req.tenant,
-            )
+            if not probe:
+                good = (
+                    req.emitted
+                    if not (req.deadline_expired or req.aborted) else 0
+                )
+                self.metrics.inc(
+                    "serve_tenant_tokens_total", float(req.emitted),
+                    tenant=req.tenant,
+                )
+                self.metrics.inc(
+                    "serve_tenant_goodput_tokens_total", float(good),
+                    tenant=req.tenant,
+                )
             self._journal(req, self._finish_reason(req))
         if self.paged and req is not None and req.blocks:
             # Point the slot at the trash block and release the blocks'
@@ -2553,6 +2587,11 @@ class ContinuousBatcher:
             deadline_expired=req.deadline_expired,
             t_submit=req.t_submit,
             t_done=time.monotonic(),
+            # Probe admission tagging: the `obs requests --no-probes`
+            # filter and the /debug/requests probes=0 query key on this.
+            extra=(
+                {"probe": True} if req.tenant == PROBE_TENANT else {}
+            ),
         ))
 
     def _shed_expired(self, req: _Request) -> None:

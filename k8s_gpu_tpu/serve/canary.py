@@ -1,0 +1,492 @@
+"""Black-box canary probing: synthetic golden requests against replicas.
+
+Every other health signal in the fleet is white-box and passive — a
+replica is "down" only after M failed metric scrapes
+(utils/federation.py) or a dispatch-time connection error
+(serve/router.py).  A replica that answers fast but returns garbage,
+hangs mid-stream, or sheds everything at the door looks perfectly
+healthy from the inside.  The ``CanaryProber`` measures the three
+things white-box metrics cannot:
+
+- **availability** — did the replica answer the probe within its
+  deadline (error / deadline / abort are hard failures);
+- **correctness** — greedy decode is deterministic, so the probe's
+  token stream is content-hashed against a *golden* recorded on first
+  healthy contact; any later drift is real breakage (wrong weights, KV
+  corruption, constraint regressions), never noise;
+- **outside-in latency** — probe TTFT/TPOT as a user would see them,
+  exported as ``probe_ttft_seconds``/``probe_tpot_seconds`` and
+  optionally classified ``slow`` against a per-probe TTFT bound (the
+  latency-SLO bad-event counter, not an FSM failure).
+
+Targets are pluggable with the same duality ``FleetCollector`` targets
+have: an in-process callable today (``ContinuousBatcher.submit`` or
+anything with its shape), an HTTP base URL tomorrow (``POST
+/generate`` on an ``LmServer``) — so ROADMAP item 1's cross-process
+front-end inherits the prober unchanged.
+
+Each replica carries a deterministic health FSM::
+
+    healthy --(1 hard failure)--> degraded
+    degraded --(>= fail_k failures in last window_n)--> unhealthy
+    degraded --(recover_k consecutive ok)--> healthy
+    unhealthy --(recover_k consecutive ok)--> healthy
+
+The walk is a pure function of the probe-outcome sequence — two
+scripted runs under ``FakeClock`` produce byte-identical
+``/debug/probes`` bodies.  Transitions drive ``FleetRouter``
+quarantine (``mark_unhealthy``: no NEW traffic, same effect as a
+drain; recovery re-admits) and the gauge
+``probe_replica_healthy{replica}`` (1.0 / 0.5 / 0.0) that the
+``CanaryFailing``/``ReplicaUnhealthy`` rules in the default pack
+evaluate.  Probe traffic rides tenant ``PROBE_TENANT`` so the serve
+plane can exclude it from user-facing SLO accounting
+(serve/batcher.py — the self-pollution guard).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import logging
+
+from ..utils.clock import Clock, RealClock
+from ..utils.metrics import MetricsRegistry, global_metrics
+from .journal import PROBE_TENANT
+
+log = logging.getLogger("k8s_gpu_tpu.canary")
+
+# FSM states, and the gauge value each exports.
+HEALTHY, DEGRADED, UNHEALTHY = "healthy", "degraded", "unhealthy"
+_STATE_GAUGE = {HEALTHY: 1.0, DEGRADED: 0.5, UNHEALTHY: 0.0}
+
+# probe_failures_total{reason=} vocabulary:
+#   error     the target raised (connection refused, queue full, crash)
+#   deadline  no complete answer inside the probe deadline
+#   aborted   the replica cut the stream (shutdown / scheduler death)
+#   corrupt   answered, but the content hash drifted from the golden
+#   slow      answered correctly but TTFT blew ttft_slo_s — a latency-
+#             SLO bad event, NOT an FSM failure (the replica works, it
+#             is just slow; quarantining it would shed capacity exactly
+#             when the fleet is saturated)
+FAILURE_REASONS = ("error", "deadline", "aborted", "corrupt", "slow")
+_HARD_FAILURES = ("error", "deadline", "aborted", "corrupt")
+
+# Bounded per-replica transition history in the snapshot.
+_MAX_TRANSITIONS = 16
+
+
+class _Replica:
+    """Per-replica probe state: the FSM, the K-of-N outcome window,
+    and the last probe's evidence.  All access under the prober lock."""
+
+    __slots__ = (
+        "target", "state", "window", "ok_streak", "probes", "failures",
+        "last", "transitions",
+    )
+
+    def __init__(self, target, window_n: int):
+        self.target = target
+        self.state = HEALTHY
+        self.window: list[bool] = []   # last window_n outcomes, oldest first
+        self.ok_streak = 0
+        self.probes = 0
+        self.failures: dict[str, int] = {}
+        self.last: dict = {}
+        self.transitions: list[dict] = []
+
+
+class CanaryProber:
+    """Clock-driven synthetic prober over a named replica set.
+
+    ``targets`` maps replica name → target, where a target is either a
+    callable with ``ContinuousBatcher.submit``'s shape (in-process) or
+    an HTTP base URL string (``POST {url}/generate``).  ``interval``
+    paces probe rounds; ``probe_once()`` runs one round explicitly
+    (tests, and the ``attach``-to-evaluator path).  ``router`` is an
+    optional ``serve.router.FleetRouter`` — transitions to unhealthy
+    quarantine the replica (``mark_unhealthy``), recovery re-admits.
+
+    ``ttft_slo_s > 0`` classifies an otherwise-good probe whose TTFT
+    exceeds it as ``slow`` — minted into ``probe_failures_total`` for
+    the latency SLO's budget math, but NOT an FSM failure.  ``golden``
+    pre-pins the correctness hash; empty records it from the first
+    clean probe fleet-wide (probe order is sorted replica names, so
+    keep a known-good replica first or pre-pin when bootstrapping
+    against a suspect fleet)."""
+
+    # Lock contract (graftcheck lockcheck + utils.faults
+    # guard_declared): probe rounds run on the prober thread (or an
+    # evaluator collector) while /debug/probes handlers snapshot.
+    _GUARDED_BY = {
+        "_lock": ("_replicas", "_golden", "_rounds", "_last_round"),
+    }
+
+    def __init__(
+        self,
+        targets: dict | None = None,
+        *,
+        clock: Clock | None = None,
+        metrics: MetricsRegistry | None = None,
+        router=None,
+        interval: float = 10.0,
+        deadline_s: float = 2.0,
+        prompt_ids=(3, 5, 7, 11, 13),
+        prompt_text: str = "canary golden probe",
+        max_new_tokens: int = 8,
+        window_n: int = 5,
+        fail_k: int = 3,
+        recover_k: int = 3,
+        ttft_slo_s: float = 0.0,
+        golden: str = "",
+        on_transition=None,
+    ):
+        self.clock = clock or RealClock()
+        self.metrics = metrics if metrics is not None else global_metrics
+        self.router = router
+        self.interval = float(interval)
+        self.deadline_s = float(deadline_s)
+        self.prompt_ids = tuple(int(i) for i in prompt_ids)
+        self.prompt_text = str(prompt_text)
+        self.max_new_tokens = max(1, int(max_new_tokens))
+        self.window_n = max(1, int(window_n))
+        self.fail_k = max(1, min(int(fail_k), self.window_n))
+        self.recover_k = max(1, int(recover_k))
+        self.ttft_slo_s = float(ttft_slo_s)
+        self.on_transition = on_transition
+        self._lock = threading.Lock()
+        self._replicas: dict[str, _Replica] = {}
+        self._golden = str(golden)
+        self._rounds = 0
+        self._last_round = float("-inf")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        for name, target in sorted((targets or {}).items()):
+            self.add_target(name, target)
+
+    # -- replica set -------------------------------------------------------
+    def add_target(self, name: str, target) -> None:
+        """Register a replica; callable or URL-string target.  A fresh
+        replica starts healthy (gauge 1.0) — innocent until probed."""
+        name = str(name)
+        with self._lock:
+            self._replicas[name] = _Replica(target, self.window_n)
+        self.metrics.set_gauge(
+            "probe_replica_healthy", 1.0, replica=name
+        )
+
+    def remove_target(self, name: str) -> None:
+        with self._lock:
+            self._replicas.pop(name, None)
+        self.metrics.remove_gauge("probe_replica_healthy", replica=name)
+        if self.router is not None:
+            self.router.mark_healthy(name)
+
+    def target_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # -- probing -----------------------------------------------------------
+    def probe_once(self) -> dict:
+        """One probe round over every replica in sorted-name order
+        (deterministic golden bootstrap and two-run identity).  Returns
+        {replica: outcome-reason-or-"ok"}."""
+        out: dict[str, str] = {}
+        for name in self.target_names():
+            with self._lock:
+                rep = self._replicas.get(name)
+                target = rep.target if rep is not None else None
+            if target is None:
+                continue
+            result = self._execute(target)
+            out[name] = self._settle(name, result)
+        with self._lock:
+            self._rounds += 1
+            self._last_round = self.clock.now()
+        return out
+
+    def _execute(self, target) -> dict:
+        """Run one probe against one target, outside the lock (a hung
+        replica must not stall the snapshot surface).  Returns
+        {"reason": "" | hard-failure, "ttft_s", "tpot_s", "hash",
+        "tokens"}."""
+        t0 = self.clock.now()
+        try:
+            if callable(target):
+                toks, ttft, expired, aborted = self._probe_callable(
+                    target, t0
+                )
+            else:
+                toks, ttft, expired, aborted = self._probe_http(
+                    str(target), t0
+                )
+        except Exception as e:          # noqa: BLE001 — any failure mode
+            return {
+                "reason": "error", "detail": type(e).__name__,
+                "ttft_s": 0.0, "tpot_s": 0.0, "hash": "", "tokens": 0,
+            }
+        t1 = self.clock.now()
+        tpot = (
+            (t1 - (t0 + ttft)) / (len(toks) - 1)
+            if len(toks) >= 2 and ttft >= 0.0 else 0.0
+        )
+        res = {
+            "reason": "", "detail": "",
+            "ttft_s": max(0.0, ttft), "tpot_s": max(0.0, tpot),
+            "hash": _content_hash(toks), "tokens": len(toks),
+        }
+        if expired or t1 - t0 > self.deadline_s:
+            res["reason"] = "deadline"
+        elif aborted:
+            res["reason"] = "aborted"
+        elif not toks:
+            res["reason"] = "error"
+            res["detail"] = "empty"
+        return res
+
+    def _probe_callable(self, submit, t0: float):
+        """In-process target: ``submit``'s shape is the batcher's —
+        greedy decode (temperature 0), tenant-tagged, deadline-bounded.
+        Under ``RealClock`` the clock domain IS ``time.monotonic``, so
+        the deadline lands in the batcher's native domain."""
+        handle = submit(
+            list(self.prompt_ids),
+            max_new_tokens=self.max_new_tokens,
+            temperature=0.0, top_p=0.0, seed=0,
+            tenant=PROBE_TENANT,
+            deadline=t0 + self.deadline_s,
+        )
+        toks, ttft = [], -1.0
+        for tok in handle:
+            if ttft < 0.0:
+                ttft = self.clock.now() - t0
+            toks.append(int(tok))
+        return (
+            toks, ttft,
+            bool(getattr(handle, "deadline_expired", False)),
+            bool(getattr(handle, "aborted", False)),
+        )
+
+    def _probe_http(self, url: str, t0: float):
+        """Over-the-wire target: the same probe through ``POST
+        /generate`` — what ROADMAP item 1's cross-process front-end
+        runs.  The deadline rides ``x-request-deadline-ms`` (server-
+        side shed) AND the socket timeout (client-side bound)."""
+        import urllib.request
+
+        req = urllib.request.Request(
+            url.rstrip("/") + "/generate",
+            data=json.dumps({
+                "prompt": self.prompt_text,
+                "max_new_tokens": self.max_new_tokens,
+                "temperature": 0.0,
+                "tenant": PROBE_TENANT,
+            }).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "x-request-deadline-ms": str(
+                    int(self.deadline_s * 1000)
+                ),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=self.deadline_s) as r:
+            body = json.loads(r.read().decode())
+        toks = [int(t) for t in body.get("ids", [])]
+        ttft = self.clock.now() - t0 if toks else -1.0
+        return toks, ttft, False, False
+
+    def _settle(self, name: str, res: dict) -> str:
+        """Classify one probe result, mint its metrics, and walk the
+        replica's FSM.  Returns the terminal reason ("ok" for a clean
+        probe)."""
+        reason = res["reason"]
+        if not reason and self._check_golden(res["hash"]) is False:
+            reason = "corrupt"
+        ok = reason == ""
+        if ok and self.ttft_slo_s > 0.0 and res["ttft_s"] > self.ttft_slo_s:
+            reason = "slow"      # latency bad event; FSM still ok
+        self.metrics.inc("probe_requests_total", replica=name)
+        if reason:
+            self.metrics.inc(
+                "probe_failures_total", replica=name, reason=reason
+            )
+        if res["tokens"] >= 1 and res["ttft_s"] >= 0.0:
+            self.metrics.observe(
+                "probe_ttft_seconds", res["ttft_s"], replica=name
+            )
+        if res["tokens"] >= 2:
+            self.metrics.observe(
+                "probe_tpot_seconds", res["tpot_s"], replica=name
+            )
+        transition = None
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:                 # removed mid-probe
+                return reason or "ok"
+            rep.probes += 1
+            if reason:
+                rep.failures[reason] = rep.failures.get(reason, 0) + 1
+            rep.last = {
+                "t": self.clock.now(), "ok": ok,
+                "reason": reason, "detail": res.get("detail", ""),
+                "ttft_s": round(res["ttft_s"], 6),
+                "tpot_s": round(res["tpot_s"], 6),
+                "tokens": res["tokens"], "hash": res["hash"],
+            }
+            rep.window.append(ok)
+            del rep.window[:-self.window_n]
+            rep.ok_streak = rep.ok_streak + 1 if ok else 0
+            nxt = self._next_state(rep, ok)
+            if nxt != rep.state:
+                transition = (rep.state, nxt)
+                rep.transitions.append({
+                    "t": self.clock.now(),
+                    "from": rep.state, "to": nxt,
+                })
+                del rep.transitions[:-_MAX_TRANSITIONS]
+                rep.state = nxt
+            state = rep.state
+        self.metrics.set_gauge(
+            "probe_replica_healthy", _STATE_GAUGE[state], replica=name
+        )
+        if transition is not None:
+            self._notify(name, *transition)
+        return reason or "ok"
+
+    def _next_state(self, rep: _Replica, ok: bool) -> str:
+        """The deterministic walk — a pure function of (state, window,
+        ok_streak).  Lock held by caller."""
+        if rep.state == HEALTHY:
+            return DEGRADED if not ok else HEALTHY
+        if rep.state == DEGRADED:
+            if rep.ok_streak >= self.recover_k:
+                return HEALTHY
+            fails = sum(1 for o in rep.window if not o)
+            if fails >= self.fail_k:
+                return UNHEALTHY
+            return DEGRADED
+        # UNHEALTHY: only a full recovery streak re-admits.
+        if rep.ok_streak >= self.recover_k:
+            return HEALTHY
+        return UNHEALTHY
+
+    def _check_golden(self, h: str):
+        """True = matches golden, False = drift, None = no golden yet
+        (this clean probe records it)."""
+        if not h:
+            return None
+        with self._lock:
+            if not self._golden:
+                self._golden = h
+                return True
+            return self._golden == h
+
+    def _notify(self, name: str, frm: str, to: str) -> None:
+        """Drive the router + user hook, outside the prober lock (the
+        router takes its own)."""
+        if self.router is not None:
+            try:
+                if to == UNHEALTHY:
+                    self.router.mark_unhealthy(name)
+                elif to == HEALTHY and frm == UNHEALTHY:
+                    self.router.mark_healthy(name)
+            except Exception:
+                log.exception("router health handoff failed for %s", name)
+        if self.on_transition is not None:
+            try:
+                self.on_transition(name, frm, to)
+            except Exception:
+                log.exception("probe transition hook failed for %s", name)
+
+    # -- introspection (the /debug/probes surface) -------------------------
+    def snapshot(self) -> dict:
+        """The ``/debug/probes`` JSON body — every value flows from the
+        injected clock or probe evidence, so two scripted ``FakeClock``
+        runs serialize byte-identically (``json.dumps(...,
+        sort_keys=True)`` on the server side)."""
+        with self._lock:
+            replicas = {
+                name: {
+                    "state": rep.state,
+                    "ok_streak": rep.ok_streak,
+                    "window": [int(o) for o in rep.window],
+                    "probes": rep.probes,
+                    "failures": dict(sorted(rep.failures.items())),
+                    "last": dict(rep.last),
+                    "transitions": list(rep.transitions),
+                }
+                for name, rep in sorted(self._replicas.items())
+            }
+            return {
+                "now": self.clock.now(),
+                "rounds": self._rounds,
+                "interval_s": self.interval,
+                "deadline_s": self.deadline_s,
+                "ttft_slo_s": self.ttft_slo_s,
+                "golden": self._golden,
+                "fsm": {
+                    "window_n": self.window_n,
+                    "fail_k": self.fail_k,
+                    "recover_k": self.recover_k,
+                },
+                "replicas": replicas,
+            }
+
+    def attach(self, evaluator) -> None:
+        """Register as a rule-evaluator collector (the federation
+        idiom): every evaluation tick probes first — interval-gated, so
+        a fast alert cadence doesn't turn into probe spam."""
+        def collect():
+            with self._lock:
+                due = (
+                    self.clock.now() - self._last_round >= self.interval
+                )
+            if due:
+                self.probe_once()
+
+        evaluator.collectors.append(collect)
+
+    # -- the probe loop ----------------------------------------------------
+    def start(self) -> "CanaryProber":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="canary-prober", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _loop(self) -> None:
+        cond = threading.Condition()
+        while not self._stop.is_set():
+            with self._lock:
+                due = (
+                    self.clock.now() - self._last_round >= self.interval
+                )
+            if due:
+                try:
+                    self.probe_once()
+                except Exception:
+                    log.exception("probe round failed")
+            with cond:
+                # Short waits: stop() stays responsive under RealClock
+                # and FakeClock's cheap poll keeps rounds aligned.
+                self.clock.wait(cond, 0.25)
+
+
+def _content_hash(tokens) -> str:
+    """The correctness fingerprint: a stable hash of the greedy token
+    stream.  Token IDS, not decoded text — tokenizer round-trips can
+    normalize away real drift."""
+    if not tokens:
+        return ""
+    raw = ",".join(str(int(t)) for t in tokens).encode()
+    return hashlib.sha256(raw).hexdigest()[:16]

@@ -23,6 +23,13 @@ from collections import deque
 from dataclasses import asdict, dataclass, field
 
 
+# The reserved synthetic tenant canary probes ride (serve/canary.py).
+# The leading underscore marks the whole "_"-prefix as reserved for
+# synthetic traffic: the batcher skips user-facing SLO accounting for
+# it and the tenant burn-rate rule skips reserved tenants wholesale.
+PROBE_TENANT = "_canary"
+
+
 # Terminal reasons a record can carry (the ``reason`` vocabulary):
 #   eos            the model emitted the stop token
 #   budget         max_new_tokens reached
@@ -101,10 +108,13 @@ class RequestJournal:
         tenant: str = "",
         reason: str = "",
         trace_id: str = "",
+        probes: bool = True,
     ) -> list[dict]:
         """Newest-first records as dicts, optionally filtered; the
         ``/debug/requests`` body.  ``limit <= 0`` returns none (the
-        bare ``[-0:]`` hazard the alerts snapshot also guards)."""
+        bare ``[-0:]`` hazard the alerts snapshot also guards).
+        ``probes=False`` drops canary records (``extra.probe`` — the
+        ``obs requests --no-probes`` filter)."""
         if limit <= 0:
             return []
         with self._lock:
@@ -116,6 +126,8 @@ class RequestJournal:
             if reason and rec.reason != reason:
                 continue
             if trace_id and rec.trace_id != trace_id:
+                continue
+            if not probes and rec.extra.get("probe"):
                 continue
             out.append(rec.to_dict())
             if len(out) >= limit:

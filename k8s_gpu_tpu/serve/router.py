@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import logging
 import math
 import threading
 from dataclasses import dataclass, field
@@ -58,13 +59,16 @@ from ..utils.clock import Clock, RealClock
 from ..utils.metrics import MetricsRegistry, global_metrics
 from .kv_blocks import chunk_hashes
 
+log = logging.getLogger("k8s_gpu_tpu.router")
+
 # Decision vocabulary (the serve_router_decisions_total{reason=} label
 # and the journal's route_reason):
 #   affinity  routed by chain hash — to the warm owner, or by rendezvous
 #             for a brand-new chain (the canonical cache home either way)
 #   load      no shareable full page: least-loaded placement
 #   fallback  the chain's warm owner was unusable (hot / draining /
-#             down): re-scored onto the best remaining replica
+#             down / canary-unhealthy): re-scored onto the best
+#             remaining replica
 ROUTE_REASONS = ("affinity", "load", "fallback")
 
 
@@ -93,8 +97,8 @@ class FleetRouter:
     # lock so a slow scrape can't stall routing.
     _GUARDED_BY = {
         "_lock": (
-            "_replicas", "_draining", "_down", "_hot", "_chains",
-            "_chain_counts",
+            "_replicas", "_draining", "_down", "_unhealthy", "_hot",
+            "_chains", "_chain_counts", "_drain_hooks",
         ),
         "_refresh_lock": ("_last_refresh",),
     }
@@ -141,7 +145,16 @@ class FleetRouter:
         self._replicas: dict[str, object] = {}   # name -> submit | None
         self._draining: set[str] = set()
         self._down: set[str] = set()
+        # Canary quarantine (serve/canary.py): replicas the prober
+        # walked to unhealthy.  Same eligibility effect as a drain — no
+        # NEW traffic, in-flight and warm chains untouched — but a
+        # separate set so recovery re-admits without touching
+        # drain/down bookkeeping.
+        self._unhealthy: set[str] = set()
         self._hot: set[str] = set()
+        # name -> callable invoked on drain(name) — the LmServer.drain
+        # hook that flips the replica's /readyz to 503.
+        self._drain_hooks: dict[str, object] = {}
         # chain hash -> owning replica, LRU order (oldest first).
         self._chains: "collections.OrderedDict[bytes, str]" = (
             collections.OrderedDict()
@@ -154,12 +167,18 @@ class FleetRouter:
         self._last_refresh = float("-inf")
 
     # -- replica set -------------------------------------------------------
-    def add_replica(self, name: str, submit=None) -> None:
+    def add_replica(self, name: str, submit=None, on_drain=None) -> None:
         """Register a replica; ``submit(ids, *, route=..., **kw)`` is
-        what ``dispatch`` calls (route-only use may pass None)."""
+        what ``dispatch`` calls (route-only use may pass None).
+        ``on_drain`` is invoked (no args) when ``drain(name)`` announces
+        a scale-down — wire ``LmServer.drain`` here so the replica's
+        /readyz flips to 503 the moment the router stops routing to it."""
         with self._lock:
             self._replicas[str(name)] = submit
             self._down.discard(str(name))
+            self._unhealthy.discard(str(name))
+            if on_drain is not None:
+                self._drain_hooks[str(name)] = on_drain
             self._chain_counts.setdefault(str(name), 0)
             self._export_gauges()
 
@@ -170,6 +189,8 @@ class FleetRouter:
             self._replicas.pop(name, None)
             self._draining.discard(name)
             self._down.discard(name)
+            self._unhealthy.discard(name)
+            self._drain_hooks.pop(name, None)
             self._hot.discard(name)
             for h in [h for h, r in self._chains.items() if r == name]:
                 del self._chains[h]
@@ -183,14 +204,23 @@ class FleetRouter:
         """Announce a scale-down: the replica stops receiving new
         requests and its hash range re-homes (warm entries reassign as
         they are touched).  Returns the warm-chain count it owned —
-        the work that will re-home."""
+        the work that will re-home.  The replica's ``on_drain`` hook
+        runs after the lock drops (it flips /readyz on the replica —
+        its own locks, its own HTTP surface)."""
         with self._lock:
             if name not in self._replicas:
                 return 0
             self._draining.add(name)
             self.metrics.inc("serve_router_drains_total")
             self._export_gauges()
-            return self._chain_counts.get(name, 0)
+            owned = self._chain_counts.get(name, 0)
+            hook = self._drain_hooks.get(name)
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                log.exception("drain hook failed for %s", name)
+        return owned
 
     def mark_down(self, name: str) -> None:
         """Exclude a replica observed failing (dispatch does this); its
@@ -203,6 +233,24 @@ class FleetRouter:
     def mark_up(self, name: str) -> None:
         with self._lock:
             self._down.discard(name)
+            self._export_gauges()
+
+    def mark_unhealthy(self, name: str) -> None:
+        """Quarantine on a canary verdict (serve/canary.py walked the
+        replica to unhealthy): no NEW traffic, exactly a drain's
+        eligibility effect — in-flight requests and warm chains are
+        untouched, so a recovered replica resumes with its cache
+        intact."""
+        with self._lock:
+            if name in self._replicas:
+                self._unhealthy.add(name)
+                self.metrics.inc("serve_router_quarantines_total")
+                self._export_gauges()
+
+    def mark_healthy(self, name: str) -> None:
+        """Re-admit after probe recovery (the FSM's recover_k streak)."""
+        with self._lock:
+            self._unhealthy.discard(name)
             self._export_gauges()
 
     def replica_names(self) -> list[str]:
@@ -230,7 +278,10 @@ class FleetRouter:
     def _eligible_locked(self) -> list[str]:
         out = []
         for name in sorted(self._replicas):
-            if name in self._draining or name in self._down:
+            if (
+                name in self._draining or name in self._down
+                or name in self._unhealthy
+            ):
                 continue
             if self.collector is not None:
                 up = self.collector.registry.gauge(
@@ -332,7 +383,8 @@ class FleetRouter:
                     "FleetRouter: no eligible replica "
                     f"({len(self._replicas)} registered, "
                     f"{len(self._draining)} draining, "
-                    f"{len(self._down)} down)"
+                    f"{len(self._down)} down, "
+                    f"{len(self._unhealthy)} unhealthy)"
                 )
             # Warm lookup: per replica, the DEEPEST chain prefix of this
             # prompt already owned by it.  ``warm_any`` remembers that
@@ -435,6 +487,10 @@ class FleetRouter:
             "serve_router_replicas_draining",
             float(len(self._draining)),
         )
+        self.metrics.set_gauge(
+            "serve_router_replicas_unhealthy",
+            float(len(self._unhealthy)),
+        )
 
     # -- dispatch ----------------------------------------------------------
     def dispatch(self, ids, **submit_kwargs):
@@ -507,6 +563,7 @@ class FleetRouter:
                         "hot": name in self._hot,
                         "draining": name in self._draining,
                         "down": name in self._down,
+                        "unhealthy": name in self._unhealthy,
                     }
                     for name in sorted(self._replicas)
                 ],

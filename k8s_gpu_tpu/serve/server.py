@@ -25,6 +25,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..data.tokenizer import BpeTokenizer
 from ..utils.obs import RequestMetricsMixin
 from .batcher import ContinuousBatcher, Overloaded
+from .journal import PROBE_TENANT
 from .journal import RequestRecord as JournalRecord
 
 # Advisory client backoff on 429/503: long enough to drain a round or
@@ -110,6 +111,11 @@ class LmServer:
         self.tokenizer = tokenizer
         self.started_at = time.time()
         self.cap = max_new_tokens_cap
+        # Drain latch (the health contract, docs/platform/serving.md):
+        # a draining replica keeps answering in-flight and direct work
+        # but reports NotReady so front-ends stop sending new traffic.
+        # Monotonic-ish single-flag state; benign bool race.
+        self._draining = False
         outer = self
 
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
@@ -119,10 +125,14 @@ class LmServer:
 
             def _get(self):
                 if self.path == "/healthz":
+                    # Liveness: the process answers.  Anything deeper
+                    # belongs in /readyz — a liveness probe that checks
+                    # readiness restarts pods for being busy.
                     self._json(200, {"ok": True,
                                      "uptime_s": time.time() - outer.started_at})
                 elif self.path == "/readyz":
-                    self._json(200, {"ready": True})
+                    r = outer.readiness()
+                    self._json(200 if r["ready"] else 503, r)
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -238,6 +248,10 @@ class LmServer:
                             deadline_expired=True,
                             t_submit=time.monotonic(),
                             t_done=time.monotonic(),
+                            extra=(
+                                {"probe": True}
+                                if tenant == PROBE_TENANT else {}
+                            ),
                         ))
                         return self._json(
                             504, {"error": "deadline exceeded"})
@@ -365,6 +379,33 @@ class LmServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="lm-server", daemon=True
         )
+
+    def readiness(self) -> dict:
+        """The /readyz verdict and its evidence — readiness is "can
+        serve a NEW request well", three legs ANDed: the batcher's
+        scheduler thread is alive (not crashed/stopped), the engine is
+        past its first compile (first request would otherwise eat
+        seconds of dead air), and the replica is not draining.  The
+        HTTP health contract ROADMAP item 1's front-end polls
+        (docs/platform/serving.md, 'The health contract')."""
+        alive = self.batcher.scheduler_alive
+        warmed = self.batcher.past_first_compile
+        draining = self._draining
+        return {
+            "ready": alive and warmed and not draining,
+            "scheduler_alive": alive,
+            "warmed": warmed,
+            "draining": draining,
+        }
+
+    def drain(self) -> None:
+        """Flip /readyz to 503 without stopping work: in-flight and
+        directly-addressed requests still serve.  FleetRouter.drain()
+        calls this through the replica's on_drain hook."""
+        self._draining = True
+
+    def undrain(self) -> None:
+        self._draining = False
 
     def start(self) -> "LmServer":
         self.batcher.start()

@@ -430,6 +430,136 @@ def _is_5xx(code: str) -> bool:
     return str(code).startswith("5")
 
 
+# -- the SLO objective layer (ISSUE 14) ---------------------------------------
+
+@dataclass
+class SloObjective:
+    """A declared service-level objective over two counter families:
+    ``bad`` events out of ``total`` events, against a ``target``
+    success ratio.  The budget math is the standard SRE shape —
+    error budget = 1 - target; burn rate = (bad_rate / total_rate) /
+    (1 - target); 1.0 burns the budget exactly at the sustainable
+    pace.
+
+    ``total_where``/``bad_where`` are label filters (exact strings or
+    predicates) applied when summing the families — so one counter
+    family can back several objectives (probe_failures_total splits
+    into availability's hard failures and the latency objective's
+    ``reason="slow"`` events)."""
+
+    name: str                    # the {slo=} label value
+    target: float                # e.g. 0.999 — the success-ratio goal
+    total: str                   # counter family counting all events
+    bad: str                     # counter family counting bad events
+    total_where: dict = field(default_factory=dict)
+    bad_where: dict = field(default_factory=dict)
+
+
+def default_slo_objectives() -> list[SloObjective]:
+    """The platform's declared objectives, both fed by the canary
+    prober (serve/canary.py): availability 99.9% (a probe answered in
+    deadline with the golden content — ``slow`` is not an availability
+    failure) and probe-TTFT 99% under the prober's ``ttft_slo_s``
+    bound (the prober classifies the breach per probe, so the budget
+    math here is pure counter arithmetic)."""
+    return [
+        SloObjective(
+            "probe-availability", 0.999,
+            total="probe_requests_total", bad="probe_failures_total",
+            bad_where={"reason": lambda r: r != "slow"},
+        ),
+        SloObjective(
+            "probe-ttft", 0.99,
+            total="probe_requests_total", bad="probe_failures_total",
+            bad_where={"reason": "slow"},
+        ),
+    ]
+
+
+def slo_rule_pack(
+    objectives: list[SloObjective] | None = None,
+    *,
+    fast_window: float = 300.0,
+    slow_window: float = 3600.0,
+    burn_threshold: float = 14.4,
+    for_s: float = 60.0,
+) -> list:
+    """Recording + alerting rules for a set of declared objectives.
+
+    Per objective (one ``{slo=}`` label-set each):
+
+    - ``slo_budget_remaining_ratio`` — the cumulative error budget
+      left, from the raw counters: ``1 - (bad/total)/(1-target)``,
+      clamped to [0, 1].  1.0 = untouched budget, 0.0 = fully spent.
+      Cumulative by design: the chaos drill's spent budget stays
+      visible after recovery (a windowed remaining-ratio would forgive
+      the incident as it scrolls out).
+    - ``slo_burn_rate_fast`` / ``slo_burn_rate_slow`` — windowed burn
+      over ``fast_window``/``slow_window``.
+    - ``SloBudgetBurn`` — the multi-window page: fires only when BOTH
+      windows burn above ``burn_threshold`` (the expression is
+      ``min(fast, slow)``), so a short blip (fast spikes, slow calm)
+      and a long-forgiven incident (slow raised, fast recovered) both
+      stay quiet — the standard multi-window multi-burn policy.
+
+    Objectives whose families are absent read total 0 → burn 0.0 and a
+    full budget; the pack is safe on any registry."""
+    objectives = (
+        list(objectives) if objectives is not None
+        else default_slo_objectives()
+    )
+
+    def _remaining(ctx: Ctx) -> dict:
+        out: dict[LabelSet, float] = {}
+        for o in objectives:
+            total = ctx.sum(o.total, **o.total_where)
+            bad = ctx.sum(o.bad, **o.bad_where)
+            spent = (
+                (bad / total) / max(1e-9, 1.0 - o.target)
+                if total > 0 else 0.0
+            )
+            out[(("slo", o.name),)] = max(0.0, min(1.0, 1.0 - spent))
+        return out
+
+    def _burn(window: float):
+        def expr(ctx: Ctx) -> dict:
+            out: dict[LabelSet, float] = {}
+            for o in objectives:
+                t = ctx.rate(o.total, window, **o.total_where)
+                b = ctx.rate(o.bad, window, **o.bad_where)
+                out[(("slo", o.name),)] = (
+                    (b / t) / max(1e-9, 1.0 - o.target) if t > 0 else 0.0
+                )
+            return out
+        return expr
+
+    def _multiwindow(ctx: Ctx) -> dict:
+        return {
+            lbls: min(
+                v,
+                ctx.gauge("slo_burn_rate_slow", default=0.0,
+                          **dict(lbls)),
+            )
+            for lbls, v in ctx.series("slo_burn_rate_fast").items()
+        }
+
+    return [
+        RecordingRule("slo_budget_remaining_ratio", _remaining),
+        RecordingRule("slo_burn_rate_fast", _burn(fast_window)),
+        RecordingRule("slo_burn_rate_slow", _burn(slow_window)),
+        AlertingRule(
+            "SloBudgetBurn",
+            _multiwindow,
+            above=burn_threshold, for_s=for_s, severity="page",
+            annotation=(
+                "SLO {slo} burning its error budget {value:.1f}x too "
+                "fast in BOTH burn windows (slo_budget_remaining_ratio "
+                "shows what is left)"
+            ),
+        ),
+    ]
+
+
 def default_rule_pack(
     *,
     slo: float = 0.99,
@@ -454,6 +584,13 @@ def default_rule_pack(
     checkpoint_for_s: float = 0.0,
     straggler_skew: float = 1.5,
     straggler_for_s: float = 30.0,
+    slo_objectives: list[SloObjective] | None = None,
+    slo_fast_window: float = 300.0,
+    slo_slow_window: float = 3600.0,
+    slo_burn_threshold: float = 14.4,
+    slo_for_s: float = 60.0,
+    canary_for_s: float = 30.0,
+    replica_unhealthy_for_s: float = 0.0,
 ) -> list:
     """The platform's default recording + alerting rules.
 
@@ -475,6 +612,16 @@ def default_rule_pack(
     ``compile_window`` — steady-state serving compiles zero new
     executables, so a sustained rate above ``compile_storm_rate``
     means shapes are churning on live traffic).
+
+    Canary trio (ISSUE 14, fed by ``serve/canary.py``'s prober):
+    CanaryFailing on ``probe_replica_healthy`` below 0.75 (the FSM's
+    degraded state exports 0.5 — first hard failure, early warning),
+    ReplicaUnhealthy below 0.25 (the FSM walked to unhealthy; the
+    prober has already quarantined the replica in the router, so the
+    page means "capacity lost", and ``replica_unhealthy_for_s``
+    defaults to 0 because the K-of-N window IS the hold), and the
+    ``slo_rule_pack`` appended last: per-objective budget gauges and
+    the multi-window SloBudgetBurn page.
 
     Training-goodput trio (ISSUE 13, fed by ``utils/goodput.py`` and
     ``train/checkpoint.py``): GoodputDegraded on the windowed
@@ -513,7 +660,12 @@ def default_rule_pack(
         # Seed the goodput watch alongside the total watch so both
         # families have rate history from the same tick onward.
         ctx.rate("serve_tenant_goodput_tokens_total", burn_window)
-        for t in sorted(t for t in tenants if t):
+        # "_"-prefixed tenants are reserved for synthetic traffic
+        # (journal.PROBE_TENANT): canary probes must not page their own
+        # tenant-SLO rule.  The batcher already keeps probes out of the
+        # serve_tenant_* families; this guard makes the exclusion hold
+        # even against a registry fed by an older replica.
+        for t in sorted(t for t in tenants if t and not t.startswith("_")):
             key = (("tenant", t),)
             total = ctx.rate(
                 "serve_tenant_tokens_total", burn_window, tenant=t
@@ -655,5 +807,35 @@ def default_rule_pack(
                 "gang waits for it every step (train_straggler_host "
                 "names it)"
             ),
+        ),
+        AlertingRule(
+            # The prober exports healthy=1.0 / degraded=0.5 /
+            # unhealthy=0.0, so one threshold per FSM state boundary:
+            # below 0.75 catches degraded-or-worse (early warning),
+            # below 0.25 catches the quarantine itself.
+            "CanaryFailing",
+            lambda ctx: ctx.series("probe_replica_healthy"),
+            below=0.75, for_s=canary_for_s,
+            annotation=(
+                "canary probes failing on replica {replica} — "
+                "probe_failures_total says why (obs probes)"
+            ),
+        ),
+        AlertingRule(
+            "ReplicaUnhealthy",
+            lambda ctx: ctx.series("probe_replica_healthy"),
+            below=0.25, for_s=replica_unhealthy_for_s, severity="page",
+            annotation=(
+                "replica {replica} failed the canary FSM and is "
+                "quarantined — the router sends it no new traffic "
+                "until probes recover"
+            ),
+        ),
+        *slo_rule_pack(
+            slo_objectives,
+            fast_window=slo_fast_window,
+            slow_window=slo_slow_window,
+            burn_threshold=slo_burn_threshold,
+            for_s=slo_for_s,
         ),
     ]

@@ -85,6 +85,14 @@ GAUGE_AGG: dict[str, str] = {
     "train_step_skew_ratio": "max",
     "train_straggler_host": "max",
     "train_checkpoint_bytes": "max",
+    # Canary/SLO plane (ISSUE 14): fleet health is its SICKEST member
+    # (min over the 1.0/0.5/0.0 state gauge — one unhealthy replica
+    # makes the fleet row say so), the remaining error budget is the
+    # tightest objective's, and burn is hottest-member.
+    "probe_replica_healthy": "min",
+    "slo_budget_remaining_ratio": "min",
+    "slo_burn_rate_fast": "max",
+    "slo_burn_rate_slow": "max",
 }
 
 # Families the collector never writes aggregates for: the fleet

@@ -45,7 +45,11 @@ class MetricsServer:
     ``utils.goodput.GoodputLedger`` — ``/debug/goodput`` serves the
     training wall-clock partition, straggler attribution, checkpoint
     telemetry and incident timeline (``obs goodput`` renders it;
-    byte-identical across two scripted FakeClock runs).
+    byte-identical across two scripted FakeClock runs).  ``probes`` is
+    a ``serve.canary.CanaryProber`` — ``/debug/probes`` serves its
+    per-replica health-FSM snapshot (``obs probes`` renders it; same
+    byte-identical contract).  ``/debug/requests`` additionally takes
+    ``probes=0`` to drop canary records (``obs requests --no-probes``).
     The handler instruments ITSELF through
     ``RequestMetricsMixin`` (server label ``"obs"``), so scrape traffic
     shows up in ``http_requests_total`` like every other HTTP plane.
@@ -63,6 +67,7 @@ class MetricsServer:
         journal=None,
         profile=None,
         goodput=None,
+        probes=None,
     ):
         self.registry = registry or global_metrics
         self.tracer = tracer or global_tracer
@@ -71,6 +76,7 @@ class MetricsServer:
         self.journal = journal
         self.profile = profile
         self.goodput = goodput
+        self.probes = probes
         self.started_at = time.time()
         self._ready_check = ready_check
         outer = self
@@ -78,9 +84,9 @@ class MetricsServer:
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
             metrics_server_label = "obs"
             known_routes = (
-                "/debug/goodput", "/debug/profile", "/debug/requests",
-                "/debug/traces", "/metrics", "/alerts", "/fleet",
-                "/healthz", "/readyz",
+                "/debug/goodput", "/debug/probes", "/debug/profile",
+                "/debug/requests", "/debug/traces", "/metrics",
+                "/alerts", "/fleet", "/healthz", "/readyz",
             )
 
             def _get(self):
@@ -98,6 +104,8 @@ class MetricsServer:
                     self._profile()
                 elif path == "/debug/goodput":
                     self._goodput()
+                elif path == "/debug/probes":
+                    self._probes()
                 elif path == "/fleet":
                     self._fleet()
                 elif path == "/healthz":
@@ -211,6 +219,21 @@ class MetricsServer:
                 ).encode()
                 self._send(200, body, "application/json")
 
+            def _probes(self):
+                if outer.probes is None:
+                    return self._send(
+                        404,
+                        json.dumps(
+                            {"error": "no canary prober attached"}
+                        ).encode(),
+                        "application/json",
+                    )
+                # sort_keys: the two-run byte-identical contract.
+                body = json.dumps(
+                    outer.probes.snapshot(), sort_keys=True
+                ).encode()
+                self._send(200, body, "application/json")
+
             def _requests(self):
                 if outer.journal is None:
                     return self._send(
@@ -234,6 +257,7 @@ class MetricsServer:
                     tenant=one("tenant"),
                     reason=one("reason"),
                     trace_id=one("trace_id"),
+                    probes=one("probes", "1") != "0",
                 )
                 self._send(
                     200,
@@ -745,6 +769,106 @@ def render_goodput(snap: dict) -> str:
             "/debug/goodput): "
             + ", ".join(f"{k}={v:.0f}" for k, v in sorted(counts.items()))
         )
+    return "\n".join(lines)
+
+
+def render_probes(snap: dict) -> str:
+    """The ``obs probes`` view of one ``/debug/probes`` snapshot: the
+    fleet-wide probe config line, one row per replica (FSM state, the
+    K-of-N window drawn as ``.``/``x``, failure tally by reason, last
+    outside-in latencies), then recent FSM transitions."""
+    fsm = snap.get("fsm", {})
+    golden = snap.get("golden") or "(unset)"
+    lines = [
+        f"CANARY PROBES  (round {snap.get('rounds', 0)}, every "
+        f"{snap.get('interval_s', 0):g}s, deadline "
+        f"{snap.get('deadline_s', 0):g}s, golden {golden}, fsm "
+        f"{fsm.get('fail_k', '?')}-of-{fsm.get('window_n', '?')} fail / "
+        f"{fsm.get('recover_k', '?')} recover)",
+        "",
+        f"  {'REPLICA':<18} {'STATE':<10} {'WINDOW':<8} {'PROBES':>7} "
+        f"{'FAILURES':<22} {'TTFT(MS)':>9} {'TPOT(MS)':>9}  LAST",
+    ]
+    replicas = snap.get("replicas", {})
+    if not replicas:
+        lines.append("  (no probe targets registered)")
+    for name, rep in replicas.items():
+        window = "".join(
+            "." if o else "x" for o in rep.get("window", [])
+        ) or "-"
+        fails = rep.get("failures", {})
+        failcell = (
+            ",".join(f"{k}={v}" for k, v in fails.items()) if fails
+            else "-"
+        )
+        last = rep.get("last", {})
+        lastcell = (
+            ("ok" if last.get("ok") else last.get("reason") or "?")
+            if last else "-"
+        )
+        lines.append(
+            f"  {name:<18} {rep.get('state', '?'):<10} {window:<8} "
+            f"{rep.get('probes', 0):>7} {failcell:<22} "
+            f"{last.get('ttft_s', 0.0) * 1000:>9.1f} "
+            f"{last.get('tpot_s', 0.0) * 1000:>9.1f}  {lastcell}"
+        )
+    transitions = [
+        {**t, "replica": name}
+        for name, rep in replicas.items()
+        for t in rep.get("transitions", [])
+    ]
+    if transitions:
+        transitions.sort(key=lambda t: (t.get("t", 0.0), t["replica"]))
+        lines.append("")
+        lines.append("TRANSITIONS  (oldest first)")
+        for t in transitions:
+            lines.append(
+                f"  {t.get('t', 0.0):>9.1f} {t['replica']:<18} "
+                f"{t.get('from', '?')} -> {t.get('to', '?')}"
+            )
+    return "\n".join(lines)
+
+
+def render_slo(families: dict) -> str:
+    """The ``obs slo`` view over parsed ``/metrics`` families
+    (``parse_exposition`` shape: ``{name: {label_tuple: value}}``):
+    per-objective budget remaining + fast/slow burn, and the
+    per-replica probe-health gauge underneath — the error-budget
+    plane at a glance."""
+    remaining = families.get("slo_budget_remaining_ratio", {})
+    fast = families.get("slo_burn_rate_fast", {})
+    slow = families.get("slo_burn_rate_slow", {})
+    lines = ["SLO ERROR BUDGETS", ""]
+    if not remaining:
+        lines.append(
+            "  (no slo_budget_remaining_ratio series — is the rules "
+            "engine ticking with the slo pack?)"
+        )
+    else:
+        lines.append(
+            f"  {'SLO':<22} {'BUDGET LEFT':>12} {'FAST BURN':>10} "
+            f"{'SLOW BURN':>10}"
+        )
+        for lbls in sorted(remaining):
+            slo = dict(lbls).get("slo", "?")
+            f_burn = fast.get(lbls)
+            s_burn = slow.get(lbls)
+            lines.append(
+                f"  {slo:<22} {remaining[lbls]:>12.2%} "
+                f"{(f'{f_burn:.2f}x' if f_burn is not None else '-'):>10} "
+                f"{(f'{s_burn:.2f}x' if s_burn is not None else '-'):>10}"
+            )
+    health = families.get("probe_replica_healthy", {})
+    if health:
+        lines.append("")
+        lines.append(f"  {'REPLICA':<22} {'PROBE HEALTH':>12}")
+        state = {1.0: "healthy", 0.5: "degraded", 0.0: "UNHEALTHY"}
+        for lbls in sorted(health):
+            v = health[lbls]
+            lines.append(
+                f"  {dict(lbls).get('replica', '?'):<22} "
+                f"{state.get(v, f'{v:g}'):>12}"
+            )
     return "\n".join(lines)
 
 

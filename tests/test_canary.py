@@ -1,0 +1,670 @@
+"""Black-box canary probing, the replica health FSM, and the SLO
+error-budget plane (ISSUE 14).  Scripted-target tests run under
+FakeClock (two-run byte-identity); the integration tests drive real
+tiny batchers.  Named test_canary so it sorts early inside the tier-1
+870 s window."""
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s_gpu_tpu.serve.canary import (
+    DEGRADED,
+    HEALTHY,
+    UNHEALTHY,
+    CanaryProber,
+)
+from k8s_gpu_tpu.serve.journal import PROBE_TENANT
+from k8s_gpu_tpu.utils.alerts import (
+    RuleEvaluator,
+    SloObjective,
+    default_rule_pack,
+    slo_rule_pack,
+)
+from k8s_gpu_tpu.utils.clock import FakeClock
+from k8s_gpu_tpu.utils.metrics import MetricsRegistry
+
+TINY_KW = dict(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8,
+    d_ff=64, max_seq=48, use_flash=False,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(dtype=jnp.float32, **TINY_KW)
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+# -- scripted probe targets ----------------------------------------------------
+
+class _Handle:
+    """The prober-visible slice of a RequestHandle."""
+
+    def __init__(self, toks, expired=False, aborted=False):
+        self._toks = list(toks)
+        self.deadline_expired = expired
+        self.aborted = aborted
+
+    def __iter__(self):
+        return iter(self._toks)
+
+
+class ScriptedReplica:
+    """A submit-shaped callable replaying a scripted outcome list.
+
+    Script entries: ("ok", tokens) | ("error",) | ("deadline",) |
+    ("aborted",) | ("slow", ttft_s, tokens).  The last entry repeats
+    once the script is exhausted.
+    """
+
+    def __init__(self, script, clock=None):
+        self.script = list(script)
+        self.clock = clock
+        self.i = 0
+        self.calls = []
+
+    def __call__(self, ids, **kw):
+        self.calls.append(kw)
+        step = self.script[min(self.i, len(self.script) - 1)]
+        self.i += 1
+        kind = step[0]
+        if kind == "error":
+            raise RuntimeError("injected")
+        if kind == "deadline":
+            return _Handle([], expired=True)
+        if kind == "aborted":
+            return _Handle([1], aborted=True)
+        if kind == "slow":
+            # Advance fake time so the prober measures a big TTFT but
+            # stays inside the deadline.
+            self.clock.advance(step[1])
+            return _Handle(step[2])
+        return _Handle(step[1])
+
+
+GOOD = [7, 11, 13, 17]
+
+
+def _prober(targets, clock, reg, **kw):
+    kw.setdefault("interval", 10.0)
+    kw.setdefault("deadline_s", 5.0)
+    kw.setdefault("window_n", 4)
+    kw.setdefault("fail_k", 2)
+    kw.setdefault("recover_k", 2)
+    return CanaryProber(targets, clock=clock, metrics=reg, **kw)
+
+
+# -- the FSM -------------------------------------------------------------------
+
+def test_fsm_walks_degraded_unhealthy_and_recovers():
+    """healthy -> degraded on the first hard failure, -> unhealthy at
+    fail_k-of-window_n, -> healthy after recover_k consecutive ok; the
+    state gauge tracks 1.0 / 0.5 / 0.0 and failures count by reason."""
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    rep = ScriptedReplica(
+        [("ok", GOOD), ("error",), ("deadline",),
+         ("ok", GOOD), ("ok", GOOD)]
+    )
+    p = _prober({"r0": rep}, clock, reg)
+
+    def state():
+        return p.snapshot()["replicas"]["r0"]["state"]
+
+    assert state() == HEALTHY
+    assert reg.gauge("probe_replica_healthy", replica="r0") == 1.0
+    p.probe_once()
+    assert state() == HEALTHY
+    p.probe_once()                      # error
+    assert state() == DEGRADED
+    assert reg.gauge("probe_replica_healthy", replica="r0") == 0.5
+    p.probe_once()                      # deadline -> 2 fails in window
+    assert state() == UNHEALTHY
+    assert reg.gauge("probe_replica_healthy", replica="r0") == 0.0
+    p.probe_once()                      # ok streak 1
+    assert state() == UNHEALTHY
+    p.probe_once()                      # ok streak 2 = recover_k
+    assert state() == HEALTHY
+    assert reg.gauge("probe_replica_healthy", replica="r0") == 1.0
+    assert reg.counter("probe_failures_total", replica="r0",
+                       reason="error") == 1.0
+    assert reg.counter("probe_failures_total", replica="r0",
+                       reason="deadline") == 1.0
+    assert reg.counter("probe_requests_total", replica="r0") == 5.0
+    # The transition history carries the whole walk.
+    trans = p.snapshot()["replicas"]["r0"]["transitions"]
+    assert [(t["from"], t["to"]) for t in trans] == [
+        (HEALTHY, DEGRADED), (DEGRADED, UNHEALTHY), (UNHEALTHY, HEALTHY),
+    ]
+
+
+def test_golden_drift_is_corrupt():
+    """The golden hash records on first healthy contact; a replica
+    answering DIFFERENT tokens later is corrupt — a hard failure."""
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    good = ScriptedReplica([("ok", GOOD)])
+    bad = ScriptedReplica([("ok", GOOD), ("ok", [9, 9, 9, 9])])
+    # Sorted probe order: a-good probes first and pins the golden.
+    p = _prober({"a-good": good, "b-drift": bad}, clock, reg)
+    p.probe_once()
+    assert p.snapshot()["golden"] != ""
+    p.probe_once()
+    snap = p.snapshot()["replicas"]
+    assert snap["a-good"]["state"] == HEALTHY
+    assert snap["b-drift"]["state"] == DEGRADED
+    assert snap["b-drift"]["last"]["reason"] == "corrupt"
+    assert reg.counter("probe_failures_total", replica="b-drift",
+                       reason="corrupt") == 1.0
+
+
+def test_slow_is_budget_event_not_fsm_failure():
+    """A correct-but-slow probe mints reason="slow" (the latency SLO's
+    bad event) but does NOT walk the FSM — quarantining slow replicas
+    would shed capacity exactly when the fleet is saturated."""
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    rep = ScriptedReplica([("slow", 1.5, GOOD)] * 3, clock=clock)
+    p = _prober({"r0": rep}, clock, reg, ttft_slo_s=0.5)
+    for _ in range(3):
+        p.probe_once()
+    snap = p.snapshot()["replicas"]["r0"]
+    assert snap["state"] == HEALTHY
+    assert snap["window"] == [1, 1, 1]
+    assert reg.counter("probe_failures_total", replica="r0",
+                       reason="slow") == 3.0
+    # The measured outside-in TTFT landed in the probe histogram.
+    assert reg.histogram("probe_ttft_seconds", replica="r0").n == 3
+    assert reg.percentile("probe_ttft_seconds", 0.5,
+                          replica="r0") == pytest.approx(1.5)
+
+
+def test_two_run_snapshots_byte_identical():
+    """The acceptance bar: two scripted FakeClock runs produce
+    byte-identical /debug/probes bodies."""
+
+    def run():
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        p = _prober(
+            {
+                "r0": ScriptedReplica([("ok", GOOD)]),
+                "r1": ScriptedReplica(
+                    [("ok", GOOD), ("error",), ("deadline",),
+                     ("ok", GOOD), ("ok", GOOD)]
+                ),
+            },
+            clock, reg,
+        )
+        for _ in range(6):
+            p.probe_once()
+            clock.advance(10.0)
+        return json.dumps(p.snapshot(), sort_keys=True)
+
+    assert run() == run()
+
+
+def test_router_quarantine_and_readmission():
+    """An unhealthy verdict quarantines the replica in the router (no
+    NEW traffic — same eligibility effect as a drain); recovery
+    re-admits it."""
+    from k8s_gpu_tpu.serve.router import FleetRouter
+
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    router = FleetRouter(page_size=4, metrics=reg)
+    for n in ("r0", "r1"):
+        router.add_replica(n)
+    rep = ScriptedReplica(
+        [("error",), ("error",), ("ok", GOOD), ("ok", GOOD)]
+    )
+    p = _prober({"r1": rep}, clock, reg, router=router)
+    p.probe_once()
+    p.probe_once()                      # 2 hard failures -> unhealthy
+    assert p.snapshot()["replicas"]["r1"]["state"] == UNHEALTHY
+    assert reg.counter("serve_router_quarantines_total") == 1.0
+    assert reg.gauge("serve_router_replicas_unhealthy") == 1.0
+    row = [r for r in router.snapshot()["replicas"]
+           if r["replica"] == "r1"][0]
+    assert row["unhealthy"] is True
+    # Zero NEW requests route to the quarantined replica.
+    assert all(
+        router.route([i, i + 1, i + 2]).replica == "r0"
+        for i in range(1, 20)
+    )
+    p.probe_once()
+    p.probe_once()                      # recover_k streak -> healthy
+    assert p.snapshot()["replicas"]["r1"]["state"] == HEALTHY
+    assert reg.gauge("serve_router_replicas_unhealthy") == 0.0
+    # Full-page prompts rendezvous-hash across the fleet again.
+    assert any(
+        router.route([i, i + 1, i + 2, i + 3, i + 4]).replica == "r1"
+        for i in range(1, 40)
+    )
+
+
+# -- the SLO error-budget plane ------------------------------------------------
+
+def test_slo_budget_math_and_multiwindow_burn():
+    """slo_budget_remaining_ratio is the cumulative clamp of
+    1 - (bad/total)/(1-target); the burn rates are windowed; and
+    SloBudgetBurn pages only while BOTH windows breach, resolving once
+    the bad events age out of the fast window."""
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    obj = SloObjective(
+        "probe-availability", 0.999,
+        total="probe_requests_total", bad="probe_failures_total",
+        bad_where={"reason": lambda r: r != "slow"},
+    )
+    ev = RuleEvaluator(
+        slo_rule_pack([obj], fast_window=300.0, slow_window=900.0),
+        clock=clock, registry=reg, interval=10.0,
+    )
+    # Tick 0: seeds the rate watches; a 1-in-2000 failure history gives
+    # remaining = 1 - (1/2000)/0.001 = 0.5 (cumulative, not windowed).
+    reg.inc("probe_requests_total", 2000.0, replica="r0")
+    reg.inc("probe_failures_total", 1.0, replica="r0", reason="error")
+    ev.evaluate_once()
+    assert reg.gauge("slo_budget_remaining_ratio",
+                     slo="probe-availability") == pytest.approx(0.5)
+    assert reg.gauge("slo_burn_rate_fast",
+                     slo="probe-availability") == 0.0
+    # A burst of failures: 5 of 10 probes bad over 10 s -> burn 500x in
+    # both windows -> SloBudgetBurn walks pending -> firing after for_s.
+    for _ in range(8):
+        clock.advance(10.0)
+        reg.inc("probe_requests_total", 10.0, replica="r0")
+        reg.inc("probe_failures_total", 5.0, replica="r0",
+                reason="deadline")
+        ev.evaluate_once()
+    assert reg.gauge("slo_burn_rate_fast",
+                     slo="probe-availability") > 14.4
+    assert reg.gauge("slo_burn_rate_slow",
+                     slo="probe-availability") > 14.4
+    assert reg.gauge("alerts_firing", alertname="SloBudgetBurn") == 1.0
+    # Budget spent stays visible (cumulative): far below the pre-burst
+    # remaining ratio.
+    assert reg.gauge("slo_budget_remaining_ratio",
+                     slo="probe-availability") == 0.0
+    # Healthy traffic + the fast window scrolling past the burst ->
+    # fast burn decays -> min(fast, slow) clears -> resolved.
+    for _ in range(8):
+        clock.advance(50.0)
+        reg.inc("probe_requests_total", 10.0, replica="r0")
+        ev.evaluate_once()
+    assert reg.gauge("slo_burn_rate_fast",
+                     slo="probe-availability") < 14.4
+    assert reg.gauge("alerts_firing", alertname="SloBudgetBurn") == 0.0
+    assert any(
+        t["alert"] == "SloBudgetBurn" and t["to"] == "resolved"
+        for t in ev.timeline
+    )
+
+
+def test_default_pack_canary_rules_and_reserved_tenant_exclusion():
+    """CanaryFailing warns at degraded (< 0.75), ReplicaUnhealthy pages
+    at unhealthy (< 0.25) with zero hold, and the tenant burn-rate rule
+    skips the reserved "_" tenants wholesale."""
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    ev = RuleEvaluator(
+        default_rule_pack(), clock=clock, registry=reg, interval=10.0,
+    )
+    reg.set_gauge("probe_replica_healthy", 1.0, replica="r0")
+    reg.inc("serve_tenant_tokens_total", 100.0, tenant="acme")
+    reg.inc("serve_tenant_goodput_tokens_total", 100.0, tenant="acme")
+    reg.inc("serve_tenant_tokens_total", 50.0, tenant=PROBE_TENANT)
+    ev.evaluate_once()
+    # The reserved tenant minted NO burn-rate series.
+    burns = {
+        dict(lbls).get("tenant")
+        for lbls in reg.series("tenant_slo_burn_rate")
+    }
+    assert burns == {"acme"}
+    # Degraded -> CanaryFailing pending, fires after its 30 s hold;
+    # ReplicaUnhealthy stays quiet above 0.25.
+    reg.set_gauge("probe_replica_healthy", 0.5, replica="r0")
+    clock.advance(10.0)
+    ev.evaluate_once()
+    clock.advance(30.0)
+    ev.evaluate_once()
+    assert reg.gauge("alerts_firing", alertname="CanaryFailing") == 1.0
+    assert reg.gauge("alerts_firing", alertname="ReplicaUnhealthy") == 0.0
+    # Unhealthy -> ReplicaUnhealthy pages in ONE tick (for_s=0: the
+    # K-of-N probe window is the hold).
+    reg.set_gauge("probe_replica_healthy", 0.0, replica="r0")
+    clock.advance(10.0)
+    ev.evaluate_once()
+    assert reg.gauge("alerts_firing", alertname="ReplicaUnhealthy") == 1.0
+    # Recovery resolves both.
+    reg.set_gauge("probe_replica_healthy", 1.0, replica="r0")
+    clock.advance(10.0)
+    ev.evaluate_once()
+    assert reg.gauge("alerts_firing", alertname="CanaryFailing") == 0.0
+    assert reg.gauge("alerts_firing", alertname="ReplicaUnhealthy") == 0.0
+
+
+def test_fleet_aggregation_policy_for_probe_and_slo_gauges():
+    """Federation stores min for probe_replica_healthy and
+    slo_budget_remaining_ratio (the fleet is its sickest member /
+    tightest budget) and max for the burn rates."""
+    from k8s_gpu_tpu.utils.federation import FleetCollector
+
+    # Two probers watching the SAME replica disagree: the fleet view
+    # must keep the pessimistic verdict.
+    regs = {"p0": MetricsRegistry(), "p1": MetricsRegistry()}
+    regs["p0"].set_gauge("probe_replica_healthy", 1.0, replica="shared")
+    regs["p0"].set_gauge("slo_budget_remaining_ratio", 0.9, slo="avail")
+    regs["p0"].set_gauge("slo_burn_rate_fast", 0.1, slo="avail")
+    regs["p1"].set_gauge("probe_replica_healthy", 0.0, replica="shared")
+    regs["p1"].set_gauge("slo_budget_remaining_ratio", 0.4, slo="avail")
+    regs["p1"].set_gauge("slo_burn_rate_fast", 20.0, slo="avail")
+    fc = FleetCollector(
+        {n: (lambda r=r: r.render()) for n, r in regs.items()},
+        clock=FakeClock(),
+    )
+    fc.scrape_once()
+    agg = fc.registry
+    assert agg.gauge("probe_replica_healthy", replica="shared") == 0.0
+    assert agg.gauge("slo_budget_remaining_ratio", slo="avail") == 0.4
+    assert agg.gauge("slo_burn_rate_fast", slo="avail") == 20.0  # max
+
+
+# -- surfaces ------------------------------------------------------------------
+
+def test_debug_probes_endpoint_and_renderers():
+    """/debug/probes serves the sort_keys snapshot; render_probes and
+    render_slo draw the tables."""
+    from k8s_gpu_tpu.utils.obs import (
+        MetricsServer,
+        render_probes,
+        render_slo,
+    )
+
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    p = _prober(
+        {"r0": ScriptedReplica([("ok", GOOD), ("error",)])}, clock, reg
+    )
+    p.probe_once()
+    p.probe_once()
+    srv = MetricsServer(registry=reg, probes=p).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/probes"
+        ) as r:
+            body = r.read()
+        assert body == json.dumps(p.snapshot(), sort_keys=True).encode()
+        out = render_probes(json.loads(body))
+        assert "r0" in out and "degraded" in out and "error=1" in out
+    finally:
+        srv.stop()
+    reg.set_gauge("slo_budget_remaining_ratio", 0.25, slo="avail")
+    reg.set_gauge("slo_burn_rate_fast", 2.0, slo="avail")
+    from k8s_gpu_tpu.utils.metrics import parse_exposition
+
+    out = render_slo(parse_exposition(reg.render()))
+    assert "avail" in out and "25.00%" in out and "2.00x" in out
+    # A prober-less server 404s the route.
+    srv = MetricsServer(registry=reg).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/probes"
+            )
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_evaluator_attach_paces_probes_by_interval():
+    """attach() probes as an evaluator collector, gated by the probe
+    interval — a fast alert cadence doesn't turn into probe spam."""
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    rep = ScriptedReplica([("ok", GOOD)])
+    p = _prober({"r0": rep}, clock, reg, interval=30.0)
+    ev = RuleEvaluator([], clock=clock, registry=reg, interval=10.0)
+    p.attach(ev)
+    ev.evaluate_once()                  # first tick probes
+    assert len(rep.calls) == 1
+    clock.advance(10.0)
+    ev.evaluate_once()                  # 10 s < interval: no probe
+    assert len(rep.calls) == 1
+    clock.advance(25.0)
+    ev.evaluate_once()
+    assert len(rep.calls) == 2
+
+
+# -- the serve-plane integration ----------------------------------------------
+
+def test_batcher_self_pollution_guard(tiny_lm):
+    """Canary traffic must not move user-facing SLO series: no tenant
+    token counters, no latency histogram observations — but the journal
+    records it (probe=true) and snapshot(probes=False) filters it."""
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+
+    model, params = tiny_lm
+    reg = MetricsRegistry()
+    b = ContinuousBatcher(model, params, slots=2, metrics=reg).start()
+    try:
+        h = b.submit([1, 2, 3], max_new_tokens=4, tenant="acme")
+        assert len(h.result()) == 4
+        p = _prober({"r0": b.submit}, FakeClock(), reg, deadline_s=60.0)
+        # RealClock prober would also work; FakeClock keeps the probe
+        # deadline far in the batcher's past, so disable it instead.
+        p.deadline_s = float("inf")
+        assert p.probe_once() == {"r0": "ok"}
+        # Tenant accounting: acme only — no _canary series anywhere.
+        tenants = {
+            dict(lbls).get("tenant")
+            for lbls in reg.series("serve_tenant_tokens_total")
+        }
+        assert tenants == {"acme"}
+        assert reg.histogram("serve_ttft_seconds").n == 1
+        assert reg.histogram("serve_ttft_seconds",
+                             tenant=PROBE_TENANT) is None
+        # The probe DID count as real work.
+        assert reg.counter("probe_requests_total", replica="r0") == 1.0
+        recs = b.journal.snapshot()
+        probe_recs = [r for r in recs if r.get("extra", {}).get("probe")]
+        assert len(probe_recs) == 1
+        assert probe_recs[0]["tenant"] == PROBE_TENANT
+        # The --no-probes filter drops exactly the probe record.
+        assert len(b.journal.snapshot(probes=False)) == len(recs) - 1
+    finally:
+        b.stop()
+
+
+def test_lm_server_health_contract(tiny_lm):
+    """/healthz is pure liveness (always 200); /readyz gates on
+    scheduler-alive AND warmed AND not draining, with the failing leg
+    named in the body; drain()/undrain() flip it."""
+    from k8s_gpu_tpu.data import BpeTokenizer
+    from k8s_gpu_tpu.serve import LmServer
+
+    model, params = tiny_lm
+    tok = BpeTokenizer.train("aa bb cc dd " * 30, vocab_size=80)
+    srv = LmServer(model, params, tok, metrics=MetricsRegistry())
+    srv._thread.start()                 # HTTP only; batcher not started
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}"
+            ) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        assert get("/healthz")[0] == 200
+        code, body = get("/readyz")
+        assert code == 503 and body["scheduler_alive"] is False
+        srv.batcher.start()
+        code, body = get("/readyz")
+        assert code == 503 and body["warmed"] is False
+        # First emitted token warms the readiness latch.
+        h = srv.batcher.submit([1, 2, 3], max_new_tokens=2)
+        assert len(h.result()) == 2
+        code, body = get("/readyz")
+        assert code == 200 and body["ready"] is True
+        # Drain: NotReady without stopping work; liveness unaffected.
+        srv.drain()
+        code, body = get("/readyz")
+        assert code == 503 and body["draining"] is True
+        assert get("/healthz")[0] == 200
+        srv.undrain()
+        assert get("/readyz")[0] == 200
+    finally:
+        srv.stop()
+
+
+def test_router_drain_hook_flips_replica_readiness():
+    """FleetRouter.drain() announces scale-down through the replica's
+    on_drain hook — the LmServer.drain seam, tested with a recorder."""
+    from k8s_gpu_tpu.serve.router import FleetRouter
+
+    drained = []
+    r = FleetRouter(page_size=4, metrics=MetricsRegistry())
+    r.add_replica("r0", on_drain=lambda: drained.append("r0"))
+    r.add_replica("r1")
+    r.drain("r0")
+    assert drained == ["r0"]
+    r.drain("r1")                       # hook-less drain still works
+    assert drained == ["r0"]
+
+
+def test_chaos_canary_acceptance(tiny_lm):
+    """The acceptance drill: a 3-replica fleet of real batchers, seeded
+    serve.submit faults plus one corrupted-output replica.  The FSM
+    walks the corrupt replica to unhealthy, ReplicaUnhealthy fires, the
+    router sends it zero NEW requests; the fault lifts, probes recover,
+    the replica re-admits, the alert resolves — and the spent error
+    budget stays visible."""
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+    from k8s_gpu_tpu.serve.router import FleetRouter
+    from k8s_gpu_tpu.utils.faults import FaultPlan, global_faults
+
+    model, params = tiny_lm
+    reg = MetricsRegistry()
+    reps = {
+        n: ContinuousBatcher(
+            model, params, slots=2, metrics=MetricsRegistry()
+        ).start()
+        for n in ("r0", "r1", "r2")
+    }
+
+    class CorruptingTarget:
+        """Wraps a replica's submit: while armed, every emitted token
+        is rewritten — the answers-garbage failure mode self-reported
+        health can never see."""
+
+        def __init__(self, submit):
+            self.submit = submit
+            self.armed = True
+
+        def __call__(self, ids, **kw):
+            h = self.submit(ids, **kw)
+            if not self.armed:
+                return h
+            toks = [(int(t) + 1) % 64 for t in h]
+            return _Handle(
+                toks,
+                expired=bool(getattr(h, "deadline_expired", False)),
+                aborted=bool(getattr(h, "aborted", False)),
+            )
+
+    corrupt = CorruptingTarget(reps["r1"].submit)
+    router = FleetRouter(page_size=4, metrics=reg)
+    for n, b in reps.items():
+        router.add_replica(n, b.submit)
+    # Probes run on the real clock (the batcher's deadline domain);
+    # alert evaluation runs on its own FakeClock over the same registry.
+    prober = CanaryProber(
+        {"r0": reps["r0"].submit, "r1": corrupt, "r2": reps["r2"].submit},
+        metrics=reg, router=router, deadline_s=60.0,
+        window_n=4, fail_k=2, recover_k=2, max_new_tokens=4,
+    )
+    clock = FakeClock()
+    ev = RuleEvaluator(
+        default_rule_pack(), clock=clock, registry=reg, interval=10.0,
+    )
+
+    def tick():
+        clock.advance(10.0)
+        ev.evaluate_once()
+
+    try:
+        # Round 1 under seeded faults: the first two probe submits (r0,
+        # r1 in sorted order) die injected; r2's clean probe pins the
+        # golden.
+        global_faults.arm(
+            "serve.submit", FaultPlan(flaky=2, kinds=("error",))
+        )
+        try:
+            out = prober.probe_once()
+        finally:
+            global_faults.disarm("serve.submit")
+        assert out == {"r0": "error", "r1": "error", "r2": "ok"}
+        assert prober.snapshot()["golden"] != ""
+        ev.evaluate_once()
+        # Round 2: faults healed; r1 now answers corrupted tokens —
+        # second hard failure in its window walks it to unhealthy.
+        out = prober.probe_once()
+        assert out == {"r0": "ok", "r1": "corrupt", "r2": "ok"}
+        states = {
+            n: d["state"]
+            for n, d in prober.snapshot()["replicas"].items()
+        }
+        assert states["r1"] == UNHEALTHY
+        assert states["r2"] == HEALTHY
+        tick()
+        assert reg.gauge("alerts_firing",
+                         alertname="ReplicaUnhealthy") == 1.0
+        # Zero NEW requests reach the quarantined replica (full-page
+        # prompts so placement rendezvous-hashes across the fleet).
+        decisions = [
+            router.route([i, i + 1, i + 2, i + 3, i + 4])
+            for i in range(1, 33)
+        ]
+        assert all(d.replica != "r1" for d in decisions)
+        assert {d.replica for d in decisions} == {"r0", "r2"}
+        # Budget spend is visible and cumulative: 3 hard failures in 6
+        # probes burned the 99.9% availability budget flat.
+        assert reg.gauge("slo_budget_remaining_ratio",
+                         slo="probe-availability") == 0.0
+        # Fault lifted: recover_k clean probes re-admit and resolve.
+        corrupt.armed = False
+        for _ in range(3):
+            prober.probe_once()
+        assert (
+            prober.snapshot()["replicas"]["r1"]["state"] == HEALTHY
+        )
+        tick()
+        assert reg.gauge("alerts_firing",
+                         alertname="ReplicaUnhealthy") == 0.0
+        assert any(
+            t["alert"] == "ReplicaUnhealthy" and t["to"] == "resolved"
+            for t in ev.timeline
+        )
+        row = [r for r in router.snapshot()["replicas"]
+               if r["replica"] == "r1"][0]
+        assert row["unhealthy"] is False
+        # The drill's cost stays on the books after recovery.
+        assert reg.gauge("slo_budget_remaining_ratio",
+                         slo="probe-availability") == 0.0
+        assert reg.counter("serve_router_quarantines_total") == 1.0
+    finally:
+        global_faults.disarm("serve.submit")
+        for b in reps.values():
+            b.stop()

@@ -8,6 +8,17 @@ import pytest
 from k8s_gpu_tpu.cli.main import main
 
 
+def _no_cryptography() -> bool:
+    # `devenv keygen` is the one CLI verb with a hard dependency on the
+    # optional 'cryptography' package (real Ed25519 keys); skip by name
+    # instead of failing where the env lacks it.
+    try:
+        import cryptography  # noqa: F401
+        return False
+    except ImportError:
+        return True
+
+
 @pytest.fixture(autouse=True)
 def isolated_dirs(tmp_path, monkeypatch):
     monkeypatch.setenv("K8SGPU_CONFIG_DIR", str(tmp_path / "config"))
@@ -221,6 +232,10 @@ def test_devenv_ssh_and_put_cli_client(tmp_path, capsys):
         p.close()
 
 
+@pytest.mark.skipif(
+    _no_cryptography(),
+    reason="devenv keygen needs the optional 'cryptography' package",
+)
 def test_devenv_ssh2_cli_end_to_end(tmp_path, capsys):
     """The SSH-2 stretch (VERDICT r3 #7): `devenv keygen` makes a real
     Ed25519 pair, `devenv create` registers the .pub, and `devenv ssh
@@ -259,6 +274,10 @@ def test_devenv_ssh2_cli_end_to_end(tmp_path, capsys):
         gw.stop()
 
 
+@pytest.mark.skipif(
+    _no_cryptography(),
+    reason="devenv keygen needs the optional 'cryptography' package",
+)
 def test_devenv_put_over_sftp_cli(tmp_path, capsys):
     """`devenv put --ssh2`: bulk upload rides the standard SFTP
     subsystem end-to-end (CLI → SSH-2 transport → sftp channel →

@@ -5,7 +5,15 @@ packets, publickey auth and exec channels against live cluster state —
 C24's standard-protocol half (GPU调度平台搭建.md:408-419)."""
 
 import pytest
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+
+# The SSH-2 suite signs with real ed25519 keys; without the optional
+# 'cryptography' package the whole module skips by name instead of
+# failing collection.
+pytest.importorskip(
+    "cryptography",
+    reason="ssh gateway tests need the optional 'cryptography' package",
+)
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (  # noqa: E402
     Ed25519PrivateKey,
 )
 

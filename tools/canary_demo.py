@@ -1,0 +1,300 @@
+"""Black-box canary smoke (`make canary-demo`) — ISSUE 14.
+
+Four acts, each asserting its invariant (non-zero exit on failure):
+
+1. **The chaos drill** — a 3-replica fleet of real (tiny) batchers with
+   seeded `serve.submit` faults plus one corrupted-output replica: the
+   health FSM walks the corrupt replica healthy→degraded→unhealthy,
+   `ReplicaUnhealthy` pages, the router routes zero NEW requests to it;
+   the fault lifts, probes recover, the replica re-admits, the alert
+   resolves — and the spent availability budget stays on the books.
+2. **The health contract** — `/healthz` answers 200 from the moment the
+   socket binds; `/readyz` walks 503(scheduler) → 503(warming) → 200 →
+   503(draining) → 200 over real HTTP.
+3. **Self-pollution guard** — a probe through a real batcher mints
+   `probe_*` series but moves NO `serve_tenant_*` counter and NO
+   latency histogram; the journal records it flagged `probe=true`.
+4. **Two-run determinism** — two identically-scripted FakeClock runs
+   produce byte-identical `/debug/probes` bodies (the graftcheck
+   determinism-plane contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from k8s_gpu_tpu.data import BpeTokenizer  # noqa: E402
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM  # noqa: E402
+from k8s_gpu_tpu.serve import ContinuousBatcher, LmServer  # noqa: E402
+from k8s_gpu_tpu.serve.canary import (  # noqa: E402
+    HEALTHY,
+    UNHEALTHY,
+    CanaryProber,
+)
+from k8s_gpu_tpu.serve.journal import PROBE_TENANT  # noqa: E402
+from k8s_gpu_tpu.serve.router import FleetRouter  # noqa: E402
+from k8s_gpu_tpu.utils.alerts import RuleEvaluator, default_rule_pack  # noqa: E402
+from k8s_gpu_tpu.utils.clock import FakeClock  # noqa: E402
+from k8s_gpu_tpu.utils.faults import FaultPlan, global_faults  # noqa: E402
+from k8s_gpu_tpu.utils.metrics import MetricsRegistry  # noqa: E402
+from k8s_gpu_tpu.utils.obs import (  # noqa: E402
+    MetricsServer,
+    render_probes,
+    render_slo,
+)
+
+TINY = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8,
+    d_ff=64, max_seq=48, use_flash=False,
+)
+
+
+def _model():
+    model = TransformerLM(TINY)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+class _Handle:
+    def __init__(self, toks, expired=False, aborted=False):
+        self._toks = list(toks)
+        self.deadline_expired = expired
+        self.aborted = aborted
+
+    def __iter__(self):
+        return iter(self._toks)
+
+
+def act1_chaos_drill() -> None:
+    print("== act 1: the chaos drill ==")
+    model, params = _model()
+    reg = MetricsRegistry()
+    reps = {
+        n: ContinuousBatcher(
+            model, params, slots=2, metrics=MetricsRegistry()
+        ).start()
+        for n in ("r0", "r1", "r2")
+    }
+
+    class CorruptingTarget:
+        def __init__(self, submit):
+            self.submit = submit
+            self.armed = True
+
+        def __call__(self, ids, **kw):
+            h = self.submit(ids, **kw)
+            if not self.armed:
+                return h
+            return _Handle([(int(t) + 1) % 64 for t in h])
+
+    corrupt = CorruptingTarget(reps["r1"].submit)
+    router = FleetRouter(page_size=4, metrics=reg)
+    for n, b in reps.items():
+        router.add_replica(n, b.submit)
+    prober = CanaryProber(
+        {"r0": reps["r0"].submit, "r1": corrupt, "r2": reps["r2"].submit},
+        metrics=reg, router=router, deadline_s=60.0,
+        window_n=4, fail_k=2, recover_k=2, max_new_tokens=4,
+    )
+    clock = FakeClock()
+    ev = RuleEvaluator(
+        default_rule_pack(), clock=clock, registry=reg, interval=10.0,
+    )
+
+    def tick():
+        clock.advance(10.0)
+        ev.evaluate_once()
+
+    try:
+        global_faults.arm(
+            "serve.submit", FaultPlan(flaky=2, kinds=("error",))
+        )
+        try:
+            out = prober.probe_once()
+        finally:
+            global_faults.disarm("serve.submit")
+        print(f"  round 1 under seeded faults: {out}")
+        assert out == {"r0": "error", "r1": "error", "r2": "ok"}
+        golden = prober.snapshot()["golden"]
+        assert golden
+        print(f"  golden pinned by r2: {golden}")
+        ev.evaluate_once()
+        out = prober.probe_once()
+        print(f"  round 2, faults healed, r1 corrupting: {out}")
+        assert out == {"r0": "ok", "r1": "corrupt", "r2": "ok"}
+        assert prober.snapshot()["replicas"]["r1"]["state"] == UNHEALTHY
+        tick()
+        assert reg.gauge("alerts_firing", alertname="ReplicaUnhealthy") == 1.0
+        print("  ReplicaUnhealthy FIRING; r1 quarantined")
+        decisions = [
+            router.route([i, i + 1, i + 2, i + 3, i + 4])
+            for i in range(1, 33)
+        ]
+        hit = sorted({d.replica for d in decisions})
+        assert "r1" not in hit
+        print(f"  32 user requests routed to {hit} — zero to r1")
+        remaining = reg.gauge(
+            "slo_budget_remaining_ratio", slo="probe-availability"
+        )
+        print(f"  availability budget remaining: {remaining:.3f}")
+        corrupt.armed = False
+        for _ in range(3):
+            prober.probe_once()
+        assert prober.snapshot()["replicas"]["r1"]["state"] == HEALTHY
+        tick()
+        assert reg.gauge("alerts_firing", alertname="ReplicaUnhealthy") == 0.0
+        assert any(
+            t["alert"] == "ReplicaUnhealthy" and t["to"] == "resolved"
+            for t in ev.timeline
+        )
+        print("  corruption lifted: r1 recovered, re-admitted, alert resolved")
+        assert reg.gauge(
+            "slo_budget_remaining_ratio", slo="probe-availability"
+        ) == 0.0
+        print("  drill cost stays on the books (budget spent, cumulative)")
+        print(render_probes(prober.snapshot()))
+        from k8s_gpu_tpu.utils.metrics import parse_exposition
+
+        print(render_slo(parse_exposition(reg.render())))
+    finally:
+        global_faults.disarm("serve.submit")
+        for b in reps.values():
+            b.stop()
+
+
+def act2_health_contract() -> None:
+    print("== act 2: the health contract ==")
+    model, params = _model()
+    tok = BpeTokenizer.train("aa bb cc dd " * 30, vocab_size=80)
+    srv = LmServer(model, params, tok, metrics=MetricsRegistry())
+    srv._thread.start()
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}"
+            ) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        assert get("/healthz")[0] == 200
+        code, body = get("/readyz")
+        assert code == 503 and not body["scheduler_alive"]
+        print(f"  scheduler down: readyz 503 {body}")
+        srv.batcher.start()
+        code, body = get("/readyz")
+        assert code == 503 and not body["warmed"]
+        print("  scheduler up, pre-compile: readyz 503 (warming)")
+        srv.batcher.submit([1, 2, 3], max_new_tokens=2).result()
+        code, body = get("/readyz")
+        assert code == 200 and body["ready"]
+        print("  first tokens emitted: readyz 200")
+        srv.drain()
+        code, body = get("/readyz")
+        assert code == 503 and body["draining"]
+        assert get("/healthz")[0] == 200
+        print("  draining: readyz 503, healthz still 200 (drain is not death)")
+        srv.undrain()
+        assert get("/readyz")[0] == 200
+        print("  undrained: readyz 200")
+    finally:
+        srv.stop()
+
+
+def act3_self_pollution_guard() -> None:
+    print("== act 3: the self-pollution guard ==")
+    model, params = _model()
+    reg = MetricsRegistry()
+    b = ContinuousBatcher(model, params, slots=2, metrics=reg).start()
+    try:
+        b.submit([1, 2, 3], max_new_tokens=4, tenant="acme").result()
+        p = CanaryProber(
+            {"r0": b.submit}, metrics=reg, deadline_s=60.0,
+            max_new_tokens=4,
+        )
+        assert p.probe_once() == {"r0": "ok"}
+        tenants = sorted(
+            dict(lbls)["tenant"]
+            for lbls in reg.series("serve_tenant_tokens_total")
+        )
+        assert tenants == ["acme"], tenants
+        assert reg.histogram("serve_ttft_seconds").n == 1
+        assert reg.counter("probe_requests_total", replica="r0") == 1.0
+        recs = b.journal.snapshot()
+        probes = [r for r in recs if r.get("extra", {}).get("probe")]
+        assert len(probes) == 1 and probes[0]["tenant"] == PROBE_TENANT
+        assert len(b.journal.snapshot(probes=False)) == len(recs) - 1
+        print(f"  probe ran as tenant {PROBE_TENANT!r}: probe_* minted,"
+              " tenant counters and latency histograms untouched,"
+              " journal flags probe=true")
+    finally:
+        b.stop()
+
+
+def act4_determinism() -> None:
+    print("== act 4: two-run determinism ==")
+
+    class Scripted:
+        def __init__(self, script):
+            self.script = list(script)
+            self.i = 0
+
+        def __call__(self, ids, **kw):
+            step = self.script[min(self.i, len(self.script) - 1)]
+            self.i += 1
+            if step == "error":
+                raise RuntimeError("injected")
+            return _Handle(step)
+
+    def run() -> bytes:
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        p = CanaryProber(
+            {
+                "r0": Scripted([[7, 11, 13, 17]]),
+                "r1": Scripted(
+                    [[7, 11, 13, 17], "error", "error", [7, 11, 13, 17]]
+                ),
+            },
+            clock=clock, metrics=reg, window_n=4, fail_k=2, recover_k=2,
+        )
+        srv = MetricsServer(registry=reg, probes=p).start()
+        try:
+            for _ in range(5):
+                p.probe_once()
+                clock.advance(10.0)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/probes"
+            ) as r:
+                return r.read()
+        finally:
+            srv.stop()
+
+    a, b = run(), run()
+    assert a == b, "probe debug bodies differ between identical runs"
+    print(f"  /debug/probes byte-identical across two runs "
+          f"({len(a)} bytes)")
+
+
+def main() -> int:
+    act1_chaos_drill()
+    act2_health_contract()
+    act3_self_pollution_guard()
+    act4_determinism()
+    print("canary-demo: all invariants held")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
