@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo
 
 # The default verify path (bare `make`): graftcheck invariants + the
 # attribution-plane smoke + the flash-v2 parity suite (ISSUE 12 — every
@@ -23,7 +23,7 @@ IMAGES = operator trainer devenv
 # train-step guard, all CPU-safe through the Pallas interpreter).  The
 # full suite stays `make test` (it takes minutes); image builds stay
 # `make docker-build`.
-verify: check profile-demo goodput-demo canary-demo flash-v2-parity
+verify: check profile-demo goodput-demo canary-demo frontend-demo flash-v2-parity
 
 flash-v2-parity:
 	python -m pytest tests/test_flash_v2.py -q -p no:cacheprovider
@@ -146,6 +146,14 @@ canary-demo:
 # train_flash_v2_vs_v1_x) are bench.py's job on a TPU host.
 kernel-demo:
 	python tools/kernel_demo.py
+
+# Fleet front-end smoke (ISSUE 15): 3 real LmServers on real sockets
+# behind the FleetFrontend gateway — admin-plane registration gated on
+# /readyz, skewed tenants routing by affinity (x-route-* headers), a
+# dead-kill rehash with zero lost requests, and an in-flight-aware
+# drain that retires gracefully while its work finishes.
+frontend-demo:
+	python tools/frontend_demo.py
 
 # Fleet router smoke: 4 paged replicas behind the prefix-affinity
 # router serve skewed multi-tenant traffic (each tenant's shared prompt

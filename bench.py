@@ -1053,6 +1053,174 @@ def router_fleet_probe(model, params) -> dict:
     return out
 
 
+def frontend_gateway_probe(model, params) -> dict:
+    """Cross-process fleet front door (ISSUE 15): the FleetFrontend
+    HTTP gateway over real LmServer sockets, measured two ways —
+
+    - cb_frontend_overhead_x: the SAME 8-wide window posted direct to
+      a replica vs through the gateway (tokenize → route → relay adds
+      one local HTTP hop); budget < 1.10x on CPU.
+    - cb_frontend_rehash_lost: a 16-request burst over 2 replicas with
+      one KILLED mid-burst; every in-flight casualty must rehash to
+      the survivor and complete — the count of lost requests, must
+      be 0."""
+    import threading
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from k8s_gpu_tpu.serve import FleetFrontend, LmServer
+    from k8s_gpu_tpu.serve.batcher import prompt_bucket
+    from k8s_gpu_tpu.utils.metrics import MetricsRegistry
+
+    cfg = model.cfg
+    page = min(16, max(4, cfg.max_seq // 8))
+    pre_len = 2 * page
+    # Long enough that the decode dominates the window: the gateway's
+    # fixed per-request cost (tokenize + route + one local HTTP hop) is
+    # what's being amortized, and the budget is a RATIO.
+    n_new = min(24, cfg.max_seq - pre_len - 4)
+    if n_new < 8:
+        return {"frontend_gateway_probe_skipped": 1.0}
+
+    import numpy as np
+
+    class _ByteTok:
+        # 1 byte = 1 token, ids in [2, 121] — inside any bench vocab.
+        # Direct posts and gateway relays then tokenize identically, so
+        # the gateway's chain hashes match the batcher's registrations.
+        vocab_size = 128
+
+        def encode(self, text):
+            return np.asarray(
+                [2 + (b % 120) for b in str(text).encode()], np.int32
+            )
+
+        def decode(self, ids):
+            return "".join(chr(97 + (int(i) % 26)) for i in ids)
+
+    tok = _ByteTok()
+
+    def prompt(tenant, i):
+        return ("t%d" % tenant) * (pre_len // 2) + ("q%02d" % (i % 100))
+
+    def post(base, body, timeout=120.0):
+        req = urllib.request.Request(
+            base.rstrip("/") + "/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    bucket = prompt_bucket(pre_len + 4, cfg.max_seq)
+    need_one = -(-(bucket + n_new) // page)
+    n_blocks = max(1 + cfg.max_seq // page,
+                   4 * (pre_len // page) + 10 * need_one)
+
+    def mk_server(name):
+        return LmServer(
+            model, params, tok, slots=8, paged_blocks=n_blocks,
+            page_size=page, metrics=MetricsRegistry(), name=name,
+        ).start()
+
+    def warm(srv):
+        # Cold full-prompt bucket, then the warm-suffix variant.
+        post(f"http://127.0.0.1:{srv.port}",
+             {"prompt": prompt(9, 0), "max_new_tokens": n_new,
+              "temperature": 0.0})
+        post(f"http://127.0.0.1:{srv.port}",
+             {"prompt": prompt(9, 1), "max_new_tokens": n_new,
+              "temperature": 0.0})
+
+    out = {}
+    # -- overhead: one replica, direct vs gateway-relayed ----------------
+    srv = mk_server("g0")
+    fe = FleetFrontend(tok, page_size=page, metrics=MetricsRegistry())
+    fe.start()
+    try:
+        warm(srv)
+        fe.register_replica("g0", f"http://127.0.0.1:{srv.port}")
+        bodies = [
+            {"prompt": prompt(i % 2, i), "max_new_tokens": n_new,
+             "temperature": 0.0}
+            for i in range(8)
+        ]
+
+        def window(base):
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                t0 = time.perf_counter()
+                list(ex.map(lambda b: post(base, b), bodies))
+                return time.perf_counter() - t0
+
+        direct = f"http://127.0.0.1:{srv.port}"
+        window(direct)
+        window(fe.url)
+
+        def best(base, trials=3):
+            return min(window(base) for _ in range(trials))
+
+        # Clean window timed again AFTER the gateway one — warm-up
+        # drift must not masquerade as gateway cost (canary idiom).
+        d1 = best(direct)
+        gw = best(fe.url)
+        d2 = best(direct)
+        out["cb_frontend_overhead_x"] = round(gw / min(d1, d2), 4)
+    finally:
+        fe.stop()
+        srv.stop()
+
+    # -- rehash: kill one of two replicas mid-burst ----------------------
+    srvs = {"g1": mk_server("g1"), "g2": mk_server("g2")}
+    fe = FleetFrontend(tok, page_size=page, metrics=MetricsRegistry())
+    fe.start()
+    try:
+        for name, s in srvs.items():
+            warm(s)
+            fe.register_replica(name, f"http://127.0.0.1:{s.port}")
+        n_burst = 16
+        done = []
+        started = threading.Event()
+
+        def fire(i):
+            started.set()
+            try:
+                post(fe.url, {"prompt": prompt(i % 4, i),
+                              "max_new_tokens": n_new,
+                              "temperature": 0.0})
+                done.append(i)
+            except Exception:
+                pass
+
+        def killer():
+            # Kill once the burst is demonstrably in flight — a fixed
+            # sleep races a fast model (whole burst done before the
+            # kill = rehash never exercised).
+            started.wait(5.0)
+            while not done and srvs["g1"].batcher.inflight_requests == 0:
+                time.sleep(0.01)
+            srvs["g1"].stop()
+
+        kt = threading.Thread(target=killer)
+        with ThreadPoolExecutor(max_workers=8) as ex:
+            kt.start()
+            futs = [ex.submit(fire, i) for i in range(n_burst)]
+            for f in futs:
+                f.result()
+        kt.join()
+        out["cb_frontend_rehash_lost"] = float(n_burst - len(done))
+        out["cb_frontend_rehash_total"] = float(
+            fe.metrics.counter("serve_router_rehash_total")
+        )
+    finally:
+        fe.stop()
+        for s in srvs.values():
+            try:
+                s.stop()
+            except Exception:
+                pass
+    return out
+
+
 def quant_decode_probe(model, params) -> dict:
     """Int8 weight-only decode throughput (serve/quant.py): same decode
     loop as decode_probe but streaming 1-byte weights from HBM."""
@@ -1335,7 +1503,8 @@ def main() -> None:
     # Serving accelerators (r3 + r4) — diagnostic: a failure must not
     # cost the graded platform metric.
     for probe in (quant_decode_probe, spec_batcher_probe,
-                  kv_quant_probe, paged_kv_probe, router_fleet_probe):
+                  kv_quant_probe, paged_kv_probe, router_fleet_probe,
+                  frontend_gateway_probe):
         try:
             decode.update(probe(tb["model"], tb["trainer"].params))
         except Exception as e:
@@ -1397,6 +1566,7 @@ def main() -> None:
         "cb_router_tokens_per_s_4rep", "cb_router_prefix_hit_ratio",
         "cb_router_affinity_hit_x", "cb_router_vs_single_x",
         "cb_router_ttft_p95_s", "cb_router_rr_ttft_p95_s",
+        "cb_frontend_overhead_x", "cb_frontend_rehash_lost",
         "cb_phase_share_decode_dispatch", "cb_phase_residual_share",
         "train_mfu_gauge", "train_flash_v2_vs_v1_x",
         "train_attn_ms_per_layer", "flash_v2_parity_ok",

@@ -44,6 +44,11 @@ DETERMINISTIC_PLANES = (
     # byte-identical /debug/probes contract — probe timing and FSM
     # walks are pure functions of (targets' behavior, injected Clock).
     "k8s_gpu_tpu/serve/canary.py",
+    # The HTTP front-end (ISSUE 15): routing, retry backoff, breaker
+    # gating, and drain deadlines all flow through the injected Clock
+    # and the deterministic-jitter RetryPolicy — the two-run routing
+    # snapshot test pins it.
+    "k8s_gpu_tpu/serve/frontend.py",
     "k8s_gpu_tpu/utils/alerts.py",
     "k8s_gpu_tpu/utils/federation.py",
     "k8s_gpu_tpu/utils/metrics.py",

@@ -55,6 +55,10 @@ FLEET_PLANE = (
     # The canary prober probes replicas from outside (ISSUE 14): its
     # probe_* families are per-replica by construction.
     "k8s_gpu_tpu/serve/canary.py",
+    # The HTTP front-end (ISSUE 15) is fleet-plane by definition: its
+    # frontend_* in-flight/latency families are per-replica dispatch
+    # bookkeeping, never scraped from inside a replica.
+    "k8s_gpu_tpu/serve/frontend.py",
 )
 
 RESERVED_LABELS = ("name", "replica")
@@ -72,6 +76,7 @@ _DOC_PREFIXES = (
     "tracing_", "circuit_breaker_", "cloud_", "http_", "alerts_",
     "alert_", "faults_", "reconcile_", "metrics_", "tenant_",
     "autoscale_", "inferenceservice_", "gc_", "probe_", "slo_",
+    "frontend_",
 )
 _BACKTICK = re.compile(r"`([^`]+)`")
 

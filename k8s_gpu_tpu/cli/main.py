@@ -1406,6 +1406,55 @@ def cmd_serve(args) -> int:
     return _serve_until(srv, args.for_seconds)
 
 
+def cmd_frontend(args) -> int:
+    """Run the fleet front door: an HTTP gateway owning the
+    prefix-affinity router over live LmServer replicas.  The model
+    asset supplies ONLY the tokenizer — the gateway holds no params;
+    it tokenizes each prompt to compute the same page-aligned chain
+    hashes the replicas' batchers register, routes, and relays."""
+    ctx = _require_login(CliConfig.load())
+    p = LocalPlatform()
+    try:
+        from ..serve.bundle import load_servable
+
+        _, _, tok = load_servable(
+            p.assets, ctx.space, args.model, args.version
+        )
+    except (KeyError, ValueError) as e:
+        print(e.args[0] if e.args else str(e), file=sys.stderr)
+        return 1
+    finally:
+        p.close()
+    if tok is None:
+        print(
+            f"asset {args.model} bundles no tokenizer; the gateway "
+            "needs it to compute routing chain hashes",
+            file=sys.stderr,
+        )
+        return 1
+    replicas = _parse_kv(args.replica, "--replica")
+    if replicas is None:
+        return 2
+    from ..serve import FleetFrontend
+
+    fe = FleetFrontend(
+        tok, port=args.port, page_size=args.page_size
+    ).start()
+    for name, url in replicas.items():
+        try:
+            fe.register_replica(name, url)
+            print(f"replica {name} -> {url}")
+        except (RuntimeError, OSError) as e:
+            # Late replicas join via POST /admin/replicas.
+            print(f"replica {name} not registered: {e}", file=sys.stderr)
+    print(
+        f"fleet frontend on {fe.url}/generate "
+        f"({len(fe.replica_names())} replicas; "
+        "POST /admin/replicas to add more)"
+    )
+    return _serve_until(fe, args.for_seconds)
+
+
 # -- parser ----------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1778,6 +1827,28 @@ def build_parser() -> argparse.ArgumentParser:
     p_srv.add_argument("--for-seconds", type=float, default=0.0,
                        help="exit after N seconds (0 = until interrupted)")
     p_srv.set_defaults(fn=cmd_serve)
+
+    p_fe = sub.add_parser(
+        "frontend",
+        help="fleet HTTP gateway: prefix-affinity routing, retry/rehash "
+             "on replica failure, in-flight-aware drain",
+    )
+    p_fe.add_argument("model",
+                      help="model asset id whose bundled tokenizer the "
+                           "gateway uses for chain hashing (no params "
+                           "are loaded)")
+    p_fe.add_argument("--version", default="", help="'' = latest")
+    p_fe.add_argument("--port", type=int, default=0)
+    p_fe.add_argument("--page-size", type=int, default=64,
+                      help="chain-hash page size; MUST match the "
+                           "replicas' paged page size or affinity "
+                           "routing degrades to load-only")
+    p_fe.add_argument("--replica", action="append", metavar="NAME=URL",
+                      help="replica to register at boot (repeatable); "
+                           "more join later via POST /admin/replicas")
+    p_fe.add_argument("--for-seconds", type=float, default=0.0,
+                      help="exit after N seconds (0 = until interrupted)")
+    p_fe.set_defaults(fn=cmd_frontend)
 
     return ap
 

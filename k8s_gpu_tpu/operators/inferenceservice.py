@@ -77,6 +77,7 @@ class InferenceServiceReconciler(Reconciler):
         clock: Clock | None = None,
         router=None,
         autoscale_params: dict | None = None,
+        frontend=None,
     ):
         """``store``: the AssetStore servable bundles load from (required
         when run_servers).  ``run_servers=False`` reconciles placement
@@ -88,14 +89,32 @@ class InferenceServiceReconciler(Reconciler):
         pod names — scale-down then retires the replica owning the
         fewest warm prefix chains and announces the drain so its hash
         range re-homes first.  ``autoscale_params`` overrides
-        ``FleetAutoscaler`` knobs (cooldown_s, max_step, ...)."""
+        ``FleetAutoscaler`` knobs (cooldown_s, max_step, ...).
+
+        ``frontend``: a ``serve.FleetFrontend`` — the frontend-backed
+        mode.  Replicas register with the gateway as their pods come up
+        (each pod's ``LmServer`` carries its pod name, so the gateway's
+        ``/readyz`` identity check holds) and deregister as they go;
+        the gateway's router IS the victim-choice router (``router=``
+        defaults to ``frontend.router``), and scale-down goes through
+        the gateway's ASYNCHRONOUS in-flight-aware drain: the victim's
+        pod only dies after the gateway reports the drain complete
+        (in-flight zero, or the drain deadline forced it) — replacing
+        the synchronous announce-then-retire of router-only mode."""
         self.kube = kube
         self.store = store
         self.run_servers = run_servers
         self.metrics = metrics or global_metrics
         self.clock = clock or RealClock()
-        self.router = router
+        self.frontend = frontend
+        self.router = router if router is not None else (
+            frontend.router if frontend is not None else None
+        )
         self.autoscale_params = dict(autoscale_params or {})
+        # Pod names whose gateway drain completed (the on_retired
+        # callback lands here from the drain-waiter thread; set ops are
+        # atomic under the GIL) — the next reconcile retires the pod.
+        self._drain_done: set = set()
         self.recorder = EventRecorder(kube, "inferenceservice-controller")
         # (namespace, service, pod) → live LmServer.
         self._servers: dict[tuple, object] = {}
@@ -169,10 +188,15 @@ class InferenceServiceReconciler(Reconciler):
         # Scale down: retire surplus replicas.  Indices need NOT stay
         # contiguous — prefix-aware victim choice may retire a low
         # index and keep higher ones (the kept set is status truth).
+        # In frontend mode some victims may still be DRAINING at the
+        # gateway — they stay up this pass and the reconcile requeues
+        # until the gateway reports their in-flight work done.
+        draining = 0
         if len(pods) > desired:
-            for p in self._scale_down_victims(
+            victims, draining = self._scale_down_victims(
                 svc, pods, len(pods) - desired
-            ):
+            )
+            for p in victims:
                 pods.pop(self._index_of(svc, p.metadata.name), None)
                 self._retire_pod(svc, p)
 
@@ -197,7 +221,16 @@ class InferenceServiceReconciler(Reconciler):
                 # instead of retrying forever with chips held.
                 return self._fail(svc, f"model bundle unusable: {e}")
 
-        return self._update_status(svc, desired, sorted(target), short)
+        res = self._update_status(svc, desired, sorted(target), short)
+        if draining and not res.requeue:
+            # Gateway drains are asynchronous: poll until the drain
+            # waiter lands each victim in _drain_done, then the next
+            # pass retires its pod.
+            wait = 1.0
+            if res.requeue_after is not None:
+                wait = min(wait, res.requeue_after)
+            return Result(requeue_after=wait)
+        return res
 
     def _fail(self, svc: InferenceService, msg: str) -> Result:
         for p in self._owned_pods(svc):
@@ -275,14 +308,21 @@ class InferenceServiceReconciler(Reconciler):
 
     def _scale_down_victims(
         self, svc: InferenceService, pods: dict, n: int
-    ) -> list[Pod]:
-        """The ``n`` surplus replicas to retire.  Default order:
-        highest index first (the historical contract).  With a router
-        attached whose replica names are this service's pod names, the
-        choice is prefix-aware — fewest warm chains first (least cache
-        state lost; ties break on higher index) — and each victim's
-        drain is ANNOUNCED to the router before its pod dies, so new
-        traffic re-homes off its hash range immediately."""
+    ) -> tuple[list[Pod], int]:
+        """The ``n`` surplus replicas chosen for retirement, plus how
+        many of them are still WAITING on a gateway drain.  Default
+        order: highest index first (the historical contract).  With a
+        router attached whose replica names are this service's pod
+        names, the choice is prefix-aware — fewest warm chains first
+        (least cache state lost; ties break on higher index) — and
+        each victim's drain is ANNOUNCED to the router before its pod
+        dies, so new traffic re-homes off its hash range immediately.
+
+        With a frontend attached the drain is asynchronous and
+        in-flight-aware: the gateway stops routing to the victim at
+        once, but its pod only dies after the gateway's drain waiter
+        reports in-flight zero (or forces at the deadline) — the name
+        lands in ``_drain_done`` and the NEXT reconcile retires it."""
         order = sorted(pods.items(), key=lambda kv: -kv[0])
         routed = (
             set(self.router.replica_names())
@@ -297,20 +337,53 @@ class InferenceServiceReconciler(Reconciler):
                     -kv[0],
                 ),
             )
-        victims = [p for _, p in order[:n]]
-        for p in victims:
-            if p.metadata.name in routed:
-                chains = self.router.drain(p.metadata.name)
-                self.recorder.event(
-                    svc, "Normal", "ReplicaDraining",
-                    f"{p.metadata.name} draining ({chains} warm "
-                    "chains re-homing) before retirement",
+        chosen = [p for _, p in order[:n]]
+        if self.frontend is None:
+            for p in chosen:
+                if p.metadata.name in routed:
+                    chains = self.router.drain(p.metadata.name)
+                    self.recorder.event(
+                        svc, "Normal", "ReplicaDraining",
+                        f"{p.metadata.name} draining ({chains} warm "
+                        "chains re-homing) before retirement",
+                    )
+            return chosen, 0
+        victims: list[Pod] = []
+        waiting = 0
+        for p in chosen:
+            name = p.metadata.name
+            if name in self._drain_done:
+                self._drain_done.discard(name)
+                victims.append(p)
+            elif name in self.frontend.replica_names():
+                # Idempotent: re-calling drain() on an in-progress
+                # drain just returns its state.
+                state = self.frontend.drain(
+                    name, on_retired=self._drain_done.add
                 )
-        return victims
+                if state.get("state") == "draining":
+                    self.recorder.event(
+                        svc, "Normal", "ReplicaDraining",
+                        f"{name} draining at gateway "
+                        f"({state.get('inflight', 0)} in flight) "
+                        "before retirement",
+                    )
+                    waiting += 1
+                else:
+                    victims.append(p)
+            else:
+                # Never registered with the gateway — nothing to
+                # drain, retire immediately.
+                victims.append(p)
+        return victims, waiting
 
     def _ensure_server(self, svc: InferenceService, pod: str) -> None:
         key = (svc.metadata.namespace, svc.metadata.name, pod)
         if key in self._servers:
+            # Registration is retried every reconcile: a replica that
+            # failed its readiness gate last pass (still compiling)
+            # joins the gateway as soon as it warms.
+            self._register_frontend(svc, pod)
             return
         from ..serve.server import LmServer
 
@@ -339,6 +412,7 @@ class InferenceServiceReconciler(Reconciler):
             paged_blocks=svc.spec.paged_blocks,
             page_size=svc.spec.paged_page_size,
             metrics=reg,
+            name=pod,
         ).start()
         self._servers[key] = server
         self._registries[key] = reg
@@ -348,6 +422,33 @@ class InferenceServiceReconciler(Reconciler):
         self.recorder.event(
             svc, "Normal", "ReplicaServing",
             f"{pod} listening on 127.0.0.1:{server.port}",
+        )
+        self._register_frontend(svc, pod)
+
+    def _register_frontend(self, svc: InferenceService, pod: str) -> None:
+        """Register ``pod``'s server with the gateway (frontend mode
+        only).  The gateway gates on the replica's /readyz and warms a
+        cold server itself; a replica that is not warmable yet raises
+        RuntimeError, which is swallowed — the next reconcile retries."""
+        if self.frontend is None or pod in self.frontend.replica_names():
+            return
+        key = (svc.metadata.namespace, svc.metadata.name, pod)
+        server = self._servers.get(key)
+        reg = self._registries.get(key)
+        if server is None:
+            return
+        try:
+            self.frontend.register_replica(
+                pod, f"http://127.0.0.1:{server.port}",
+                metrics_target=reg.render if reg is not None else None,
+                on_drain=server.drain,
+            )
+        except (RuntimeError, OSError) as e:
+            log.info("gateway registration of %s deferred: %s", pod, e)
+            return
+        self.recorder.event(
+            svc, "Normal", "ReplicaRegistered",
+            f"{pod} registered with fleet frontend at {self.frontend.url}",
         )
 
     def _stop_server(self, svc: InferenceService, pod: str) -> None:
@@ -363,6 +464,9 @@ class InferenceServiceReconciler(Reconciler):
         st = self._fleet.get(key[:2])
         if st is not None:
             st["collector"].remove_target(pod)
+        if self.frontend is not None:
+            self.frontend.retire_replica(pod)
+            self._drain_done.discard(pod)
         if self.router is not None and pod in self.router.replica_names():
             self.router.remove_replica(pod)
 

@@ -7,6 +7,7 @@ from .canary import CanaryProber
 from .constrain import RegexConstraint, compile_constraint
 from .disagg import DisaggregatedLm
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
+from .frontend import FleetFrontend
 from .journal import PROBE_TENANT, RequestJournal, RequestRecord
 from .jsonschema import SchemaError, schema_to_regex
 from .quant import quantize_params
@@ -24,7 +25,7 @@ __all__ = [
     "InferenceEngine", "SamplingConfig", "DecodeOutput", "LmServer",
     "ContinuousBatcher", "Overloaded", "RequestHandle",
     "RequestJournal", "RequestRecord",
-    "CanaryProber", "PROBE_TENANT",
+    "CanaryProber", "PROBE_TENANT", "FleetFrontend",
     "FleetRouter", "RouteDecision", "FleetAutoscaler", "ScaleDecision",
     "router_rule_pack",
     "quantize_params", "export_servable", "load_servable",

@@ -60,7 +60,7 @@ from .engine import (
     InferenceEngine, _empty_cache, _empty_cache_paged, nucleus_mask,
 )
 from .journal import PROBE_TENANT, RequestJournal, RequestRecord
-from .kv_blocks import BlockPool, chunk_hashes
+from .kv_blocks import BlockPool, chunk_hashes, shareable_depth
 from .speculative import reject_row
 
 log = logging.getLogger("k8s_gpu_tpu.serve")
@@ -866,14 +866,16 @@ class ContinuousBatcher:
             req.prefix_tokens = None
             return True
         # Automatic block-granular prefix sharing: acquire the longest
-        # chain of cached full prompt pages (capped so at least one
-        # suffix token remains — the extend must produce first-token
-        # logits), then allocate the private tail.  Acquire BEFORE
-        # alloc: the fresh allocation may evict LRU blocks, and a
-        # refcount pins the matched prefix against that eviction.
+        # chain of cached full prompt pages (capped by
+        # kv_blocks.shareable_depth — at least one suffix token must
+        # remain so the extend produces first-token logits; the router
+        # and the HTTP front-end key on the same cap), then allocate
+        # the private tail.  Acquire BEFORE alloc: the fresh allocation
+        # may evict LRU blocks, and a refcount pins the matched prefix
+        # against that eviction.
         hashes = chunk_hashes(req.ids, page)
         shared: list[int] = []
-        for h in hashes[: (n - 1) // page]:
+        for h in hashes[: shareable_depth(n, page)]:
             blk = self._pool.acquire(h)
             if blk is None:
                 break
@@ -1775,6 +1777,16 @@ class ContinuousBatcher:
         (operators/inferenceservice.py) and the same quantity the
         'serve_pending_requests' gauge reports."""
         return self._pending.qsize()
+
+    @property
+    def inflight_requests(self) -> int:
+        """Live request count: queued-but-unadmitted plus admitted rows
+        still decoding.  The drain signal — a front-end retiring this
+        replica waits for zero (serve/frontend.py; /readyz carries it
+        so the wait needs no metrics scrape).  Benign racy read of the
+        slot list, like the gauge export's."""
+        active = sum(1 for r in self._active if r is not None)
+        return self._pending.qsize() + active
 
     @property
     def scheduler_alive(self) -> bool:

@@ -1,0 +1,1194 @@
+"""Cross-process fleet front-end: the one door over N ``LmServer``s.
+
+``FleetRouter`` (serve/router.py) made placement a policy; until now the
+policy ran inside whatever process also owned the replicas.  This module
+is the missing half of ROADMAP item 1 — a standalone ``FleetFrontend``
+HTTP process that owns a ``FleetRouter`` + ``FleetCollector`` +
+``CanaryProber`` over *remote* ``LmServer`` base URLs and speaks the
+same ``POST /generate`` contract to clients, so replicas can come, go,
+and die without the client-visible endpoint moving (the FlexNPU /
+VirtualFlow decoupling: dispatch outlives any worker).
+
+Per request the gateway tokenizes the prompt and routes on the
+page-aligned chain hashes (``kv_blocks.shareable_chain`` through
+``FleetRouter.route`` — the SAME helper the batcher's paged admission
+keys on, so gateway routing and replica block caches can never skew),
+then forwards downstream with the ``x-route-replica`` /
+``x-route-reason`` stamp plus tenant / deadline / traceparent
+propagation.  Failure handling reuses ``cloud/resilience.py`` — a
+``BreakerBank`` gates contact per replica and a ``RetryPolicy`` paces
+re-dispatch with deterministic jitter — not a new retry stack:
+
+==================  =========================================  ==========
+downstream outcome  gateway action                             client sees
+==================  =========================================  ==========
+connect error /     ``record_failure`` + ``mark_down`` +       200 from a
+timeout / 5xx       ``serve_router_rehash_total``; re-route    survivor
+429 Retry-After     retry elsewhere WITHOUT marking down       200, or the
+                    (full is load, not death); last shed       last 429 +
+                    passes through verbatim                    Retry-After
+other 4xx           a REQUEST fault — identical on every       that 4xx
+                    replica; passes through immediately
+504                 the request's own deadline died downstream 504
+                    — retrying would duplicate work it can
+                    no longer use
+no eligible         503 + Retry-After,                         503
+replica             ``frontend_shed_total{reason=no_replica}``
+==================  =========================================  ==========
+
+Replica lifecycle is dynamic: ``POST /admin/replicas`` registers an
+endpoint (gated on its ``/readyz`` — an unwarmed-but-alive replica is
+warmed with one real ``/generate`` first, which doubles as an
+end-to-end smoke test of the URL), ``DELETE`` retires it, and
+``POST /admin/drain`` starts an ASYNCHRONOUS in-flight-aware drain:
+``drain(name)`` stops new traffic immediately (``FleetRouter.drain``),
+but the victim is only retired once its in-flight count reaches zero —
+read gateway-locally first, then from the replica's ``/readyz``
+``inflight`` field (the scrape-free fast path ``LmServer`` exports),
+then from the federated ``serve_pending_requests`` /
+``serve_slots_active`` gauges — or a deadline forces it
+(``frontend_drains_total{outcome=forced}``).
+
+Canary probes flow THROUGH the front-end: each replica's probe target
+is the gateway's own ``POST /replica/<name>/generate`` pinned-dispatch
+path, so the black-box health verdict covers the real client path
+(gateway handling, header propagation, downstream HTTP) — and a
+successful pinned contact is also the recovery path that ``mark_up``s
+a replica the dispatch loop had marked down.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import math
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..cloud.resilience import BreakerBank, RetryPolicy
+from ..utils.clock import Clock, RealClock
+from ..utils.federation import FleetCollector
+from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.obs import RequestMetricsMixin
+from ..utils.tracing import format_traceparent
+from .canary import CanaryProber
+from .journal import RequestJournal
+from .journal import RequestRecord as JournalRecord
+from .router import FleetRouter
+
+log = logging.getLogger("k8s_gpu_tpu.frontend")
+
+# Advisory client backoff on gateway-minted 503s (matches LmServer's).
+RETRY_AFTER_S = 1
+
+
+class FleetFrontend:
+    """The gateway process (module docstring for the model).  ``port=0``
+    binds ephemeral; ``.port`` is the bound one.  All collaborators are
+    injectable and default to privately-owned instances on the shared
+    ``clock`` — one time domain across router staleness, breaker reset,
+    probe pacing, and drain deadlines, which is what makes the whole
+    plane replayable under ``FakeClock``."""
+
+    # Lock contract (graftcheck lockcheck): the replica URL map, the
+    # gateway-local in-flight counters, and the drain state table are
+    # shared between request handler threads, admin handlers, and the
+    # per-drain waiter threads.
+    _GUARDED_BY = {
+        "_lock": ("_replicas", "_inflight", "_drains"),
+    }
+
+    def __init__(
+        self,
+        tokenizer,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        page_size: int = 64,
+        clock: Clock | None = None,
+        metrics: MetricsRegistry | None = None,
+        collector: FleetCollector | None = None,
+        router: FleetRouter | None = None,
+        prober: CanaryProber | None = None,
+        retry_policy: RetryPolicy | None = None,
+        breakers: BreakerBank | None = None,
+        request_timeout_s: float = 30.0,
+        drain_deadline_s: float = 30.0,
+        drain_poll_s: float = 0.05,
+        max_journal: int = 512,
+    ):
+        """``page_size`` must match the replicas' paged-KV page size —
+        it is the router's chain-hash chunking, and the whole affinity
+        win rides the gateway's chain equalling the block cache's.
+        ``retry_policy`` / ``breakers`` are the ``cloud/resilience.py``
+        primitives; the defaults are tuned for a serving hop (tens of
+        milliseconds of backoff, a short breaker reset so canary
+        recovery probes half-open quickly), not a cloud API."""
+        self.tokenizer = tokenizer
+        self.clock = clock or RealClock()
+        self.metrics = metrics if metrics is not None else global_metrics
+        self.collector = collector or FleetCollector({}, clock=self.clock)
+        # Mirror ContinuousBatcher's page-size floor: a replica given
+        # page_size < 8 runs at 8, so the gateway must hash at 8 too or
+        # every chain silently skews (test_frontend pins the equality).
+        page_size = max(8, int(page_size))
+        self.router = router or FleetRouter(
+            page_size=page_size, collector=self.collector,
+            metrics=self.metrics, clock=self.clock,
+        )
+        self.policy = retry_policy or RetryPolicy(
+            max_attempts=3, budget=16,
+            base_delay=0.02, max_delay=0.25, jitter=0.5,
+        )
+        self.breakers = breakers or BreakerBank(
+            clock=self.clock, name="frontend",
+            failure_threshold=3, reset_timeout=5.0,
+            registry=self.metrics,
+        )
+        self.request_timeout_s = float(request_timeout_s)
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.drain_poll_s = max(0.005, float(drain_poll_s))
+        # The gateway's own request journal: one record per CLIENT
+        # request with the final outcome and the routing evidence —
+        # the zero-lost audit surface (/debug/requests).
+        self.journal = RequestJournal(maxlen=max_journal)
+        self._lock = threading.Lock()
+        self._replicas: dict[str, str] = {}     # name -> base URL
+        self._inflight: dict[str, int] = {}     # name -> gateway-local
+        self._drains: dict[str, dict] = {}      # name -> drain state
+        self._stop = threading.Event()
+        self._drain_threads: list[threading.Thread] = []
+        outer = self
+
+        class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
+            metrics_server_label = "fleet-frontend"
+            known_routes = (
+                "/generate", "/replica", "/admin/replicas",
+                "/admin/drain", "/healthz", "/readyz", "/metrics",
+                "/debug/requests",
+            )
+
+            def _get(self):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    with outer._lock:
+                        n = len(outer._replicas)
+                        d = sum(
+                            1 for s in outer._drains.values()
+                            if s["state"] == "draining"
+                        )
+                    return self._json(200, {
+                        "ok": True, "replicas": n, "draining": d,
+                    })
+                if path == "/readyz":
+                    snap = outer.router.snapshot()
+                    eligible = [
+                        r["replica"] for r in snap["replicas"]
+                        if not (r["draining"] or r["down"]
+                                or r["unhealthy"])
+                    ]
+                    return self._json(
+                        200 if eligible else 503,
+                        {
+                            "ready": bool(eligible),
+                            "replicas": len(snap["replicas"]),
+                            "eligible": len(eligible),
+                        },
+                    )
+                if path == "/metrics":
+                    body = outer.metrics.render().encode()
+                    self._last_code = 200
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/admin/replicas":
+                    return self._json(
+                        200, {"replicas": outer.replica_states()}
+                    )
+                if path == "/admin/drain":
+                    return self._json(
+                        200, {"drains": outer.drain_states()}
+                    )
+                if path == "/debug/requests":
+                    one = self._query()
+                    try:
+                        limit = int(one("limit", "100"))
+                    except ValueError:
+                        return self._json(
+                            400, {"error": "limit must be an int"}
+                        )
+                    return self._json(200, {
+                        "requests": outer.journal.snapshot(
+                            limit=limit,
+                            tenant=one("tenant"),
+                            reason=one("reason"),
+                            trace_id=one("trace_id"),
+                            probes=one("probes", "1") != "0",
+                        ),
+                    })
+                return self._json(404, {"error": "not found"})
+
+            def _post(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return self._json(400, {"error": "invalid JSON body"})
+                if not isinstance(body, dict):
+                    return self._json(
+                        400, {"error": "body must be an object"}
+                    )
+                path = self.path.split("?")[0]
+                if path == "/generate":
+                    return self._generate(body, pinned=None)
+                if path.startswith("/replica/"):
+                    # Pinned dispatch: POST /replica/<name>/generate
+                    # bypasses routing and contacts exactly that
+                    # replica — the canary's per-replica probe path,
+                    # and the recovery path for a marked-down one.
+                    parts = path.split("/")
+                    if len(parts) == 4 and parts[3] == "generate":
+                        return self._generate(body, pinned=parts[2])
+                    return self._json(404, {"error": "not found"})
+                if path == "/admin/replicas":
+                    return self._register(body)
+                if path == "/admin/drain":
+                    return self._drain(body)
+                return self._json(404, {"error": "not found"})
+
+            def _delete(self):
+                path = self.path.split("?")[0]
+                if path != "/admin/replicas":
+                    return self._json(404, {"error": "not found"})
+                name = self._query()("name")
+                if not name:
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        name = body.get("name", "")
+                    except (ValueError, json.JSONDecodeError,
+                            AttributeError):
+                        name = ""
+                if not name:
+                    return self._json(
+                        400, {"error": "name (query or body) required"}
+                    )
+                if outer.retire_replica(name):
+                    return self._json(200, {"retired": name})
+                return self._json(
+                    404, {"error": f"unknown replica {name!r}"}
+                )
+
+            def do_DELETE(self):  # noqa: N802 (stdlib API name)
+                self._timed("DELETE", self._delete)
+
+            # -- admin bodies ---------------------------------------------
+            def _register(self, body):
+                name = body.get("name", "")
+                url = body.get("url", "")
+                if not isinstance(name, str) or not name.strip():
+                    return self._json(
+                        400, {"error": "name (string) required"}
+                    )
+                if not isinstance(url, str) or not url.strip():
+                    return self._json(
+                        400, {"error": "url (string) required"}
+                    )
+                try:
+                    r = outer.register_replica(
+                        name.strip(), url.strip(),
+                        metrics_target=body.get("metrics_url") or None,
+                    )
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                except RuntimeError as e:
+                    # The /readyz gate failed: the caller retries once
+                    # the replica is actually servable.
+                    return self._json(
+                        503, {"error": str(e)},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+                return self._json(200, {
+                    "registered": name.strip(),
+                    "replicas": len(outer.replica_names()),
+                    "readiness": r,
+                })
+
+            def _drain(self, body):
+                name = body.get("name", "")
+                if not isinstance(name, str) or not name.strip():
+                    return self._json(
+                        400, {"error": "name (string) required"}
+                    )
+                deadline_s = body.get("deadline_s")
+                try:
+                    st = outer.drain(
+                        name.strip(),
+                        deadline_s=(
+                            float(deadline_s)
+                            if deadline_s is not None else None
+                        ),
+                    )
+                except KeyError:
+                    return self._json(
+                        404, {"error": f"unknown replica {name!r}"}
+                    )
+                except (TypeError, ValueError):
+                    return self._json(
+                        400, {"error": "deadline_s must be a number"}
+                    )
+                return self._json(202, {"draining": name.strip(), **st})
+
+            # -- /generate ------------------------------------------------
+            def _generate(self, body, pinned):
+                prompt = body.get("prompt", "")
+                if not isinstance(prompt, str) or not prompt:
+                    return self._json(
+                        400, {"error": "prompt (string) required"}
+                    )
+                tenant = body.get("tenant")
+                if tenant is None:
+                    tenant = self.headers.get("x-tenant") or ""
+                if not isinstance(tenant, str):
+                    return self._json(
+                        400, {"error": "tenant must be a string"}
+                    )
+                tenant = tenant.strip()[:64] or "default"
+                # The deadline budget is validated HERE (same contract
+                # as LmServer) and re-propagated downstream as the
+                # REMAINING budget, so time spent routing and retrying
+                # counts against the client's budget, not on top of it.
+                deadline = None
+                budget_ms = self.headers.get("x-request-deadline-ms")
+                if budget_ms is not None:
+                    try:
+                        budget_ms = float(budget_ms)
+                    except (TypeError, ValueError):
+                        budget_ms = None
+                    if budget_ms is None or not math.isfinite(budget_ms):
+                        return self._json(400, {
+                            "error": "x-request-deadline-ms must be a "
+                                     "finite number"
+                        })
+                    if budget_ms <= 0:
+                        outer.metrics.inc(
+                            "frontend_shed_total", reason="deadline"
+                        )
+                        outer._journal(
+                            tenant=tenant, trace_ctx=self.trace_ctx,
+                            reason="deadline", code=504,
+                            t0=outer.clock.now(),
+                        )
+                        return self._json(
+                            504, {"error": "deadline exceeded"}
+                        )
+                    deadline = outer.clock.now() + budget_ms / 1000.0
+                ids = outer.tokenizer.encode(prompt)
+                out = outer.dispatch(
+                    ids, body, tenant=tenant, deadline=deadline,
+                    trace_ctx=self.trace_ctx,
+                    stream=bool(body.get("stream", False)),
+                    pinned=pinned,
+                )
+                if out["kind"] == "stream":
+                    return self._relay(out)
+                hdrs = dict(out.get("headers") or {})
+                if out.get("replica"):
+                    hdrs["x-route-replica"] = out["replica"]
+                    hdrs["x-route-reason"] = out["reason"]
+                return self._json(out["code"], out["payload"], hdrs)
+
+            def _relay(self, out):
+                """Relay a downstream ndjson stream event-by-event.  A
+                mid-stream downstream death cannot be retried (tokens
+                already reached the client) — the relay just ends, and
+                the client's summary-event protocol tells it the stream
+                was truncated."""
+                resp = out["resp"]
+                self._last_code = 200
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    resp.headers.get(
+                        "Content-Type", "application/x-ndjson"
+                    ),
+                )
+                self.send_header("X-Accel-Buffering", "no")
+                self.send_header("x-route-replica", out["replica"])
+                self.send_header("x-route-reason", out["reason"])
+                self.end_headers()
+                events = 0
+                try:
+                    while True:
+                        line = resp.readline()
+                        if not line:
+                            break
+                        events += 1
+                        self.wfile.write(line)
+                        self.wfile.flush()
+                except (OSError, http.client.HTTPException):
+                    pass
+                finally:
+                    try:
+                        resp.close()
+                    except OSError:
+                        pass
+                    out["finish"](max(0, events - 1))
+
+            def _query(self):
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+
+                def one(key, default=""):
+                    v = q.get(key, [default])
+                    return v[0] if v else default
+
+                return one
+
+            def _json(self, code: int, payload: dict,
+                      headers: dict | None = None) -> None:
+                self._last_code = code
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-frontend",
+            daemon=True,
+        )
+        # The prober is built LAST so it can target the bound port:
+        # probes go through the gateway's pinned-dispatch path, making
+        # the black-box health verdict cover the real client path.
+        self.prober = prober if prober is not None else CanaryProber(
+            clock=self.clock, metrics=self.metrics, router=self.router,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FleetFrontend":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.prober.stop()
+        except Exception:
+            pass
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)
+        for t in list(self._drain_threads):
+            t.join(timeout=2)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- replica lifecycle -------------------------------------------------
+    def register_replica(
+        self,
+        name: str,
+        url: str,
+        *,
+        metrics_target=None,
+        on_drain=None,
+        warm: bool = True,
+    ) -> dict:
+        """Admit a replica behind the gateway, gated on its ``/readyz``:
+        unreachable or draining raises RuntimeError; alive-but-unwarmed
+        (``scheduler_alive`` and not ``draining`` but the first compile
+        hasn't happened) is warmed with one real 1-token ``/generate``
+        when ``warm`` — which is also an end-to-end smoke test that the
+        URL serves — then re-gated.  ``metrics_target`` (a URL serving
+        ``/metrics`` or a zero-arg callable returning an exposition) is
+        federated for load-aware routing; without one the replica routes
+        on affinity alone.  ``on_drain`` is forwarded to the router so a
+        drain announcement can flip an in-process replica's own
+        ``/readyz`` (``LmServer.drain``).  Returns the readiness body."""
+        name = str(name).strip()[:64]
+        if not name:
+            raise ValueError("replica name required")
+        url = str(url).rstrip("/")
+        r = self._readyz(url)
+        if r is None:
+            raise RuntimeError(
+                f"replica {name!r} at {url} is unreachable"
+            )
+        if not r.get("ready", False):
+            if warm and r.get("scheduler_alive") and not r.get("draining"):
+                self._warm(url)
+                r = self._readyz(url)
+            if r is None or not r.get("ready", False):
+                raise RuntimeError(
+                    f"replica {name!r} at {url} is not ready: "
+                    f"{json.dumps(r, sort_keys=True)}"
+                )
+        claimed = r.get("replica", "")
+        if claimed and claimed != name:
+            raise RuntimeError(
+                f"replica at {url} calls itself {claimed!r}; "
+                f"refusing to register it as {name!r}"
+            )
+        with self._lock:
+            self._replicas[name] = url
+            self._inflight.setdefault(name, 0)
+            self._drains.pop(name, None)
+            count = len(self._replicas)
+        self.router.add_replica(name, submit=None, on_drain=on_drain)
+        # A re-registered replica starts with a clean slate: the breaker
+        # memory of its previous life would otherwise short-circuit the
+        # first contacts of the new one.
+        self.breakers.get(name).record_success()
+        if metrics_target is not None:
+            self.collector.add_target(name, metrics_target)
+        self.prober.add_target(name, f"{self.url}/replica/{name}")
+        self.metrics.set_gauge("frontend_replicas", float(count))
+        self.metrics.set_gauge(
+            "frontend_inflight_requests", 0.0, replica=name
+        )
+        return r
+
+    def retire_replica(self, name: str) -> bool:
+        """Remove a replica from every plane (router, federation,
+        prober, dispatch) immediately — the synchronous half a finished
+        or forced drain calls, and the ``DELETE /admin/replicas``
+        behavior for an already-dead endpoint."""
+        with self._lock:
+            url = self._replicas.pop(name, None)
+            self._inflight.pop(name, None)
+            count = len(self._replicas)
+        if url is None:
+            return False
+        self.router.remove_replica(name)
+        self.collector.remove_target(name)
+        self.prober.remove_target(name)
+        self.metrics.set_gauge("frontend_replicas", float(count))
+        self.metrics.remove_gauge(
+            "frontend_inflight_requests", replica=name
+        )
+        return True
+
+    def replica_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def replica_states(self) -> list[dict]:
+        """The ``GET /admin/replicas`` body: router flags joined with
+        the gateway's own URL / in-flight / drain bookkeeping."""
+        snap = {
+            r["replica"]: r for r in self.router.snapshot()["replicas"]
+        }
+        with self._lock:
+            names = sorted(self._replicas)
+            out = []
+            for name in names:
+                st = dict(snap.get(name) or {"replica": name})
+                st["url"] = self._replicas[name]
+                st["inflight_gateway"] = self._inflight.get(name, 0)
+                d = self._drains.get(name)
+                if d is not None:
+                    st["drain"] = d["state"]
+                out.append(st)
+        return out
+
+    # -- drain -------------------------------------------------------------
+    def drain(
+        self, name: str, deadline_s: float | None = None,
+        on_retired=None,
+    ) -> dict:
+        """Asynchronous in-flight-aware drain: new traffic stops NOW
+        (``FleetRouter.drain`` — the victim's hash range re-homes on
+        next touch), but the replica is only retired once its in-flight
+        count reaches zero (``_replica_inflight``'s three-step read) or
+        ``deadline_s`` forces it.  Idempotent per replica; returns the
+        drain state.  ``on_retired(name)`` fires after retirement — the
+        operator's signal that the pod behind the replica may die."""
+        deadline_s = (
+            self.drain_deadline_s if deadline_s is None
+            else float(deadline_s)
+        )
+        with self._lock:
+            if name not in self._replicas:
+                raise KeyError(name)
+            st = self._drains.get(name)
+            if st is not None:
+                return dict(st)
+            st = {
+                "replica": name,
+                "state": "draining",
+                "forced": False,
+                "deadline_s": deadline_s,
+                "inflight": self._inflight.get(name, 0),
+            }
+            self._drains[name] = st
+        self.router.drain(name)
+        t = threading.Thread(
+            target=self._drain_worker,
+            args=(name, self.clock.now() + deadline_s, on_retired),
+            name=f"frontend-drain-{name}", daemon=True,
+        )
+        self._drain_threads.append(t)
+        t.start()
+        return dict(st)
+
+    def drain_states(self) -> list[dict]:
+        with self._lock:
+            return [
+                dict(self._drains[name])
+                for name in sorted(self._drains)
+            ]
+
+    def _drain_worker(self, name, deadline, on_retired) -> None:
+        """Waits for the victim's in-flight work, then retires it.  The
+        wait paces on the stop event (so ``stop()`` interrupts it) but
+        judges the deadline on the injected clock."""
+        t0 = self.clock.now()
+        forced = False
+        while not self._stop.is_set():
+            if self._replica_inflight(name) <= 0:
+                break
+            if self.clock.now() >= deadline:
+                forced = True
+                break
+            self._stop.wait(self.drain_poll_s)
+        if self._stop.is_set():
+            return
+        waited = self.clock.now() - t0
+        self.metrics.observe("frontend_drain_wait_seconds", waited)
+        self.metrics.inc(
+            "frontend_drains_total",
+            outcome="forced" if forced else "graceful",
+        )
+        with self._lock:
+            st = self._drains.get(name)
+            if st is not None:
+                st["state"] = "retired"
+                st["forced"] = forced
+                st["waited_s"] = round(waited, 4)
+        self.retire_replica(name)
+        if on_retired is not None:
+            try:
+                on_retired(name)
+            except Exception:
+                log.exception("on_retired hook failed for %s", name)
+
+    def _replica_inflight(self, name: str) -> int:
+        """The drain signal, cheapest source first: (1) the gateway's
+        own outstanding-dispatch count (authoritative for traffic that
+        came through this door), (2) the replica's ``/readyz``
+        ``inflight`` field — the scrape-free fast path, served even
+        while the body says NotReady, (3) the federated
+        ``serve_pending_requests`` + ``serve_slots_active`` gauges.
+        All three unobservable means the replica is dead or mute —
+        nothing left to wait for."""
+        with self._lock:
+            local = self._inflight.get(name, 0)
+            url = self._replicas.get(name)
+        if local > 0:
+            return local
+        if url is not None:
+            got = self._readyz(url)
+            if got is not None and "inflight" in got:
+                try:
+                    return int(got["inflight"])
+                except (TypeError, ValueError):
+                    pass
+        reg = self.collector.registry
+        pend = reg.gauge("serve_pending_requests", replica=name)
+        act = reg.gauge("serve_slots_active", replica=name)
+        if pend is None and act is None:
+            return 0
+        return int((pend or 0.0) + (act or 0.0))
+
+    # -- downstream I/O ----------------------------------------------------
+    def _readyz(self, url: str) -> dict | None:
+        """GET {url}/readyz — the body parses the same whether the
+        verdict was 200 or 503 (a draining replica still reports its
+        in-flight count there).  None means unreachable."""
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                url + "/readyz", timeout=self.request_timeout_s
+            ) as r:
+                return json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read().decode() or "{}")
+            except (ValueError, OSError):
+                return None
+            finally:
+                e.close()
+        except (OSError, http.client.HTTPException, ValueError):
+            return None
+
+    def _warm(self, url: str) -> None:
+        """One real 1-token ``/generate`` against a fresh replica: the
+        first compile happens HERE, at registration, instead of inside
+        the first client's latency budget."""
+        import urllib.request
+
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps({
+                "prompt": self.prober.prompt_text,
+                "max_new_tokens": 1,
+                "temperature": 0.0,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.request_timeout_s
+            ) as r:
+                r.read()
+        except (OSError, http.client.HTTPException):
+            pass  # the re-gated /readyz delivers the verdict
+
+    def _forward(self, url, body, headers, timeout, stream):
+        """One downstream POST {url}/generate attempt, classified:
+        ("ok", code, payload) | ("stream", resp) |
+        ("shed", payload, retry_after) | ("reject", code, payload) |
+        ("deadline", payload) | ("fail", detail)."""
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            url + "/generate", data=json.dumps(body).encode(),
+            headers=headers, method="POST",
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            code = e.code
+            try:
+                payload = json.loads(e.read().decode() or "{}")
+            except (ValueError, OSError):
+                payload = {"error": f"upstream status {code}"}
+            retry_after = e.headers.get("Retry-After") if e.headers else None
+            e.close()
+            if code == 429:
+                return ("shed", payload, retry_after)
+            if code == 504:
+                return ("deadline", payload)
+            if 400 <= code < 500:
+                return ("reject", code, payload)
+            return ("fail", f"upstream status {code}")
+        except (OSError, http.client.HTTPException) as e:
+            return ("fail", type(e).__name__)
+        if stream:
+            return ("stream", resp)
+        try:
+            payload = json.loads(resp.read().decode() or "{}")
+            code = resp.status
+        except (ValueError, OSError):
+            return ("fail", "unparseable upstream body")
+        finally:
+            resp.close()
+        return ("ok", code, payload)
+
+    def _headers_for(self, replica, reason, tenant, deadline, trace_ctx):
+        """The dispatch stamp: the routing decision
+        (``x-route-replica``/``x-route-reason`` — the downstream
+        journal's placement evidence), the tenant, the REMAINING
+        deadline budget, and the gateway span's traceparent so the
+        downstream trace joins the client's."""
+        h = {
+            "Content-Type": "application/json",
+            "x-tenant": tenant,
+            "x-route-replica": replica[:64],
+            "x-route-reason": reason[:16],
+        }
+        if deadline is not None:
+            remaining_ms = (deadline - self.clock.now()) * 1000.0
+            h["x-request-deadline-ms"] = str(max(1, int(remaining_ms)))
+        if trace_ctx is not None:
+            h["traceparent"] = format_traceparent(trace_ctx)
+        return h
+
+    def _track(self, name: str, delta: int) -> int:
+        with self._lock:
+            if name not in self._inflight:
+                return 0
+            cur = max(0, self._inflight[name] + delta)
+            self._inflight[name] = cur
+        self.metrics.set_gauge(
+            "frontend_inflight_requests", float(cur), replica=name
+        )
+        return cur
+
+    def _url_of(self, name: str) -> str | None:
+        with self._lock:
+            return self._replicas.get(name)
+
+    def _journal(
+        self, *, tenant, trace_ctx, reason, code, t0,
+        replica="", route_reason="", prompt_tokens=0, tokens=0,
+        attempts=1, extra=None,
+    ) -> None:
+        e = {"status": int(code), "attempts": int(attempts)}
+        e.update(extra or {})
+        self.journal.append(JournalRecord(
+            tenant=tenant,
+            trace_id=trace_ctx.trace_id if trace_ctx else "",
+            reason=reason,
+            path="gateway",
+            replica=replica,
+            route_reason=route_reason,
+            prompt_tokens=int(prompt_tokens),
+            tokens=int(tokens),
+            deadline_expired=(reason == "deadline"),
+            t_submit=t0,
+            t_done=self.clock.now(),
+            extra=e,
+        ))
+
+    # -- dispatch ----------------------------------------------------------
+    def dispatch(
+        self, ids, body, *, tenant, deadline=None, trace_ctx=None,
+        stream=False, pinned=None,
+    ) -> dict:
+        """Route → breaker-gate → forward → classify, retrying per the
+        failure matrix (module docstring).  Returns a response outcome
+        for the handler: {"kind": "json", code, payload, headers,
+        replica, reason} or {"kind": "stream", resp, replica, reason,
+        finish}.  ``pinned`` skips routing and contacts exactly that
+        replica — no rehash, a pinned failure IS the answer (the canary
+        contract: a dead replica must fail its probe, not silently
+        succeed elsewhere)."""
+        t0 = self.clock.now()
+        body = dict(body)
+        body["tenant"] = tenant
+        if pinned is not None:
+            return self._dispatch_pinned(
+                pinned, ids, body, tenant, deadline, trace_ctx,
+                stream, t0,
+            )
+        max_tries = max(1, len(self.router.replica_names()))
+        budget = self.policy.budget
+        tried: set[str] = set()
+        shed = None           # (payload, retry_after) of the last 429
+        last_fail = ""
+        contacts = 0
+        attempt = 0
+        while attempt < max_tries:
+            if deadline is not None and self.clock.now() >= deadline:
+                return self._shed_out(
+                    "deadline", 504, {"error": "deadline exceeded"},
+                    tenant, trace_ctx, t0, contacts,
+                )
+            try:
+                dec = self.router.route(ids, exclude=tried)
+            except RuntimeError:
+                break
+            replica, reason = dec.replica, dec.reason
+            br = self.breakers.get(replica)
+            if not br.allow():
+                # Open breaker: known-bad, don't even contact — spend
+                # the attempt on the next candidate.
+                tried.add(replica)
+                attempt += 1
+                continue
+            url = self._url_of(replica)
+            if url is None:
+                # Retired between route and contact.
+                br.release()
+                tried.add(replica)
+                attempt += 1
+                continue
+            if contacts > 0:
+                self.metrics.inc("frontend_retries_total")
+            contacts += 1
+            headers = self._headers_for(
+                replica, reason, tenant, deadline, trace_ctx
+            )
+            timeout = self.request_timeout_s
+            if deadline is not None:
+                timeout = max(
+                    0.001, min(timeout, deadline - self.clock.now())
+                )
+            self._track(replica, +1)
+            t_at = self.clock.now()
+            out = self._forward(url, body, headers, timeout, stream)
+            kind = out[0]
+            if kind != "stream":
+                self._track(replica, -1)
+                self.metrics.observe(
+                    "frontend_upstream_seconds",
+                    self.clock.now() - t_at, replica=replica,
+                )
+            if kind == "ok":
+                br.record_success()
+                self.router.mark_up(replica)
+                code, payload = out[1], out[2]
+                self._journal(
+                    tenant=tenant, trace_ctx=trace_ctx, reason="ok",
+                    code=code, t0=t0, replica=replica,
+                    route_reason=reason, prompt_tokens=len(ids),
+                    tokens=int(payload.get("generated_tokens", 0) or 0),
+                    attempts=contacts,
+                )
+                return {
+                    "kind": "json", "code": code, "payload": payload,
+                    "headers": {}, "replica": replica, "reason": reason,
+                }
+            if kind == "stream":
+                br.record_success()
+                self.router.mark_up(replica)
+                resp = out[1]
+                n_prompt = len(ids)
+
+                def finish(tokens, _r=replica, _reason=reason,
+                           _t_at=t_at, _n=n_prompt, _c=contacts):
+                    self._track(_r, -1)
+                    self.metrics.observe(
+                        "frontend_upstream_seconds",
+                        self.clock.now() - _t_at, replica=_r,
+                    )
+                    self._journal(
+                        tenant=tenant, trace_ctx=trace_ctx,
+                        reason="ok", code=200, t0=t0, replica=_r,
+                        route_reason=_reason, prompt_tokens=_n,
+                        tokens=tokens, attempts=_c,
+                        extra={"stream": True},
+                    )
+
+                return {
+                    "kind": "stream", "resp": resp, "replica": replica,
+                    "reason": reason, "finish": finish,
+                }
+            if kind == "shed":
+                # 429: the replica is alive and telling us it is full —
+                # a load signal, never a death.  Retry elsewhere; if the
+                # whole fleet sheds, the LAST 429 (and its Retry-After)
+                # passes through verbatim.
+                br.record_success()
+                shed = (out[1], out[2])
+                tried.add(replica)
+                self.metrics.inc("serve_router_rehash_total")
+                attempt += 1
+                continue
+            if kind == "reject":
+                # A request fault (bad adapter, prompt too long): it
+                # would fail identically on every replica.
+                br.record_success()
+                code, payload = out[1], out[2]
+                self._journal(
+                    tenant=tenant, trace_ctx=trace_ctx,
+                    reason="rejected", code=code, t0=t0,
+                    replica=replica, route_reason=reason,
+                    prompt_tokens=len(ids), attempts=contacts,
+                )
+                return {
+                    "kind": "json", "code": code, "payload": payload,
+                    "headers": {}, "replica": replica, "reason": reason,
+                }
+            if kind == "deadline":
+                # The request's own budget died downstream; a retry
+                # would duplicate work the client can no longer use.
+                br.record_success()
+                payload = out[1]
+                self._journal(
+                    tenant=tenant, trace_ctx=trace_ctx,
+                    reason="deadline", code=504, t0=t0,
+                    replica=replica, route_reason=reason,
+                    prompt_tokens=len(ids), attempts=contacts,
+                )
+                return {
+                    "kind": "json", "code": 504, "payload": payload,
+                    "headers": {}, "replica": replica, "reason": reason,
+                }
+            # kind == "fail": connection refused / timeout / 5xx — the
+            # replica is observed dead.  Mark it down (its chains
+            # re-home), rehash, and retry the next candidate after a
+            # deterministic-jitter backoff.
+            br.record_failure()
+            last_fail = out[1]
+            tried.add(replica)
+            self.router.mark_down(replica)
+            self.metrics.inc("serve_router_rehash_total")
+            attempt += 1
+            budget -= 1
+            if budget <= 0:
+                break
+            if attempt < max_tries:
+                self.clock.sleep(
+                    self.policy.delay(attempt, key=replica)
+                )
+        if shed is not None:
+            payload, retry_after = shed
+            return self._shed_out(
+                "overloaded", 429, payload, tenant, trace_ctx, t0,
+                contacts,
+                headers={
+                    "Retry-After": retry_after or str(RETRY_AFTER_S)
+                },
+            )
+        detail = last_fail or "none eligible"
+        return self._shed_out(
+            "no_replica", 503,
+            {"error": f"no replica available ({detail})"},
+            tenant, trace_ctx, t0, contacts,
+            headers={"Retry-After": str(RETRY_AFTER_S)},
+        )
+
+    def _dispatch_pinned(
+        self, name, ids, body, tenant, deadline, trace_ctx, stream, t0
+    ) -> dict:
+        """Pinned single-replica dispatch (``/replica/<name>/generate``):
+        no routing, no rehash — the canary probe path, and the recovery
+        path (a successful contact ``mark_up``s a downed replica and
+        closes its breaker)."""
+        url = self._url_of(name)
+        if url is None:
+            return {
+                "kind": "json", "code": 404,
+                "payload": {"error": f"unknown replica {name!r}"},
+                "headers": {}, "replica": "", "reason": "",
+            }
+        br = self.breakers.get(name)
+        if not br.allow():
+            return {
+                "kind": "json", "code": 503,
+                "payload": {"error": f"circuit open for {name!r}"},
+                "headers": {"Retry-After": str(RETRY_AFTER_S)},
+                "replica": name, "reason": "pinned",
+            }
+        headers = self._headers_for(
+            name, "pinned", tenant, deadline, trace_ctx
+        )
+        timeout = self.request_timeout_s
+        if deadline is not None:
+            timeout = max(
+                0.001, min(timeout, deadline - self.clock.now())
+            )
+        self._track(name, +1)
+        t_at = self.clock.now()
+        out = self._forward(url, body, headers, timeout, stream)
+        kind = out[0]
+        if kind != "stream":
+            self._track(name, -1)
+            self.metrics.observe(
+                "frontend_upstream_seconds",
+                self.clock.now() - t_at, replica=name,
+            )
+        if kind == "ok":
+            br.record_success()
+            self.router.mark_up(name)
+            code, payload = out[1], out[2]
+            self._journal(
+                tenant=tenant, trace_ctx=trace_ctx, reason="ok",
+                code=code, t0=t0, replica=name, route_reason="pinned",
+                prompt_tokens=len(ids),
+                tokens=int(payload.get("generated_tokens", 0) or 0),
+            )
+            return {
+                "kind": "json", "code": code, "payload": payload,
+                "headers": {}, "replica": name, "reason": "pinned",
+            }
+        if kind == "stream":
+            br.record_success()
+            self.router.mark_up(name)
+            n_prompt = len(ids)
+
+            def finish(tokens, _t_at=t_at):
+                self._track(name, -1)
+                self.metrics.observe(
+                    "frontend_upstream_seconds",
+                    self.clock.now() - _t_at, replica=name,
+                )
+                self._journal(
+                    tenant=tenant, trace_ctx=trace_ctx, reason="ok",
+                    code=200, t0=t0, replica=name,
+                    route_reason="pinned", prompt_tokens=n_prompt,
+                    tokens=tokens, extra={"stream": True},
+                )
+
+            return {
+                "kind": "stream", "resp": out[1], "replica": name,
+                "reason": "pinned", "finish": finish,
+            }
+        if kind == "shed":
+            br.record_success()
+            payload, retry_after = out[1], out[2]
+            self._journal(
+                tenant=tenant, trace_ctx=trace_ctx,
+                reason="overloaded", code=429, t0=t0, replica=name,
+                route_reason="pinned", prompt_tokens=len(ids),
+            )
+            return {
+                "kind": "json", "code": 429, "payload": payload,
+                "headers": {
+                    "Retry-After": retry_after or str(RETRY_AFTER_S)
+                },
+                "replica": name, "reason": "pinned",
+            }
+        if kind == "reject":
+            br.record_success()
+            code, payload = out[1], out[2]
+            self._journal(
+                tenant=tenant, trace_ctx=trace_ctx, reason="rejected",
+                code=code, t0=t0, replica=name, route_reason="pinned",
+                prompt_tokens=len(ids),
+            )
+            return {
+                "kind": "json", "code": code, "payload": payload,
+                "headers": {}, "replica": name, "reason": "pinned",
+            }
+        if kind == "deadline":
+            br.record_success()
+            self._journal(
+                tenant=tenant, trace_ctx=trace_ctx, reason="deadline",
+                code=504, t0=t0, replica=name, route_reason="pinned",
+                prompt_tokens=len(ids),
+            )
+            return {
+                "kind": "json", "code": 504, "payload": out[1],
+                "headers": {}, "replica": name, "reason": "pinned",
+            }
+        br.record_failure()
+        self.router.mark_down(name)
+        self._journal(
+            tenant=tenant, trace_ctx=trace_ctx, reason="error",
+            code=502, t0=t0, replica=name, route_reason="pinned",
+            prompt_tokens=len(ids), extra={"detail": out[1]},
+        )
+        return {
+            "kind": "json", "code": 502,
+            "payload": {"error": f"replica {name!r} failed: {out[1]}"},
+            "headers": {}, "replica": name, "reason": "pinned",
+        }
+
+    def _shed_out(
+        self, reason, code, payload, tenant, trace_ctx, t0, contacts,
+        headers=None,
+    ) -> dict:
+        self.metrics.inc("frontend_shed_total", reason=reason)
+        self._journal(
+            tenant=tenant, trace_ctx=trace_ctx,
+            reason="deadline" if reason == "deadline" else reason,
+            code=code, t0=t0, attempts=max(1, contacts),
+        )
+        return {
+            "kind": "json", "code": code, "payload": payload,
+            "headers": dict(headers or {}), "replica": "", "reason": "",
+        }

@@ -58,6 +58,28 @@ def chunk_hashes(ids: np.ndarray, page: int) -> list[bytes]:
     return out
 
 
+def shareable_depth(n: int, page: int) -> int:
+    """How many leading full pages of an ``n``-token prompt are
+    SHAREABLE: full pages only, capped so at least one suffix token
+    remains (the extend must produce first-token logits).  This is the
+    one definition of "the chain" — the batcher's paged admission, the
+    in-process router, and the HTTP front-end all key on it, so a
+    request hashed by the gateway lands on the replica whose block
+    cache registered the very same chain."""
+    return max(0, int(n) - 1) // max(1, int(page))
+
+
+def shareable_chain(ids, page: int) -> list[bytes]:
+    """The page-aligned chain hashes of a prompt's shareable prefix —
+    ``chunk_hashes`` truncated to ``shareable_depth``.  The routing key
+    (serve/router.py, serve/frontend.py) and the acquire chain of paged
+    admission (serve/batcher.py) are byte-identical by construction
+    because both come from here."""
+    ids = np.ascontiguousarray(ids, np.int32)
+    depth = shareable_depth(int(ids.size), page)
+    return chunk_hashes(ids, page)[:depth] if depth else []
+
+
 class BlockPool:
     """Block allocator: free list + refcounts + hash table + LRU.
 

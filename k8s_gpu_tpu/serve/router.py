@@ -57,7 +57,7 @@ import numpy as np
 from ..utils.alerts import AlertingRule, RecordingRule
 from ..utils.clock import Clock, RealClock
 from ..utils.metrics import MetricsRegistry, global_metrics
-from .kv_blocks import chunk_hashes
+from .kv_blocks import shareable_chain
 
 log = logging.getLogger("k8s_gpu_tpu.router")
 
@@ -365,12 +365,14 @@ class FleetRouter:
         per-request blacklist (dispatch's retry path).  Raises
         RuntimeError when no replica is eligible."""
         ids = np.asarray(ids, np.int32).ravel()
-        n = int(ids.size)
         # Only FULL pages are shareable, and at least one suffix token
-        # must remain for the extend — the same cap _paged_plan applies,
-        # so the router's chain and the block cache's chain agree.
-        depth = max(0, (n - 1)) // self.page
-        hashes = chunk_hashes(ids, self.page)[:depth] if depth else []
+        # must remain for the extend — kv_blocks.shareable_chain is the
+        # ONE implementation of that cap, shared with _paged_plan's
+        # acquire loop and the HTTP front-end's routing key, so the
+        # router's chain and the block cache's chain agree by
+        # construction.
+        hashes = shareable_chain(ids, self.page)
+        depth = len(hashes)
         self._maybe_refresh()
         with self._lock:
             loads = self._loads_locked()

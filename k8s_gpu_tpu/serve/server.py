@@ -56,6 +56,7 @@ class LmServer:
         page_size: int = 64,
         max_pending: int = 64,
         metrics=None,
+        name: str = "",
     ):
         """``max_pending`` bounds the batcher's unadmitted-request queue:
         at the bound, /generate sheds with 429 + Retry-After instead of
@@ -86,7 +87,13 @@ class LmServer:
 
         ``draft``/``kv_quant``/``paged_blocks``/``page_size`` pass
         through to ContinuousBatcher: speculative rounds, the int8 pool
-        KV cache, and the paged (block-table) KV pool."""
+        KV cache, and the paged (block-table) KV pool.
+
+        ``name``: this replica's fleet name, echoed in the /healthz and
+        /readyz JSON bodies next to the live in-flight count — the
+        scrape-free fast path a draining front-end polls
+        (serve/frontend.py) and a sanity check that a gateway is
+        talking to the replica it thinks it is."""
         cbank = None
         if constraints:
             from .constrain import ConstraintBank
@@ -109,6 +116,7 @@ class LmServer:
         # serve the attribution snapshot at /debug/profile (obs profile).
         self.profiler = self.batcher.profiler
         self.tokenizer = tokenizer
+        self.name = str(name)
         self.started_at = time.time()
         self.cap = max_new_tokens_cap
         # Drain latch (the health contract, docs/platform/serving.md):
@@ -127,9 +135,16 @@ class LmServer:
                 if self.path == "/healthz":
                     # Liveness: the process answers.  Anything deeper
                     # belongs in /readyz — a liveness probe that checks
-                    # readiness restarts pods for being busy.
-                    self._json(200, {"ok": True,
-                                     "uptime_s": time.time() - outer.started_at})
+                    # readiness restarts pods for being busy.  The
+                    # replica name + in-flight count ride along so a
+                    # front-end's drain wait stays scrape-free even
+                    # while the replica reports NotReady.
+                    self._json(200, {
+                        "ok": True,
+                        "uptime_s": time.time() - outer.started_at,
+                        "replica": outer.name,
+                        "inflight": outer.batcher.inflight_requests,
+                    })
                 elif self.path == "/readyz":
                     r = outer.readiness()
                     self._json(200 if r["ready"] else 503, r)
@@ -396,6 +411,12 @@ class LmServer:
             "scheduler_alive": alive,
             "warmed": warmed,
             "draining": draining,
+            # Fleet identity + the drain fast path: a front-end
+            # retiring this replica polls ``inflight`` here instead of
+            # scraping metrics (serve/frontend.py), and ``replica``
+            # lets registration verify it reached the right process.
+            "replica": self.name,
+            "inflight": self.batcher.inflight_requests,
         }
 
     def drain(self) -> None:

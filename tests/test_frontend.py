@@ -1,0 +1,537 @@
+"""Cross-process fleet front-end (serve/frontend.py): the HTTP gateway
+over live LmServer replicas, end to end over real sockets.
+
+The chaos drill the module exists for: affinity through the gateway's
+chain hashing, a mid-burst replica kill rehashing with zero lost
+requests, an in-flight-aware drain that retires its victim only after
+the victim's stream finishes, 429 pass-through without a mark-down,
+header propagation verified in BOTH journals, and two-run byte-identical
+routing under FakeClock.  Plus the shared chain-hash helper's skew
+regression: the gateway's routing key and the batcher's paged-admission
+key must be the same bytes.
+"""
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.data import BpeTokenizer
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.serve import FleetFrontend, LmServer
+from k8s_gpu_tpu.serve.kv_blocks import (
+    chunk_hashes,
+    shareable_chain,
+    shareable_depth,
+)
+from k8s_gpu_tpu.utils import FakeClock, MetricsRegistry
+
+# LmServer's batcher floors the paged page size at 8 (batcher.py) — the
+# gateway MUST hash at the replicas' EFFECTIVE page or every chain skews,
+# which is precisely what test_gateway_chain_equals_batcher_registration
+# pins.
+PAGE = 8
+
+# Word-order permutations the corpus BPE cannot collapse: ~14 tokens of
+# shared per-tenant prefix (plus the per-request suffix) — at least two
+# full shareable pages at PAGE=8, so routing is chain-affine.
+TENANT_PROMPTS = {
+    "acme": ("the cat sat on the log. the dog sat on the mat. "
+             "the mat sat on the cat."),
+    "blue": ("the dog sat on the mat. the cat sat on the log. "
+             "the log sat on the dog."),
+    "coral": ("the log sat on the cat. the mat sat on the dog. "
+              "the cat sat on the log."),
+}
+
+
+@pytest.fixture(scope="module")
+def stack():
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 40
+    tok = BpeTokenizer.train(corpus, vocab_size=300)
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=32, n_layers=1, n_heads=2,
+        d_head=16, d_ff=64, max_seq=64, use_flash=False,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return tok, model, params
+
+
+def _mk_server(stack, name):
+    tok, model, params = stack
+    return LmServer(
+        model, params, tok, slots=4, paged_blocks=64, page_size=PAGE,
+        metrics=MetricsRegistry(), name=name,
+    ).start()
+
+
+@pytest.fixture(scope="module")
+def fleet(stack):
+    """3 live LmServers registered behind one gateway — shared by the
+    non-destructive tests (nothing here kills or retires a replica)."""
+    tok, _, _ = stack
+    servers = {f"fr-{i}": _mk_server(stack, f"fr-{i}") for i in range(3)}
+    fe = FleetFrontend(
+        tok, page_size=PAGE, metrics=MetricsRegistry()
+    ).start()
+    for name, srv in servers.items():
+        fe.register_replica(name, f"http://127.0.0.1:{srv.port}")
+    yield fe, servers
+    fe.stop()
+    for srv in servers.values():
+        srv.stop()
+
+
+def _post(base, path, payload, headers=None, timeout=60.0):
+    req = urllib.request.Request(
+        base.rstrip("/") + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except ValueError:
+            payload = {}
+        return e.code, payload, dict(e.headers)
+
+
+def _gen(tenant, i, extra=None):
+    body = {
+        "prompt": TENANT_PROMPTS[tenant] + f" q{i}",
+        "max_new_tokens": 4, "temperature": 0.0, "tenant": tenant,
+    }
+    body.update(extra or {})
+    return body
+
+
+# -- the shared chain definition (satellite 1) ---------------------------
+
+
+def test_shareable_chain_matches_definition():
+    ids = np.arange(2, 2 + 23, dtype=np.int32)
+    # 23 tokens / page 4: (23-1)//4 = 5 full shareable pages.
+    assert shareable_depth(23, 4) == 5
+    assert shareable_chain(ids, 4) == chunk_hashes(ids, 4)[:5]
+    # Exactly page-aligned: the LAST full page is NOT shareable — one
+    # suffix token must remain to produce first-token logits.
+    assert shareable_depth(24, 4) == 5
+    assert len(shareable_chain(np.arange(24, dtype=np.int32), 4)) == 5
+    # Shorter than a page: nothing shareable.
+    assert shareable_chain(np.arange(4, dtype=np.int32), 4) == []
+
+
+def test_gateway_chain_equals_batcher_registration(stack, fleet):
+    """Skew regression: the hashes the gateway routes on are the very
+    hashes the replica's block pool registers for the same prompt."""
+    tok, _, _ = stack
+    _, servers = fleet
+    srv = servers["fr-0"]
+    prompt = TENANT_PROMPTS["acme"] + " skew probe"
+    code, _, _ = _post(
+        f"http://127.0.0.1:{srv.port}", "/generate",
+        {"prompt": prompt, "max_new_tokens": 2, "temperature": 0.0},
+    )
+    assert code == 200
+    ids = tok.encode(prompt)
+    chain = shareable_chain(ids, PAGE)
+    assert len(chain) == shareable_depth(int(ids.size), PAGE) >= 2
+    registered = srv.batcher._pool._blk_of
+    for h in chain:
+        assert h in registered, "gateway chain hash unknown to the pool"
+
+
+# -- LmServer health contract through a live drain (satellite 2) ---------
+
+
+def test_readyz_identity_and_inflight_through_drain(fleet):
+    _, servers = fleet
+    srv = servers["fr-1"]
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def readyz():
+        try:
+            with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    code, body = readyz()
+    assert code == 200 and body["replica"] == "fr-1"
+    assert body["inflight"] == 0
+    # Hold a stream open so in-flight is observably non-zero.
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+    conn.request(
+        "POST", "/generate",
+        json.dumps({"prompt": TENANT_PROMPTS["blue"],
+                    "max_new_tokens": 24, "temperature": 0.0,
+                    "stream": True}),
+        {"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    deadline = time.time() + 10.0
+    seen = 0
+    while time.time() < deadline:
+        seen = readyz()[1]["inflight"]
+        if seen >= 1:
+            break
+    assert seen >= 1
+    try:
+        srv.drain()
+        code, body = readyz()
+        # Draining: NotReady verdict, but identity and the in-flight
+        # count keep being served — the gateway's drain fast path.
+        assert code == 503 and body["draining"] is True
+        assert body["replica"] == "fr-1" and "inflight" in body
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["replica"] == "fr-1" and h["inflight"] >= 0
+    finally:
+        srv.undrain()
+        while resp.readline():
+            pass
+        conn.close()
+    assert readyz()[0] == 200
+
+
+# -- affinity through the gateway ----------------------------------------
+
+
+def test_affinity_across_live_fleet(fleet):
+    fe, _ = fleet
+    owners, reasons = {}, {}
+    for tenant in TENANT_PROMPTS:
+        for i in range(3):
+            code, _, hdrs = _post(fe.url, "/generate", _gen(tenant, i))
+            assert code == 200
+            owners.setdefault(tenant, set()).add(hdrs["x-route-replica"])
+            reasons.setdefault(tenant, []).append(hdrs["x-route-reason"])
+    for tenant, reps in owners.items():
+        assert len(reps) == 1, f"{tenant} scattered across {reps}"
+    for tenant, rs in reasons.items():
+        assert rs[-1] == "affinity", (tenant, rs)
+
+
+def test_admin_views_and_gateway_health(fleet):
+    fe, _ = fleet
+    with urllib.request.urlopen(fe.url + "/healthz", timeout=10) as r:
+        body = json.loads(r.read())
+    assert body["ok"] is True and body["replicas"] == 3
+    with urllib.request.urlopen(fe.url + "/readyz", timeout=10) as r:
+        body = json.loads(r.read())
+    assert body["ready"] is True and body["eligible"] == 3
+    code, body, _ = _post(fe.url + "/admin/replicas", "", {"x": 1})
+    assert code == 400  # name/url required
+    with urllib.request.urlopen(fe.url + "/admin/replicas",
+                                timeout=10) as r:
+        states = json.loads(r.read())["replicas"]
+    assert sorted(s["replica"] for s in states) == [
+        "fr-0", "fr-1", "fr-2"
+    ]
+    assert all("url" in s and "inflight_gateway" in s for s in states)
+
+
+# -- header propagation, journal-verified --------------------------------
+
+
+def test_header_propagation_both_journals(fleet):
+    fe, servers = fleet
+    trace_id = "ab" * 16
+    code, out, hdrs = _post(
+        fe.url, "/generate", _gen("blue", 77),
+        headers={
+            "traceparent": f"00-{trace_id}-{'cd' * 8}-01",
+            "x-request-deadline-ms": "20000",
+        },
+    )
+    assert code == 200
+    replica = hdrs["x-route-replica"]
+    reason = hdrs["x-route-reason"]
+    # Gateway journal: the client-facing record.
+    rec = next(
+        r for r in fe.journal.snapshot(limit=50)
+        if r["trace_id"] == trace_id
+    )
+    assert rec["tenant"] == "blue" and rec["path"] == "gateway"
+    assert rec["replica"] == replica and rec["route_reason"] == reason
+    assert rec["extra"]["status"] == 200
+    # Replica journal: the SAME trace id, tenant, and routing stamp
+    # arrived downstream in headers.
+    down = next(
+        r for r in servers[replica].journal.snapshot(limit=50)
+        if r["trace_id"] == trace_id
+    )
+    assert down["tenant"] == "blue"
+    assert down["replica"] == replica
+    assert down["route_reason"] == reason
+
+
+def test_expired_deadline_sheds_at_gateway(fleet):
+    fe, _ = fleet
+    before = fe.metrics.counter("frontend_shed_total", reason="deadline")
+    code, body, _ = _post(
+        fe.url, "/generate", _gen("acme", 5),
+        headers={"x-request-deadline-ms": "0"},
+    )
+    assert code == 504 and "deadline" in body["error"]
+    after = fe.metrics.counter("frontend_shed_total", reason="deadline")
+    assert after == before + 1
+
+
+# -- kill mid-burst: rehash, zero lost -----------------------------------
+
+
+def test_kill_mid_burst_zero_lost(stack):
+    tok, _, _ = stack
+    servers = {f"kb-{i}": _mk_server(stack, f"kb-{i}") for i in range(2)}
+    fe = FleetFrontend(
+        tok, page_size=PAGE, metrics=MetricsRegistry()
+    ).start()
+    try:
+        for name, srv in servers.items():
+            fe.register_replica(name, f"http://127.0.0.1:{srv.port}")
+        # Learn acme's owner, then kill it once its work is in flight.
+        _, _, hdrs = _post(fe.url, "/generate", _gen("acme", 0))
+        victim = hdrs["x-route-replica"]
+        n_burst = 12
+        codes = []
+
+        def fire(i):
+            tenant = "acme" if i % 2 else "blue"
+            code, _, _ = _post(
+                fe.url, "/generate",
+                _gen(tenant, 100 + i, {"max_new_tokens": 16}),
+            )
+            codes.append(code)
+
+        def killer():
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if servers[victim].batcher.inflight_requests > 0:
+                    break
+                time.sleep(0.005)
+            servers[victim].stop()
+
+        kt = threading.Thread(target=killer)
+        with ThreadPoolExecutor(max_workers=6) as ex:
+            kt.start()
+            futs = [ex.submit(fire, i) for i in range(n_burst)]
+            for f in futs:
+                f.result()
+        kt.join()
+        assert codes == [200] * n_burst, f"lost requests: {codes}"
+        assert fe.metrics.counter("serve_router_rehash_total") >= 1
+        # Journal audit: every burst request has exactly one terminal
+        # gateway record, all ok — zero lost, zero duplicated.
+        recs = [
+            r for r in fe.journal.snapshot(limit=100)
+            if r["tenant"] in ("acme", "blue")
+        ]
+        assert len(recs) == n_burst + 1  # burst + the owner probe
+        assert all(r["reason"] == "ok" for r in recs)
+        # Post-kill traffic re-homes off the victim.
+        _, _, hdrs = _post(fe.url, "/generate", _gen("acme", 999))
+        assert hdrs["x-route-replica"] != victim
+    finally:
+        fe.stop()
+        for srv in servers.values():
+            try:
+                srv.stop()
+            except Exception:
+                pass
+
+
+# -- in-flight-aware drain ------------------------------------------------
+
+
+def test_drain_waits_for_inflight_stream(stack):
+    tok, _, _ = stack
+    servers = {f"dr-{i}": _mk_server(stack, f"dr-{i}") for i in range(2)}
+    fe = FleetFrontend(
+        tok, page_size=PAGE, metrics=MetricsRegistry()
+    ).start()
+    try:
+        for name, srv in servers.items():
+            fe.register_replica(
+                name, f"http://127.0.0.1:{srv.port}",
+                on_drain=srv.drain,
+            )
+        # Open a stream; the routing headers arrive before the body, so
+        # the victim is known while its work is still in flight.
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=60)
+        conn.request(
+            "POST", "/generate",
+            json.dumps(_gen("coral", 1, {"stream": True,
+                                         "max_new_tokens": 24})),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        victim = resp.getheader("x-route-replica")
+        code, st, _ = _post(
+            fe.url, "/admin/drain", {"name": victim, "deadline_s": 30.0}
+        )
+        assert code == 202 and st["state"] == "draining"
+        # The drain is announced: the victim's own /readyz flips NotReady
+        # (on_drain hook) while the stream is still being served.
+        events = [json.loads(line) for line in resp if line.strip()]
+        conn.close()
+        summary = events[-1]
+        assert summary["done"] is True, summary
+        assert summary["generated_tokens"] == 24
+        deadline = time.time() + 15.0
+        state = {}
+        while time.time() < deadline:
+            with urllib.request.urlopen(fe.url + "/admin/drain",
+                                        timeout=10) as r:
+                drains = json.loads(r.read())["drains"]
+            state = next(
+                (d for d in drains if d["replica"] == victim), {}
+            )
+            if state.get("state") == "retired":
+                break
+            time.sleep(0.05)
+        assert state.get("state") == "retired", state
+        assert state["forced"] is False  # graceful: the stream finished
+        assert victim not in fe.replica_names()
+        assert fe.metrics.counter(
+            "frontend_drains_total", outcome="graceful"
+        ) == 1
+        # Traffic keeps flowing on the survivor.
+        code, _, hdrs = _post(fe.url, "/generate", _gen("coral", 2))
+        assert code == 200 and hdrs["x-route-replica"] != victim
+    finally:
+        fe.stop()
+        for srv in servers.values():
+            srv.stop()
+
+
+# -- 429 pass-through without mark-down ----------------------------------
+
+
+class _ShedReplica:
+    """A replica that is alive, ready, and permanently full: /readyz
+    says ready, every /generate sheds 429 with its own Retry-After."""
+
+    def __init__(self, name):
+        outer_name = name
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps({
+                    "ready": True, "scheduler_alive": True,
+                    "draining": False, "replica": outer_name,
+                    "inflight": 0,
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = json.dumps({"error": "queue full"}).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", "7")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_429_passes_through_without_markdown(stack):
+    tok, _, _ = stack
+    shed = _ShedReplica("shed-0")
+    fe = FleetFrontend(
+        tok, page_size=PAGE, metrics=MetricsRegistry()
+    ).start()
+    try:
+        fe.register_replica("shed-0", f"http://127.0.0.1:{shed.port}")
+        code, body, hdrs = _post(fe.url, "/generate", _gen("acme", 1))
+        assert code == 429
+        assert body["error"] == "queue full"  # the replica's own body
+        assert hdrs["Retry-After"] == "7"     # and its own backoff hint
+        # Overload is load, not death: the replica stays routable.
+        snap = {
+            r["replica"]: r
+            for r in fe.router.snapshot()["replicas"]
+        }
+        assert snap["shed-0"]["down"] is False
+        assert fe.metrics.counter(
+            "frontend_shed_total", reason="overloaded"
+        ) == 1
+        rec = fe.journal.snapshot(limit=5)[0]  # newest-first
+        assert rec["reason"] == "overloaded"
+    finally:
+        fe.stop()
+        shed.stop()
+
+
+# -- two-run deterministic routing ---------------------------------------
+
+
+def test_two_run_routing_is_byte_identical(stack, fleet):
+    """Same replica set, same request sequence, FakeClock: the routing
+    decisions AND the router snapshot must be byte-identical across two
+    fresh gateways — routing is a pure function of its inputs."""
+    tok, _, _ = stack
+    _, servers = fleet
+
+    def run():
+        fe = FleetFrontend(
+            tok, page_size=PAGE, clock=FakeClock(),
+            metrics=MetricsRegistry(),
+        ).start()
+        try:
+            for name in sorted(servers):
+                fe.register_replica(
+                    name, f"http://127.0.0.1:{servers[name].port}"
+                )
+            decisions = []
+            for i in range(6):
+                tenant = ["acme", "blue", "coral"][i % 3]
+                code, _, hdrs = _post(
+                    fe.url, "/generate", _gen(tenant, i)
+                )
+                assert code == 200
+                decisions.append(
+                    (hdrs["x-route-replica"], hdrs["x-route-reason"])
+                )
+            snap = dict(fe.router.snapshot())
+            return json.dumps(
+                {"decisions": decisions, "snapshot": snap},
+                sort_keys=True,
+            )
+        finally:
+            fe.stop()
+
+    assert run() == run()
