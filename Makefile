@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo waterfall-demo
 
 # The default verify path (bare `make`): graftcheck invariants + the
 # attribution-plane smoke + the flash-v2 parity suite (ISSUE 12 — every
@@ -23,7 +23,7 @@ IMAGES = operator trainer devenv
 # train-step guard, all CPU-safe through the Pallas interpreter).  The
 # full suite stays `make test` (it takes minutes); image builds stay
 # `make docker-build`.
-verify: check profile-demo goodput-demo canary-demo frontend-demo flash-v2-parity
+verify: check profile-demo goodput-demo canary-demo frontend-demo waterfall-demo flash-v2-parity
 
 flash-v2-parity:
 	python -m pytest tests/test_flash_v2.py -q -p no:cacheprovider
@@ -154,6 +154,14 @@ kernel-demo:
 # drain that retires gracefully while its work finishes.
 frontend-demo:
 	python tools/frontend_demo.py
+
+# Fleet waterfall smoke (ISSUE 16): 3 replicas behind the gateway,
+# skewed traffic with one replica killed mid-burst — the cross-process
+# stitcher shows the rehashed request's dead attempt AND the
+# survivor's completion in ONE trace, retry_hop attributed, segments
+# summing exactly to E2E, byte-identical across two stitching runs.
+waterfall-demo:
+	python tools/waterfall_demo.py
 
 # Fleet router smoke: 4 paged replicas behind the prefix-affinity
 # router serve skewed multi-tenant traffic (each tenant's shared prompt

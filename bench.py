@@ -1063,7 +1063,12 @@ def frontend_gateway_probe(model, params) -> dict:
     - cb_frontend_rehash_lost: a 16-request burst over 2 replicas with
       one KILLED mid-burst; every in-flight casualty must rehash to
       the survivor and complete — the count of lost requests, must
-      be 0."""
+      be 0.
+    - cb_frontend_gateway_share / cb_frontend_network_share: the
+      overhead multiple decomposed by the fleet waterfall (ISSUE 16) —
+      the mean share of each gateway-relayed request's E2E spent on
+      the gateway side (route + retries + residual) vs on the local
+      HTTP hop (network_gap), from stitched cross-process traces."""
     import threading
     import urllib.request
     from concurrent.futures import ThreadPoolExecutor
@@ -1165,6 +1170,75 @@ def frontend_gateway_probe(model, params) -> dict:
         gw = best(fe.url)
         d2 = best(direct)
         out["cb_frontend_overhead_x"] = round(gw / min(d1, d2), 4)
+
+        # -- decomposition: where does the multiple live? ----------------
+        # One more 8-wide gateway window with known trace ids
+        # (attribution, not timing), stitched by the fleet waterfall:
+        # each request's E2E splits into a gateway-side share (route +
+        # retries + residual) and the local-hop network share.
+        from k8s_gpu_tpu.utils import (
+            FakeClock, FleetTraceAssembler, split_by_process,
+        )
+        from k8s_gpu_tpu.utils.tracing import global_tracer
+
+        def tid_for(i):
+            return f"{0xBE2C44 + i:032x}"
+
+        def traced_post(i):
+            req = urllib.request.Request(
+                fe.url.rstrip("/") + "/generate",
+                data=json.dumps(bodies[i]).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": f"00-{tid_for(i)}-{'cd' * 8}-01"},
+            )
+            with urllib.request.urlopen(req, timeout=120.0) as r:
+                json.loads(r.read())
+
+        with ThreadPoolExecutor(max_workers=8) as ex:
+            list(ex.map(traced_post, range(len(bodies))))
+        # The http spans close just after the response bytes go out.
+        deadline = time.time() + 10.0
+        captured = []
+        while time.time() < deadline:
+            captured = [
+                tr for i in range(len(bodies))
+                for tr in global_tracer.traces(
+                    trace_id=tid_for(i), limit=1
+                )
+                if '"gateway.dispatch"' in json.dumps(tr)
+            ]
+            if len(captured) == len(bodies):
+                break
+            time.sleep(0.05)
+        frags = split_by_process(captured)
+        asm = FleetTraceAssembler(
+            targets={
+                p: (lambda p=p: {"traces": frags[p]}) for p in frags
+            },
+            registry=MetricsRegistry(), clock=FakeClock(),
+        )
+        asm.scrape_once()
+        gw_shares, net_shares = [], []
+        for i in range(len(bodies)):
+            wf = asm.waterfall(tid_for(i))
+            if not wf or not wf.get("stitched") or not wf.get("e2e_s"):
+                continue
+            segs = wf["segments"]
+            gw_shares.append(
+                (segs["gateway_route"]["seconds"]
+                 + segs["retry_hop"]["seconds"]
+                 + segs["unattributed"]["seconds"]) / wf["e2e_s"]
+            )
+            net_shares.append(
+                segs["network_gap"]["seconds"] / wf["e2e_s"]
+            )
+        if gw_shares:
+            out["cb_frontend_gateway_share"] = round(
+                sum(gw_shares) / len(gw_shares), 4
+            )
+            out["cb_frontend_network_share"] = round(
+                sum(net_shares) / len(net_shares), 4
+            )
     finally:
         fe.stop()
         srv.stop()
@@ -1567,6 +1641,7 @@ def main() -> None:
         "cb_router_affinity_hit_x", "cb_router_vs_single_x",
         "cb_router_ttft_p95_s", "cb_router_rr_ttft_p95_s",
         "cb_frontend_overhead_x", "cb_frontend_rehash_lost",
+        "cb_frontend_gateway_share", "cb_frontend_network_share",
         "cb_phase_share_decode_dispatch", "cb_phase_residual_share",
         "train_mfu_gauge", "train_flash_v2_vs_v1_x",
         "train_attn_ms_per_layer", "flash_v2_parity_ok",

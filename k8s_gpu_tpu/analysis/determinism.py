@@ -53,6 +53,11 @@ DETERMINISTIC_PLANES = (
     "k8s_gpu_tpu/utils/federation.py",
     "k8s_gpu_tpu/utils/metrics.py",
     "k8s_gpu_tpu/utils/tracing.py",
+    # The waterfall plane (ISSUE 16): cross-process stitching, clock
+    # alignment, and the segment sweep are pure functions of (scraped
+    # rings, injected Clock) — the two-run byte-identical
+    # /debug/waterfall contract depends on it.
+    "k8s_gpu_tpu/utils/waterfall.py",
     # The attribution plane (ISSUE 9): the phase profiler's two-run
     # bit-identical /debug/profile contract, and the jax.profiler
     # wrappers whose wall window now flows through Clock.

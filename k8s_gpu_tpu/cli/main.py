@@ -1260,6 +1260,59 @@ def cmd_obs(args) -> int:
             print(render_trace(t))
             print()
         return 0
+    if args.obs_cmd == "waterfall":
+        # The fleet waterfall: stitched cross-process request traces
+        # with the per-segment critical-path decomposition — the
+        # "where did THIS request's 900ms go" view (/debug/waterfall).
+        from ..utils.obs import render_waterfall
+
+        if not args.url:
+            print("obs waterfall needs --url (the trace assembler "
+                  "lives in the serving process)", file=sys.stderr)
+            return 2
+        params = f"?limit={args.limit}"
+        if args.trace:
+            params = f"?trace_id={args.trace}"
+        body = _obs_fetch(args.url, f"/debug/waterfall{params}")
+        if body is None:
+            return 1
+        try:
+            snap = json.loads(body)
+            if not isinstance(snap, dict) or "error" in snap:
+                raise ValueError(
+                    (snap or {}).get("error", "not a waterfall snapshot")
+                )
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            print(f"fetch failed: {e}", file=sys.stderr)
+            return 1
+        if args.chrome_trace:
+            from pathlib import Path
+
+            if not args.trace:
+                print("--chrome-trace needs --trace (one stitched "
+                      "trace per Perfetto export)", file=sys.stderr)
+                return 2
+            ch_body = _obs_fetch(
+                args.url,
+                f"/debug/waterfall?trace_id={args.trace}&chrome=1",
+            )
+            if ch_body is None:
+                return 1
+            try:
+                data = json.loads(ch_body)
+                data["traceEvents"]
+            except (ValueError, KeyError, TypeError) as e:
+                print(f"fetch failed: {e}", file=sys.stderr)
+                return 1
+            Path(args.chrome_trace).write_text(json.dumps(data))
+            print(
+                f"chrome trace written to {args.chrome_trace} "
+                f"({len(data['traceEvents'])} events, one pid per "
+                "process) — load it at ui.perfetto.dev or "
+                "chrome://tracing"
+            )
+        print(render_waterfall(snap))
+        return 0
     if args.obs_cmd == "serve":
         from ..utils.obs import MetricsServer
 
@@ -1780,6 +1833,23 @@ def build_parser() -> argparse.ArgumentParser:
     p_ot.add_argument("--min-ms", type=float, default=0.0,
                       help="only traces at least this long end-to-end")
     p_ot.add_argument("--limit", type=int, default=20)
+    p_owf = obs_sub.add_parser(
+        "waterfall",
+        help="fleet waterfall: stitched cross-process request traces "
+             "with the critical-path segment decomposition (gateway/"
+             "retry/network/queue/prefill/decode) off /debug/waterfall",
+    )
+    p_owf.add_argument("--url", required=True,
+                       help="base URL of a metrics server with a "
+                            "FleetTraceAssembler attached "
+                            "(/debug/waterfall)")
+    p_owf.add_argument("--trace", default="",
+                       help="exact trace id: render ONE request's full "
+                            "waterfall instead of the listing")
+    p_owf.add_argument("--chrome-trace", default="",
+                       help="write the multi-process Chrome/Perfetto "
+                            "trace JSON to PATH; requires --trace")
+    p_owf.add_argument("--limit", type=int, default=20)
     p_os = obs_sub.add_parser("serve")
     p_os.add_argument("--port", type=int, default=0)
     p_os.add_argument("--for-seconds", type=float, default=0.0,

@@ -71,7 +71,12 @@ from ..utils.clock import Clock, RealClock
 from ..utils.federation import FleetCollector
 from ..utils.metrics import MetricsRegistry, global_metrics
 from ..utils.obs import RequestMetricsMixin
-from ..utils.tracing import format_traceparent
+from ..utils.tracing import (
+    SpanContext,
+    format_traceparent,
+    global_tracer,
+    new_span_id,
+)
 from .canary import CanaryProber
 from .journal import RequestJournal
 from .journal import RequestRecord as JournalRecord
@@ -422,6 +427,9 @@ class FleetFrontend:
                 self.send_header("X-Accel-Buffering", "no")
                 self.send_header("x-route-replica", out["replica"])
                 self.send_header("x-route-reason", out["reason"])
+                ctx = getattr(self, "trace_ctx", None)
+                if ctx is not None:
+                    self.send_header("x-trace-id", ctx.trace_id)
                 self.end_headers()
                 events = 0
                 try:
@@ -459,7 +467,15 @@ class FleetFrontend:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
-                for k, v in (headers or {}).items():
+                # EVERY client-visible outcome — success, shed, 503,
+                # 504, validation error — carries the trace id, so any
+                # client-observed failure is findable in the waterfall
+                # (/debug/waterfall, utils/waterfall.py).
+                hdrs = dict(headers or {})
+                ctx = getattr(self, "trace_ctx", None)
+                if ctx is not None and "x-trace-id" not in hdrs:
+                    hdrs["x-trace-id"] = ctx.trace_id
+                for k, v in hdrs.items():
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
@@ -825,6 +841,32 @@ class FleetFrontend:
             h["traceparent"] = format_traceparent(trace_ctx)
         return h
 
+    def _attempt_span(
+        self, trace_ctx, attempt_ctx, replica, attempt, outcome, s_at,
+    ) -> None:
+        """Record one ``gateway.dispatch`` span per downstream contact —
+        the waterfall plane's evidence (utils/waterfall.py).  Its
+        pre-minted id was the traceparent the attempt propagated, so
+        the replica's server span nests INSIDE it: that containment is
+        the cross-process clock-pinning anchor, and a failed attempt's
+        span bounds the ``retry_hop`` segment.  Boundaries come from
+        the tracer's own clock so dispatch spans share the mixin root
+        span's timeline (the injected ``self.clock`` may be a test
+        FakeClock on a different time line)."""
+        if attempt_ctx is None:
+            return
+        global_tracer.add_span(
+            "gateway.dispatch",
+            parent=trace_ctx,
+            start=s_at,
+            end=global_tracer.clock.now(),
+            status="error" if outcome == "fail" else "ok",
+            span_id=attempt_ctx.span_id,
+            replica=replica,
+            attempt=attempt,
+            outcome=outcome,
+        )
+
     def _track(self, name: str, delta: int) -> int:
         with self._lock:
             if name not in self._inflight:
@@ -918,8 +960,17 @@ class FleetFrontend:
             if contacts > 0:
                 self.metrics.inc("frontend_retries_total")
             contacts += 1
+            # Pre-mint the attempt span's identity and propagate THAT
+            # downstream: the replica's server span then parents to
+            # this attempt, not the whole request — the structural
+            # pairing utils/waterfall.py aligns clocks by.
+            attempt_ctx = (
+                SpanContext(trace_ctx.trace_id, new_span_id())
+                if trace_ctx is not None else None
+            )
             headers = self._headers_for(
-                replica, reason, tenant, deadline, trace_ctx
+                replica, reason, tenant, deadline,
+                attempt_ctx or trace_ctx,
             )
             timeout = self.request_timeout_s
             if deadline is not None:
@@ -928,6 +979,7 @@ class FleetFrontend:
                 )
             self._track(replica, +1)
             t_at = self.clock.now()
+            s_at = global_tracer.clock.now()
             out = self._forward(url, body, headers, timeout, stream)
             kind = out[0]
             if kind != "stream":
@@ -935,6 +987,10 @@ class FleetFrontend:
                 self.metrics.observe(
                     "frontend_upstream_seconds",
                     self.clock.now() - t_at, replica=replica,
+                )
+                self._attempt_span(
+                    trace_ctx, attempt_ctx, replica, contacts, kind,
+                    s_at,
                 )
             if kind == "ok":
                 br.record_success()
@@ -958,11 +1014,15 @@ class FleetFrontend:
                 n_prompt = len(ids)
 
                 def finish(tokens, _r=replica, _reason=reason,
-                           _t_at=t_at, _n=n_prompt, _c=contacts):
+                           _t_at=t_at, _n=n_prompt, _c=contacts,
+                           _actx=attempt_ctx, _s_at=s_at):
                     self._track(_r, -1)
                     self.metrics.observe(
                         "frontend_upstream_seconds",
                         self.clock.now() - _t_at, replica=_r,
+                    )
+                    self._attempt_span(
+                        trace_ctx, _actx, _r, _c, "stream", _s_at,
                     )
                     self._journal(
                         tenant=tenant, trace_ctx=trace_ctx,
@@ -1073,8 +1133,12 @@ class FleetFrontend:
                 "headers": {"Retry-After": str(RETRY_AFTER_S)},
                 "replica": name, "reason": "pinned",
             }
+        attempt_ctx = (
+            SpanContext(trace_ctx.trace_id, new_span_id())
+            if trace_ctx is not None else None
+        )
         headers = self._headers_for(
-            name, "pinned", tenant, deadline, trace_ctx
+            name, "pinned", tenant, deadline, attempt_ctx or trace_ctx
         )
         timeout = self.request_timeout_s
         if deadline is not None:
@@ -1083,6 +1147,7 @@ class FleetFrontend:
             )
         self._track(name, +1)
         t_at = self.clock.now()
+        s_at = global_tracer.clock.now()
         out = self._forward(url, body, headers, timeout, stream)
         kind = out[0]
         if kind != "stream":
@@ -1090,6 +1155,9 @@ class FleetFrontend:
             self.metrics.observe(
                 "frontend_upstream_seconds",
                 self.clock.now() - t_at, replica=name,
+            )
+            self._attempt_span(
+                trace_ctx, attempt_ctx, name, 1, kind, s_at
             )
         if kind == "ok":
             br.record_success()
@@ -1110,11 +1178,15 @@ class FleetFrontend:
             self.router.mark_up(name)
             n_prompt = len(ids)
 
-            def finish(tokens, _t_at=t_at):
+            def finish(tokens, _t_at=t_at, _actx=attempt_ctx,
+                       _s_at=s_at):
                 self._track(name, -1)
                 self.metrics.observe(
                     "frontend_upstream_seconds",
                     self.clock.now() - _t_at, replica=name,
+                )
+                self._attempt_span(
+                    trace_ctx, _actx, name, 1, "stream", _s_at
                 )
                 self._journal(
                     tenant=tenant, trace_ctx=trace_ctx, reason="ok",

@@ -341,6 +341,9 @@ class LmServer:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("X-Accel-Buffering", "no")
+                ctx = getattr(self, "trace_ctx", None)
+                if ctx is not None:
+                    self.send_header("x-trace-id", ctx.trace_id)
                 self.end_headers()
                 gen_ids = []
                 for tok in handle:
@@ -381,7 +384,14 @@ class LmServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
-                for k, v in (headers or {}).items():
+                hdrs = dict(headers or {})
+                # Every client-visible outcome carries the trace id so
+                # a failure is findable in the fleet waterfall
+                # (utils/waterfall.py), not just a success body.
+                ctx = getattr(self, "trace_ctx", None)
+                if ctx is not None and "x-trace-id" not in hdrs:
+                    hdrs["x-trace-id"] = ctx.trace_id
+                for k, v in hdrs.items():
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)

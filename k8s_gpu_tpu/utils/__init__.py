@@ -28,6 +28,7 @@ from .tracing import (
     parse_traceparent,
     render_trace,
 )
+from .waterfall import FleetTraceAssembler, split_by_process
 
 __all__ = [
     "AlertingRule",
@@ -66,6 +67,8 @@ __all__ = [
     "global_tracer",
     "parse_traceparent",
     "render_trace",
+    "FleetTraceAssembler",
+    "split_by_process",
     "trace",
     "step_annotation",
     "profile_trainer",

@@ -93,6 +93,10 @@ GAUGE_AGG: dict[str, str] = {
     "slo_budget_remaining_ratio": "min",
     "slo_burn_rate_fast": "max",
     "slo_burn_rate_slow": "max",
+    # Waterfall plane (ISSUE 16): the fleet's clock-skew answer is its
+    # worst-aligned process — the one whose attributed segments carry
+    # the most alignment error.
+    "e2e_clock_skew_seconds": "max",
 }
 
 # Families the collector never writes aggregates for: the fleet

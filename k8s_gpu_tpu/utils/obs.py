@@ -50,6 +50,16 @@ class MetricsServer:
     per-replica health-FSM snapshot (``obs probes`` renders it; same
     byte-identical contract).  ``/debug/requests`` additionally takes
     ``probes=0`` to drop canary records (``obs requests --no-probes``).
+    ``waterfall`` is a ``utils.waterfall.FleetTraceAssembler`` —
+    ``/debug/waterfall`` serves the stitched cross-process request
+    listing, ``?trace_id=`` the full per-segment waterfall,
+    ``&chrome=1`` its multi-process Perfetto export, ``?refresh=1``
+    forces a scrape pass (a never-scraped assembler scrapes once on
+    first read); ``obs waterfall`` renders it, byte-identical across
+    two FakeClock runs over the same captured rings.
+    ``/debug/traces`` additionally takes ``since=`` (the tracer's
+    completion-index cursor, echoed back as ``cursor`` in every
+    response) so a periodic scraper ships only new traces.
     The handler instruments ITSELF through
     ``RequestMetricsMixin`` (server label ``"obs"``), so scrape traffic
     shows up in ``http_requests_total`` like every other HTTP plane.
@@ -68,6 +78,7 @@ class MetricsServer:
         profile=None,
         goodput=None,
         probes=None,
+        waterfall=None,
     ):
         self.registry = registry or global_metrics
         self.tracer = tracer or global_tracer
@@ -77,6 +88,7 @@ class MetricsServer:
         self.profile = profile
         self.goodput = goodput
         self.probes = probes
+        self.waterfall = waterfall
         self.started_at = time.time()
         self._ready_check = ready_check
         outer = self
@@ -85,8 +97,8 @@ class MetricsServer:
             metrics_server_label = "obs"
             known_routes = (
                 "/debug/goodput", "/debug/probes", "/debug/profile",
-                "/debug/requests", "/debug/traces", "/metrics",
-                "/alerts", "/fleet", "/healthz", "/readyz",
+                "/debug/requests", "/debug/traces", "/debug/waterfall",
+                "/metrics", "/alerts", "/fleet", "/healthz", "/readyz",
             )
 
             def _get(self):
@@ -106,6 +118,8 @@ class MetricsServer:
                     self._goodput()
                 elif path == "/debug/probes":
                     self._probes()
+                elif path == "/debug/waterfall":
+                    self._waterfall()
                 elif path == "/fleet":
                     self._fleet()
                 elif path == "/healthz":
@@ -234,6 +248,46 @@ class MetricsServer:
                 ).encode()
                 self._send(200, body, "application/json")
 
+            def _waterfall(self):
+                if outer.waterfall is None:
+                    return self._send(
+                        404,
+                        json.dumps(
+                            {"error": "no trace assembler attached"}
+                        ).encode(),
+                        "application/json",
+                    )
+                one = self._query()
+                try:
+                    limit = int(one("limit", "50"))
+                except ValueError:
+                    return self._send(
+                        400,
+                        json.dumps({"error": "limit must be an int"}).encode(),
+                        "application/json",
+                    )
+                if one("refresh") == "1" or outer.waterfall.never_scraped:
+                    outer.waterfall.scrape_once()
+                tid = one("trace_id")
+                if tid:
+                    if one("chrome") == "1":
+                        snap = outer.waterfall.chrome(tid)
+                    else:
+                        snap = outer.waterfall.waterfall(tid)
+                    if snap is None:
+                        return self._send(
+                            404,
+                            json.dumps(
+                                {"error": f"no spans for trace {tid!r}"}
+                            ).encode(),
+                            "application/json",
+                        )
+                else:
+                    snap = outer.waterfall.snapshot(limit=limit)
+                # sort_keys: the two-run byte-identical contract.
+                body = json.dumps(snap, sort_keys=True).encode()
+                self._send(200, body, "application/json")
+
             def _requests(self):
                 if outer.journal is None:
                     return self._send(
@@ -270,23 +324,31 @@ class MetricsServer:
                 try:
                     min_ms = float(one("min_ms", "0"))
                     limit = int(one("limit", "50"))
+                    since = int(one("since", "0"))
                 except ValueError:
                     return self._send(
                         400,
                         json.dumps({
-                            "error": "min_ms/limit must be numeric"
+                            "error": "min_ms/limit/since must be numeric"
                         }).encode(),
                         "application/json",
                     )
+                # cursor first: a span recorded between traces() and the
+                # cursor read would otherwise be skipped by the NEXT
+                # since= pass; double-shipping dedups, gaps don't.
+                cursor = outer.tracer.cursor
                 traces = outer.tracer.traces(
                     trace_id=one("trace_id") or None,
                     min_ms=min_ms,
                     name=one("name"),
                     limit=limit,
+                    since=since,
                 )
                 self._send(
                     200,
-                    json.dumps({"traces": traces}).encode(),
+                    json.dumps(
+                        {"traces": traces, "cursor": cursor}
+                    ).encode(),
                     "application/json",
                 )
 
@@ -359,6 +421,10 @@ class RequestMetricsMixin:
 
     def _timed(self, method: str, impl) -> None:
         self._last_code = 0
+        # Reset per request: on a keep-alive connection an exempt route
+        # must not inherit (and stamp x-trace-id with) the PREVIOUS
+        # request's context.
+        self.trace_ctx = None
         route = self._route()
         t0 = time.time()
         inbound = parse_traceparent(self.headers.get("traceparent"))
@@ -826,6 +892,101 @@ def render_probes(snap: dict) -> str:
                 f"  {t.get('t', 0.0):>9.1f} {t['replica']:<18} "
                 f"{t.get('from', '?')} -> {t.get('to', '?')}"
             )
+    return "\n".join(lines)
+
+
+def render_waterfall(snap: dict) -> str:
+    """The ``obs waterfall`` view.  A listing snapshot (``/debug/
+    waterfall``) renders one line per stitched request; a single-trace
+    snapshot (``?trace_id=``) renders the per-segment table with the
+    critical-path segment starred, the attempt timeline (a rehash shows
+    the dead replica's attempt AND the survivor's), and the per-process
+    clock-skew line — the honesty report, never hidden."""
+    if "traces" in snap:
+        traces = snap.get("traces", [])
+        lines = [
+            f"FLEET WATERFALL  ({len(traces)} stitched requests, "
+            f"{snap.get('scrapes', 0)} scrapes)",
+            "",
+            f"  {'TRACE':<34} {'E2E(MS)':>9} {'TTFT(MS)':>9} "
+            f"{'HOPS':>5} {'CRITICAL':<14} FLAGS",
+        ]
+        if not traces:
+            lines.append("  (no stitched request traces yet)")
+        for t in traces:
+            ttft = t.get("ttft_s")
+            lines.append(
+                f"  {t['trace_id']:<34} {t['e2e_s'] * 1000:>9.2f} "
+                f"{(f'{ttft * 1000:.2f}' if ttft is not None else '-'):>9} "
+                f"{t.get('attempts', 0):>5} {t.get('critical', '?'):<14} "
+                f"{'missing-spans' if t.get('missing_spans') else '-'}"
+            )
+        return "\n".join(lines)
+    e2e = snap.get("e2e_s", 0.0)
+    ttft = snap.get("ttft_s")
+    lines = [
+        f"WATERFALL  trace {snap.get('trace_id', '?')}  "
+        f"(e2e {e2e * 1000:.2f} ms"
+        + (f", ttft {ttft * 1000:.2f} ms" if ttft is not None else "")
+        + (", MISSING SPANS" if snap.get("missing_spans") else "")
+        + ")",
+        "",
+        f"  {'SEGMENT':<16} {'SECONDS':>12} {'SHARE':>7} {'TTFT(MS)':>9}",
+    ]
+    segments = snap.get("segments", {})
+    tseg = snap.get("ttft_segments") or {}
+    for seg in sorted(
+        segments, key=lambda s: -segments[s].get("seconds", 0.0)
+    ):
+        st = segments[seg]
+        mark = " *" if snap.get("critical") == seg else ""
+        tv = tseg.get(seg)
+        lines.append(
+            f"  {seg + mark:<16} {st.get('seconds', 0.0):>12.6f} "
+            f"{st.get('share', 0.0):>7.1%} "
+            f"{(f'{tv * 1000:.2f}' if tv is not None else '-'):>9}"
+        )
+    attempts = snap.get("attempts", [])
+    if attempts:
+        lines.append("")
+        lines.append(
+            f"  {'#':>3} {'REPLICA':<18} {'OUTCOME':<9} {'START(MS)':>10} "
+            f"{'END(MS)':>10}  SERVER SPAN"
+        )
+        for a in attempts:
+            lines.append(
+                f"  {a.get('attempt', 0):>3} {a.get('replica', '?'):<18} "
+                f"{a.get('outcome', '?'):<9} "
+                f"{a.get('start_s', 0.0) * 1000:>10.2f} "
+                f"{a.get('end_s', 0.0) * 1000:>10.2f}  "
+                f"{'yes' if a.get('server_span') else 'MISSING'}"
+            )
+    procs = snap.get("processes", {})
+    if procs:
+        lines.append("")
+        cells = []
+        for p in sorted(procs):
+            info = procs[p]
+            off = info.get("offset_s", 0.0)
+            # Monotonic origins differ by arbitrary amounts (process
+            # uptimes) — sub-second offsets are the readable-in-ms case.
+            cell = f"{p} " + (
+                f"{off * 1000:+.3f}ms" if abs(off) < 1.0
+                else f"{off:+.3f}s"
+            )
+            cell += (
+                f" ({info.get('pairs', 0)} pairs)"
+                if info.get("aligned") else " (UNALIGNED)"
+            )
+            cells.append(cell)
+        lines.append("clock skew vs gateway: " + ", ".join(cells))
+    net = snap.get("network")
+    if net:
+        lines.append(
+            f"network gap: request {net.get('request_s', 0.0) * 1000:.3f}ms"
+            f" / response {net.get('response_s', 0.0) * 1000:.3f}ms "
+            "(symmetric-legs assumption — see docs)"
+        )
     return "\n".join(lines)
 
 

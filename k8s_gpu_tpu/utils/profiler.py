@@ -440,35 +440,72 @@ def _walk_tree(node: dict, pid: int, tid: int, events: list) -> None:
 
 
 def chrome_trace(traces: list | None = None,
-                 profile: dict | None = None) -> dict:
+                 profile: dict | None = None,
+                 by_process: dict | None = None) -> dict:
     """Chrome trace-event JSON (the Perfetto-loadable format) from the
     assembled span ring (the ``/debug/traces`` shape) and a profile
     snapshot's rolling phase samples.  Spans render under pid 1 (one
     Perfetto track per trace), phase samples under pid 2 (one track per
     phase).  Events are sorted by timestamp — monotonic ``ts`` is part
-    of the format contract the export test pins."""
+    of the format contract the export test pins.
+
+    ``by_process`` is the multi-process form the fleet waterfall
+    (utils/waterfall.py) exports: ``{process_name: [assembled traces]}``
+    with span times already aligned onto one clock.  Process names map
+    to pids 1..N in sorted order (deterministic across runs) with
+    ``process_name`` metadata, so Perfetto shows gateway and replicas
+    as separate named processes on a shared timeline; the profile track
+    then lands on pid N+1.  Mutually exclusive with ``traces``."""
     events: list[dict] = []
-    meta: list[dict] = [
-        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
-         "args": {"name": "spans"}},
-        {"name": "process_name", "ph": "M", "pid": 2, "tid": 0,
-         "args": {"name": "phases"}},
-    ]
-    for i, trace in enumerate(traces or []):
-        tid = i + 1
+    meta: list[dict] = []
+    if by_process is not None:
+        procs = sorted(by_process)
+        for pid, proc in enumerate(procs, start=1):
+            meta.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": str(proc)},
+            })
+            for i, trace in enumerate(by_process[proc]):
+                tid = i + 1
+                meta.append({
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid,
+                    "args": {
+                        "name":
+                        f"trace {str(trace.get('trace_id', '?'))[:8]}"
+                    },
+                })
+                for root in trace.get("tree", ()):
+                    _walk_tree(root, pid, tid, events)
+        profile_pid = len(procs) + 1
+    else:
         meta.append({
-            "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
-            "args": {"name": f"trace {str(trace.get('trace_id', '?'))[:8]}"},
+            "name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+            "args": {"name": "spans"},
         })
-        for root in trace.get("tree", ()):
-            _walk_tree(root, 1, tid, events)
+        for i, trace in enumerate(traces or []):
+            tid = i + 1
+            meta.append({
+                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                "args": {
+                    "name": f"trace {str(trace.get('trace_id', '?'))[:8]}"
+                },
+            })
+            for root in trace.get("tree", ()):
+                _walk_tree(root, 1, tid, events)
+        profile_pid = 2
+    if by_process is None or profile:
+        meta.append({
+            "name": "process_name", "ph": "M", "pid": profile_pid,
+            "tid": 0, "args": {"name": "phases"},
+        })
     if profile:
         names = sorted({ph for _, ph, _ in profile.get("samples", [])})
         tids = {ph: i + 1 for i, ph in enumerate(names)}
         for ph, tid in tids.items():
             meta.append({
-                "name": "thread_name", "ph": "M", "pid": 2, "tid": tid,
-                "args": {"name": ph},
+                "name": "thread_name", "ph": "M", "pid": profile_pid,
+                "tid": tid, "args": {"name": ph},
             })
         for t_end, ph, dt in profile.get("samples", []):
             events.append({
@@ -476,7 +513,7 @@ def chrome_trace(traces: list | None = None,
                 "ph": "X",
                 "ts": (float(t_end) - float(dt)) * 1e6,
                 "dur": float(dt) * 1e6,
-                "pid": 2,
+                "pid": profile_pid,
                 "tid": tids[ph],
                 "args": {},
             })

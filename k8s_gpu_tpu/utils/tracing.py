@@ -136,14 +136,16 @@ class _TraceBucket:
     """One trace's spans under the per-trace cap: ``head`` pins the
     trace's origin (first spans), ``tail`` is a rolling window of the
     most recent — a capped long-lived trace never goes dark, it drops
-    its middle."""
+    its middle.  ``last_seq`` is the tracer-global completion index of
+    the newest span recorded here — the ``since=`` cursor's unit."""
 
-    __slots__ = ("head", "tail", "_head_cap")
+    __slots__ = ("head", "tail", "_head_cap", "last_seq")
 
     def __init__(self, head_cap: int, tail_cap: int):
         self.head: list[Span] = []
         self.tail: "deque[Span]" = deque(maxlen=max(0, tail_cap))
         self._head_cap = head_cap
+        self.last_seq = 0
 
     def add(self, sp: Span) -> bool:
         """Record *sp*; returns True when an older span was dropped."""
@@ -167,9 +169,9 @@ class Tracer:
 
     # Lock contract (verified statically by k8s_gpu_tpu/analysis
     # lockcheck and at runtime by utils.faults.guard_declared): the
-    # trace ring is shared between every recording thread and the
-    # /debug/traces reader.
-    _GUARDED_BY = {"_lock": ("_traces",)}
+    # trace ring and its completion counter are shared between every
+    # recording thread and the /debug/traces reader.
+    _GUARDED_BY = {"_lock": ("_traces", "_seq")}
 
     def __init__(
         self,
@@ -188,6 +190,11 @@ class Tracer:
         self._lock = threading.Lock()
         # trace_id → bucket, insertion-ordered for FIFO eviction.
         self._traces: "OrderedDict[str, _TraceBucket]" = OrderedDict()
+        # Monotonic completion index: +1 per recorded span, never reset
+        # by eviction — the ``/debug/traces?since=`` cursor a periodic
+        # scraper (utils/waterfall.py) resumes from, so each pass ships
+        # only traces that gained spans since the last one.
+        self._seq = 0
         self._tls = threading.local()
 
     # -- context -----------------------------------------------------------
@@ -255,17 +262,22 @@ class Tracer:
         start: float | None = None,
         end: float | None = None,
         status: str = "ok",
+        span_id: str | None = None,
         **attributes,
     ) -> SpanContext:
         """Record an already-completed span with explicit boundaries —
         the cross-thread API (queue waits, batcher rounds) where the
         span's lifetime does not match any ``with`` block.  Returns its
-        context so further children can chain."""
+        context so further children can chain.  ``span_id`` lets a
+        caller pre-mint the identity (``new_span_id()``) and propagate
+        it downstream BEFORE the span completes — the gateway's
+        per-attempt dispatch span does this so the replica's server
+        span parents to the ATTEMPT, not the whole request."""
         now = self.clock.now()
         sp = Span(
             name=name,
             trace_id=parent.trace_id if parent else new_trace_id(),
-            span_id=new_span_id(),
+            span_id=span_id or new_span_id(),
             parent_id=parent.span_id if parent else None,
             start=now if start is None else start,
             ts=self.clock.wall(),
@@ -291,7 +303,16 @@ class Tracer:
                 self._traces[sp.trace_id] = bucket
             if bucket.add(sp):
                 self.registry.inc("tracing_dropped_total", kind="span")
+            self._seq += 1
+            bucket.last_seq = self._seq
             self.registry.inc("tracing_spans_total")
+
+    @property
+    def cursor(self) -> int:
+        """The current completion index: pass it back as ``since=`` to
+        receive only traces that recorded spans after this read."""
+        with self._lock:
+            return self._seq
 
     def clear(self) -> None:
         with self._lock:
@@ -328,15 +349,24 @@ class Tracer:
         min_ms: float = 0.0,
         name: str = "",
         limit: int = 50,
+        since: int = 0,
     ) -> list[dict]:
         """Assembled traces, most recent first.  ``name`` matches a
         substring of any span name; ``min_ms`` filters on total trace
-        duration; ``trace_id`` selects exactly one."""
+        duration; ``trace_id`` selects exactly one.  ``since`` is a
+        completion-index cursor (``Tracer.cursor``): only traces that
+        recorded a span AFTER that read are returned, so a periodic
+        scraper ships deltas instead of re-fetching the whole ring."""
         with self._lock:
-            snap = [(tid, b.spans()) for tid, b in self._traces.items()]
+            snap = [
+                (tid, b.spans(), b.last_seq)
+                for tid, b in self._traces.items()
+            ]
         out = []
-        for tid, spans in reversed(snap):
+        for tid, spans, last_seq in reversed(snap):
             if not spans or (trace_id and tid != trace_id):
+                continue
+            if since and last_seq <= since:
                 continue
             if name and not any(name in s.name for s in spans):
                 continue

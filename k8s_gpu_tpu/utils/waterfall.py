@@ -1,0 +1,723 @@
+"""Fleet waterfall: cross-process trace stitching and per-request
+critical-path attribution.
+
+PR 15's gateway propagates ``traceparent`` to replicas and both sides
+journal the trace id, but the spans land in two separate per-process
+rings — nobody can answer "where did THIS request's 900ms go: gateway
+routing, network, replica queue, prefill, or decode?".
+``FleetTraceAssembler`` is the missing stitcher:
+
+- **scrape**: FleetCollector-style targets — ``{process_name: url}``
+  fetches ``/debug/traces?since=<cursor>`` (the tracer's completion
+  index, so each pass ships only new traces) plus ``/debug/requests``
+  (journal context; optional — a target without a journal just skips
+  it), and ``{process_name: callable}`` returns the same JSON shape
+  in-process (fully deterministic in tests).  Targets iterate in
+  sorted name order; spans dedup by span id, so re-scraping is
+  idempotent.
+- **stitch**: spans merge by trace id into ONE tree per request.  The
+  gateway mints a ``gateway.dispatch`` span per downstream contact and
+  propagates that span's PRE-MINTED id as the attempt's
+  ``traceparent``, so the replica's server span parents to the attempt
+  — a structural cross-process edge that survives both rings being
+  scraped independently.
+- **clock alignment**: each process runs its own monotonic clock with
+  an arbitrary origin.  For every (dispatch span, child server span)
+  pair the replica's offset is estimated as the difference of the two
+  spans' midpoints, averaged over the trace's pairs — pinning the
+  child span centered inside its enclosing dispatch span.  The offset
+  is REPORTED (``e2e_clock_skew_seconds{process=}``), never hidden;
+  its honesty limit is that the request/response network legs are
+  assumed symmetric, so ``network_gap`` splits evenly when they are
+  not.  A process with spans but no pair stays unaligned and flags the
+  trace.
+- **attribution**: a priority interval sweep over the client-observed
+  window decomposes E2E (and TTFT, when a prefill span marks the first
+  token) into an exhaustive partition — ``gateway_route`` (request
+  start → first contact), one ``retry_hop`` per failed rehash attempt
+  (a kill-mid-burst request shows the dead replica's partial spans AND
+  the survivor's completion in one trace), ``network_gap`` (serving
+  dispatch time not covered by the replica's server span, split
+  request/response side), ``queue_wait``/``prefill``/``decode`` from
+  the serving replica's batcher spans, and an explicit
+  ``unattributed`` residual — segments always sum to the
+  client-observed elapsed, never to a story.
+- **export**: ``e2e_latency_seconds{segment=}`` histograms per stitched
+  request, ``e2e_traces_total`` / ``e2e_missing_spans_total`` counters
+  (a process that died mid-request leaves a flagged, counted hole),
+  ``e2e_scrape_failures_total{process=}`` for scrape liveness, and the
+  skew gauges above.  ``/debug/waterfall`` (utils/obs.py) serves the
+  snapshot as sort_keys JSON — byte-identical across two FakeClock
+  runs over the same captured rings — and ``chrome()`` emits the
+  multi-process Perfetto export (utils/profiler.chrome_trace
+  ``by_process`` form: one named pid per process, shared timeline).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+
+from .clock import Clock, RealClock
+from .metrics import MetricsRegistry, global_metrics
+
+# The exhaustive E2E partition, in claim-priority order: an earlier
+# segment wins overlapping time (batcher spans legitimately overlap —
+# a fused prefill covers the first decode round), and ``unattributed``
+# is the residual that makes the sum exact.
+SEGMENTS = (
+    "gateway_route",
+    "retry_hop",
+    "network_gap",
+    "queue_wait",
+    "prefill",
+    "decode",
+    "unattributed",
+)
+
+_SERVE_SEGMENTS = (
+    ("queue_wait", "serve.queue_wait"),
+    ("prefill", "serve.prefill"),
+    ("decode", "serve.round"),
+)
+
+
+def _claim(covered: list, lo: float, hi: float) -> float:
+    """Claim ``[lo, hi)`` minus already-covered time: returns the
+    seconds gained and folds the gained pieces into ``covered`` (a
+    sorted list of disjoint intervals) — the sweep primitive that makes
+    the partition exhaustive and double-count-free."""
+    if hi <= lo:
+        return 0.0
+    pieces = []
+    cur = lo
+    for c0, c1 in covered:
+        if c1 <= cur:
+            continue
+        if c0 >= hi:
+            break
+        if c0 > cur:
+            pieces.append((cur, min(c0, hi)))
+        cur = max(cur, c1)
+        if cur >= hi:
+            break
+    if cur < hi:
+        pieces.append((cur, hi))
+    if not pieces:
+        return 0.0
+    covered.extend(pieces)
+    covered.sort()
+    merged = []
+    for c0, c1 in covered:
+        if merged and c0 <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], c1))
+        else:
+            merged.append((c0, c1))
+    covered[:] = merged
+    return sum(p1 - p0 for p0, p1 in pieces)
+
+
+def _flatten_tree(trace: dict) -> list[dict]:
+    """One assembled trace (the ``/debug/traces`` shape) → flat span
+    dicts, children stripped — the stitcher re-parents across
+    processes, so source nesting is only a transport detail."""
+    out = []
+    stack = list(trace.get("tree") or [])
+    while stack:
+        node = stack.pop()
+        out.append({k: v for k, v in node.items() if k != "children"})
+        stack.extend(node.get("children") or [])
+    return out
+
+
+def split_by_process(
+    traces: list[dict],
+    gateway_label: str = "fleet-frontend",
+    gateway_name: str = "gateway",
+) -> dict[str, list[dict]]:
+    """Split assembled traces from ONE shared in-process tracer ring
+    into the per-process fragments separate rings would hold — the
+    test/demo harness for real stitching without real processes.
+
+    Gateway spans are those labeled ``server=<gateway_label>`` plus
+    every ``gateway.dispatch``; a span parented to a dispatch span
+    belongs to that dispatch's ``replica``; everything else inherits
+    its parent's process.  The replica fragment's server span keeps its
+    (now unresolved) ``parent_id`` — exactly what a real per-process
+    ring ships."""
+    by_proc: dict[str, dict[str, list[dict]]] = {}
+    for tr in traces:
+        tid = str(tr.get("trace_id") or "")
+        spans = sorted(
+            _flatten_tree(tr), key=lambda s: str(s.get("span_id"))
+        )
+        byid = {str(s.get("span_id")): s for s in spans}
+        proc: dict[str, str] = {}
+        for s in spans:
+            attrs = s.get("attributes") or {}
+            if (
+                s.get("name") == "gateway.dispatch"
+                or attrs.get("server") == gateway_label
+            ):
+                proc[str(s.get("span_id"))] = gateway_name
+        changed = True
+        while changed:
+            changed = False
+            for s in spans:
+                sid = str(s.get("span_id"))
+                if sid in proc:
+                    continue
+                parent = byid.get(str(s.get("parent_id") or ""))
+                if parent is None:
+                    continue
+                psid = str(parent.get("span_id"))
+                if psid not in proc:
+                    continue
+                if parent.get("name") == "gateway.dispatch":
+                    rep = (parent.get("attributes") or {}).get("replica")
+                    proc[sid] = str(rep) if rep else proc[psid]
+                else:
+                    proc[sid] = proc[psid]
+                changed = True
+        for s in spans:
+            p = proc.get(str(s.get("span_id")), gateway_name)
+            by_proc.setdefault(p, {}).setdefault(tid, []).append(s)
+    out: dict[str, list[dict]] = {}
+    for p in sorted(by_proc):
+        frags = []
+        for tid in sorted(by_proc[p]):
+            sps = sorted(
+                by_proc[p][tid],
+                key=lambda s: (
+                    float(s.get("start", 0.0)), str(s.get("span_id"))
+                ),
+            )
+            frags.append({
+                "trace_id": tid,
+                "span_count": len(sps),
+                "tree": [dict(s) for s in sps],
+            })
+        out[p] = frags
+    return out
+
+
+class FleetTraceAssembler:
+    """Scrapes per-process span rings into stitched per-request
+    waterfalls (see module docstring for the model)."""
+
+    # Lock contract (graftcheck lockcheck + utils.faults
+    # guard_declared): the span store and scrape bookkeeping are shared
+    # between a periodic scrape thread and /debug/waterfall HTTP
+    # handlers.  ``_scrape_lock`` serializes whole passes (ordering,
+    # not state), the same split utils/federation.py uses.
+    _GUARDED_BY = {
+        "_lock": (
+            "_targets", "_cursors", "_spans", "_journal", "_exported",
+            "_scrapes", "_last_scrape",
+        ),
+    }
+
+    def __init__(
+        self,
+        targets: dict | None = None,
+        registry: MetricsRegistry | None = None,
+        clock: Clock | None = None,
+        timeout: float = 5.0,
+        max_traces: int = 256,
+        scrape_limit: int = 200,
+    ):
+        self.registry = registry or global_metrics
+        self.clock = clock or RealClock()
+        self.timeout = float(timeout)
+        self.max_traces = max(1, int(max_traces))
+        self.scrape_limit = max(1, int(scrape_limit))
+        self._lock = threading.Lock()
+        self._scrape_lock = threading.Lock()
+        self._targets: dict[str, object] = {}
+        self._cursors: dict[str, int] = {}
+        # trace_id → span_id → (process, span dict); insertion-ordered
+        # for FIFO eviction, exactly like the tracer's own ring.
+        self._spans: "OrderedDict[str, dict]" = OrderedDict()
+        # trace_id → process → journal record (bounded by _spans: only
+        # traces we hold spans for keep journal context).
+        self._journal: dict[str, dict] = {}
+        self._exported: set[str] = set()
+        self._scrapes = 0
+        self._last_scrape: float | None = None
+        for name, target in (targets or {}).items():
+            self.add_target(name, target)
+
+    # -- target management -------------------------------------------------
+    def add_target(self, name: str, target) -> None:
+        with self._lock:
+            self._targets[str(name)] = target
+            self._cursors.setdefault(str(name), 0)
+
+    def remove_target(self, name: str) -> None:
+        with self._lock:
+            self._targets.pop(name, None)
+            self._cursors.pop(name, None)
+
+    def process_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._targets)
+
+    @property
+    def never_scraped(self) -> bool:
+        with self._lock:
+            return self._scrapes == 0
+
+    # -- scraping ----------------------------------------------------------
+    def _fetch(self, target, cursor: int):
+        """One target → (traces, new_cursor_or_None, journal_records).
+        Callables return the ``/debug/traces`` JSON shape (dict or
+        text) and may carry ``requests`` inline; URLs fetch both
+        endpoints, journal optional."""
+        if callable(target):
+            raw = target()
+            if isinstance(raw, (str, bytes)):
+                raw = json.loads(raw)
+            if isinstance(raw, list):
+                return raw, None, []
+            return (
+                raw.get("traces") or [],
+                raw.get("cursor"),
+                raw.get("requests") or [],
+            )
+        import urllib.request
+
+        base = str(target).rstrip("/")
+        url = (
+            f"{base}/debug/traces?since={int(cursor)}"
+            f"&limit={self.scrape_limit}"
+        )
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            body = json.loads(r.read().decode())
+        records: list = []
+        try:
+            req_url = f"{base}/debug/requests?limit={self.scrape_limit}"
+            with urllib.request.urlopen(
+                req_url, timeout=self.timeout
+            ) as r:
+                records = json.loads(r.read().decode()).get(
+                    "requests"
+                ) or []
+        except Exception:
+            records = []  # no journal on this target — context, not data
+        return body.get("traces") or [], body.get("cursor"), records
+
+    def scrape_once(self) -> dict[str, bool]:
+        """One assembly pass over every target (sorted order —
+        deterministic); returns ``{process: scraped_ok}``.  Concurrent
+        calls serialize, never interleave."""
+        with self._scrape_lock:
+            return self._scrape_once_locked()
+
+    def _scrape_once_locked(self) -> dict[str, bool]:
+        with self._lock:
+            targets = sorted(self._targets.items())
+            cursors = dict(self._cursors)
+        up: dict[str, bool] = {}
+        for name, target in targets:
+            try:
+                traces, cursor, records = self._fetch(
+                    target, cursors.get(name, 0)
+                )
+            except Exception:
+                self.registry.inc(
+                    "e2e_scrape_failures_total", process=name
+                )
+                up[name] = False
+                continue
+            self._ingest(name, traces, records)
+            if cursor is not None:
+                with self._lock:
+                    self._cursors[name] = int(cursor)
+            up[name] = True
+        self._export()
+        with self._lock:
+            self._scrapes += 1
+            self._last_scrape = self.clock.now()
+        return up
+
+    def _ingest(self, process: str, traces: list, records: list) -> None:
+        with self._lock:
+            for tr in traces:
+                tid = str(tr.get("trace_id") or "")
+                if not tid:
+                    continue
+                bucket = self._spans.get(tid)
+                if bucket is None:
+                    while len(self._spans) >= self.max_traces:
+                        old, _ = self._spans.popitem(last=False)
+                        self._journal.pop(old, None)
+                        self._exported.discard(old)
+                    bucket = {}
+                    self._spans[tid] = bucket
+                for sp in _flatten_tree(tr):
+                    sid = str(sp.get("span_id") or "")
+                    if sid:
+                        bucket[sid] = (process, sp)
+            for rec in records:
+                tid = rec.get("trace_id")
+                if tid and tid in self._spans:
+                    self._journal.setdefault(tid, {}).setdefault(
+                        process, dict(rec)
+                    )
+
+    def _export(self) -> None:
+        """Metrics for traces newly complete (stitched gateway root):
+        exactly once per trace, after the whole pass — the gateway and
+        its replicas land in the same pass, so a one-pass scrape sees
+        the full request."""
+        with self._lock:
+            pending = [
+                (tid, dict(bucket))
+                for tid, bucket in self._spans.items()
+                if tid not in self._exported
+            ]
+        offsets: dict[str, float] = {}
+        for tid, members in pending:
+            wf = self._stitch(tid, members)
+            if not wf.get("stitched"):
+                continue
+            with self._lock:
+                self._exported.add(tid)
+            for seg in SEGMENTS:
+                self.registry.observe(
+                    "e2e_latency_seconds",
+                    wf["segments"][seg]["seconds"], segment=seg,
+                )
+            self.registry.inc("e2e_traces_total")
+            if wf["missing_spans"]:
+                self.registry.inc("e2e_missing_spans_total")
+            for proc in sorted(wf["processes"]):
+                offsets[proc] = wf["processes"][proc]["offset_s"]
+        for proc in sorted(offsets):
+            self.registry.set_gauge(
+                "e2e_clock_skew_seconds", offsets[proc], process=proc
+            )
+
+    # -- stitching ---------------------------------------------------------
+    def _stitch(self, trace_id: str, members: dict) -> dict:
+        """One trace's scraped spans → the stitched waterfall dict.
+        Pure over its inputs: identical members produce byte-identical
+        sort_keys JSON — the two-run contract /debug/waterfall pins."""
+        spans: dict[str, dict] = {}
+        proc_of: dict[str, str] = {}
+        for sid, (proc, sp) in members.items():
+            spans[sid] = sp
+            proc_of[sid] = proc
+
+        def t0(s):
+            return float(s.get("start", 0.0))
+
+        def t1(s):
+            return t0(s) + float(s.get("duration_ms", 0.0)) / 1000.0
+
+        children: dict[str, list[str]] = {}
+        for sid in sorted(spans):
+            pid = spans[sid].get("parent_id")
+            if pid:
+                children.setdefault(str(pid), []).append(sid)
+
+        dispatch = sorted(
+            (s for s in spans.values()
+             if s.get("name") == "gateway.dispatch"),
+            key=lambda s: (
+                int((s.get("attributes") or {}).get("attempt", 0) or 0),
+                t0(s), str(s.get("span_id")),
+            ),
+        )
+        root = None
+        if dispatch:
+            root = spans.get(str(dispatch[0].get("parent_id") or ""))
+        if root is None:
+            cands = sorted(
+                (s for s in spans.values()
+                 if str(s.get("name", "")).startswith("http ")
+                 and str(s.get("parent_id") or "") not in spans),
+                key=lambda s: (t0(s), str(s.get("span_id"))),
+            )
+            root = cands[0] if cands else None
+        gw_proc = None
+        if dispatch:
+            gw_proc = proc_of[str(dispatch[0]["span_id"])]
+        elif root is not None:
+            gw_proc = proc_of[str(root["span_id"])]
+
+        # -- clock alignment: pin each child server span inside its
+        # enclosing dispatch span (midpoint difference, averaged).
+        server_of: dict[str, dict] = {}
+        pair_deltas: dict[str, list[float]] = {}
+        for d in dispatch:
+            kids = sorted(
+                (spans[k] for k in children.get(str(d["span_id"]), [])
+                 if str(spans[k].get("name", "")).startswith("http ")),
+                key=lambda s: (t0(s), str(s.get("span_id"))),
+            )
+            if not kids:
+                continue
+            s = kids[0]
+            server_of[str(d["span_id"])] = s
+            d_mid = (t0(d) + t1(d)) / 2.0
+            s_mid = (t0(s) + t1(s)) / 2.0
+            pair_deltas.setdefault(
+                proc_of[str(s["span_id"])], []
+            ).append(d_mid - s_mid)
+
+        offsets: dict[str, float] = {}
+        processes: dict[str, dict] = {}
+        for p in sorted(set(proc_of.values())):
+            deltas = pair_deltas.get(p, [])
+            if p == gw_proc:
+                off, pairs, aligned = 0.0, 0, True
+            else:
+                off = sum(deltas) / len(deltas) if deltas else 0.0
+                pairs, aligned = len(deltas), bool(deltas)
+            offsets[p] = off
+            processes[p] = {
+                "offset_s": round(off, 9),
+                "pairs": pairs,
+                "aligned": aligned,
+            }
+
+        def a0(s):
+            return t0(s) + offsets.get(proc_of[str(s["span_id"])], 0.0)
+
+        def a1(s):
+            return t1(s) + offsets.get(proc_of[str(s["span_id"])], 0.0)
+
+        stitched = bool(root is not None and dispatch)
+        unaligned = any(
+            not info["aligned"] for info in processes.values()
+        )
+
+        # -- stitched, aligned tree (cross-process parents resolve) ----
+        rel = t0(root) if root is not None else min(
+            (a0(s) for s in spans.values()), default=0.0
+        )
+        nodes: dict[str, dict] = {}
+        for sid in sorted(spans):
+            sp = spans[sid]
+            nodes[sid] = {
+                "name": sp.get("name"),
+                "process": proc_of[sid],
+                "span_id": sid,
+                "parent_id": sp.get("parent_id"),
+                "start_s": round(a0(sp) - rel, 9),
+                "duration_ms": round((t1(sp) - t0(sp)) * 1000.0, 6),
+                "status": sp.get("status", "ok"),
+                "attributes": dict(sp.get("attributes") or {}),
+                "children": [],
+            }
+        roots: list[dict] = []
+        for sid in sorted(
+            nodes, key=lambda x: (nodes[x]["start_s"], x)
+        ):
+            n = nodes[sid]
+            parent = nodes.get(str(n["parent_id"] or ""))
+            (parent["children"] if parent is not None
+             else roots).append(n)
+
+        wf: dict = {
+            "trace_id": trace_id,
+            "stitched": stitched,
+            "span_count": len(spans),
+            "missing_spans": (not stitched) or unaligned,
+            "processes": processes,
+            "tree": roots,
+        }
+        if not stitched:
+            return wf
+
+        # -- critical-path partition (priority interval sweep) ---------
+        R0, R1 = t0(root), t1(root)
+        e2e = max(0.0, R1 - R0)
+        serving = None
+        for d in reversed(dispatch):
+            outcome = (d.get("attributes") or {}).get("outcome")
+            if outcome in ("ok", "stream"):
+                serving = d
+                break
+        if serving is None:
+            serving = dispatch[-1]
+
+        claims: list[tuple[str, float, float]] = [
+            ("gateway_route", R0, a0(dispatch[0])),
+        ]
+        for i, d in enumerate(dispatch):
+            if d is serving:
+                continue
+            hop_hi = (
+                a0(dispatch[i + 1]) if i + 1 < len(dispatch) else a1(d)
+            )
+            claims.append(("retry_hop", a0(d), hop_hi))
+        net = {"request_s": 0.0, "response_s": 0.0}
+        srv_proc = None
+        srv = server_of.get(str(serving["span_id"]))
+        if srv is not None:
+            srv_proc = proc_of[str(srv["span_id"])]
+            d0, d1 = a0(serving), a1(serving)
+            s0, s1 = a0(srv), a1(srv)
+            claims.append(("network_gap", d0, min(s0, d1)))
+            claims.append(("network_gap", max(s1, d0), d1))
+            net["request_s"] = round(max(0.0, min(s0, d1) - d0), 9)
+            net["response_s"] = round(max(0.0, d1 - max(s1, d0)), 9)
+        if srv_proc is not None:
+            for seg, name in _SERVE_SEGMENTS:
+                for sid in sorted(spans):
+                    sp = spans[sid]
+                    if (
+                        sp.get("name") == name
+                        and proc_of[sid] == srv_proc
+                    ):
+                        claims.append((seg, a0(sp), a1(sp)))
+
+        def sweep(hi_bound: float):
+            covered: list = []
+            segs = {seg: 0.0 for seg in SEGMENTS}
+            span_total = max(0.0, hi_bound - R0)
+            for seg, lo, hi in claims:
+                segs[seg] += _claim(
+                    covered, max(lo, R0), min(hi, hi_bound)
+                )
+            covered_total = sum(c1 - c0 for c0, c1 in covered)
+            segs["unattributed"] = max(0.0, span_total - covered_total)
+            return segs, span_total
+
+        segs, _ = sweep(R1)
+        segments = {
+            seg: {
+                "seconds": round(segs[seg], 9),
+                "share": (
+                    round(segs[seg] / e2e, 6) if e2e > 0 else 0.0
+                ),
+            }
+            for seg in SEGMENTS
+        }
+        critical = max(SEGMENTS, key=lambda s: segs[s])
+
+        ttft = None
+        ttft_segments = None
+        if srv_proc is not None:
+            ends = sorted(
+                a1(spans[sid]) for sid in sorted(spans)
+                if spans[sid].get("name") == "serve.prefill"
+                and proc_of[sid] == srv_proc
+            )
+            if ends:
+                ttft_end = min(max(R0, ends[0]), R1)
+                tsegs, tspan = sweep(ttft_end)
+                ttft = round(tspan, 9)
+                ttft_segments = {
+                    seg: round(tsegs[seg], 9) for seg in SEGMENTS
+                }
+
+        attempts = []
+        for i, d in enumerate(dispatch):
+            attrs = d.get("attributes") or {}
+            attempts.append({
+                "attempt": int(attrs.get("attempt", i + 1) or (i + 1)),
+                "replica": str(attrs.get("replica", "?")),
+                "outcome": str(attrs.get("outcome", "?")),
+                "status": d.get("status", "ok"),
+                "start_s": round(a0(d) - R0, 9),
+                "end_s": round(a1(d) - R0, 9),
+                "server_span": str(d["span_id"]) in server_of,
+            })
+        served_ok = (
+            (serving.get("attributes") or {}).get("outcome")
+            in ("ok", "stream")
+        )
+        wf["missing_spans"] = unaligned or (served_ok and srv is None)
+        wf.update({
+            "e2e_s": round(e2e, 9),
+            "ttft_s": ttft,
+            "segments": segments,
+            "ttft_segments": ttft_segments,
+            "critical": critical,
+            "network": net,
+            "attempts": attempts,
+        })
+        return wf
+
+    # -- read surface ------------------------------------------------------
+    def waterfall(self, trace_id: str) -> dict | None:
+        """The full stitched waterfall for one trace (None if the
+        assembler holds no spans for it), journal context attached when
+        a scraped ``/debug/requests`` record matched."""
+        with self._lock:
+            members = dict(self._spans.get(trace_id) or {})
+            journal = {
+                p: dict(r)
+                for p, r in (self._journal.get(trace_id) or {}).items()
+            }
+        if not members:
+            return None
+        wf = self._stitch(trace_id, members)
+        if journal:
+            wf["journal"] = journal
+        return wf
+
+    def snapshot(self, limit: int = 50) -> dict:
+        """The ``/debug/waterfall`` listing: stitched request traces,
+        most recent first — per-trace E2E/TTFT, the starred critical
+        segment, attempt count, and the missing-span flag."""
+        with self._lock:
+            tids = list(self._spans)
+            scrapes = self._scrapes
+        out = []
+        for tid in reversed(tids):
+            wf = self.waterfall(tid)
+            if wf is None or not wf["stitched"]:
+                continue
+            out.append({
+                "trace_id": tid,
+                "e2e_s": wf["e2e_s"],
+                "ttft_s": wf["ttft_s"],
+                "critical": wf["critical"],
+                "attempts": len(wf["attempts"]),
+                "missing_spans": wf["missing_spans"],
+            })
+            if len(out) >= max(1, int(limit)):
+                break
+        return {"scrapes": scrapes, "traces": out}
+
+    def chrome(self, trace_id: str) -> dict | None:
+        """Multi-process Perfetto export of one stitched trace: the
+        aligned tree regrouped into per-process fragments, handed to
+        ``profiler.chrome_trace(by_process=...)`` — gateway and every
+        replica render as named processes on one shared timeline."""
+        wf = self.waterfall(trace_id)
+        if wf is None:
+            return None
+        from .profiler import chrome_trace
+
+        frags: dict[str, list[dict]] = {}
+
+        def walk(node: dict, parent_conv, parent_proc) -> None:
+            proc = node["process"]
+            conv = {
+                "name": node["name"],
+                "start": node["start_s"],
+                "duration_ms": node["duration_ms"],
+                "attributes": node["attributes"],
+                "status": node["status"],
+                "children": [],
+            }
+            if parent_conv is not None and proc == parent_proc:
+                parent_conv["children"].append(conv)
+            else:
+                frags.setdefault(proc, []).append(conv)
+            for child in node["children"]:
+                walk(child, conv, proc)
+
+        for r in wf["tree"]:
+            walk(r, None, None)
+        by_process = {
+            p: [{"trace_id": trace_id, "tree": frags[p]}]
+            for p in sorted(frags)
+        }
+        return chrome_trace(by_process=by_process)

@@ -1,0 +1,494 @@
+"""Fleet waterfall (utils/waterfall.py): cross-process trace stitching
+and per-request critical-path attribution.
+
+Two halves.  The synthetic half pins the math: a hand-built
+gateway+replica trace with a known 5000s clock skew must stitch into
+the exact segment partition (segments + unattributed summing to the
+client-observed elapsed), report the skew rather than hide it, and
+produce byte-identical sort_keys JSON across two fresh assembler runs
+— the /debug/waterfall contract.  The live half drives the real thing:
+traceparent through the gateway's ndjson streaming path with
+``x-trace-id`` echoed on every outcome including sheds, then the chaos
+drill — a gateway over two live LmServers, one killed mid-burst, and
+the rehashed request's SINGLE stitched trace showing the dead
+replica's failed attempt AND the survivor's completion, with
+``retry_hop`` attributed and the partition still exact.
+"""
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from k8s_gpu_tpu.data import BpeTokenizer
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.serve import FleetFrontend, LmServer
+from k8s_gpu_tpu.utils import (
+    FakeClock,
+    FleetTraceAssembler,
+    MetricsRegistry,
+    split_by_process,
+)
+from k8s_gpu_tpu.utils.obs import MetricsServer
+from k8s_gpu_tpu.utils.tracing import SpanContext, Tracer, global_tracer
+
+PAGE = 8
+
+TENANT_PROMPTS = {
+    "acme": ("the cat sat on the log. the dog sat on the mat. "
+             "the mat sat on the cat."),
+    "blue": ("the dog sat on the mat. the cat sat on the log. "
+             "the log sat on the dog."),
+}
+
+
+# -- synthetic fixtures ---------------------------------------------------
+
+TID = "ab" * 16
+GW_ROOT = "aa" * 8
+D1 = "d1" * 8
+D2 = "d2" * 8
+SRV = "e5" * 8
+# rep-b's monotonic origin sits 5000s behind the gateway's: every
+# rep-b-local timestamp below is true_time + 5000.
+SKEW = 5000.0
+
+
+def _span(name, sid, parent, start, dur_ms, status="ok", **attrs):
+    return {
+        "name": name, "trace_id": TID, "span_id": sid,
+        "parent_id": parent, "start": start, "duration_ms": dur_ms,
+        "ts": 0.0, "attributes": attrs, "status": status,
+    }
+
+
+def _frag(spans):
+    return {"trace_id": TID, "span_count": len(spans), "tree": spans}
+
+
+def _synthetic_targets():
+    """A gateway fragment and a skewed replica fragment whose stitched
+    partition is known exactly: e2e 1.0s = gateway_route 0.10 +
+    retry_hop 0.20 + network_gap 0.10 (0.05 each leg) + queue_wait 0.04
+    + prefill 0.15 + decode 0.29 + unattributed 0.12."""
+    gw = _frag([
+        _span("http POST /generate", GW_ROOT, "cd" * 8, 10.0, 1000.0,
+              server="fleet-frontend"),
+        _span("gateway.dispatch", D1, GW_ROOT, 10.1, 200.0,
+              status="error", replica="rep-a", attempt=1,
+              outcome="fail"),
+        _span("gateway.dispatch", D2, GW_ROOT, 10.3, 650.0,
+              replica="rep-b", attempt=2, outcome="ok"),
+    ])
+    rb = _frag([
+        _span("http POST /generate", SRV, D2, SKEW + 10.35, 550.0,
+              server="lm-server"),
+        _span("serve.queue_wait", "b1" * 8, D2, SKEW + 10.35, 40.0),
+        _span("serve.prefill", "b2" * 8, D2, SKEW + 10.39, 150.0,
+              fused=True),
+        _span("serve.round", "b3" * 8, D2, SKEW + 10.54, 290.0),
+    ])
+    return {
+        "gateway": lambda: {"traces": [gw], "cursor": 1},
+        "rep-b": lambda: {"traces": [rb], "cursor": 1},
+    }
+
+
+def _assembler(reg=None):
+    a = FleetTraceAssembler(
+        targets=_synthetic_targets(),
+        registry=reg or MetricsRegistry(), clock=FakeClock(),
+    )
+    assert a.scrape_once() == {"gateway": True, "rep-b": True}
+    return a
+
+
+# -- the exact partition --------------------------------------------------
+
+
+def test_synthetic_stitch_segments_exact():
+    reg = MetricsRegistry()
+    wf = _assembler(reg).waterfall(TID)
+    assert wf["stitched"] and not wf["missing_spans"]
+    assert wf["e2e_s"] == pytest.approx(1.0, abs=1e-9)
+    secs = {s: wf["segments"][s]["seconds"] for s in wf["segments"]}
+    assert secs["gateway_route"] == pytest.approx(0.10, abs=1e-9)
+    assert secs["retry_hop"] == pytest.approx(0.20, abs=1e-9)
+    assert secs["network_gap"] == pytest.approx(0.10, abs=1e-9)
+    assert secs["queue_wait"] == pytest.approx(0.04, abs=1e-9)
+    assert secs["prefill"] == pytest.approx(0.15, abs=1e-9)
+    assert secs["decode"] == pytest.approx(0.29, abs=1e-9)
+    assert secs["unattributed"] == pytest.approx(0.12, abs=1e-9)
+    # The exhaustiveness contract: segments sum to the client-observed
+    # elapsed — exactly, because unattributed is the residual.
+    assert abs(sum(secs.values()) - wf["e2e_s"]) < 1e-8
+    assert wf["critical"] == "decode"
+    # Symmetric-legs network split, both sides reported.
+    assert wf["network"]["request_s"] == pytest.approx(0.05, abs=1e-9)
+    assert wf["network"]["response_s"] == pytest.approx(0.05, abs=1e-9)
+    # TTFT clips the same sweep at first prefill end: 0.54s, with the
+    # response network leg and decode excluded.
+    assert wf["ttft_s"] == pytest.approx(0.54, abs=1e-9)
+    assert wf["ttft_segments"]["decode"] == pytest.approx(0.0, abs=1e-9)
+    assert wf["ttft_segments"]["network_gap"] == pytest.approx(
+        0.05, abs=1e-9
+    )
+    # Skew is REPORTED, never hidden: the replica pinned 5000s off.
+    assert wf["processes"]["gateway"]["offset_s"] == 0.0
+    assert wf["processes"]["rep-b"]["aligned"]
+    assert wf["processes"]["rep-b"]["pairs"] == 1
+    assert wf["processes"]["rep-b"]["offset_s"] == pytest.approx(
+        -SKEW, abs=1e-6
+    )
+    # Both attempts live in the one stitched trace.
+    assert [a["outcome"] for a in wf["attempts"]] == ["fail", "ok"]
+    assert [a["replica"] for a in wf["attempts"]] == ["rep-a", "rep-b"]
+    assert wf["attempts"][0]["status"] == "error"
+    # Metric export: one stitched trace, skew gauge per process.
+    assert reg.counter("e2e_traces_total") == 1
+    assert reg.counter("e2e_missing_spans_total") == 0
+
+
+def test_two_run_byte_identical():
+    """Two fresh assemblers over identical scraped rings under FakeClock
+    produce byte-identical sort_keys JSON — waterfall AND listing."""
+    a1, a2 = _assembler(), _assembler()
+    assert (
+        json.dumps(a1.waterfall(TID), sort_keys=True)
+        == json.dumps(a2.waterfall(TID), sort_keys=True)
+    )
+    assert (
+        json.dumps(a1.snapshot(), sort_keys=True)
+        == json.dumps(a2.snapshot(), sort_keys=True)
+    )
+
+
+def test_unaligned_process_flags_missing_spans():
+    """A replica whose server span never completed (killed mid-request)
+    leaves the dispatch pair-less: the process reads UNALIGNED and the
+    trace is flagged, not silently absorbed."""
+    targets = _synthetic_targets()
+    # rep-b ships only batcher spans — no "http " server span, so no
+    # (dispatch, server) pair to pin its clock with.
+    rb = _frag([
+        _span("serve.queue_wait", "b1" * 8, D2, SKEW + 10.35, 40.0),
+    ])
+    targets["rep-b"] = lambda: {"traces": [rb], "cursor": 1}
+    reg = MetricsRegistry()
+    a = FleetTraceAssembler(
+        targets=targets, registry=reg, clock=FakeClock()
+    )
+    a.scrape_once()
+    wf = a.waterfall(TID)
+    assert wf["stitched"] and wf["missing_spans"]
+    assert not wf["processes"]["rep-b"]["aligned"]
+    assert reg.counter("e2e_missing_spans_total") == 1
+
+
+def test_chrome_export_one_pid_per_process():
+    ct = _assembler().chrome(TID)
+    procs = {
+        e["pid"]: e["args"]["name"]
+        for e in ct["traceEvents"] if e["name"] == "process_name"
+    }
+    assert procs == {1: "gateway", 2: "rep-b"}
+    slices = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in slices} == {1, 2}
+    # Aligned shared timeline: the replica's server span starts after
+    # the serving dispatch despite its 5000s-skewed source clock.
+    d2 = next(s for s in slices if s["name"] == "gateway.dispatch"
+              and s["args"].get("attempt") == "2")
+    srv = next(s for s in slices if s["pid"] == 2
+               and s["name"].startswith("http "))
+    assert srv["ts"] >= d2["ts"]
+
+
+def test_tracer_since_cursor():
+    """The /debug/traces?since= contract: the completion index only
+    ships traces that recorded a span after the cursor read."""
+    tr = Tracer(clock=FakeClock())
+    c0 = tr.cursor
+    assert c0 == 0
+    t1 = SpanContext("11" * 16, "aa" * 8)
+    tr.add_span("one", parent=t1, start=0.0, end=1.0)
+    c1 = tr.cursor
+    assert c1 == 1
+    assert [t["trace_id"] for t in tr.traces(since=c0)] == [t1.trace_id]
+    assert tr.traces(since=c1) == []
+    t2 = SpanContext("22" * 16, "bb" * 8)
+    tr.add_span("two", parent=t2, start=1.0, end=2.0)
+    assert [t["trace_id"] for t in tr.traces(since=c1)] == [t2.trace_id]
+    # A new span in the OLD trace re-ships it (dedup is the scraper's
+    # job — by span id, which is why overlap is safe and gaps are not).
+    c2 = tr.cursor
+    tr.add_span("one-more", parent=t1, start=2.0, end=3.0)
+    assert {t["trace_id"] for t in tr.traces(since=c2)} == {t1.trace_id}
+
+
+def test_debug_waterfall_endpoint():
+    """MetricsServer serves the assembler: listing, one trace, chrome
+    form — and two servers over identical assemblers answer with
+    byte-identical bodies."""
+    def fetch(port, path):
+        url = f"http://127.0.0.1:{port}{path}"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read()
+
+    srvs = [
+        MetricsServer(
+            registry=MetricsRegistry(), waterfall=_assembler()
+        ).start()
+        for _ in range(2)
+    ]
+    try:
+        bodies = [fetch(s.port, "/debug/waterfall") for s in srvs]
+        assert bodies[0] == bodies[1]
+        listing = json.loads(bodies[0])
+        assert [t["trace_id"] for t in listing["traces"]] == [TID]
+        assert listing["traces"][0]["critical"] == "decode"
+        one = [
+            fetch(s.port, f"/debug/waterfall?trace_id={TID}")
+            for s in srvs
+        ]
+        assert one[0] == one[1]
+        wf = json.loads(one[0])
+        assert wf["stitched"] and wf["e2e_s"] == pytest.approx(1.0)
+        ct = json.loads(
+            fetch(srvs[0].port,
+                  f"/debug/waterfall?trace_id={TID}&chrome=1")
+        )
+        assert any(
+            e["name"] == "process_name"
+            for e in ct["traceEvents"]
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            fetch(srvs[0].port, "/debug/waterfall?trace_id=" + "f" * 32)
+        assert ei.value.code == 404
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+# -- live half: the gateway over real replicas ----------------------------
+
+
+@pytest.fixture(scope="module")
+def stack():
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 40
+    tok = BpeTokenizer.train(corpus, vocab_size=300)
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=32, n_layers=1, n_heads=2,
+        d_head=16, d_ff=64, max_seq=64, use_flash=False,
+    )
+    model = TransformerLM(cfg)
+    model.init(jax.random.PRNGKey(0))
+    return tok, model
+
+
+def _mk_server(stack, name):
+    tok, model = stack
+    params = model.init(jax.random.PRNGKey(0))
+    return LmServer(
+        model, params, tok, slots=4, paged_blocks=64, page_size=PAGE,
+        metrics=MetricsRegistry(), name=name,
+    ).start()
+
+
+def _post(base, path, payload, headers=None, timeout=60.0):
+    req = urllib.request.Request(
+        base.rstrip("/") + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except ValueError:
+            payload = {}
+        return e.code, payload, dict(e.headers)
+
+
+def _gen(tenant, i, extra=None):
+    body = {
+        "prompt": TENANT_PROMPTS[tenant] + f" q{i}",
+        "max_new_tokens": 4, "temperature": 0.0, "tenant": tenant,
+    }
+    body.update(extra or {})
+    return body
+
+
+def _tid(i):
+    return f"{0x57A7ED00 + i:032x}"
+
+
+def test_traceparent_through_gateway_stream(stack):
+    """The ndjson streaming path: the client's traceparent survives the
+    gateway hop into the replica's summary event, and x-trace-id is
+    echoed on the stream headers AND on shed outcomes."""
+    servers = {"ws-0": _mk_server(stack, "ws-0")}
+    tok, _ = stack
+    fe = FleetFrontend(
+        tok, page_size=PAGE, metrics=MetricsRegistry()
+    ).start()
+    try:
+        fe.register_replica(
+            "ws-0", f"http://127.0.0.1:{servers['ws-0'].port}"
+        )
+        trace_id = "ab" * 16
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", fe.port, timeout=60
+        )
+        conn.request(
+            "POST", "/generate",
+            json.dumps(_gen("blue", 1, {"stream": True,
+                                        "max_new_tokens": 6})),
+            {"Content-Type": "application/json",
+             "traceparent": f"00-{trace_id}-{'cd' * 8}-01"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("x-trace-id") == trace_id
+        lines = [ln for ln in resp.read().splitlines() if ln.strip()]
+        conn.close()
+        summary = json.loads(lines[-1])
+        assert summary["done"] is True
+        # The replica continued OUR trace across both hops.
+        assert summary["trace_id"] == trace_id
+        # Shed outcomes are findable too: a dead deadline never reaches
+        # a replica, yet the 504 still carries the trace id.
+        shed_tid = "ef" * 16
+        code, body, hdrs = _post(
+            fe.url, "/generate", _gen("acme", 2),
+            headers={"traceparent": f"00-{shed_tid}-{'cd' * 8}-01",
+                     "x-request-deadline-ms": "0"},
+        )
+        assert code == 504 and "deadline" in body["error"]
+        assert hdrs["x-trace-id"] == shed_tid
+    finally:
+        fe.stop()
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_kill_mid_burst_single_stitched_trace(stack):
+    """The chaos drill: kill a replica mid-burst; the rehashed request
+    yields ONE stitched waterfall holding the dead replica's failed
+    attempt and the survivor's completion, retry_hop attributed, the
+    partition exact — and the stitch byte-identical across two fresh
+    assembler runs over the same captured rings."""
+    tok, _ = stack
+    servers = {f"wf-{i}": _mk_server(stack, f"wf-{i}") for i in range(2)}
+    fe = FleetFrontend(
+        tok, page_size=PAGE, metrics=MetricsRegistry()
+    ).start()
+    try:
+        for name, srv in servers.items():
+            fe.register_replica(name, f"http://127.0.0.1:{srv.port}")
+        _, _, hdrs = _post(fe.url, "/generate", _gen("acme", 0))
+        victim = hdrs["x-route-replica"]
+        n_burst = 10
+        codes = []
+
+        def fire(i):
+            tenant = "acme" if i % 2 else "blue"
+            code, _, _ = _post(
+                fe.url, "/generate",
+                _gen(tenant, 100 + i, {"max_new_tokens": 12}),
+                headers={"traceparent": f"00-{_tid(i)}-{'cd' * 8}-01"},
+            )
+            codes.append(code)
+
+        def killer():
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if servers[victim].batcher.inflight_requests > 0:
+                    break
+                time.sleep(0.005)
+            servers[victim].stop()
+
+        threads = [threading.Thread(target=killer)]
+        threads += [
+            threading.Thread(target=fire, args=(i,))
+            for i in range(n_burst)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert codes == [200] * n_burst, f"lost requests: {codes}"
+
+        # The http spans close just after the response bytes — wait for
+        # a rehashed trace (>= 2 dispatch attempts) to fully land.
+        def rehashed_tids():
+            out = []
+            for i in range(n_burst):
+                tr = global_tracer.traces(trace_id=_tid(i), limit=1)
+                if not tr:
+                    continue
+                flat = json.dumps(tr[0])
+                if flat.count('"gateway.dispatch"') >= 2:
+                    out.append(_tid(i))
+            return out
+
+        deadline = time.time() + 10.0
+        tids = rehashed_tids()
+        while not tids and time.time() < deadline:
+            time.sleep(0.05)
+            tids = rehashed_tids()
+        assert tids, "no request rehashed — kill landed too late"
+        tid = tids[0]
+
+        # Capture the shared ring ONCE, split into the per-process
+        # fragments real /debug/traces scrapes would ship, then stitch
+        # twice from scratch: the byte-identical contract.
+        captured = global_tracer.traces(trace_id=tid, limit=1)
+        frags = split_by_process(captured)
+        assert "gateway" in frags
+        targets = {
+            p: (lambda p=p: {"traces": frags[p]}) for p in frags
+        }
+        wfs = []
+        for _ in range(2):
+            a = FleetTraceAssembler(
+                targets=targets, registry=MetricsRegistry(),
+                clock=FakeClock(),
+            )
+            a.scrape_once()
+            wfs.append(a.waterfall(tid))
+        assert (
+            json.dumps(wfs[0], sort_keys=True)
+            == json.dumps(wfs[1], sort_keys=True)
+        )
+        wf = wfs[0]
+        assert wf["stitched"]
+        # Both attempts in ONE trace: the dead replica's failed hop and
+        # the survivor's completion.
+        outcomes = [a["outcome"] for a in wf["attempts"]]
+        assert len(wf["attempts"]) >= 2
+        assert "fail" in outcomes and outcomes[-1] == "ok"
+        replicas = [a["replica"] for a in wf["attempts"]]
+        assert victim in replicas
+        assert replicas[-1] != victim
+        # The rehash cost is attributed, not absorbed.
+        secs = {s: wf["segments"][s]["seconds"] for s in wf["segments"]}
+        assert secs["retry_hop"] > 0.0
+        assert secs["prefill"] > 0.0 or secs["decode"] > 0.0
+        # And the partition stays exact even in chaos.
+        assert abs(sum(secs.values()) - wf["e2e_s"]) < 1e-8
+        # The survivor's clock got pinned through its server span.
+        survivor = replicas[-1]
+        assert wf["processes"][survivor]["aligned"]
+    finally:
+        fe.stop()
+        for srv in servers.values():
+            try:
+                srv.stop()
+            except Exception:
+                pass
