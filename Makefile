@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo waterfall-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo
 
 # The default verify path (bare `make`): graftcheck invariants + the
 # attribution-plane smoke + the flash-v2 parity suite (ISSUE 12 — every
@@ -23,7 +23,7 @@ IMAGES = operator trainer devenv
 # train-step guard, all CPU-safe through the Pallas interpreter).  The
 # full suite stays `make test` (it takes minutes); image builds stay
 # `make docker-build`.
-verify: check profile-demo goodput-demo canary-demo frontend-demo waterfall-demo flash-v2-parity
+verify: check profile-demo goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo flash-v2-parity
 
 flash-v2-parity:
 	python -m pytest tests/test_flash_v2.py -q -p no:cacheprovider
@@ -162,6 +162,14 @@ frontend-demo:
 # summing exactly to E2E, byte-identical across two stitching runs.
 waterfall-demo:
 	python tools/waterfall_demo.py
+
+# KV migration chaos drill (ISSUE 17): 2 replicas behind the gateway,
+# one drained while a long stream is mid-flight — wire-level block
+# export/import + router re-home, the cut stream resumes on the
+# survivor (full token budget, zero lost/duplicated, one trace id),
+# and the migrated prefix beats a cold re-prefill by >= 2x TTFT.
+migrate-demo:
+	python tools/migration_demo.py
 
 # Fleet router smoke: 4 paged replicas behind the prefix-affinity
 # router serve skewed multi-tenant traffic (each tenant's shared prompt

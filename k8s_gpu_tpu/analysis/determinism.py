@@ -49,6 +49,10 @@ DETERMINISTIC_PLANES = (
     # and the deterministic-jitter RetryPolicy — the two-run routing
     # snapshot test pins it.
     "k8s_gpu_tpu/serve/frontend.py",
+    # The block migration plane (ISSUE 17): the wire payload carries no
+    # ambient time or randomness (two-run byte-identical exports), and
+    # the coordinator's only duration source is the injected Clock.
+    "k8s_gpu_tpu/serve/migrate.py",
     "k8s_gpu_tpu/utils/alerts.py",
     "k8s_gpu_tpu/utils/federation.py",
     "k8s_gpu_tpu/utils/metrics.py",

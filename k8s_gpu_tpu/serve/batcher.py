@@ -231,6 +231,14 @@ class _Request:
     # placement.  "" for direct submits.
     route_replica: str = ""
     route_reason: str = ""
+    # Live-migration evidence (serve/migrate.py).  ``migrated`` marks a
+    # stream CUT here because its replica exported its KV state away —
+    # the server's truncation summary tells the gateway relay this is a
+    # resumable handover, not a crash.  ``migrated_from`` names the
+    # replica a RESUMED request left (the x-migrated-from header):
+    # journaled, and counted by serve_resumed_requests_total.
+    migrated: bool = False
+    migrated_from: str = ""
 
 
 class RequestHandle:
@@ -272,6 +280,13 @@ class RequestHandle:
         """True when the stream ended because the request's deadline
         passed (shed at admission, or cut between rounds)."""
         return self._req.deadline_expired
+
+    @property
+    def migrated(self) -> bool:
+        """True when the stream was cut because the replica migrated
+        its KV state away (serve/migrate.py) — the truncation is a
+        resumable handover, not a failure."""
+        return self._req.migrated
 
     @property
     def logprobs(self) -> list:
@@ -631,6 +646,13 @@ class ContinuousBatcher:
         self._lifecycle = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
+        # Quiesce barriers (run_quiesced): thunks the scheduler runs at
+        # a round boundary with the dispatch pipeline fully drained —
+        # the pause point block migration exports/imports through.
+        # Enqueued under _lifecycle (same either-or as _pending: a
+        # barrier lands before the death drain empties the queue, or
+        # the caller sees _dead and raises).
+        self._barriers: "queue.Queue[tuple]" = queue.Queue()
         self._round_count = 0
         # Speculative acceptance telemetry (host-side, live rows only).
         self._spec_drafted = 0
@@ -1508,6 +1530,7 @@ class ContinuousBatcher:
         deadline: float | None = None,
         tenant: str | None = None,
         route: tuple | None = None,
+        migrated_from: str = "",
     ) -> RequestHandle:
         """Queue a request; returns a handle streaming generated ids.
         Raises ValueError when the prompt cannot fit, KeyError for an
@@ -1522,7 +1545,10 @@ class ContinuousBatcher:
         distinct tenant strings collapses into the overflow series,
         never unbounded growth.  ``route``: ``(replica, reason)`` from
         a fleet front-end (serve/router.py) — journaled so the request
-        record explains its placement."""
+        record explains its placement.  ``migrated_from`` names the
+        replica this request resumed away from after a KV migration
+        (serve/migrate.py) — journaled, and counted by
+        ``serve_resumed_requests_total``."""
         # error/timeout only: this site has no clock to realize a
         # "slow" decision, and a silently-skipped delay must not be
         # counted as an injection.
@@ -1555,7 +1581,10 @@ class ContinuousBatcher:
             prompt_tokens=int(ids.size),
             route_replica=str(route[0]) if route else "",
             route_reason=str(route[1]) if route else "",
+            migrated_from=str(migrated_from or ""),
         )
+        if req.migrated_from:
+            self.metrics.inc("serve_resumed_requests_total")
         with self._lifecycle:
             if self._dead:
                 raise RuntimeError(
@@ -1740,6 +1769,194 @@ class ContinuousBatcher:
             self._prefix.move_to_end(ids.tobytes())
             while len(self._prefix) > self._prefix_cap:
                 self._prefix.popitem(last=False)
+
+    # -- block migration (serve/migrate.py) --------------------------------
+    def run_quiesced(self, fn, timeout_s: float = 60.0):
+        """Run ``fn()`` ON the scheduler thread at the next round
+        boundary with the dispatch pipeline fully drained — every
+        device write landed, no program in flight.  The pause point
+        block migration exports/imports through: ``fn`` may read block
+        contents, splice new ones, and mutate the pool without racing
+        a decode round.  Blocks the calling thread for the result;
+        ``fn``'s exception re-raises here (the scheduler survives it).
+        Raises RuntimeError when the scheduler is stopped and
+        TimeoutError when no boundary is reached in ``timeout_s`` (the
+        thunk may still run later; its side effects stand)."""
+        box = {
+            "done": threading.Event(), "result": None, "error": None,
+        }
+        with self._lifecycle:
+            if self._dead:
+                raise RuntimeError(
+                    "batcher scheduler is stopped; restart the server"
+                )
+            self._barriers.put((fn, box))
+        self._wake.set()
+        if not box["done"].wait(timeout_s):
+            raise TimeoutError(
+                f"scheduler did not reach a round boundary in "
+                f"{timeout_s:.1f}s"
+            )
+        if box["error"] is not None:
+            raise box["error"]
+        return box["result"]
+
+    def _run_barriers(self) -> None:
+        """Scheduler thread, pipeline drained: run every queued
+        quiesced thunk.  A thunk's exception is delivered to ITS
+        waiter, never raised here — a malformed import must not kill
+        the scheduler serving everyone else."""
+        while True:
+            try:
+                fn, box = self._barriers.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                box["result"] = fn()
+            except Exception as e:
+                box["error"] = e
+            box["done"].set()
+
+    def migrate_export(
+        self, *, abort_live: bool = False, include_blocks: bool = True
+    ) -> dict:
+        """Snapshot every registered block (hash-addressed, full pages,
+        content final) plus the live-stream manifest for the wire —
+        ``serve/migrate.py pack()``'s input.  MUST run under
+        ``run_quiesced`` (reads device cache + mutates scheduler
+        state).  Only registered blocks travel: a partial tail is CoW —
+        the destination recomputes it private, exactly as a local
+        prefix hit would.  ``abort_live=True`` additionally retires
+        every live stream stamped *migrated* (a resumable handover,
+        not a crash — the server's truncation summary tells the
+        gateway relay to fail the stream over).  ``include_blocks=
+        False`` skips block bodies: the coordinator's abort-only
+        second call after the import landed."""
+        if not self.paged:
+            raise ValueError("block migration requires paged KV mode")
+        cache = self._dev["cache"]
+        geometry = {
+            name: {
+                "dtype": np.dtype(arr.dtype).name,
+                # One block's contents: arr[:, blk] per leaf.
+                "shape": (int(arr.shape[0]),) + tuple(
+                    int(s) for s in arr.shape[2:]
+                ),
+            }
+            for name, arr in sorted(cache.items())
+        }
+        blocks: list[tuple[bytes, dict]] = []
+        if include_blocks:
+            items = self._pool.registered()
+            if items:
+                # ONE gather + ONE device_get for the whole export —
+                # per-block fetches would pay N host round-trips.
+                idx = jnp.asarray(
+                    np.asarray([b for _, b in items], np.int32)
+                )
+                sel = jax.device_get(
+                    {name: arr[:, idx] for name, arr in cache.items()}
+                )
+                for j, (h, _) in enumerate(items):
+                    blocks.append((h, {
+                        name: np.ascontiguousarray(sel[name][:, j])
+                        for name in sorted(sel)
+                    }))
+        requests = []
+        for r in self._active:
+            if r is None:
+                continue
+            requests.append({
+                "tenant": r.tenant,
+                "trace_id": (
+                    r.trace_ctx.trace_id if r.trace_ctx is not None
+                    else ""
+                ),
+                "prompt_tokens": int(r.prompt_tokens),
+                "emitted": int(r.emitted),
+            })
+        aborted = 0
+        if abort_live:
+            for slot, r in enumerate(self._active):
+                if r is None:
+                    continue
+                r.migrated = True
+                r.aborted = True
+                self._retire(slot)
+                aborted += 1
+        return {
+            "page_size": self.page_size,
+            "geometry": geometry,
+            "blocks": blocks,
+            "requests": requests,
+            "aborted": aborted,
+        }
+
+    def migrate_import(self, parsed: dict) -> int:
+        """Splice wire blocks (``serve/migrate.py unpack()``'s output)
+        into this pool via the SAME alloc/register/release path a local
+        admission retires through, so a migrated chain is
+        indistinguishable from one prefilled here: alloc a fresh block,
+        write the wire bytes, register its chain hash, release to
+        refcount 0 — it parks in the LRU exactly like a retired
+        prompt's pages, ready for the next matching acquire.  MUST run
+        under ``run_quiesced``.  Hashes already registered are skipped
+        (content-addressed: same hash, same bytes); a pool too full to
+        take more stops early — a partial chain is still a valid
+        (shorter) warm prefix.  Returns the blocks spliced."""
+        if not self.paged:
+            raise ValueError("block migration requires paged KV mode")
+        if int(parsed.get("page_size", 0)) != self.page_size:
+            raise ValueError(
+                f"wire page_size {parsed.get('page_size')} != local "
+                f"{self.page_size}"
+            )
+        cache = self._dev["cache"]
+        geometry = parsed.get("geometry") or {}
+        if sorted(geometry) != sorted(cache):
+            raise ValueError(
+                f"wire cache leaves {sorted(geometry)} != local "
+                f"{sorted(cache)}"
+            )
+        for name, arr in sorted(cache.items()):
+            want_dtype = np.dtype(arr.dtype)
+            want_shape = (int(arr.shape[0]),) + tuple(
+                int(s) for s in arr.shape[2:]
+            )
+            g = geometry[name]
+            if (np.dtype(g["dtype"]) != want_dtype
+                    or tuple(g["shape"]) != want_shape):
+                raise ValueError(
+                    f"leaf {name!r}: wire {g['dtype']}{g['shape']} != "
+                    f"local {want_dtype.name}{want_shape}"
+                )
+        fresh: list[tuple[bytes, int, dict]] = []
+        for h, leaves in parsed.get("blocks", []):
+            if self._pool.contains(h):
+                continue
+            got = self._pool.alloc(1)
+            if got is None:
+                break
+            fresh.append((h, got[0], leaves))
+        if fresh:
+            # ONE scatter per leaf for the whole import — per-block
+            # .at[].set would copy the full pool N times.
+            idx = jnp.asarray(
+                np.asarray([b for _, b, _ in fresh], np.int32)
+            )
+            new_cache = dict(cache)
+            for name in sorted(cache):
+                stacked = np.stack(
+                    [lv[name] for _, _, lv in fresh], axis=1
+                )
+                new_cache[name] = cache[name].at[:, idx].set(
+                    jnp.asarray(stacked, cache[name].dtype)
+                )
+            self._dev["cache"] = self._constrain_cache_paged(new_cache)
+            for h, blk, _ in fresh:
+                self._pool.register(blk, h)
+                self._pool.release(blk)
+        return len(fresh)
 
     def _match_prefix(self, ids: np.ndarray):
         """Longest cached prefix of *ids* (LRU-touched), or None."""
@@ -2601,9 +2818,17 @@ class ContinuousBatcher:
             t_done=time.monotonic(),
             # Probe admission tagging: the `obs requests --no-probes`
             # filter and the /debug/requests probes=0 query key on this.
-            extra=(
-                {"probe": True} if req.tenant == PROBE_TENANT else {}
-            ),
+            # Migration evidence rides the same extra dict: a stream cut
+            # by an export is stamped migrated, a request resumed from
+            # another replica's blocks names where it came from.
+            extra={
+                **({"probe": True} if req.tenant == PROBE_TENANT else {}),
+                **({"migrated": True} if req.migrated else {}),
+                **(
+                    {"migrated_from": req.migrated_from}
+                    if req.migrated_from else {}
+                ),
+            },
         ))
 
     def _shed_expired(self, req: _Request) -> None:
@@ -2897,6 +3122,16 @@ class ContinuousBatcher:
         inflight: collections.deque = collections.deque()
         try:
             while not self._stop.is_set():
+                # Quiesce point (run_quiesced): barriers run at a round
+                # boundary with the dispatch pipeline fully drained, so
+                # a barrier thunk sees every device write landed and no
+                # program in flight — the pause migration export/import
+                # splices through.  Checked FIRST each iteration: live
+                # rows pause between rounds, idle loops wake via _wake.
+                if not self._barriers.empty():
+                    while inflight:
+                        self._drain_one(inflight)
+                    self._run_barriers()
                 any_active = any(r is not None for r in self._active)
                 if (not any_active and self._pending.empty()
                         and not inflight
@@ -3019,7 +3254,14 @@ class ContinuousBatcher:
                 # means every live row's budget is already covered by
                 # in-flight rounds — process one instead so the loop
                 # always makes progress toward retiring those rows.
-                if any(r is not None for r in self._active):
+                # A pending quiesce barrier pauses NEW dispatch: each
+                # round already in flight still lands (the barrier drain
+                # above consumes them), but pipelining further rounds
+                # would race the barrier's purpose — a migration abort
+                # cannot cut a stream whose whole budget was dispatched
+                # ahead of the boundary.
+                if (any(r is not None for r in self._active)
+                        and self._barriers.empty()):
                     # decode_dispatch self-time = gate/sizing + the plain
                     # round's program enqueue; the spec program enqueue
                     # (spec_draft) and any timed-round drain consumption
@@ -3045,6 +3287,19 @@ class ContinuousBatcher:
             # silently truncated 200.
             with self._lifecycle:
                 self._dead = True
+                # Fail queued barriers under the SAME lock acquisition
+                # that sets _dead: run_quiesced either enqueued before
+                # this drain (failed here) or sees _dead and raises —
+                # never a waiter parked on a dead scheduler.
+                while True:
+                    try:
+                        _, box = self._barriers.get_nowait()
+                    except queue.Empty:
+                        break
+                    box["error"] = RuntimeError(
+                        "batcher scheduler stopped"
+                    )
+                    box["done"].set()
                 for r in self._active:
                     if r is not None:
                         r.aborted = True

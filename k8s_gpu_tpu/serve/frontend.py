@@ -68,6 +68,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..cloud.resilience import BreakerBank, RetryPolicy
 from ..utils.clock import Clock, RealClock
+from ..utils.faults import global_faults
 from ..utils.federation import FleetCollector
 from ..utils.metrics import MetricsRegistry, global_metrics
 from ..utils.obs import RequestMetricsMixin
@@ -80,6 +81,7 @@ from ..utils.tracing import (
 from .canary import CanaryProber
 from .journal import RequestJournal
 from .journal import RequestRecord as JournalRecord
+from .migrate import BlockMigrator
 from .router import FleetRouter
 
 log = logging.getLogger("k8s_gpu_tpu.frontend")
@@ -97,11 +99,14 @@ class FleetFrontend:
     plane replayable under ``FakeClock``."""
 
     # Lock contract (graftcheck lockcheck): the replica URL map, the
-    # gateway-local in-flight counters, and the drain state table are
-    # shared between request handler threads, admin handlers, and the
-    # per-drain waiter threads.
+    # gateway-local in-flight counters, the drain state table, and the
+    # live-dispatch table (per-replica in-flight request info — the
+    # forced-drain abandonment audit) are shared between request
+    # handler threads, admin handlers, and the per-drain waiter
+    # threads.
     _GUARDED_BY = {
-        "_lock": ("_replicas", "_inflight", "_drains"),
+        "_lock": ("_replicas", "_inflight", "_drains", "_live",
+                  "_live_seq"),
     }
 
     def __init__(
@@ -162,6 +167,19 @@ class FleetFrontend:
         self._replicas: dict[str, str] = {}     # name -> base URL
         self._inflight: dict[str, int] = {}     # name -> gateway-local
         self._drains: dict[str, dict] = {}      # name -> drain state
+        # Per-replica live-dispatch info: name -> {key -> request info}.
+        # The forced-drain audit surface — when a deadline abandons a
+        # replica's in-flight work, each entry becomes one gateway
+        # journal record instead of silently vanishing.
+        self._live: dict[str, dict[int, dict]] = {}
+        self._live_seq = 0
+        # The wire-level KV migration coordinator (serve/migrate.py):
+        # drains hand a victim's warm chains to the router-chosen new
+        # owner instead of letting them die with the process.
+        self.migrator = BlockMigrator(
+            clock=self.clock, metrics=self.metrics,
+            timeout_s=request_timeout_s,
+        )
         self._stop = threading.Event()
         self._drain_threads: list[threading.Thread] = []
         outer = self
@@ -402,6 +420,26 @@ class FleetFrontend:
                     pinned=pinned,
                 )
                 if out["kind"] == "stream":
+                    # Everything the relay needs to RESUME this stream
+                    # on another replica if its owner dies or migrates
+                    # mid-flight (serve/migrate.py): the original ids,
+                    # the client body, and the remaining-budget inputs.
+                    # A PINNED stream never resumes elsewhere — the
+                    # canary contract is that a dead replica fails its
+                    # probe instead of silently succeeding on another.
+                    try:
+                        want_new = int(body.get("max_new_tokens", 32))
+                    except (TypeError, ValueError):
+                        want_new = 32
+                    if pinned is None:
+                        out["resume_ctx"] = {
+                            "ids": [int(i) for i in ids.tolist()],
+                            "body": body,
+                            "tenant": tenant,
+                            "deadline": deadline,
+                            "trace_ctx": self.trace_ctx,
+                            "max_new": max(1, want_new),
+                        }
                     return self._relay(out)
                 hdrs = dict(out.get("headers") or {})
                 if out.get("replica"):
@@ -410,17 +448,29 @@ class FleetFrontend:
                 return self._json(out["code"], out["payload"], hdrs)
 
             def _relay(self, out):
-                """Relay a downstream ndjson stream event-by-event.  A
-                mid-stream downstream death cannot be retried (tokens
-                already reached the client) — the relay just ends, and
-                the client's summary-event protocol tells it the stream
-                was truncated."""
-                resp = out["resp"]
+                """Relay a downstream ndjson stream event-by-event,
+                with MID-STREAM FAILOVER: the relay parses each event,
+                tracks the token ids already delivered to the client,
+                and when the stream is cut — the replica died, or its
+                drain migrated its KV state away (the ``"migrated"``
+                truncation summary, serve/migrate.py) — it re-dispatches
+                the request as ``prompt_ids = original + emitted`` with
+                the REMAINING token budget, excluding the victim.  The
+                client's ndjson stream continues seamlessly: same
+                connection, same ``x-trace-id``, no duplicated and no
+                lost tokens (greedy decode resumed from a teacher-forced
+                prefix continues exactly).  Resume attempts are capped
+                (``migrate.resume`` fault site); when they exhaust, the
+                client gets an honest truncation summary — degraded,
+                never wrong.  A deadline truncation is NOT resumed: the
+                budget died, new work would be waste."""
+                rctx = out.get("resume_ctx")
+                resp0 = out["resp"]
                 self._last_code = 200
                 self.send_response(200)
                 self.send_header(
                     "Content-Type",
-                    resp.headers.get(
+                    resp0.headers.get(
                         "Content-Type", "application/x-ndjson"
                     ),
                 )
@@ -431,23 +481,165 @@ class FleetFrontend:
                 if ctx is not None:
                     self.send_header("x-trace-id", ctx.trace_id)
                 self.end_headers()
-                events = 0
-                try:
-                    while True:
-                        line = resp.readline()
-                        if not line:
-                            break
-                        events += 1
-                        self.wfile.write(line)
-                        self.wfile.flush()
-                except (OSError, http.client.HTTPException):
-                    pass
-                finally:
+                emitted: list[int] = []
+                segments = 0
+                cur = out
+                while True:
+                    segments += 1
+                    resp = cur["resp"]
+                    seg_tokens = 0
+                    truncated = False
+                    client_gone = False
+                    finished = False
                     try:
-                        resp.close()
-                    except OSError:
-                        pass
-                    out["finish"](max(0, events - 1))
+                        while True:
+                            try:
+                                line = resp.readline()
+                            except (OSError, ValueError,
+                                    http.client.HTTPException):
+                                # ValueError: a migrating drain closed
+                                # this upstream under us
+                                # (_cut_live_streams) — read-on-closed.
+                                truncated = True
+                                break
+                            if not line:
+                                truncated = True
+                                break
+                            ev = None
+                            try:
+                                ev = json.loads(line)
+                            except ValueError:
+                                pass
+                            forward = line
+                            if isinstance(ev, dict):
+                                if "id" in ev and "done" not in ev:
+                                    seg_tokens += 1
+                                    emitted.append(int(ev["id"]))
+                                elif ev.get("done") is True:
+                                    finished = True
+                                    if segments > 1:
+                                        # The summary must describe the
+                                        # WHOLE stream the client saw,
+                                        # not the last segment.
+                                        ev["generated_tokens"] = (
+                                            len(emitted)
+                                        )
+                                        ev["text"] = (
+                                            outer.tokenizer.decode(
+                                                emitted
+                                            )
+                                        )
+                                        ev["resumed"] = segments - 1
+                                        forward = (
+                                            json.dumps(ev) + "\n"
+                                        ).encode()
+                                elif ev.get("done") is False:
+                                    if (ev.get("error")
+                                            == "deadline exceeded"):
+                                        finished = True
+                                    else:
+                                        # "migrated" / aborted: a
+                                        # resumable truncation — do NOT
+                                        # forward it to the client.
+                                        truncated = True
+                                        break
+                            try:
+                                self.wfile.write(forward)
+                                self.wfile.flush()
+                            except OSError:
+                                client_gone = True
+                                break
+                            if finished:
+                                break
+                    finally:
+                        try:
+                            resp.close()
+                        except OSError:
+                            pass
+                        cur["finish"](seg_tokens)
+                    if finished or client_gone:
+                        return
+                    if not truncated:
+                        return
+                    # -- failover: resume on another replica ----------
+                    if rctx is None:
+                        self._stream_fail(len(emitted))
+                        return
+                    remaining = rctx["max_new"] - len(emitted)
+                    if remaining <= 0:
+                        # The budget is already fully delivered — the
+                        # only thing lost was the summary event.
+                        self._stream_done(rctx, emitted, segments)
+                        return
+                    nxt = None
+                    for _ in range(2):
+                        try:
+                            # error/timeout only: no clock here to
+                            # realize a "slow" decision as a delay.
+                            global_faults.fire(
+                                "migrate.resume",
+                                error_type=RuntimeError,
+                                only=("error", "timeout"),
+                            )
+                            got = outer.resume_stream(
+                                rctx, emitted, victim=cur["replica"],
+                            )
+                        except RuntimeError:
+                            outer.metrics.inc(
+                                "migrate_failures_total",
+                                stage="resume",
+                            )
+                            continue
+                        if got["kind"] == "stream":
+                            nxt = got
+                            break
+                        outer.metrics.inc(
+                            "migrate_failures_total", stage="resume",
+                        )
+                    if nxt is None:
+                        self._stream_fail(len(emitted))
+                        return
+                    cur = nxt
+
+            def _stream_done(self, rctx, emitted, segments):
+                """Synthesize the terminal summary for a resumed stream
+                whose token budget was already fully delivered when its
+                last owner died."""
+                summary = {
+                    "done": True,
+                    "text": outer.tokenizer.decode(emitted),
+                    "prompt_tokens": len(rctx["ids"]),
+                    "generated_tokens": len(emitted),
+                    "tokens_per_s": 0.0,
+                    "resumed": max(0, segments - 1),
+                }
+                ctx = getattr(self, "trace_ctx", None)
+                if ctx is not None:
+                    summary["trace_id"] = ctx.trace_id
+                try:
+                    self.wfile.write(
+                        (json.dumps(summary) + "\n").encode()
+                    )
+                    self.wfile.flush()
+                except OSError:
+                    pass
+
+            def _stream_fail(self, n_emitted):
+                """Honest truncation summary when every resume attempt
+                failed: the tokens already streamed are a prefix, not a
+                completion — never silently pretend otherwise."""
+                summary = {
+                    "done": False,
+                    "error": "stream interrupted; resume failed",
+                    "generated_tokens": int(n_emitted),
+                }
+                try:
+                    self.wfile.write(
+                        (json.dumps(summary) + "\n").encode()
+                    )
+                    self.wfile.flush()
+                except OSError:
+                    pass
 
             def _query(self):
                 from urllib.parse import parse_qs, urlparse
@@ -590,6 +782,7 @@ class FleetFrontend:
         with self._lock:
             url = self._replicas.pop(name, None)
             self._inflight.pop(name, None)
+            self._live.pop(name, None)
             count = len(self._replicas)
         if url is None:
             return False
@@ -630,13 +823,16 @@ class FleetFrontend:
         self, name: str, deadline_s: float | None = None,
         on_retired=None,
     ) -> dict:
-        """Asynchronous in-flight-aware drain: new traffic stops NOW
-        (``FleetRouter.drain`` — the victim's hash range re-homes on
-        next touch), but the replica is only retired once its in-flight
-        count reaches zero (``_replica_inflight``'s three-step read) or
-        ``deadline_s`` forces it.  Idempotent per replica; returns the
-        drain state.  ``on_retired(name)`` fires after retirement — the
-        operator's signal that the pod behind the replica may die."""
+        """Asynchronous LIVE-MIGRATING drain: new traffic stops NOW
+        (``FleetRouter.drain``), the victim's warm KV blocks and
+        mid-stream requests hand over to a surviving replica
+        (``_migrate_for_drain`` / serve/migrate.py), and the replica is
+        retired once its in-flight count reaches zero
+        (``_replica_inflight``'s three-step read) or ``deadline_s``
+        forces it — a forced retirement journals every abandoned
+        request.  Idempotent per replica; returns the drain state.
+        ``on_retired(name)`` fires after retirement — the operator's
+        signal that the pod behind the replica may die."""
         deadline_s = (
             self.drain_deadline_s if deadline_s is None
             else float(deadline_s)
@@ -673,10 +869,21 @@ class FleetFrontend:
             ]
 
     def _drain_worker(self, name, deadline, on_retired) -> None:
-        """Waits for the victim's in-flight work, then retires it.  The
-        wait paces on the stop event (so ``stop()`` interrupts it) but
-        judges the deadline on the injected clock."""
+        """Live-migrates the victim's warm KV state to a surviving
+        replica (serve/migrate.py), then waits for its in-flight work
+        and retires it.  The migration runs FIRST: export → import →
+        re-home → cut the victim's live streams — a cut stream's relay
+        failover then re-dispatches onto a destination that is already
+        warm, so the in-flight wait below converges fast instead of
+        babysitting long decodes on a dying process.  A failed
+        migration degrades to the old behavior (wait; resumed requests
+        re-prefill from scratch).  The wait paces on the stop event (so
+        ``stop()`` interrupts it) but judges the deadline on the
+        injected clock.  At a forced deadline, every request still in
+        the live ledger is journaled as abandoned — a forced drain must
+        be distinguishable from a graceful one in the evidence."""
         t0 = self.clock.now()
+        moved = self._migrate_for_drain(name)
         forced = False
         while not self._stop.is_set():
             if self._replica_inflight(name) <= 0:
@@ -687,6 +894,7 @@ class FleetFrontend:
             self._stop.wait(self.drain_poll_s)
         if self._stop.is_set():
             return
+        abandoned = self._abandon_live(name) if forced else 0
         waited = self.clock.now() - t0
         self.metrics.observe("frontend_drain_wait_seconds", waited)
         self.metrics.inc(
@@ -699,12 +907,101 @@ class FleetFrontend:
                 st["state"] = "retired"
                 st["forced"] = forced
                 st["waited_s"] = round(waited, 4)
+                st["abandoned"] = abandoned
+                if moved is not None:
+                    st["migrated"] = {
+                        "dest": moved["dest"],
+                        "blocks": moved["blocks"],
+                        "bytes": moved["bytes"],
+                        "rehomed": moved["rehomed"],
+                        "resumed": moved["resumed"],
+                    }
         self.retire_replica(name)
         if on_retired is not None:
             try:
                 on_retired(name)
             except Exception:
                 log.exception("on_retired hook failed for %s", name)
+
+    def _migrate_for_drain(self, name: str) -> dict | None:
+        """The drain's migration leg: pick the destination (the
+        healthiest replica owning the FEWEST warm chains — the mirror
+        of ``scale_down_victim``, it has the most free pool to accept
+        state), move the victim's registered blocks, re-home the chains
+        on the router, and only THEN cut the victim's live streams —
+        the relay failover re-dispatches the instant a stream is cut,
+        and that re-route must find the destination warm and owning.
+        None when there is nowhere to migrate or a stage exhausted its
+        retries (``BlockMigrator`` already minted the failure metrics);
+        the caller degrades to the plain wait-and-retire drain."""
+        victim_url = self._url_of(name)
+        if victim_url is None:
+            return None
+        snap = {
+            r["replica"]: r for r in self.router.snapshot()["replicas"]
+        }
+        with self._lock:
+            cands = [n for n in self._replicas if n != name]
+        eligible = [
+            n for n in sorted(cands)
+            if not any(
+                (snap.get(n) or {}).get(flag)
+                for flag in ("draining", "down", "unhealthy")
+            )
+        ]
+        if not eligible:
+            return None
+        dest = min(
+            eligible, key=lambda n: (self.router.chains_owned(n), n)
+        )
+        dest_url = self._url_of(dest)
+        if dest_url is None:
+            return None
+        result = self.migrator.migrate(victim_url, dest_url, victim=name)
+        if result is None:
+            return None
+        rehomed = self.router.rehome(
+            [bytes.fromhex(h) for h in result["hashes"]], dest
+        )
+        # Cut order matters: the GATEWAY cut first (each relay's
+        # failover re-dispatches immediately, and the destination is
+        # already warm and owning), then the victim-side abort, which
+        # frees the victim's compute — it alone is not a reliable cut,
+        # because a batcher with the whole budget pipelined retires the
+        # stream at the quiesce barrier before the abort sees it.
+        cut = self._cut_live_streams(name)
+        aborted = self.migrator.abort_live(victim_url)
+        out = dict(result)
+        out.update({
+            "dest": dest, "rehomed": rehomed,
+            "resumed": cut, "aborted": aborted,
+        })
+        log.info(
+            "drain %s: migrated %d blocks (%d bytes) to %s, "
+            "re-homed %d chains, cut %d live streams (%d aborted)",
+            name, out["blocks"], out["bytes"], dest, rehomed, cut,
+            aborted,
+        )
+        return out
+
+    def _abandon_live(self, name: str) -> int:
+        """The forced drain's honest ledger: one ``path="gateway"``
+        journal record per in-flight request abandoned at the deadline.
+        Without this a forced drain looks identical to a graceful one
+        in the evidence — the SLO plane would never see the requests
+        the deadline killed."""
+        with self._lock:
+            reqs = self._live.pop(name, None) or {}
+        n = len(reqs)
+        for info in reqs.values():
+            self._journal(
+                tenant=info["tenant"], trace_ctx=info["trace_ctx"],
+                reason="aborted", code=503, t0=info["t0"],
+                replica=name, route_reason=info["route_reason"],
+                prompt_tokens=info["prompt_tokens"],
+                extra={"drain_forced": True, "abandoned": n},
+            )
+        return n
 
     def _replica_inflight(self, name: str) -> int:
         """The drain signal, cheapest source first: (1) the gateway's
@@ -878,6 +1175,58 @@ class FleetFrontend:
         )
         return cur
 
+    def _live_add(self, name: str, info: dict) -> int:
+        """Register an outstanding downstream contact in the per-replica
+        live ledger — the forced drain's abandonment evidence (each
+        entry it still holds at the deadline becomes one journal
+        record).  Returns the ledger key, -1 for an unknown replica."""
+        with self._lock:
+            if name not in self._replicas:
+                return -1
+            self._live_seq += 1
+            key = self._live_seq
+            self._live.setdefault(name, {})[key] = info
+        return key
+
+    def _live_drop(self, name: str, key: int) -> None:
+        with self._lock:
+            reqs = self._live.get(name)
+            if reqs is not None:
+                reqs.pop(key, None)
+                if not reqs:
+                    self._live.pop(name, None)
+
+    def _live_attach(self, name: str, key: int, resp) -> None:
+        """Attach a cuttable upstream stream handle to a live-ledger
+        entry (routed streams only — a pinned stream is an explicit
+        this-replica contract, so a drain never cuts it)."""
+        with self._lock:
+            info = self._live.get(name, {}).get(key)
+            if info is not None:
+                info["resp"] = resp
+
+    def _cut_live_streams(self, name: str) -> int:
+        """Cut ``name``'s live routed streams at the GATEWAY: closing
+        the upstream response makes each relay see a truncation and run
+        its failover (resume on a surviving replica).  The authoritative
+        mid-stream cut for a migrating drain — the victim's own
+        ``abort_live`` only frees compute, and a pipelined batcher may
+        have the whole token budget in flight before its quiesce barrier
+        runs, which would let the stream finish on the victim instead of
+        handing over."""
+        with self._lock:
+            resps = [
+                info["resp"]
+                for info in self._live.get(name, {}).values()
+                if info.get("resp") is not None
+            ]
+        for resp in resps:
+            try:
+                resp.close()
+            except OSError:
+                pass
+        return len(resps)
+
     def _url_of(self, name: str) -> str | None:
         with self._lock:
             return self._replicas.get(name)
@@ -907,7 +1256,7 @@ class FleetFrontend:
     # -- dispatch ----------------------------------------------------------
     def dispatch(
         self, ids, body, *, tenant, deadline=None, trace_ctx=None,
-        stream=False, pinned=None,
+        stream=False, pinned=None, exclude=None, migrated_from="",
     ) -> dict:
         """Route → breaker-gate → forward → classify, retrying per the
         failure matrix (module docstring).  Returns a response outcome
@@ -916,7 +1265,11 @@ class FleetFrontend:
         finish}.  ``pinned`` skips routing and contacts exactly that
         replica — no rehash, a pinned failure IS the answer (the canary
         contract: a dead replica must fail its probe, not silently
-        succeed elsewhere)."""
+        succeed elsewhere).  ``exclude`` pre-blacklists replicas (the
+        stream-failover path must not resume on the victim it just
+        lost); ``migrated_from`` stamps the downstream submit as a
+        migration resume (``x-migrated-from`` — the replica journals
+        and counts it)."""
         t0 = self.clock.now()
         body = dict(body)
         body["tenant"] = tenant
@@ -927,7 +1280,7 @@ class FleetFrontend:
             )
         max_tries = max(1, len(self.router.replica_names()))
         budget = self.policy.budget
-        tried: set[str] = set()
+        tried: set[str] = set(exclude or ())
         shed = None           # (payload, retry_after) of the last 429
         last_fail = ""
         contacts = 0
@@ -972,18 +1325,25 @@ class FleetFrontend:
                 replica, reason, tenant, deadline,
                 attempt_ctx or trace_ctx,
             )
+            if migrated_from:
+                headers["x-migrated-from"] = migrated_from[:64]
             timeout = self.request_timeout_s
             if deadline is not None:
                 timeout = max(
                     0.001, min(timeout, deadline - self.clock.now())
                 )
             self._track(replica, +1)
+            live_key = self._live_add(replica, {
+                "tenant": tenant, "trace_ctx": trace_ctx, "t0": t0,
+                "prompt_tokens": len(ids), "route_reason": reason,
+            })
             t_at = self.clock.now()
             s_at = global_tracer.clock.now()
             out = self._forward(url, body, headers, timeout, stream)
             kind = out[0]
             if kind != "stream":
                 self._track(replica, -1)
+                self._live_drop(replica, live_key)
                 self.metrics.observe(
                     "frontend_upstream_seconds",
                     self.clock.now() - t_at, replica=replica,
@@ -1011,12 +1371,15 @@ class FleetFrontend:
                 br.record_success()
                 self.router.mark_up(replica)
                 resp = out[1]
+                self._live_attach(replica, live_key, resp)
                 n_prompt = len(ids)
 
                 def finish(tokens, _r=replica, _reason=reason,
                            _t_at=t_at, _n=n_prompt, _c=contacts,
-                           _actx=attempt_ctx, _s_at=s_at):
+                           _actx=attempt_ctx, _s_at=s_at,
+                           _lk=live_key):
                     self._track(_r, -1)
+                    self._live_drop(_r, _lk)
                     self.metrics.observe(
                         "frontend_upstream_seconds",
                         self.clock.now() - _t_at, replica=_r,
@@ -1111,6 +1474,30 @@ class FleetFrontend:
             headers={"Retry-After": str(RETRY_AFTER_S)},
         )
 
+    def resume_stream(self, rctx, emitted, *, victim: str) -> dict:
+        """Re-dispatch a truncated stream on a surviving replica: the
+        prompt becomes ``original ids + tokens already delivered`` (a
+        teacher-forced prefix — greedy decode continues exactly where
+        the victim stopped) and the token budget shrinks to what the
+        client is still owed.  The victim is excluded from routing and
+        the submit is stamped ``x-migrated-from`` so the destination's
+        journal carries the provenance.  When the victim's KV chains
+        were wire-migrated first (serve/migrate.py), the new owner
+        prefix-hits the moved blocks and the resume costs one extend,
+        not a re-prefill."""
+        body = dict(rctx["body"])
+        body.pop("prompt", None)
+        prompt_ids = list(rctx["ids"]) + [int(t) for t in emitted]
+        body["prompt_ids"] = prompt_ids
+        body["max_new_tokens"] = int(rctx["max_new"] - len(emitted))
+        body["stream"] = True
+        return self.dispatch(
+            prompt_ids, body,
+            tenant=rctx["tenant"], deadline=rctx["deadline"],
+            trace_ctx=rctx["trace_ctx"], stream=True,
+            exclude={victim}, migrated_from=victim,
+        )
+
     def _dispatch_pinned(
         self, name, ids, body, tenant, deadline, trace_ctx, stream, t0
     ) -> dict:
@@ -1146,12 +1533,17 @@ class FleetFrontend:
                 0.001, min(timeout, deadline - self.clock.now())
             )
         self._track(name, +1)
+        live_key = self._live_add(name, {
+            "tenant": tenant, "trace_ctx": trace_ctx, "t0": t0,
+            "prompt_tokens": len(ids), "route_reason": "pinned",
+        })
         t_at = self.clock.now()
         s_at = global_tracer.clock.now()
         out = self._forward(url, body, headers, timeout, stream)
         kind = out[0]
         if kind != "stream":
             self._track(name, -1)
+            self._live_drop(name, live_key)
             self.metrics.observe(
                 "frontend_upstream_seconds",
                 self.clock.now() - t_at, replica=name,
@@ -1179,8 +1571,9 @@ class FleetFrontend:
             n_prompt = len(ids)
 
             def finish(tokens, _t_at=t_at, _actx=attempt_ctx,
-                       _s_at=s_at):
+                       _s_at=s_at, _lk=live_key):
                 self._track(name, -1)
+                self._live_drop(name, _lk)
                 self.metrics.observe(
                     "frontend_upstream_seconds",
                     self.clock.now() - _t_at, replica=name,

@@ -135,6 +135,19 @@ class BlockPool:
         blocks here, whether plain-free or cached)."""
         return sorted(list(self._free) + list(self._lru))
 
+    def contains(self, h: bytes) -> bool:
+        """Whether ``h`` is registered (pinned or cached) — the
+        migration import's duplicate gate, checked WITHOUT touching
+        refcounts or LRU order."""
+        return h in self._blk_of
+
+    def registered(self) -> list[tuple[bytes, int]]:
+        """Every registered ``(hash, block)`` pair, sorted by hash —
+        the deterministic enumeration the wire-level export serializes.
+        Covers pinned and cached blocks alike: both are content the
+        chain addresses, and the destination decides what it lacks."""
+        return sorted(self._blk_of.items(), key=lambda kv: kv[0])
+
     # -- sharing -----------------------------------------------------------
     def acquire(self, h: bytes) -> int | None:
         """Pin the block registered under ``h`` (refcount++), pulling it

@@ -473,6 +473,27 @@ class FleetRouter:
         if hashes:
             self._export_gauges()
 
+    def rehome(self, hashes, new_owner: str) -> int:
+        """Reassign warm-chain ownership after a wire-level block
+        migration (serve/migrate.py): the destination replica now
+        physically holds these chain hashes, so affinity routing must
+        send their tenants THERE — without this, the gateway would
+        keep routing to the drained victim's re-prefill path and the
+        migrated bytes would sit unused until LRU eviction.  Unknown
+        owners are refused (0): re-homing onto a retired replica would
+        route traffic into a wall.  Returns the chains re-homed."""
+        with self._lock:
+            if new_owner not in self._replicas:
+                return 0
+            hashes = list(hashes)
+            self._record_chains_locked(hashes, new_owner)
+            if hashes:
+                self.metrics.inc(
+                    "serve_router_rehomed_chains_total",
+                    float(len(hashes)),
+                )
+            return len(hashes)
+
     def _export_gauges(self) -> None:
         """Refresh the serve_router_* gauges.  Lock held by caller
         (every mutation path calls this before releasing _lock)."""

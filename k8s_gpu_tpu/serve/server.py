@@ -22,11 +22,16 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import numpy as np
+
 from ..data.tokenizer import BpeTokenizer
+from ..utils.faults import global_faults
 from ..utils.obs import RequestMetricsMixin
 from .batcher import ContinuousBatcher, Overloaded
 from .journal import PROBE_TENANT
 from .journal import RequestRecord as JournalRecord
+from .migrate import pack as migrate_pack
+from .migrate import unpack as migrate_unpack
 
 # Advisory client backoff on 429/503: long enough to drain a round or
 # two, short enough that a recovered server re-fills quickly.
@@ -124,12 +129,18 @@ class LmServer:
         # but reports NotReady so front-ends stop sending new traffic.
         # Monotonic-ish single-flag state; benign bool race.
         self._draining = False
+        # Migration latch: True while an /admin/export barrier holds
+        # the scheduler — /readyz reports NotReady so a gateway doesn't
+        # route new traffic onto a replica whose warm state is mid-copy
+        # to another owner.  Same benign bool race as _draining.
+        self._migrating = False
         outer = self
 
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
             metrics_server_label = "lm-server"
             known_routes = ("/generate", "/tokenize", "/precache",
-                            "/healthz", "/readyz")
+                            "/healthz", "/readyz",
+                            "/admin/export", "/admin/import")
 
             def _get(self):
                 if self.path == "/healthz":
@@ -181,11 +192,104 @@ class LmServer:
                     except ValueError as e:
                         return self._json(400, {"error": str(e)})
                     return self._json(200, {"cached_tokens": int(ids.size)})
+                if self.path == "/admin/export":
+                    return self._admin_export(body)
+                if self.path == "/admin/import":
+                    return self._admin_import(body)
                 return self._json(404, {"error": "not found"})
 
+            def _admin_export(self, body):
+                """Serialize this replica's registered KV blocks into
+                the chain-hash-addressed wire payload (serve/migrate.py)
+                through a scheduler quiesce barrier.  ``abort_live``
+                additionally retires live streams stamped *migrated*
+                (the coordinator's second call, AFTER the import
+                landed); ``include_blocks=false`` skips block bodies.
+                /readyz reports a ``migrating`` leg for the duration so
+                no new traffic lands mid-export."""
+                abort_live = bool(body.get("abort_live", False))
+                include_blocks = bool(body.get("include_blocks", True))
+                try:
+                    # error/timeout only: no clock here to realize a
+                    # "slow" decision as an actual delay.
+                    global_faults.fire(
+                        "migrate.export", error_type=RuntimeError,
+                        only=("error", "timeout"),
+                    )
+                    outer._migrating = True
+                    try:
+                        snap = outer.batcher.run_quiesced(
+                            lambda: outer.batcher.migrate_export(
+                                abort_live=abort_live,
+                                include_blocks=include_blocks,
+                            )
+                        )
+                    finally:
+                        outer._migrating = False
+                except ValueError as e:  # not paged: a request fault
+                    return self._json(400, {"error": str(e)})
+                except (RuntimeError, TimeoutError) as e:
+                    return self._json(
+                        503, {"error": str(e)},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+                payload = migrate_pack(snap)
+                payload["replica"] = outer.name
+                return self._json(200, payload)
+
+            def _admin_import(self, body):
+                """Splice a wire payload's blocks into this replica's
+                pool through a scheduler quiesce barrier.  Geometry or
+                encoding mismatches are refused with 400 before any
+                pool mutation — never splice garbage into live state."""
+                try:
+                    # error/timeout only, as at migrate.export.
+                    global_faults.fire(
+                        "migrate.import", error_type=RuntimeError,
+                        only=("error", "timeout"),
+                    )
+                    parsed = migrate_unpack(body)
+                    n = outer.batcher.run_quiesced(
+                        lambda: outer.batcher.migrate_import(parsed)
+                    )
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                except (RuntimeError, TimeoutError) as e:
+                    return self._json(
+                        503, {"error": str(e)},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+                return self._json(200, {
+                    "imported": n,
+                    "replica": outer.name,
+                })
+
             def _generate(self, body):
+                # ``prompt_ids`` (pre-tokenized) is the resume path: a
+                # gateway failing a migrated stream over re-submits the
+                # original prompt PLUS the tokens already emitted, and
+                # round-tripping those through decode/encode could
+                # re-tokenize differently — ids are the contract.
                 prompt = body.get("prompt", "")
-                if not isinstance(prompt, str) or not prompt:
+                prompt_ids = body.get("prompt_ids")
+                if prompt_ids is not None:
+                    if (not isinstance(prompt_ids, list) or not prompt_ids
+                            or not all(
+                                isinstance(i, int)
+                                and not isinstance(i, bool)
+                                for i in prompt_ids
+                            )):
+                        return self._json(400, {
+                            "error": "prompt_ids must be a non-empty "
+                                     "list of ints"})
+                    vocab = getattr(outer.tokenizer, "vocab_size", 0)
+                    if vocab and not all(
+                        0 <= i < vocab for i in prompt_ids
+                    ):
+                        return self._json(400, {
+                            "error": "prompt_ids out of vocabulary "
+                                     "range"})
+                elif not isinstance(prompt, str) or not prompt:
                     return self._json(400, {"error": "prompt (string) required"})
                 try:
                     want = int(body.get("max_new_tokens", 32))
@@ -271,7 +375,17 @@ class LmServer:
                         return self._json(
                             504, {"error": "deadline exceeded"})
                     deadline = time.monotonic() + budget_ms / 1000.0
-                ids = outer.tokenizer.encode(prompt)
+                # Resume stamp (serve/migrate.py): the gateway names the
+                # replica this request migrated away from — journaled,
+                # counted by serve_resumed_requests_total.
+                migrated_from = (
+                    self.headers.get("x-migrated-from") or ""
+                ).strip()[:64]
+                ids = (
+                    np.asarray(prompt_ids, np.int32)
+                    if prompt_ids is not None
+                    else outer.tokenizer.encode(prompt)
+                )
                 t0 = time.perf_counter()
                 try:
                     handle = outer.batcher.submit(
@@ -285,6 +399,7 @@ class LmServer:
                         deadline=deadline,
                         tenant=tenant,
                         route=route,
+                        migrated_from=migrated_from,
                     )
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
@@ -346,22 +461,40 @@ class LmServer:
                     self.send_header("x-trace-id", ctx.trace_id)
                 self.end_headers()
                 gen_ids = []
-                for tok in handle:
-                    gen_ids.append(tok)
-                    event = {"id": tok}
-                    if want_lp:
-                        event["logprob"] = handle.last_logprob
-                    self.wfile.write((json.dumps(event) + "\n").encode())
-                    self.wfile.flush()
+                try:
+                    for tok in handle:
+                        gen_ids.append(tok)
+                        event = {"id": tok}
+                        if want_lp:
+                            event["logprob"] = handle.last_logprob
+                        self.wfile.write(
+                            (json.dumps(event) + "\n").encode()
+                        )
+                        self.wfile.flush()
+                except OSError:
+                    # Client gone mid-stream — a migrating gateway cuts
+                    # its upstream leg on purpose (frontend
+                    # _cut_live_streams); drain the handle so the slot
+                    # retires, and drop the summary nobody will read.
+                    for _ in handle:
+                        pass
+                    return
                 dt = time.perf_counter() - t0
                 if handle.deadline_expired:
                     summary = {"done": False, "error": "deadline exceeded"}
                 elif handle.aborted:
                     # The stream already carries tokens; the terminal event
-                    # must say they are a truncation, not a completion.
-                    summary = {"done": False,
-                               "error": "generation aborted: server "
-                                        "shutting down or batcher crashed"}
+                    # must say they are a truncation, not a completion.  A
+                    # migration cut is distinguishable: the gateway relay
+                    # resumes it on the new owner instead of erroring.
+                    if handle.migrated:
+                        summary = {"done": False, "error": "migrated",
+                                   "resume": True}
+                    else:
+                        summary = {"done": False,
+                                   "error": "generation aborted: server "
+                                            "shutting down or batcher "
+                                            "crashed"}
                 else:
                     summary = {
                         "done": True,
@@ -374,8 +507,13 @@ class LmServer:
                     ctx = getattr(self, "trace_ctx", None)
                     if ctx is not None:
                         summary["trace_id"] = ctx.trace_id
-                self.wfile.write((json.dumps(summary) + "\n").encode())
-                self.wfile.flush()
+                try:
+                    self.wfile.write(
+                        (json.dumps(summary) + "\n").encode()
+                    )
+                    self.wfile.flush()
+                except OSError:
+                    pass
 
             def _json(self, code: int, payload: dict,
                       headers: dict | None = None) -> None:
@@ -407,20 +545,25 @@ class LmServer:
 
     def readiness(self) -> dict:
         """The /readyz verdict and its evidence — readiness is "can
-        serve a NEW request well", three legs ANDed: the batcher's
+        serve a NEW request well", four legs ANDed: the batcher's
         scheduler thread is alive (not crashed/stopped), the engine is
         past its first compile (first request would otherwise eat
-        seconds of dead air), and the replica is not draining.  The
-        HTTP health contract ROADMAP item 1's front-end polls
+        seconds of dead air), the replica is not draining, and it is
+        not mid-export of its KV state (``migrating`` — new traffic
+        routed onto a replica whose warm chains are leaving would
+        admit cold AND stall behind the barrier).  The HTTP health
+        contract ROADMAP item 1's front-end polls
         (docs/platform/serving.md, 'The health contract')."""
         alive = self.batcher.scheduler_alive
         warmed = self.batcher.past_first_compile
         draining = self._draining
+        migrating = self._migrating
         return {
-            "ready": alive and warmed and not draining,
+            "ready": alive and warmed and not draining and not migrating,
             "scheduler_alive": alive,
             "warmed": warmed,
             "draining": draining,
+            "migrating": migrating,
             # Fleet identity + the drain fast path: a front-end
             # retiring this replica polls ``inflight`` here instead of
             # scraping metrics (serve/frontend.py), and ``replica``

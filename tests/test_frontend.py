@@ -535,3 +535,142 @@ def test_two_run_routing_is_byte_identical(stack, fleet):
             fe.stop()
 
     assert run() == run()
+
+
+# -- forced-at-deadline drain ---------------------------------------------
+
+
+class _StuckStreamReplica:
+    """Alive, ready, and permanently mid-stream: /generate emits one
+    token event and then parks on a release gate — an in-flight request
+    a drain deadline must eventually abandon."""
+
+    def __init__(self, name):
+        outer_name = name
+        self.release = threading.Event()
+        release = self.release
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps({
+                    "ready": True, "scheduler_alive": True,
+                    "draining": False, "replica": outer_name,
+                    "inflight": 1,
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/x-ndjson"
+                )
+                self.end_headers()
+                self.wfile.write(b'{"id": 1}\n')
+                self.wfile.flush()
+                release.wait(30.0)
+                self.wfile.write(
+                    json.dumps({"done": False,
+                                "error": "replica gave up"}).encode()
+                    + b"\n"
+                )
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def stop(self):
+        self.release.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_drain_deadline_forces_and_journals_abandoned(stack):
+    """A drain whose victim never goes idle is FORCED at the deadline —
+    and the force is auditable: ``frontend_drains_total{forced}``, the
+    drain state's abandoned count, and one ``path="gateway"`` journal
+    record per in-flight request killed (``extra.drain_forced``).  With
+    no surviving replica the cut stream's resume fails honestly: the
+    client's last event says truncation, never a fake completion."""
+    tok, _, _ = stack
+    stuck = _StuckStreamReplica("stuck-0")
+    fe = FleetFrontend(
+        tok, page_size=PAGE, metrics=MetricsRegistry()
+    ).start()
+    try:
+        fe.register_replica("stuck-0", f"http://127.0.0.1:{stuck.port}")
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=60)
+        conn.request(
+            "POST", "/generate",
+            json.dumps(_gen("acme", 1, {"stream": True})),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        first = json.loads(resp.readline())
+        assert first == {"id": 1}  # mid-stream: one token delivered
+        code, st, _ = _post(
+            fe.url, "/admin/drain",
+            {"name": "stuck-0", "deadline_s": 0.4},
+        )
+        assert code == 202 and st["state"] == "draining"
+        deadline = time.time() + 15.0
+        state = {}
+        while time.time() < deadline:
+            with urllib.request.urlopen(fe.url + "/admin/drain",
+                                        timeout=10) as r:
+                drains = json.loads(r.read())["drains"]
+            state = next(
+                (d for d in drains if d["replica"] == "stuck-0"), {}
+            )
+            if state.get("state") == "retired":
+                break
+            time.sleep(0.05)
+        assert state.get("state") == "retired", state
+        assert state["forced"] is True
+        assert state["abandoned"] == 1
+        assert "stuck-0" not in fe.replica_names()
+        assert fe.metrics.counter(
+            "frontend_drains_total", outcome="forced"
+        ) == 1
+        # The abandoned request is in the gateway journal, marked as a
+        # forced-drain casualty — not silently indistinguishable from a
+        # graceful retirement.
+        recs = [
+            r for r in fe.journal.snapshot(limit=20)
+            if r.get("extra", {}).get("drain_forced")
+        ]
+        assert len(recs) == 1
+        assert recs[0]["reason"] == "aborted"
+        assert recs[0]["path"] == "gateway"
+        assert recs[0]["replica"] == "stuck-0"
+        assert recs[0]["tenant"] == "acme"
+        assert recs[0]["extra"]["abandoned"] == 1
+        # Release the stuck stream: the relay sees a resumable
+        # truncation, finds no surviving replica, and closes with an
+        # honest failure summary.
+        stuck.release.set()
+        events = [json.loads(line) for line in resp if line.strip()]
+        conn.close()
+        assert events, "client never got a terminal event"
+        last = events[-1]
+        assert last["done"] is False
+        assert "resume failed" in last["error"]
+        assert fe.metrics.counter(
+            "migrate_failures_total", stage="resume"
+        ) >= 1
+    finally:
+        fe.stop()
+        stuck.stop()
