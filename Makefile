@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo
 
 # The default verify path (bare `make`): graftcheck invariants + the
 # attribution-plane smoke + the flash-v2 parity suite (ISSUE 12 — every
@@ -23,7 +23,7 @@ IMAGES = operator trainer devenv
 # train-step guard, all CPU-safe through the Pallas interpreter).  The
 # full suite stays `make test` (it takes minutes); image builds stay
 # `make docker-build`.
-verify: check profile-demo goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo flash-v2-parity
+verify: check profile-demo goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo flash-v2-parity
 
 flash-v2-parity:
 	python -m pytest tests/test_flash_v2.py -q -p no:cacheprovider
@@ -170,6 +170,13 @@ waterfall-demo:
 # and the migrated prefix beats a cold re-prefill by >= 2x TTFT.
 migrate-demo:
 	python tools/migration_demo.py
+
+# Replicated-gateway smoke (ISSUE 18): 3 gateways over 3 replicas —
+# byte-identical owner-map reconstruction from scrapes alone, a cruel
+# mid-burst gateway kill with client failover losing zero tokens, and a
+# 10:1 hot-tenant flood throttled at the weighted-fair admission door.
+gateway-demo:
+	python tools/gateway_demo.py
 
 # Fleet router smoke: 4 paged replicas behind the prefix-affinity
 # router serve skewed multi-tenant traffic (each tenant's shared prompt

@@ -53,6 +53,11 @@ DETERMINISTIC_PLANES = (
     # ambient time or randomness (two-run byte-identical exports), and
     # the coordinator's only duration source is the injected Clock.
     "k8s_gpu_tpu/serve/migrate.py",
+    # The admission plane (ISSUE 18): DRR rounds, preemption order,
+    # quota refill and the decayed share accumulator are pure
+    # functions of (offer sequence, injected Clock) — the two-run
+    # byte-identical WFQ schedule test pins it.
+    "k8s_gpu_tpu/serve/admission.py",
     "k8s_gpu_tpu/utils/alerts.py",
     "k8s_gpu_tpu/utils/federation.py",
     "k8s_gpu_tpu/utils/metrics.py",

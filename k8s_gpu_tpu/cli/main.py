@@ -948,6 +948,36 @@ def cmd_obs(args) -> int:
             return 2
         print(render_fleet(snap))
         return 0
+    if args.obs_cmd == "gateways":
+        # The gateway-fleet view: per-gateway owner-map digest (do the
+        # independently reconstructed maps agree?) plus the admission
+        # plane's per-tenant quota/WFQ table.
+        from ..utils.obs import render_gateways
+
+        if not args.url:
+            print("obs gateways needs repeated --url NAME=URL (or bare "
+                  "URL) of each gateway", file=sys.stderr)
+            return 2
+        snaps = []
+        for name, u in _parse_scrape_targets(args.url).items():
+            om = _obs_fetch(u, "/admin/ownermap?chains=0")
+            adm = _obs_fetch(u, "/admin/admission")
+            try:
+                snaps.append({
+                    "name": name,
+                    "ownermap": json.loads(om) if om else None,
+                    "admission": json.loads(adm) if adm else None,
+                })
+            except ValueError:
+                snaps.append(
+                    {"name": name, "ownermap": None, "admission": None}
+                )
+        print(render_gateways(snaps))
+        digests = {
+            (s["ownermap"] or {}).get("digest")
+            for s in snaps if s["ownermap"]
+        }
+        return 0 if len(digests) <= 1 else 1
     if args.obs_cmd == "requests":
         # The per-request journal: what /debug/requests serves, with
         # the trace id column cross-linking into `obs traces --trace`.
@@ -1727,6 +1757,16 @@ def build_parser() -> argparse.ArgumentParser:
     p_ofleet.add_argument("--scrape-url", action="append", default=None,
                           help="NAME=URL (or bare URL) of one replica's "
                                "metrics server; repeatable")
+    p_ogw = obs_sub.add_parser(
+        "gateways",
+        help="gateway-fleet view: per-gateway owner-map digest + "
+             "agreement verdict (/admin/ownermap) and the per-tenant "
+             "admission quota/WFQ table (/admin/admission); exits 1 "
+             "when digests diverge",
+    )
+    p_ogw.add_argument("--url", action="append", default=None,
+                       help="NAME=URL (or bare URL) of one gateway; "
+                            "repeatable")
     p_oreq = obs_sub.add_parser(
         "requests",
         help="per-request journal (lifecycle, latency, prefix/spec "

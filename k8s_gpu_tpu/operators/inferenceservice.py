@@ -450,6 +450,19 @@ class InferenceServiceReconciler(Reconciler):
             svc, "Normal", "ReplicaRegistered",
             f"{pod} registered with fleet frontend at {self.frontend.url}",
         )
+        self._nudge_reconstruction()
+
+    def _nudge_reconstruction(self) -> None:
+        """Rebuild the gateway's owner map after replica churn
+        (register/retire both shift rendezvous ownership).  Best-effort:
+        a scrape-less gateway (no replicas up yet, all faulted) is the
+        next reconcile's problem, not this one's."""
+        if self.frontend is None:
+            return
+        try:
+            self.frontend.reconstruct(check_peers=False)
+        except (RuntimeError, OSError):
+            pass
 
     def _stop_server(self, svc: InferenceService, pod: str) -> None:
         key = (svc.metadata.namespace, svc.metadata.name, pod)
@@ -467,6 +480,7 @@ class InferenceServiceReconciler(Reconciler):
         if self.frontend is not None:
             self.frontend.retire_replica(pod)
             self._drain_done.discard(pod)
+            self._nudge_reconstruction()
         if self.router is not None and pod in self.router.replica_names():
             self.router.remove_replica(pod)
 

@@ -1,13 +1,14 @@
 """Serving: KV-cache engine, continuous batcher, speculative decoding,
 int8 weight-only quantization, LM HTTP server."""
 
+from .admission import AdmissionController, TenantPolicy
 from .batcher import ContinuousBatcher, Overloaded, RequestHandle
 from .bundle import export_servable, load_servable
 from .canary import CanaryProber
 from .constrain import RegexConstraint, compile_constraint
 from .disagg import DisaggregatedLm
 from .engine import DecodeOutput, InferenceEngine, SamplingConfig
-from .frontend import FleetFrontend
+from .frontend import FleetFrontend, merge_owner_map, owner_map_digest
 from .journal import PROBE_TENANT, RequestJournal, RequestRecord
 from .jsonschema import SchemaError, schema_to_regex
 from .quant import quantize_params
@@ -26,6 +27,8 @@ __all__ = [
     "ContinuousBatcher", "Overloaded", "RequestHandle",
     "RequestJournal", "RequestRecord",
     "CanaryProber", "PROBE_TENANT", "FleetFrontend",
+    "merge_owner_map", "owner_map_digest",
+    "AdmissionController", "TenantPolicy",
     "FleetRouter", "RouteDecision", "FleetAutoscaler", "ScaleDecision",
     "router_rule_pack",
     "quantize_params", "export_servable", "load_servable",

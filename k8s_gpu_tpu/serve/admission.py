@@ -1,0 +1,535 @@
+"""Per-tenant weighted-fair admission control for the gateway fleet.
+
+PR 15's front door sheds on raw queue pressure: one hot tenant posting
+floods can eat the whole shed budget while a polite tenant starves —
+the ``serve_tenant_*`` counters SEE the skew but nothing acts on it.
+This module is the acting half (ROADMAP item 3): an
+``AdmissionController`` every ``FleetFrontend`` consults at the door,
+built from three classic mechanisms composed deterministically:
+
+- **Weighted-fair queueing** — entitlement-vs-service deficits over
+  per-tenant queues: every granted token entitles each backlogged
+  tenant its weight-share, every grant debits the grantee its cost,
+  and each free slot goes to the most underserved tenant — so
+  admitted-token throughput converges to the weight ratio no matter
+  how lopsided the arrival rates are (a 10:1 flood degrades the
+  flooder, not the fleet, and no tenant name order can starve
+  anyone).
+- **Priority classes** — ``interactive`` strictly precedes ``batch``
+  at every round boundary, and when the slot pool is exhausted a
+  waiting interactive request PREEMPTS a granted-but-not-yet-running
+  batch ticket (the batch work re-queues at the front of its tenant
+  queue — delayed, never lost; ``admission_preemptions_total``).
+- **Token-rate quotas** — a per-tenant token bucket (rate × burst)
+  refilled on the injected clock; an offer the bucket cannot cover is
+  throttled at the door (``admission_quota_throttled_total{tenant}``)
+  before it can occupy queue space.
+
+The SLO budget plane (PR 14) is the feedback loop: ``burn_source`` (a
+zero-arg callable, typically reading ``slo_burn_rate_fast`` off the
+fleet registry) decides which class sheds first — at
+``burn_shed_batch`` the batch class sheds at the door while
+interactive still admits; only past ``burn_shed_interactive`` does
+interactive shed too.  Degradation is ordered, never alphabetical.
+
+Determinism is a hard contract (this module is in
+``DETERMINISTIC_PLANES``): every decision is a pure function of (offer
+sequence, policy table, injected Clock) — tenants iterate in sorted
+order, ticket ids are a monotone sequence, and the only time source is
+``clock.now()`` — so the WFQ fairness test replays two-run
+byte-identical under ``FakeClock``.  The ``threading.Event`` per
+ticket exists only for the HTTP path's blocking wait
+(``FleetFrontend._generate``); the synchronous ``offer``/``pump``/
+``release`` API never touches wall time.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..utils.clock import Clock, RealClock
+from ..utils.metrics import MetricsRegistry, global_metrics
+
+# Priority vocabulary, strongest first — the round boundary serves
+# classes in exactly this order.
+PRIORITY_CLASSES = ("interactive", "batch")
+
+# Ticket lifecycle states (the ``state`` vocabulary):
+#   queued     waiting in its tenant queue for a DRR grant
+#   granted    holds a slot; not yet running — still preemptible
+#   running    work started downstream — immune to preemption
+#   done       released; slot returned
+#   throttled  quota bucket could not cover the offer (shed at the door)
+#   shed       burn-driven or queue-bound shed (never entered a slot)
+TICKET_STATES = (
+    "queued", "granted", "running", "done", "throttled", "shed",
+)
+
+
+@dataclass
+class TenantPolicy:
+    """One tenant's admission contract.  ``weight`` scales the
+    entitlement share (2.0 admits twice the tokens of 1.0 under
+    contention); ``priority`` picks the class; ``quota_tokens_per_s``
+    of None means unmetered, and ``quota_burst`` defaults to two
+    seconds of rate."""
+
+    weight: float = 1.0
+    priority: str = "interactive"
+    quota_tokens_per_s: float | None = None
+    quota_burst: float | None = None
+
+
+@dataclass
+class Ticket:
+    """One admission request.  ``tokens`` is the cost the DRR deficit
+    must cover (prompt + requested budget — the quantity quotas meter
+    and fairness balances).  ``shed_reason`` explains a terminal
+    ``throttled``/``shed`` state."""
+
+    seq: int
+    tenant: str
+    tokens: int
+    priority: str
+    t_offer: float
+    state: str = "queued"
+    shed_reason: str = ""
+    preemptions: int = 0
+    _event: threading.Event = field(
+        default_factory=threading.Event, repr=False, compare=False,
+    )
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the next state transition signal (HTTP path
+        only — deterministic tests drive pump() synchronously)."""
+        return self._event.wait(timeout)
+
+
+class AdmissionController:
+    """Deficit-round-robin admission over per-tenant queues (module
+    docstring for the model).  Thread-safe; every offer/pump/release
+    serializes on one lock — admission is host-side bookkeeping."""
+
+    # Lock contract (graftcheck lockcheck + utils.faults
+    # guard_declared): the policy table, per-tenant queues/deficits/
+    # buckets, the granted-slot set, and the share accumulators are
+    # shared between every handler thread offering work and every
+    # thread releasing it.
+    _GUARDED_BY = {
+        "_lock": (
+            "_policies", "_queues", "_deficits", "_buckets",
+            "_held", "_shares", "_seq", "_share_t",
+        ),
+    }
+
+    def __init__(
+        self,
+        *,
+        slots: int = 8,
+        quantum_tokens: float = 64.0,
+        clock: Clock | None = None,
+        metrics: MetricsRegistry | None = None,
+        burn_source=None,
+        burn_shed_batch: float = 14.4,
+        burn_shed_interactive: float = 28.8,
+        max_queue_per_tenant: int = 64,
+        share_halflife_s: float = 30.0,
+    ):
+        """``slots`` bounds concurrently admitted requests (the
+        gateway's dispatch width, NOT the replicas' decode slots —
+        replicas still shed 429 on their own queue).
+        ``quantum_tokens`` is a display-scale knob only (the snapshot
+        and ``obs gateways`` surface it for operators reading deficit
+        magnitudes); fairness itself is entitlement bookkeeping and
+        needs no quantum — see ``_pump_locked``.  ``burn_source``
+        is the PR 14 feedback: a zero-arg callable returning the
+        current fast burn rate; see the module docstring for the
+        two-threshold shed order.  ``share_halflife_s`` is the decay
+        of the admitted-token share accumulator behind
+        ``admission_tenant_share`` — recent traffic dominates, history
+        forgives."""
+        self.slots = max(1, int(slots))
+        self.quantum = max(1.0, float(quantum_tokens))
+        self.clock = clock or RealClock()
+        self.metrics = metrics if metrics is not None else global_metrics
+        self.burn_source = burn_source
+        self.burn_shed_batch = float(burn_shed_batch)
+        self.burn_shed_interactive = float(burn_shed_interactive)
+        self.max_queue_per_tenant = max(1, int(max_queue_per_tenant))
+        self.share_halflife_s = max(1e-3, float(share_halflife_s))
+        self._lock = threading.Lock()
+        self._policies: dict[str, TenantPolicy] = {}
+        self._queues: dict[str, list[Ticket]] = {}
+        self._deficits: dict[str, float] = {}
+        # tenant -> (level, last_refill_t): the quota token bucket.
+        self._buckets: dict[str, tuple[float, float]] = {}
+        # seq -> Ticket for every granted/running slot holder.
+        self._held: dict[int, Ticket] = {}
+        # tenant -> decayed admitted-token accumulator (the share gauge).
+        self._shares: dict[str, float] = {}
+        self._share_t = self.clock.now()
+        self._seq = 0
+
+    # -- policy ------------------------------------------------------------
+    def set_tenant(
+        self,
+        tenant: str,
+        *,
+        weight: float = 1.0,
+        priority: str = "interactive",
+        quota_tokens_per_s: float | None = None,
+        quota_burst: float | None = None,
+    ) -> TenantPolicy:
+        """Declare (or replace) a tenant's policy.  Unknown tenants
+        admit under the default ``TenantPolicy()`` — admission control
+        must never turn 'unconfigured' into 'locked out'."""
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"priority must be one of {PRIORITY_CLASSES}, "
+                f"got {priority!r}"
+            )
+        pol = TenantPolicy(
+            weight=max(1e-6, float(weight)),
+            priority=priority,
+            quota_tokens_per_s=(
+                float(quota_tokens_per_s)
+                if quota_tokens_per_s is not None else None
+            ),
+            quota_burst=(
+                float(quota_burst) if quota_burst is not None else None
+            ),
+        )
+        with self._lock:
+            self._policies[str(tenant)] = pol
+            # A policy change resets the bucket to full burst at the
+            # change instant — deterministic, and never punishes a
+            # tenant for a mid-flight quota edit.
+            self._buckets.pop(str(tenant), None)
+        return pol
+
+    def policy(self, tenant: str) -> TenantPolicy:
+        with self._lock:
+            return self._policies.get(tenant) or TenantPolicy()
+
+    # -- the door ----------------------------------------------------------
+    def offer(self, tenant: str, tokens: int) -> Ticket:
+        """Present ``tokens`` of work for ``tenant``.  Returns a
+        Ticket whose state is one of: ``granted`` (slot held — call
+        ``try_run`` then ``release``), ``queued`` (wait and re-pump),
+        or terminal ``throttled``/``shed`` (the door refused; the
+        reason is on the ticket)."""
+        tenant = str(tenant)
+        tokens = max(1, int(tokens))
+        now = self.clock.now()
+        with self._lock:
+            pol = self._policies.get(tenant) or TenantPolicy()
+            self._seq += 1
+            t = Ticket(
+                seq=self._seq, tenant=tenant, tokens=tokens,
+                priority=pol.priority, t_offer=now,
+            )
+            if not self._quota_take_locked(tenant, pol, tokens, now):
+                t.state = "throttled"
+                t.shed_reason = "quota"
+                t._event.set()
+                self.metrics.inc(
+                    "admission_quota_throttled_total", tenant=tenant
+                )
+                return t
+            burn = self._burn()
+            if burn >= self.burn_shed_interactive or (
+                burn >= self.burn_shed_batch and pol.priority == "batch"
+            ):
+                # The PR 14 feedback loop: budget burning too fast →
+                # shed at the door, batch class first.
+                t.state = "shed"
+                t.shed_reason = "burn"
+                t._event.set()
+                self.metrics.inc(
+                    "admission_sheds_total", reason="burn"
+                )
+                return t
+            q = self._queues.setdefault(tenant, [])
+            if len(q) >= self.max_queue_per_tenant:
+                t.state = "shed"
+                t.shed_reason = "queue_full"
+                t._event.set()
+                self.metrics.inc(
+                    "admission_sheds_total", reason="queue_full"
+                )
+                return t
+            q.append(t)
+            self._pump_locked(now)
+        return t
+
+    def pump(self) -> None:
+        """Run one grant round now — the synchronous hook the
+        deterministic tests and the HTTP wait loop drive."""
+        with self._lock:
+            self._pump_locked(self.clock.now())
+
+    def try_run(self, ticket: Ticket) -> bool:
+        """Atomically promote a ``granted`` ticket to ``running``
+        (immune to preemption).  False means the grant was preempted
+        or shed meanwhile — keep waiting or give up."""
+        with self._lock:
+            if ticket.state == "granted":
+                ticket.state = "running"
+                return True
+            return False
+
+    def release(self, ticket: Ticket) -> None:
+        """Return a granted/running ticket's slot and run a round —
+        idempotent, safe on terminal tickets."""
+        with self._lock:
+            if ticket.state in ("granted", "running"):
+                ticket.state = "done"
+                self._held.pop(ticket.seq, None)
+                self._pump_locked(self.clock.now())
+
+    def cancel(self, ticket: Ticket, reason: str = "timeout") -> None:
+        """Withdraw a still-queued ticket (the HTTP wait loop's
+        deadline path) — a no-op for any other state."""
+        with self._lock:
+            if ticket.state != "queued":
+                return
+            q = self._queues.get(ticket.tenant)
+            if q is not None and ticket in q:
+                q.remove(ticket)
+            ticket.state = "shed"
+            ticket.shed_reason = reason
+            ticket._event.set()
+            self.metrics.inc("admission_sheds_total", reason=reason)
+
+    def await_grant(
+        self, ticket: Ticket, deadline: float | None = None,
+        poll_s: float = 0.01,
+    ) -> bool:
+        """The HTTP path's blocking wait: True once ``ticket`` is
+        RUNNING (grant won and promoted), False when it terminated or
+        ``deadline`` (clock domain) expired — the ticket is cancelled
+        so it cannot be granted after the caller walked away."""
+        while True:
+            with self._lock:
+                st = ticket.state
+                if st == "granted":
+                    ticket.state = "running"
+                    return True
+                if st in ("throttled", "shed", "done"):
+                    return False
+                ticket._event.clear()
+            if deadline is not None and self.clock.now() >= deadline:
+                self.cancel(ticket, reason="timeout")
+                return False
+            ticket.wait(poll_s)
+            self.pump()
+
+    # -- internals ---------------------------------------------------------
+    def _burn(self) -> float:
+        if self.burn_source is None:
+            return 0.0
+        try:
+            return float(self.burn_source() or 0.0)
+        except Exception:
+            return 0.0
+
+    def _quota_take_locked(
+        self, tenant: str, pol: TenantPolicy, tokens: int, now: float,
+    ) -> bool:
+        rate = pol.quota_tokens_per_s
+        if rate is None:
+            return True
+        burst = (
+            pol.quota_burst if pol.quota_burst is not None
+            else 2.0 * rate
+        )
+        level, last = self._buckets.get(tenant, (burst, now))
+        level = min(burst, level + rate * max(0.0, now - last))
+        if tokens > level:
+            self._buckets[tenant] = (level, now)
+            return False
+        self._buckets[tenant] = (level - tokens, now)
+        return True
+
+    def _grant_locked(self, tenant: str, t: Ticket, now: float) -> None:
+        t.state = "granted"
+        self._held[t.seq] = t
+        self._record_share_locked(tenant, float(t.tokens), now)
+        t._event.set()
+
+    def _record_share_locked(
+        self, tenant: str, tokens: float, now: float,
+    ) -> None:
+        """Decay every accumulator to ``now``, add the grant, export
+        the normalized per-tenant share gauge."""
+        dt = max(0.0, now - self._share_t)
+        if dt > 0.0:
+            decay = 0.5 ** (dt / self.share_halflife_s)
+            for k in list(self._shares):
+                self._shares[k] *= decay
+            self._share_t = now
+        self._shares[tenant] = self._shares.get(tenant, 0.0) + tokens
+        total = sum(self._shares.values())
+        if total > 0.0:
+            for k in sorted(self._shares):
+                self.metrics.set_gauge(
+                    "admission_tenant_share",
+                    self._shares[k] / total, tenant=k,
+                )
+
+    def _preempt_locked(self, now: float) -> int:
+        """The round-boundary preemption: revoke granted-but-not-
+        running BATCH tickets (newest grant first — it lost the least
+        progress) to free slots for waiting interactive work.  The
+        revoked ticket re-queues at the FRONT of its tenant queue with
+        its cost already share-accounted, so it wins its next
+        eligible round instead of starving behind the flood."""
+        waiting = sum(
+            len(q) for t, q in self._queues.items()
+            if q and (
+                self._policies.get(t) or TenantPolicy()
+            ).priority == "interactive"
+        )
+        if waiting <= 0:
+            return 0
+        revocable = sorted(
+            (
+                t for t in self._held.values()
+                if t.state == "granted" and t.priority == "batch"
+            ),
+            key=lambda t: -t.seq,
+        )
+        n = 0
+        for t in revocable:
+            if waiting <= 0:
+                break
+            self._held.pop(t.seq, None)
+            t.state = "queued"
+            t.preemptions += 1
+            t._event.set()
+            self._queues.setdefault(t.tenant, []).insert(0, t)
+            self.metrics.inc(
+                "admission_preemptions_total", **{"class": "batch"}
+            )
+            waiting -= 1
+            n += 1
+        return n
+
+    def _pump_locked(self, now: float) -> None:
+        """One grant round per priority class, interactive first.
+        Weighted fairness is entitlement-vs-service bookkeeping:
+        ``_deficits[t]`` is the tokens tenant ``t`` was ENTITLED to
+        minus the tokens it was GRANTED.  Every grant of ``C`` tokens
+        credits each backlogged tenant in the class its weight-share
+        of ``C`` and debits the grantee ``C`` — deficits sum to ~zero,
+        and each free slot goes to the most underserved backlogged
+        tenant (max deficit; ties break to the sorted-first name), so
+        granted-token throughput converges to the weight ratio under
+        any arrival skew.  Per-round credit ACCRUAL (textbook DRR)
+        does not have that property at this door: slots, not credit,
+        are the binding constraint, so a flooder whose credit refills
+        every pump stays richest forever and starves the rest — the
+        weight-skew regression in test_gateway_ha pins this.  A
+        tenant whose queue empties forfeits leftover credit but keeps
+        its debt (no hoarding, and no debt amnesty by draining)."""
+        free = self.slots - len(self._held)
+        if free <= 0:
+            free += self._preempt_locked(now)
+        for cls in PRIORITY_CLASSES:
+            while free > 0:
+                backlogged = sorted(
+                    t for t, q in self._queues.items()
+                    if q and (
+                        self._policies.get(t) or TenantPolicy()
+                    ).priority == cls
+                )
+                if not backlogged:
+                    break
+                best = backlogged[0]
+                for t in backlogged[1:]:
+                    if (self._deficits.get(t, 0.0)
+                            > self._deficits.get(best, 0.0)):
+                        best = t
+                head = self._queues[best].pop(0)
+                cost = float(head.tokens)
+                w_all = sum(
+                    (self._policies.get(t) or TenantPolicy()).weight
+                    for t in backlogged
+                )
+                for t in backlogged:
+                    w = (self._policies.get(t) or TenantPolicy()).weight
+                    self._deficits[t] = (
+                        self._deficits.get(t, 0.0) + cost * (w / w_all)
+                    )
+                self._deficits[best] -= cost
+                if not self._queues[best]:
+                    self._deficits[best] = min(
+                        0.0, self._deficits[best]
+                    )
+                self._grant_locked(best, head, now)
+                free -= 1
+        for cls in PRIORITY_CLASSES:
+            depth = sum(
+                len(q) for t, q in self._queues.items()
+                if (
+                    self._policies.get(t) or TenantPolicy()
+                ).priority == cls
+            )
+            self.metrics.set_gauge(
+                "admission_queue_depth", float(depth),
+                **{"class": cls},
+            )
+
+    # -- read surface ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The explain view (``obs gateways`` / ``/admin/admission``):
+        per tenant, its policy, DRR deficit, queue depth, quota level,
+        and decayed admitted-token share — sorted keys throughout, the
+        two-run byte-identity surface."""
+        with self._lock:
+            tenants = sorted(
+                set(self._policies) | set(self._queues)
+                | set(self._shares)
+            )
+            total = sum(self._shares.values())
+            held = sorted(
+                (t.tenant, t.seq, t.state) for t in self._held.values()
+            )
+            out = {
+                "slots": self.slots,
+                "held": len(held),
+                "holders": [
+                    {"tenant": t, "seq": s, "state": st}
+                    for t, s, st in held
+                ],
+                "quantum": self.quantum,
+                "tenants": [],
+            }
+            for t in tenants:
+                pol = self._policies.get(t) or TenantPolicy()
+                level = None
+                if pol.quota_tokens_per_s is not None:
+                    burst = (
+                        pol.quota_burst
+                        if pol.quota_burst is not None
+                        else 2.0 * pol.quota_tokens_per_s
+                    )
+                    lv, last = self._buckets.get(
+                        t, (burst, self._share_t)
+                    )
+                    level = round(lv, 4)
+                out["tenants"].append({
+                    "tenant": t,
+                    "weight": pol.weight,
+                    "priority": pol.priority,
+                    "deficit": round(self._deficits.get(t, 0.0), 4),
+                    "queued": len(self._queues.get(t, ())),
+                    "quota_tokens_per_s": pol.quota_tokens_per_s,
+                    "quota_level": level,
+                    "share": round(
+                        (self._shares.get(t, 0.0) / total)
+                        if total > 0 else 0.0, 6,
+                    ),
+                })
+            return out

@@ -2024,6 +2024,27 @@ class ContinuousBatcher:
         return self._warmed
 
     @property
+    def warm_chain_hashes(self) -> list[str]:
+        """Sorted hex content hashes of every registered KV block —
+        the ``GET /debug/chains`` body the gateway fleet's owner-map
+        reconstruction scrapes (serve/frontend.py).  Non-paged mode
+        has no chain-addressed state and returns [].  Benign racy read
+        of the pool's registry, like the gauge export's: the scheduler
+        may register a block mid-iteration, so retry the snapshot a
+        few times and degrade to [] rather than block the scrape
+        behind a quiesce barrier (reconstruction tolerates a stale
+        scrape; it re-converges on the next pass)."""
+        pool = getattr(self, "_pool", None)
+        if pool is None:
+            return []
+        for _ in range(3):
+            try:
+                return [h.hex() for h in pool.chain_hashes()]
+            except RuntimeError:
+                continue
+        return []
+
+    @property
     def spec_stats(self) -> dict:
         """Measured speculative acceptance over live rows: drafted /
         accepted counts and the rate (0.0 when spec is off or nothing

@@ -59,6 +59,7 @@ a replica the dispatch loop had marked down.
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
 import logging
@@ -90,6 +91,51 @@ log = logging.getLogger("k8s_gpu_tpu.frontend")
 RETRY_AFTER_S = 1
 
 
+def merge_owner_map(scrapes: dict) -> dict:
+    """Pure merge of per-replica ``/debug/chains`` scrape bodies into
+    ONE chain→owner map — the gateway fleet's reconstruction kernel
+    (ROADMAP item 3): routing state is *reconstructible rather than
+    replicated*, so N gateways started independently converge to the
+    same map with no gossip, no consensus, and no shared store.
+
+    ``scrapes`` maps replica name → list of hex chain hashes warm on
+    it.  A chain warm on exactly one replica is owned by it; a chain
+    warm on several (migration copies, fallback re-routes) tie-breaks
+    by rendezvous hash on the CHAIN bytes over the sorted claimant set
+    — the same HRW primitive brand-new chains route by, so every
+    gateway computing this merge lands on the same owner.  Output is
+    ``{hex: owner}`` over sorted hashes; malformed hashes are dropped
+    (a corrupt scrape entry must not poison the whole map)."""
+    claims: dict[str, list[str]] = {}
+    for name in sorted(scrapes):
+        for h in scrapes[name]:
+            if not isinstance(h, str) or not h:
+                continue
+            try:
+                bytes.fromhex(h)
+            except ValueError:
+                continue
+            claims.setdefault(h, []).append(name)
+    out: dict[str, str] = {}
+    for h in sorted(claims):
+        owners = sorted(set(claims[h]))
+        if len(owners) == 1:
+            out[h] = owners[0]
+            continue
+        out[h] = FleetRouter._rendezvous(bytes.fromhex(h), owners)
+    return out
+
+
+def owner_map_digest(mapping: dict) -> str:
+    """The agreement fingerprint two gateways compare: blake2b over
+    the canonical JSON of the chain→owner map.  Byte-identical maps —
+    the reconstruction contract — give byte-identical digests."""
+    blob = json.dumps(
+        mapping, sort_keys=True, separators=(",", ":")
+    ).encode()
+    return hashlib.blake2b(blob, digest_size=8).hexdigest()
+
+
 class FleetFrontend:
     """The gateway process (module docstring for the model).  ``port=0``
     binds ephemeral; ``.port`` is the bound one.  All collaborators are
@@ -106,7 +152,8 @@ class FleetFrontend:
     # threads.
     _GUARDED_BY = {
         "_lock": ("_replicas", "_inflight", "_drains", "_live",
-                  "_live_seq"),
+                  "_live_seq", "_peers", "_owner_map", "_owner_digest",
+                  "_owner_seq"),
     }
 
     def __init__(
@@ -127,6 +174,8 @@ class FleetFrontend:
         drain_deadline_s: float = 30.0,
         drain_poll_s: float = 0.05,
         max_journal: int = 512,
+        admission=None,
+        admission_wait_s: float = 5.0,
     ):
         """``page_size`` must match the replicas' paged-KV page size —
         it is the router's chain-hash chunking, and the whole affinity
@@ -134,7 +183,14 @@ class FleetFrontend:
         ``retry_policy`` / ``breakers`` are the ``cloud/resilience.py``
         primitives; the defaults are tuned for a serving hop (tens of
         milliseconds of backoff, a short breaker reset so canary
-        recovery probes half-open quickly), not a cloud API."""
+        recovery probes half-open quickly), not a cloud API.
+        ``admission`` is an optional ``serve/admission.py``
+        AdmissionController: when set, /generate consults it at the
+        door (weighted-fair queueing, priority classes, per-tenant
+        quotas) and a refused request sheds 429 — None (the default)
+        keeps the PR 15 behavior, admission unconditional.
+        ``admission_wait_s`` bounds how long a queued request waits
+        for a grant when the client gave no deadline."""
         self.tokenizer = tokenizer
         self.clock = clock or RealClock()
         self.metrics = metrics if metrics is not None else global_metrics
@@ -173,6 +229,17 @@ class FleetFrontend:
         # journal record instead of silently vanishing.
         self._live: dict[str, dict[int, dict]] = {}
         self._live_seq = 0
+        # The gateway fleet (ROADMAP item 3): peer gateways serving the
+        # same replica pool, and this gateway's last reconstructed
+        # chain→owner map + its agreement digest.  Peers never gossip
+        # state — they only compare digests (/admin/ownermap), because
+        # each rebuilds the same map from the same replica scrapes.
+        self._peers: dict[str, str] = {}        # name -> base URL
+        self._owner_map: dict[str, str] = {}    # hex chain -> owner
+        self._owner_digest = ""
+        self._owner_seq = 0
+        self.admission = admission
+        self.admission_wait_s = max(0.05, float(admission_wait_s))
         # The wire-level KV migration coordinator (serve/migrate.py):
         # drains hand a victim's warm chains to the router-chosen new
         # owner instead of letting them die with the process.
@@ -188,7 +255,8 @@ class FleetFrontend:
             metrics_server_label = "fleet-frontend"
             known_routes = (
                 "/generate", "/replica", "/admin/replicas",
-                "/admin/drain", "/healthz", "/readyz", "/metrics",
+                "/admin/drain", "/admin/ownermap", "/admin/peers",
+                "/admin/admission", "/healthz", "/readyz", "/metrics",
                 "/debug/requests",
             )
 
@@ -238,6 +306,26 @@ class FleetFrontend:
                     return self._json(
                         200, {"drains": outer.drain_states()}
                     )
+                if path == "/admin/ownermap":
+                    # The agreement surface peers compare digests on;
+                    # ?chains=0 skips the full map (the peer check
+                    # only needs the digest).
+                    return self._json(200, outer.owner_map_snapshot(
+                        include_chains=(
+                            self._query()("chains", "1") != "0"
+                        ),
+                    ))
+                if path == "/admin/peers":
+                    return self._json(
+                        200, {"peers": outer.peer_states()}
+                    )
+                if path == "/admin/admission":
+                    a = outer.admission
+                    if a is None:
+                        return self._json(200, {"enabled": False})
+                    return self._json(
+                        200, {"enabled": True, **a.snapshot()}
+                    )
                 if path == "/debug/requests":
                     one = self._query()
                     try:
@@ -283,10 +371,55 @@ class FleetFrontend:
                     return self._register(body)
                 if path == "/admin/drain":
                     return self._drain(body)
+                if path == "/admin/ownermap":
+                    # Rebuild the owner map from replica scrapes NOW —
+                    # the admin trigger for a freshly started gateway
+                    # joining an already-warm fleet.
+                    try:
+                        got = outer.reconstruct(
+                            check_peers=bool(
+                                body.get("check_peers", True)
+                            ),
+                        )
+                    except RuntimeError as e:
+                        return self._json(
+                            503, {"error": str(e)},
+                            headers={
+                                "Retry-After": str(RETRY_AFTER_S)
+                            },
+                        )
+                    return self._json(200, got)
+                if path == "/admin/peers":
+                    name = body.get("name", "")
+                    url = body.get("url", "")
+                    if not isinstance(name, str) or not name.strip():
+                        return self._json(
+                            400, {"error": "name (string) required"}
+                        )
+                    if not isinstance(url, str) or not url.strip():
+                        return self._json(
+                            400, {"error": "url (string) required"}
+                        )
+                    outer.add_peer(name.strip(), url.strip())
+                    return self._json(200, {
+                        "peer": name.strip(),
+                        "peers": len(outer.peer_states()),
+                    })
                 return self._json(404, {"error": "not found"})
 
             def _delete(self):
                 path = self.path.split("?")[0]
+                if path == "/admin/peers":
+                    name = self._query()("name")
+                    if not name:
+                        return self._json(
+                            400, {"error": "name (query) required"}
+                        )
+                    if outer.remove_peer(name):
+                        return self._json(200, {"removed": name})
+                    return self._json(
+                        404, {"error": f"unknown peer {name!r}"}
+                    )
                 if path != "/admin/replicas":
                     return self._json(404, {"error": "not found"})
                 name = self._query()("name")
@@ -370,8 +503,27 @@ class FleetFrontend:
 
             # -- /generate ------------------------------------------------
             def _generate(self, body, pinned):
+                # ``prompt_ids`` (pre-tokenized) is the CLIENT retry
+                # contract for a dead gateway: a client whose stream
+                # was cut re-issues ``original ids + tokens already
+                # received`` to a SURVIVING gateway, which routes it by
+                # the same chain hashes to the same replica — the
+                # teacher-forced resume (serve/migrate.py) with the
+                # client, not the relay, holding the prefix.
                 prompt = body.get("prompt", "")
-                if not isinstance(prompt, str) or not prompt:
+                prompt_ids = body.get("prompt_ids")
+                if prompt_ids is not None:
+                    if (not isinstance(prompt_ids, list)
+                            or not prompt_ids
+                            or not all(
+                                isinstance(i, int)
+                                and not isinstance(i, bool)
+                                for i in prompt_ids
+                            )):
+                        return self._json(400, {
+                            "error": "prompt_ids must be a non-empty "
+                                     "list of ints"})
+                elif not isinstance(prompt, str) or not prompt:
                     return self._json(
                         400, {"error": "prompt (string) required"}
                     )
@@ -412,40 +564,99 @@ class FleetFrontend:
                             504, {"error": "deadline exceeded"}
                         )
                     deadline = outer.clock.now() + budget_ms / 1000.0
-                ids = outer.tokenizer.encode(prompt)
-                out = outer.dispatch(
-                    ids, body, tenant=tenant, deadline=deadline,
-                    trace_ctx=self.trace_ctx,
-                    stream=bool(body.get("stream", False)),
-                    pinned=pinned,
-                )
-                if out["kind"] == "stream":
-                    # Everything the relay needs to RESUME this stream
-                    # on another replica if its owner dies or migrates
-                    # mid-flight (serve/migrate.py): the original ids,
-                    # the client body, and the remaining-budget inputs.
-                    # A PINNED stream never resumes elsewhere — the
-                    # canary contract is that a dead replica fails its
-                    # probe instead of silently succeeding on another.
-                    try:
-                        want_new = int(body.get("max_new_tokens", 32))
-                    except (TypeError, ValueError):
-                        want_new = 32
-                    if pinned is None:
-                        out["resume_ctx"] = {
-                            "ids": [int(i) for i in ids.tolist()],
-                            "body": body,
-                            "tenant": tenant,
-                            "deadline": deadline,
-                            "trace_ctx": self.trace_ctx,
-                            "max_new": max(1, want_new),
-                        }
-                    return self._relay(out)
-                hdrs = dict(out.get("headers") or {})
-                if out.get("replica"):
-                    hdrs["x-route-replica"] = out["replica"]
-                    hdrs["x-route-reason"] = out["reason"]
-                return self._json(out["code"], out["payload"], hdrs)
+                if prompt_ids is not None:
+                    ids = [int(i) for i in prompt_ids]
+                else:
+                    ids = [
+                        int(i)
+                        for i in outer.tokenizer.encode(prompt).tolist()
+                    ]
+                try:
+                    want_new = int(body.get("max_new_tokens", 32))
+                except (TypeError, ValueError):
+                    want_new = 32
+                # A surviving gateway accepting a client retry stamps
+                # the downstream submit with the replica/gateway the
+                # request fled (x-resume-from → x-migrated-from), so
+                # the destination journal carries the provenance.
+                resume_from = (
+                    self.headers.get("x-resume-from") or ""
+                ).strip()[:64]
+                # -- admission (serve/admission.py) -------------------
+                # Pinned probes and reserved "_" tenants bypass: probe
+                # traffic must measure the replica, not the queue, and
+                # synthetic tenants carry no admission contract.
+                ticket = None
+                if (outer.admission is not None and pinned is None
+                        and not tenant.startswith("_")):
+                    ticket = outer.admission.offer(
+                        tenant, len(ids) + max(1, want_new)
+                    )
+                    admitted = False
+                    if ticket.state not in ("throttled", "shed"):
+                        admitted = outer.admission.await_grant(
+                            ticket,
+                            deadline=(
+                                deadline if deadline is not None
+                                else outer.clock.now()
+                                + outer.admission_wait_s
+                            ),
+                        )
+                    if not admitted:
+                        why = ticket.shed_reason or "admission"
+                        outer.metrics.inc(
+                            "frontend_shed_total", reason="admission"
+                        )
+                        outer._journal(
+                            tenant=tenant, trace_ctx=self.trace_ctx,
+                            reason="admission", code=429,
+                            t0=ticket.t_offer,
+                            extra={"admission": why},
+                        )
+                        return self._json(
+                            429,
+                            {"error": f"admission refused ({why})"},
+                            headers={
+                                "Retry-After": str(RETRY_AFTER_S)
+                            },
+                        )
+                try:
+                    out = outer.dispatch(
+                        ids, body, tenant=tenant, deadline=deadline,
+                        trace_ctx=self.trace_ctx,
+                        stream=bool(body.get("stream", False)),
+                        pinned=pinned, migrated_from=resume_from,
+                    )
+                    if out["kind"] == "stream":
+                        # Everything the relay needs to RESUME this
+                        # stream on another replica if its owner dies
+                        # or migrates mid-flight (serve/migrate.py):
+                        # the original ids, the client body, and the
+                        # remaining-budget inputs.  A PINNED stream
+                        # never resumes elsewhere — the canary
+                        # contract is that a dead replica fails its
+                        # probe instead of silently succeeding on
+                        # another.
+                        if pinned is None:
+                            out["resume_ctx"] = {
+                                "ids": list(ids),
+                                "body": body,
+                                "tenant": tenant,
+                                "deadline": deadline,
+                                "trace_ctx": self.trace_ctx,
+                                "max_new": max(1, want_new),
+                            }
+                        return self._relay(out)
+                    hdrs = dict(out.get("headers") or {})
+                    if out.get("replica"):
+                        hdrs["x-route-replica"] = out["replica"]
+                        hdrs["x-route-reason"] = out["reason"]
+                    return self._json(
+                        out["code"], out["payload"], hdrs
+                    )
+                finally:
+                    if ticket is not None:
+                        outer.admission.release(ticket)
 
             def _relay(self, out):
                 """Relay a downstream ndjson stream event-by-event,
@@ -818,6 +1029,158 @@ class FleetFrontend:
                 out.append(st)
         return out
 
+    # -- gateway fleet (ROADMAP item 3) --------------------------------------
+    def add_peer(self, name: str, url: str) -> None:
+        """Register a peer gateway serving the same replica pool.
+        Peers are compared, never consulted: each gateway rebuilds its
+        own owner map from replica scrapes, and the peer list only
+        feeds the convergence check (digest agreement) and the
+        client's failover target set."""
+        name = str(name).strip()[:64]
+        if not name:
+            raise ValueError("peer name required")
+        with self._lock:
+            self._peers[name] = str(url).rstrip("/")
+
+    def remove_peer(self, name: str) -> bool:
+        with self._lock:
+            return self._peers.pop(name, None) is not None
+
+    def peer_states(self) -> list[dict]:
+        with self._lock:
+            return [
+                {"peer": name, "url": self._peers[name]}
+                for name in sorted(self._peers)
+            ]
+
+    def scrape_chains(self) -> dict[str, list[str]]:
+        """One reconstruction pass's raw input: per registered replica
+        (sorted), its ``/debug/chains`` body.  The ``gateway.scrape``
+        fault site sits in front of every fetch so chaos runs can drop
+        scrapes deterministically; an unreachable or faulted replica
+        is SKIPPED (``gateway_scrape_failures_total{replica=}``) — a
+        partial scrape yields a smaller map, never a wrong one, and
+        the next pass re-converges."""
+        with self._lock:
+            targets = sorted(self._replicas.items())
+        out: dict[str, list[str]] = {}
+        for name, url in targets:
+            got = None
+            try:
+                global_faults.fire(
+                    "gateway.scrape", error_type=RuntimeError,
+                    only=("error", "timeout"),
+                )
+                got = self._get_json(url + "/debug/chains")
+            except RuntimeError:
+                got = None
+            if got is None or not isinstance(got.get("chains"), list):
+                self.metrics.inc(
+                    "gateway_scrape_failures_total", replica=name
+                )
+                continue
+            out[name] = [h for h in got["chains"] if isinstance(h, str)]
+        return out
+
+    def reconstruct(self, check_peers: bool = True) -> dict:
+        """Rebuild the chain→owner map purely from replica scrapes
+        (``merge_owner_map``) and install it on the router — the
+        tentpole contract: a gateway started five minutes late, or
+        rebooted with empty state, converges to the SAME owner map as
+        every peer, because the map is a pure function of (replica
+        set, replica pool contents, rendezvous hash) and none of those
+        live in any gateway.  Updates ``gateway_owner_map_hash`` (the
+        digest's leading 48 bits — exactly representable in the float
+        gauge) and, with ``check_peers``, ``gateway_converged``.
+        Raises RuntimeError when no replica could be scraped."""
+        scrapes = self.scrape_chains()
+        with self._lock:
+            have_replicas = bool(self._replicas)
+        if have_replicas and not scrapes:
+            raise RuntimeError(
+                "reconstruction scraped no replica (all unreachable "
+                "or faulted)"
+            )
+        mapping = merge_owner_map(scrapes)
+        installed = self.router.install_chains({
+            bytes.fromhex(h): owner for h, owner in mapping.items()
+        })
+        digest = owner_map_digest(mapping)
+        with self._lock:
+            self._owner_map = mapping
+            self._owner_digest = digest
+            self._owner_seq += 1
+            seq = self._owner_seq
+        self.metrics.inc("gateway_reconstructions_total")
+        self.metrics.set_gauge(
+            "gateway_owner_map_hash", float(int(digest[:12], 16))
+        )
+        out = {
+            "digest": digest,
+            "seq": seq,
+            "chains": len(mapping),
+            "installed": installed,
+            "scraped": sorted(scrapes),
+        }
+        if check_peers:
+            out["peers"] = self.check_convergence()
+        return out
+
+    def check_convergence(self) -> list[dict]:
+        """Compare this gateway's owner-map digest against every
+        peer's (``GET /admin/ownermap?chains=0`` — digests only, the
+        map itself never travels).  ``gateway_converged`` reads 1.0
+        when every reachable peer agrees; an unreachable peer counts
+        as disagreement (a fleet that cannot prove convergence must
+        not claim it).  The ``gateway.peer`` fault site lets chaos
+        runs sever gateways deterministically."""
+        with self._lock:
+            mine = self._owner_digest
+            peers = sorted(self._peers.items())
+        out = []
+        agree = True
+        for name, url in peers:
+            got = None
+            try:
+                global_faults.fire(
+                    "gateway.peer", error_type=RuntimeError,
+                    only=("error", "timeout"),
+                )
+                got = self._get_json(url + "/admin/ownermap?chains=0")
+            except RuntimeError:
+                got = None
+            if got is None:
+                out.append(
+                    {"peer": name, "digest": None, "agree": False}
+                )
+                agree = False
+                continue
+            d = str(got.get("digest") or "")
+            ok = bool(mine) and d == mine
+            out.append({"peer": name, "digest": d, "agree": ok})
+            agree = agree and ok
+        self.metrics.set_gauge(
+            "gateway_converged", 1.0 if agree else 0.0
+        )
+        return out
+
+    def owner_map_snapshot(self, include_chains: bool = True) -> dict:
+        """The ``GET /admin/ownermap`` body: digest, generation, and
+        (unless suppressed) the full chain→owner map — the byte string
+        the N-gateway identity test compares."""
+        with self._lock:
+            snap = {
+                "gateway": self.url,
+                "digest": self._owner_digest,
+                "seq": self._owner_seq,
+                "tracked": len(self._owner_map),
+                "peers": sorted(self._peers),
+                "replicas": sorted(self._replicas),
+            }
+            if include_chains:
+                snap["chains"] = dict(self._owner_map)
+        return snap
+
     # -- drain -------------------------------------------------------------
     def drain(
         self, name: str, deadline_s: float | None = None,
@@ -1053,6 +1416,25 @@ class FleetFrontend:
                 e.close()
         except (OSError, http.client.HTTPException, ValueError):
             return None
+
+    def _get_json(self, url: str) -> dict | None:
+        """GET ``url``, parse JSON; None on any transport/parse error.
+        The scrape and peer-digest fetches ride this — both treat None
+        as "skip and count", never as fatal."""
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                url, timeout=self.request_timeout_s
+            ) as r:
+                got = json.loads(r.read().decode() or "{}")
+        except (
+            urllib.error.HTTPError, OSError,
+            http.client.HTTPException, ValueError,
+        ):
+            return None
+        return got if isinstance(got, dict) else None
 
     def _warm(self, url: str) -> None:
         """One real 1-token ``/generate`` against a fresh replica: the

@@ -41,6 +41,20 @@ FINISH_REASONS = (
     "eos", "budget", "deadline", "queue_full", "no_capacity", "aborted",
 )
 
+# Gateway-side terminal reasons (serve/frontend.py writes these with
+# path="gateway"; replica journals never carry them):
+#   admission      the weighted-fair admission controller refused the
+#                  ticket — ``extra["admission"]`` narrows it to the
+#                  shed cause (quota / burn / queue_full / timeout)
+#   overloaded     every candidate replica was saturated
+#   rejected       a replica rejected the request (4xx passthrough)
+#   error          relay failed after exhausting dispatch attempts
+#   ok             delivered (gateway-side mirror of the replica record)
+GATEWAY_REASONS = (
+    "ok", "admission", "overloaded", "rejected", "error",
+    "deadline", "aborted",
+)
+
 
 @dataclass
 class RequestRecord:

@@ -148,6 +148,15 @@ class BlockPool:
         chain addresses, and the destination decides what it lacks."""
         return sorted(self._blk_of.items(), key=lambda kv: kv[0])
 
+    def chain_hashes(self) -> list[bytes]:
+        """Sorted registered content hashes, no block ids — the
+        read-only enumeration the gateway's owner-map reconstruction
+        scrapes (``GET /debug/chains``, serve/frontend.py).  The sort
+        makes the scrape body a deterministic function of pool content,
+        which is what lets N gateways rebuild the SAME owner map from
+        independent scrapes."""
+        return sorted(self._blk_of)
+
     # -- sharing -----------------------------------------------------------
     def acquire(self, h: bytes) -> int | None:
         """Pin the block registered under ``h`` (refcount++), pulling it

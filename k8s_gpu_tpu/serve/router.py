@@ -494,6 +494,50 @@ class FleetRouter:
                 )
             return len(hashes)
 
+    def owner_map(self) -> dict[str, str]:
+        """The warm-chain table as ``{hex hash: owner}``, sorted by
+        hash — the gateway fleet's agreement surface
+        (``/admin/ownermap``, serve/frontend.py).  Serialized with
+        ``json.dumps(..., sort_keys=True)`` this is the byte string
+        two gateways compare digests of."""
+        with self._lock:
+            return {
+                h.hex(): owner
+                for h, owner in sorted(self._chains.items())
+            }
+
+    def install_chains(self, mapping: dict[bytes, str]) -> int:
+        """REPLACE the warm-chain table with a reconstructed
+        chain→owner map (serve/frontend.py rebuilt it from replica
+        ``/debug/chains`` scrapes + rendezvous tie-breaks).  Entries
+        naming an unregistered owner are dropped — installing them
+        would route traffic into a wall, exactly the ``rehome``
+        refusal.  Insertion in sorted-hash order makes the resulting
+        LRU order (and therefore ``snapshot()`` and ``owner_map()``)
+        a pure function of the mapping — the two-run byte-identity
+        the reconstruction contract pins.  Returns entries installed."""
+        with self._lock:
+            self._chains.clear()
+            for name in self._chain_counts:
+                self._chain_counts[name] = 0
+            n = 0
+            for h, owner in sorted(mapping.items()):
+                if owner not in self._replicas:
+                    continue
+                self._chains[h] = owner
+                self._chain_counts[owner] = (
+                    self._chain_counts.get(owner, 0) + 1
+                )
+                n += 1
+            while len(self._chains) > self.max_tracked_chains:
+                _, owner = self._chains.popitem(last=False)
+                self._chain_counts[owner] = (
+                    self._chain_counts.get(owner, 1) - 1
+                )
+                n -= 1
+            self._export_gauges()
+            return n
+
     def _export_gauges(self) -> None:
         """Refresh the serve_router_* gauges.  Lock held by caller
         (every mutation path calls this before releasing _lock)."""

@@ -139,10 +139,17 @@ class LmServer:
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
             metrics_server_label = "lm-server"
             known_routes = ("/generate", "/tokenize", "/precache",
-                            "/healthz", "/readyz",
+                            "/healthz", "/readyz", "/debug/chains",
                             "/admin/export", "/admin/import")
 
             def _get(self):
+                if self.path == "/debug/chains":
+                    # The gateway fleet's reconstruction scrape
+                    # (serve/frontend.py): which chain hashes are
+                    # physically warm HERE.  Read-only and barrier-free
+                    # — a reconstruction pass hits every replica and
+                    # must never stall decode to answer.
+                    return self._json(200, outer.chain_state())
                 if self.path == "/healthz":
                     # Liveness: the process answers.  Anything deeper
                     # belongs in /readyz — a liveness probe that checks
@@ -570,6 +577,20 @@ class LmServer:
             # lets registration verify it reached the right process.
             "replica": self.name,
             "inflight": self.batcher.inflight_requests,
+        }
+
+    def chain_state(self) -> dict:
+        """The ``GET /debug/chains`` body: this replica's identity,
+        its page size, and the sorted hex chain hashes physically warm
+        in its paged pool.  The ONE scrape surface the gateway fleet's
+        owner-map reconstruction reads (serve/frontend.py) — N
+        gateways scraping the same replicas get byte-identical bodies,
+        which is what makes independently rebuilt owner maps agree
+        without gossip or consensus."""
+        return {
+            "replica": self.name,
+            "page_size": int(self.batcher.page_size),
+            "chains": self.batcher.warm_chain_hashes,
         }
 
     def drain(self) -> None:

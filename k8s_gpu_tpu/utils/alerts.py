@@ -560,6 +560,73 @@ def slo_rule_pack(
     ]
 
 
+def admission_rule_pack(
+    *,
+    quota_window: float = 300.0,
+    quota_rate: float = 0.5,
+    quota_for_s: float = 60.0,
+    preempt_window: float = 300.0,
+    preempt_rate: float = 1.0,
+    preempt_for_s: float = 120.0,
+    diverged_for_s: float = 60.0,
+) -> list:
+    """Gateway-fleet rules (ISSUE 18): the admission plane's abuse and
+    divergence signals.
+
+    - ``TenantQuotaStorm`` — sustained ``admission_quota_throttled``
+      rate: some tenant is hammering past its token budget (the
+      throttle is doing its job; the page is about the CLIENT, and
+      ``obs gateways`` names the tenant).
+    - ``AdmissionPreemptionChurn`` — batch work being revoked faster
+      than ``preempt_rate``/s for minutes: interactive load is high
+      enough that batch effectively never runs — capacity, not
+      fairness, is the fix.
+    - ``GatewayDiverged`` — ``gateway_converged`` stuck at 0: this
+      gateway's reconstructed owner map disagrees with (or cannot
+      reach) a peer, so affinity routing is split-brained.  Pages
+      because the whole point of reconstructible state is that this
+      should self-heal within one scrape cycle.
+
+    Every family is absent-safe: missing metrics read as 0 rates and
+    empty series, so the pack loads on any registry."""
+    return [
+        AlertingRule(
+            "TenantQuotaStorm",
+            lambda ctx: ctx.rate(
+                "admission_quota_throttled_total", quota_window
+            ),
+            above=quota_rate, for_s=quota_for_s,
+            annotation=(
+                "tenants throttled at {value:.2f}/s — someone is "
+                "sustained past their token quota (obs gateways shows "
+                "per-tenant levels)"
+            ),
+        ),
+        AlertingRule(
+            "AdmissionPreemptionChurn",
+            lambda ctx: ctx.rate(
+                "admission_preemptions_total", preempt_window
+            ),
+            above=preempt_rate, for_s=preempt_for_s,
+            annotation=(
+                "batch admissions revoked at {value:.2f}/s — "
+                "interactive load is starving batch; add capacity or "
+                "lower interactive share"
+            ),
+        ),
+        AlertingRule(
+            "GatewayDiverged",
+            lambda ctx: ctx.series("gateway_converged"),
+            below=0.5, for_s=diverged_for_s, severity="page",
+            annotation=(
+                "gateway owner-map digest disagrees with a peer (or "
+                "the peer is unreachable) — affinity routing is "
+                "split-brained; POST /admin/ownermap to reconverge"
+            ),
+        ),
+    ]
+
+
 def default_rule_pack(
     *,
     slo: float = 0.99,

@@ -7,7 +7,9 @@ poses: does the whole control plane *converge* when 30% of everything
 fails, and does the serving plane degrade instead of hanging?  This module
 is the second, orthogonal layer: **injection sites** are named choke
 points compiled into production code paths (cloud transport, fake cloud
-verbs, workqueue enqueue, reconcile dispatch, serve admission), and a
+verbs, workqueue enqueue, reconcile dispatch, serve admission, the
+gateway's replica scrapes and peer digest checks — ``gateway.scrape`` /
+``gateway.peer`` in serve/frontend.py), and a
 test/demo *arms* a site with a seeded ``FaultPlan``.  Disarmed sites cost
 one dict lookup — the default state everywhere outside a chaos run.
 

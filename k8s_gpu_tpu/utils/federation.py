@@ -97,6 +97,17 @@ GAUGE_AGG: dict[str, str] = {
     # worst-aligned process — the one whose attributed segments carry
     # the most alignment error.
     "e2e_clock_skew_seconds": "max",
+    # Gateway fleet (ISSUE 18): convergence is its WORST member (one
+    # diverged gateway makes the fleet row say 0), and the owner-map
+    # hash aggregates min so "all gateways equal" reads as "min equals
+    # every member" — any disagreement shows up as the fleet row
+    # differing from some replica row.  Tenant share averages across
+    # gateways (each admits its own slice of one tenant's traffic);
+    # queue depth is total queued work.
+    "gateway_converged": "min",
+    "gateway_owner_map_hash": "min",
+    "admission_tenant_share": "avg",
+    "admission_queue_depth": "sum",
 }
 
 # Families the collector never writes aggregates for: the fleet

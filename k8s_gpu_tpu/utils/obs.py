@@ -655,6 +655,85 @@ def render_fleet(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_gateways(snaps: list[dict]) -> str:
+    """The ``obs gateways`` view: one row per gateway (owner-map digest,
+    generation, tracked chains, peer-agreement verdict), then the
+    admission plane's per-tenant quota/WFQ table from the first gateway
+    that serves one.  ``snaps`` rows are ``{"name", "ownermap", per
+    gateway /admin/ownermap body or None, "admission": /admin/admission
+    body or None}``."""
+    if not snaps:
+        return "no gateways to render"
+    digests = [
+        (s.get("ownermap") or {}).get("digest") or "" for s in snaps
+    ]
+    have = [d for d in digests if d]
+    converged = bool(have) and len(set(have)) == 1
+    lines = [
+        f"GATEWAYS  ({len(snaps)} gateways, "
+        + ("owner maps CONVERGED" if converged
+           else "owner maps DIVERGED" if have else "no owner maps yet")
+        + ")",
+        "",
+        f"  {'GATEWAY':<16} {'DIGEST':<18} {'SEQ':>5} {'CHAINS':>7} "
+        f"{'REPLICAS':>9} {'PEERS':>6} {'AGREE':>6}",
+    ]
+    for s, d in zip(snaps, digests):
+        om = s.get("ownermap")
+        if om is None:
+            lines.append(
+                f"  {s.get('name', '?'):<16} {'unreachable':<18}"
+            )
+            continue
+        agree = (
+            "-" if len(have) < 2
+            else "yes" if d and all(d == x for x in have)
+            else "NO"
+        )
+        lines.append(
+            f"  {s.get('name', '?'):<16} {d or '-':<18} "
+            f"{om.get('seq', 0):>5} {om.get('tracked', 0):>7} "
+            f"{len(om.get('replicas', [])):>9} "
+            f"{len(om.get('peers', [])):>6} {agree:>6}"
+        )
+    adm = next(
+        (
+            (s.get("name", "?"), s["admission"]) for s in snaps
+            if (s.get("admission") or {}).get("enabled")
+        ),
+        None,
+    )
+    if adm is not None:
+        name, a = adm
+        lines.append("")
+        lines.append(
+            f"  ADMISSION @ {name}  "
+            f"(slots {a.get('held', 0)}/{a.get('slots', 0)} held, "
+            f"quantum {a.get('quantum', 0):.0f} tokens)"
+        )
+        tenants = a.get("tenants", [])
+        if tenants:
+            lines.append(
+                f"  {'TENANT':<14} {'CLASS':<12} {'WEIGHT':>7} "
+                f"{'SHARE':>8} {'DEFICIT':>8} {'QUEUED':>7} "
+                f"{'QUOTA/S':>8} {'LEVEL':>8}"
+            )
+            for d in tenants:
+                q = d.get("quota_tokens_per_s")
+                lv = d.get("quota_level")
+                lines.append(
+                    f"  {d.get('tenant', '?'):<14} "
+                    f"{d.get('priority', '?'):<12} "
+                    f"{d.get('weight', 1.0):>7.1f} "
+                    f"{d.get('share', 0.0):>8.1%} "
+                    f"{d.get('deficit', 0.0):>8,.0f} "
+                    f"{d.get('queued', 0):>7} "
+                    f"{(f'{q:,.0f}' if q is not None else '-'):>8} "
+                    f"{(f'{lv:,.0f}' if lv is not None else '-'):>8}"
+                )
+    return "\n".join(lines)
+
+
 def render_requests(records: list[dict]) -> str:
     """The ``obs requests`` view of ``/debug/requests`` records —
     newest first, one line per retired request, trace id last so the
