@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo replay-demo
 
 # The default verify path (bare `make`): graftcheck invariants + the
 # attribution-plane smoke + the flash-v2 parity suite (ISSUE 12 — every
@@ -23,7 +23,7 @@ IMAGES = operator trainer devenv
 # train-step guard, all CPU-safe through the Pallas interpreter).  The
 # full suite stays `make test` (it takes minutes); image builds stay
 # `make docker-build`.
-verify: check profile-demo goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo flash-v2-parity
+verify: check profile-demo goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo replay-demo flash-v2-parity
 
 flash-v2-parity:
 	python -m pytest tests/test_flash_v2.py -q -p no:cacheprovider
@@ -177,6 +177,14 @@ migrate-demo:
 # 10:1 hot-tenant flood throttled at the weighted-fair admission door.
 gateway-demo:
 	python tools/gateway_demo.py
+
+# Workload flight-recorder drill (ISSUE 19): capture mixed paged+spec
+# multi-tenant traffic (two byte-identical captures), replay it
+# byte-exact on a fresh replica (mid-burst replica-kill capture
+# included), then catch a seeded prefix-cache-off regression with the
+# diff attributing the delta to prefill and ReplayRegression firing.
+replay-demo:
+	python tools/replay_demo.py
 
 # Fleet router smoke: 4 paged replicas behind the prefix-affinity
 # router serve skewed multi-tenant traffic (each tenant's shared prompt

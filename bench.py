@@ -1662,6 +1662,133 @@ def gateway_ha_probe(model, params) -> dict:
     return out
 
 
+def replay_fidelity_probe(model, params) -> dict:
+    """Workload flight recorder (ISSUE 19, serve/replay.py):
+
+    - cb_replay_exact_match_ratio: a burst recorded from a live paged
+      batcher, replayed greedy on a FRESH batcher — fraction of
+      verifiable requests whose replayed token stream hashes to the
+      recorded golden.  Must be 1.0: the capture is a complete
+      reproduction record, not a sample.
+    - cb_replay_overhead_x: wall time of the same burst with a live
+      WorkloadRecorder scraping the journal every 5 ms vs recorder
+      off (min of 2 reps each).  Budget < 1.03x — capture rides the
+      journal ring the batcher already writes; scraping must never
+      tax the serving path.
+    - cb_replay_ttft_fidelity: replayed mean TTFT over recorded mean
+      TTFT at recorded arrivals on identical hardware — how honestly
+      a replay reproduces the latency shape, not just the bytes."""
+    import threading
+
+    import numpy as np
+
+    from k8s_gpu_tpu.serve import (
+        ContinuousBatcher,
+        RequestJournal,
+        WorkloadRecorder,
+        WorkloadReplayer,
+    )
+    from k8s_gpu_tpu.utils.metrics import MetricsRegistry
+
+    cfg = model.cfg
+    page = min(16, max(4, cfg.max_seq // 8))
+    prefix_len = 2 * page
+    tail = max(2, page // 2)
+    n_new = min(8, cfg.max_seq - prefix_len - tail - 1)
+    if n_new < 2:
+        return {
+            "replay_fidelity_probe_skipped":
+                f"max_seq {cfg.max_seq} too small",
+        }
+    rng = np.random.default_rng(11)
+    shared = rng.integers(2, cfg.vocab_size - 2, size=prefix_len)
+    prompts = [
+        np.concatenate([
+            shared, rng.integers(2, cfg.vocab_size - 2, size=tail),
+        ]).astype(np.int32)
+        for _ in range(6)
+    ]
+    warm_prompt = rng.integers(
+        2, cfg.vocab_size - 2, size=prefix_len + tail,
+    ).astype(np.int32)
+
+    def burst(journal, recorder):
+        b = ContinuousBatcher(
+            model, params, slots=4, paged_blocks=64, page_size=page,
+            metrics=MetricsRegistry(), journal=journal,
+        ).start()
+        try:
+            # Warm the SAME shapes the burst uses, so recorded
+            # timings measure compute, not XLA compiles.
+            b.submit(warm_prompt, max_new_tokens=n_new).result()
+            stop = threading.Event()
+
+            def scrape_loop():
+                while not stop.is_set():
+                    recorder.scrape_once()
+                    stop.wait(0.005)
+
+            th = None
+            if recorder is not None:
+                th = threading.Thread(target=scrape_loop, daemon=True)
+                th.start()
+            t0 = time.perf_counter()
+            hs = [
+                b.submit(p, max_new_tokens=n_new, seed=i)
+                for i, p in enumerate(prompts)
+            ]
+            for h in hs:
+                h.result()
+            wall = time.perf_counter() - t0
+            if th is not None:
+                stop.set()
+                th.join(timeout=2)
+                recorder.scrape_once()  # final delta: no request missed
+            return wall
+        finally:
+            b.stop()
+
+    # Overhead: min-of-2 with recorder live vs off, identical traffic.
+    rec = None
+    t_on, t_off = [], []
+    for _ in range(2):
+        j = RequestJournal()
+        rec = WorkloadRecorder({"bench": j})
+        t_on.append(burst(j, rec))
+        t_off.append(burst(RequestJournal(), None))
+    out = {"cb_replay_overhead_x": min(t_on) / max(min(t_off), 1e-9)}
+
+    # Fidelity: replay the live-scraped capture on a fresh batcher.
+    workload = rec.workload()
+    reqs = [r for r in workload["requests"] if r["verify"]]
+    fresh = ContinuousBatcher(
+        model, params, slots=4, paged_blocks=64, page_size=page,
+        metrics=MetricsRegistry(), journal=RequestJournal(),
+    ).start()
+    try:
+        fresh.submit(warm_prompt, max_new_tokens=n_new).result()  # warm
+        report = WorkloadReplayer(registry=MetricsRegistry()).run(
+            workload, batcher=fresh,
+        )
+    finally:
+        fresh.stop()
+    t = report["totals"]
+    out["cb_replay_exact_match_ratio"] = (
+        t["matched"] / t["verified"] if t["verified"] else 0.0
+    )
+    rec_ttft = [r["ttft_s"] for r in reqs if r["ttft_s"] > 0]
+    rep_ttft = [
+        e["ttft_s"] for e in report["requests"]
+        if e["verify"] and e["ttft_s"] > 0
+    ]
+    if rec_ttft and rep_ttft:
+        out["cb_replay_ttft_fidelity"] = (
+            (sum(rep_ttft) / len(rep_ttft))
+            / (sum(rec_ttft) / len(rec_ttft))
+        )
+    return out
+
+
 def quant_decode_probe(model, params) -> dict:
     """Int8 weight-only decode throughput (serve/quant.py): same decode
     loop as decode_probe but streaming 1-byte weights from HBM."""
@@ -1946,7 +2073,7 @@ def main() -> None:
     for probe in (quant_decode_probe, spec_batcher_probe,
                   kv_quant_probe, paged_kv_probe, router_fleet_probe,
                   frontend_gateway_probe, migration_probe,
-                  gateway_ha_probe):
+                  gateway_ha_probe, replay_fidelity_probe):
         try:
             decode.update(probe(tb["model"], tb["trainer"].params))
         except Exception as e:

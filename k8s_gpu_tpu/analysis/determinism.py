@@ -58,6 +58,11 @@ DETERMINISTIC_PLANES = (
     # functions of (offer sequence, injected Clock) — the two-run
     # byte-identical WFQ schedule test pins it.
     "k8s_gpu_tpu/serve/admission.py",
+    # The replay plane (ISSUE 19): captures are byte-identical and
+    # replays pace on the injected Clock — any ambient time or
+    # randomness here would break the whole record/re-execute/diff
+    # contract at its root.
+    "k8s_gpu_tpu/serve/replay.py",
     "k8s_gpu_tpu/utils/alerts.py",
     "k8s_gpu_tpu/utils/federation.py",
     "k8s_gpu_tpu/utils/metrics.py",

@@ -993,6 +993,7 @@ def cmd_obs(args) -> int:
             k: v for k, v in (
                 ("tenant", args.tenant), ("reason", args.reason),
                 ("trace_id", args.trace), ("limit", args.limit),
+                ("since", args.since or ""),
                 # probes=0 drops canary records (synthetic traffic).
                 ("probes", "0" if args.no_probes else ""),
             ) if v
@@ -1001,7 +1002,12 @@ def cmd_obs(args) -> int:
         if body is None:
             return 1
         try:
-            recs = json.loads(body)["requests"]
+            parsed = json.loads(body)
+            # Cursor BEFORE records (the /debug/traces discipline): a
+            # scraper that resumes from this cursor double-ships the
+            # overlap instead of gapping it.
+            cursor = int(parsed.get("cursor", 0))
+            recs = parsed["requests"]
             if not isinstance(recs, list):
                 raise ValueError("'requests' is not a list")
         except (ValueError, KeyError, TypeError) as e:
@@ -1011,7 +1017,101 @@ def cmd_obs(args) -> int:
         if any(r.get("trace_id") for r in recs):
             print("\n(follow a request: obs traces --url "
                   f"{args.url} --trace <TRACE>)")
+        print(f"\n(resume from here: obs requests --url {args.url} "
+              f"--since {cursor})")
         return 0
+    if args.obs_cmd == "replay":
+        # Workload flight recorder: capture journals to a .workload
+        # file, re-inject it against a live fleet, diff two runs with
+        # segment attribution (serve/replay.py).
+        from pathlib import Path
+
+        from ..serve import replay as rp
+        from ..utils.clock import RealClock
+        from ..utils.obs import render_replay
+
+        def _load_report(path: str):
+            """A run report, or a .workload viewed as the recorded
+            baseline — so `obs replay diff` compares capture-vs-run
+            or run-vs-run with one flag shape."""
+            data = Path(path).read_bytes()
+            obj = json.loads(data.decode())
+            if isinstance(obj, dict) and "source" in obj:
+                return obj
+            return rp.workload_report(rp.load_workload(data))
+
+        if args.replay_cmd == "record":
+            targets = _parse_scrape_targets(args.url)
+            if not targets:
+                print("obs replay record needs --url NAME=URL of "
+                      "metrics servers with journals attached",
+                      file=sys.stderr)
+                return 2
+            rec = rp.WorkloadRecorder(targets, probes=args.probes)
+            clock = RealClock()
+            t_end = clock.now() + max(0.0, args.duration)
+            n = rec.scrape_once()
+            while clock.now() < t_end:
+                clock.sleep(max(0.1, args.poll))
+                n += rec.scrape_once()
+            w = rec.workload()
+            Path(args.out).write_bytes(rp.workload_bytes(w))
+            print(f"captured {len(w['requests'])} requests "
+                  f"({n} journal records) from {len(targets)} "
+                  f"targets -> {args.out}")
+            if rec.scrape_errors:
+                print(f"warning: {rec.scrape_errors} scrape errors "
+                      "(dead targets are skipped; their requests "
+                      "survive in resuming replicas' journals)",
+                      file=sys.stderr)
+            return 0 if w["requests"] else 1
+        if args.replay_cmd == "run":
+            try:
+                w = rp.load_workload(Path(args.workload).read_bytes())
+            except (OSError, ValueError) as e:
+                print(f"bad workload: {e}", file=sys.stderr)
+                return 2
+            if not args.url:
+                print("obs replay run needs --url of a replica or "
+                      "gateway /generate endpoint", file=sys.stderr)
+                return 2
+            rep = rp.WorkloadReplayer(
+                time_scale=args.time_scale,
+                arm_deadlines=args.arm_deadlines,
+            ).run(w, url=args.url, journal_url=args.journal_url or "")
+            if args.out:
+                Path(args.out).write_bytes(rp.report_bytes(rep))
+            t = rep["totals"]
+            print(f"replayed {t['requests']} requests against "
+                  f"{args.url}: {t['matched']}/{t['verified']} golden "
+                  f"matches, {t['mismatches']} mismatches, "
+                  f"{t['errors']} errors"
+                  + (f" -> {args.out}" if args.out else ""))
+            # Wrong bytes (or failed sends) gate: non-zero exit is the
+            # CI contract.
+            return 1 if t["mismatches"] or t["errors"] else 0
+        if args.replay_cmd == "diff":
+            try:
+                baseline = _load_report(args.baseline)
+                candidate = _load_report(args.candidate)
+            except (OSError, ValueError) as e:
+                print(f"bad report: {e}", file=sys.stderr)
+                return 2
+            d = rp.diff_reports(
+                baseline, candidate,
+                rel_threshold=args.threshold,
+                abs_floor_s=args.floor_ms / 1000.0,
+            )
+            if args.out:
+                Path(args.out).write_bytes(rp.diff_bytes(d))
+            if args.json:
+                print(json.dumps(d, sort_keys=True, indent=2))
+            else:
+                print(render_replay(d))
+            # The threshold gate: regression (or mismatch) exits 1.
+            return 1 if d["regression"] else 0
+        print("obs replay: record|run|diff required", file=sys.stderr)
+        return 2
     if args.obs_cmd == "profile":
         # Continuous performance attribution: the /debug/profile view
         # (per-phase p50/p95/share, compile telemetry, per-axis
@@ -1782,9 +1882,81 @@ def build_parser() -> argparse.ArgumentParser:
     p_oreq.add_argument("--trace", default="",
                         help="exact trace id filter")
     p_oreq.add_argument("--limit", type=int, default=30)
+    p_oreq.add_argument("--since", type=int, default=0,
+                        help="completion-index cursor from a previous "
+                             "call: only records appended after it")
     p_oreq.add_argument("--no-probes", action="store_true",
                         help="drop synthetic canary-probe records "
                              "(tenant _canary)")
+    p_orp = obs_sub.add_parser(
+        "replay",
+        help="workload flight recorder: capture journals to a "
+             ".workload file, re-inject it byte-exact against a live "
+             "fleet, diff two runs with segment attribution",
+    )
+    orp_sub = p_orp.add_subparsers(dest="replay_cmd", required=True)
+    p_orpr = orp_sub.add_parser(
+        "record",
+        help="scrape /debug/requests journals (cursor-delta) into a "
+             "deterministic .workload file",
+    )
+    p_orpr.add_argument("--url", action="append", default=None,
+                        help="NAME=URL (or bare URL) of a metrics "
+                             "server with a journal attached; "
+                             "repeatable")
+    p_orpr.add_argument("--out", default="capture.workload",
+                        help="output .workload path")
+    p_orpr.add_argument("--duration", type=float, default=0.0,
+                        help="keep scraping this many seconds "
+                             "(default: one pass)")
+    p_orpr.add_argument("--poll", type=float, default=1.0,
+                        help="scrape interval during --duration")
+    p_orpr.add_argument("--probes", action="store_true",
+                        help="include synthetic canary-probe records")
+    p_orpu = orp_sub.add_parser(
+        "run",
+        help="re-inject a .workload at recorded (or time-scaled) "
+             "arrivals against a /generate endpoint; verifies greedy "
+             "golden hashes, exits non-zero on mismatch",
+    )
+    p_orpu.add_argument("--workload", required=True,
+                        help=".workload file from `obs replay record`")
+    p_orpu.add_argument("--url", default="",
+                        help="replica or gateway base URL (/generate)")
+    p_orpu.add_argument("--journal-url", default="",
+                        help="metrics server of the target's journal "
+                             "(/debug/requests) — enables segment "
+                             "attribution in the report")
+    p_orpu.add_argument("--time-scale", type=float, default=1.0,
+                        help="stretch (>1) / compress (<1) arrival "
+                             "gaps; 0 = fire immediately")
+    p_orpu.add_argument("--arm-deadlines", action="store_true",
+                        help="re-arm recorded latency budgets (off by "
+                             "default: byte-exactness first)")
+    p_orpu.add_argument("--out", default="",
+                        help="write the run report JSON here")
+    p_orpd = orp_sub.add_parser(
+        "diff",
+        help="baseline-vs-candidate diff with waterfall-segment "
+             "attribution; regressed segments starred; exits non-zero "
+             "on regression or mismatch",
+    )
+    p_orpd.add_argument("--baseline", required=True,
+                        help="run report JSON, or a .workload (the "
+                             "recorded timings become the baseline)")
+    p_orpd.add_argument("--candidate", required=True,
+                        help="run report JSON to compare")
+    p_orpd.add_argument("--threshold", type=float, default=0.10,
+                        help="relative regression threshold per "
+                             "segment (0.10 = +10%%)")
+    p_orpd.add_argument("--floor-ms", type=float, default=5.0,
+                        help="absolute per-segment delta floor (ms) "
+                             "below which jitter never regresses")
+    p_orpd.add_argument("--out", default="",
+                        help="write the diff report JSON here")
+    p_orpd.add_argument("--json", action="store_true",
+                        help="print the diff as JSON instead of the "
+                             "table")
     p_oprof = obs_sub.add_parser(
         "profile",
         help="continuous performance attribution: per-phase p50/p95/"

@@ -12,6 +12,14 @@ from .frontend import FleetFrontend, merge_owner_map, owner_map_digest
 from .journal import PROBE_TENANT, RequestJournal, RequestRecord
 from .jsonschema import SchemaError, schema_to_regex
 from .quant import quantize_params
+from .replay import (
+    ReplayState,
+    WorkloadRecorder,
+    WorkloadReplayer,
+    diff_reports,
+    load_workload,
+    workload_report,
+)
 from .router import (
     FleetAutoscaler,
     FleetRouter,
@@ -35,4 +43,6 @@ __all__ = [
     "DisaggregatedLm", "RegexConstraint", "compile_constraint",
     "distill_draft", "int8_draft", "rejection_sample",
     "schema_to_regex", "SchemaError",
+    "WorkloadRecorder", "WorkloadReplayer", "ReplayState",
+    "diff_reports", "load_workload", "workload_report",
 ]

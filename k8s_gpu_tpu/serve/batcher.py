@@ -59,7 +59,9 @@ from ..utils.tracing import global_tracer
 from .engine import (
     InferenceEngine, _empty_cache, _empty_cache_paged, nucleus_mask,
 )
-from .journal import PROBE_TENANT, RequestJournal, RequestRecord
+from .journal import (
+    PROBE_TENANT, RequestJournal, RequestRecord, golden_hash,
+)
 from .kv_blocks import BlockPool, chunk_hashes, shareable_depth
 from .speculative import reject_row
 
@@ -239,6 +241,11 @@ class _Request:
     # journaled, and counted by serve_resumed_requests_total.
     migrated: bool = False
     migrated_from: str = ""
+    # Every token id delivered to the caller, in emission order —
+    # accumulated by the _emit funnel so the journal can stamp a
+    # golden content-hash at retirement (serve/replay.py verifies
+    # replayed streams against it).
+    emitted_ids: list = field(default_factory=list)
 
 
 class RequestHandle:
@@ -343,6 +350,7 @@ class ContinuousBatcher:
         attn_impl: str | None = None,
         paged_blocks: int = 0,
         page_size: int = 64,
+        prefix_cache: bool = True,
         max_pending: int = 0,
         metrics: MetricsRegistry | None = None,
         journal: RequestJournal | None = None,
@@ -564,7 +572,7 @@ class ContinuousBatcher:
             # (MoE chunked prefill diverges from the one-shot oracle —
             # same refusal as the dense prefix cache; adapter requests
             # are excluded per-request, their K/V differ from base).
-            self._paged_share = not self.engine.cfg.moe
+            self._paged_share = prefix_cache and not self.engine.cfg.moe
 
         # Device-resident decode state: flows dispatch-to-dispatch without
         # touching the host (the latency-hiding invariant).
@@ -807,6 +815,11 @@ class ContinuousBatcher:
         # Prefix cache: prompt-prefix bytes → prefilled device cache row.
         # Entries are read-only after insert; LRU-bounded (each entry owns
         # a full [L,1,H,max_seq,Dh] K/V row — HBM, not host RAM).
+        # ``prefix_cache=False`` disables BOTH prefix planes (this dense
+        # entry cache and paged block sharing) — the replay A/B harness's
+        # candidate config (ISSUE 19's seeded-regression demo) and an
+        # escape hatch when cache reuse itself is the suspect.
+        self.prefix_cache = bool(prefix_cache)
         self._prefix: "collections.OrderedDict[bytes, dict]" = (
             collections.OrderedDict()
         )
@@ -1960,6 +1973,8 @@ class ContinuousBatcher:
 
     def _match_prefix(self, ids: np.ndarray):
         """Longest cached prefix of *ids* (LRU-touched), or None."""
+        if not self.prefix_cache:
+            return None
         best_key = None
         best = None
         with self._prefix_lock:
@@ -2094,6 +2109,11 @@ class ContinuousBatcher:
         """``entry``: the prefix-cache match for ``req.ids`` when the
         caller already looked it up (the _loop fused gate does); left
         unset, it is resolved here."""
+        # Queue wait ends the moment the scheduler commits this request
+        # to a slot: stamp BEFORE the admit dispatch, so prefill compute
+        # lands in the prefill segment (ttft - queue_wait) rather than
+        # inflating queue_wait.
+        req.t_admit = time.monotonic()
         ctab = self.cbank.banked if self.cbank else None
         if req.precomputed is not None:
             row, logits, pos, rope, start = req.precomputed
@@ -2224,6 +2244,7 @@ class ContinuousBatcher:
         (no spec), cold path (no precomputed row, no prefix hit), the
         batcher idle.  The stream equals the unfused path's bit-for-bit
         (same _admit_dev + _round_dev bodies, same PRNG consumption)."""
+        req.t_admit = time.monotonic()
         ctab = self.cbank.banked if self.cbank else None
         bucket = prompt_bucket(int(req.ids.size), self.engine.max_seq)
         pad = bucket - int(req.ids.size)
@@ -2265,7 +2286,8 @@ class ContinuousBatcher:
         req.slot = slot
         req.path = path
         self._active[slot] = req
-        req.t_admit = time.monotonic()
+        if req.t_admit <= 0.0:
+            req.t_admit = time.monotonic()
         self.metrics.observe(
             "serve_queue_wait_seconds", req.t_admit - req.t_submit
         )
@@ -2290,7 +2312,7 @@ class ContinuousBatcher:
         # and counting them as misses would deflate the observed hit
         # ratio an operator sizes the cache from.
         consulted = req.aidx == 0 and (
-            self._paged_share if self.paged else True
+            self._paged_share if self.paged else self.prefix_cache
         )
         if path in ("prefix_exact", "prefix_suffix", "paged_shared"):
             self.metrics.inc("serve_prefix_cache_hits_total")
@@ -2697,6 +2719,7 @@ class ContinuousBatcher:
         if req.emitted == 1:
             req.t_first = req.t_last
         self._interleave_log.append((round_id, req.slot))
+        req.emitted_ids.append(int(tok))
         # One queue item carries both — the handle collects logprobs on
         # ITS side of the thread boundary (no per-token list snapshots).
         req.out.put((int(tok), float(lp)))
@@ -2708,7 +2731,6 @@ class ContinuousBatcher:
     def _retire_inner(self, slot: int) -> None:
         req = self._active[slot]
         if req is not None:
-            req.out.put(None)  # completion sentinel
             # Self-pollution guard (serve/canary.py): canary probes ride
             # the reserved tenant and are excluded from every user-facing
             # SLO series — the latency histograms (their outside-in view
@@ -2765,6 +2787,13 @@ class ContinuousBatcher:
                     tenant=req.tenant,
                 )
             self._journal(req, self._finish_reason(req))
+            # Completion sentinel LAST — journal-before-close, like
+            # every shed/abort path: when a caller's stream ends, the
+            # journal record already exists, so a workload capture
+            # taken right after ``result()`` returns can never miss
+            # the request it just consumed (serve/replay.py's
+            # recorder depends on this happens-before).
+            req.out.put(None)
         if self.paged and req is not None and req.blocks:
             # Point the slot at the trash block and release the blocks'
             # references — a shared prefix block stays pinned while any
@@ -2811,6 +2840,20 @@ class ContinuousBatcher:
             ),
             reason=reason,
             path=req.path,
+            # Replay-completeness contract (serve/replay.py): every
+            # terminal record carries the full reproduction tuple.
+            # prompt_ids is [] only for precomputed-prefill rows — the
+            # prompt never existed at this layer.
+            prompt_ids=[int(t) for t in req.ids.tolist()],
+            max_new=req.max_new,
+            temperature=req.temperature,
+            top_p=req.top_p,
+            seed=req.seed,
+            deadline_s=(
+                req.deadline - req.t_submit
+                if req.deadline is not None else 0.0
+            ),
+            golden_hash=golden_hash(req.emitted_ids),
             replica=req.route_replica,
             route_reason=req.route_reason,
             slot=req.slot,

@@ -1616,15 +1616,28 @@ class FleetFrontend:
     def _journal(
         self, *, tenant, trace_ctx, reason, code, t0,
         replica="", route_reason="", prompt_tokens=0, tokens=0,
-        attempts=1, extra=None,
+        attempts=1, extra=None, req_ids=None, req_body=None,
     ) -> None:
         e = {"status": int(code), "attempts": int(attempts)}
         e.update(extra or {})
+        # Replay plane (serve/replay.py): when the dispatch path hands
+        # us the request itself, the gateway record becomes a complete
+        # reproduction record — a gateway journal alone is then a
+        # capturable workload (arrival offsets are stamped by
+        # journal.append from t_submit=t0).
+        body = req_body or {}
         self.journal.append(JournalRecord(
             tenant=tenant,
             trace_id=trace_ctx.trace_id if trace_ctx else "",
             reason=reason,
             path="gateway",
+            prompt_ids=(
+                [int(t) for t in req_ids] if req_ids is not None else []
+            ),
+            max_new=int(body.get("max_new_tokens", 0) or 0),
+            temperature=float(body.get("temperature", 0.0) or 0.0),
+            top_p=float(body.get("top_p", 0.0) or 0.0),
+            seed=int(body.get("seed", 0) or 0),
             replica=replica,
             route_reason=route_reason,
             prompt_tokens=int(prompt_tokens),
@@ -1743,7 +1756,7 @@ class FleetFrontend:
                     code=code, t0=t0, replica=replica,
                     route_reason=reason, prompt_tokens=len(ids),
                     tokens=int(payload.get("generated_tokens", 0) or 0),
-                    attempts=contacts,
+                    attempts=contacts, req_ids=ids, req_body=body,
                 )
                 return {
                     "kind": "json", "code": code, "payload": payload,
@@ -1775,6 +1788,7 @@ class FleetFrontend:
                         route_reason=_reason, prompt_tokens=_n,
                         tokens=tokens, attempts=_c,
                         extra={"stream": True},
+                        req_ids=ids, req_body=body,
                     )
 
                 return {
@@ -1802,6 +1816,7 @@ class FleetFrontend:
                     reason="rejected", code=code, t0=t0,
                     replica=replica, route_reason=reason,
                     prompt_tokens=len(ids), attempts=contacts,
+                    req_ids=ids, req_body=body,
                 )
                 return {
                     "kind": "json", "code": code, "payload": payload,
@@ -1817,6 +1832,7 @@ class FleetFrontend:
                     reason="deadline", code=504, t0=t0,
                     replica=replica, route_reason=reason,
                     prompt_tokens=len(ids), attempts=contacts,
+                    req_ids=ids, req_body=body,
                 )
                 return {
                     "kind": "json", "code": 504, "payload": payload,
@@ -1942,6 +1958,7 @@ class FleetFrontend:
                 code=code, t0=t0, replica=name, route_reason="pinned",
                 prompt_tokens=len(ids),
                 tokens=int(payload.get("generated_tokens", 0) or 0),
+                req_ids=ids, req_body=body,
             )
             return {
                 "kind": "json", "code": code, "payload": payload,
@@ -1968,6 +1985,7 @@ class FleetFrontend:
                     code=200, t0=t0, replica=name,
                     route_reason="pinned", prompt_tokens=n_prompt,
                     tokens=tokens, extra={"stream": True},
+                    req_ids=ids, req_body=body,
                 )
 
             return {
@@ -1981,6 +1999,7 @@ class FleetFrontend:
                 tenant=tenant, trace_ctx=trace_ctx,
                 reason="overloaded", code=429, t0=t0, replica=name,
                 route_reason="pinned", prompt_tokens=len(ids),
+                req_ids=ids, req_body=body,
             )
             return {
                 "kind": "json", "code": 429, "payload": payload,
@@ -1995,7 +2014,7 @@ class FleetFrontend:
             self._journal(
                 tenant=tenant, trace_ctx=trace_ctx, reason="rejected",
                 code=code, t0=t0, replica=name, route_reason="pinned",
-                prompt_tokens=len(ids),
+                prompt_tokens=len(ids), req_ids=ids, req_body=body,
             )
             return {
                 "kind": "json", "code": code, "payload": payload,
@@ -2006,7 +2025,7 @@ class FleetFrontend:
             self._journal(
                 tenant=tenant, trace_ctx=trace_ctx, reason="deadline",
                 code=504, t0=t0, replica=name, route_reason="pinned",
-                prompt_tokens=len(ids),
+                prompt_tokens=len(ids), req_ids=ids, req_body=body,
             )
             return {
                 "kind": "json", "code": 504, "payload": out[1],
@@ -2018,6 +2037,7 @@ class FleetFrontend:
             tenant=tenant, trace_ctx=trace_ctx, reason="error",
             code=502, t0=t0, replica=name, route_reason="pinned",
             prompt_tokens=len(ids), extra={"detail": out[1]},
+            req_ids=ids, req_body=body,
         )
         return {
             "kind": "json", "code": 502,

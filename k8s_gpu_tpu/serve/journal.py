@@ -18,9 +18,21 @@ histogram reservoirs and the trace ring); ``dropped`` counts evictions.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import deque
 from dataclasses import asdict, dataclass, field
+
+
+def golden_hash(token_ids) -> str:
+    """sha256[:16] over a delivered token-id stream — the replay
+    golden (the CanaryProber content-hash discipline, applied to every
+    journaled request).  Empty stream hashes to "" so "no tokens" and
+    "tokens" never compare equal."""
+    if not token_ids:
+        return ""
+    raw = ",".join(str(int(t)) for t in token_ids).encode()
+    return hashlib.sha256(raw).hexdigest()[:16]
 
 
 # The reserved synthetic tenant canary probes ride (serve/canary.py).
@@ -64,6 +76,29 @@ class RequestRecord:
     trace_id: str = ""
     reason: str = ""
     path: str = ""            # admission path ("" when shed pre-admission)
+    # Replay plane (serve/replay.py): the complete reproduction record.
+    # Every terminal path must fill these — a journal record that cannot
+    # be re-submitted is a gap in the flight recorder.  ``prompt_ids``
+    # is empty only when the prompt genuinely never existed at this
+    # layer (precomputed-prefill handoff rows).
+    prompt_ids: list = field(default_factory=list)
+    max_new: int = 0
+    temperature: float = 0.0
+    top_p: float = 0.0
+    seed: int = 0
+    # Arrival time relative to the journal's origin (first-appended
+    # record's t_submit) — may be negative for a request that arrived
+    # before the journal's first terminal event; the recorder re-bases.
+    arrival_offset_s: float = 0.0
+    # The request's RELATIVE latency budget at submit (seconds; 0.0 =
+    # none) — replay re-arms the same budget against its own clock.
+    deadline_s: float = 0.0
+    # sha256[:16] over the emitted token-id stream (canary discipline);
+    # "" when no token was delivered.
+    golden_hash: str = ""
+    # Journal-global completion index, stamped by append(): the
+    # ``/debug/requests?since=`` cursor's unit.
+    seq: int = 0
     # Fleet routing evidence (serve/router.py): which replica the
     # front-end chose and why ("" when the request reached the batcher
     # without going through a router) — `obs requests` explains
@@ -97,7 +132,7 @@ class RequestJournal:
     # Lock contract (graftcheck lockcheck + utils.faults
     # guard_declared): the scheduler thread appends while /debug/requests
     # handlers snapshot.
-    _GUARDED_BY = {"_lock": ("_ring", "dropped")}
+    _GUARDED_BY = {"_lock": ("_ring", "dropped", "_seq", "_origin")}
 
     def __init__(self, maxlen: int = 512):
         self._lock = threading.Lock()
@@ -105,12 +140,41 @@ class RequestJournal:
             maxlen=max(1, int(maxlen))
         )
         self.dropped = 0
+        # Monotonic completion index: +1 per appended record, never
+        # reset by ring eviction — the ``?since=`` cursor a periodic
+        # scraper (serve/replay.py's recorder) resumes from.
+        self._seq = 0
+        # Arrival origin: the first appended record's t_submit.  Every
+        # later record's arrival_offset_s is relative to it, so one
+        # journal's offsets share a zero without leaking absolute
+        # monotonic-clock values into the wire format.
+        self._origin: float | None = None
 
     def append(self, rec: RequestRecord) -> None:
         with self._lock:
+            if self._origin is None:
+                self._origin = rec.t_submit
+            rec.arrival_offset_s = rec.t_submit - self._origin
+            self._seq += 1
+            rec.seq = self._seq
             if len(self._ring) == self._ring.maxlen:
                 self.dropped += 1
             self._ring.append(rec)
+
+    @property
+    def cursor(self) -> int:
+        """The current completion index: pass it back as ``since=`` to
+        receive only records appended after this read."""
+        with self._lock:
+            return self._seq
+
+    @property
+    def origin(self) -> float | None:
+        """This journal's arrival-offset zero (first record's
+        t_submit, monotonic domain) — None before any append.  The
+        workload recorder aligns multi-journal captures on it."""
+        with self._lock:
+            return self._origin
 
     def __len__(self) -> int:
         with self._lock:
@@ -123,18 +187,24 @@ class RequestJournal:
         reason: str = "",
         trace_id: str = "",
         probes: bool = True,
+        since: int = 0,
     ) -> list[dict]:
         """Newest-first records as dicts, optionally filtered; the
         ``/debug/requests`` body.  ``limit <= 0`` returns none (the
         bare ``[-0:]`` hazard the alerts snapshot also guards).
         ``probes=False`` drops canary records (``extra.probe`` — the
-        ``obs requests --no-probes`` filter)."""
+        ``obs requests --no-probes`` filter).  ``since`` is a
+        completion-index cursor (``RequestJournal.cursor``): only
+        records appended AFTER that read are returned, so a periodic
+        scraper ships deltas instead of re-fetching the whole ring."""
         if limit <= 0:
             return []
         with self._lock:
             recs = list(self._ring)
         out = []
         for rec in reversed(recs):
+            if since and rec.seq <= since:
+                break  # the ring is seq-ordered; everything older matches
             if tenant and rec.tenant != tenant:
                 continue
             if reason and rec.reason != reason:

@@ -1,0 +1,804 @@
+"""Workload flight recorder & deterministic replay.
+
+The journal (serve/journal.py) answers "what did the fleet just do";
+this module answers the next operational question — *do it again*.
+Three pieces:
+
+- ``WorkloadRecorder`` scrapes request journals (in-process objects or
+  live ``/debug/requests`` URLs, cursor-delta like the waterfall's
+  trace scraper) and assembles a ``.workload`` file: the complete
+  reproduction record per request — prompt token ids, full sampling
+  params + seed, tenant, latency budget, arrival-time offset schedule,
+  and the golden content-hash of what was actually emitted.  The wire
+  format is deterministic sorted-JSON (the ``migrate.py`` discipline):
+  two captures of the same traffic are byte-identical.
+
+- ``WorkloadReplayer`` re-injects a workload at recorded (or
+  time-scaled) arrivals against an in-process ``ContinuousBatcher`` or
+  a live fleet URL, under the injected Clock, and verifies every
+  greedy completion against its recorded golden hash — the
+  CanaryProber correctness discipline applied to *every* recorded
+  request, not one synthetic probe.  Emits ``replay_requests_total`` /
+  ``replay_mismatch_total`` and a deterministic run report.
+
+- ``diff_reports`` compares two runs (or a run against the recorded
+  baseline via ``workload_report``) request-by-request: TTFT/TPOT/E2E
+  deltas decomposed into the waterfall segment taxonomy
+  (``queue_wait``/``prefill``/``decode``/``gateway_route``/...), with
+  a threshold gate (``regression`` + ``regressed_segments``) that
+  ``obs replay diff`` turns into a non-zero exit and
+  ``replay_rule_pack`` turns into a ``ReplayRegression`` page.
+
+Clock domains: journal offsets are per-journal (each ring's origin is
+its first record's ``t_submit``).  The recorder aligns multi-target
+captures on each journal's reported ``origin`` — exact when the
+targets share a monotonic clock (one host), best-effort across hosts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.request
+
+import numpy as np
+
+from ..utils.clock import Clock, RealClock
+from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.waterfall import SEGMENTS
+from .journal import golden_hash
+
+WORKLOAD_VERSION = 1
+REPORT_VERSION = 1
+
+# A request is verifiable when it is greedy (sampling would need the
+# exact RNG stream; greedy needs only the model) and actually finished
+# with content (eos/budget — a shed emitted nothing to verify).
+_VERIFIABLE_REASONS = ("eos", "budget")
+
+# Wire-format float precision: one grid for every duration/offset so
+# serialization never depends on float repr noise (the waterfall
+# snapshot uses the same round(x, 9)).
+def _r9(x: float) -> float:
+    return round(float(x), 9)
+
+
+def request_key(prompt_ids, max_new, temperature, top_p, seed,
+                tenant) -> str:
+    """Identity hash of the reproduction tuple — the cross-run join
+    key ``diff_reports`` matches requests by.  Two submissions of the
+    same prompt/params/tenant share a key and are told apart by their
+    occurrence index (arrival order)."""
+    raw = "|".join((
+        ",".join(str(int(t)) for t in prompt_ids),
+        str(int(max_new)),
+        repr(float(temperature)),
+        repr(float(top_p)),
+        str(int(seed)),
+        str(tenant),
+    )).encode()
+    return hashlib.sha256(raw).hexdigest()[:16]
+
+
+def record_segments(rec: dict) -> dict:
+    """Decompose one journal record's E2E into the waterfall segment
+    taxonomy.  Exhaustive partition: the returned values sum to the
+    record's E2E exactly (``unattributed`` is the residual), the same
+    contract utils/waterfall.py keeps for span timelines."""
+    e2e = max(0.0, float(rec.get("t_done", 0.0)) -
+              float(rec.get("t_submit", 0.0)))
+    qw = min(max(0.0, float(rec.get("queue_wait_s", 0.0))), e2e)
+    ttft = float(rec.get("ttft_s", 0.0))
+    if ttft > 0.0:
+        prefill = max(0.0, min(ttft, e2e) - qw)
+        decode = max(0.0, e2e - max(min(ttft, e2e), qw))
+    else:
+        prefill = 0.0
+        decode = 0.0
+    unattributed = max(0.0, e2e - qw - prefill - decode)
+    return {
+        "queue_wait": _r9(qw),
+        "prefill": _r9(prefill),
+        "decode": _r9(decode),
+        "unattributed": _r9(unattributed),
+    }
+
+
+def _entry_e2e(rec: dict) -> float:
+    return max(0.0, float(rec.get("t_done", 0.0)) -
+               float(rec.get("t_submit", 0.0)))
+
+
+# ---------------------------------------------------------------------------
+# capture
+
+
+class WorkloadRecorder:
+    """Cursor-delta journal scraper → deterministic ``.workload``.
+
+    ``targets`` maps a source name to either a ``RequestJournal``
+    object (in-process capture) or a base URL whose
+    ``/debug/requests?since=`` endpoint serves that journal (live
+    capture).  ``scrape_once`` ships deltas only — the ``since=``
+    cursor contract ``/debug/traces`` pioneered — and dedups on
+    ``(target, seq)`` so the cursor-before-records overlap never
+    double-counts.  A dead target (mid-burst replica kill) is counted
+    in ``scrape_errors`` and skipped; its requests survive in the
+    journals of the replicas that resumed them."""
+
+    # Lock contract (graftcheck lockcheck): callers may scrape from a
+    # background thread while another thread builds the workload.
+    _GUARDED_BY = {
+        "_lock": ("_records", "_cursors", "_origins", "scrape_errors"),
+    }
+
+    def __init__(self, targets: dict, *, clock: Clock | None = None,
+                 probes: bool = False, timeout_s: float = 5.0,
+                 cursors: dict | None = None):
+        self.targets = dict(targets)
+        self.clock = clock or RealClock()
+        self.probes = probes
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        # (target, seq) → record dict; insertion order is scrape order,
+        # but the workload build re-sorts deterministically.
+        self._records: dict = {}
+        # ``cursors`` seeds per-target start positions ("capture from
+        # here"): records at-or-before a seeded cursor are never
+        # scraped — how a capture window excludes warmup traffic.
+        self._cursors: dict = dict(cursors or {})
+        self._origins: dict = {}
+        self.scrape_errors = 0
+
+    # -- scraping ----------------------------------------------------------
+    def _scrape_journal(self, name: str, journal) -> list[dict]:
+        with self._lock:
+            since = self._cursors.get(name, 0)
+        # Cursor FIRST (the /debug/traces discipline): a record
+        # appended between the cursor read and the snapshot is shipped
+        # twice, and the (target, seq) dedup absorbs it; reading the
+        # cursor after would turn that race into a silent gap.
+        cur = journal.cursor
+        recs = journal.snapshot(
+            limit=1_000_000, since=since, probes=True,
+        )
+        origin = journal.origin
+        with self._lock:
+            self._cursors[name] = cur
+            if origin is not None:
+                self._origins[name] = origin
+        return recs
+
+    def _scrape_url(self, name: str, url: str) -> list[dict]:
+        with self._lock:
+            since = self._cursors.get(name, 0)
+        full = (
+            f"{url.rstrip('/')}/debug/requests"
+            f"?since={since}&limit=1000000"
+        )
+        with urllib.request.urlopen(full, timeout=self.timeout_s) as r:
+            body = json.loads(r.read().decode())
+        with self._lock:
+            self._cursors[name] = int(body.get("cursor", since))
+            if body.get("origin") is not None:
+                self._origins[name] = float(body["origin"])
+        return list(body.get("requests", ()))
+
+    def scrape_once(self) -> int:
+        """One pass over every target; returns records newly seen."""
+        new = 0
+        for name in sorted(self.targets):
+            target = self.targets[name]
+            try:
+                if isinstance(target, str):
+                    recs = self._scrape_url(name, target)
+                else:
+                    recs = self._scrape_journal(name, target)
+            except (OSError, ValueError):
+                with self._lock:
+                    self.scrape_errors += 1
+                continue
+            with self._lock:
+                for rec in recs:
+                    k = (name, int(rec.get("seq", 0)))
+                    if k not in self._records:
+                        self._records[k] = rec
+                        new += 1
+        return new
+
+    # -- assembly ----------------------------------------------------------
+    def workload(self) -> dict:
+        """Build the canonical workload from everything scraped so
+        far.  Deterministic: same records in, same object out — the
+        two-captures-byte-identical contract."""
+        with self._lock:
+            items = [
+                (name, seq, rec)
+                for (name, seq), rec in self._records.items()
+            ]
+            origins = dict(self._origins)
+        base_origin = min(origins.values()) if origins else 0.0
+        # Global arrival offset: per-journal offset re-based onto the
+        # earliest journal origin (exact when targets share a
+        # monotonic clock; per-target-consistent otherwise).
+        staged = []
+        for name, seq, rec in items:
+            if not self.probes and (rec.get("extra") or {}).get("probe"):
+                continue
+            ids = rec.get("prompt_ids") or []
+            if not ids:
+                continue  # not reproducible at this layer
+            shift = origins.get(name, base_origin) - base_origin
+            staged.append((
+                float(rec.get("arrival_offset_s", 0.0)) + shift,
+                name, seq, rec,
+            ))
+        # Dedup one logical request observed on several planes (a
+        # gateway "ok" mirror + the replica's own record share a trace
+        # id).  Untraced records never dedup — each is its own
+        # occurrence.
+        groups: dict = {}
+        for off, name, seq, rec in staged:
+            key = request_key(
+                rec["prompt_ids"], rec.get("max_new", 0),
+                rec.get("temperature", 0.0), rec.get("top_p", 0.0),
+                rec.get("seed", 0), rec.get("tenant", "default"),
+            )
+            tid = rec.get("trace_id", "")
+            gk = (key, tid) if tid else (key, f"@{name}/{seq}")
+            groups.setdefault(gk, []).append((off, name, seq, rec, key))
+        chosen = []
+        for gk in sorted(groups):
+            cands = groups[gk]
+            # Completed beats shed/abort (the resume path finished the
+            # request somewhere); a replica record beats its gateway
+            # mirror (it carries the golden hash and real segments);
+            # then earliest wins.
+            cands.sort(key=lambda c: (
+                0 if c[3].get("reason") in _VERIFIABLE_REASONS else 1,
+                1 if c[3].get("path") == "gateway" else 0,
+                c[0], c[1], c[2],
+            ))
+            chosen.append(cands[0])
+        chosen.sort(key=lambda c: (c[0], c[4], c[1], c[2]))
+        min_off = chosen[0][0] if chosen else 0.0
+        occurrence: dict = {}
+        out = []
+        for off, name, seq, rec, key in chosen:
+            occ = occurrence.get(key, 0)
+            occurrence[key] = occ + 1
+            out.append({
+                "key": key,
+                "occurrence": occ,
+                "arrival_offset_s": _r9(off - min_off),
+                "prompt_ids": [int(t) for t in rec["prompt_ids"]],
+                "max_new": int(rec.get("max_new", 0)),
+                "temperature": float(rec.get("temperature", 0.0)),
+                "top_p": float(rec.get("top_p", 0.0)),
+                "seed": int(rec.get("seed", 0)),
+                "tenant": str(rec.get("tenant", "default")),
+                "deadline_s": _r9(rec.get("deadline_s", 0.0)),
+                "reason": str(rec.get("reason", "")),
+                "tokens": int(rec.get("tokens", 0)),
+                "verify": bool(
+                    float(rec.get("temperature", 0.0)) == 0.0
+                    and rec.get("reason") in _VERIFIABLE_REASONS
+                    and rec.get("golden_hash")
+                ),
+                "golden_hash": str(rec.get("golden_hash", "")),
+                "trace_id": str(rec.get("trace_id", "")),
+                "source": name,
+                "ttft_s": _r9(rec.get("ttft_s", 0.0)),
+                "tpot_s": _r9(rec.get("tpot_s", 0.0)),
+                "e2e_s": _r9(_entry_e2e(rec)),
+                "segments": record_segments(rec),
+            })
+        return {"version": WORKLOAD_VERSION, "requests": out}
+
+    def workload_bytes(self) -> bytes:
+        return workload_bytes(self.workload())
+
+
+def workload_bytes(workload: dict) -> bytes:
+    """Canonical ``.workload`` encoding: sorted keys, no whitespace,
+    trailing newline — byte-identical for equal captures."""
+    return (
+        json.dumps(workload, sort_keys=True, separators=(",", ":"))
+        + "\n"
+    ).encode()
+
+
+def load_workload(data: bytes) -> dict:
+    """Parse + validate a ``.workload`` payload; raises ``ValueError``
+    on malformed input *before* anything is replayed."""
+    try:
+        obj = json.loads(data.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"not a workload file: {e}") from None
+    if not isinstance(obj, dict) or obj.get("version") != WORKLOAD_VERSION:
+        raise ValueError(
+            f"workload version {obj.get('version') if isinstance(obj, dict) else '?'!r} "
+            f"unsupported (want {WORKLOAD_VERSION})"
+        )
+    reqs = obj.get("requests")
+    if not isinstance(reqs, list):
+        raise ValueError("workload has no requests list")
+    for i, r in enumerate(reqs):
+        if not isinstance(r, dict):
+            raise ValueError(f"request {i} is not an object")
+        ids = r.get("prompt_ids")
+        if not isinstance(ids, list) or not ids or not all(
+            isinstance(t, int) and t >= 0 for t in ids
+        ):
+            raise ValueError(f"request {i}: bad prompt_ids")
+        if not isinstance(r.get("max_new"), int) or r["max_new"] < 0:
+            raise ValueError(f"request {i}: bad max_new")
+        for f in ("temperature", "top_p", "arrival_offset_s"):
+            if not isinstance(r.get(f, 0.0), (int, float)):
+                raise ValueError(f"request {i}: bad {f}")
+    return obj
+
+
+def workload_report(workload: dict) -> dict:
+    """View a capture as a run report — the *recorded* baseline
+    ``obs replay diff`` compares a replay against."""
+    entries = []
+    for r in workload.get("requests", ()):
+        entries.append({
+            "key": r["key"],
+            "occurrence": int(r.get("occurrence", 0)),
+            "tenant": r.get("tenant", "default"),
+            "reason": r.get("reason", ""),
+            "tokens": int(r.get("tokens", 0)),
+            "verify": bool(r.get("verify")),
+            "match": None,
+            "golden_hash": r.get("golden_hash", ""),
+            "replay_hash": "",
+            "error": "",
+            "ttft_s": _r9(r.get("ttft_s", 0.0)),
+            "tpot_s": _r9(r.get("tpot_s", 0.0)),
+            "e2e_s": _r9(r.get("e2e_s", 0.0)),
+            "segments": dict(r.get("segments") or {}),
+        })
+    return {
+        "version": REPORT_VERSION,
+        "source": "recorded",
+        "target": "capture",
+        "time_scale": 1.0,
+        "requests": entries,
+        "totals": _totals(entries),
+    }
+
+
+def _totals(entries: list[dict]) -> dict:
+    return {
+        "requests": len(entries),
+        "verified": sum(1 for e in entries if e["verify"]),
+        "matched": sum(1 for e in entries if e["match"] is True),
+        "mismatches": sum(1 for e in entries if e["match"] is False),
+        "errors": sum(1 for e in entries if e.get("error")),
+    }
+
+
+def report_bytes(report: dict) -> bytes:
+    return (
+        json.dumps(report, sort_keys=True, separators=(",", ":")) + "\n"
+    ).encode()
+
+
+# ---------------------------------------------------------------------------
+# replay
+
+
+class WorkloadReplayer:
+    """Re-inject a workload at its recorded arrival schedule.
+
+    ``time_scale`` stretches (>1) or compresses (<1) inter-arrival
+    gaps; 0 fires everything immediately (ordering still preserved —
+    submissions are issued sequentially in arrival order).  Deadlines
+    are NOT re-armed by default: a replay exists to compare compute,
+    and re-arming wall-clock budgets on different hardware would shed
+    different requests run-to-run (``arm_deadlines=True`` opts in).
+
+    Verification: every ``verify`` request's replayed token stream is
+    hashed (``golden_hash``) and compared to the recorded golden —
+    mismatches increment ``replay_mismatch_total``; every replayed
+    request increments ``replay_requests_total``."""
+
+    def __init__(self, *, clock: Clock | None = None,
+                 registry: MetricsRegistry | None = None,
+                 time_scale: float = 1.0, arm_deadlines: bool = False,
+                 state: "ReplayState | None" = None,
+                 timeout_s: float = 60.0):
+        self.clock = clock or RealClock()
+        self.registry = registry or global_metrics
+        self.time_scale = max(0.0, float(time_scale))
+        self.arm_deadlines = arm_deadlines
+        self.state = state
+        self.timeout_s = timeout_s
+
+    # -- pacing ------------------------------------------------------------
+    def _pace(self, t_start: float, offset_s: float) -> None:
+        due = offset_s * self.time_scale
+        delay = due - (self.clock.now() - t_start)
+        if delay > 0:
+            self.clock.sleep(delay)
+
+    # -- in-process --------------------------------------------------------
+    def run(self, workload: dict, *, batcher=None, journal=None,
+            url: str = "", journal_url: str = "") -> dict:
+        """Replay against an in-process batcher (``batcher=``) or a
+        live fleet URL (``url=``).  Returns the run report; publishes
+        it to ``state`` when attached."""
+        reqs = list(workload.get("requests", ()))
+        if batcher is not None:
+            report = self._run_batcher(reqs, batcher, journal)
+        elif url:
+            report = self._run_http(reqs, url, journal_url)
+        else:
+            raise ValueError("replay target required: batcher= or url=")
+        if self.state is not None:
+            self.state.publish_report(report)
+        return report
+
+    def _run_batcher(self, reqs, batcher, journal) -> dict:
+        journal = journal if journal is not None else batcher.journal
+        start_cursor = journal.cursor
+        t_start = self.clock.now()
+        handles: list = [None] * len(reqs)
+        errors: list[str] = [""] * len(reqs)
+        for i, r in enumerate(reqs):
+            self._pace(t_start, float(r.get("arrival_offset_s", 0.0)))
+            deadline = None
+            if self.arm_deadlines and float(r.get("deadline_s", 0.0)):
+                deadline = self.clock.now() + float(r["deadline_s"])
+            err = ""
+            for attempt in range(6):
+                try:
+                    handles[i] = batcher.submit(
+                        np.asarray(r["prompt_ids"], np.int32),
+                        max_new_tokens=max(1, int(r.get("max_new", 1))),
+                        temperature=float(r.get("temperature", 0.0)),
+                        top_p=float(r.get("top_p", 0.0)),
+                        seed=int(r.get("seed", 0)),
+                        deadline=deadline,
+                        tenant=r.get("tenant", "default"),
+                    )
+                    err = ""
+                    break
+                except Exception as e:  # Overloaded / scheduler dead
+                    err = f"{type(e).__name__}: {e}"
+                    # The recorded fleet admitted this request; a shed
+                    # here is replay-harness backpressure, not a
+                    # finding — brief clock backoff, bounded retries.
+                    self.clock.sleep(0.05)
+            errors[i] = err
+        streams: list[list[int]] = []
+        for h in handles:
+            streams.append([int(t) for t in h.result()] if h is not None
+                           else [])
+        return self._report_from_journal(
+            reqs, streams, errors, journal, start_cursor,
+            target="batcher", client_e2e=None,
+        )
+
+    # -- live fleet --------------------------------------------------------
+    def _run_http(self, reqs, url: str, journal_url: str) -> dict:
+        base = url.rstrip("/")
+        t_start = self.clock.now()
+        streams: list[list[int]] = [[] for _ in reqs]
+        errors: list[str] = [""] * len(reqs)
+        e2e: list[float] = [0.0] * len(reqs)
+        threads = []
+
+        def _one(i: int, r: dict) -> None:
+            body = {
+                "prompt": "",
+                "prompt_ids": [int(t) for t in r["prompt_ids"]],
+                "max_new_tokens": max(1, int(r.get("max_new", 1))),
+                "temperature": float(r.get("temperature", 0.0)),
+                "top_p": float(r.get("top_p", 0.0)),
+                "seed": int(r.get("seed", 0)),
+                "tenant": r.get("tenant", "default"),
+            }
+            headers = {"Content-Type": "application/json"}
+            if self.arm_deadlines and float(r.get("deadline_s", 0.0)):
+                headers["x-request-deadline-ms"] = str(
+                    float(r["deadline_s"]) * 1000.0
+                )
+            req = urllib.request.Request(
+                base + "/generate",
+                data=json.dumps(body).encode(),
+                headers=headers, method="POST",
+            )
+            t0 = self.clock.now()
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout_s
+                ) as resp:
+                    payload = json.loads(resp.read().decode())
+                streams[i] = [int(t) for t in payload.get("ids", ())]
+            except OSError as e:
+                errors[i] = f"OSError: {e}"
+            except ValueError as e:
+                errors[i] = f"ValueError: {e}"
+            e2e[i] = self.clock.now() - t0
+
+        for i, r in enumerate(reqs):
+            self._pace(t_start, float(r.get("arrival_offset_s", 0.0)))
+            th = threading.Thread(
+                target=_one, args=(i, r), daemon=True,
+                name=f"replay-{i}",
+            )
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(self.timeout_s)
+        journal = None
+        start_cursor = 0
+        recs = []
+        if journal_url:
+            try:
+                full = (
+                    f"{journal_url.rstrip('/')}/debug/requests"
+                    "?since=0&limit=1000000"
+                )
+                with urllib.request.urlopen(
+                    full, timeout=self.timeout_s
+                ) as r:
+                    recs = list(
+                        json.loads(r.read().decode()).get("requests", ())
+                    )
+            except (OSError, ValueError):
+                recs = []
+        return self._report_from_records(
+            reqs, streams, errors, recs, target=url, client_e2e=e2e,
+        )
+
+    # -- report assembly ---------------------------------------------------
+    def _report_from_journal(self, reqs, streams, errors, journal,
+                             start_cursor, *, target,
+                             client_e2e) -> dict:
+        recs = list(reversed(journal.snapshot(
+            limit=1_000_000, since=start_cursor, probes=True,
+        )))
+        return self._report_from_records(
+            reqs, streams, errors, recs, target=target,
+            client_e2e=client_e2e,
+        )
+
+    def _report_from_records(self, reqs, streams, errors, recs, *,
+                             target, client_e2e) -> dict:
+        # Oldest-first per-key FIFO: the i-th replayed occurrence of a
+        # key matches the i-th journal record with that key.
+        by_key: dict = {}
+        for rec in recs:
+            ids = rec.get("prompt_ids") or []
+            if not ids:
+                continue
+            k = request_key(
+                ids, rec.get("max_new", 0), rec.get("temperature", 0.0),
+                rec.get("top_p", 0.0), rec.get("seed", 0),
+                rec.get("tenant", "default"),
+            )
+            by_key.setdefault(k, []).append(rec)
+        entries = []
+        for i, r in enumerate(reqs):
+            rec = None
+            pool = by_key.get(r["key"])
+            if pool:
+                rec = pool.pop(0)
+            replay_hash = golden_hash(streams[i]) if streams[i] else (
+                (rec or {}).get("golden_hash", "") or ""
+            )
+            verify = bool(r.get("verify"))
+            match: bool | None = None
+            if verify:
+                match = bool(
+                    replay_hash and
+                    replay_hash == r.get("golden_hash", "")
+                )
+            self.registry.inc("replay_requests_total")
+            if match is False:
+                self.registry.inc("replay_mismatch_total")
+            segs = record_segments(rec) if rec is not None else {
+                "queue_wait": 0.0, "prefill": 0.0, "decode": 0.0,
+                "unattributed": 0.0,
+            }
+            e2e_s = _entry_e2e(rec) if rec is not None else 0.0
+            if client_e2e is not None:
+                # Client-observed E2E ⊇ replica E2E: the surplus is the
+                # fleet plane (routing + network), attributed to
+                # gateway_route so a gateway-layer regression shows up
+                # as its own segment, not inflated decode.
+                gw = max(0.0, client_e2e[i] - e2e_s)
+                segs = dict(segs)
+                segs["gateway_route"] = _r9(gw)
+                e2e_s = max(e2e_s, client_e2e[i])
+            entries.append({
+                "key": r["key"],
+                "occurrence": int(r.get("occurrence", 0)),
+                "tenant": r.get("tenant", "default"),
+                "reason": (rec or {}).get("reason", ""),
+                "tokens": int((rec or {}).get(
+                    "tokens", len(streams[i]))),
+                "verify": verify,
+                "match": match,
+                "golden_hash": r.get("golden_hash", ""),
+                "replay_hash": replay_hash,
+                "error": errors[i],
+                "ttft_s": _r9((rec or {}).get("ttft_s", 0.0)),
+                "tpot_s": _r9((rec or {}).get("tpot_s", 0.0)),
+                "e2e_s": _r9(e2e_s),
+                "segments": segs,
+            })
+        return {
+            "version": REPORT_VERSION,
+            "source": "replay",
+            "target": str(target),
+            "time_scale": self.time_scale,
+            "requests": entries,
+            "totals": _totals(entries),
+        }
+
+
+# ---------------------------------------------------------------------------
+# diff
+
+
+def _mean(xs: list[float]) -> float:
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+def _ratio(c: float, b: float) -> float:
+    if b > 0.0:
+        return round(c / b, 6)
+    return 1.0 if c <= 0.0 else 1e9
+
+
+def diff_reports(baseline: dict, candidate: dict, *,
+                 rel_threshold: float = 0.10,
+                 abs_floor_s: float = 0.005) -> dict:
+    """Per-request, per-segment comparison of two run reports.
+
+    A segment regresses when the candidate spends more than
+    ``abs_floor_s`` extra seconds in it across matched requests AND
+    exceeds the baseline by ``rel_threshold`` relative — the double
+    gate keeps microsecond jitter from starring a segment while still
+    catching a real phase shift.  ``regression`` is the overall gate
+    (any regressed segment, or any candidate mismatch — wrong bytes
+    always gate)."""
+    b_by = {
+        (e["key"], e["occurrence"]): e
+        for e in baseline.get("requests", ())
+    }
+    c_by = {
+        (e["key"], e["occurrence"]): e
+        for e in candidate.get("requests", ())
+    }
+    matched_keys = sorted(k for k in b_by if k in c_by)
+    rows = []
+    seg_names = sorted(set(SEGMENTS))
+    seg_b = {s: 0.0 for s in seg_names}
+    seg_c = {s: 0.0 for s in seg_names}
+    b_ttft, c_ttft, b_tpot, c_tpot, b_e2e, c_e2e = [], [], [], [], [], []
+    for k in matched_keys:
+        be, ce = b_by[k], c_by[k]
+        b_ttft.append(be["ttft_s"]); c_ttft.append(ce["ttft_s"])
+        b_tpot.append(be["tpot_s"]); c_tpot.append(ce["tpot_s"])
+        b_e2e.append(be["e2e_s"]); c_e2e.append(ce["e2e_s"])
+        deltas = {}
+        for s in seg_names:
+            bv = float((be.get("segments") or {}).get(s, 0.0))
+            cv = float((ce.get("segments") or {}).get(s, 0.0))
+            seg_b[s] += bv
+            seg_c[s] += cv
+            if bv or cv:
+                deltas[s] = _r9(cv - bv)
+        rows.append({
+            "key": k[0],
+            "occurrence": k[1],
+            "tenant": ce.get("tenant", "default"),
+            "d_ttft_s": _r9(ce["ttft_s"] - be["ttft_s"]),
+            "d_tpot_s": _r9(ce["tpot_s"] - be["tpot_s"]),
+            "d_e2e_s": _r9(ce["e2e_s"] - be["e2e_s"]),
+            "match": ce.get("match"),
+            "segments": deltas,
+        })
+    segments = {}
+    regressed = []
+    for s in seg_names:
+        bv, cv = seg_b[s], seg_c[s]
+        if bv == 0.0 and cv == 0.0:
+            continue
+        delta = cv - bv
+        reg = bool(
+            delta > abs_floor_s
+            and (bv <= 0.0 or cv > bv * (1.0 + rel_threshold))
+        )
+        segments[s] = {
+            "baseline_s": _r9(bv),
+            "candidate_s": _r9(cv),
+            "delta_s": _r9(delta),
+            "ratio": _ratio(cv, bv),
+            "regressed": reg,
+        }
+        if reg:
+            regressed.append(s)
+    mismatches = sum(
+        1 for e in candidate.get("requests", ())
+        if e.get("match") is False
+    )
+    return {
+        "version": REPORT_VERSION,
+        "matched": len(matched_keys),
+        "only_baseline": sum(1 for k in b_by if k not in c_by),
+        "only_candidate": sum(1 for k in c_by if k not in b_by),
+        "mismatches": mismatches,
+        "ttft": {
+            "baseline_s": _r9(_mean(b_ttft)),
+            "candidate_s": _r9(_mean(c_ttft)),
+            "ratio": _ratio(_mean(c_ttft), _mean(b_ttft)),
+        },
+        "tpot": {
+            "baseline_s": _r9(_mean(b_tpot)),
+            "candidate_s": _r9(_mean(c_tpot)),
+            "ratio": _ratio(_mean(c_tpot), _mean(b_tpot)),
+        },
+        "e2e": {
+            "baseline_s": _r9(_mean(b_e2e)),
+            "candidate_s": _r9(_mean(c_e2e)),
+            "ratio": _ratio(_mean(c_e2e), _mean(b_e2e)),
+        },
+        "segments": segments,
+        "regressed_segments": regressed,
+        "regression": bool(regressed) or mismatches > 0,
+        "requests": rows,
+    }
+
+
+def diff_bytes(diff: dict) -> bytes:
+    return (
+        json.dumps(diff, sort_keys=True, separators=(",", ":")) + "\n"
+    ).encode()
+
+
+def export_gauges(diff: dict,
+                  registry: MetricsRegistry | None = None) -> None:
+    """Publish a diff's headline numbers so the alert plane can gate
+    on them (``replay_rule_pack``'s ``ReplayRegression``)."""
+    reg = registry or global_metrics
+    reg.set_gauge("replay_ttft_regression_x",
+                  float(diff.get("ttft", {}).get("ratio", 1.0)))
+    reg.set_gauge("replay_regressed_segments",
+                  float(len(diff.get("regressed_segments", ()))))
+
+
+# ---------------------------------------------------------------------------
+# /debug/replay state
+
+
+class ReplayState:
+    """The ``/debug/replay`` backing store: last run report + last
+    diff, snapshotted as one sorted-JSON body (two reads of the same
+    state are byte-identical)."""
+
+    _GUARDED_BY = {"_lock": ("_report", "_diff")}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._report: dict | None = None
+        self._diff: dict | None = None
+
+    def publish_report(self, report: dict) -> None:
+        with self._lock:
+            self._report = report
+
+    def publish_diff(self, diff: dict) -> None:
+        with self._lock:
+            self._diff = diff
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"report": self._report, "diff": self._diff}

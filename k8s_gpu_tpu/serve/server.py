@@ -365,10 +365,25 @@ class LmServer:
                             tenant=tenant,
                         )
                         ctx = getattr(self, "trace_ctx", None)
+                        # Replay completeness: even a door shed must be
+                        # a reproducible record — tokenize here (the
+                        # normal path does it a few lines down anyway).
+                        shed_ids = (
+                            np.asarray(prompt_ids, np.int32)
+                            if prompt_ids is not None
+                            else outer.tokenizer.encode(prompt)
+                        )
                         outer.journal.append(JournalRecord(
                             tenant=tenant,
                             trace_id=ctx.trace_id if ctx else "",
                             reason="deadline",
+                            prompt_ids=[int(t) for t in shed_ids],
+                            max_new=max(1, min(want, outer.cap)),
+                            temperature=temperature,
+                            top_p=top_p,
+                            seed=seed,
+                            deadline_s=budget_ms / 1000.0,
+                            prompt_tokens=int(len(shed_ids)),
                             replica=route[0] if route else "",
                             route_reason=route[1] if route else "",
                             deadline_expired=True,

@@ -13,6 +13,7 @@ from .obs import (
     MetricsServer,
     render_fleet,
     render_profile,
+    render_replay,
     render_requests,
     render_route,
     render_top,
@@ -42,6 +43,7 @@ __all__ = [
     "bucket_quantile",
     "parse_exposition",
     "render_fleet",
+    "render_replay",
     "render_requests",
     "render_route",
     "render_top",

@@ -627,6 +627,53 @@ def admission_rule_pack(
     ]
 
 
+def replay_rule_pack(
+    *,
+    regression_x: float = 1.2,
+    regression_for_s: float = 0.0,
+    mismatch_window: float = 300.0,
+) -> list:
+    """Replay-harness rules (ISSUE 19): the A/B gate as alerts, for
+    fleets that run a periodic replay canary instead of a one-shot
+    ``obs replay diff``.
+
+    - ``ReplayRegression`` — the last published diff's mean-TTFT
+      ratio (``replay_ttft_regression_x``, written by
+      ``serve.replay.export_gauges``) exceeds ``regression_x``: the
+      candidate config is slower on the *same bytes* the baseline
+      served, with ``/debug/replay`` holding the per-segment
+      attribution.
+    - ``ReplayMismatch`` — any ``replay_mismatch_total`` movement: a
+      greedy replay produced different tokens than the recording.
+      Pages, because wrong bytes are a correctness incident, not a
+      latency one.
+
+    Absent-safe like every pack: missing families read as empty
+    series / 0 rates."""
+    return [
+        AlertingRule(
+            "ReplayRegression",
+            lambda ctx: ctx.series("replay_ttft_regression_x"),
+            above=regression_x, for_s=regression_for_s,
+            annotation=(
+                "replayed workload TTFT at {value:.2f}x baseline — "
+                "obs replay diff / /debug/replay attribute the "
+                "regressed segments"
+            ),
+        ),
+        AlertingRule(
+            "ReplayMismatch",
+            lambda ctx: ctx.rate("replay_mismatch_total", mismatch_window),
+            above=0.0, severity="page",
+            annotation=(
+                "greedy replay produced tokens that differ from the "
+                "recorded golden hashes — determinism or correctness "
+                "broke; /debug/replay lists the mismatched requests"
+            ),
+        ),
+    ]
+
+
 def default_rule_pack(
     *,
     slo: float = 0.99,

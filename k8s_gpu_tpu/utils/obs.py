@@ -79,6 +79,7 @@ class MetricsServer:
         goodput=None,
         probes=None,
         waterfall=None,
+        replay=None,
     ):
         self.registry = registry or global_metrics
         self.tracer = tracer or global_tracer
@@ -89,6 +90,7 @@ class MetricsServer:
         self.goodput = goodput
         self.probes = probes
         self.waterfall = waterfall
+        self.replay = replay
         self.started_at = time.time()
         self._ready_check = ready_check
         outer = self
@@ -97,7 +99,8 @@ class MetricsServer:
             metrics_server_label = "obs"
             known_routes = (
                 "/debug/goodput", "/debug/probes", "/debug/profile",
-                "/debug/requests", "/debug/traces", "/debug/waterfall",
+                "/debug/replay", "/debug/requests", "/debug/traces",
+                "/debug/waterfall",
                 "/metrics", "/alerts", "/fleet", "/healthz", "/readyz",
             )
 
@@ -120,6 +123,8 @@ class MetricsServer:
                     self._probes()
                 elif path == "/debug/waterfall":
                     self._waterfall()
+                elif path == "/debug/replay":
+                    self._replay()
                 elif path == "/fleet":
                     self._fleet()
                 elif path == "/healthz":
@@ -288,6 +293,21 @@ class MetricsServer:
                 body = json.dumps(snap, sort_keys=True).encode()
                 self._send(200, body, "application/json")
 
+            def _replay(self):
+                if outer.replay is None:
+                    return self._send(
+                        404,
+                        json.dumps(
+                            {"error": "no replay state attached"}
+                        ).encode(),
+                        "application/json",
+                    )
+                # sort_keys: the two-run byte-identical contract.
+                body = json.dumps(
+                    outer.replay.snapshot(), sort_keys=True
+                ).encode()
+                self._send(200, body, "application/json")
+
             def _requests(self):
                 if outer.journal is None:
                     return self._send(
@@ -300,22 +320,36 @@ class MetricsServer:
                 one = self._query()
                 try:
                     limit = int(one("limit", "100"))
+                    since = int(one("since", "0"))
                 except ValueError:
                     return self._send(
                         400,
-                        json.dumps({"error": "limit must be an int"}).encode(),
+                        json.dumps(
+                            {"error": "limit/since must be ints"}
+                        ).encode(),
                         "application/json",
                     )
+                # cursor first (the /debug/traces discipline): a record
+                # appended between snapshot() and the cursor read would
+                # otherwise be skipped by the NEXT since= pass;
+                # double-shipping dedups, gaps don't.
+                cursor = outer.journal.cursor
+                origin = outer.journal.origin
                 recs = outer.journal.snapshot(
                     limit=limit,
                     tenant=one("tenant"),
                     reason=one("reason"),
                     trace_id=one("trace_id"),
                     probes=one("probes", "1") != "0",
+                    since=since,
                 )
                 self._send(
                     200,
-                    json.dumps({"requests": recs}).encode(),
+                    json.dumps({
+                        "requests": recs,
+                        "cursor": cursor,
+                        "origin": origin,
+                    }).encode(),
                     "application/json",
                 )
 
@@ -772,6 +806,58 @@ def render_requests(records: list[dict]) -> str:
             f"{r.get('trace_id') or '-'}"
         )
         lines.append(line)
+    return "\n".join(lines)
+
+
+def render_replay(diff: dict) -> str:
+    """The ``obs replay diff`` view of one replay diff report: the
+    per-segment baseline/candidate attribution with regressed segments
+    starred, then the headline ratios and the gate verdict."""
+    lines = [
+        f"REPLAY DIFF  (matched {diff.get('matched', 0)}, "
+        f"baseline-only {diff.get('only_baseline', 0)}, "
+        f"candidate-only {diff.get('only_candidate', 0)}, "
+        f"mismatches {diff.get('mismatches', 0)})",
+        "",
+        f"  {'SEGMENT':<14} {'BASE(MS)':>10} {'CAND(MS)':>10} "
+        f"{'DELTA(MS)':>10} {'RATIO':>7}",
+    ]
+    segs = diff.get("segments", {})
+    if not segs:
+        lines.append("  (no matched requests to attribute)")
+    for name in sorted(segs):
+        s = segs[name]
+        star = " *" if s.get("regressed") else ""
+        lines.append(
+            f"  {name:<14} {s['baseline_s'] * 1000:>10.2f} "
+            f"{s['candidate_s'] * 1000:>10.2f} "
+            f"{s['delta_s'] * 1000:>10.2f} "
+            f"{s['ratio']:>7.2f}{star}"
+        )
+    lines.append("")
+    for metric in ("ttft", "tpot", "e2e"):
+        m = diff.get(metric, {})
+        if m:
+            lines.append(
+                f"  {metric.upper():<6} "
+                f"{m.get('baseline_s', 0) * 1000:.2f}ms -> "
+                f"{m.get('candidate_s', 0) * 1000:.2f}ms "
+                f"({m.get('ratio', 1.0):.2f}x)"
+            )
+    regressed = diff.get("regressed_segments", [])
+    lines.append("")
+    if diff.get("mismatches"):
+        lines.append(
+            f"  VERDICT: FAIL — {diff['mismatches']} golden mismatches "
+            "(wrong bytes always gate)"
+        )
+    elif regressed:
+        lines.append(
+            "  VERDICT: REGRESSION in " + ", ".join(regressed)
+            + "  (* = regressed segment)"
+        )
+    else:
+        lines.append("  VERDICT: OK — no segment regressed")
     return "\n".join(lines)
 
 

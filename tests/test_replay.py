@@ -1,0 +1,610 @@
+"""Workload flight recorder & deterministic replay (ISSUE 19).
+
+Synthetic-journal tests pin the wire format (two captures
+byte-identical) and the diff gate under FakeClock; the integration
+tests drive real tiny batchers — every terminal path must emit a
+replayable journal record, and a greedy capture must replay byte-exact
+through a fresh batcher and over live HTTP.  Named test_replay so it
+lands inside the tier-1 window alongside the other serve-plane suites.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from k8s_gpu_tpu.serve.journal import RequestJournal, RequestRecord, golden_hash
+from k8s_gpu_tpu.serve.replay import (
+    ReplayState,
+    WorkloadRecorder,
+    WorkloadReplayer,
+    diff_bytes,
+    diff_reports,
+    export_gauges,
+    load_workload,
+    request_key,
+    workload_bytes,
+    workload_report,
+)
+from k8s_gpu_tpu.utils.alerts import RuleEvaluator, replay_rule_pack
+from k8s_gpu_tpu.utils.clock import FakeClock
+from k8s_gpu_tpu.utils.metrics import MetricsRegistry
+
+TINY_KW = dict(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8,
+    d_ff=64, max_seq=48, use_flash=False,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(dtype=jnp.float32, **TINY_KW)
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+# -- synthetic journal helpers -------------------------------------------------
+
+
+def _rec(prompt, t_submit, t_done, *, toks=(5, 6, 7), reason="budget",
+         tenant="default", seed=0, max_new=4, extra=None):
+    """A fully-populated terminal record at a FIXED monotonic instant —
+    the journal stamps seq/arrival_offset_s on append."""
+    return RequestRecord(
+        tenant=tenant, reason=reason, path="direct",
+        prompt_ids=[int(t) for t in prompt], max_new=max_new,
+        temperature=0.0, top_p=0.0, seed=seed, deadline_s=0.0,
+        golden_hash=golden_hash(list(toks)), prompt_tokens=len(prompt),
+        tokens=len(toks), queue_wait_s=0.002, ttft_s=0.01, tpot_s=0.001,
+        t_submit=t_submit, t_done=t_done, extra=dict(extra or {}),
+    )
+
+
+def test_capture_two_runs_byte_identical():
+    """Same journal contents -> byte-identical .workload, regardless of
+    how many scrape passes assembled it; probe traffic is excluded and
+    a second journal's offsets re-base onto the earliest origin."""
+    j1, j2 = RequestJournal(), RequestJournal()
+    j1.append(_rec([1, 2, 3], 100.0, 100.1))
+    j1.append(_rec([1, 2, 3], 100.2, 100.3))          # occurrence 1
+    j1.append(_rec([4, 5], 100.4, 100.5, tenant="chat", seed=7))
+    j1.append(_rec([9], 100.6, 100.7, tenant="_canary",
+                   extra={"probe": True}))            # dropped by default
+    j2.append(_rec([8, 8], 100.5, 100.9, tenant="batch"))
+
+    targets = {"a": j1, "b": j2}
+    r1 = WorkloadRecorder(targets)
+    r1.scrape_once()
+    r2 = WorkloadRecorder(targets)
+    r2.scrape_once()
+    r2.scrape_once()  # overlap pass: (target, seq) dedup absorbs it
+
+    b1, b2 = r1.workload_bytes(), r2.workload_bytes()
+    assert b1 == b2
+    w = load_workload(b1)
+    reqs = w["requests"]
+    assert len(reqs) == 4  # probe record excluded
+    offs = [r["arrival_offset_s"] for r in reqs]
+    assert offs == sorted(offs) and offs[0] == 0.0
+    # j2's origin is 0.5s after j1's: its record keeps fleet-relative time.
+    (b_entry,) = [r for r in reqs if r["tenant"] == "batch"]
+    assert b_entry["arrival_offset_s"] == pytest.approx(0.5)
+    # Two submissions of one reproduction tuple are occurrences 0 and 1.
+    occ = sorted(r["occurrence"] for r in reqs if r["prompt_ids"] == [1, 2, 3])
+    assert occ == [0, 1]
+    # Everything here is greedy + completed + hashed -> verifiable.
+    assert all(r["verify"] for r in reqs)
+    # Round-trip: re-encoding the parsed object reproduces the bytes.
+    assert workload_bytes(w) == b1
+
+
+def test_recorder_seeded_cursor_excludes_warmup():
+    """cursors= seeds the capture window: records at-or-before the
+    seeded cursor never enter the workload."""
+    j = RequestJournal()
+    j.append(_rec([1], 10.0, 10.1))
+    j.append(_rec([2], 10.2, 10.3))
+    window = {"j": j.cursor}
+    j.append(_rec([3], 10.4, 10.5))
+    j.append(_rec([4], 10.6, 10.7))
+    rec = WorkloadRecorder({"j": j}, cursors=window)
+    rec.scrape_once()
+    rec.scrape_once()
+    got = sorted(r["prompt_ids"][0] for r in rec.workload()["requests"])
+    assert got == [3, 4]
+
+
+def test_load_workload_rejects_malformed():
+    with pytest.raises(ValueError):
+        load_workload(b"not json")
+    with pytest.raises(ValueError):
+        load_workload(b'{"version": 99, "requests": []}\n')
+    bad = {"version": 1, "requests": [{"prompt_ids": [], "max_new": 1}]}
+    with pytest.raises(ValueError):
+        load_workload(json.dumps(bad).encode())
+
+
+# -- /debug/requests?since= (the cursor contract) ------------------------------
+
+
+def test_debug_requests_since_cursor_http():
+    """Cursor rides in the body BEFORE-read semantics: resuming from
+    the returned cursor yields exactly the later appends — no gaps, no
+    leftovers — and equal state reads are byte-identical."""
+    from k8s_gpu_tpu.utils.obs import MetricsServer
+
+    j = RequestJournal()
+    j.append(_rec([1], 1.0, 1.1))
+    j.append(_rec([2], 1.2, 1.3))
+    srv = MetricsServer(registry=MetricsRegistry(), journal=j)
+    srv.start()
+    try:
+        def get(q=""):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/requests{q}"
+            ) as r:
+                return r.read()
+
+        a, b = get(), get()
+        assert a == b  # same journal state -> same bytes
+        body = json.loads(a)
+        assert body["cursor"] == j.cursor == 2
+        assert len(body["requests"]) == 2
+        cur = body["cursor"]
+        # Nothing new yet: the delta from the cursor is empty.
+        empty = json.loads(get(f"?since={cur}"))
+        assert empty["requests"] == [] and empty["cursor"] == cur
+        j.append(_rec([3], 1.4, 1.5))
+        delta = json.loads(get(f"?since={cur}"))
+        assert [r["prompt_ids"] for r in delta["requests"]] == [[3]]
+        assert delta["cursor"] == cur + 1
+        # Resuming from the NEW cursor again yields nothing — no dups.
+        assert json.loads(get(f"?since={delta['cursor']}"))["requests"] == []
+    finally:
+        srv.stop()
+
+
+# -- every terminal path is replayable (the flight-recorder guarantee) ---------
+
+_REPLAY_FIELDS = (
+    "prompt_ids", "max_new", "temperature", "top_p", "seed",
+    "arrival_offset_s", "deadline_s",
+)
+
+
+def test_every_terminal_path_emits_replayable_record(tiny_lm):
+    """budget / deadline / queue_full / aborted / eos: each terminal
+    reason lands a journal record carrying the full reproduction tuple,
+    and the recorder classifies verifiability correctly."""
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+    from k8s_gpu_tpu.serve.batcher import Overloaded
+
+    model, params = tiny_lm
+    ja = RequestJournal()
+    # Paged + single-step rounds: admission is unfused and stop() is
+    # checked after every emitted token, so the abort below provably
+    # cuts mid-stream — an idle DENSE batcher fuses admission with a
+    # multi-token round whose fetch can deliver the whole budget in one
+    # burst, racing both the Overloaded window and the abort on a
+    # loaded 1-core box.
+    a = ContinuousBatcher(
+        model, params, slots=1, max_pending=1, paged_blocks=64,
+        page_size=8, steps_per_round=1, metrics=MetricsRegistry(),
+        journal=ja,
+    ).start()
+    p_long, p2 = [1, 2, 3, 4], [7, 8, 9]
+    try:
+        # budget (and the greedy stream the eos batcher below replays)
+        h_long = a.submit(p_long, max_new_tokens=12)
+        next(iter(h_long))  # seated: slot 0 is provably occupied
+        h_pend = a.submit(p2, max_new_tokens=6)        # pending (slots=1)
+        with pytest.raises(Overloaded):
+            a.submit([5, 5], max_new_tokens=2)         # queue_full shed
+        long_toks = [int(t) for t in h_long.result()]
+        eos_toks = [int(t) for t in h_pend.result()]
+        assert len(long_toks) == 12 and len(eos_toks) == 6
+        # deadline: an already-expired absolute budget sheds at admission
+        h_dead = a.submit(p_long, max_new_tokens=4, deadline=1e-9)
+        assert h_dead.result() == [] and h_dead.deadline_expired
+        # aborted: stop() cuts a live stream mid-decode
+        h_ab = a.submit(p_long, max_new_tokens=40)
+        next(iter(h_ab))
+        a.stop()
+        assert h_ab.aborted and 0 < len(h_ab.result()) < 40
+    finally:
+        a.stop()
+
+    # eos: pick the first token of p2's greedy stream that hasn't
+    # appeared before it — a batcher with that eos_id retires the same
+    # prompt early with reason "eos" and a non-empty delivered prefix.
+    cut = next(
+        (i for i in range(1, len(eos_toks)) if eos_toks[i] not in eos_toks[:i]),
+        None,
+    )
+    assert cut is not None, f"degenerate greedy stream {eos_toks}"
+    jb = RequestJournal()
+    b = ContinuousBatcher(
+        model, params, slots=1, eos_id=eos_toks[cut],
+        metrics=MetricsRegistry(), journal=jb,
+    ).start()
+    try:
+        h_eos = b.submit(p2, max_new_tokens=6)
+        assert [int(t) for t in h_eos.result()] == eos_toks[:cut]
+    finally:
+        b.stop()
+
+    recs = ja.snapshot(limit=100) + jb.snapshot(limit=100)
+    reasons = sorted(r["reason"] for r in recs)
+    assert reasons == sorted(
+        ["budget", "budget", "queue_full", "deadline", "aborted", "eos"]
+    )
+    for r in recs:
+        for f in _REPLAY_FIELDS:
+            assert f in r, f"reason={r['reason']} missing {f}"
+        assert r["prompt_ids"] and r["max_new"] > 0
+        assert isinstance(r["seed"], int)
+    # The capture classifies them: completed greedy streams verify,
+    # sheds/aborts ride along as load but are never hash-checked.
+    w = WorkloadRecorder({"a": ja, "b": jb})
+    w.scrape_once()
+    by_reason = {r["reason"]: r for r in w.workload()["requests"]}
+    assert by_reason["budget"]["verify"] and by_reason["eos"]["verify"]
+    assert by_reason["eos"]["golden_hash"] == golden_hash(eos_toks[:cut])
+    for shed in ("deadline", "queue_full", "aborted"):
+        assert not by_reason[shed]["verify"]
+
+
+# -- byte-exact replay through a real batcher ----------------------------------
+
+
+def test_greedy_replay_byte_exact_and_mismatch_detection(tiny_lm):
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+
+    model, params = tiny_lm
+    jc = RequestJournal()
+    c = ContinuousBatcher(
+        model, params, slots=2, metrics=MetricsRegistry(), journal=jc,
+    ).start()
+    try:
+        handles = [
+            c.submit([1, 2, 3], max_new_tokens=5, tenant="search"),
+            c.submit([1, 2, 3], max_new_tokens=5, tenant="search"),
+            c.submit([4, 5], max_new_tokens=5, tenant="chat"),
+            c.submit([6, 7, 8, 9], max_new_tokens=5, tenant="chat"),
+        ]
+        for h in handles:
+            assert len(h.result()) == 5
+    finally:
+        c.stop()
+    rec = WorkloadRecorder({"c": jc})
+    rec.scrape_once()
+    workload = rec.workload()
+    assert len(workload["requests"]) == 4
+    assert all(r["verify"] for r in workload["requests"])
+
+    jd = RequestJournal()
+    d = ContinuousBatcher(
+        model, params, slots=2, metrics=MetricsRegistry(), journal=jd,
+    ).start()
+    reg = MetricsRegistry()
+    try:
+        rep = WorkloadReplayer(registry=reg, time_scale=0.0).run(
+            workload, batcher=d,
+        )
+        t = rep["totals"]
+        assert (t["requests"], t["verified"], t["matched"]) == (4, 4, 4)
+        assert t["mismatches"] == 0 and t["errors"] == 0
+        assert reg.counter("replay_requests_total") == 4.0
+        assert reg.counter("replay_mismatch_total") == 0.0
+        # Segment attribution came from the replay journal, not zeros.
+        assert any(e["segments"]["prefill"] > 0 for e in rep["requests"])
+
+        # Corrupt one golden: the replay must notice — wrong bytes gate.
+        bad = json.loads(workload_bytes(workload).decode())
+        bad["requests"][0]["golden_hash"] = "0" * 16
+        rep2 = WorkloadReplayer(registry=reg, time_scale=0.0).run(
+            bad, batcher=d,
+        )
+        assert rep2["totals"]["mismatches"] == 1
+        assert reg.counter("replay_mismatch_total") == 1.0
+        flagged = [e for e in rep2["requests"] if e["match"] is False]
+        assert len(flagged) == 1 and flagged[0]["replay_hash"] != "0" * 16
+        diff = diff_reports(workload_report(bad), rep2,
+                            rel_threshold=10.0, abs_floor_s=10.0)
+        assert diff["regression"] and diff["mismatches"] == 1
+    finally:
+        d.stop()
+
+
+# -- arrival pacing ------------------------------------------------------------
+
+
+class _AutoClock(FakeClock):
+    """FakeClock whose sleep() advances itself — single-threaded
+    deterministic pacing (nobody else drives the clock)."""
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(max(0.0, seconds))
+
+
+class _FakeHandle:
+    def __init__(self, toks):
+        self._toks = list(toks)
+
+    def result(self):
+        return list(self._toks)
+
+
+class _FakeBatcher:
+    """submit-shaped recorder: logs (fake-clock instant, prompt)."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.journal = RequestJournal()
+        self.submits = []
+
+    def submit(self, ids, **kw):
+        self.submits.append((self.clock.now(), tuple(int(t) for t in ids)))
+        return _FakeHandle([1, 2])
+
+
+def test_time_scaled_arrivals_preserve_ordering():
+    clock = _AutoClock()
+    fb = _FakeBatcher(clock)
+    prompts = [[1], [2], [3]]
+    offsets = [0.0, 0.1, 0.3]
+    workload = {"version": 1, "requests": [
+        {
+            "key": request_key(p, 4, 0.0, 0.0, 0, "default"),
+            "occurrence": 0, "arrival_offset_s": off, "prompt_ids": p,
+            "max_new": 4, "temperature": 0.0, "top_p": 0.0, "seed": 0,
+            "tenant": "default", "deadline_s": 0.0, "verify": False,
+            "golden_hash": "", "ttft_s": 0.0, "tpot_s": 0.0, "e2e_s": 0.0,
+        }
+        for p, off in zip(prompts, offsets)
+    ]}
+    rep = WorkloadReplayer(
+        clock=clock, registry=MetricsRegistry(), time_scale=2.0,
+    ).run(workload, batcher=fb)
+    assert rep["totals"]["requests"] == 3
+    assert [p for _, p in fb.submits] == [(1,), (2,), (3,)]
+    # Inter-arrival gaps stretched exactly 2x on the injected clock.
+    times = [t for t, _ in fb.submits]
+    assert times == pytest.approx([0.0, 0.2, 0.6])
+
+
+# -- live-fleet HTTP replay + the obs replay CLI -------------------------------
+
+
+def test_http_replay_and_cli_roundtrip(tiny_lm, tmp_path):
+    """record (scrape over HTTP) -> run (re-inject over /generate) ->
+    diff: the full CLI loop, exit codes as the CI contract — then a
+    corrupted golden flips `run` non-zero."""
+    from k8s_gpu_tpu.cli.main import main
+    from k8s_gpu_tpu.data import BpeTokenizer
+    from k8s_gpu_tpu.serve import LmServer
+    from k8s_gpu_tpu.utils.obs import MetricsServer
+
+    model, params = tiny_lm
+    tok = BpeTokenizer.train("aa bb cc dd " * 30, vocab_size=80)
+    srv_rec = LmServer(model, params, tok, metrics=MetricsRegistry())
+    srv_rec._thread.start()
+    srv_rec.batcher.start()
+    obs_rec = MetricsServer(
+        registry=MetricsRegistry(), journal=srv_rec.journal,
+    ).start()
+    srv_play = LmServer(model, params, tok, metrics=MetricsRegistry())
+    srv_play._thread.start()
+    srv_play.batcher.start()
+    obs_play = MetricsServer(
+        registry=MetricsRegistry(), journal=srv_play.journal,
+    ).start()
+
+    def post(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    wl = tmp_path / "capture.workload"
+    run = tmp_path / "run.json"
+    try:
+        for ids in ([1, 2, 3], [4, 5], [1, 2, 3]):
+            out = post(srv_rec.port, {
+                "prompt_ids": ids, "max_new_tokens": 4, "temperature": 0.0,
+            })
+            assert len(out["ids"]) == 4
+        # Journal-before-close: the records exist once /generate answered.
+        rc = main([
+            "obs", "replay", "record",
+            "--url", f"rec=http://127.0.0.1:{obs_rec.port}",
+            "--out", str(wl),
+        ])
+        assert rc == 0
+        w = load_workload(wl.read_bytes())
+        assert len(w["requests"]) == 3
+        assert all(r["verify"] for r in w["requests"])
+
+        rc = main([
+            "obs", "replay", "run", "--workload", str(wl),
+            "--url", f"http://127.0.0.1:{srv_play.port}",
+            "--journal-url", f"http://127.0.0.1:{obs_play.port}",
+            "--time-scale", "0", "--out", str(run),
+        ])
+        assert rc == 0  # every golden matched over live HTTP
+        rep = json.loads(run.read_bytes())
+        t = rep["totals"]
+        assert (t["verified"], t["matched"], t["mismatches"]) == (3, 3, 0)
+        # Client-observed surplus is attributed to the fleet plane.
+        assert all("gateway_route" in e["segments"] for e in rep["requests"])
+
+        # diff capture-vs-run: thresholds wide open -> no regression.
+        rc = main([
+            "obs", "replay", "diff", "--baseline", str(wl),
+            "--candidate", str(run),
+            "--threshold", "1000", "--floor-ms", "100000",
+        ])
+        assert rc == 0
+
+        # Corrupt a golden in the capture: the run gate flips non-zero.
+        w["requests"][0]["golden_hash"] = "0" * 16
+        wl.write_bytes(workload_bytes(w))
+        rc = main([
+            "obs", "replay", "run", "--workload", str(wl),
+            "--url", f"http://127.0.0.1:{srv_play.port}",
+            "--time-scale", "0",
+        ])
+        assert rc == 1
+
+        # obs requests --since: the cursor-delta view renders cleanly.
+        cur = srv_rec.journal.cursor
+        assert main([
+            "obs", "requests",
+            "--url", f"http://127.0.0.1:{obs_rec.port}",
+            "--since", str(max(0, cur - 1)),
+        ]) == 0
+    finally:
+        obs_rec.stop()
+        obs_play.stop()
+        srv_rec.stop()
+        srv_play.stop()
+
+
+# -- diff gate -----------------------------------------------------------------
+
+
+def _entry(key, occ, *, ttft, e2e, segs, match=None):
+    return {
+        "key": key, "occurrence": occ, "tenant": "default",
+        "reason": "budget", "tokens": 4, "verify": match is not None,
+        "match": match, "golden_hash": "", "replay_hash": "", "error": "",
+        "ttft_s": ttft, "tpot_s": 0.001, "e2e_s": e2e, "segments": segs,
+    }
+
+
+def _report(entries):
+    return {
+        "version": 1, "source": "replay", "target": "batcher",
+        "time_scale": 1.0, "requests": entries, "totals": {},
+    }
+
+
+def test_diff_double_gate_and_byte_identity():
+    """A segment stars only past BOTH gates (abs floor + relative
+    threshold); sub-floor jitter never regresses; equal inputs produce
+    byte-identical diff bytes."""
+    keys = [request_key([i], 4, 0.0, 0.0, 0, "default") for i in range(3)]
+    base = _report([
+        _entry(k, 0, ttft=0.010, e2e=0.05, segs={
+            "queue_wait": 0.002, "prefill": 0.008, "decode": 0.040,
+            "unattributed": 0.0,
+        })
+        for k in keys
+    ])
+    # prefill doubles (+8ms/request, past floor+threshold); decode
+    # wobbles +0.1ms/request (sub-floor jitter).
+    cand = _report([
+        _entry(k, 0, ttft=0.018, e2e=0.0581, segs={
+            "queue_wait": 0.002, "prefill": 0.016, "decode": 0.0401,
+            "unattributed": 0.0,
+        })
+        for k in keys
+    ])
+    d = diff_reports(base, cand, rel_threshold=0.10, abs_floor_s=0.005)
+    assert d["matched"] == 3 and d["regression"]
+    assert d["regressed_segments"] == ["prefill"]
+    assert d["segments"]["prefill"]["ratio"] == pytest.approx(2.0)
+    assert not d["segments"]["decode"]["regressed"]
+    assert d["ttft"]["ratio"] == pytest.approx(1.8)
+    assert diff_bytes(d) == diff_bytes(
+        diff_reports(base, cand, rel_threshold=0.10, abs_floor_s=0.005)
+    )
+    # A mismatch gates even with zero latency movement.
+    cand_bad = _report([
+        _entry(keys[0], 0, ttft=0.010, e2e=0.05, segs={
+            "queue_wait": 0.002, "prefill": 0.008, "decode": 0.040,
+            "unattributed": 0.0,
+        }, match=False),
+    ])
+    d2 = diff_reports(base, cand_bad, rel_threshold=10.0, abs_floor_s=10.0)
+    assert d2["regression"] and d2["mismatches"] == 1
+    assert d2["regressed_segments"] == []
+
+
+# -- /debug/replay + the alert gate --------------------------------------------
+
+
+def test_replay_state_endpoint_byte_stable():
+    from k8s_gpu_tpu.utils.obs import MetricsServer
+
+    keys = [request_key([1], 4, 0.0, 0.0, 0, "default")]
+    base = _report([_entry(keys[0], 0, ttft=0.01, e2e=0.05, segs={
+        "queue_wait": 0.0, "prefill": 0.01, "decode": 0.04,
+        "unattributed": 0.0,
+    })])
+    state = ReplayState()
+    state.publish_report(base)
+    state.publish_diff(diff_reports(base, base))
+    srv = MetricsServer(registry=MetricsRegistry(), replay=state)
+    srv.start()
+    try:
+        def get():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/replay"
+            ) as r:
+                return r.read()
+
+        a, b = get(), get()
+        assert a == b
+        body = json.loads(a)
+        assert body["report"]["totals"] is not None
+        assert body["diff"]["regression"] is False
+    finally:
+        srv.stop()
+
+
+def test_replay_regression_rule_fires_and_resolves():
+    """export_gauges feeds the alert plane: a >1.2x TTFT diff fires
+    ReplayRegression; a healthy diff resolves it.  A mismatch-counter
+    bump fires ReplayMismatch (page)."""
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    ev = RuleEvaluator(
+        replay_rule_pack(regression_x=1.2), clock=clock, registry=reg,
+    )
+    keys = [request_key([1], 4, 0.0, 0.0, 0, "default")]
+    segs = {"queue_wait": 0.0, "prefill": 0.01, "decode": 0.04,
+            "unattributed": 0.0}
+    base = _report([_entry(keys[0], 0, ttft=0.010, e2e=0.05, segs=segs)])
+    slow = _report([_entry(keys[0], 0, ttft=0.050, e2e=0.09, segs=segs)])
+
+    export_gauges(diff_reports(base, slow), reg)
+    assert reg.gauge("replay_ttft_regression_x") == pytest.approx(5.0)
+    ev.evaluate_once()
+    clock.advance(30)
+    ev.evaluate_once()
+    names = {a["alertname"] for a in ev.active_alerts()}
+    assert "ReplayRegression" in names
+
+    export_gauges(diff_reports(base, base), reg)
+    clock.advance(30)
+    ev.evaluate_once()
+    names = {a["alertname"] for a in ev.active_alerts()}
+    assert "ReplayRegression" not in names
+
+    reg.inc("replay_mismatch_total")
+    clock.advance(30)
+    ev.evaluate_once()
+    assert any(
+        a["alertname"] == "ReplayMismatch" and a["severity"] == "page"
+        for a in ev.active_alerts()
+    )
