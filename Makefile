@@ -15,7 +15,7 @@ NAMESPACE ?= gohai-system
 
 IMAGES = operator trainer devenv
 
-.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo replay-demo
+.PHONY: verify docker-build docker-push deploy undeploy test check trace-demo chaos-demo alerts-demo prefix-demo fleet-demo router-demo analysis-demo profile-demo kernel-demo flash-v2-parity goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo replay-demo disagg-demo
 
 # The default verify path (bare `make`): graftcheck invariants + the
 # attribution-plane smoke + the flash-v2 parity suite (ISSUE 12 — every
@@ -23,7 +23,7 @@ IMAGES = operator trainer devenv
 # train-step guard, all CPU-safe through the Pallas interpreter).  The
 # full suite stays `make test` (it takes minutes); image builds stay
 # `make docker-build`.
-verify: check profile-demo goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo replay-demo flash-v2-parity
+verify: check profile-demo goodput-demo canary-demo frontend-demo waterfall-demo migrate-demo gateway-demo replay-demo disagg-demo flash-v2-parity
 
 flash-v2-parity:
 	python -m pytest tests/test_flash_v2.py -q -p no:cacheprovider
@@ -185,6 +185,15 @@ gateway-demo:
 # diff attributing the delta to prefill and ReplayRegression firing.
 replay-demo:
 	python tools/replay_demo.py
+
+# Disaggregated prefill/decode drill: long prompts prefill on a
+# dedicated worker and ship KV over the migration wire while 8 short
+# decode streams deliver in full (byte-identical to fused references),
+# seeded disagg.handover faults degrade to fused with zero lost, and a
+# traffic-mix flip makes the ratio controller reassign a live worker
+# (role flip observed on the worker AND the gateway).
+disagg-demo:
+	python tools/disagg_demo.py
 
 # Fleet router smoke: 4 paged replicas behind the prefix-affinity
 # router serve skewed multi-tenant traffic (each tenant's shared prompt

@@ -1413,6 +1413,177 @@ def migration_probe(model, params) -> dict:
     return out
 
 
+def disagg_probe(model, params) -> dict:
+    """Disaggregated prefill/decode (ISSUE 20, serve/frontend.py +
+    serve/ratio.py):
+
+    - cb_disagg_decode_stall_x: decode TPOT p95 across 8 concurrent
+      short decode streams while long prompts keep arriving — fused
+      (long prefills run in the SAME batcher, stalling decode rounds)
+      over disagg (long prompts prefill in a separate prefill-role
+      batcher, ship over the migration wire, and the decode batcher
+      only extends the warm chain's sub-page tail).  Bar >= 1.5x:
+      moving prefill off the decode pool must visibly protect decode
+      latency, or the extra worker is theater.
+    - cb_disagg_handover_s: mean prefill+export+wire+import wall time
+      per handed-over prompt.
+    - cb_disagg_lost: handed-over streams that differ from the fused
+      reference, plus decode-stream tokens not delivered.  Must be 0:
+      disaggregation is a placement change, never a content change."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from k8s_gpu_tpu.serve import ContinuousBatcher
+    from k8s_gpu_tpu.serve.kv_blocks import chunk_hashes, shareable_depth
+    from k8s_gpu_tpu.serve.migrate import pack, unpack
+    from k8s_gpu_tpu.utils.metrics import MetricsRegistry
+
+    cfg = model.cfg
+    page = min(16, max(8, cfg.max_seq // 8))
+    n_streams, n_long, n_tail = 8, 5, 4
+    # As long as max_seq allows: the fused-leg stall IS the inline
+    # prefill of this prompt, so the drill wants it as expensive as
+    # the model permits relative to one decode round.
+    long_len = ((cfg.max_seq - n_tail - 1) // page) * page + 1
+    if long_len <= 2 * page + 1:
+        return {"disagg_probe_skipped": f"max_seq {cfg.max_seq} too small"}
+    n_dec = min(48, max(16, cfg.max_seq // 4))
+    rng = np.random.default_rng(23)
+    shorts = [
+        [int(t) for t in rng.integers(2, cfg.vocab_size - 2, size=4)]
+        for _ in range(n_streams)
+    ]
+
+    def mk_long(tag):
+        r = np.random.default_rng(1000 + tag)
+        return [
+            int(t) for t in r.integers(2, cfg.vocab_size - 2, size=long_len)
+        ]
+
+    longs = [mk_long(i) for i in range(n_long)]
+    long_pages = -(-long_len // page)
+    nb = (
+        n_streams * -(-(4 + n_dec) // page)
+        + (n_long + 2) * (long_pages + 1) + 16
+    )
+
+    def run_leg(disagg):
+        dec_b = ContinuousBatcher(
+            model, params, slots=n_streams + 4, paged_blocks=nb,
+            page_size=page, metrics=MetricsRegistry(),
+        ).start()
+        pre_b = None
+        if disagg:
+            pre_b = ContinuousBatcher(
+                model, params, slots=4, paged_blocks=nb,
+                page_size=page, role="prefill",
+                metrics=MetricsRegistry(),
+            ).start()
+        gaps: list = []
+        long_streams: dict = {}
+        handovers: list = []
+        results = [None] * n_streams
+
+        def handover(lp):
+            h = pre_b.submit(lp, max_new_tokens=1)
+            h.result()
+            depth = shareable_depth(len(lp), page)
+            chain = chunk_hashes(np.asarray(lp, np.int32), page)[:depth]
+            snap = pre_b.run_quiesced(
+                lambda: pre_b.migrate_export(hashes=chain)
+            )
+            dec_b.run_quiesced(
+                lambda: dec_b.migrate_import(unpack(pack(snap)))
+            )
+
+        try:
+            # Compile warmup on BOTH paths this leg will take, so no
+            # timed gap pays a compile: one short decode stream, one
+            # full long-prompt cycle (cold admission fused; prefill +
+            # import + shared-chain admission disagg).
+            dec_b.submit(shorts[0][:3] + [3], max_new_tokens=4).result()
+            wl = mk_long(900)
+            if disagg:
+                handover(wl)
+            dec_b.submit(wl, max_new_tokens=n_tail).result()
+
+            def feeder():
+                for i, lp in enumerate(longs):
+                    t0 = _time.perf_counter()
+                    if disagg:
+                        handover(lp)
+                        handovers.append(_time.perf_counter() - t0)
+                    long_streams[i] = dec_b.submit(
+                        lp, max_new_tokens=n_tail
+                    ).result()
+
+            # Emission-side round timestamps: client-side arrival
+            # timing is useless on a starved host (the scheduler runs
+            # ahead, tokens buffer, and consumers see near-zero burst
+            # gaps), so hook the _emit funnel ON the scheduler thread
+            # and stamp the first emission of each distinct round for
+            # the measured streams.  Consecutive diffs are the round
+            # pacing the drill is about: an inline long prefill in
+            # the fused leg (head-of-line stall) vs a quiesced import
+            # in the disagg leg.
+            tracked: set = set()
+            state: dict = {"last": None}
+            times: list = []
+            orig_emit = dec_b._emit
+
+            def emit_hook(req, tok, round_id, lp):
+                if id(req) in tracked and round_id != state["last"]:
+                    state["last"] = round_id
+                    times.append(_time.perf_counter())
+                return orig_emit(req, tok, round_id, lp)
+
+            dec_b._emit = emit_hook
+            try:
+                handles = [
+                    dec_b.submit(shorts[k], max_new_tokens=n_dec)
+                    for k in range(n_streams)
+                ]
+                for h in handles:
+                    tracked.add(id(h._req))
+                ft = threading.Thread(target=feeder)
+                ft.start()
+                for k, h in enumerate(handles):
+                    results[k] = h.result()
+                ft.join()
+            finally:
+                dec_b._emit = orig_emit
+            gaps.extend(np.diff(times))
+        finally:
+            dec_b.stop()
+            if pre_b is not None:
+                pre_b.stop()
+        p95 = float(np.percentile(np.asarray(gaps), 95))
+        undelivered = sum(
+            n_dec - len(r) for r in results if r is not None
+        ) + sum(r is None for r in results) * n_dec
+        return p95, long_streams, handovers, undelivered
+
+    fused_p95, fused_longs, _, fused_missing = run_leg(False)
+    dis_p95, dis_longs, handovers, dis_missing = run_leg(True)
+    lost = float(fused_missing + dis_missing)
+    for i in range(n_long):
+        if fused_longs.get(i) != dis_longs.get(i):
+            lost += 1.0
+    return {
+        "cb_disagg_decode_tpot_p95_fused_s": fused_p95,
+        "cb_disagg_decode_tpot_p95_disagg_s": dis_p95,
+        "cb_disagg_decode_stall_x": (
+            fused_p95 / dis_p95 if dis_p95 > 0 else 0.0
+        ),
+        "cb_disagg_handover_s": (
+            float(np.mean(handovers)) if handovers else 0.0
+        ),
+        "cb_disagg_lost": lost,
+    }
+
+
 def gateway_ha_probe(model, params) -> dict:
     """Replicated gateway fleet (ISSUE 18, serve/frontend.py +
     serve/admission.py):
@@ -2073,7 +2244,8 @@ def main() -> None:
     for probe in (quant_decode_probe, spec_batcher_probe,
                   kv_quant_probe, paged_kv_probe, router_fleet_probe,
                   frontend_gateway_probe, migration_probe,
-                  gateway_ha_probe, replay_fidelity_probe):
+                  disagg_probe, gateway_ha_probe,
+                  replay_fidelity_probe):
         try:
             decode.update(probe(tb["model"], tb["trainer"].params))
         except Exception as e:

@@ -33,6 +33,10 @@ from . import Finding, ScopeVisitor, rel, tree_for
 # serve batcher is deliberately absent: it is the real-time plane (its
 # latency measurements ARE wall-clock); everything that must replay —
 # routing, journal identity, alert FSMs, federation, operators — is in.
+# The batcher's split-out halves (serve/scheduler.py, serve/allocator.py,
+# serve/executor.py — ISSUE 20) stay out for the same reason: they ARE
+# the batcher, relocated, and their queue waits and round timings are
+# wall-clock measurements by design.
 # ops/ (Pallas kernels, ISSUE 11) is likewise absent by design: kernel
 # code is the real-time plane's compute half — its determinism bar is
 # numeric parity vs an oracle (tests/test_paged_attention_kernel.py),
@@ -63,6 +67,11 @@ DETERMINISTIC_PLANES = (
     # randomness here would break the whole record/re-execute/diff
     # contract at its root.
     "k8s_gpu_tpu/serve/replay.py",
+    # The prefill:decode ratio controller (ISSUE 20): decisions are a
+    # pure function of (pool sizes, token rates, injected Clock) — the
+    # two-run byte-identical decision-sequence test pins it, exactly
+    # like the autoscaler it mirrors.
+    "k8s_gpu_tpu/serve/ratio.py",
     "k8s_gpu_tpu/utils/alerts.py",
     "k8s_gpu_tpu/utils/federation.py",
     "k8s_gpu_tpu/utils/metrics.py",

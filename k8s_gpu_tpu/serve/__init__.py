@@ -12,6 +12,7 @@ from .frontend import FleetFrontend, merge_owner_map, owner_map_digest
 from .journal import PROBE_TENANT, RequestJournal, RequestRecord
 from .jsonschema import SchemaError, schema_to_regex
 from .quant import quantize_params
+from .ratio import RatioController, RatioDecision
 from .replay import (
     ReplayState,
     WorkloadRecorder,
@@ -38,7 +39,7 @@ __all__ = [
     "merge_owner_map", "owner_map_digest",
     "AdmissionController", "TenantPolicy",
     "FleetRouter", "RouteDecision", "FleetAutoscaler", "ScaleDecision",
-    "router_rule_pack",
+    "router_rule_pack", "RatioController", "RatioDecision",
     "quantize_params", "export_servable", "load_servable",
     "DisaggregatedLm", "RegexConstraint", "compile_constraint",
     "distill_draft", "int8_draft", "rejection_sample",

@@ -1,0 +1,257 @@
+"""Paged-KV block allocation and page planning for the batcher.
+
+Split out of the original ``serve/batcher.py`` monolith (ISSUE 20):
+this module owns the *block plane* — every host-side interaction with
+``kv_blocks.BlockPool`` (page-table rows, chain acquire/register
+planning for admissions) plus the wire-level block export/import the
+migration plane (serve/migrate.py) and the disaggregated prefill
+handover ride on.  ``migrate_export(hashes=...)`` is the per-chain
+filter the prefill workers use to ship exactly one prompt's pages.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_blocks import chunk_hashes, shareable_depth
+from .scheduler import _Request, prompt_bucket
+
+log = logging.getLogger("k8s_gpu_tpu.serve")
+
+
+class AllocatorMixin:
+    """BlockPool-interaction half of ``ContinuousBatcher``: page
+    planning at admission, page-table maintenance, and quiesced
+    block export/import over the migration wire format."""
+
+    # -- paged-KV block allocator (host side) ------------------------------
+    def _blocks_needed(self, bucket: int, max_new: int) -> int:
+        return -(-(bucket + max_new) // self.page_size)
+
+    def _set_page_row(self, slot: int, blocks: list[int]):
+        """Install a slot's block list in the host page table (entries
+        past the allocation → trash block 0) and return the row as the
+        admit program's device operand."""
+        self._pages[slot, :] = 0
+        self._pages[slot, :len(blocks)] = blocks
+        return jnp.asarray(self._pages[slot])
+
+    @property
+    def _free_blocks(self) -> list[int]:
+        """Allocatable block ids (free + refcount-0 cached) — the leak
+        check surface tests pin after shutdown."""
+        return self._pool.allocatable_blocks()
+
+    def _paged_plan(self, req: _Request) -> bool:
+        """Block allocation (and prefix matching) for one paged
+        admission — scheduler thread only.  On success ``req.blocks``
+        holds shared-then-fresh block ids and ``req.prefix_tokens`` is
+        the shared token count (None = dense-splice path: precomputed
+        rows, MoE, adapters).  False = block pressure, caller defers;
+        no references are held on failure."""
+        page = self.page_size
+        if req.precomputed is not None:
+            # Disagg handover: the dense row splices into fresh blocks;
+            # no sharing (its geometry may carry left pad, and its K/V
+            # come from a different program than the pool's own extend).
+            need = self._blocks_needed(int(req.precomputed[2]), req.max_new)
+            blocks = self._pool.alloc(need)
+            if blocks is None:
+                return False
+            req.blocks = blocks
+            req.prefix_tokens = None
+            return True
+        n = int(req.ids.size)
+        if not (self._paged_share and req.aidx == 0):
+            bucket = prompt_bucket(n, self.engine.max_seq)
+            blocks = self._pool.alloc(self._blocks_needed(bucket, req.max_new))
+            if blocks is None:
+                return False
+            req.blocks = blocks
+            req.prefix_tokens = None
+            return True
+        # Automatic block-granular prefix sharing: acquire the longest
+        # chain of cached full prompt pages (capped by
+        # kv_blocks.shareable_depth — at least one suffix token must
+        # remain so the extend produces first-token logits; the router
+        # and the HTTP front-end key on the same cap), then allocate
+        # the private tail.  Acquire BEFORE alloc: the fresh allocation
+        # may evict LRU blocks, and a refcount pins the matched prefix
+        # against that eviction.
+        hashes = chunk_hashes(req.ids, page)
+        shared: list[int] = []
+        for h in hashes[: shareable_depth(n, page)]:
+            blk = self._pool.acquire(h)
+            if blk is None:
+                break
+            shared.append(blk)
+        s = len(shared)
+        fresh = self._pool.alloc(self._blocks_needed(n, req.max_new) - s)
+        if fresh is None:
+            for blk in reversed(shared):
+                self._pool.release(blk)
+            return False
+        req.blocks = shared + fresh
+        req.prefix_tokens = s * page
+        # Register the request's own FULL prompt pages (never the
+        # partial tail — decode writes into it; never shared pages —
+        # already registered).  Content is written by the admit program
+        # dispatched right after this plan; any sharer's read program
+        # is dispatched later and device FIFO orders write before read.
+        for j in range(s, n // page):
+            self._pool.register(req.blocks[j], hashes[j])
+        return True
+
+
+    def migrate_export(
+        self, *, abort_live: bool = False, include_blocks: bool = True,
+        hashes=None,
+    ) -> dict:
+        """Snapshot every registered block (hash-addressed, full pages,
+        content final) plus the live-stream manifest for the wire —
+        ``serve/migrate.py pack()``'s input.  MUST run under
+        ``run_quiesced`` (reads device cache + mutates scheduler
+        state).  Only registered blocks travel: a partial tail is CoW —
+        the destination recomputes it private, exactly as a local
+        prefix hit would.  ``abort_live=True`` additionally retires
+        every live stream stamped *migrated* (a resumable handover,
+        not a crash — the server's truncation summary tells the
+        gateway relay to fail the stream over).  ``include_blocks=
+        False`` skips block bodies: the coordinator's abort-only
+        second call after the import landed.  ``hashes`` (iterable of
+        chain-hash bytes) filters the export to exactly those
+        registered blocks — the disaggregated prefill handover ships
+        one prompt's chain, not the whole pool."""
+        if not self.paged:
+            raise ValueError("block migration requires paged KV mode")
+        cache = self._dev["cache"]
+        geometry = {
+            name: {
+                "dtype": np.dtype(arr.dtype).name,
+                # One block's contents: arr[:, blk] per leaf.
+                "shape": (int(arr.shape[0]),) + tuple(
+                    int(s) for s in arr.shape[2:]
+                ),
+            }
+            for name, arr in sorted(cache.items())
+        }
+        blocks: list[tuple[bytes, dict]] = []
+        if include_blocks:
+            items = self._pool.registered()
+            if hashes is not None:
+                want = set(hashes)
+                items = [(h, b) for h, b in items if h in want]
+            if items:
+                # ONE gather + ONE device_get for the whole export —
+                # per-block fetches would pay N host round-trips.
+                idx = jnp.asarray(
+                    np.asarray([b for _, b in items], np.int32)
+                )
+                sel = jax.device_get(
+                    {name: arr[:, idx] for name, arr in cache.items()}
+                )
+                for j, (h, _) in enumerate(items):
+                    blocks.append((h, {
+                        name: np.ascontiguousarray(sel[name][:, j])
+                        for name in sorted(sel)
+                    }))
+        requests = []
+        for r in self._active:
+            if r is None:
+                continue
+            requests.append({
+                "tenant": r.tenant,
+                "trace_id": (
+                    r.trace_ctx.trace_id if r.trace_ctx is not None
+                    else ""
+                ),
+                "prompt_tokens": int(r.prompt_tokens),
+                "emitted": int(r.emitted),
+            })
+        aborted = 0
+        if abort_live:
+            for slot, r in enumerate(self._active):
+                if r is None:
+                    continue
+                r.migrated = True
+                r.aborted = True
+                self._retire(slot)
+                aborted += 1
+        return {
+            "page_size": self.page_size,
+            "geometry": geometry,
+            "blocks": blocks,
+            "requests": requests,
+            "aborted": aborted,
+        }
+
+    def migrate_import(self, parsed: dict) -> int:
+        """Splice wire blocks (``serve/migrate.py unpack()``'s output)
+        into this pool via the SAME alloc/register/release path a local
+        admission retires through, so a migrated chain is
+        indistinguishable from one prefilled here: alloc a fresh block,
+        write the wire bytes, register its chain hash, release to
+        refcount 0 — it parks in the LRU exactly like a retired
+        prompt's pages, ready for the next matching acquire.  MUST run
+        under ``run_quiesced``.  Hashes already registered are skipped
+        (content-addressed: same hash, same bytes); a pool too full to
+        take more stops early — a partial chain is still a valid
+        (shorter) warm prefix.  Returns the blocks spliced."""
+        if not self.paged:
+            raise ValueError("block migration requires paged KV mode")
+        if int(parsed.get("page_size", 0)) != self.page_size:
+            raise ValueError(
+                f"wire page_size {parsed.get('page_size')} != local "
+                f"{self.page_size}"
+            )
+        cache = self._dev["cache"]
+        geometry = parsed.get("geometry") or {}
+        if sorted(geometry) != sorted(cache):
+            raise ValueError(
+                f"wire cache leaves {sorted(geometry)} != local "
+                f"{sorted(cache)}"
+            )
+        for name, arr in sorted(cache.items()):
+            want_dtype = np.dtype(arr.dtype)
+            want_shape = (int(arr.shape[0]),) + tuple(
+                int(s) for s in arr.shape[2:]
+            )
+            g = geometry[name]
+            if (np.dtype(g["dtype"]) != want_dtype
+                    or tuple(g["shape"]) != want_shape):
+                raise ValueError(
+                    f"leaf {name!r}: wire {g['dtype']}{g['shape']} != "
+                    f"local {want_dtype.name}{want_shape}"
+                )
+        fresh: list[tuple[bytes, int, dict]] = []
+        for h, leaves in parsed.get("blocks", []):
+            if self._pool.contains(h):
+                continue
+            got = self._pool.alloc(1)
+            if got is None:
+                break
+            fresh.append((h, got[0], leaves))
+        if fresh:
+            # ONE scatter per leaf for the whole import — per-block
+            # .at[].set would copy the full pool N times.
+            idx = jnp.asarray(
+                np.asarray([b for _, b, _ in fresh], np.int32)
+            )
+            new_cache = dict(cache)
+            for name in sorted(cache):
+                stacked = np.stack(
+                    [lv[name] for _, _, lv in fresh], axis=1
+                )
+                new_cache[name] = cache[name].at[:, idx].set(
+                    jnp.asarray(stacked, cache[name].dtype)
+                )
+            self._dev["cache"] = self._constrain_cache_paged(new_cache)
+            for h, blk, _ in fresh:
+                self._pool.register(blk, h)
+                self._pool.release(blk)
+        return len(fresh)
+

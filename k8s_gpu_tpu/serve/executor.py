@@ -1,0 +1,690 @@
+"""Device programs for the continuous batcher: prefill and decode dispatch.
+
+Split out of the original ``serve/batcher.py`` monolith (ISSUE 20):
+this module owns the *execution plane* — every jitted device program
+(admission prefills, seat splices, decode rounds, speculative verify
+rounds) and the n-gram draft proposal.  It is role-aware: a
+prefill-only executor (``role="prefill"``) admits and prefills but
+refuses decode-round dispatch outright (``_guard_decode``), which is
+what makes a dedicated prefill worker a safe deployable — it can never
+emit decode tokens, only the admission sample its handover discards.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from .engine import nucleus_mask
+from .speculative import reject_row
+
+log = logging.getLogger("k8s_gpu_tpu.serve")
+
+
+def ngram_propose(hist, token, pos, k: int, m: int = 3):
+    """Prompt-lookup proposals for ONE slot row (the "ngram" draft —
+    vLLM's ngram speculative method, TPU-shaped): find the most recent
+    position whose trailing ``m``..1-gram matches the stream's current
+    trailing gram, and propose the ``k`` tokens that followed it.
+
+    ``hist`` [S] int32 is the row's token history — ``hist[p]`` is the
+    stream token at position ``p``, ``-1`` where unwritten (left-pad,
+    future) — and ``token`` is the stream token at ``pos``.  All static
+    shapes: the match is a vectorized compare over every position (three
+    shifted equality maps and a cumulative product), the winner the
+    argmax of ``matched_len * S + recency``.  No match (or a proposal
+    running past written history) degrades to repeating ``token`` — a
+    loop guess the verify gate scores like any other.  Proposals are
+    *hints*: the target's verify pass accepts or corrects every one, so
+    this function affects throughput only, never the emitted stream."""
+    s = hist.shape[0]
+    hist = hist.at[pos].set(token)  # garbage-row safety; live rows hold this
+    idx = jnp.arange(s, dtype=jnp.int32)
+    score = jnp.zeros(s, jnp.int32)
+    run = jnp.ones(s, jnp.bool_)
+    for u in range(m):
+        # shifted[j] = hist[j-1-u]; pad with -2 so it never matches a
+        # real token OR the -1 unwritten fill.
+        shifted = jnp.concatenate(
+            [jnp.full((u + 1,), -2, jnp.int32), hist[: s - u - 1]]
+        )
+        suffix_tok = hist[jnp.maximum(pos - u, 0)]
+        run = run & (shifted == suffix_tok) & (suffix_tok >= 0)
+        score = score + run.astype(jnp.int32)
+    # j == pos+1 would be the trivial self-match; j <= pos keeps matches
+    # strictly earlier in the stream.
+    score = jnp.where(idx <= pos, score, 0)
+    j = jnp.argmax(score * s + idx).astype(jnp.int32)
+    ext = jnp.concatenate([hist, jnp.full((k,), -1, jnp.int32)])
+    g = jax.lax.dynamic_slice(ext, (j,), (k,))
+    return jnp.where((score[j] > 0) & (g >= 0), g, token)
+
+
+class ExecutorMixin:
+    """Prefill/decode dispatch half of ``ContinuousBatcher``.  All
+    methods are device programs (or their jit wrappers' bodies); the
+    only host-side policy here is the role gate."""
+
+    role: str = "both"  # "both" | "prefill" | "decode"
+
+    def _guard_decode(self) -> None:
+        """Refuse decode-round dispatch on a prefill-only executor.
+
+        A prefill worker's requests are admitted with a 1-token budget
+        and retire at admission, so the scheduler never *reaches* a
+        decode round for them — this guard turns any future violation
+        of that invariant into a loud error instead of a silently
+        wrong stream on a worker whose KV pages may already have been
+        handed over."""
+        if self.role == "prefill":
+            raise RuntimeError(
+                "prefill-only executor: decode round dispatch refused")
+
+    # -- device programs ---------------------------------------------------
+    def _constrain_cache_paged(self, cache):
+        """Paged pool [L, NB, KH, page, Dh]: heads shard over tp; the
+        block axis stays replicated (per-row page gathers cross it)."""
+        if self.engine.mesh is None:
+            return cache
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def one(x):
+            spec = (
+                P(None, None, "tp", None, None) if x.ndim == 5
+                else P(None, None, "tp", None)
+            )
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.engine.mesh, spec)
+            )
+
+        return jax.tree.map(one, cache)
+
+
+    def _constrained_first(self, logits, temp, key, ctab, cidx,
+                           top_p=None):
+        """First-token sampling under the constraint bank: mask at the
+        start state (0), then advance the DFA by the chosen token."""
+        if ctab is None:
+            first, key, lp = self._first_token(
+                logits, temp, key, top_p=top_p
+            )
+            return first, key, jnp.int32(0), lp
+        mask = ctab["allowed"][cidx, 0]
+        dead = self.eos_id if self.eos_id >= 0 else 0
+        first, key, lp = self._first_token(
+            logits, temp, key, mask, dead, top_p=top_p
+        )
+        cstate = jnp.where(
+            mask.any(), ctab["next"][cidx, 0, first], jnp.int32(0)
+        )
+        return first, key, cstate, lp
+
+    def _admit_dev(self, params, dev, padded, slot, temp, key, pad, bank,
+                   aidx, ctab, cidx, top_p, dparams=None, hist_row=None,
+                   page_row=None):
+        """Prefill one request on a [1, bucket] shape, splice its cache row
+        into the pool, seat its decode state at *slot*, and sample the
+        first token — all on device (no host fetch on the admit path).
+        ``pad`` is traced: prompts of every length within a bucket share
+        one compiled program (the O(log max_seq) compile story).
+        Speculative mode prefills the draft on the SAME padded shape in
+        the same program — admission stays a single dispatch."""
+        row_cache, last_logits = self.engine.prefill(
+            params, padded, pad_left=pad,
+            adapters=bank, adapter_idx=aidx[None] if bank else None,
+        )
+        bucket = padded.shape[1]
+        first, key, cstate, lp = self._constrained_first(
+            last_logits[0], temp, key, ctab, cidx, top_p=top_p
+        )
+        draft_row = None
+        if self.draft_engine is not None and dparams is not None:
+            draft_row, _ = self.draft_engine.prefill(
+                dparams, padded, pad_left=pad
+            )
+        return self._seat(
+            dev, row_cache, slot, first, bucket, bucket - pad, pad, temp,
+            key, aidx, cidx, cstate, top_p,
+            draft_row=draft_row, prev=padded[0, -1], hist_row=hist_row,
+            page_row=page_row, n_copy=bucket,
+        ), first, lp
+
+    def _admit_round_dev(self, params, dev, padded, slot, temp, key, pad,
+                         bank, aidx, ctab, cidx, top_p, use_top_p,
+                         n_steps, t_hi=None):
+        """Cold-start fusion: prefill + seat + ``n_steps`` decode in ONE
+        device program — the solo cold-admission path (plain mode only).
+        A cold solo request otherwise pays two dispatches (admit, round)
+        where the one-shot engine pays one; through a tunneled TPU each
+        dispatch costs ~60-100 ms, so the fusion brings the batcher's
+        single-stream latency to the engine's (VERDICT r3 ask #4).  The
+        program body IS _admit_dev followed by _round_dev — the fused
+        stream is bit-identical to the unfused path by construction."""
+        dev, first, lp = self._admit_dev(
+            params, dev, padded, slot, temp, key, pad, bank, aidx, ctab,
+            cidx, top_p,
+        )
+        dev, (toks, lps) = self._round_dev(
+            params, dev, bank, ctab, use_top_p, n_steps, t_hi,
+        )
+        return dev, first, lp, toks, lps
+
+    @staticmethod
+    def _first_token(logits, temp, key, mask=None, dead_tok=0,
+                     top_p=None):
+        """``mask`` [V] bool: constrained sampling — disallowed logits go
+        to -inf; a fully-masked row emits ``dead_tok`` (EOS by
+        convention) so the scheduler retires it.  Returns
+        (token, key, logprob) — the chosen token's log-probability under
+        the (masked, unscaled) distribution, the OpenAI-style per-token
+        logprob surface."""
+        any_ok = None
+        if mask is not None:
+            any_ok = mask.any()
+            logits = jnp.where(mask, logits, -jnp.inf)
+        key, sub = jax.random.split(key)
+        greedy = jnp.argmax(logits).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temp, 1e-6)
+        if top_p is not None:
+            scaled = nucleus_mask(scaled, top_p)
+        sampled = jax.random.categorical(sub, scaled).astype(jnp.int32)
+        first = jnp.where(temp > 0, sampled, greedy)
+        if mask is not None:
+            first = jnp.where(any_ok, first, jnp.int32(dead_tok))
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))[first]
+        if mask is not None:
+            # all--inf logits → NaN log_softmax; a dead-end row's logprob
+            # must stay finite (it would otherwise serialize as invalid
+            # JSON in the /generate response).
+            lp = jnp.where(any_ok, lp, 0.0)
+        return first, key, lp
+
+    def _seat(self, dev, row, slot, first, pos, rope, start, temp, key,
+              aidx, cidx=0, cstate=0, top_p=0.0, draft_row=None, prev=0,
+              hist_row=None, page_row=None, n_copy=0):
+        """Splice a prefilled K/V row into the pool and seat a slot's
+        decode state — the single owner of the per-slot field list (a
+        field added here reaches all three admission paths at once).
+
+        ``draft_row``/``prev`` (speculative mode): the draft's prefilled
+        K/V row, or None to seat a ZEROED row — a stale previous tenant's
+        draft K/V would otherwise poison this request's proposals.  prev
+        is the last prompt token (re-ingested at pos-1 each spec round).
+
+        ``page_row`` [max_pages] int32 + ``n_copy`` (static): paged-KV
+        mode — the first ``n_copy`` positions of ``row`` scatter into
+        the physical blocks ``page_row`` names, page by page.
+
+        ``row`` None: the K/V already live in the pool (the paged
+        suffix-extend admission wrote them through the page table) —
+        only the per-slot decode state seats."""
+        if row is None:
+            cache = dev["cache"]
+        elif page_row is not None:
+            # One advanced-index scatter per leaf — the same
+            # logical→physical address math as engine._paged_store's
+            # window branch (blk = pages[p // page], off = p % page).
+            page = self.page_size
+            q_pos = jnp.arange(n_copy)
+            blk = page_row[q_pos // page]          # [n_copy]
+            off = q_pos % page                     # [n_copy]
+
+            def splice(p, r):
+                chunk = r[:, 0, :, :n_copy]        # [L, KH, n_copy, *rest]
+                return p.at[:, blk, :, off].set(
+                    jnp.moveaxis(chunk, 2, 0).astype(p.dtype)
+                )
+
+            cache = jax.tree.map(splice, dev["cache"], row)
+        else:
+            cache = jax.tree.map(
+                # Rank-generic splice: int8 values are rank 5, their
+                # scales rank 4 — both splice on the same (layer, slot)
+                # leading axes.
+                lambda p, r: jax.lax.dynamic_update_slice(
+                    p, r.astype(p.dtype), (0, slot) + (0,) * (p.ndim - 2)
+                ),
+                dev["cache"], row,
+            )
+        out = {
+            "cache": cache,
+            "token": dev["token"].at[slot].set(first),
+            "pos": dev["pos"].at[slot].set(pos),
+            "rope": dev["rope"].at[slot].set(rope),
+            "start": dev["start"].at[slot].set(start),
+            "temps": dev["temps"].at[slot].set(temp),
+            "top_p": dev["top_p"].at[slot].set(top_p),
+            "keys": dev["keys"].at[slot].set(key),
+            "aidx": dev["aidx"].at[slot].set(aidx),
+            "cidx": dev["cidx"].at[slot].set(cidx),
+            "cstate": dev["cstate"].at[slot].set(cstate),
+        }
+        if self.draft_engine is not None:
+            if draft_row is None:
+                draft_row = jax.tree.map(
+                    lambda p: jnp.zeros(
+                        (p.shape[0], 1) + p.shape[2:], p.dtype
+                    ),
+                    dev["d_cache"],
+                )
+            out["d_cache"] = jax.tree.map(
+                lambda p, r: jax.lax.dynamic_update_slice(
+                    p, r.astype(p.dtype), (0, slot, 0, 0, 0)
+                ),
+                dev["d_cache"], draft_row,
+            )
+            out["prev"] = dev["prev"].at[slot].set(prev)
+        if self.spec_mode == "ngram":
+            # ``hist_row`` carries the prompt tokens at their cache
+            # positions (None — a disagg row with unknown geometry —
+            # seats an unwritten history: proposals start weak, verify
+            # keeps them correct); the first token lands at ``pos``.
+            if hist_row is None:
+                hist_row = jnp.full(
+                    (self.engine.max_seq,), -1, jnp.int32
+                )
+            out["hist"] = dev["hist"].at[slot].set(
+                hist_row.at[pos].set(first)
+            )
+        return out
+
+    def _admit_prefix_dev(self, params, dev, base, suffix, n_real, slot,
+                          temp, key, base_pos, ctab, cidx, top_p,
+                          hist_row=None):
+        """Admit on top of a cached prefix: extend the prefix's K/V row
+        with the RIGHT-padded suffix (one extend_multi, width = suffix
+        bucket) instead of prefilling the whole prompt.
+
+        Right-padding is the safety trick: pad slots write garbage K/V at
+        positions past the live length, which the decode masks
+        (t <= pos) never attend and the decode loop overwrites in order —
+        left-padding would instead clobber the real prefix tail."""
+        row, logits = self.engine.extend_multi(
+            params, base, suffix,
+            jnp.asarray([base_pos]), jnp.asarray([base_pos]),
+            jnp.asarray([0]),
+        )
+        first, key, cstate, lp = self._constrained_first(
+            logits[0, n_real - 1], temp, key, ctab, cidx, top_p=top_p
+        )
+        pos = base_pos + n_real
+        return self._seat(
+            dev, row, slot, first, pos, pos, 0, temp, key, 0, cidx, cstate,
+            top_p, prev=suffix[0, n_real - 1], hist_row=hist_row,
+        ), first, lp
+
+    def _admit_exact_dev(self, dev, base, base_logits, pos, rope, start,
+                         slot, temp, key, aidx, ctab, cidx, top_p,
+                         prev=0, hist_row=None, page_row=None):
+        """Seat a row whose K/V were computed elsewhere: splice + sample,
+        no model forward on THIS program.  Two callers: a prompt that IS
+        a cached prefix (pos=rope=n, start=0), and disaggregated-prefill
+        admission (serve/disagg.py — a prefill worker hands over the row
+        with its bucketing geometry intact).  ``page_row`` (paged mode):
+        the whole dense row splices into the slot's blocks page by page
+        — one compile regardless of prompt length; positions past the
+        allocation map to table entry 0 (trash) and splice harmlessly."""
+        first, key, cstate, lp = self._constrained_first(
+            base_logits[0], temp, key, ctab, cidx, top_p=top_p
+        )
+        return self._seat(
+            dev, base, slot, first, pos, rope, start, temp, key, aidx,
+            cidx, cstate, top_p, prev=prev, hist_row=hist_row,
+            page_row=page_row,
+            n_copy=self.engine.max_seq if page_row is not None else 0,
+        ), first, lp
+
+    def _admit_paged_dev(self, params, dev, suffix, n_real, slot, temp,
+                         key, base_pos, ctab, cidx, top_p, page_row,
+                         hist_row=None):
+        """Paged admission: extend the slot's page-table row with the
+        RIGHT-padded suffix, writing K/V straight into the pool's
+        physical blocks (no dense row, no splice).  ``base_pos`` tokens
+        of shared prefix are already resident in the blocks the table's
+        head names (0 on a cold miss — the "suffix" is then the whole
+        prompt); the extend's reads gather them through the table, its
+        writes scatter only at positions >= base_pos, which always map
+        to the request's PRIVATE tail blocks — shared blocks are
+        read-only by construction.  Right-pad garbage K/V land above
+        the live length (decode overwrites them in order, masks never
+        attend them) or past the table in the trash block.
+
+        Speculative mode seats a zeroed draft row / a prompt-seeded
+        ngram history exactly like the dense prefix path — the draft
+        re-warms from the stream, costing acceptance, never
+        correctness."""
+        cache, logits = self.engine.extend_multi(
+            params, dev["cache"], suffix,
+            jnp.reshape(base_pos, (1,)), jnp.reshape(base_pos, (1,)),
+            jnp.zeros((1,), jnp.int32),
+            pages=page_row[None], page=self.page_size,
+        )
+        first, key, cstate, lp = self._constrained_first(
+            logits[0, n_real - 1], temp, key, ctab, cidx, top_p=top_p
+        )
+        pos = base_pos + n_real
+        dev = dict(dev, cache=cache)
+        return self._seat(
+            dev, None, slot, first, pos, pos, 0, temp, key, 0, cidx,
+            cstate, top_p, prev=suffix[0, n_real - 1], hist_row=hist_row,
+        ), first, lp
+
+    def _round_dev(self, params, dev, bank, ctab, use_top_p, n_steps,
+                   t_hi=None, pages=None):
+        """One scheduler round: ``n_steps`` batched decode steps as a
+        single on-device scan.  Returns (new_dev, tokens [T, B]).  Rows
+        that hit EOS/budget mid-round produce garbage tails the host drops
+        when it retires the slot.
+
+        ``n_steps`` is STATIC (one compiled variant per bucket): the
+        normal ``steps_per_round`` when requests share rounds, and a
+        ``solo_buckets`` size — the smallest covering the request's
+        remaining budget — when exactly one request is live with nothing
+        pending.  A single stream's cost is dominated by per-dispatch
+        overhead (~60 ms on a tunneled TPU), so solo rounds amortize it
+        over up to 8× the steps while the budget gate in _dispatch_round
+        stops anything past the request's end (VERDICT r3 weak #2/ask
+        #4).  An arrival during a long solo round waits at most the
+        in-flight rounds before its admit — bounded, and the scheduler
+        switches back to the short variant the moment a second request
+        exists.
+
+        Ngram-mode batchers also dispatch THIS round when the adaptive
+        gate measures acceptance below break-even (the plain-fallback
+        path): the per-slot token history then keeps updating here, so
+        a later probe's proposals come from real history, not a stale
+        snapshot."""
+        temps = dev["temps"]
+        kv_start = dev["start"]
+        track_hist = self.spec_mode == "ngram"
+
+        def one(carry, _):
+            cache, token, pos, rope, keys, cstate, hist = carry
+            cache, logits = self.engine.decode_step_multi(
+                params, cache, token, pos, rope, kv_start,
+                adapters=bank,
+                adapter_idx=dev["aidx"] if bank else None,
+                t_hi=t_hi, pages=pages, page=self.page_size,
+            )
+            if ctab is not None:
+                mask = ctab["allowed"][dev["cidx"], cstate]   # [B, V]
+                logits = jnp.where(mask, logits, -jnp.inf)
+                any_ok = mask.any(-1)
+            split = jax.vmap(jax.random.split)(keys)     # [B, 2, 2]
+            new_keys, subs = split[:, 0], split[:, 1]
+            greedy = jnp.argmax(logits, axis=-1)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            if use_top_p:
+                scaled = nucleus_mask(scaled, dev["top_p"])
+            sampled = jax.vmap(
+                lambda k, l: jax.random.categorical(k, l)
+            )(subs, scaled)
+            nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            if ctab is not None:
+                # Dead end: emit EOS so the scheduler retires the row.
+                dead = self.eos_id if self.eos_id >= 0 else 0
+                nxt = jnp.where(any_ok, nxt, jnp.int32(dead))
+                cstate = jnp.where(
+                    any_ok, ctab["next"][dev["cidx"], cstate, nxt], cstate
+                )
+            if self.collect_logprobs:
+                lp = jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=-1
+                )[jnp.arange(nxt.shape[0]), nxt]
+                if ctab is not None:
+                    lp = jnp.where(any_ok, lp, 0.0)  # dead end: finite
+            else:
+                lp = jnp.zeros(nxt.shape[0], jnp.float32)
+            if track_hist:
+                # hist[b, p] = stream token at position p; nxt lands at
+                # pos+1 (out-of-range garbage-row writes drop by scatter
+                # semantics).
+                hist = hist.at[jnp.arange(nxt.shape[0]), pos + 1].set(nxt)
+            return (cache, nxt, pos + 1, rope + 1, new_keys, cstate,
+                    hist), (nxt, lp)
+
+        (cache, token, pos, rope, keys, cstate, hist), (toks, lps) = (
+            jax.lax.scan(
+                one,
+                (dev["cache"], dev["token"], dev["pos"], dev["rope"],
+                 dev["keys"], dev["cstate"],
+                 dev["hist"] if track_hist else jnp.zeros((), jnp.int32)),
+                length=n_steps,
+            )
+        )
+        out = dict(dev)
+        out.update(
+            cache=cache, token=token, pos=pos, rope=rope, keys=keys,
+            cstate=cstate,
+        )
+        if track_hist:
+            out["hist"] = hist
+        return out, (toks, lps)
+
+    def _spec_accept(self, vlogits, g, q, rkeys, temps, top_p, use_top_p):
+        """THE verify/accept/advance math both speculative surfaces ride
+        (neural-draft `_round_spec_dev` and ngram `_round_spec_ngram_dev`)
+        — one implementation so the two cannot drift (the same hazard
+        reject_row's docstring names).
+
+        ``vlogits`` [B, K+1, V] target verify logits over each row's
+        [token, g] window; ``g`` [B, K] proposals; ``q`` [B, K, V] the
+        warped distributions the proposals were drawn from (a one-hot
+        delta for deterministic drafts); ``rkeys`` [B] rejection keys.
+        Returns (e [B, K+1] emitted tokens, n [B] = accepted+1, lp,
+        a [B] accepted counts, new_token [B] the next feed)."""
+        K = g.shape[1]
+        B = g.shape[0]
+        sampled_row = temps > 0.0
+
+        def warp(logits):
+            scaled = (
+                logits.astype(jnp.float32)
+                / jnp.maximum(temps, 1e-6)[:, None]
+            )
+            if use_top_p:
+                scaled = nucleus_mask(scaled, top_p)
+            return scaled
+
+        # Greedy: longest target-argmax-matching prefix.
+        t_pred = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+        match = (g == t_pred[:, :K]).astype(jnp.int32)
+        a_g = jnp.cumprod(match, axis=1).sum(axis=1)
+        # Sampled: per-row rejection sampling on warped p/q.
+        p = jax.nn.softmax(
+            jax.vmap(warp, in_axes=1, out_axes=1)(vlogits), axis=-1
+        )                                                   # [B,K+1,V]
+        a_s, x = jax.vmap(reject_row)(rkeys, p, q, g)
+        a = jnp.where(sampled_row, a_s, a_g)
+        corr = jnp.where(
+            sampled_row[:, None],
+            jnp.broadcast_to(x[:, None], (B, K + 1)),
+            t_pred,
+        )
+        idx = jnp.arange(K + 1, dtype=jnp.int32)[None]
+        base = jnp.concatenate([g, g[:, -1:]], axis=1)
+        e = jnp.where(idx < a[:, None], base, corr)         # [B,K+1]
+        n = a + 1
+        if self.collect_logprobs:
+            lsm = jax.nn.log_softmax(vlogits.astype(jnp.float32), axis=-1)
+            lp = jnp.take_along_axis(lsm, e[..., None], axis=2)[..., 0]
+        else:
+            lp = jnp.zeros((B, K + 1), jnp.float32)
+        new_token = jnp.take_along_axis(e, a[:, None], 1)[:, 0]
+        return e, n, lp, a, new_token
+
+    def _round_spec_dev(self, params, dparams, dev, bank, use_top_p,
+                        n_rounds, t_hi=None, spec_k=None, pages=None):
+        """Speculative scheduler round(s): ``spec_rounds`` × (K draft
+        steps + ONE target verify over every slot's own window, via
+        engine.extend_multi's per-row window writes).  Returns
+        (new_dev, (toks [R, B, K+1], ns [R, B], lps [R, B, K+1])) —
+        row b emitted ns[r, b] = a+1 tokens in sub-round r (the accepted
+        draft prefix plus the target's correction/bonus token); the host
+        trims by EOS/budget exactly as in the plain round.
+
+        Greedy rows (temp == 0) are BIT-exact with the plain path: every
+        emitted token is a target argmax over the same cached prefix —
+        the draft only changes how many arrive per dispatch.  Sampled
+        rows run per-row rejection sampling (_reject_row) against the
+        same per-row warp the plain round samples from: exact in
+        distribution for ANY draft, though a seeded stream consumes PRNG
+        differently than the plain path (the one-shot SpeculativeDecoder
+        contract).  Retired-but-unnoticed slots advance up to K+1
+        positions per sub-round as garbage; their out-of-range window
+        writes are dropped by XLA scatter semantics and never emitted
+        (same argument as the plain round's garbage tail).
+
+        ``spec_k`` (static): the draft window for THIS dispatch — the
+        adaptive-K scheduler (_adaptive_k) resizes it from measured
+        acceptance, one compiled variant per K."""
+        K = self.spec_k if spec_k is None else spec_k
+        kv_start = dev["start"]
+        temps = dev["temps"]
+        B = kv_start.shape[0]
+        sampled_row = temps > 0.0
+
+        def warp(logits):
+            scaled = (
+                logits.astype(jnp.float32)
+                / jnp.maximum(temps, 1e-6)[:, None]
+            )
+            if use_top_p:
+                scaled = nucleus_mask(scaled, dev["top_p"])
+            return scaled
+
+        def one(carry, _):
+            cache, d_cache, token, prev, pos, rope, keys = carry
+            # Per-row keys: 1 fresh carry + K draft draws + 1 rejection.
+            split = jax.vmap(lambda k: jax.random.split(k, K + 2))(keys)
+            new_keys = split[:, 0]
+            # 1. Draft: re-ingest prev at pos-1 (idempotent overwrite;
+            #    re-warms zero-seated rows too), then K lookahead steps.
+            d_cache, _ = self.draft_engine.decode_step_multi(
+                dparams, d_cache, prev,
+                jnp.maximum(pos - 1, kv_start), jnp.maximum(rope - 1, 0),
+                kv_start, t_hi=t_hi,
+            )
+            tok = token
+            drafts, qs = [], []
+            for i in range(K):
+                d_cache, dlogits = self.draft_engine.decode_step_multi(
+                    dparams, d_cache, tok, pos + i, rope + i, kv_start,
+                    t_hi=t_hi,
+                )
+                dscaled = warp(dlogits)
+                draw = jax.vmap(jax.random.categorical)(
+                    split[:, 1 + i], dscaled
+                )
+                tok = jnp.where(
+                    sampled_row, draw, jnp.argmax(dlogits, axis=-1)
+                ).astype(jnp.int32)
+                drafts.append(tok)
+                qs.append(jax.nn.softmax(dscaled, axis=-1))
+            g = jnp.stack(drafts, axis=1)                      # [B, K]
+            # 2. Verify: one target forward over [token, g] windows.
+            window = jnp.concatenate([token[:, None], g], axis=1)
+            cache, vlogits = self.engine.extend_multi(
+                params, cache, window, pos, rope, kv_start,
+                adapters=bank, adapter_idx=dev["aidx"] if bank else None,
+                t_hi=t_hi, pages=pages, page=self.page_size,
+            )
+            # 3. Accept/correct via the shared math (_spec_accept).
+            q = jnp.stack(qs, axis=1)                           # [B,K,V]
+            e, n, lp, a, new_token = self._spec_accept(
+                vlogits, g, q, split[:, K + 1], temps, dev["top_p"],
+                use_top_p,
+            )
+            # 4. Advance: prev/token slide to the accepted frontier —
+            #    window[a] sits at the new pos-1, e[a] is the next feed.
+            new_prev = jnp.take_along_axis(window, a[:, None], 1)[:, 0]
+            return (
+                cache, d_cache, new_token, new_prev, pos + n, rope + n,
+                new_keys,
+            ), (e, n, lp)
+
+        (cache, d_cache, token, prev, pos, rope, keys), (toks, ns, lps) = (
+            jax.lax.scan(
+                one,
+                (dev["cache"], dev["d_cache"], dev["token"], dev["prev"],
+                 dev["pos"], dev["rope"], dev["keys"]),
+                length=n_rounds,
+            )
+        )
+        out = dict(dev)
+        out.update(
+            cache=cache, d_cache=d_cache, token=token, prev=prev,
+            pos=pos, rope=rope, keys=keys,
+        )
+        return out, (toks, ns, lps)
+
+    def _round_spec_ngram_dev(self, params, dev, bank, use_top_p,
+                              n_rounds, t_hi=None, spec_k=None,
+                              pages=None):
+        """Speculative rounds with the prompt-lookup draft: proposals come
+        from ``ngram_propose`` over each row's token history instead of a
+        draft model's chain — so a sub-round is ONE target ``extend_multi``
+        over the K+1 window and nothing else.  The verify/accept/advance
+        math is `_round_spec_dev`'s exactly, with the draft distribution a
+        one-hot delta at the proposal (rejection sampling then accepts
+        g_i with prob p_i(g_i) and corrects from the normalized residual
+        — still exact-in-distribution for sampled rows, bit-exact greedy
+        for temp==0 rows).
+
+        History maintenance: the emitted window ``e`` scatters into
+        ``hist`` at pos+1 each sub-round — including rejected-position
+        tokens past the accepted frontier.  The NEXT sub-round's lookup
+        runs before its own scatter, so a continuation slice CAN read
+        those stale post-frontier tokens (and a row within K+1 of
+        max_seq clamps its scatter backwards over old history).  Both
+        only degrade proposal quality, never the stream: every emission
+        is verify-gated."""
+        K = self.spec_k if spec_k is None else spec_k
+        kv_start = dev["start"]
+        temps = dev["temps"]
+        V = self.engine.cfg.vocab_size
+
+        def one(carry, _):
+            cache, hist, token, pos, rope, keys = carry
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            new_keys, rkeys = split[:, 0], split[:, 1]
+            g = jax.vmap(
+                lambda h, t, p: ngram_propose(h, t, p, K)
+            )(hist, token, pos)                                 # [B, K]
+            window = jnp.concatenate([token[:, None], g], axis=1)
+            cache, vlogits = self.engine.extend_multi(
+                params, cache, window, pos, rope, kv_start,
+                adapters=bank, adapter_idx=dev["aidx"] if bank else None,
+                t_hi=t_hi, pages=pages, page=self.page_size,
+            )
+            q = jax.nn.one_hot(g, V, dtype=jnp.float32)         # [B,K,V]
+            e, n, lp, a, new_token = self._spec_accept(
+                vlogits, g, q, rkeys, temps, dev["top_p"], use_top_p,
+            )
+            hist = jax.vmap(
+                lambda h, ee, p_: jax.lax.dynamic_update_slice(
+                    h, ee, (p_ + 1,)
+                )
+            )(hist, e, pos)
+            return (
+                cache, hist, new_token, pos + n, rope + n, new_keys,
+            ), (e, n, lp)
+
+        (cache, hist, token, pos, rope, keys), (toks, ns, lps) = (
+            jax.lax.scan(
+                one,
+                (dev["cache"], dev["hist"], dev["token"], dev["pos"],
+                 dev["rope"], dev["keys"]),
+                length=n_rounds,
+            )
+        )
+        out = dict(dev)
+        out.update(
+            cache=cache, hist=hist, token=token, pos=pos, rope=rope,
+            keys=keys,
+        )
+        return out, (toks, ns, lps)
+

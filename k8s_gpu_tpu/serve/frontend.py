@@ -82,7 +82,9 @@ from ..utils.tracing import (
 from .canary import CanaryProber
 from .journal import RequestJournal
 from .journal import RequestRecord as JournalRecord
+from .kv_blocks import shareable_chain
 from .migrate import BlockMigrator
+from .ratio import RatioController, RatioDecision
 from .router import FleetRouter
 
 log = logging.getLogger("k8s_gpu_tpu.frontend")
@@ -153,7 +155,7 @@ class FleetFrontend:
     _GUARDED_BY = {
         "_lock": ("_replicas", "_inflight", "_drains", "_live",
                   "_live_seq", "_peers", "_owner_map", "_owner_digest",
-                  "_owner_seq"),
+                  "_owner_seq", "_roles", "_mix"),
     }
 
     def __init__(
@@ -176,6 +178,8 @@ class FleetFrontend:
         max_journal: int = 512,
         admission=None,
         admission_wait_s: float = 5.0,
+        disagg_threshold: int = 0,
+        ratio: RatioController | None = None,
     ):
         """``page_size`` must match the replicas' paged-KV page size —
         it is the router's chain-hash chunking, and the whole affinity
@@ -190,7 +194,22 @@ class FleetFrontend:
         quotas) and a refused request sheds 429 — None (the default)
         keeps the PR 15 behavior, admission unconditional.
         ``admission_wait_s`` bounds how long a queued request waits
-        for a grant when the client gave no deadline."""
+        for a grant when the client gave no deadline.
+
+        ``disagg_threshold`` (ISSUE 20) > 0 enables disaggregated
+        prefill/decode: a /generate prompt of at least that many
+        tokens (floored to page_size+1 — shorter prompts have no
+        page-aligned chain to hand over) prefills on a dedicated
+        prefill worker (``register_replica(role="prefill")``), its KV
+        chain ships over the migration wire to the routed decode
+        owner's /admin/import, and only then does the normal dispatch
+        run — the decode worker's paged admission acquires the warm
+        chain and computes just the sub-page tail, never the full
+        prefill.  0 (the default) disables classification entirely:
+        every request takes the fused path and none of the disagg
+        machinery runs.  ``ratio`` is an optional
+        ``serve/ratio.py`` RatioController; ``ratio_tick()`` feeds it
+        the observed traffic mix and applies its reassignment."""
         self.tokenizer = tokenizer
         self.clock = clock or RealClock()
         self.metrics = metrics if metrics is not None else global_metrics
@@ -240,6 +259,17 @@ class FleetFrontend:
         self._owner_seq = 0
         self.admission = admission
         self.admission_wait_s = max(0.05, float(admission_wait_s))
+        # Disaggregated prefill/decode (ISSUE 20): the classification
+        # threshold, the per-worker role table (decode workers live in
+        # the router; prefill workers only here), the ratio controller,
+        # and the traffic-mix accumulator its decisions read.
+        self._page = page_size
+        self.disagg_threshold = max(0, int(disagg_threshold))
+        self.ratio = ratio
+        self._roles: dict[str, str] = {}        # name -> decode|prefill
+        self._mix = {
+            "prefill": 0.0, "decode": 0.0, "t0": self.clock.now(),
+        }
         # The wire-level KV migration coordinator (serve/migrate.py):
         # drains hand a victim's warm chains to the router-chosen new
         # owner instead of letting them die with the process.
@@ -256,8 +286,8 @@ class FleetFrontend:
             known_routes = (
                 "/generate", "/replica", "/admin/replicas",
                 "/admin/drain", "/admin/ownermap", "/admin/peers",
-                "/admin/admission", "/healthz", "/readyz", "/metrics",
-                "/debug/requests",
+                "/admin/admission", "/admin/ratio", "/healthz",
+                "/readyz", "/metrics", "/debug/requests",
             )
 
             def _get(self):
@@ -326,6 +356,8 @@ class FleetFrontend:
                     return self._json(
                         200, {"enabled": True, **a.snapshot()}
                     )
+                if path == "/admin/ratio":
+                    return self._json(200, outer.ratio_state())
                 if path == "/debug/requests":
                     one = self._query()
                     try:
@@ -389,6 +421,13 @@ class FleetFrontend:
                             },
                         )
                     return self._json(200, got)
+                if path == "/admin/ratio":
+                    # Admin trigger for one controller evaluation —
+                    # the same tick a periodic operator loop would
+                    # run; returns what it decided and applied.
+                    if outer.ratio is None:
+                        return self._json(200, {"enabled": False})
+                    return self._json(200, outer.ratio_tick())
                 if path == "/admin/peers":
                     name = body.get("name", "")
                     url = body.get("url", "")
@@ -456,10 +495,17 @@ class FleetFrontend:
                     return self._json(
                         400, {"error": "url (string) required"}
                     )
+                role = body.get("role", "decode")
+                if role not in ("decode", "prefill"):
+                    return self._json(
+                        400,
+                        {"error": "role must be decode or prefill"},
+                    )
                 try:
                     r = outer.register_replica(
                         name.strip(), url.strip(),
                         metrics_target=body.get("metrics_url") or None,
+                        role=role,
                     )
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
@@ -620,12 +666,40 @@ class FleetFrontend:
                                 "Retry-After": str(RETRY_AFTER_S)
                             },
                         )
+                # -- disaggregated prefill/decode (ISSUE 20) ----------
+                # Classification and handover happen AFTER admission
+                # (a shed request must not burn prefill-pool work) and
+                # before dispatch, so a successful handover's warm
+                # chain is registered on the decode owner the instant
+                # the normal dispatch routes there.  Every failure
+                # between here and dispatch degrades to the fused path
+                # — the request itself is never at risk.
+                handover = None
+                if outer.disagg_threshold > 0 and pinned is None:
+                    long_prompt = outer._classify(ids)
+                    outer._mix_account(
+                        len(ids), max(1, want_new), long_prompt
+                    )
+                    if long_prompt:
+                        handover = outer._disagg_handover(
+                            ids, tenant=tenant, deadline=deadline,
+                            trace_ctx=self.trace_ctx,
+                            seed=body.get("seed", 0),
+                            temperature=body.get("temperature", 0.0),
+                            top_p=body.get("top_p", 0.0),
+                        )
+                        if handover is None:
+                            outer.metrics.inc(
+                                "disagg_requests_total",
+                                path="fused_fallback",
+                            )
                 try:
                     out = outer.dispatch(
                         ids, body, tenant=tenant, deadline=deadline,
                         trace_ctx=self.trace_ctx,
                         stream=bool(body.get("stream", False)),
                         pinned=pinned, migrated_from=resume_from,
+                        handover=handover,
                     )
                     if out["kind"] == "stream":
                         # Everything the relay needs to RESUME this
@@ -930,6 +1004,7 @@ class FleetFrontend:
         metrics_target=None,
         on_drain=None,
         warm: bool = True,
+        role: str = "decode",
     ) -> dict:
         """Admit a replica behind the gateway, gated on its ``/readyz``:
         unreachable or draining raises RuntimeError; alive-but-unwarmed
@@ -941,11 +1016,24 @@ class FleetFrontend:
         federated for load-aware routing; without one the replica routes
         on affinity alone.  ``on_drain`` is forwarded to the router so a
         drain announcement can flip an in-process replica's own
-        ``/readyz`` (``LmServer.drain``).  Returns the readiness body."""
+        ``/readyz`` (``LmServer.drain``).  Returns the readiness body.
+
+        ``role`` (ISSUE 20): ``"decode"`` (default) joins the routing
+        pool exactly as before; ``"prefill"`` keeps the worker OUT of
+        the router and the canary prober — it never receives routed
+        /generate traffic, only the gateway's /prefill handovers — and
+        a worker whose own ``/readyz`` reports a prefill-only batcher
+        is refused as a decode replica (its 1-token-clamped streams
+        would be silently wrong)."""
         name = str(name).strip()[:64]
         if not name:
             raise ValueError("replica name required")
+        if role not in ("decode", "prefill"):
+            raise ValueError(f"unknown replica role {role!r}")
         url = str(url).rstrip("/")
+        # A prefill worker never serves a multi-token /generate, so
+        # the 1-token warm probe is the ONLY warm it can take — which
+        # is exactly what `warm` already sends.
         r = self._readyz(url)
         if r is None:
             raise RuntimeError(
@@ -966,11 +1054,28 @@ class FleetFrontend:
                 f"replica at {url} calls itself {claimed!r}; "
                 f"refusing to register it as {name!r}"
             )
+        if role == "decode" and r.get("role") == "prefill":
+            raise RuntimeError(
+                f"replica {name!r} reports a prefill-only batcher; "
+                f"refusing to route decode traffic to it"
+            )
         with self._lock:
             self._replicas[name] = url
             self._inflight.setdefault(name, 0)
             self._drains.pop(name, None)
+            self._roles[name] = role
             count = len(self._replicas)
+            prefill_n = sum(
+                1 for v in self._roles.values() if v == "prefill"
+            )
+        if role == "prefill":
+            # Out of the router, out of the prober: routed /generate
+            # and canary probes are decode-pool concerns.
+            self.metrics.set_gauge("frontend_replicas", float(count))
+            self.metrics.set_gauge(
+                "disagg_prefill_workers", float(prefill_n)
+            )
+            return r
         self.router.add_replica(name, submit=None, on_drain=on_drain)
         # A re-registered replica starts with a clean slate: the breaker
         # memory of its previous life would otherwise short-circuit the
@@ -980,6 +1085,9 @@ class FleetFrontend:
             self.collector.add_target(name, metrics_target)
         self.prober.add_target(name, f"{self.url}/replica/{name}")
         self.metrics.set_gauge("frontend_replicas", float(count))
+        self.metrics.set_gauge(
+            "disagg_prefill_workers", float(prefill_n)
+        )
         self.metrics.set_gauge(
             "frontend_inflight_requests", 0.0, replica=name
         )
@@ -994,15 +1102,23 @@ class FleetFrontend:
             url = self._replicas.pop(name, None)
             self._inflight.pop(name, None)
             self._live.pop(name, None)
+            role = self._roles.pop(name, "decode")
             count = len(self._replicas)
+            prefill_n = sum(
+                1 for v in self._roles.values() if v == "prefill"
+            )
         if url is None:
             return False
-        self.router.remove_replica(name)
-        self.collector.remove_target(name)
-        self.prober.remove_target(name)
+        if role != "prefill":
+            self.router.remove_replica(name)
+            self.collector.remove_target(name)
+            self.prober.remove_target(name)
+            self.metrics.remove_gauge(
+                "frontend_inflight_requests", replica=name
+            )
         self.metrics.set_gauge("frontend_replicas", float(count))
-        self.metrics.remove_gauge(
-            "frontend_inflight_requests", replica=name
+        self.metrics.set_gauge(
+            "disagg_prefill_workers", float(prefill_n)
         )
         return True
 
@@ -1022,12 +1138,334 @@ class FleetFrontend:
             for name in names:
                 st = dict(snap.get(name) or {"replica": name})
                 st["url"] = self._replicas[name]
+                st["role"] = self._roles.get(name, "decode")
                 st["inflight_gateway"] = self._inflight.get(name, 0)
                 d = self._drains.get(name)
                 if d is not None:
                     st["drain"] = d["state"]
                 out.append(st)
         return out
+
+    # -- disaggregated prefill/decode (ISSUE 20) -----------------------------
+    def prefill_pool(self) -> list[str]:
+        """Registered prefill workers with a live URL, sorted — the
+        rendezvous candidate set."""
+        with self._lock:
+            return sorted(
+                n for n, r in self._roles.items()
+                if r == "prefill" and self._replicas.get(n)
+            )
+
+    def _classify(self, ids) -> bool:
+        """Prompt-length classification: True routes the request
+        through the disagg handover, False keeps the fused path.  The
+        effective threshold is floored to ``page_size + 1`` — a prompt
+        inside one page has no page-aligned chain to hand over.  The
+        seeded ``disagg.classify`` fault site models a broken
+        classifier: a fault counts
+        (``disagg_handover_failures_total{stage="classify"}``) and
+        degrades to the fused path — never a lost request."""
+        try:
+            global_faults.fire(
+                "disagg.classify", error_type=RuntimeError,
+                only=("error", "timeout"),
+            )
+        except (RuntimeError, TimeoutError):
+            self.metrics.inc(
+                "disagg_handover_failures_total", stage="classify"
+            )
+            return False
+        # Deliberately NO prefill-pool check here: classification is
+        # the DEMAND signal the ratio controller grows the pool from
+        # (a long prompt with zero prefill workers still counts as
+        # prefill flow); the handover itself degrades to the fused
+        # path when no worker exists to take it.
+        return len(ids) >= max(self.disagg_threshold, self._page + 1)
+
+    def _mix_account(
+        self, prompt_tokens: int, want_new: int, long_prompt: bool,
+    ) -> None:
+        """Traffic-mix accounting, the ratio controller's signal:
+        prompt tokens of disagg-classified (long) requests are prefill
+        flow, requested decode budgets are decode flow.  Mirrored into
+        federated counters so any scraper can recompute the
+        controller's input from ``/metrics``."""
+        with self._lock:
+            if long_prompt:
+                self._mix["prefill"] += float(prompt_tokens)
+            self._mix["decode"] += float(want_new)
+        if long_prompt:
+            self.metrics.inc(
+                "disagg_prefill_tokens_total", float(prompt_tokens)
+            )
+        self.metrics.inc(
+            "disagg_decode_tokens_total", float(want_new)
+        )
+
+    def _post_json(self, url: str, body: dict, timeout: float) -> dict:
+        """POST a JSON body, return the decoded JSON response; any
+        transport failure or non-2xx maps to RuntimeError so handover
+        callers have ONE failure type to degrade on."""
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            try:
+                detail = e.read().decode()[:200]
+            except (OSError, ValueError):
+                detail = ""
+            finally:
+                e.close()
+            raise RuntimeError(
+                f"POST {url} -> {e.code} {detail}"
+            ) from None
+        except (OSError, http.client.HTTPException, ValueError) as e:
+            raise RuntimeError(
+                f"POST {url} failed: {type(e).__name__}: {e}"
+            ) from None
+
+    def _disagg_handover(
+        self, ids, *, tenant, deadline, trace_ctx,
+        seed=0, temperature=0.0, top_p=0.0,
+    ):
+        """Prefill→export→wire→import for ONE long prompt; returns the
+        handover summary ({"prefill", "replica", "seconds", "blocks"})
+        or None to degrade to the fused path (the degradation matrix
+        in docs/platform/serving.md — a handover failure costs
+        re-prefill on the decode worker, never correctness).
+
+        The prefill worker is chosen by rendezvous hash on the chain
+        ROOT — the same HRW family the router uses — so repeated long
+        prompts sharing a prefix prefill where their pages are already
+        registered.  The decode destination is routed NOW (before
+        dispatch): ``router.route`` records the chain→owner assignment,
+        so the real dispatch moments later routes to the same owner by
+        affinity and the import lands exactly where decode runs."""
+        chain = shareable_chain(ids, self._page)
+        if not chain:
+            return None
+        pool = self.prefill_pool()
+        if not pool:
+            return None
+        with self._lock:
+            purls = {n: self._replicas.get(n) for n in pool}
+        pname = FleetRouter._rendezvous(chain[0], pool)
+        try:
+            dec = self.router.route(ids)
+        except RuntimeError:
+            return None          # no decode capacity: fused path sheds
+        dest_url = self._url_of(dec.replica)
+        if dest_url is None:
+            return None
+        timeout = self.request_timeout_s
+        if deadline is not None:
+            timeout = max(
+                0.001, min(timeout, deadline - self.clock.now())
+            )
+        t0 = self.clock.now()
+        s_at = global_tracer.clock.now()
+        stage = "prefill"
+        try:
+            global_faults.fire(
+                "disagg.handover", error_type=RuntimeError,
+                only=("error", "timeout"),
+            )
+            payload = self._post_json(
+                purls[pname] + "/prefill",
+                {
+                    "prompt_ids": [int(i) for i in ids],
+                    "seed": int(seed or 0),
+                    "temperature": float(temperature or 0.0),
+                    "top_p": float(top_p or 0.0),
+                    "tenant": tenant,
+                },
+                timeout,
+            )
+            stage = "import"
+            global_faults.fire(
+                "disagg.handover", error_type=RuntimeError,
+                only=("error", "timeout"),
+            )
+            imported = self._post_json(
+                dest_url + "/admin/import", payload, timeout
+            )
+        except (RuntimeError, TimeoutError) as e:
+            self.metrics.inc(
+                "disagg_handover_failures_total", stage=stage
+            )
+            log.warning(
+                "disagg handover failed at %s (prefill=%s dest=%s): "
+                "%s — degrading to fused path", stage, pname,
+                dec.replica, e,
+            )
+            return None
+        dt = self.clock.now() - t0
+        blocks = int(imported.get("imported", 0) or 0)
+        self.metrics.observe("disagg_handover_seconds", dt)
+        self.metrics.inc("disagg_requests_total", path="disagg")
+        if trace_ctx is not None:
+            # Span boundaries on the tracer's own clock (the
+            # _attempt_span discipline) so the waterfall's
+            # ``kv_handover`` segment shares the root span's timeline.
+            global_tracer.add_span(
+                "gateway.handover",
+                parent=trace_ctx,
+                start=s_at,
+                end=global_tracer.clock.now(),
+                prefill=pname,
+                replica=dec.replica,
+                blocks=blocks,
+            )
+        return {
+            "prefill": pname, "replica": dec.replica,
+            "seconds": dt, "blocks": blocks,
+        }
+
+    def ratio_state(self) -> dict:
+        """The ``GET /admin/ratio`` body: pools, threshold, and the
+        current traffic-mix window."""
+        with self._lock:
+            prefill = sorted(
+                n for n, r in self._roles.items() if r == "prefill"
+            )
+            decode = sorted(
+                n for n, r in self._roles.items() if r != "prefill"
+            )
+            mix = dict(self._mix)
+        return {
+            "enabled": self.ratio is not None,
+            "threshold": self.disagg_threshold,
+            "prefill_pool": prefill,
+            "decode_pool": decode,
+            "mix_window": {
+                "prefill_tokens": mix["prefill"],
+                "decode_tokens": mix["decode"],
+                "window_s": max(0.0, self.clock.now() - mix["t0"]),
+            },
+        }
+
+    def ratio_tick(self) -> dict:
+        """One controller evaluation: read-and-reset the traffic-mix
+        window, feed the rates to ``RatioController.decide``, and apply
+        a nonzero decision via ``reassign_replica``.  Deterministic
+        given the window contents and the clock — the operator loop
+        (or the ``POST /admin/ratio`` admin trigger, or a test) calls
+        this periodically; the controller's own cooldown makes the call
+        rate safe to choose freely."""
+        if self.ratio is None:
+            return {"enabled": False}
+        now = self.clock.now()
+        with self._lock:
+            window = max(1e-9, now - self._mix["t0"])
+            prefill_tps = self._mix["prefill"] / window
+            decode_tps = self._mix["decode"] / window
+            self._mix = {"prefill": 0.0, "decode": 0.0, "t0": now}
+            prefill = sorted(
+                n for n, r in self._roles.items() if r == "prefill"
+            )
+            decode = sorted(
+                n for n, r in self._roles.items() if r != "prefill"
+            )
+        d = self.ratio.decide(
+            prefill_workers=len(prefill),
+            decode_workers=len(decode),
+            prefill_tps=prefill_tps,
+            decode_tps=decode_tps,
+            now=now,
+        )
+        out = {
+            "enabled": True,
+            "target_prefill": d.target_prefill,
+            "reason": d.reason,
+            "direction": d.direction,
+            "prefill_tps": prefill_tps,
+            "decode_tps": decode_tps,
+            "reassigned": "",
+        }
+        if d.direction > 0 and decode:
+            # Grow prefill: the router's scale-down victim (fewest
+            # resident chains → cheapest KV loss) flips role.
+            victim = self.router.scale_down_victim()
+            if victim is not None and self.reassign_replica(
+                victim, "prefill"
+            ):
+                out["reassigned"] = victim
+        elif d.direction < 0 and prefill:
+            victim = prefill[0]
+            if self.reassign_replica(victim, "decode"):
+                out["reassigned"] = victim
+        return out
+
+    def reassign_replica(self, name: str, role: str) -> bool:
+        """Flip one worker between the decode and prefill pools — the
+        ratio controller's actuator.  →prefill removes the worker from
+        the router and the prober FIRST (no new routed traffic), then
+        best-effort flips the worker's own batcher role (a refusal —
+        409 while requests are in flight — leaves it a
+        gateway-side-only prefill worker until the next tick retries).
+        →decode flips the worker's batcher role first and only joins
+        it to the router once the worker CONFIRMS — a worker still
+        clamping budgets to 1 token must never receive routed decode
+        traffic."""
+        if role not in ("decode", "prefill"):
+            raise ValueError(f"unknown replica role {role!r}")
+        with self._lock:
+            url = self._replicas.get(name)
+            current = self._roles.get(name)
+        if url is None or current is None or current == role:
+            return False
+        if role == "prefill":
+            self.router.remove_replica(name)
+            self.prober.remove_target(name)
+            with self._lock:
+                self._roles[name] = "prefill"
+                prefill_n = sum(
+                    1 for v in self._roles.values() if v == "prefill"
+                )
+            self.metrics.set_gauge(
+                "disagg_prefill_workers", float(prefill_n)
+            )
+            try:
+                self._post_json(
+                    url + "/admin/role", {"role": "prefill"},
+                    self.request_timeout_s,
+                )
+            except RuntimeError as e:
+                log.warning(
+                    "role flip to prefill deferred on %s: %s", name, e
+                )
+            return True
+        try:
+            self._post_json(
+                url + "/admin/role", {"role": "decode"},
+                self.request_timeout_s,
+            )
+        except RuntimeError as e:
+            log.warning(
+                "role flip to decode refused on %s: %s", name, e
+            )
+            return False
+        with self._lock:
+            self._roles[name] = "decode"
+            prefill_n = sum(
+                1 for v in self._roles.values() if v == "prefill"
+            )
+        self.router.add_replica(name, submit=None)
+        self.breakers.get(name).record_success()
+        self.prober.add_target(name, f"{self.url}/replica/{name}")
+        self.metrics.set_gauge(
+            "disagg_prefill_workers", float(prefill_n)
+        )
+        return True
 
     # -- gateway fleet (ROADMAP item 3) --------------------------------------
     def add_peer(self, name: str, url: str) -> None:
@@ -1617,6 +2055,7 @@ class FleetFrontend:
         self, *, tenant, trace_ctx, reason, code, t0,
         replica="", route_reason="", prompt_tokens=0, tokens=0,
         attempts=1, extra=None, req_ids=None, req_body=None,
+        prefill_replica="", handover_s=0.0,
     ) -> None:
         e = {"status": int(code), "attempts": int(attempts)}
         e.update(extra or {})
@@ -1640,6 +2079,8 @@ class FleetFrontend:
             seed=int(body.get("seed", 0) or 0),
             replica=replica,
             route_reason=route_reason,
+            prefill_replica=prefill_replica,
+            handover=float(handover_s),
             prompt_tokens=int(prompt_tokens),
             tokens=int(tokens),
             deadline_expired=(reason == "deadline"),
@@ -1652,6 +2093,7 @@ class FleetFrontend:
     def dispatch(
         self, ids, body, *, tenant, deadline=None, trace_ctx=None,
         stream=False, pinned=None, exclude=None, migrated_from="",
+        handover=None,
     ) -> dict:
         """Route → breaker-gate → forward → classify, retrying per the
         failure matrix (module docstring).  Returns a response outcome
@@ -1664,8 +2106,14 @@ class FleetFrontend:
         stream-failover path must not resume on the victim it just
         lost); ``migrated_from`` stamps the downstream submit as a
         migration resume (``x-migrated-from`` — the replica journals
-        and counts it)."""
+        and counts it).  ``handover`` is a completed disagg handover's
+        summary ({"prefill", "seconds", ...}) — journaled onto the
+        request's record, never re-attempted here: if routing lands
+        somewhere other than the import destination, the decode worker
+        simply misses the warm chain and re-prefills (fused path)."""
         t0 = self.clock.now()
+        h_rep = (handover or {}).get("prefill", "")
+        h_s = float((handover or {}).get("seconds", 0.0) or 0.0)
         body = dict(body)
         body["tenant"] = tenant
         if pinned is not None:
@@ -1757,6 +2205,7 @@ class FleetFrontend:
                     route_reason=reason, prompt_tokens=len(ids),
                     tokens=int(payload.get("generated_tokens", 0) or 0),
                     attempts=contacts, req_ids=ids, req_body=body,
+                    prefill_replica=h_rep, handover_s=h_s,
                 )
                 return {
                     "kind": "json", "code": code, "payload": payload,
@@ -1789,6 +2238,7 @@ class FleetFrontend:
                         tokens=tokens, attempts=_c,
                         extra={"stream": True},
                         req_ids=ids, req_body=body,
+                        prefill_replica=h_rep, handover_s=h_s,
                     )
 
                 return {

@@ -105,6 +105,13 @@ class RequestRecord:
     # placement from these.
     replica: str = ""
     route_reason: str = ""    # affinity | load | fallback | ""
+    # Disaggregated prefill/decode handover (ISSUE 20): the prefill
+    # worker that computed this request's KV pages ("" when the
+    # request took the fused path) and the handover wall time —
+    # prefill + export + wire + import, the gateway's "gateway.handover"
+    # span — so replay diffs attribute disagg cost per request.
+    prefill_replica: str = ""
+    handover: float = 0.0     # seconds; 0.0 on the fused path
     slot: int = -1
     prompt_tokens: int = 0
     tokens: int = 0           # generated tokens actually delivered

@@ -1,0 +1,136 @@
+"""Prefill:decode worker-split controller for disaggregated serving.
+
+ISSUE 20: once prefill and decode run in separate worker pools
+(serve/frontend.py classifies by prompt length and hands long prompts
+prefill→export→import→decode over the migration wire), the pool SPLIT
+becomes a control problem — a long-prompt-heavy mix starves for
+prefill capacity while decode workers idle, and a short-prompt mix
+does the opposite.  ``RatioController`` closes that loop the same way
+``FleetAutoscaler`` closes the replica-count loop: a pure, Clock-driven
+FSM whose ``decide`` is a deterministic function of (pool sizes,
+observed token-arrival rates, clock time, last-action time) — the same
+scripted sequence produces byte-identical decisions under ``FakeClock``,
+which is what makes the reassignment testable and replayable.
+
+The signal is the *traffic mix*, not utilization: ``prefill_tps`` is
+the arrival rate of prompt tokens on disagg-classified (long) requests
+and ``decode_tps`` the arrival rate of requested decode tokens — both
+derived from the gateway's federated counters
+(``disagg_prefill_tokens_total`` / ``disagg_decode_tokens_total``), so
+any scraper can recompute the controller's input.  The desired prefill
+share of the pool is the prefill share of the token flow; the
+controller steps the split at most ``max_step`` worker(s) per action,
+holds inside a hysteresis deadband so a noisy mix never flaps a worker
+back and forth, and enforces a cooldown between actions exactly like
+the autoscaler (reassignment costs a drain + role flip on a real
+fleet).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.clock import Clock, RealClock
+from ..utils.metrics import MetricsRegistry, global_metrics
+
+
+@dataclass
+class RatioDecision:
+    target_prefill: int
+    reason: str      # mix_shift | hold | cooldown | idle
+    direction: int   # +1 grow prefill pool, -1 shrink, 0 hold
+
+
+class RatioController:
+    """Deterministic prefill:decode split FSM over the traffic mix.
+
+    ``decide`` never moves more than ``max_step`` workers per action,
+    never shrinks the decode pool below ``min_decode`` (decode owns the
+    resident KV — a fleet with no decode workers serves nothing), and
+    never acts twice inside ``cooldown_s``.  With no traffic at all it
+    holds (``idle``): a quiet fleet keeps its last shape rather than
+    collapsing to a default."""
+
+    def __init__(
+        self,
+        *,
+        min_prefill: int = 0,
+        min_decode: int = 1,
+        clock: Clock | None = None,
+        cooldown_s: float = 30.0,
+        max_step: int = 1,
+        deadband: float = 0.15,
+        metrics: MetricsRegistry | None = None,
+    ):
+        """``deadband``: minimum absolute gap between the observed
+        prefill token share and the current prefill worker share
+        before a move is worth a reassignment — the hysteresis that
+        keeps a mix hovering near a pool boundary from flapping a
+        worker every cooldown."""
+        self.min_prefill = max(0, int(min_prefill))
+        self.min_decode = max(1, int(min_decode))
+        self.clock = clock or RealClock()
+        self.cooldown_s = float(cooldown_s)
+        self.max_step = max(1, int(max_step))
+        self.deadband = max(0.0, float(deadband))
+        self.metrics = metrics if metrics is not None else global_metrics
+        self._last_action = float("-inf")
+
+    def decide(
+        self,
+        *,
+        prefill_workers: int,
+        decode_workers: int,
+        prefill_tps: float = 0.0,
+        decode_tps: float = 0.0,
+        now: float | None = None,
+    ) -> RatioDecision:
+        """``prefill_tps``/``decode_tps``: token-arrival rates over the
+        gateway's observation window (tokens/second; any consistent
+        unit works — only the RATIO enters the decision)."""
+        now = self.clock.now() if now is None else now
+        prefill = max(0, int(prefill_workers))
+        decode = max(0, int(decode_workers))
+        total = prefill + decode
+        if total <= 0:
+            return self._hold(prefill, "idle")
+        flow = float(prefill_tps) + float(decode_tps)
+        if flow <= 0.0:
+            return self._hold(prefill, "idle")
+        share = float(prefill_tps) / flow
+        current = prefill / total
+        if abs(share - current) <= self.deadband:
+            return self._hold(prefill, "hold")
+        # Deterministic round-half-up (round() would bank to even), then
+        # clamp to the pool-shape floors.
+        desired = int(share * total + 0.5)
+        desired = min(max(desired, self.min_prefill), total - self.min_decode)
+        if desired == prefill:
+            return self._hold(prefill, "hold")
+        if now - self._last_action < self.cooldown_s:
+            return self._hold(prefill, "cooldown")
+        step = min(self.max_step, abs(desired - prefill))
+        target = prefill + step if desired > prefill else prefill - step
+        return self._act(prefill, target, now)
+
+    def _hold(self, prefill: int, reason: str) -> RatioDecision:
+        self.metrics.set_gauge(
+            "disagg_ratio_target_prefill", float(prefill)
+        )
+        return RatioDecision(
+            target_prefill=prefill, reason=reason, direction=0
+        )
+
+    def _act(self, prefill: int, target: int, now: float) -> RatioDecision:
+        self._last_action = now
+        direction = 1 if target > prefill else -1
+        self.metrics.inc(
+            "disagg_ratio_actions_total",
+            direction="grow" if direction > 0 else "shrink",
+        )
+        self.metrics.set_gauge(
+            "disagg_ratio_target_prefill", float(target)
+        )
+        return RatioDecision(
+            target_prefill=target, reason="mix_shift", direction=direction
+        )

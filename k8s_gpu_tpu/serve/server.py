@@ -30,6 +30,7 @@ from ..utils.obs import RequestMetricsMixin
 from .batcher import ContinuousBatcher, Overloaded
 from .journal import PROBE_TENANT
 from .journal import RequestRecord as JournalRecord
+from .kv_blocks import chunk_hashes, shareable_depth
 from .migrate import pack as migrate_pack
 from .migrate import unpack as migrate_unpack
 
@@ -62,6 +63,7 @@ class LmServer:
         max_pending: int = 64,
         metrics=None,
         name: str = "",
+        role: str = "both",
     ):
         """``max_pending`` bounds the batcher's unadmitted-request queue:
         at the bound, /generate sheds with 429 + Retry-After instead of
@@ -98,7 +100,16 @@ class LmServer:
         /readyz JSON bodies next to the live in-flight count — the
         scrape-free fast path a draining front-end polls
         (serve/frontend.py) and a sanity check that a gateway is
-        talking to the replica it thinks it is."""
+        talking to the replica it thinks it is.
+
+        ``role`` (ISSUE 20, disaggregated serving): ``"prefill"``
+        makes this a dedicated prefill worker — every /generate or
+        /prefill budget clamps to the one admission-sampled token and
+        the executor refuses decode rounds outright; ``"decode"`` and
+        ``"both"`` serve normally (the gateway's classifier, not this
+        process, keeps long prompts off decode workers).  The live
+        role is flippable via POST /admin/role while idle — the ratio
+        controller's reassignment path."""
         cbank = None
         if constraints:
             from .constrain import ConstraintBank
@@ -112,7 +123,7 @@ class LmServer:
             constraints=cbank, eos_id=eos_id, logprobs=True,
             draft=draft, spec_k=spec_k, kv_quant=kv_quant,
             paged_blocks=paged_blocks, page_size=page_size,
-            max_pending=max_pending, metrics=metrics,
+            max_pending=max_pending, metrics=metrics, role=role,
         )
         # The per-request lifecycle ring — hand to a MetricsServer's
         # ``journal=`` to serve it at /debug/requests.
@@ -139,8 +150,10 @@ class LmServer:
         class Handler(RequestMetricsMixin, BaseHTTPRequestHandler):
             metrics_server_label = "lm-server"
             known_routes = ("/generate", "/tokenize", "/precache",
+                            "/prefill",
                             "/healthz", "/readyz", "/debug/chains",
-                            "/admin/export", "/admin/import")
+                            "/admin/export", "/admin/import",
+                            "/admin/role")
 
             def _get(self):
                 if self.path == "/debug/chains":
@@ -199,11 +212,129 @@ class LmServer:
                     except ValueError as e:
                         return self._json(400, {"error": str(e)})
                     return self._json(200, {"cached_tokens": int(ids.size)})
+                if self.path == "/prefill":
+                    return self._prefill(body)
                 if self.path == "/admin/export":
                     return self._admin_export(body)
                 if self.path == "/admin/import":
                     return self._admin_import(body)
+                if self.path == "/admin/role":
+                    return self._admin_role(body)
                 return self._json(404, {"error": "not found"})
+
+            def _prefill(self, body):
+                """Disaggregated prefill (ISSUE 20): admit + prefill
+                the prompt into this replica's paged pool, then export
+                exactly that prompt's registered page chain over the
+                migration wire format (serve/migrate.py).  The 1-token
+                admission sample is discarded — the decode worker
+                recomputes the suffix (and that token) from the
+                imported chain byte-identically, because sampling is
+                seeded per request, not per process.  Returns the
+                packed payload plus the hex ``chain`` the gateway
+                forwards to the decode owner's /admin/import.  No
+                ``migrating`` readiness latch: this is a per-chain
+                export on a worker the gateway never routes decode
+                traffic to, and flapping /readyz per prefill would
+                churn the fleet's health view."""
+                prompt_ids = body.get("prompt_ids")
+                if (not isinstance(prompt_ids, list) or not prompt_ids
+                        or not all(
+                            isinstance(i, int)
+                            and not isinstance(i, bool)
+                            for i in prompt_ids
+                        )):
+                    return self._json(400, {
+                        "error": "prompt_ids must be a non-empty "
+                                 "list of ints"})
+                if not outer.batcher.paged:
+                    return self._json(400, {
+                        "error": "disaggregated prefill requires "
+                                 "paged KV mode"})
+                ids = np.asarray(prompt_ids, np.int32)
+                page = int(outer.batcher.page_size)
+                depth = shareable_depth(int(ids.size), page)
+                if depth <= 0:
+                    return self._json(400, {
+                        "error": "prompt too short for page-aligned "
+                                 f"handover (needs > {page} tokens)"})
+                try:
+                    seed = int(body.get("seed", 0))
+                    temperature = float(body.get("temperature", 0.0))
+                    top_p = float(body.get("top_p", 0.0))
+                except (TypeError, ValueError) as e:
+                    return self._json(400, {"error": f"bad parameter: {e}"})
+                tenant = body.get("tenant")
+                if tenant is not None and not isinstance(tenant, str):
+                    return self._json(
+                        400, {"error": "tenant must be a string"})
+                t0 = time.perf_counter()
+                try:
+                    handle = outer.batcher.submit(
+                        ids, max_new_tokens=1, temperature=temperature,
+                        top_p=top_p, seed=seed, tenant=tenant,
+                    )
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                except Overloaded as e:
+                    return self._json(
+                        429, {"error": str(e)},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+                except RuntimeError as e:
+                    return self._json(
+                        503, {"error": str(e)},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+                handle.result()
+                if handle.aborted:
+                    return self._json(
+                        503, {"error": "prefill aborted: server "
+                                       "shutting down or batcher "
+                                       "crashed"},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+                chain = chunk_hashes(ids, page)[:depth]
+                try:
+                    snap = outer.batcher.run_quiesced(
+                        lambda: outer.batcher.migrate_export(
+                            hashes=chain,
+                        )
+                    )
+                except (RuntimeError, TimeoutError) as e:
+                    return self._json(
+                        503, {"error": str(e)},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+                payload = migrate_pack(snap)
+                payload["replica"] = outer.name
+                payload["chain"] = [h.hex() for h in chain]
+                payload["prefill_s"] = round(
+                    time.perf_counter() - t0, 6)
+                return self._json(200, payload)
+
+            def _admin_role(self, body):
+                """Flip this replica's executor role — the ratio
+                controller's reassignment path (serve/ratio.py).
+                Refused while requests are in flight: a prefill-only
+                executor raises on any decode round, so flipping under
+                live streams would crash the scheduler instead of
+                degrading."""
+                role = body.get("role")
+                if role not in ("both", "prefill", "decode"):
+                    return self._json(
+                        400, {"error": f"unknown role {role!r}"})
+                if outer.batcher.inflight_requests > 0:
+                    return self._json(
+                        409,
+                        {"error": "role flip refused: requests in "
+                                  "flight"},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+                outer.batcher.role = role
+                return self._json(200, {
+                    "replica": outer.name, "role": role,
+                })
 
             def _admin_export(self, body):
                 """Serialize this replica's registered KV blocks into
@@ -592,6 +723,10 @@ class LmServer:
             # lets registration verify it reached the right process.
             "replica": self.name,
             "inflight": self.batcher.inflight_requests,
+            # Disagg role (ISSUE 20): the gateway's registration and
+            # the ratio controller's reassignment both verify the
+            # worker really is in the role they think it is.
+            "role": self.batcher.role,
         }
 
     def chain_state(self) -> dict:

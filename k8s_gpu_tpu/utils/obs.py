@@ -775,9 +775,14 @@ def render_requests(records: list[dict]) -> str:
     if not records:
         return "no journal records (no requests retired yet)"
     routed = any(r.get("replica") for r in records)
+    # Disagg handover columns appear only when some request actually
+    # handed over — the same conditional-column discipline as ROUTE.
+    disagg = any(r.get("prefill_replica") for r in records)
     head = f"  {'TENANT':<12} {'REASON':<11} {'PATH':<13} "
     if routed:
         head += f"{'REPLICA':<12} {'ROUTE':<9} "
+    if disagg:
+        head += f"{'PREFILL':<12} {'HAND(MS)':>9} "
     head += (
         f"{'TOK':>5} {'WAIT(MS)':>9} {'TTFT(MS)':>9} {'TPOT(MS)':>9} "
         f"{'PFX':>4} {'ACC%':>5}  TRACE"
@@ -796,6 +801,12 @@ def render_requests(records: list[dict]) -> str:
             line += (
                 f"{(r.get('replica') or '-'):<12} "
                 f"{(r.get('route_reason') or '-'):<9} "
+            )
+        if disagg:
+            h = r.get("handover", 0.0) or 0.0
+            line += (
+                f"{(r.get('prefill_replica') or '-'):<12} "
+                f"{(f'{h * 1000:.1f}' if h else '-'):>9} "
             )
         line += (
             f"{r['tokens']:>5} "

@@ -33,7 +33,10 @@ routing, network, replica queue, prefill, or decode?".
   trace.
 - **attribution**: a priority interval sweep over the client-observed
   window decomposes E2E (and TTFT, when a prefill span marks the first
-  token) into an exhaustive partition — ``gateway_route`` (request
+  token) into an exhaustive partition — ``kv_handover`` (disagg
+  prefill→export→wire→import time, the gateway's ``gateway.handover``
+  span; claimed before ``gateway_route`` because the handover happens
+  inside the pre-dispatch window), ``gateway_route`` (request
   start → first contact), one ``retry_hop`` per failed rehash attempt
   (a kill-mid-burst request shows the dead replica's partial spans AND
   the survivor's completion in one trace), ``network_gap`` (serving
@@ -67,6 +70,7 @@ from .metrics import MetricsRegistry, global_metrics
 # a fused prefill covers the first decode round), and ``unattributed``
 # is the residual that makes the sum exact.
 SEGMENTS = (
+    "kv_handover",
     "gateway_route",
     "retry_hop",
     "network_gap",
@@ -141,7 +145,8 @@ def split_by_process(
     test/demo harness for real stitching without real processes.
 
     Gateway spans are those labeled ``server=<gateway_label>`` plus
-    every ``gateway.dispatch``; a span parented to a dispatch span
+    every ``gateway.dispatch``/``gateway.handover``; a span parented
+    to a dispatch span
     belongs to that dispatch's ``replica``; everything else inherits
     its parent's process.  The replica fragment's server span keeps its
     (now unresolved) ``parent_id`` — exactly what a real per-process
@@ -157,7 +162,8 @@ def split_by_process(
         for s in spans:
             attrs = s.get("attributes") or {}
             if (
-                s.get("name") == "gateway.dispatch"
+                s.get("name") in ("gateway.dispatch",
+                                  "gateway.handover")
                 or attrs.get("server") == gateway_label
             ):
                 proc[str(s.get("span_id"))] = gateway_name
@@ -544,9 +550,19 @@ class FleetTraceAssembler:
         if serving is None:
             serving = dispatch[-1]
 
-        claims: list[tuple[str, float, float]] = [
-            ("gateway_route", R0, a0(dispatch[0])),
-        ]
+        claims: list[tuple[str, float, float]] = []
+        # kv_handover claims FIRST: the disagg handover runs inside
+        # the pre-dispatch window whose whole span gateway_route
+        # claims next — claim-list order is claim priority, so the
+        # handover span must win its interval or it vanishes into
+        # gateway_route.
+        for h in sorted(
+            (s for s in spans.values()
+             if s.get("name") == "gateway.handover"),
+            key=lambda s: (t0(s), str(s.get("span_id"))),
+        ):
+            claims.append(("kv_handover", a0(h), a1(h)))
+        claims.append(("gateway_route", R0, a0(dispatch[0])))
         for i, d in enumerate(dispatch):
             if d is serving:
                 continue

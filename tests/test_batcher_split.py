@@ -1,0 +1,154 @@
+"""Batcher split (ISSUE 20): scheduler/allocator/executor compose back
+into the SAME batcher.
+
+The ~3.4k-line serve/batcher.py monolith split into serve/scheduler.py
+(admission, queueing, round policy), serve/allocator.py (BlockPool
+interaction, page planning, migration payloads) and serve/executor.py
+(prefill and decode device programs); ``ContinuousBatcher`` remains as
+the thin composition owning all mutable state.  Contract: the split is
+a pure relocation — greedy, sampled, speculative and paged-prefix
+streams are byte-identical through the composed class — and the
+prefill-only executor role never emits a decode token.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from k8s_gpu_tpu.models import TransformerConfig, TransformerLM
+from k8s_gpu_tpu.serve import ContinuousBatcher
+from k8s_gpu_tpu.serve.allocator import AllocatorMixin
+from k8s_gpu_tpu.serve.executor import ExecutorMixin
+from k8s_gpu_tpu.serve.scheduler import SchedulerMixin
+
+CFG = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_head=16,
+    d_ff=64, max_seq=128, use_flash=False, dtype=jnp.float32,
+)
+MODEL = TransformerLM(CFG)
+PARAMS = MODEL.init(jax.random.PRNGKey(0))
+
+PROMPTS = [
+    [3, 5, 7],
+    list(range(2, 24)),        # crosses a 16-token page
+    [11, 13],
+    list(range(40, 75)),       # multi-page
+]
+
+
+def _run(batcher_kwargs, reqs):
+    b = ContinuousBatcher(MODEL, PARAMS, slots=4, **batcher_kwargs).start()
+    try:
+        handles = [b.submit(ids, **kw) for ids, kw in reqs]
+        return [h.result() for h in handles]
+    finally:
+        b.stop()
+
+
+def _oracle(ids, n):
+    seq = jnp.asarray(ids, jnp.int32)[None, :]
+    out = []
+    for _ in range(n):
+        logits, _ = MODEL.forward(PARAMS, seq)
+        nxt = int(jnp.argmax(logits[:, -1], axis=-1)[0])
+        out.append(nxt)
+        seq = jnp.concatenate(
+            [seq, jnp.asarray([[nxt]], jnp.int32)], axis=1
+        )
+    return out
+
+
+# -- composition is structural, not copied code ------------------------------
+
+def test_compose_module_boundaries():
+    """Each plane's methods actually LIVE in their module: the split is
+    real (a regression that quietly reintroduces a monolith method on
+    the composition class fails here)."""
+    assert issubclass(ContinuousBatcher, SchedulerMixin)
+    assert issubclass(ContinuousBatcher, AllocatorMixin)
+    assert issubclass(ContinuousBatcher, ExecutorMixin)
+    sched = "k8s_gpu_tpu.serve.scheduler"
+    alloc = "k8s_gpu_tpu.serve.allocator"
+    execu = "k8s_gpu_tpu.serve.executor"
+    for name, mod in [
+        ("submit", sched), ("_loop", sched), ("_dispatch_round", sched),
+        ("run_quiesced", sched), ("_free_slot", sched),
+        ("_paged_plan", alloc), ("migrate_export", alloc),
+        ("migrate_import", alloc), ("_blocks_needed", alloc),
+        ("_round_dev", execu), ("_admit_dev", execu),
+        ("_guard_decode", execu), ("_spec_accept", execu),
+    ]:
+        assert getattr(ContinuousBatcher, name).__module__ == mod, name
+
+
+# -- stream parity through the composed class --------------------------------
+
+def test_greedy_streams_match_oracle():
+    got = _run({}, [(p, dict(max_new_tokens=10)) for p in PROMPTS])
+    for p, toks in zip(PROMPTS, got):
+        assert toks == _oracle(p, 10), p
+
+
+def test_sampled_streams_two_run_identical():
+    reqs = [
+        (p, dict(max_new_tokens=8, temperature=0.8, seed=41 + i))
+        for i, p in enumerate(PROMPTS)
+    ]
+    assert _run({}, reqs) == _run({}, reqs)
+
+
+def test_spec_ngram_matches_plain_greedy():
+    reqs = [(p, dict(max_new_tokens=10)) for p in PROMPTS]
+    plain = _run({}, reqs)
+    spec = _run({"draft": "ngram", "spec_k": 3}, reqs)
+    assert spec == plain
+
+
+def test_paged_prefix_streams_match_dense():
+    """Paged admission with a shared warm prefix (the second request
+    acquires the first's registered chain) is still byte-identical to
+    the dense batcher."""
+    base = list(range(2, 36))
+    reqs = [
+        (base + [77], dict(max_new_tokens=8)),
+        (base + [78], dict(max_new_tokens=8)),
+    ]
+    dense = _run({}, reqs)
+    paged = _run({"paged_blocks": 64, "page_size": 16}, reqs)
+    assert paged == dense
+
+
+# -- prefill-only executor role ----------------------------------------------
+
+def test_prefill_role_emits_no_decode_tokens():
+    """A prefill-role batcher retires every request at admission: the
+    stream is exactly the ONE admission-sampled token (greedy: the
+    oracle's first token) regardless of the requested budget, and no
+    decode round ever ran."""
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=2, paged_blocks=64, page_size=16,
+        role="prefill",
+    ).start()
+    try:
+        ids = list(range(2, 24))
+        got = b.submit(ids, max_new_tokens=16).result()
+        assert got == _oracle(ids, 1)
+        assert b.steps_taken == 0, "a decode round ran on a prefill worker"
+    finally:
+        b.stop()
+
+
+def test_prefill_role_guard_refuses_decode_dispatch():
+    b = ContinuousBatcher(
+        MODEL, PARAMS, slots=2, paged_blocks=64, page_size=16,
+        role="prefill",
+    )
+    with pytest.raises(RuntimeError, match="prefill-only"):
+        b._guard_decode()
+    # The decode/both roles never trip the guard.
+    ContinuousBatcher(MODEL, PARAMS, slots=2)._guard_decode()
+
+
+def test_unknown_role_rejected():
+    with pytest.raises(ValueError, match="role"):
+        ContinuousBatcher(MODEL, PARAMS, slots=2, role="verify")
